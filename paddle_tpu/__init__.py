@@ -1,0 +1,118 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface (reference: python/paddle/__init__.py, 387 exports),
+built on JAX/XLA/Pallas/pjit rather than ported from the CUDA design.
+"""
+
+from __future__ import annotations
+
+# dtypes
+from ._core.dtype import (  # noqa: F401
+    DType,
+    bfloat16,
+    bool_ as bool8,
+    complex64,
+    complex128,
+    dtype,
+    float16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from ._core.place import (  # noqa: F401
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from ._core.flags import get_flags, set_flags  # noqa: F401
+from ._core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from ._core.tensor import Parameter, Tensor  # noqa: F401
+from ._core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from ._core.autograd import grad  # noqa: F401
+
+# Full tensor-op surface (also patches Tensor methods).
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation as _creation  # noqa: F401
+
+# Common bool dtype name
+from ._core import dtype as _dtype_mod
+
+bool = _dtype_mod.bool_  # noqa: A001
+
+# Subpackages land incrementally; import what exists.
+import importlib as _importlib
+
+for _sub in (
+    "autograd",
+    "nn",
+    "optimizer",
+    "amp",
+    "io",
+    "device",
+    "framework",
+    "jit",
+    "static",
+    "distributed",
+    "incubate",
+    "metric",
+    "vision",
+    "linalg",
+):
+    try:
+        globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
+    except ModuleNotFoundError:
+        pass
+
+try:
+    from .framework.io_utils import load, save  # noqa: F401,E402
+except ImportError:
+    pass
+try:
+    from .nn.layer.layers import Layer  # noqa: F401,E402
+except ImportError:
+    pass
+
+__version__ = "0.1.0"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+_static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True

@@ -1,0 +1,8 @@
+import jax as _jax
+
+# Paddle dtype semantics: int64 creation defaults, float64 available.  XLA
+# still computes the hot path in bf16/f32 (models pass explicit dtypes);
+# x64 here is about API parity, not compute width.
+_jax.config.update("jax_enable_x64", True)
+
+from . import autograd, dtype, flags, place, random, tensor  # noqa: F401

@@ -1,0 +1,158 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (paddle.float32 etc.; reference:
+paddle/phi/common/data_type.h, python/paddle/framework/dtype.py) but is a thin
+veneer over numpy/jax dtypes — on TPU the canonical compute dtype is bfloat16
+and XLA owns layout, so no DataLayout/LoD machinery is reproduced.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "DType",
+    "dtype",
+    "bool_",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+    "float8_e4m3fn",
+    "float8_e5m2",
+    "to_jax_dtype",
+    "to_paddle_dtype",
+    "is_floating_dtype",
+    "is_integer_dtype",
+    "is_complex_dtype",
+    "promote_types",
+]
+
+
+class DType:
+    """A framework dtype: hashable, comparable with strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype", "itemsize")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        self.itemsize = self.np_dtype.itemsize
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            other_norm = _STR_ALIASES.get(other, other)
+            return self.name == other_norm
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        if result is NotImplemented:
+            return result
+        return not result
+
+    @property
+    def is_floating_point(self) -> bool:
+        return is_floating_dtype(self)
+
+    @property
+    def is_complex(self) -> bool:
+        return is_complex_dtype(self)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+
+_STR_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+    "bfloat": "bfloat16",
+}
+
+_FLOATING = {"float16", "bfloat16", "float32", "float64", "float8_e4m3fn", "float8_e5m2"}
+_INTEGER = {"uint8", "int8", "int16", "int32", "int64"}
+_COMPLEX = {"complex64", "complex128"}
+
+
+def dtype(obj) -> DType:
+    """Coerce a string / numpy dtype / DType into a DType."""
+    return to_paddle_dtype(obj)
+
+
+def to_paddle_dtype(obj) -> DType:
+    if isinstance(obj, DType):
+        return obj
+    if isinstance(obj, str):
+        name = _STR_ALIASES.get(obj, obj)
+        if name in DType._registry:
+            return DType._registry[name]
+        raise ValueError(f"Unknown dtype string: {obj!r}")
+    np_dt = np.dtype(obj)
+    for dt in DType._registry.values():
+        if dt.np_dtype == np_dt:
+            return dt
+    raise ValueError(f"Unsupported dtype: {obj!r}")
+
+
+def to_jax_dtype(obj):
+    """Coerce to a numpy dtype usable by jax.numpy."""
+    if obj is None:
+        return None
+    return to_paddle_dtype(obj).np_dtype
+
+
+def is_floating_dtype(dt) -> bool:
+    return to_paddle_dtype(dt).name in _FLOATING
+
+
+def is_integer_dtype(dt) -> bool:
+    return to_paddle_dtype(dt).name in _INTEGER
+
+
+def is_complex_dtype(dt) -> bool:
+    return to_paddle_dtype(dt).name in _COMPLEX
+
+
+def promote_types(a, b) -> DType:
+    return to_paddle_dtype(jnp.promote_types(to_jax_dtype(a), to_jax_dtype(b)))

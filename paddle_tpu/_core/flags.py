@@ -1,0 +1,70 @@
+"""Global flag registry.
+
+Equivalent of the reference's exported-flags system (paddle/phi/core/flags.h:141,
+paddle.get_flags/set_flags) with env-var override (FLAGS_*), minus the C++
+gflags machinery — a process-wide Python registry is the right weight here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+_FLAGS: dict[str, dict[str, Any]] = {}
+
+
+def _coerce(value, default):
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    value = _coerce(env, default) if env is not None else default
+    _FLAGS[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def flag(name: str):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _FLAGS[name]["value"]
+
+
+def get_flags(flags=None) -> dict:
+    if flags is None:
+        return {k: v["value"] for k, v in _FLAGS.items()}
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        out[name] = _FLAGS[key]["value"]
+    return out
+
+
+def set_flags(flags: dict):
+    for name, value in flags.items():
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _FLAGS:
+            define_flag(key, value)
+        else:
+            _FLAGS[key]["value"] = _coerce(value, _FLAGS[key]["default"])
+
+
+# Core flags (subset of the reference's 71 exported flags that are meaningful on TPU).
+define_flag("FLAGS_check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
+define_flag("FLAGS_default_dtype", "float32", "Default floating dtype for creation ops")
+define_flag("FLAGS_tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("FLAGS_eager_op_jit", True, "Route eager composite ops through cached jax.jit")

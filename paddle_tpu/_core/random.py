@@ -1,0 +1,77 @@
+"""Global RNG state.
+
+The reference keeps stateful per-device generators (python/paddle/framework/random.py,
+CUDA Philox states).  On TPU/XLA randomness must be functional: every random op
+consumes a jax PRNG key.  This module provides paddle-style stateful semantics
+in eager mode (a global seed + call counter) while staying jit-compatible: a
+traced training step installs an explicit key via `key_scope`, and all random
+ops inside the trace fold the call counter into that traced key — so randomness
+varies per step through a threaded key rather than a baked constant.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "key_scope", "split_key"]
+
+
+class _RNG(threading.local):
+    def __init__(self):
+        self.seed = 0
+        self.counter = 0
+        self.trace_key = None  # explicit key installed by key_scope
+
+
+_rng = _RNG()
+
+
+def seed(s: int):
+    """paddle.seed equivalent: reset the global generator."""
+    _rng.seed = int(s)
+    _rng.counter = 0
+    return _rng
+
+
+def get_rng_state():
+    return (_rng.seed, _rng.counter)
+
+
+def set_rng_state(state):
+    _rng.seed, _rng.counter = int(state[0]), int(state[1])
+
+
+def next_key():
+    """Return a fresh PRNG key; advances the global counter.
+
+    Inside `key_scope(step_key)` (used by jitted training steps) the returned
+    key derives from the scoped key, so it is a proper traced value.
+    """
+    c = _rng.counter
+    _rng.counter += 1
+    if _rng.trace_key is not None:
+        return jax.random.fold_in(_rng.trace_key, c)
+    base = jax.random.key(_rng.seed)
+    return jax.random.fold_in(base, c)
+
+
+def split_key(n: int):
+    return jax.random.split(next_key(), n)
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Install an explicit PRNG key (typically a tracer inside jit).
+
+    Counter restarts at 0 within the scope so a given op sequence folds
+    deterministic per-call offsets into the per-step key.
+    """
+    prev_key, prev_counter = _rng.trace_key, _rng.counter
+    _rng.trace_key, _rng.counter = key, 0
+    try:
+        yield
+    finally:
+        _rng.trace_key, _rng.counter = prev_key, prev_counter

@@ -1,0 +1,328 @@
+"""Tensor facade.
+
+Capability equivalent of the reference's eager Tensor
+(paddle/phi/core/dense_tensor.h + pybind methods in
+paddle/fluid/pybind/eager_method.cc, math patches in eager_math_op_patch.cc),
+built as a thin wrapper over jax.Array:
+
+- the payload is a jax.Array (or a tracer inside jit) — XLA owns memory,
+  layout, and streams, so there is no allocator/LoD/stride machinery here;
+- autograd metadata (stop_gradient, grad, grad node) lives on the wrapper,
+  the tape itself is in `paddle_tpu._core.autograd`;
+- Tensor is a registered pytree node so user code written against this API
+  can be traced by jax.jit / shard_map unchanged.
+
+Op methods (t.add, t.reshape, ...) are patched onto the class by
+`paddle_tpu.tensor` at import time, mirroring the reference's monkey-patch
+approach (python/paddle/base/dygraph/math_op_patch.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .place import Place, get_default_device
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+def _as_value(data, dt=None):
+    if isinstance(data, Tensor):
+        v = data._value
+        return v.astype(dtype_mod.to_jax_dtype(dt)) if dt is not None else v
+    if isinstance(data, (jax.Array, jnp.ndarray)) and not isinstance(data, np.ndarray):
+        return data.astype(dtype_mod.to_jax_dtype(dt)) if dt is not None else data
+    arr = np.asarray(data)
+    if dt is not None:
+        arr = arr.astype(dtype_mod.to_jax_dtype(dt))
+    elif arr.dtype == np.float64:
+        # Match the reference default of float32 for Python floats.
+        arr = arr.astype(np.float32)
+    elif arr.dtype == np.int64 and np.isscalar(data):
+        arr = arr.astype(np.int64)  # keep int64 for scalars, as paddle does
+    return jnp.asarray(arr)
+
+
+class Tensor:
+    """Eager tensor with autograd metadata over a jax.Array payload."""
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "_hooks",
+        "name",
+        "persistable",
+        "__weakref__",
+    )
+
+    # populated by paddle_tpu.tensor to break import cycles
+    _op_module = None
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = ""):
+        self._value = value if isinstance(value, (jax.Array,)) or _is_tracer(value) else _as_value(value)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return dtype_mod.to_paddle_dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        return get_default_device()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    @property
+    def T(self):
+        return self.transpose(list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return self.transpose(perm)
+
+    # ------------------------------------------------------------- conversion
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_txt = f", stop_gradient={self.stop_gradient}"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_txt},\n"
+            f"       {np.asarray(jax.device_get(self._value)) if not _is_tracer(self._value) else self._value})"
+        )
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from . import autograd
+
+        autograd.backward_from(self, grad_tensor, retain_graph)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Removable()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from . import autograd
+
+        return autograd.apply("clone", lambda v: v + jnp.zeros((), v.dtype), self)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    @requires_grad.setter
+    def requires_grad(self, value):
+        self.stop_gradient = not value
+
+    # --------------------------------------------------------------- device
+    def to(self, *args, **kwargs):
+        """to(place)/to(dtype)/to(place, dtype) — device moves are handled by
+        jax.device_put; dtype converts via cast."""
+        target_dtype = None
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, dtype_mod.DType)):
+                try:
+                    target_dtype = dtype_mod.to_paddle_dtype(a)
+                except ValueError:
+                    pass  # a device string
+        out = self
+        if target_dtype is not None and target_dtype != self.dtype:
+            out = out.astype(target_dtype)
+        return out
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ------------------------------------------------------------ value ops
+    def set_value(self, value):
+        """In-place payload replacement (used by optimizers / state loading)."""
+        v = _as_value(value)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: tensor {tuple(self._value.shape)} vs value {tuple(v.shape)}"
+            )
+        self._value = v.astype(self._value.dtype) if not _is_tracer(v) and not _is_tracer(self._value) else v
+        return self
+
+    def _bind(self, value):
+        """Rebind payload without checks (tracer binding for functionalization)."""
+        self._value = value
+        return self
+
+    # Indexing delegates to the op layer for tape support.
+    def __getitem__(self, idx):
+        from paddle_tpu.tensor import manipulation
+
+        return manipulation._getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from paddle_tpu.tensor import manipulation
+
+        manipulation._setitem_(self, idx, value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient defaults to False (reference:
+    python/paddle/base/framework.py EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, trainable: bool = True, name: str = ""):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor equivalent (reference python/paddle/tensor/creation.py)."""
+    return Tensor(_as_value(data, dtype), stop_gradient=stop_gradient)
+
+
+# ------------------------------------------------------------------ pytree
+def _flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _unflatten(aux, children):
+    t = Tensor.__new__(Tensor)
+    t._value = children[0]
+    t.stop_gradient, t.name = aux
+    t.grad = None
+    t._grad_node = None
+    t._out_index = 0
+    t._hooks = []
+    t.persistable = False
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _flatten, _unflatten)
+
+
+def _flatten_param(p: Parameter):
+    return (p._value,), (p.stop_gradient, p.name)
+
+
+def _unflatten_param(aux, children):
+    p = Parameter.__new__(Parameter)
+    p._value = children[0]
+    p.stop_gradient, p.name = aux
+    p.grad = None
+    p._grad_node = None
+    p._out_index = 0
+    p._hooks = []
+    p.trainable = not p.stop_gradient
+    p.optimize_attr = {"learning_rate": 1.0}
+    p.regularizer = None
+    p.need_clip = True
+    p.is_distributed = False
+    p.persistable = True
+    return p
+
+
+jax.tree_util.register_pytree_node(Parameter, _flatten_param, _unflatten_param)

@@ -1,0 +1,109 @@
+"""Tensor op namespace + method patching.
+
+Mirrors the reference's monkey-patch of tensor methods
+(python/paddle/base/dygraph/math_op_patch.py, tensor method table in
+python/paddle/tensor/__init__.py) — every functional op is also a Tensor
+method, and Python operators route through the tape-aware ops.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu._core.tensor import Tensor
+
+from . import attribute, creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .attribute import is_complex, is_floating_point, is_integer  # noqa: F401
+
+_METHOD_SOURCES = [math, manipulation, logic, linalg, search, stat, creation, random, attribute]
+
+# Functions that are not tensor methods (creation-style or multi-tensor entry points).
+_NON_METHODS = {
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "tril_indices", "triu_indices", "assign",
+    "uniform", "rand", "randn", "randint", "randperm", "gaussian", "normal",
+    "standard_normal", "standard_gamma", "log_normal", "rayleigh",
+    "broadcast_shape", "cartesian_prod", "one_hot", "scatter_nd",
+    "hstack", "vstack", "dstack", "row_stack", "column_stack",
+    "broadcast_tensors", "multi_dot", "multiplex",
+}
+
+
+def _patch_methods():
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _NON_METHODS:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # Non-colliding aliases and special names.
+    Tensor.astype = manipulation.cast
+    Tensor.cast = manipulation.cast
+    Tensor.dim = lambda self: self.ndim
+    Tensor.nelement = lambda self: self.size
+    Tensor.element_size = lambda self: self.dtype.itemsize
+    # concat/stack-style ops as methods operate with self as first element of list
+    Tensor.split = lambda self, *a, **k: manipulation.split(self, *a, **k)
+    Tensor.chunk = lambda self, *a, **k: manipulation.chunk(self, *a, **k)
+
+
+def _patch_operators():
+    from .math import add, divide, floor_divide, matmul, maximum, minimum, mod, multiply, pow_, subtract
+    from .logic import (
+        equal,
+        greater_equal,
+        greater_than,
+        less_equal,
+        less_than,
+        logical_and,
+        logical_not,
+        logical_or,
+        logical_xor,
+        not_equal,
+    )
+
+    Tensor.__add__ = lambda s, o: add(s, o)
+    Tensor.__radd__ = lambda s, o: add(o, s)
+    Tensor.__sub__ = lambda s, o: subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: subtract(o, s)
+    Tensor.__mul__ = lambda s, o: multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: mod(s, o)
+    Tensor.__rmod__ = lambda s, o: mod(o, s)
+    Tensor.__pow__ = lambda s, o: pow_(s, o)
+    Tensor.__rpow__ = lambda s, o: pow_(o, s)
+    Tensor.__matmul__ = lambda s, o: matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: matmul(o, s)
+    Tensor.__neg__ = lambda s: multiply(s, -1)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__pos__ = lambda s: s
+    Tensor.__invert__ = lambda s: logical_not(s) if s.dtype == "bool" else math.multiply(s, 1).bitwise_not()
+    Tensor.__eq__ = lambda s, o: equal(s, o)
+    Tensor.__ne__ = lambda s, o: not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: less_than(s, o)
+    Tensor.__le__ = lambda s, o: less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: logical_and(s, o) if s.dtype == "bool" else logic.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logical_or(s, o) if s.dtype == "bool" else logic.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logical_xor(s, o) if s.dtype == "bool" else logic.bitwise_xor(s, o)
+
+
+_patch_methods()
+_patch_operators()

@@ -1,0 +1,70 @@
+"""Shared helpers for defining tensor ops.
+
+The reference drives its op surface from YAML codegen
+(paddle/phi/api/yaml/ops.yaml -> generated C++ + pybind).  Here every op is a
+pure jax function routed through the autograd tape via
+`paddle_tpu._core.autograd.apply` — jax.vjp is the generated-backward
+equivalent, XLA the kernel library.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.autograd import apply
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["apply", "wrap", "ensure_tensor", "unary", "binary", "to_jax_dtype", "Tensor", "jnp"]
+
+
+def ensure_tensor(x, ref=None):
+    """Coerce python scalars / numpy arrays to Tensor (for binary op operands)."""
+    if isinstance(x, Tensor):
+        return x
+    if ref is not None and isinstance(x, (int, float, bool)) and not isinstance(x, bool):
+        # Match paddle scalar-promotion: scalar takes the tensor's dtype when
+        # that preserves value semantics (float scalar + int tensor -> float).
+        ref_dt = ref._value.dtype
+        if isinstance(x, float) and not jnp.issubdtype(ref_dt, jnp.inexact):
+            return Tensor(jnp.asarray(x, jnp.float32))
+        return Tensor(jnp.asarray(x, ref_dt))
+    from paddle_tpu._core.tensor import to_tensor
+
+    return to_tensor(x)
+
+
+def wrap(name, jfn):
+    """Build a tensor-level op from a jax fn: op(*tensors, **static_kwargs)."""
+
+    def op(*args, **kwargs):
+        return apply(name, jfn, *args, **kwargs)
+
+    op.__name__ = name
+    return op
+
+
+def unary(name, jfn, doc=""):
+    def op(x, name_arg=None, name=None):
+        x = ensure_tensor(x)
+        return apply(name_or(op), jfn, x)
+
+    def name_or(_):
+        return name
+
+    op.__name__ = name
+    op.__doc__ = doc or f"Elementwise {name} (TPU-native equivalent of paddle.{name})."
+    return op
+
+
+def binary(name, jfn, doc=""):
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor) and isinstance(y, Tensor):
+            x = ensure_tensor(x, ref=y)
+        x = ensure_tensor(x)
+        y = ensure_tensor(y, ref=x)
+        return apply(name, jfn, x, y)
+
+    op.__name__ = name
+    op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting (paddle.{name})."
+    return op

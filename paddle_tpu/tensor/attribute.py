@@ -1,0 +1,22 @@
+"""Attribute ops (reference: python/paddle/tensor/attribute.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import is_complex_dtype, is_floating_dtype, is_integer_dtype
+from ._ops_common import Tensor, apply, ensure_tensor
+from .manipulation import rank, shape  # noqa: F401
+from .math import imag, real  # noqa: F401
+
+
+def is_floating_point(x):
+    return is_floating_dtype(ensure_tensor(x).dtype)
+
+
+def is_integer(x):
+    return is_integer_dtype(ensure_tensor(x).dtype)
+
+
+def is_complex(x):
+    return is_complex_dtype(ensure_tensor(x).dtype)

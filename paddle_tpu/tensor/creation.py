@@ -1,0 +1,240 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py, 44 functions)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core.tensor import Tensor, to_tensor
+from paddle_tpu._core import flags
+from ._ops_common import apply, ensure_tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "meshgrid",
+    "diag",
+    "diagflat",
+    "diag_embed",
+    "tril",
+    "triu",
+    "tril_indices",
+    "triu_indices",
+    "assign",
+    "clone",
+    "complex",
+    "polar",
+    "one_hot",
+]
+
+
+def _default_float():
+    return to_jax_dtype(flags.flag("FLAGS_default_dtype"))
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else _default_float()
+    return Tensor(jnp.zeros(_shape_list(shape), dt))
+
+
+def ones(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else _default_float()
+    return Tensor(jnp.ones(_shape_list(shape), dt))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is not None:
+        dt = to_jax_dtype(dtype)
+    else:
+        dt = _default_float() if isinstance(fill_value, float) else (
+            jnp.bool_ if isinstance(fill_value, bool) else jnp.int64
+        )
+    return Tensor(jnp.full(_shape_list(shape), fill_value, dt))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros(x._value.shape, to_jax_dtype(dtype) or x._value.dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones(x._value.shape, to_jax_dtype(dtype) or x._value.dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full(x._value.shape, fill_value, to_jax_dtype(dtype) or x._value.dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "float32"
+            if any(isinstance(v, float) for v in (start, end, step))
+            else "int64"
+        )
+    return Tensor(jnp.arange(start, end, step, to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else _default_float()
+    s = start.item() if isinstance(start, Tensor) else start
+    e = stop.item() if isinstance(stop, Tensor) else stop
+    n = num.item() if isinstance(num, Tensor) else num
+    return Tensor(jnp.linspace(s, e, int(n), dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else _default_float()
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype is not None else _default_float()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=dt))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    tensors = [ensure_tensor(a) for a in args]
+    return apply("meshgrid", lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *tensors)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def _diag(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, v.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+
+    return apply("diag", _diag, x)
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(x)
+
+    def _embed(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        rows = idx + max(0, -offset)
+        cols = idx + max(0, offset)
+        out = base.at[..., rows, cols].set(v)
+        if (dim1, dim2) != (-2, -1):
+            nd = out.ndim
+            d1, d2 = dim1 % nd, dim2 % nd
+            perm = [d for d in range(nd) if d not in (d1, d2)]
+            order = list(range(nd - 2)) + [nd - 2, nd - 1]
+            full = perm + [d1, d2]
+            inv = [0] * nd
+            for i, p in enumerate(full):
+                inv[p] = order[i]
+            out = jnp.transpose(out, inv)
+        return out
+
+    return apply("diag_embed", _embed, x)
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), to_jax_dtype(dtype)))
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, int, float)) else to_tensor(x)
+    out = apply("assign", lambda v: v + jnp.zeros((), v.dtype), x)
+    if output is not None:
+        output._bind(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def complex(real, imag, name=None):
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def polar(abs, angle, name=None):
+    abs, angle = ensure_tensor(abs), ensure_tensor(angle)
+    return apply(
+        "polar", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)), abs, angle
+    )
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "one_hot",
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32),
+        x,
+    )
+

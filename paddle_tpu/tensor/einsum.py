@@ -1,0 +1,13 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py — a 1000-line planner;
+here jnp.einsum lowers straight to dot_general, which XLA maps to the MXU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._ops_common import apply, ensure_tensor
+
+
+def einsum(equation, *operands):
+    tensors = [ensure_tensor(t) for t in operands]
+    return apply("einsum", lambda *vs: jnp.einsum(equation, *vs), *tensors)

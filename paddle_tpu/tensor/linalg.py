@@ -1,0 +1,341 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, 61 fns).
+
+Decompositions route to jax.numpy.linalg / jax.scipy.linalg — XLA provides
+TPU/CPU implementations; matmul-class ops lower to dot_general (MXU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._ops_common import Tensor, apply, ensure_tensor
+from .math import bmm, dot, matmul, mm, mv  # re-export  # noqa: F401
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def _norm(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.real(v * jnp.conj(v)))).astype(v.dtype)
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            ordv = jnp.inf
+        elif p == float("-inf") or p == "-inf":
+            ordv = -jnp.inf
+        else:
+            ordv = p
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=ordv, keepdims=keepdim)
+        ax = _ax(axis)
+        if isinstance(ax, tuple) and len(ax) == 1:
+            ax = ax[0]
+        if isinstance(ax, int):
+            # vector norm along one axis
+            if ordv == jnp.inf:
+                return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+            if ordv == -jnp.inf:
+                return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+            if ordv == 0:
+                return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+            return jnp.sum(jnp.abs(v) ** ordv, axis=ax, keepdims=keepdim) ** (1.0 / ordv)
+        return jnp.linalg.norm(v, ord=ordv, axis=ax, keepdims=keepdim)
+
+    return apply("norm", _norm, x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "vector_norm",
+        lambda v: jnp.linalg.vector_norm(v, ord=p, axis=_ax(axis), keepdims=keepdim),
+        x,
+    )
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ordv = {"fro": "fro", "nuc": "nuc"}.get(p, p)
+    return apply(
+        "matrix_norm",
+        lambda v: jnp.linalg.norm(v, ord=ordv, axis=tuple(axis), keepdims=keepdim),
+        x,
+    )
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _dist(a, b):
+        d = a - b
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply("dist", _dist, x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _cdist(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply("cdist", _cdist, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "cholesky",
+        lambda v: jnp.linalg.cholesky(v) if not upper else jnp.swapaxes(jnp.linalg.cholesky(v), -1, -2).conj(),
+        x,
+    )
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _cs(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply("cholesky_solve", _cs, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return apply("qr", lambda v: jnp.linalg.qr(v, mode="r"), x)
+    return apply("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return apply("svd", lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    x = ensure_tensor(x)
+
+    def _svdl(v):
+        u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+        k = min(q, s.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+    return apply("svd_lowrank", _svdl, x)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    qq = q if q is not None else min(6, *x.shape[-2:])
+
+    def _pca(v):
+        if center:
+            v = v - jnp.mean(v, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
+
+    return apply("pca_lowrank", _pca, x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return apply("matrix_rank", lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x)
+
+
+def matrix_power(x, n, name=None):
+    x = ensure_tensor(x)
+    return apply("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def inv(x, name=None):
+    x = ensure_tensor(x)
+    return apply("inv", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return apply("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        ),
+        x,
+        y,
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _lstsq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply("lstsq", _lstsq, x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+
+    def _lu(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        if get_infos:
+            return lu_mat, piv.astype(jnp.int32) + 1, jnp.zeros((), jnp.int32)
+        return lu_mat, piv.astype(jnp.int32) + 1
+
+    return apply("lu", _lu, x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_data, lu_pivots = ensure_tensor(lu_data), ensure_tensor(lu_pivots)
+
+    def _unpack(lu_mat, piv):
+        m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+        # build permutation from pivots (1-based sequential swaps)
+        p = jnp.arange(m)
+        piv0 = piv - 1
+
+        def body(i, p):
+            j = piv0[i]
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj).at[j].set(pi)
+            return p
+
+        p = jax.lax.fori_loop(0, piv0.shape[-1], body, p)
+        P = jnp.eye(m, dtype=lu_mat.dtype)[p].T
+        return P, L, U
+
+    return apply("lu_unpack", _unpack, lu_data, lu_pivots)
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(x._value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._value))))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def det(x, name=None):
+    x = ensure_tensor(x)
+    return apply("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    return apply("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), x)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else None
+
+    def _cross(a, b):
+        if ax is None:
+            # first axis of length 3 (paddle semantics)
+            for d in range(a.ndim):
+                if a.shape[d] == 3:
+                    return jnp.cross(a, b, axis=d)
+            raise ValueError("no axis of size 3 found for cross()")
+        return jnp.cross(a, b, axis=ax)
+
+    return apply("cross", _cross, x, y)
+
+
+def householder_product(x, tau, name=None):
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def _hp(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype), a[i + 1 :, i]])
+            q = q - t[i] * (q @ jnp.outer(v, v))
+        return q[:, :n]
+
+    return apply("householder_product", _hp, x, tau)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = ensure_tensor(x)
+    return apply("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "cov",
+        lambda v: jnp.cov(
+            v,
+            rowvar=rowvar,
+            ddof=1 if ddof else 0,
+            fweights=None if fweights is None else ensure_tensor(fweights)._value,
+            aweights=None if aweights is None else ensure_tensor(aweights)._value,
+        ),
+        x,
+    )
+
+
+def matrix_exp(x, name=None):
+    x = ensure_tensor(x)
+    return apply("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def orthogonalize(x, name=None):
+    x = ensure_tensor(x)
+    return apply("orthogonalize", lambda v: jnp.linalg.qr(v)[0], x)
+
+
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return apply("multi_dot", lambda *vs: jnp.linalg.multi_dot(list(vs)), *tensors)

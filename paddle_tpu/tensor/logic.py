@@ -1,0 +1,73 @@
+"""Logic/comparison ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._ops_common import Tensor, apply, binary, ensure_tensor, unary
+
+equal = binary("equal", jnp.equal)
+not_equal = binary("not_equal", jnp.not_equal)
+greater_than = binary("greater_than", jnp.greater)
+greater_equal = binary("greater_equal", jnp.greater_equal)
+less_than = binary("less_than", jnp.less)
+less_equal = binary("less_equal", jnp.less_equal)
+logical_and = binary("logical_and", jnp.logical_and)
+logical_or = binary("logical_or", jnp.logical_or)
+logical_xor = binary("logical_xor", jnp.logical_xor)
+logical_not = unary("logical_not", jnp.logical_not)
+bitwise_and = binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = unary("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = binary("bitwise_right_shift", jnp.right_shift)
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("equal_all", lambda a, b: jnp.asarray(a.shape == b.shape and bool(jnp.all(a == b)) if not _traced(a, b) else jnp.all(a == b)), x, y)
+
+
+def _traced(*vs):
+    import jax
+
+    return any(isinstance(v, jax.core.Tracer) for v in vs)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=float(rtol), atol=float(atol), equal_nan=equal_nan),
+        x,
+        y,
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=float(rtol), atol=float(atol), equal_nan=equal_nan),
+        x,
+        y,
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in1d(x, test_x, assume_unique=False, invert=False, name=None):
+    x, test_x = ensure_tensor(x), ensure_tensor(test_x)
+    return apply("in1d", lambda a, b: jnp.isin(a.reshape(-1), b, invert=invert), x, test_x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, test_x = ensure_tensor(x), ensure_tensor(test_x)
+    return apply("isin", lambda a, b: jnp.isin(a, b, invert=invert), x, test_x)

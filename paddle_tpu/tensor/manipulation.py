@@ -1,0 +1,721 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py, ~98 fns).
+
+XLA has no strides — every view op here is a functional (often zero-copy after
+XLA layout assignment) transform.  In-place variants rebind the wrapper's
+payload, matching the reference's inplace-op semantics without aliasing."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from ._ops_common import Tensor, apply, ensure_tensor
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    sh = _shape_list(shape)
+    return apply("reshape", lambda v: jnp.reshape(v, sh), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    p = [int(i) for i in perm]
+    return apply("transpose", lambda v: jnp.transpose(v, p), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return apply("moveaxis", lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = ensure_tensor(x)
+    return apply("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+transpose_ = transpose  # placeholder for inplace variant
+t = lambda x, name=None: transpose(ensure_tensor(x), list(range(ensure_tensor(x).ndim))[::-1])  # noqa: E731
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply("concat", lambda *vs: jnp.concatenate(vs, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return apply("stack", lambda *vs: jnp.stack(vs, axis=int(axis)), *tensors)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    outs = apply(
+        "unstack",
+        lambda v: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis)),
+        x,
+    )
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    if isinstance(num_or_sections, int):
+        outs = apply(
+            "split", lambda v: tuple(jnp.split(v, num_or_sections, axis=ax)), x
+        )
+    else:
+        secs = [int(s) for s in num_or_sections]
+        total = x.shape[ax]
+        if any(s == -1 for s in secs):
+            known = sum(s for s in secs if s != -1)
+            secs = [total - known if s == -1 else s for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        outs = apply("split", lambda v: tuple(jnp.split(v, idx, axis=ax)), x)
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if ensure_tensor(x).ndim > 1 else 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(int(a) for a in axes if x.shape[int(a)] == 1)
+    return apply("squeeze", lambda v: jnp.squeeze(v, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def _unsq(v):
+        for a in sorted(axes):
+            v = jnp.expand_dims(v, a if a >= 0 else a + v.ndim + 1)
+        return v
+
+    return apply("unsqueeze", _unsq, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def _fl(v):
+        if v.ndim == 0:
+            return v.reshape(1)
+        new_shape = list(v.shape[:s]) + [-1] + list(v.shape[e + 1 :])
+        return v.reshape(new_shape)
+
+    return apply("flatten", _fl, x)
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda v: jnp.flip(v, axis=tuple(int(a) for a in axes)), x)
+
+
+def fliplr(x, name=None):
+    return flip(x, 1)
+
+
+def flipud(x, name=None):
+    return flip(x, 0)
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("roll", lambda v: jnp.roll(v, sh, axis=ax), x)
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = _shape_list(repeat_times)
+    return apply("tile", lambda v: jnp.tile(v, reps), x)
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    sh = _shape_list(shape)
+    cur = list(x.shape)
+    full = list(sh)
+    # -1 entries keep original dims (right aligned)
+    offset = len(full) - len(cur)
+    for i in range(len(full)):
+        if full[i] == -1:
+            full[i] = cur[i - offset] if i >= offset else 1
+    return apply("expand", lambda v: jnp.broadcast_to(v, full), x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    x = ensure_tensor(x)
+    return apply("broadcast_to", lambda v: jnp.broadcast_to(v, _shape_list(shape)), x)
+
+
+def broadcast_tensors(input, name=None):
+    tensors = [ensure_tensor(t) for t in input]
+    return list(apply("broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *tensors))
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype)
+    return apply("cast", lambda v: v.astype(dt), x)
+
+
+def cast_(x, dtype):
+    out = cast(x, dtype)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+astype = cast
+
+
+def slice(input, axes, starts, ends):  # noqa: A001
+    input = ensure_tensor(input)
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def _do(v):
+        sl = [None] * v.ndim
+        for d in range(v.ndim):
+            sl[d] = (0, v.shape[d], 1)
+        for a, s, e in zip(axes, starts, ends):
+            n = v.shape[a]
+            s2 = s + n if s < 0 else s
+            e2 = e + n if e < 0 else e
+            e2 = min(e2, n)
+            sl[a] = (s2, e2, 1)
+        indexer = tuple(jnp.s_[b:e:st] for (b, e, st) in sl)
+        return v[indexer]
+
+    return apply("slice", _do, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+
+    def _do(v):
+        sl = [jnp.s_[:]] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            sl[int(a)] = jnp.s_[int(s) : int(e) : int(st)]
+        return v[tuple(sl)]
+
+    return apply("strided_slice", _do, x)
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply("gather", lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def _gnd(v, idx):
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply("gather_nd", _gnd, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def _sc(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+
+    return apply("scatter", _sc, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    sh = _shape_list(shape)
+    return apply(
+        "scatter_nd",
+        lambda i, u: jnp.zeros(sh, u.dtype).at[tuple(jnp.moveaxis(i, -1, 0))].add(u),
+        index,
+        updates,
+    )
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+    return apply(
+        "scatter_nd_add",
+        lambda v, i, u: v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u),
+        x,
+        index,
+        updates,
+    )
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply("index_select", lambda v, i: jnp.take(v, i, axis=int(axis)), x, index)
+
+
+def index_sample(x, index):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply(
+        "index_sample",
+        lambda v, i: jnp.take_along_axis(v, i, axis=1),
+        x,
+        index,
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def _ia(v, i, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        out = vm.at[i].add(um)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_add", _ia, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx_tensors = [ensure_tensor(i) for i in indices]
+
+    def _ip(v, u, *idxs):
+        if accumulate:
+            return v.at[tuple(idxs)].add(u)
+        return v.at[tuple(idxs)].set(u)
+
+    return apply("index_put", _ip, x, value, *idx_tensors)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return apply(
+        "take_along_axis", lambda v, i: jnp.take_along_axis(v, i, axis=axis), arr, indices
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):  # noqa: A002
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def _pa(v, i, u):
+        u = jnp.broadcast_to(u, i.shape) if u.ndim < i.ndim or u.shape != i.shape else u
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=axis, inplace=False)
+        vm = jnp.moveaxis(v, axis, 0)
+        im = jnp.moveaxis(i, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        # scatter per position along other dims using at[] with explicit index grids
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in im.shape], indexing="ij")
+        full_idx = list(grids)
+        full_idx[0] = im
+        if reduce in ("add", "sum"):
+            out = vm.at[tuple(full_idx)].add(um)
+        elif reduce in ("mul", "multiply"):
+            out = vm.at[tuple(full_idx)].multiply(um)
+        elif reduce == "amax":
+            out = vm.at[tuple(full_idx)].max(um)
+        elif reduce == "amin":
+            out = vm.at[tuple(full_idx)].min(um)
+        elif reduce == "mean":
+            ones = jnp.ones_like(um)
+            cnt = jnp.zeros_like(vm).at[tuple(full_idx)].add(ones)
+            tot = vm.at[tuple(full_idx)].add(um)
+            out = jnp.where(cnt > 0, tot / jnp.maximum(cnt + include_self, 1), vm)
+        else:
+            raise ValueError(f"unknown reduce {reduce}")
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("put_along_axis", _pa, arr, indices, values)
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # Data-dependent shape: eager-only (reference has the same dynamic output).
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value)
+    return Tensor(jnp.asarray(np.broadcast_to(v, np.broadcast_shapes(v.shape, m.shape))[np.broadcast_to(m, np.broadcast_shapes(v.shape, m.shape))]))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    val = value._value if isinstance(value, Tensor) else value
+    return apply("masked_fill", lambda v, m: jnp.where(m, jnp.asarray(val, v.dtype), v), x, mask)
+
+
+def masked_fill_(x, mask, value, name=None):
+    out = masked_fill(x, mask, value)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+    v = np.asarray(x._value).copy()
+    m = np.broadcast_to(np.asarray(mask._value), v.shape)
+    vals = np.asarray(value._value).reshape(-1)
+    v[m] = vals[: int(m.sum())]
+    return Tensor(jnp.asarray(v))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = repeats
+        return apply(
+            "repeat_interleave",
+            lambda v, r: jnp.repeat(
+                v.reshape(-1) if axis is None else v,
+                r,
+                axis=0 if axis is None else axis,
+                total_repeat_length=int(np.asarray(r).sum()),
+            ),
+            x,
+            reps,
+        )
+    return apply(
+        "repeat_interleave",
+        lambda v: jnp.repeat(v.reshape(-1) if axis is None else v, repeats, axis=0 if axis is None else axis),
+        x,
+    )
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(
+        np.asarray(x._value),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if arr.size == 0:
+        out = [Tensor(jnp.asarray(arr))]
+    else:
+        keep = np.ones(arr.shape[ax], bool)
+        sl = np.take(arr, np.arange(1, arr.shape[ax]), axis=ax) != np.take(arr, np.arange(arr.shape[ax] - 1), axis=ax)
+        if sl.ndim > 1:
+            sl = sl.any(axis=tuple(d for d in range(sl.ndim) if d != ax))
+        keep[1:] = sl
+        uniq = np.compress(keep, arr, axis=ax)
+        out = [Tensor(jnp.asarray(uniq))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            out.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, arr.shape[ax]))
+            out.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return apply("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return apply("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, ensure_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, ensure_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, ensure_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def view(x, shape_or_dtype, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    dt = to_jax_dtype(shape_or_dtype)
+    return apply("view_dtype", lambda v: jax.lax.bitcast_convert_type(v, dt), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = ensure_tensor(x)
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x._value).reshape(-1)[offset:],
+        shape=shape,
+        strides=[s * x._value.dtype.itemsize for s in stride],
+    )
+    return Tensor(jnp.asarray(arr.copy()))
+
+
+def unfold(x, axis, size, step, name=None):
+    x = ensure_tensor(x)
+
+    def _unfold(v):
+        n = v.shape[axis]
+        starts = jnp.arange(0, n - size + 1, step)
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm[idx]  # (n_windows, size, ...)
+        out = jnp.moveaxis(out, (0, 1), (axis, v.ndim))
+        return out
+
+    return apply("unfold", _unfold, x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(num_or_indices, int):
+        return list(
+            apply("tensor_split", lambda v: tuple(jnp.array_split(v, num_or_indices, axis=axis)), x)
+        )
+    return list(
+        apply("tensor_split", lambda v: tuple(jnp.split(v, list(num_or_indices), axis=axis)), x)
+    )
+
+
+def hstack(x, name=None):
+    return apply("hstack", lambda *vs: jnp.hstack(vs), *[ensure_tensor(t) for t in x])
+
+
+def vstack(x, name=None):
+    return apply("vstack", lambda *vs: jnp.vstack(vs), *[ensure_tensor(t) for t in x])
+
+
+def dstack(x, name=None):
+    return apply("dstack", lambda *vs: jnp.dstack(vs), *[ensure_tensor(t) for t in x])
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def column_stack(x, name=None):
+    return apply("column_stack", lambda *vs: jnp.column_stack(vs), *[ensure_tensor(t) for t in x])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def _si(v):
+        in_shard = (v // shard_size) == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+
+    return apply("shard_index", _si, input)
+
+
+# ----------------------------------------------------------- getitem/setitem
+def _norm_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def _getitem(x, idx):
+    nidx = _norm_index(idx)
+    return apply("getitem", lambda v: v[nidx], x)
+
+
+def _setitem_(x, idx, value):
+    nidx = _norm_index(idx)
+    value = ensure_tensor(value, ref=x)
+
+    def _set(v, u):
+        return v.at[nidx].set(u.astype(v.dtype))
+
+    out = apply("setitem", _set, x, value)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    return x
+
+
+def fill_(x, value):
+    x = ensure_tensor(x)
+    x._bind(jnp.full_like(x._value, value))
+    return x
+
+
+def zero_(x):
+    return fill_(x, 0)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = ensure_tensor(x)
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n - abs(offset))
+    rows = idx + max(0, -offset)
+    cols = idx + max(0, offset)
+    x._bind(x._value.at[..., rows, cols].set(value))
+    return x
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def _pad(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            # paddle full-dim format: [before0, after0, before1, after1, ...]? No:
+            # paddle uses per-dim pairs in dim order for len==2*ndim
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to trailing spatial dims (paddle NCHW conv style):
+            # pad = [left, right, top, bottom, front, back...] applying to last dims reversed
+            npairs = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C") and nd >= 3:  # NHWC / NLC / NDHWC
+                spatial = list(range(1, nd - 1))[-npairs:]
+            else:
+                spatial = list(range(nd))[-npairs:]
+            for j, d in enumerate(reversed(spatial)):
+                widths[d] = (pad[2 * j], pad[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply("pad", _pad, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    sh = _shape_list(shape) if shape is not None else x.shape
+    off = _shape_list(offsets) if offsets is not None else [0] * x.ndim
+    sh = [x.shape[i] - off[i] if s == -1 else s for i, s in enumerate(sh)]
+
+    def _crop(v):
+        return jax.lax.dynamic_slice(v, off, sh)
+
+    return apply("crop", _crop, x)
+
+
+def numel(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(ensure_tensor(x).ndim, jnp.int32))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(ensure_tensor(x).shape, jnp.int32))

@@ -1,0 +1,507 @@
+"""Math ops (reference: python/paddle/tensor/math.py, ~142 functions).
+
+Every op is a pure jax.numpy function routed through the autograd tape; XLA
+fuses elementwise chains into matmul epilogues on TPU, which is the whole
+fusion story the reference builds CINN for.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from ._ops_common import Tensor, apply, binary, ensure_tensor, unary
+
+# ----------------------------------------------------------------- elementwise
+add = binary("add", jnp.add)
+subtract = binary("subtract", jnp.subtract)
+multiply = binary("multiply", jnp.multiply)
+divide = binary("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = binary("floor_divide", jnp.floor_divide)
+mod = binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+def pow_(x, y, name=None):
+    # Keep python-scalar exponents static: XLA lowers integer powers to
+    # multiply chains instead of exp(y*log(x)).
+    if isinstance(y, (int, float)) and not isinstance(y, bool):
+        x = ensure_tensor(x)
+        return apply("pow", lambda v: jnp.power(v, y), x)
+    x = ensure_tensor(x, ref=y if isinstance(y, Tensor) else None)
+    y = ensure_tensor(y, ref=x)
+    return apply("pow", jnp.power, x, y)
+maximum = binary("maximum", jnp.maximum)
+minimum = binary("minimum", jnp.minimum)
+fmax = binary("fmax", jnp.fmax)
+fmin = binary("fmin", jnp.fmin)
+atan2 = binary("atan2", jnp.arctan2)
+hypot = binary("hypot", jnp.hypot)
+logaddexp = binary("logaddexp", jnp.logaddexp)
+nextafter = binary("nextafter", jnp.nextafter)
+copysign = binary("copysign", jnp.copysign)
+ldexp = binary("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+heaviside = binary("heaviside", jnp.heaviside)
+gcd = binary("gcd", jnp.gcd)
+lcm = binary("lcm", jnp.lcm)
+inner = binary("inner", jnp.inner)
+outer = binary("outer", lambda x, y: jnp.outer(x, y))
+kron = binary("kron", jnp.kron)
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return pow_(x, y)
+
+
+def divide_no_nan(x, y):
+    x, y = ensure_tensor(x), ensure_tensor(y, ref=x)
+    return apply(
+        "divide_no_nan",
+        lambda a, b: jnp.where(b == 0, jnp.zeros((), a.dtype), a / jnp.where(b == 0, 1, b)),
+        x,
+        y,
+    )
+
+
+# --------------------------------------------------------------------- unary
+exp = unary("exp", jnp.exp)
+expm1 = unary("expm1", jnp.expm1)
+log = unary("log", jnp.log)
+log2 = unary("log2", jnp.log2)
+log10 = unary("log10", jnp.log10)
+log1p = unary("log1p", jnp.log1p)
+sqrt = unary("sqrt", jnp.sqrt)
+rsqrt = unary("rsqrt", jax.lax.rsqrt)
+square = unary("square", jnp.square)
+abs = unary("abs", jnp.abs)  # noqa: A001
+sign = unary("sign", jnp.sign)
+sgn = unary("sgn", lambda v: jnp.sign(v) if not jnp.issubdtype(v.dtype, jnp.complexfloating) else jnp.where(v == 0, 0, v / jnp.abs(v)))
+ceil = unary("ceil", jnp.ceil)
+floor = unary("floor", jnp.floor)
+round = unary("round", jnp.round)  # noqa: A001
+trunc = unary("trunc", jnp.trunc)
+frac = unary("frac", lambda v: v - jnp.trunc(v))
+reciprocal = unary("reciprocal", lambda v: 1.0 / v)
+neg = unary("neg", jnp.negative)
+sin = unary("sin", jnp.sin)
+cos = unary("cos", jnp.cos)
+tan = unary("tan", jnp.tan)
+asin = unary("asin", jnp.arcsin)
+acos = unary("acos", jnp.arccos)
+atan = unary("atan", jnp.arctan)
+sinh = unary("sinh", jnp.sinh)
+cosh = unary("cosh", jnp.cosh)
+tanh = unary("tanh", jnp.tanh)
+asinh = unary("asinh", jnp.arcsinh)
+acosh = unary("acosh", jnp.arccosh)
+atanh = unary("atanh", jnp.arctanh)
+erf = unary("erf", jax.scipy.special.erf)
+erfinv = unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+logit = unary("logit", lambda v: jnp.log(v / (1.0 - v)))
+digamma = unary("digamma", jax.scipy.special.digamma)
+lgamma = unary("lgamma", jax.scipy.special.gammaln)
+gamma = unary("gamma", lambda v: jnp.exp(jax.scipy.special.gammaln(v)) * jnp.sign(jnp.ones_like(v)))
+i0 = unary("i0", jax.scipy.special.i0)
+i0e = unary("i0e", jax.scipy.special.i0e)
+i1 = unary("i1", jax.scipy.special.i1)
+i1e = unary("i1e", jax.scipy.special.i1e)
+deg2rad = unary("deg2rad", jnp.deg2rad)
+rad2deg = unary("rad2deg", jnp.rad2deg)
+angle = unary("angle", jnp.angle)
+conj = unary("conj", jnp.conj)
+real = unary("real", jnp.real)
+imag = unary("imag", jnp.imag)
+exponent = unary("exponent", lambda v: jnp.frexp(v)[1].astype(v.dtype))
+
+
+def polygamma(x, n, name=None):
+    x = ensure_tensor(x)
+    return apply("polygamma", lambda v: jax.scipy.special.polygamma(n, v), x)
+
+
+def multigammaln(x, p, name=None):
+    x = ensure_tensor(x)
+    return apply("multigammaln", lambda v: jax.scipy.special.multigammaln(v, p), x)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda v: jnp.clip(v, lo, hi), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def _scale(v):
+        s_ = jnp.asarray(s, v.dtype)
+        b_ = jnp.asarray(bias, v.dtype)
+        out = v * s_ + b_ if bias_after_scale else (v + b_) * s_
+        return out
+
+    return apply("scale", _scale, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return apply("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    out = apply("increment", lambda v: v + jnp.asarray(value, v.dtype), x)
+    x._bind(out._value)
+    return x
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return apply("nan_to_num", lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+# ---------------------------------------------------------------- reductions
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduction(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = ensure_tensor(x)
+        ax = _axis_arg(axis)
+        kw = {}
+        if dtype is not None:
+            kw["dtype"] = to_jax_dtype(dtype)
+        return apply(name, lambda v: jfn(v, axis=ax, keepdims=keepdim, **kw), x)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduction("sum", jnp.sum)  # noqa: A001
+nansum = _reduction("nansum", jnp.nansum)
+mean = _reduction("mean", jnp.mean)
+nanmean = _reduction("nanmean", jnp.nanmean)
+prod = _reduction("prod", jnp.prod)
+max = _reduction("max", jnp.max)  # noqa: A001
+min = _reduction("min", jnp.min)  # noqa: A001
+amax = _reduction("amax", jnp.max)
+amin = _reduction("amin", jnp.min)
+all = _reduction("all", jnp.all)  # noqa: A001
+any = _reduction("any", jnp.any)  # noqa: A001
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(
+        "count_nonzero", lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype(jnp.int64), x
+    )
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply("logsumexp", lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), x)
+
+
+# ------------------------------------------------------------------ cumulative
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def _cs(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=to_jax_dtype(dtype))
+        return jnp.cumsum(v, axis=int(axis), dtype=to_jax_dtype(dtype))
+
+    return apply("cumsum", _cs, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply("cumprod", lambda v: jnp.cumprod(v, axis=int(dim), dtype=to_jax_dtype(dtype)), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def _cm(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        out = jax.lax.cummax(vv, axis=ax)
+        idx = jnp.asarray(jnp.argmax(jnp.cumsum(jnp.zeros_like(vv, jnp.int32), axis=ax), axis=ax))
+        # indices: positions where a new max was set
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        is_new = vv == out
+        idx = jax.lax.cummax(jnp.where(is_new, ar, -1), axis=ax)
+        return out, idx.astype(to_jax_dtype(dtype))
+
+    return apply("cummax", _cm, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def _cm(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        out = jax.lax.cummin(vv, axis=ax)
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        is_new = vv == out
+        idx = jax.lax.cummax(jnp.where(is_new, ar, -1), axis=ax)
+        return out, idx.astype(to_jax_dtype(dtype))
+
+    return apply("cummin", _cm, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def _lcse(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.cumlogsumexp(vv, axis=ax)
+
+    return apply("logcumsumexp", _lcse, x)
+
+
+# ----------------------------------------------------------------- lin-adjacent
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Batched matmul lowering straight to dot_general (MXU path)."""
+    x, y = ensure_tensor(x), ensure_tensor(y, ref=x)
+
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", _mm, x, y)
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y, ref=x)
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y
+    )
+
+
+def multiplex(inputs, index, name=None):
+    tensors = [ensure_tensor(t) for t in inputs]
+    index = ensure_tensor(index)
+
+    def _mx(idx, *vs):
+        stacked = jnp.stack(vs, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    return apply("multiplex", _mx, index, *tensors)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply("diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+# ----------------------------------------------------------------------- misc
+def isfinite(x, name=None):
+    return apply("isfinite", jnp.isfinite, ensure_tensor(x))
+
+
+def isinf(x, name=None):
+    return apply("isinf", jnp.isinf, ensure_tensor(x))
+
+
+def isnan(x, name=None):
+    return apply("isnan", jnp.isnan, ensure_tensor(x))
+
+
+def isneginf(x, name=None):
+    return apply("isneginf", jnp.isneginf, ensure_tensor(x))
+
+
+def isposinf(x, name=None):
+    return apply("isposinf", jnp.isposinf, ensure_tensor(x))
+
+
+def isreal(x, name=None):
+    return apply("isreal", jnp.isreal, ensure_tensor(x))
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    input = ensure_tensor(input)
+    v = input._value
+    lo, hi = (float(jnp.min(v)), float(jnp.max(v))) if min == 0 and max == 0 else (min, max)
+    hist, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+
+    h, edges = np.histogramdd(
+        np.asarray(x._value),
+        bins=bins,
+        range=ranges,
+        density=density,
+        weights=None if weights is None else np.asarray(ensure_tensor(weights)._value),
+    )
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    length = int(jnp.maximum(jnp.max(v) + 1 if v.size else 0, minlength))
+    w = ensure_tensor(weights)._value if weights is not None else None
+    return Tensor(jnp.bincount(v.reshape(-1), weights=w, length=length))
+
+
+def cartesian_prod(x, name=None):
+    tensors = [ensure_tensor(t)._value for t in x]
+    grids = jnp.meshgrid(*tensors, indexing="ij")
+    return Tensor(jnp.stack([g.reshape(-1) for g in grids], axis=-1))
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply("take", lambda v, i: jnp.take(v.reshape(-1), i, mode=m), x, index)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        x = ensure_tensor(x)
+        return apply("trapezoid", lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis), y, x)
+    return apply("trapezoid", lambda yy: jnp.trapezoid(yy, dx=dx or 1.0, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+
+    def _ct(yy, xx=None):
+        import jax.numpy as jn
+
+        d = jn.diff(xx, axis=axis) if xx is not None else (dx or 1.0)
+        sl1 = [slice(None)] * yy.ndim
+        sl2 = [slice(None)] * yy.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (yy[tuple(sl1)] + yy[tuple(sl2)]) / 2.0
+        return jn.cumsum(avg * d, axis=axis)
+
+    if x is not None:
+        return apply("cumulative_trapezoid", _ct, y, ensure_tensor(x))
+    return apply("cumulative_trapezoid", lambda yy: _ct(yy), y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    extra = []
+    if prepend is not None:
+        extra.append(ensure_tensor(prepend))
+    if append is not None:
+        extra.append(ensure_tensor(append))
+
+    def _diff(v, *rest):
+        it = iter(rest)
+        pre = next(it) if prepend is not None else None
+        app = next(it) if append is not None else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply("diff", _diff, x, *extra)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = ensure_tensor(x)
+
+    def _renorm(v):
+        dims = [d for d in range(v.ndim) if d != axis % v.ndim]
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return apply("renorm", _renorm, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = ensure_tensor(x)
+    return apply("vander", lambda v: jnp.vander(v, N=n, increasing=increasing), x)
+
+
+def frexp(x, name=None):
+    x = ensure_tensor(x)
+    return apply("frexp", lambda v: tuple(jnp.frexp(v)), x)
+
+
+def signbit(x, name=None):
+    return apply("signbit", jnp.signbit, ensure_tensor(x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    import numpy as np
+
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    it = itertools.combinations_with_replacement(arr, r) if with_replacement else itertools.combinations(arr, r)
+    combos = list(it)
+    if not combos:
+        return Tensor(jnp.zeros((0, r), x._value.dtype))
+    return Tensor(jnp.asarray(np.stack([np.stack(c) for c in combos])))

@@ -1,0 +1,165 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Stateful paddle semantics over functional jax PRNG: each call consumes a key
+from the global generator (paddle_tpu._core.random).  Inside a jitted train
+step wrapped with `key_scope`, keys derive from the traced per-step key.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core import random as rng
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core import flags
+from ._ops_common import Tensor, ensure_tensor
+
+
+def _default_float():
+    return to_jax_dtype(flags.flag("FLAGS_default_dtype"))
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    dt = to_jax_dtype(dtype) or _default_float()
+    key = jax.random.key(seed) if seed else rng.next_key()
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape_list(shape), dt, lo, hi))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    x._bind(uniform(x.shape, x._value.dtype, min, max, seed)._value)
+    return x
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) or _default_float()
+    return Tensor(jax.random.normal(rng.next_key(), _shape_list(shape), dt))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(
+            jnp.shape(m) if hasattr(m, "shape") else (), jnp.shape(s) if hasattr(s, "shape") else ()
+        )
+        return Tensor(jax.random.normal(rng.next_key(), sh) * s + m)
+    sh = _shape_list(shape) if shape is not None else []
+    return Tensor(jax.random.normal(rng.next_key(), sh) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x = ensure_tensor(x)
+    x._bind(jax.random.normal(rng.next_key(), x._value.shape, x._value.dtype) * std + mean)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) or _default_float()
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return Tensor(jax.random.normal(key, _shape_list(shape), dt) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def standard_gamma(alpha, name=None):
+    alpha = ensure_tensor(alpha)
+    return Tensor(jax.random.gamma(rng.next_key(), alpha._value))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = to_jax_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.randint(rng.next_key(), _shape_list(shape), low, high, dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x._value.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), n).astype(to_jax_dtype(dtype)))
+
+
+def shuffle(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.permutation(rng.next_key(), x._value, axis=axis, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    probs = v / jnp.sum(v, axis=-1, keepdims=True)
+    if replacement:
+        out = jax.random.categorical(
+            rng.next_key(), jnp.log(jnp.maximum(probs, 1e-30)), shape=(num_samples,) + v.shape[:-1]
+        )
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k for sampling without replacement.
+        g = jax.random.gumbel(rng.next_key(), v.shape)
+        scores = jnp.log(jnp.maximum(probs, 1e-30)) + g
+        out = jnp.argsort(-scores, axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(
+        jax.random.bernoulli(rng.next_key(), x._value).astype(x._value.dtype)
+    )
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x = ensure_tensor(x)
+    x._bind(jax.random.bernoulli(rng.next_key(), p, x._value.shape).astype(x._value.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(rng.next_key(), x._value).astype(x._value.dtype))
+
+
+def binomial(count, prob, name=None):
+    count, prob = ensure_tensor(count), ensure_tensor(prob)
+    return Tensor(
+        jax.random.binomial(rng.next_key(), count._value.astype(jnp.float32), prob._value).astype(jnp.int64)
+    )
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    x._bind((jax.random.exponential(rng.next_key(), x._value.shape, x._value.dtype) / lam))
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    sh = _shape_list(shape) if shape is not None else []
+    return Tensor(jnp.exp(jax.random.normal(rng.next_key(), sh) * std + mean))
+
+
+def rayleigh(scale=1.0, shape=None, name=None):
+    sh = _shape_list(shape) if shape is not None else []
+    u = jax.random.uniform(rng.next_key(), sh, minval=1e-9, maxval=1.0)
+    return Tensor(scale * jnp.sqrt(-2.0 * jnp.log(u)))

@@ -1,0 +1,167 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from ._ops_common import Tensor, apply, ensure_tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype)
+
+    def _am(v):
+        if axis is None:
+            return jnp.argmax(v.reshape(-1)).astype(dt)
+        out = jnp.argmax(v, axis=int(axis)).astype(dt)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+
+    return apply("argmax", _am, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype)
+
+    def _am(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1)).astype(dt)
+        out = jnp.argmin(v, axis=int(axis)).astype(dt)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+
+    return apply("argmin", _am, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def _as(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply("argsort", _as, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def _sort(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return apply("sort", _sort, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def _topk(v):
+        ax = -1 if axis is None else int(axis)
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vm, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply("topk", _topk, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x, ref=None), ensure_tensor(y)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x = ensure_tensor(x)
+    x._bind(out._value)
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    # Data-dependent output shape: eager only (XLA needs static shapes).
+    arr = np.asarray(x._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n.astype(np.int64))[:, None]) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def _kth(v):
+        vals = jnp.sort(v, axis=axis)
+        idxs = jnp.argsort(v, axis=axis, stable=True)
+        sel_v = jnp.take(vals, k - 1, axis=axis)
+        sel_i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            sel_v = jnp.expand_dims(sel_v, axis)
+            sel_i = jnp.expand_dims(sel_i, axis)
+        return sel_v, sel_i
+
+    return apply("kthvalue", _kth, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    vm = np.moveaxis(arr, axis, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.where(counts == counts.max())[0][-1]]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = vm.shape[:-1]
+    v_out = vals.reshape(out_shape)
+    i_out = idxs.reshape(out_shape)
+    if keepdim:
+        v_out = np.expand_dims(v_out, axis)
+        i_out = np.expand_dims(i_out, axis)
+    return Tensor(jnp.asarray(v_out)), Tensor(jnp.asarray(i_out))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask, name)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    sorted_sequence, values = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def _ss(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        # batched: apply along leading dims
+        fn = lambda s, vv: jnp.searchsorted(s, vv, side=side)  # noqa: E731
+        for _ in range(seq.ndim - 1):
+            fn = jax.vmap(fn)
+        return fn(seq, v).astype(dt)
+
+    return apply("searchsorted", _ss, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    x, sorted_sequence = ensure_tensor(x), ensure_tensor(sorted_sequence)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return apply(
+        "bucketize", lambda v, seq: jnp.searchsorted(seq, v, side=side).astype(dt), x, sorted_sequence
+    )

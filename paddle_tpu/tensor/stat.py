@@ -1,0 +1,90 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._ops_common import apply, ensure_tensor
+from .math import _axis_arg, mean  # noqa: F401  (mean re-exported)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(
+        "std", lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(
+        "var", lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    if mode == "avg":
+        return apply("median", lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x)
+
+    def _min_mode(v):
+        # 'min' mode: lower of the two middle values, with index
+        axis_ = ax if ax is not None else None
+        if axis_ is None:
+            flat = v.reshape(-1)
+            n = flat.shape[0]
+            idx_sorted = jnp.argsort(flat)
+            mid = (n - 1) // 2
+            i = idx_sorted[mid]
+            return flat[i], i.astype(jnp.int64)
+        vs = jnp.sort(v, axis=axis_)
+        isort = jnp.argsort(v, axis=axis_)
+        n = v.shape[axis_]
+        mid = (n - 1) // 2
+        val = jnp.take(vs, mid, axis=axis_)
+        idx = jnp.take(isort, mid, axis=axis_).astype(jnp.int64)
+        if keepdim:
+            val = jnp.expand_dims(val, axis_)
+            idx = jnp.expand_dims(idx, axis_)
+        return val, idx
+
+    return apply("median_min", _min_mode, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply("nanmedian", lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    qv = q if not hasattr(q, "_value") else q._value
+
+    def _q(v):
+        out = jnp.quantile(
+            v.astype(jnp.float64 if v.dtype == jnp.float64 else jnp.float32),
+            jnp.asarray(qv),
+            axis=ax,
+            keepdims=keepdim,
+            method=interpolation,
+        )
+        return out
+
+    return apply("quantile", _q, x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(
+        "nanquantile",
+        lambda v: jnp.nanquantile(
+            v.astype(jnp.float32), jnp.asarray(q), axis=ax, keepdims=keepdim, method=interpolation
+        ),
+        x,
+    )

@@ -1,0 +1,24 @@
+"""Test config: run everything on an 8-device virtual CPU mesh.
+
+This is the TPU-native analog of the reference's fake-device / Gloo tricks
+(SURVEY.md §4): XLA's host platform is forced to expose 8 devices so all
+sharding/collective paths execute for real without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import jax  # noqa: E402
+
+# float32 means float32 in numeric tests; TPU runs keep the fast MXU default.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+# Single-core VM: persist XLA compilations across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

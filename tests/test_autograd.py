@@ -1,0 +1,210 @@
+"""Autograd tape tests (behavioral parity with reference eager autograd,
+paddle/fluid/eager/backward.cc; gradient values checked against analytic and
+jax.grad references)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _param(arr):
+    t = paddle.to_tensor(np.asarray(arr, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def test_simple_backward():
+    x = _param([1.0, 2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_rule():
+    x = _param(2.0)
+    y = paddle.exp(x * x)  # dy/dx = 2x*exp(x^2)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * 2 * np.exp(4.0), rtol=1e-5)
+
+
+def test_branching_graph_accumulates():
+    x = _param(3.0)
+    a = x * 2.0
+    b = x * 5.0
+    y = a + b  # dy/dx = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+
+def test_diamond_graph():
+    x = _param(2.0)
+    a = x * x  # a = x^2
+    y = (a * a).sum()  # y = x^4, dy/dx = 4x^3 = 32
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 32.0)
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    a, b = _param(a_np), _param(b_np)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    # d(sum(AB))/dA = ones @ B^T
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a_np.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = _param(2.0)
+    y = paddle.to_tensor(3.0)  # stop_gradient=True
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
+    assert y.grad is None
+
+
+def test_detach():
+    x = _param(2.0)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4.0)  # only through z=y*x
+
+
+def test_no_grad_context():
+    x = _param(2.0)
+    with paddle.no_grad():
+        y = x * x
+    assert y._grad_node is None
+    assert y.stop_gradient
+
+
+def test_grad_accumulation_across_backwards():
+    x = _param(2.0)
+    (x * 2.0).backward()
+    (x * 3.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_clear_grad():
+    x = _param(2.0)
+    (x * 2.0).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_backward_with_grad_tensor():
+    x = _param([1.0, 2.0])
+    y = x * 3.0
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_non_scalar_backward_raises():
+    x = _param([1.0, 2.0])
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_double_backward_without_retain_raises():
+    x = _param(2.0)
+    y = x * x
+    z = y.sum()
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_retain_graph():
+    x = _param(2.0)
+    z = (x * x).sum()
+    z.backward(retain_graph=True)
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)  # 4 + 4
+
+
+def test_hook():
+    x = _param(2.0)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy())
+        return g * 2.0
+
+    x.register_hook(hook)
+    (x * 3.0).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], 3.0)
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)  # doubled by hook
+
+
+def test_paddle_grad_api():
+    x = _param(2.0)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 4.0)
+    assert x.grad is None  # paddle.grad does not write .grad
+
+
+def test_paddle_grad_intermediate():
+    x = _param(2.0)
+    a = x * x
+    y = a * 3.0
+    (ga,) = paddle.grad(y, a, retain_graph=True)
+    np.testing.assert_allclose(ga.numpy(), 3.0)
+
+
+def test_grad_matches_jax_reference():
+    """Cross-check a composite function against pure jax.grad."""
+
+    def f_jax(x):
+        return jnp.sum(jnp.tanh(x @ x.T) * jnp.exp(x[:, :1]))
+
+    x_np = np.random.rand(4, 4).astype(np.float32)
+    expected = jax.grad(f_jax)(jnp.asarray(x_np))
+
+    x = _param(x_np)
+    out = (paddle.tanh(x @ x.T) * paddle.exp(x[:, :1])).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(expected), rtol=1e-4, atol=1e-5)
+
+
+def test_backward_inside_jit():
+    """The tape must compose with jax.jit — whole-step compile is the TPU hot
+    path (SURVEY.md §7 design stance)."""
+
+    def step(xv):
+        x = paddle.Tensor(xv, stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        return x.grad._value
+
+    out = jax.jit(step)(jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(out), [2, 4, 6])
+
+
+def test_mean_grad():
+    x = _param(np.ones((2, 8)))
+    paddle.mean(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 8), 1 / 16))
+
+
+def test_getitem_grad():
+    x = _param([1.0, 2.0, 3.0, 4.0])
+    y = (x[1:3] * 2.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 2, 2, 0])
+
+
+def test_concat_grad():
+    a = _param([1.0, 2.0])
+    b = _param([3.0, 4.0])
+    y = (paddle.concat([a, b]) * paddle.to_tensor([1.0, 2.0, 3.0, 4.0])).sum()
+    y.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [1, 2])
+    np.testing.assert_allclose(b.grad.numpy(), [3, 4])

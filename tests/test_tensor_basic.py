@@ -1,0 +1,194 @@
+"""Core tensor + op-surface tests (model: reference OpTest numpy comparisons,
+test/legacy_test/op_test.py:417)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == paddle.int64
+    f = t.astype("float32")
+    assert f.dtype == paddle.float32
+    b = t.astype(paddle.bfloat16)
+    assert b.dtype == paddle.bfloat16
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_array_equal(
+        paddle.full([2, 2], 7, dtype="int32").numpy(), np.full((2, 2), 7, np.int32)
+    )
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5, dtype=np.float32)
+    )
+
+
+def test_elementwise_math():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x - y).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((x**2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose(paddle.exp(x).numpy(), np.exp([1, 2, 3]), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sqrt(x).numpy(), np.sqrt([1, 2, 3]), rtol=1e-5)
+    np.testing.assert_allclose(paddle.log(x).numpy(), np.log([1, 2, 3]), rtol=1e-4)
+
+
+def test_scalar_broadcasting():
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3])
+    np.testing.assert_allclose((1 + x).numpy(), [2, 3])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4])
+    np.testing.assert_allclose((1 - x).numpy(), [0, -1])
+    np.testing.assert_allclose((2 / x).numpy(), [2, 1])
+
+
+def test_reductions():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert float(paddle.sum(x)) == 10.0
+    assert float(paddle.mean(x)) == 2.5
+    assert float(paddle.max(x)) == 4.0
+    assert float(paddle.min(x)) == 1.0
+    np.testing.assert_allclose(paddle.sum(x, axis=0).numpy(), [4, 6])
+    np.testing.assert_allclose(paddle.sum(x, axis=1, keepdim=True).numpy(), [[3], [7]])
+    assert float(paddle.prod(x)) == 24.0
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(
+        paddle.matmul(a, b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5
+    )
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+    # transpose flags
+    np.testing.assert_allclose(
+        paddle.matmul(a, a, transpose_y=True).numpy(), a.numpy() @ a.numpy().T, rtol=1e-5
+    )
+
+
+def test_manipulation():
+    x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x).shape == [24]
+    assert paddle.flatten(x, 1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(x, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+    c = paddle.concat([x, x], axis=1)
+    assert c.shape == [2, 6, 4]
+    s = paddle.split(x, 3, axis=1)
+    assert len(s) == 3 and s[0].shape == [2, 1, 4]
+    st = paddle.stack([x, x], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+    np.testing.assert_allclose(x[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0] = paddle.to_tensor([9.0, 9.0, 9.0, 9.0])
+    np.testing.assert_allclose(x[0].numpy(), [9, 9, 9, 9])
+
+
+def test_logic_and_comparison():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+    np.testing.assert_array_equal((x >= y).numpy(), [False, True, True])
+    assert bool(paddle.allclose(x, x))
+    assert not bool(paddle.allclose(x, y))
+
+
+def test_search_sort():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]])
+    np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(), [0, 0])
+    np.testing.assert_array_equal(paddle.argmin(x, axis=1).numpy(), [1, 2])
+    vals, idx = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[3, 2], [6, 5]])
+    s = paddle.sort(x, axis=1)
+    np.testing.assert_allclose(s.numpy(), [[1, 2, 3], [4, 5, 6]])
+    w = paddle.where(x > 2.0, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [[3, 0, 0], [6, 5, 4]])
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype(np.float32))
+    idx = paddle.to_tensor([0, 2])
+    g = paddle.gather(x, idx, axis=0)
+    np.testing.assert_allclose(g.numpy(), [[0, 1, 2], [6, 7, 8]])
+    upd = paddle.to_tensor([[10.0, 10, 10], [20, 20, 20]])
+    s = paddle.scatter(x, idx, upd)
+    np.testing.assert_allclose(s.numpy()[0], [10, 10, 10])
+    np.testing.assert_allclose(s.numpy()[2], [20, 20, 20])
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.linalg.inv(t).numpy(), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(paddle.linalg.det(t)), np.linalg.det(a), rtol=1e-4)
+    q, r = paddle.linalg.qr(t)
+    np.testing.assert_allclose((q @ r).numpy(), a, rtol=1e-4, atol=1e-4)
+    n = paddle.linalg.norm(t)
+    np.testing.assert_allclose(float(n), np.linalg.norm(a), rtol=1e-5)
+
+
+def test_random_reproducibility():
+    paddle.seed(42)
+    a = paddle.randn([4, 4])
+    paddle.seed(42)
+    b = paddle.randn([4, 4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    c = paddle.randn([4, 4])
+    assert not np.array_equal(b.numpy(), c.numpy())
+
+
+def test_stat():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(float(paddle.std(x)), np.std(x.numpy(), ddof=1), rtol=1e-6)
+    np.testing.assert_allclose(float(paddle.var(x)), np.var(x.numpy(), ddof=1), rtol=1e-6)
+    np.testing.assert_allclose(float(paddle.median(x)), 2.5)
+
+
+def test_cast_chain_and_item():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == 3.5
+    assert int(paddle.to_tensor(7)) == 7
+    assert paddle.to_tensor(True).dtype == paddle.bool
+
+
+def test_cumsum_cumprod():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(paddle.cumsum(x, axis=0).numpy(), [[1, 2], [4, 6]])
+    np.testing.assert_allclose(paddle.cumprod(x, dim=1).numpy(), [[1, 2], [3, 12]])
+
+
+def test_clip_and_scale():
+    x = paddle.to_tensor([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(paddle.clip(x, 0.0, 1.0).numpy(), [0, 0.5, 1])
+    np.testing.assert_allclose(paddle.scale(x, 2.0, 1.0).numpy(), [-1, 2, 5])
