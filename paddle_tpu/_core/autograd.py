@@ -115,6 +115,37 @@ class GradNode:
         self.released = True
 
 
+def _maybe_amp_cast(name, args):
+    """AMP O1 cast hook (reference: AMP logic in generated ad_funcs,
+    paddle/fluid/eager/amp_utils.h): white-listed ops run in the low dtype,
+    black-listed ops in float32, others follow their inputs."""
+    try:
+        from paddle_tpu import amp as amp_mod
+    except ImportError:
+        return args
+    st = amp_mod.amp_state()
+    if not st.enabled:
+        return args
+    if name in amp_mod.white_list():
+        target = st.dtype
+    elif name in amp_mod.black_list():
+        target = jnp.float32
+    else:
+        return args
+
+    def cast(a):
+        if isinstance(a, Tensor) and jnp.issubdtype(a._value.dtype, jnp.floating):
+            if a._value.dtype != target:
+                if a.stop_gradient or not _state.enabled:
+                    return Tensor(a._value.astype(target))
+                # grad-carrying tensors cast through the tape so the cotangent
+                # is cast back on the way down
+                return apply("amp_cast", lambda v: v.astype(target), a)
+        return a
+
+    return tuple(cast(a) for a in args)
+
+
 def _check_nan_inf(name, vals):
     for v in vals:
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
@@ -130,6 +161,7 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
     Non-Tensor args and stop_gradient Tensors are closed over (not
     differentiated).  Integer/bool outputs never require grad.
     """
+    args = _maybe_amp_cast(name, args)
     tensors = [a for a in args if isinstance(a, Tensor)]
     needs_grad = _state.enabled and any(not t.stop_gradient for t in tensors)
 

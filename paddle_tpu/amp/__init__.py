@@ -1,0 +1,204 @@
+"""AMP: auto_cast / decorate / GradScaler.
+
+Reference: python/paddle/amp/auto_cast.py:703, grad_scaler.py:578, per-op
+white/black lists in C++ (paddle/fluid/eager/amp_utils.h).  TPU-native notes:
+the natural mixed-precision dtype on TPU is bfloat16, which needs NO loss
+scaling (same exponent range as fp32) — GradScaler degenerates to a pass-
+through there, but retains full dynamic-scaling semantics for float16.
+The cast hook lives in the op dispatcher (autograd.apply consults
+`amp_state()`), mirroring the reference's AMP auto-cast insertion in
+generated ad_funcs (eager_gen.py AMP logic).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "amp_state", "white_list", "black_list"]
+
+# Ops that benefit from low precision (MXU ops) — reference white list.
+WHITE_LIST = {
+    "matmul", "linear", "conv", "conv_transpose", "mm", "bmm", "einsum", "addmm",
+    "scaled_dot_product_attention",
+}
+# Numerically sensitive ops stay fp32 — reference black list.
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax_with_cross_entropy",
+    "cross_entropy", "layer_norm", "rms_norm", "batch_norm", "group_norm",
+    "instance_norm", "softmax", "log_softmax", "mean", "sum", "cumsum", "norm",
+    "pow", "sqrt", "rsqrt", "square", "reciprocal",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def white_list():
+    return (WHITE_LIST | _state.custom_white) - _state.custom_black
+
+
+def black_list():
+    return (BLACK_LIST | _state.custom_black) - _state.custom_white
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast equivalent.  Default dtype is bfloat16 (TPU MXU
+    native); 'float16' also supported."""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = to_jax_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision; optimizers keep fp32 master
+    weights (our optimizers always compute in fp32 — multi_precision built in).
+    """
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        dt = to_jax_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._bind(p._value.astype(dt))
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference grad_scaler.py).  On bfloat16 runs,
+    construct with enable=False (scaling unnecessary)."""
+
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=2.0**16,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=2000,
+        decr_every_n_nan_or_inf=1,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from paddle_tpu.tensor._ops_common import apply
+
+        s = self._scale
+        return apply("amp_scale", lambda v: v * jnp.asarray(s, v.dtype), var)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value.astype(jnp.float32) * inv
+                if not _is_tracer(g):
+                    found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+
+def _is_tracer(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)

@@ -1,0 +1,28 @@
+"""Public autograd API (reference: python/paddle/autograd/__init__.py)."""
+
+from paddle_tpu._core.autograd import (  # noqa: F401
+    backward_multi,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from . import functional  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference backward_mode.py:23)."""
+    import jax.numpy as jnp
+
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grads = [jnp.ones_like(t._value) for t in tensors]
+    else:
+        grad_tensors = grad_tensors if isinstance(grad_tensors, (list, tuple)) else [grad_tensors]
+        grads = [
+            jnp.ones_like(t._value) if g is None else g._value
+            for t, g in zip(tensors, grad_tensors)
+        ]
+    backward_multi(tensors, grads, retain_graph)

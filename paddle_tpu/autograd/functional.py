@@ -1,0 +1,73 @@
+"""Functional differentiation (reference: python/paddle/autograd/functional
+jacobian/hessian) — delegated to jax transforms, which also provide the
+higher-order derivatives the tape doesn't."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp"]
+
+
+def _fn_on_values(func):
+    def wrapped(*vals):
+        args = [Tensor(v) for v in vals]
+        out = func(*args)
+        return out._value if isinstance(out, Tensor) else jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out
+        )
+
+    return wrapped
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    vals = [x._value for x in xs_list]
+    jac = jax.jacobian(_fn_on_values(func), argnums=tuple(range(len(vals))))(*vals)
+    out = jax.tree_util.tree_map(Tensor, jac)
+    return out[0] if single and isinstance(out, tuple) else out
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    vals = [x._value for x in xs_list]
+    h = jax.hessian(_fn_on_values(func), argnums=tuple(range(len(vals))))(*vals)
+    out = jax.tree_util.tree_map(Tensor, h)
+    return out[0] if single and isinstance(out, tuple) else out
+
+
+def vjp(func, xs, v=None):
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    vals = [x._value for x in xs_list]
+    out, vjp_fn = jax.vjp(_fn_on_values(func), *vals)
+    if v is None:
+        cots = jnp.ones_like(out)
+    else:
+        cots = v._value if isinstance(v, Tensor) else jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, v
+        )
+    grads = vjp_fn(cots)
+    grads_t = [Tensor(g) for g in grads]
+    return Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out), (
+        grads_t[0] if single else grads_t
+    )
+
+
+def jvp(func, xs, v=None):
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    vals = [x._value for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(val) for val in vals]
+    else:
+        v_list = [v] if single else list(v)
+        tangents = [t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in v_list]
+    out, tangent_out = jax.jvp(_fn_on_values(func), tuple(vals), tuple(tangents))
+    wrap = lambda o: Tensor(o) if not isinstance(o, tuple) else tuple(Tensor(x) for x in o)  # noqa: E731
+    return wrap(out), wrap(tangent_out)

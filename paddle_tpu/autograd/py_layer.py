@@ -1,0 +1,115 @@
+"""PyLayer — user-defined autograd ops (reference: python/paddle/autograd/py_layer.py).
+
+The custom backward is attached to the tape as a hand-built GradNode, exactly
+how the reference installs a PyLayer GradNode into the eager graph."""
+
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core import autograd as core_ag
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        # forward runs without taping its internals — PyLayer owns backward
+        with core_ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        if not core_ag.is_grad_enabled():
+            return outputs
+
+        diff_inputs = [
+            a
+            for a in args
+            if isinstance(a, Tensor)
+            and not a.stop_gradient
+            and jnp.issubdtype(a._value.dtype, jnp.inexact)
+        ]
+        if not diff_inputs:
+            return outputs
+
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        out_avals = [(o._value.shape, o._value.dtype) for o in out_tensors]
+        flat_tree = jax.tree_util.tree_structure(tuple(range(len(out_tensors))))
+
+        backward_fn = cls.backward
+        n_inputs = len(diff_inputs)
+        input_positions = [i for i, a in enumerate(args) if any(a is d for d in diff_inputs)]
+        n_args_tensors = len([a for a in args if isinstance(a, Tensor)])
+
+        def vjp_fn(cot_struct):
+            cots = jax.tree_util.tree_leaves(cot_struct)
+            grad_out_tensors = [Tensor(c) for c in cots]
+            with core_ag.no_grad():
+                grads = backward_fn(ctx, *grad_out_tensors)
+            grads = grads if isinstance(grads, (list, tuple)) else (grads,)
+            # Map returned grads to diff_inputs: backward returns one grad per
+            # *tensor* input of forward (reference contract).
+            vals = []
+            gi = 0
+            tensor_args = [a for a in args if isinstance(a, Tensor)]
+            grads_full = list(grads) + [None] * (len(tensor_args) - len(grads))
+            per_tensor = dict(zip([id(t) for t in tensor_args], grads_full))
+            for d in diff_inputs:
+                g = per_tensor.get(id(d))
+                vals.append(None if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(vals)
+
+        node = core_ag.GradNode(f"PyLayer[{cls.__name__}]", vjp_fn, diff_inputs, out_avals, flat_tree)
+        for i, o in enumerate(out_tensors):
+            if jnp.issubdtype(o._value.dtype, jnp.inexact):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = i
+            node.out_refs.append(weakref.ref(o))
+        return outputs
+
+
+# Alias used by some reference code paths
+LegacyPyLayer = PyLayer

@@ -1,0 +1,148 @@
+"""Device API (reference: python/paddle/device/__init__.py:265 set_device,
+cuda stream/event API).  Streams don't exist on the XLA path — ordering is
+owned by the compiler — so Stream/Event are compatibility no-ops that still
+give correct synchronize() semantics via jax block_until_ready."""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu._core.place import (  # noqa: F401
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+__all__ = [
+    "set_device",
+    "get_device",
+    "get_all_device_type",
+    "get_available_device",
+    "device_count",
+    "synchronize",
+    "Stream",
+    "Event",
+    "current_stream",
+    "stream_guard",
+    "is_compiled_with_tpu",
+    "IS_WINDOWS",
+]
+
+IS_WINDOWS = False
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "tpu")]
+
+
+def synchronize(device=None):
+    """Block until all launched device work completes."""
+    jax.effects_barrier()
+
+
+class Stream:
+    """Compatibility stream object; XLA schedules internally."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+class cuda:
+    """Namespace shim: the reference exposes paddle.device.cuda.*; here those
+    map to the single accelerator's stats."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _current
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass

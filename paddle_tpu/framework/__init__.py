@@ -1,0 +1,5 @@
+"""Framework utilities (reference: python/paddle/framework/)."""
+
+from .io_utils import load, save  # noqa: F401
+from paddle_tpu._core.random import seed  # noqa: F401
+from paddle_tpu._core.random import get_rng_state, set_rng_state  # noqa: F401

@@ -1,0 +1,63 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:721,960).
+
+Serialization format: pickle of a nested structure whose leaf Tensors become
+numpy arrays (portable, framework-agnostic) — same pickle-compatible contract
+as the reference's state_dict files, without the protobuf program baggage.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PDTPU1\x00"
+
+
+def _to_portable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value), "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_portable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_to_portable(v) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    return obj
+
+
+def _from_portable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _from_portable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_from_portable(v, return_numpy) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_portable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _from_portable(obj, return_numpy=return_numpy)

@@ -1,0 +1,164 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference python/paddle/metric/metrics.py accuracy)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _acc(logits, lbl):
+        topk_idx = jnp.argsort(-logits, axis=-1)[..., :k]
+        l = lbl.reshape(-1, 1) if lbl.ndim == 1 else lbl
+        hit = jnp.any(topk_idx == l, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply("accuracy", _acc, input, label)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
+        label_np = np.asarray(label._value if isinstance(label, Tensor) else label)
+        idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        correct = idx == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(correct._value if isinstance(correct, Tensor) else correct)
+        num = c.shape[0] if c.ndim > 0 else 1
+        for i, k in enumerate(self.topk):
+            hits = c[..., :k].any(axis=-1).sum()
+            self.total[i] += float(hits)
+            self.count[i] += int(num)
+        accs = [t / max(cn, 1) for t, cn in zip(self.total, self.count)]
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        accs = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return accs[0] if len(accs) == 1 else accs
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds).round()
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds).round()
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self.num_thresholds = num_thresholds
+        self._name = name or "auc"
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).reshape(-1)
+        pos_prob = p[:, 1] if p.ndim == 2 and p.shape[1] == 2 else p.reshape(-1)
+        bins = np.minimum((pos_prob * self.num_thresholds).astype(int), self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate over thresholds from high to low
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name

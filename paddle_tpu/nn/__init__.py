@@ -1,0 +1,7 @@
+"""paddle.nn equivalent surface (reference: python/paddle/nn/__init__.py)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer, ParamAttr  # noqa: F401

@@ -1,0 +1,184 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All map to jax.nn / jnp primitives — XLA fuses them into surrounding matmuls,
+which is the TPU replacement for the reference's fused activation kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor, unary
+
+relu = unary("relu", jax.nn.relu)
+relu6 = unary("relu6", jax.nn.relu6)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+tanh = unary("tanh", jnp.tanh)
+silu = unary("silu", jax.nn.silu)
+swish = silu
+mish = unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+hardswish = unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = unary("hardsigmoid", lambda v: jnp.clip(v / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = unary("tanhshrink", lambda v: v - jnp.tanh(v))
+softsign = unary("softsign", jax.nn.soft_sign)
+log_sigmoid = unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    x = ensure_tensor(x)
+    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=bool(approximate)), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = ensure_tensor(x)
+    return apply("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply("elu", lambda v: jax.nn.elu(v, alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply("celu", lambda v: jax.nn.celu(v, alpha), x)
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    x = ensure_tensor(x)
+    return apply("selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def _prelu(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v > 0, v, wb * v)
+
+    return apply("prelu", _prelu, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    x = ensure_tensor(x)
+    if training:
+        from paddle_tpu._core import random as rng
+
+        def _rrelu(v):
+            a = jax.random.uniform(rng.next_key(), v.shape, jnp.float32, lower, upper).astype(v.dtype)
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply("rrelu", _rrelu, x)
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    return apply("hardtanh", lambda v: jnp.clip(v, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, jnp.zeros((), v.dtype)), x
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, jnp.zeros((), v.dtype))),
+        x,
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta),
+        x,
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from paddle_tpu._core.dtype import to_jax_dtype
+
+    dt = to_jax_dtype(dtype)
+
+    def _sm(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.softmax(v, axis=int(axis))
+
+    return apply("softmax", _sm, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from paddle_tpu._core.dtype import to_jax_dtype
+
+    dt = to_jax_dtype(dtype)
+
+    def _lsm(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.log_softmax(v, axis=int(axis))
+
+    return apply("log_softmax", _lsm, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    from paddle_tpu._core import random as rng
+
+    def _gs(v):
+        g = jax.random.gumbel(rng.next_key(), v.shape).astype(v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, jnp.ones((), y.dtype), axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return apply("gumbel_softmax", _gs, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def _mo(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = list(v.shape[:ax]) + [c // groups, groups] + list(v.shape[ax + 1 :])
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", _mo, x)
+
+
+def glu(x, axis=-1, name=None):
+    x = ensure_tensor(x)
+    return apply("glu", lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = ensure_tensor(x)
+    return apply(
+        "thresholded_relu", lambda v: jnp.where(v > threshold, v, jnp.asarray(value, v.dtype)), x
+    )

@@ -1,0 +1,87 @@
+"""Attention functionals.
+
+Reference surface: paddle.nn.functional.scaled_dot_product_attention backed by
+flash-attention CUDA kernels (paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+TPU-native: jax.nn.dot_product_attention by default, with a Pallas
+flash-attention kernel (paddle_tpu.ops.flash_attention) for the fused path.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p=0.0,
+    is_causal=False,
+    training=True,
+    name=None,
+):
+    """Inputs are [batch, seq, heads, head_dim] (paddle flash-attn layout)."""
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+
+    def _sdpa(q, k, v, *rest):
+        # jax.nn.dot_product_attention expects BSNH as well.
+        mask = rest[0] if rest else None
+        bias = None
+        if mask is not None and mask.dtype != jnp.bool_:
+            bias = mask
+            mask = None
+        out = jax.nn.dot_product_attention(
+            q,
+            k,
+            v,
+            bias=bias,
+            mask=mask,
+            is_causal=bool(is_causal),
+        )
+        return out
+
+    extra = [ensure_tensor(attn_mask)] if attn_mask is not None else []
+    out = apply("scaled_dot_product_attention", _sdpa, query, key, value, *extra)
+    if dropout_p > 0.0 and training:
+        from .common import dropout
+
+        out = dropout(out, dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity: returns
+    (out, softmax_lse placeholder)."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    return out, None
+
+
+def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
+    """Pure-jnp reference used by tests and as the flash-attn numerics oracle."""
+    # q,k,v: [B, S, N, H] -> compute in [B, N, S, H]
+    q = jnp.swapaxes(q, 1, 2)
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bnqh,bnkh->bnqk", q, k) * s
+    if is_causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(causal_mask, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnqk,bnkh->bnqh", probs, v)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,336 @@
+"""Common functionals: linear, dropout, embedding, normalize, similarity,
+interpolate, pad, unfold (reference: python/paddle/nn/functional/common.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core import random as rng
+from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W is [in, out] (paddle convention) — straight MXU matmul."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        return apply("linear", lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias)
+    return apply("linear", lambda v, w: jnp.matmul(v, w), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout_infer", lambda v: v * (1.0 - p), x)
+        return x
+    key = rng.next_key()
+
+    def _drop(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return apply("dropout", _drop, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = rng.next_key()
+
+    def _ad(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 - p + p * alpha_p**2) ** -0.5
+        b = -a * p * alpha_p
+        return a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b
+
+    return apply("alpha_dropout", _ad, x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    return alpha_dropout(x, p, training)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def _emb(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply("embedding", _emb, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return apply("one_hot", lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def _norm(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply("normalize", _norm, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def _cs(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply("cosine_similarity", _cs, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def _bl(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    if bias is not None:
+        return apply("bilinear", _bl, x1, x2, weight, ensure_tensor(bias))
+    return apply("bilinear", _bl, x1, x2, weight)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from paddle_tpu.tensor.manipulation import pad as _pad
+
+    return _pad(x, pad, mode, value, data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference unfold op) — NCHW in, [N, C*kh*kw, L] out."""
+    x = ensure_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def _unfold(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        patches = jax.lax.conv_general_dilated_patches(
+            v,
+            filter_shape=ks,
+            window_strides=st,
+            padding="VALID",
+            rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        # patches: [N, C*kh*kw, out_h, out_w]
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply("unfold", _unfold, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    os = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def _fold(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os[0] + pd[0] + pd[2], os[1] + pd[1] + pd[3]
+        out_h = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        out_w = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], out_h, out_w)
+        result = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wj = j * dl[1]
+                result = result.at[
+                    :, :, hi : hi + out_h * st[0] : st[0], wj : wj + out_w * st[1] : st[1]
+                ].add(v[:, :, i, j])
+        return result[:, :, pd[0] : ph - pd[2], pd[1] : pw - pd[3]]
+
+    return apply("fold", _fold, x)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    channel_last = data_format[-1] == "C"
+    spatial = nd - 2
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        out_size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * spatial)]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial
+        in_sp = x.shape[2:] if not channel_last else x.shape[1:-1]
+        out_size = [int(s * f) for s, f in zip(in_sp, sf)]
+
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode.lower()]
+
+    def _interp(v):
+        if channel_last:
+            full = [v.shape[0]] + out_size + [v.shape[-1]]
+        else:
+            full = [v.shape[0], v.shape[1]] + out_size
+        if jmode == "nearest":
+            return jax.image.resize(v, full, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with explicit gather.
+            return _resize_align_corners(v, full, jmode, channel_last)
+        return jax.image.resize(v, full, method=jmode)
+
+    return apply("interpolate", _interp, x)
+
+
+def _resize_align_corners(v, full, method, channel_last):
+    sp_axes = list(range(1, v.ndim - 1)) if channel_last else list(range(2, v.ndim))
+    out = v
+    for ax_i, ax in enumerate(sp_axes):
+        in_n = out.shape[ax]
+        out_n = full[ax]
+        if in_n == out_n:
+            continue
+        if out_n == 1:
+            idx = jnp.zeros((1,), jnp.float32)
+        else:
+            idx = jnp.linspace(0.0, in_n - 1, out_n)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_n - 1)
+        frac = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = out_n
+        frac = frac.reshape(shape)
+        lo_g = jnp.take(out, lo, axis=ax)
+        hi_g = jnp.take(out, hi, axis=ax)
+        out = lo_g * (1 - frac) + hi_g * frac
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def _ps(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply("pixel_shuffle", _ps, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def _pu(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+        return v.reshape(n, h // r, w // r, c * r * r)
+
+    return apply("pixel_unshuffle", _pu, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def _cs(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            v = jnp.swapaxes(v, 1, 2)
+            return v.reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        v = jnp.swapaxes(v, 3, 4)
+        return v.reshape(n, h, w, c)
+
+    return apply("channel_shuffle", _cs, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+
+    def _ls(v, *rest):
+        k = v.shape[-1]
+        if rest:
+            return (1 - epsilon) * v + epsilon * rest[0]
+        return (1 - epsilon) * v + epsilon / k
+
+    if prior_dist is not None:
+        return apply("label_smooth", _ls, label, ensure_tensor(prior_dist))
+    return apply("label_smooth", _ls, label)

@@ -1,0 +1,153 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py; kernels
+paddle/phi/kernels/gpu/conv_kernel.cu → here lax.conv_general_dilated, which
+XLA tiles onto the MXU)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(i) for i in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(i) for i in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, strides, dilations, kernel):
+    """Normalize paddle padding spec → lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[-nd:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    out_spec = lhs_spec
+    rhs_spec = "OI" + spatial  # weight is [out, in/groups, *k]
+    pad_spec = _padding(padding, nd, strides, dilations, weight.shape[2:])
+    dn = jax.lax.conv_dimension_numbers(tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+
+    def _cv(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v,
+            w,
+            window_strides=strides,
+            padding=pad_spec,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply("conv", _cv, x, weight, ensure_tensor(bias))
+    return apply("conv", _cv, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, nd, data_format, output_size):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    opad = _tuple(output_padding, nd) if output_padding is not None else (0,) * nd
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[-nd:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    rhs_spec = "IO" + spatial  # paddle conv_transpose weight is [in, out/groups, *k]
+    dn = jax.lax.conv_dimension_numbers(tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, lhs_spec))
+    pad_spec = _padding(padding, nd, strides, dilations, weight.shape[2:])
+
+    def _cvt(v, w, *rest):
+        if isinstance(pad_spec, str):
+            pads = pad_spec
+        else:
+            # transpose padding: lax.conv_transpose handles via 'padding' on the fwd conv
+            pads = [(p[0], p[1] + o) for p, o in zip(pad_spec, opad)] if opad != (0,) * nd else pad_spec
+
+        if groups == 1:
+            out = jax.lax.conv_transpose(
+                v, w, strides=strides, padding=pads, rhs_dilation=dilations,
+                dimension_numbers=dn, transpose_kernel=False,
+            )
+        else:
+            # grouped transpose: split and concat along channel axis
+            ch_ax = 1 if not channel_last else v.ndim - 1
+            vs = jnp.split(v, groups, axis=ch_ax)
+            ws = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_transpose(
+                    vv, ww, strides=strides, padding=pads, rhs_dilation=dilations,
+                    dimension_numbers=dn, transpose_kernel=False,
+                )
+                for vv, ww in zip(vs, ws)
+            ]
+            out = jnp.concatenate(outs, axis=ch_ax)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    out = apply("conv_transpose", _cvt, x, weight, *( [ensure_tensor(bias)] if bias is not None else [] ))
+    if output_size is not None:
+        target = [int(s) for s in (output_size if isinstance(output_size, (list, tuple)) else [output_size] * nd)]
+        cur = out.shape[2:] if not channel_last else out.shape[1:-1]
+        if list(cur) != target:
+            # crop/pad to requested size
+            from paddle_tpu.tensor.manipulation import slice as _slice
+
+            axes = list(range(2, 2 + nd)) if not channel_last else list(range(1, 1 + nd))
+            starts = [0] * nd
+            ends = target
+            out = _slice(out, axes, starts, ends)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, output_size)

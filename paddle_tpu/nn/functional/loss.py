@@ -1,0 +1,409 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _ce(logits, lbl, *rest):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lbl_idx = lbl.astype(jnp.int32)
+            if lbl_idx.ndim == logits.ndim:
+                lbl_idx = jnp.squeeze(lbl_idx, axis=axis)
+            if label_smoothing > 0:
+                oh = jax.nn.one_hot(lbl_idx, n_classes, dtype=logp.dtype, axis=axis)
+                soft = oh * (1 - label_smoothing) + label_smoothing / n_classes
+                loss = -jnp.sum(soft * logp, axis=axis)
+            else:
+                picked = jnp.take_along_axis(logp, jnp.expand_dims(lbl_idx, axis), axis=axis)
+                loss = -jnp.squeeze(picked, axis=axis)
+            mask = lbl_idx != ignore_index
+            loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+            if rest:
+                w = rest[0]
+                wsel = jnp.take(w, jnp.clip(lbl_idx, 0, n_classes - 1))
+                loss = loss * jnp.where(mask, wsel, 0.0)
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(mask, wsel, 0.0)), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    extra = [ensure_tensor(weight)] if weight is not None else []
+    return apply("cross_entropy", _ce, input, label, *extra)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+
+    def _swce(lg, lb):
+        logp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lb * logp, axis=axis, keepdims=True)
+        else:
+            idx = lb.astype(jnp.int32)
+            squeeze = idx.ndim == lg.ndim
+            if squeeze:
+                idx = jnp.squeeze(idx, axis=axis)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(idx, axis), axis=axis)
+            loss = -picked
+            mask = jnp.expand_dims(idx, axis) != ignore_index
+            loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+        if return_softmax:
+            return loss, jax.nn.softmax(lg, axis=axis)
+        return loss
+
+    return apply("softmax_with_cross_entropy", _swce, logits, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _nll(logp, lbl, *rest):
+        idx = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(idx, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        mask = idx != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if rest:
+            w = jnp.take(rest[0], jnp.clip(idx, 0, logp.shape[1] - 1))
+            w = jnp.where(mask, w, 0.0)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    extra = [ensure_tensor(weight)] if weight is not None else []
+    return apply("nll_loss", _nll, input, label, *extra)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _sl1(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply("smooth_l1_loss", _sl1, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _huber(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply("huber_loss", _huber, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _bce(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    extra = [ensure_tensor(weight)] if weight is not None else []
+    return apply("bce", _bce, input, label, *extra)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+
+    def _bcel(z, y, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        pw = next(it) if pos_weight is not None else None
+        max_val = jnp.maximum(-z, 0.0)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val)) + max_val)
+        else:
+            loss = (1 - y) * z + max_val + jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    extra = [ensure_tensor(t) for t in (weight, pos_weight) if t is not None]
+    return apply("bce_with_logits", _bcel, logit, label, *extra)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _kl(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kl_div", _kl, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    input, other, label = ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)
+    return apply(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+        input,
+        other,
+        label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(
+        "hinge_embedding_loss",
+        lambda x, y: _reduce(jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0)), reduction),
+        input,
+        label,
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    input1, input2, label = ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label)
+
+    def _cel(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return apply("cosine_embedding_loss", _cel, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    input, positive, negative = ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative)
+
+    def _tml(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1.0 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1.0 / p)
+        if swap:
+            dsn = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1.0 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", _tml, input, positive, negative)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha recursion in log space, vectorized with scan
+    (reference: warpctc kernel paddle/phi/kernels/gpu/warpctc_kernel.cu)."""
+    log_probs, labels = ensure_tensor(log_probs), ensure_tensor(labels)
+    input_lengths, label_lengths = ensure_tensor(input_lengths), ensure_tensor(label_lengths)
+
+    def _ctc(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] log-softmax already? paddle expects raw logits? docs: log_probs
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended labels with blanks
+        ext = jnp.full((B, S), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        def get_probs(t_lp):
+            return jnp.take_along_axis(t_lp[:, :], ext, axis=1)  # [B, S]
+
+        alpha0 = jnp.full((B, S), neg_inf, lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = jnp.take_along_axis(lp[0], jnp.clip(ext[:, 1:2], 0, C - 1), axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lbl_len > 0, first_lbl, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, t_lp):
+            p = jnp.take_along_axis(t_lp, jnp.clip(ext, 0, C - 1), axis=1)
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf, lp.dtype), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf, lp.dtype), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            m_safe = jnp.where(m == neg_inf, 0.0, m)
+            summed = (
+                jnp.exp(a_prev - m_safe)
+                + jnp.exp(a_shift1 - m_safe)
+                + jnp.where(a_shift2 == neg_inf, 0.0, jnp.exp(a_shift2 - m_safe))
+            )
+            new_alpha = jnp.where(m == neg_inf, neg_inf, m_safe + jnp.log(summed)) + p
+            return new_alpha, new_alpha
+
+        alpha_T, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+        # gather alpha at t = in_len-1, s in {2*lbl_len, 2*lbl_len-1}
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        aT = jnp.take_along_axis(all_alphas, t_idx[None, :, None], axis=0)[0]  # [B,S]
+        sl = jnp.clip(2 * lbl_len, 0, S - 1)
+        sl1 = jnp.clip(2 * lbl_len - 1, 0, S - 1)
+        a1 = jnp.take_along_axis(aT, sl[:, None], axis=1)[:, 0]
+        a2 = jnp.take_along_axis(aT, sl1[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a1, a2)
+        m_safe = jnp.where(m == neg_inf, 0.0, m)
+        ll = m_safe + jnp.log(jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply("ctc_loss", _ctc, log_probs, labels, input_lengths, label_lengths)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+
+    def _focal(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            alpha_t = alpha * y + (1 - alpha) * (1 - y)
+            loss = alpha_t * loss
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+
+    extra = [ensure_tensor(normalizer)] if normalizer is not None else []
+    return apply("sigmoid_focal_loss", _focal, logit, label, *extra)
+
+
+def square_error_cost(input, label):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input,
+        label,
+    )
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _pnll(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(jnp.maximum(y, 1.0)) - y + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(y, 1.0))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply("poisson_nll_loss", _pnll, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):
+    input, label, variance = ensure_tensor(input), ensure_tensor(label), ensure_tensor(variance)
+
+    def _gnll(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+
+    return apply("gaussian_nll_loss", _gnll, input, label, variance)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _ml(z, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if rest:
+            loss = loss * rest[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+
+    extra = [ensure_tensor(weight)] if weight is not None else []
+    return apply("multi_label_soft_margin_loss", _ml, input, label, *extra)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(
+        "soft_margin_loss",
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+        input,
+        label,
+    )
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _dice(p, y):
+        y_oh = jax.nn.one_hot(jnp.squeeze(y, -1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y_oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y_oh, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", _dice, input, label)

@@ -1,0 +1,175 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+fused kernels paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu — on TPU
+XLA fuses the reduction+scale chain; a Pallas fused variant lives in
+paddle_tpu.incubate for the long-row case)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = ensure_tensor(x)
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    nd = len(ns)
+
+    def _ln(v, *rest):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mean), axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it)
+        if bias is not None:
+            out = out + next(it)
+        return out
+
+    extra = [ensure_tensor(t) for t in (weight, bias) if t is not None]
+    return apply("layer_norm", _ln, x, *extra)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (no mean subtraction) — the LLaMA-family norm; reference exposes
+    it as incubate fused_rms_norm."""
+    x = ensure_tensor(x)
+
+    def _rms(v, *rest):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+
+    extra = [ensure_tensor(weight)] if weight is not None else []
+    return apply("rms_norm", _rms, x, *extra)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    x = ensure_tensor(x)
+    running_mean, running_var = ensure_tensor(running_mean), ensure_tensor(running_var)
+    channel_last = data_format[-1] == "C" and len(data_format) > 2 or data_format == "NLC" or data_format == "NHWC" or data_format == "NDHWC"
+    use_batch_stats = training and not use_global_stats
+
+    def _bn(v, rm, rv, *rest):
+        ch_ax = v.ndim - 1 if channel_last else (1 if v.ndim > 1 else 0)
+        shape = [1] * v.ndim
+        shape[ch_ax] = v.shape[ch_ax]
+        if use_batch_stats:
+            axes = tuple(d for d in range(v.ndim) if d != ch_ax)
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        out = (v - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out, mean, var
+
+    extra = [ensure_tensor(t) for t in (weight, bias) if t is not None]
+    out, batch_mean, batch_var = apply("batch_norm", _bn, x, running_mean, running_var, *extra)
+
+    if use_batch_stats:
+        # Update running stats in place (reference semantics: stats are
+        # buffers mutated during training).
+        with_no_grad_update(running_mean, momentum, batch_mean)
+        with_no_grad_update(running_var, momentum, batch_var)
+    return out
+
+
+def with_no_grad_update(running, momentum, batch_stat):
+    running._bind(running._value * momentum + batch_stat._value * (1.0 - momentum))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C" and len(data_format) > 3
+
+    def _in(v, *rest):
+        ch_ax = v.ndim - 1 if channel_last else 1
+        axes = tuple(d for d in range(2, v.ndim)) if not channel_last else tuple(d for d in range(1, v.ndim - 1))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * v.ndim
+        shape[ch_ax] = v.shape[ch_ax]
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+
+    extra = [ensure_tensor(t) for t in (weight, bias) if t is not None]
+    return apply("instance_norm", _in, x, *extra)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+
+    def _gn(v, *rest):
+        if channel_last:
+            v_t = jnp.moveaxis(v, -1, 1)
+        else:
+            v_t = v
+        n, c = v_t.shape[0], v_t.shape[1]
+        sp = v_t.shape[2:]
+        g = v_t.reshape(n, num_groups, c // num_groups, *sp)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_t.shape)
+        shape = [1] * v_t.ndim
+        shape[1] = c
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    extra = [ensure_tensor(t) for t in (weight, bias) if t is not None]
+    return apply("group_norm", _gn, x, *extra)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def _lrn(v):
+        ch_ax = 1 if data_format[1] == "C" else v.ndim - 1
+        sq = jnp.square(v)
+        # sum over a window along channels
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * v.ndim
+        pads[ch_ax] = (pad_lo, pad_hi)
+        sq_p = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            sl = [slice(None)] * v.ndim
+            sl[ch_ax] = slice(i, i + v.shape[ch_ax])
+            acc = acc + sq_p[tuple(sl)]
+        return v / jnp.power(k + alpha * acc, beta)
+
+    return apply("local_response_norm", _lrn, x)

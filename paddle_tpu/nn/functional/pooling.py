@@ -1,0 +1,170 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py) —
+lax.reduce_window is the XLA-native pooling primitive."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in (v if len(v) == n else list(v) * n)[:n])
+    return tuple(int(v) for _ in range(n))
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _pool(x, kernel, stride, padding, nd, data_format, reducer, init, ceil_mode=False, count_include_pad=True, is_avg=False):
+    x = ensure_tensor(x)
+    ks = _tuple(kernel, nd)
+    st = _tuple(stride if stride is not None else kernel, nd)
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        dims = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+    else:
+        dims = (1, 1) + ks
+        strides = (1, 1) + st
+    pd = _pads(padding, nd)
+    if isinstance(pd, str):
+        pad_full = pd
+    else:
+        pad_full = ([(0, 0)] + list(pd) + [(0, 0)]) if channel_last else ([(0, 0), (0, 0)] + list(pd))
+
+    def _p(v):
+        if is_avg:
+            ones = jnp.ones_like(v)
+            s = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, pad_full)
+            if count_include_pad and not isinstance(pad_full, str):
+                denom = float(np.prod(ks))
+                return s / denom
+            c = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad_full)
+            return s / c
+        return jax.lax.reduce_window(v, init, reducer, dims, strides, pad_full)
+
+    return apply("pool", _p, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.max, -jnp.inf)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max, -jnp.inf)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max, -jnp.inf)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add, 0.0, is_avg=True, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add, 0.0, is_avg=True, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add, 0.0, is_avg=True, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, nd, data_format, is_avg):
+    x = ensure_tensor(x)
+    os = _tuple(output_size, nd)
+    channel_last = data_format[-1] == "C"
+
+    def _ap(v):
+        sp_axes = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+        out = v
+        for ax, o in zip(sp_axes, os):
+            n = out.shape[ax]
+            # split into o regions with boundaries floor(i*n/o) .. ceil((i+1)*n/o)
+            starts = [int(np.floor(i * n / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * n / o)) for i in range(o)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(s, e)
+                seg = out[tuple(sl)]
+                red = jnp.mean(seg, axis=ax, keepdims=True) if is_avg else jnp.max(seg, axis=ax, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply("adaptive_pool", _ap, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, True)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, True)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", False)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+
+    def _lp(v):
+        from paddle_tpu.nn.functional.pooling import _pads, _tuple  # self-import ok
+
+        ks = _tuple(kernel_size, 1)
+        st = _tuple(stride if stride is not None else kernel_size, 1)
+        dims = (1, 1) + ks
+        strides = (1, 1) + st
+        pd = _pads(padding, 1)
+        pad_full = [(0, 0), (0, 0)] + list(pd)
+        s = jax.lax.reduce_window(jnp.abs(v) ** p, 0.0, jax.lax.add, dims, strides, pad_full)
+        return s ** (1.0 / p)
+
+    return apply("lp_pool1d", _lp, x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+
+    def _lp(v):
+        ks = _tuple(kernel_size, 2)
+        st = _tuple(stride if stride is not None else kernel_size, 2)
+        dims = (1, 1) + ks
+        strides = (1, 1) + st
+        pd = _pads(padding, 2)
+        pad_full = [(0, 0), (0, 0)] + list(pd)
+        s = jax.lax.reduce_window(jnp.abs(v) ** p, 0.0, jax.lax.add, dims, strides, pad_full)
+        return s ** (1.0 / p)
+
+    return apply("lp_pool2d", _lp, x)

@@ -1,0 +1,326 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+Time recursion runs under jax.lax.scan — the compiler-friendly control-flow
+replacement for the reference's cudnn RNN kernels / per-step Python loops.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32", init_value=0.0):
+        batch = batch_ref.shape[0]
+        return paddle.full([batch, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _cell(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply("simple_rnn_cell", _cell, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def _cell(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply("lstm_cell", _cell, inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply("gru_cell", _cell, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outputs = []
+        x = inputs
+        if not self.time_major:
+            x = paddle.transpose(x, [1, 0] + list(range(2, x.ndim)))
+        steps = range(x.shape[0] - 1, -1, -1) if self.is_reverse else range(x.shape[0])
+        states = initial_states
+        outs = [None] * x.shape[0]
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        stacked = paddle.stack(outs, axis=0)
+        if not self.time_major:
+            stacked = paddle.transpose(stacked, [1, 0] + list(range(2, stacked.ndim)))
+        return stacked, states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent network executed as a
+    fused lax.scan per layer/direction — weights stacked so each time step is
+    one batched matmul on the MXU."""
+
+    mode = "RNN_TANH"
+
+    def __init__(
+        self,
+        input_size,
+        hidden_size,
+        num_layers=1,
+        direction="forward",
+        time_major=False,
+        dropout=0.0,
+        weight_ih_attr=None,
+        weight_hh_attr=None,
+        bias_ih_attr=None,
+        bias_hh_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        self.num_directions = num_dirs
+        gate_mult = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}[self.mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = f"_reverse" if d == 1 else ""
+                self.add_parameter(
+                    f"weight_ih_l{layer}{suffix}",
+                    self.create_parameter([gate_mult * hidden_size, in_sz], weight_ih_attr, default_initializer=init),
+                )
+                self.add_parameter(
+                    f"weight_hh_l{layer}{suffix}",
+                    self.create_parameter([gate_mult * hidden_size, hidden_size], weight_hh_attr, default_initializer=init),
+                )
+                self.add_parameter(
+                    f"bias_ih_l{layer}{suffix}",
+                    self.create_parameter([gate_mult * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init),
+                )
+                self.add_parameter(
+                    f"bias_hh_l{layer}{suffix}",
+                    self.create_parameter([gate_mult * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init),
+                )
+
+    def _step_fn(self):
+        mode = self.mode
+
+        def step(carry, xt, wi, wh, bi, bh):
+            if mode == "LSTM":
+                h, c = carry
+                gates = xt @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            if mode == "GRU":
+                h = carry
+                gi = xt @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                return (1 - z) * c + z * h, (1 - z) * c + z * h
+            h = carry
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+            h_new = act(xt @ wi.T + bi + h @ wh.T + bh)
+            return h_new, h_new
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.mode == "LSTM"
+        num_dirs = self.num_directions
+        step = self._step_fn()
+
+        params = []
+        for layer in range(self.num_layers):
+            for d in range(num_dirs):
+                suffix = "_reverse" if d == 1 else ""
+                params.append(
+                    (
+                        getattr(self, f"weight_ih_l{layer}{suffix}"),
+                        getattr(self, f"weight_hh_l{layer}{suffix}"),
+                        getattr(self, f"bias_ih_l{layer}{suffix}"),
+                        getattr(self, f"bias_hh_l{layer}{suffix}"),
+                    )
+                )
+
+        time_major = self.time_major
+        num_layers = self.num_layers
+        hidden = self.hidden_size
+        mode = self.mode
+
+        def _run(x, *flat_params):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            B = x.shape[1]
+            hs, cs = [], []
+            inp = x
+            idx = 0
+            for layer in range(num_layers):
+                outs_dir = []
+                for d in range(num_dirs):
+                    wi, wh, bi, bh = flat_params[idx * 4 : idx * 4 + 4]
+                    idx += 1
+                    h0 = jnp.zeros((B, hidden), x.dtype)
+                    carry0 = (h0, jnp.zeros((B, hidden), x.dtype)) if is_lstm else h0
+                    seq = jnp.flip(inp, 0) if d == 1 else inp
+
+                    def scan_step(carry, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(carry, xt, wi, wh, bi, bh)
+
+                    carry_f, out = jax.lax.scan(scan_step, carry0, seq)
+                    if d == 1:
+                        out = jnp.flip(out, 0)
+                    outs_dir.append(out)
+                    if is_lstm:
+                        hs.append(carry_f[0])
+                        cs.append(carry_f[1])
+                    else:
+                        hs.append(carry_f)
+                inp = jnp.concatenate(outs_dir, axis=-1) if num_dirs == 2 else outs_dir[0]
+            out = inp if time_major else jnp.swapaxes(inp, 0, 1)
+            h_stack = jnp.stack(hs, axis=0)
+            if is_lstm:
+                c_stack = jnp.stack(cs, axis=0)
+                return out, h_stack, c_stack
+            return out, h_stack
+
+        flat = [p for group in params for p in group]
+        result = apply("rnn", _run, ensure_tensor(inputs), *flat)
+        if is_lstm:
+            out, h, c = result
+            return out, (h, c)
+        out, h = result
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh", **kw):
+        self.mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    mode = "LSTM"
+
+
+class GRU(_RNNBase):
+    mode = "GRU"
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.fw(inputs, states_fw)
+        out_bw, st_bw = self.bw(inputs, states_bw)
+        return paddle.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

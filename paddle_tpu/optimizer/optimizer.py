@@ -1,0 +1,159 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:99).
+
+Design notes (TPU-first):
+- Per-parameter state ("accumulators", reference naming) are Tensors; the
+  update math is pure jnp, so a whole train step (forward+backward+step) can
+  be traced by jax.jit and the python loop unrolls into one fused XLA program
+  — the reference needs fused multi-tensor CUDA kernels
+  (DistributedFusedLamb etc.) to get this; XLA fusion gives it for free.
+- The learning rate lives in a scalar Tensor so LR schedules don't retrigger
+  compilation under jit (the scalar is a traced input, not a Python constant).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.autograd import no_grad
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn.clip import ClipGradBase
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        from . import lr as lr_mod
+
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        # parameter groups support (list of dicts, reference optimizer.py:197)
+        self._param_groups = []
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            groups = self._parameter_list
+            self._parameter_list = []
+            for g in groups:
+                ps = list(g["params"])
+                self._parameter_list.extend(ps)
+                self._param_groups.append({**g, "params": ps})
+        else:
+            self._param_groups.append({"params": self._parameter_list})
+
+        self._lr_scheduler = None
+        if isinstance(learning_rate, lr_mod.LRScheduler):
+            self._lr_scheduler = learning_rate
+            base_lr = float(learning_rate.get_lr())
+        else:
+            base_lr = float(learning_rate)
+        self._lr_t = Tensor(jnp.asarray(base_lr, jnp.float32))
+
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+            self._wd_is_l2 = True  # plain L2 into grads (reference L2Decay)
+        else:
+            self._weight_decay = 0.0
+            self._wd_is_l2 = True
+        self._grad_clip = grad_clip
+        self._accumulators: dict = {}
+        self._step_count = 0
+        self.helper = None
+
+    # ------------------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler.get_lr())
+        return float(self._lr_t._value) if not _is_tracer(self._lr_t._value) else self._lr_t
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._lr_t._bind(jnp.asarray(float(value), jnp.float32))
+
+    def _sync_lr(self):
+        if self._lr_scheduler is not None:
+            self._lr_t._bind(jnp.asarray(float(self._lr_scheduler.get_lr()), jnp.float32))
+
+    # ---------------------------------------------------------- accumulators
+    def _acc(self, name: str, p: Tensor, init=None, dtype=None):
+        key = (name, id(p))
+        if key not in self._accumulators:
+            if init is None:
+                v = jnp.zeros(p._value.shape, dtype or p._value.dtype)
+            else:
+                v = init
+            self._accumulators[key] = Tensor(v)
+        return self._accumulators[key]
+
+    # ---------------------------------------------------------------- update
+    def _single_update(self, p: Tensor, grad, lr):
+        raise NotImplementedError
+
+    def step(self):
+        self._sync_lr()
+        lr = self._lr_t._value
+        params_grads = [
+            (p, p.grad) for p in self._parameter_list if not p.stop_gradient and p.grad is not None
+        ]
+        if self._grad_clip is not None and isinstance(self._grad_clip, ClipGradBase):
+            params_grads = self._grad_clip(params_grads)
+        with no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                gv = g._value.astype(jnp.float32) if g._value.dtype == jnp.float16 else g._value
+                if self._weight_decay and self._wd_is_l2 and not self._decoupled_wd():
+                    gv = gv + self._weight_decay * p._value.astype(gv.dtype)
+                new_val = self._single_update(p, gv, lr)
+                p._bind(new_val.astype(p._value.dtype) if new_val.dtype != p._value.dtype else new_val)
+        self._step_count += 1
+
+    def _decoupled_wd(self) -> bool:
+        return False
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self) -> dict:
+        out = {}
+        for (name, pid), t in self._accumulators.items():
+            # stable naming: param-index based
+            idx = next((i for i, p in enumerate(self._parameter_list) if id(p) == pid), None)
+            out[f"{name}_{idx}"] = t
+        out["LR_Scheduler"] = (
+            self._lr_scheduler.state_dict() if self._lr_scheduler is not None else {"lr": float(self._lr_t._value)}
+        )
+        out["step_count"] = self._step_count
+        return out
+
+    def set_state_dict(self, state: dict):
+        for (name, pid), t in self._accumulators.items():
+            idx = next((i for i, p in enumerate(self._parameter_list) if id(p) == pid), None)
+            key = f"{name}_{idx}"
+            if key in state:
+                src = state[key]
+                t.set_value(src._value if isinstance(src, Tensor) else src)
+        if "LR_Scheduler" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        self._step_count = state.get("step_count", self._step_count)
+
+    # -------------------------------------------------- functionalization API
+    def opt_state_tensors(self) -> list:
+        """All mutable optimizer-state tensors (for jit functionalization)."""
+        return list(self._accumulators.values()) + [self._lr_t]
+
+
+def _is_tracer(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)

@@ -1,0 +1,244 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,adadelta,rmsprop,lamb}.py).  Update math is pure jnp so XLA
+fuses the whole update sweep into one program under jit."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta", "RMSProp", "Lamb", "NAdam", "RAdam", "Adamax", "ASGD", "Rprop"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _single_update(self, p, g, lr):
+        return p._value - lr.astype(g.dtype) * g
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _single_update(self, p, g, lr):
+        vel = self._acc("velocity", p, dtype=g.dtype)
+        new_v = self._momentum * vel._value + g
+        vel._bind(new_v)
+        if self._nesterov:
+            return p._value - lr.astype(g.dtype) * (g + self._momentum * new_v)
+        return p._value - lr.astype(g.dtype) * new_v
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _update_moments(self, p, g):
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        g32 = g.astype(jnp.float32)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g32
+        new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g32)
+        new_b1p = b1p._value * self._beta1
+        new_b2p = b2p._value * self._beta2
+        m._bind(new_m)
+        v._bind(new_v)
+        b1p._bind(new_b1p)
+        b2p._bind(new_b2p)
+        m_hat = new_m / (1 - new_b1p)
+        v_hat = new_v / (1 - new_b2p)
+        return m_hat, v_hat
+
+    def _single_update(self, p, g, lr):
+        m_hat, v_hat = self._update_moments(p, g)
+        master = p._value.astype(jnp.float32)
+        new32 = master - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new32
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd_coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
+        self._apply_decay_fn = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled_wd(self):
+        return True
+
+    def _single_update(self, p, g, lr):
+        m_hat, v_hat = self._update_moments(p, g)
+        master = p._value.astype(jnp.float32)
+        decay = self._wd_coeff
+        if self._apply_decay_fn is not None and not self._apply_decay_fn(p.name):
+            decay = 0.0
+        lr_eff = lr * (self._lr_ratio(p) if self._lr_ratio is not None else 1.0)
+        master = master * (1.0 - lr_eff * decay)
+        return master - lr_eff * m_hat / (jnp.sqrt(v_hat) + self._eps)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _single_update(self, p, g, lr):
+        acc = self._acc("moment", p, init=jnp.full(p._value.shape, self._init_acc, jnp.float32))
+        new_acc = acc._value + jnp.square(g.astype(jnp.float32))
+        acc._bind(new_acc)
+        return p._value.astype(jnp.float32) - lr * g.astype(jnp.float32) / (jnp.sqrt(new_acc) + self._eps)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _single_update(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        avg_sq = self._acc("avg_squared_grad", p, dtype=jnp.float32)
+        avg_upd = self._acc("avg_squared_update", p, dtype=jnp.float32)
+        new_avg_sq = self._rho * avg_sq._value + (1 - self._rho) * jnp.square(g32)
+        update = jnp.sqrt(avg_upd._value + self._eps) / jnp.sqrt(new_avg_sq + self._eps) * g32
+        new_avg_upd = self._rho * avg_upd._value + (1 - self._rho) * jnp.square(update)
+        avg_sq._bind(new_avg_sq)
+        avg_upd._bind(new_avg_upd)
+        return p._value.astype(jnp.float32) - lr * update
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _single_update(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        ms = self._acc("mean_square", p, dtype=jnp.float32)
+        new_ms = self._rho * ms._value + (1 - self._rho) * jnp.square(g32)
+        ms._bind(new_ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p, dtype=jnp.float32)
+            new_mg = self._rho * mg._value + (1 - self._rho) * g32
+            mg._bind(new_mg)
+            denom = jnp.sqrt(new_ms - jnp.square(new_mg) + self._eps)
+        else:
+            denom = jnp.sqrt(new_ms + self._eps)
+        update = lr * g32 / denom
+        if self._momentum > 0:
+            mom = self._acc("momentum", p, dtype=jnp.float32)
+            new_mom = self._momentum * mom._value + update
+            mom._bind(new_mom)
+            update = new_mom
+        return p._value.astype(jnp.float32) - update
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference python/paddle/optimizer/lamb.py;
+    the fused DistributedFusedLamb CUDA path is unnecessary here — XLA fuses)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _single_update(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g32
+        new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g32)
+        new_b1p, new_b2p = b1p._value * self._beta1, b2p._value * self._beta2
+        m._bind(new_m), v._bind(new_v), b1p._bind(new_b1p), b2p._bind(new_b2p)
+        m_hat = new_m / (1 - new_b1p)
+        v_hat = new_v / (1 - new_b2p)
+        master = p._value.astype(jnp.float32)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        update = r + wd * master
+        w_norm = jnp.linalg.norm(master)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return master - lr * trust * update
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _single_update(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        m = self._acc("moment", p, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g32
+        new_u = jnp.maximum(self._beta2 * u._value, jnp.abs(g32))
+        new_b1p = b1p._value * self._beta1
+        m._bind(new_m), u._bind(new_u), b1p._bind(new_b1p)
+        return p._value.astype(jnp.float32) - lr / (1 - new_b1p) * new_m / (new_u + self._eps)
+
+
+class NAdam(Adam):
+    def _single_update(self, p, g, lr):
+        m_hat, v_hat = self._update_moments(p, g)
+        g32 = g.astype(jnp.float32)
+        nesterov_m = self._beta1 * m_hat + (1 - self._beta1) * g32
+        return p._value.astype(jnp.float32) - lr * nesterov_m / (jnp.sqrt(v_hat) + self._eps)
+
+
+class RAdam(Adam):
+    def _single_update(self, p, g, lr):
+        # Rectified Adam: variance rectification term
+        m_hat, v_hat = self._update_moments(p, g)
+        t = self._step_count + 1
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        beta2_t = self._beta2**t
+        rho_t = rho_inf - 2 * t * beta2_t / (1 - beta2_t)
+        master = p._value.astype(jnp.float32)
+        if rho_t > 4:
+            r = ((rho_t - 4) * (rho_t - 2) * rho_inf / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            return master - lr * r * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return master - lr * m_hat
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _single_update(self, p, g, lr):
+        return p._value.astype(jnp.float32) - lr * g.astype(jnp.float32)
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50), parameters=None, etas=(0.5, 1.2), grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _single_update(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        prev_g = self._acc("prev_grad", p, dtype=jnp.float32)
+        step_size = self._acc("step_size", p, init=jnp.full(p._value.shape, float(lr), jnp.float32))
+        sign = jnp.sign(g32 * prev_g._value)
+        factor = jnp.where(sign > 0, self._eta_plus, jnp.where(sign < 0, self._eta_minus, 1.0))
+        new_step = jnp.clip(step_size._value * factor, self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        step_size._bind(new_step)
+        prev_g._bind(g_eff)
+        return p._value.astype(jnp.float32) - jnp.sign(g_eff) * new_step

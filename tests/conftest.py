@@ -3,17 +3,21 @@
 This is the TPU-native analog of the reference's fake-device / Gloo tricks
 (SURVEY.md §4): XLA's host platform is forced to expose 8 devices so all
 sharding/collective paths execute for real without TPU hardware.
+
+Note: this image's sitecustomize registers a remote-TPU PJRT plugin ("axon")
+and pins jax_platforms to it; tests must override via jax.config (env vars
+are ignored because the plugin wins at interpreter startup).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # float32 means float32 in numeric tests; TPU runs keep the fast MXU default.
 jax.config.update("jax_default_matmul_precision", "highest")

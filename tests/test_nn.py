@@ -1,0 +1,253 @@
+"""nn Layer/functional tests (torch-free numpy references)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward_backward():
+    paddle.seed(0)
+    lin = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    y = lin(x)
+    assert y.shape == [2, 4]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ lin.weight.numpy() + lin.bias.numpy(), rtol=1e-5, atol=1e-6
+    )
+    loss = y.sum()
+    loss.backward()
+    assert lin.weight.grad.shape == [8, 4]
+    assert lin.bias.grad.shape == [4]
+    np.testing.assert_allclose(lin.bias.grad.numpy(), [2, 2, 2, 2])
+
+
+def test_layer_registry_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    assert set(sd.keys()) == set(names)
+    # round trip
+    net2 = Net()
+    net2.set_state_dict({k: v for k, v in sd.items()})
+    for (n1, p1), (n2, p2) in zip(net.named_parameters(), net2.named_parameters()):
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+    out = net(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+
+
+def test_conv2d_matches_manual():
+    paddle.seed(1)
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.randn([1, 2, 5, 5])
+    y = conv(x)
+    assert y.shape == [1, 3, 5, 5]
+    # check one output position by manual correlation
+    xn = np.pad(x.numpy(), [(0, 0), (0, 0), (1, 1), (1, 1)])
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    manual = np.sum(xn[0, :, 1:4, 1:4] * w[1]) + b[1]
+    np.testing.assert_allclose(y.numpy()[0, 1, 1, 1], manual, rtol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 3, 3]) * 3.0 + 1.0
+    bn.train()
+    y = bn(x)
+    # normalized output: near zero mean, unit var per channel
+    yn = y.numpy()
+    assert abs(yn.mean()) < 0.1
+    assert abs(yn.std() - 1.0) < 0.1
+    assert abs(float(bn._mean.numpy().mean()) - 0.1) < 0.5  # momentum update moved stats
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layernorm_and_rmsnorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 5 + 2
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(axis=-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(axis=-1), 1, atol=1e-2)
+    rn = nn.RMSNorm(16)
+    y2 = rn(x).numpy()
+    rms = np.sqrt((y2**2).mean(axis=-1))
+    np.testing.assert_allclose(rms, 1.0, atol=1e-2)
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    drop = nn.Dropout(0.5)
+    drop.train()
+    y = drop(x).numpy()
+    assert 0.3 < (y == 0).mean() < 0.7
+    # upscale keeps expectation
+    assert abs(y.mean() - 1.0) < 0.2
+    drop.eval()
+    np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(), [-0.2, -0.05, 0, 0.5, 2], rtol=1e-6)
+    sm = F.softmax(x).numpy()
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+    g = F.gelu(x).numpy()
+    assert g[0] < 0 and g[-1] > 1.9
+
+
+def test_cross_entropy():
+    logits = paddle.to_tensor([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]])
+    labels = paddle.to_tensor([0, 1])
+    loss = F.cross_entropy(logits, labels)
+    # numpy reference
+    ln = logits.numpy()
+    expected = -np.log(np.exp(ln[np.arange(2), [0, 1]]) / np.exp(ln).sum(-1)).mean()
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+    # gradient flows
+    logits.stop_gradient = False
+    F.cross_entropy(logits, labels).backward()
+    assert logits.grad is not None
+
+
+def test_losses():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([1.5, 2.0, 2.0])
+    np.testing.assert_allclose(float(F.mse_loss(a, b)), ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-6)
+    np.testing.assert_allclose(float(F.l1_loss(a, b)), np.abs(a.numpy() - b.numpy()).mean(), rtol=1e-6)
+    p = paddle.to_tensor([0.8, 0.4])
+    y = paddle.to_tensor([1.0, 0.0])
+    expected = -(np.log(0.8) + np.log(0.6)) / 2
+    np.testing.assert_allclose(float(F.binary_cross_entropy(p, y)), expected, rtol=1e-5)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2, 2).numpy()
+    np.testing.assert_array_equal(mp[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2, 2).numpy()
+    np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    gap = F.adaptive_avg_pool2d(x, 1).numpy()
+    np.testing.assert_allclose(gap[0, 0, 0, 0], 7.5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor([[1, 2], [3, 4]])
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_array_equal(out.numpy()[0, 0], emb.weight.numpy()[1])
+    # grad scatters back
+    loss = out.sum()
+    loss.backward()
+    assert emb.weight.grad is not None
+    g = emb.weight.grad.numpy()
+    assert (g[1] == 1).all() and (g[0] == 0).all()
+
+
+def test_mha_shapes_and_causal():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_sdpa_matches_reference():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+
+    paddle.seed(3)
+    q = paddle.randn([2, 5, 2, 8])
+    k = paddle.randn([2, 5, 2, 8])
+    v = paddle.randn([2, 5, 2, 8])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    ref = sdpa_reference(q._value, k._value, v._value, is_causal=True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gru_shapes():
+    lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    x = paddle.randn([3, 7, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 7, 32]
+    assert h.shape == [4, 3, 16] and c.shape == [4, 3, 16]
+    gru = nn.GRU(8, 16)
+    out, h = gru(x)
+    assert out.shape == [3, 7, 16] and h.shape == [1, 3, 16]
+
+
+def test_rnn_gradients_flow():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    out, _ = lstm(x)
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+    assert lstm.weight_hh_l0.grad is not None
+
+
+def test_sequential_and_layerlist():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    out = net(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+    assert len(net) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p1 = paddle.Parameter(np.array([3.0, 4.0], np.float32))
+    g1 = paddle.to_tensor([3.0, 4.0])
+    out = clip([(p1, g1)])
+    np.testing.assert_allclose(out[0][1].numpy(), [0.6, 0.8], rtol=1e-5)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+
+    w = paddle.nn.Layer().create_parameter([100, 50], default_initializer=I.XavierUniform())
+    limit = np.sqrt(6.0 / 150)
+    assert abs(w.numpy()).max() <= limit + 1e-6
+    c = paddle.nn.Layer().create_parameter([10], default_initializer=I.Constant(3.0))
+    np.testing.assert_array_equal(c.numpy(), np.full(10, 3.0, np.float32))
+
+
+def test_forward_hooks():
+    lin = nn.Linear(4, 4)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    lin(paddle.randn([1, 4]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    lin(paddle.randn([1, 4]))
+    assert calls == []
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
