@@ -1,0 +1,16 @@
+"""paddle.distributed surface (reference: python/paddle/distributed/__init__.py).
+
+TPU-native architecture: SPMD-first.  Collectives compile into XLA programs
+over the device mesh (ICI/DCN); jax.distributed is the coordination service.
+The imperative ProcessGroup-style API is provided on top of compiled
+collective executables (see communication.py) for Fleet-style code.
+"""
+
+from . import fleet  # noqa: F401
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)

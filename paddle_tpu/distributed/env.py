@@ -1,0 +1,94 @@
+"""Process/environment info for distributed runs.
+
+Reference: python/paddle/distributed/parallel.py (env-var driven rank info,
+init_parallel_env at :943 building TCPStore + ProcessGroups).  TPU-native:
+jax.distributed is the coordination service (TCPStore equivalent); under
+single-controller SPMD, world size is the device count, and "rank" for IO
+sharding purposes is the process index.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = [
+    "get_rank",
+    "get_world_size",
+    "init_parallel_env",
+    "is_initialized",
+    "parallel_device_count",
+    "ParallelEnv",
+]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Initialize multi-host coordination (jax.distributed).  Single-host /
+    single-process runs are already 'initialized' — SPMD needs no process
+    group objects; collectives compile into the program."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+    proc_id = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord.split(':')[0]}:{port}",
+            num_processes=nprocs,
+            process_id=proc_id,
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.world_size
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", jax.process_count())))
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Reference paddle.distributed.ParallelEnv surface."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0"))
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")
+
+    @property
+    def nrings(self):
+        return 1

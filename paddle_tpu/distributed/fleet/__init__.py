@@ -1,0 +1,6 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py:167).
+
+Filled out incrementally: recompute first (used by models), HCG/engines land
+with the parallel stack."""
+
+from .recompute import recompute, recompute_sequential  # noqa: F401

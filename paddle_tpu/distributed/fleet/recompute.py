@@ -1,0 +1,103 @@
+"""Activation recompute (reference: python/paddle/distributed/fleet/recompute/
+recompute.py:108 RecomputeFunction — PyLayer replay with RNG state restore).
+
+TPU-native: jax.checkpoint (remat) is the principled mechanism — it inserts
+optimization barriers so XLA actually rematerializes instead of CSE-ing the
+replay, and PRNG keys are part of the traced program so dropout replays
+identically without the reference's CUDA seed bookkeeping."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core import autograd as core_ag
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.tensor._ops_common import apply
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True, **kwargs):
+    """Run `function(*args)` with activations rematerialized in backward."""
+    from paddle_tpu.nn import Layer
+
+    if isinstance(function, Layer):
+        state = [t for t in function.state_dict().values()]
+    else:
+        state = []
+    n_state = len(state)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other_args = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    def pure(*vals):
+        state_vals = vals[:n_state]
+        arg_vals = vals[n_state:]
+        originals = [t._value for t in state]
+        try:
+            for t, v in zip(state, state_vals):
+                t._bind(v)
+            full_args = [None] * len(args)
+            for (i, a) in other_args:
+                full_args[i] = a
+            for i, v in zip(tensor_pos, arg_vals):
+                full_args[i] = Tensor(v)
+            with core_ag.no_grad():
+                out = function(*full_args, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t,
+                out,
+                is_leaf=lambda x: isinstance(x, Tensor),
+            )
+        finally:
+            for t, v in zip(state, originals):
+                t._bind(v)
+
+    ckpt_fn = jax.checkpoint(pure)
+    return apply("recompute", ckpt_fn, *state, *tensor_args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segment-wise recompute over a Sequential (reference
+    fleet/recompute/recompute_hybrid.py recompute_sequential)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    from paddle_tpu.nn import Sequential
+
+    if isinstance(functions, Sequential):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg_size = max(1, n // segments)
+    out = args
+    i = 0
+    while i < n:
+        chunk = layers[i : i + seg_size]
+
+        def run_chunk(*xs, _chunk=tuple(chunk)):
+            y = xs if len(xs) > 1 else xs[0]
+            for l in _chunk:
+                y = l(y) if not isinstance(y, tuple) else l(*y)
+            return y
+
+        from paddle_tpu.nn import Layer
+
+        class _ChunkLayer(Layer):
+            def __init__(self, mods):
+                super().__init__()
+                for j, m in enumerate(mods):
+                    self.add_sublayer(str(j), m)
+
+            def forward(self, *xs):
+                y = xs if len(xs) > 1 else xs[0]
+                for m in self._sub_layers.values():
+                    y = m(y) if not isinstance(y, tuple) else m(*y)
+                return y
+
+        wrapper = _ChunkLayer(chunk)
+        out = recompute(wrapper, *(out if isinstance(out, tuple) else (out,)))
+        out = (out,) if not isinstance(out, tuple) else out
+        i += seg_size
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out

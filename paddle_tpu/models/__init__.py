@@ -1,0 +1,8 @@
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaDecoderLayer,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_7b,
+    llama_tiny,
+)

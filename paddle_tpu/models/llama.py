@@ -1,0 +1,232 @@
+"""LLaMA-family decoder (flagship model).
+
+Capability target: the reference trains LLaMA-2 via PaddleNLP on fleet hybrid
+parallel (BASELINE.md north star).  Architecture built on this framework's nn
+API; TPU-first choices:
+- bfloat16 parameters/activations by default, fp32 RMSNorm statistics;
+- rotary embeddings computed once and gathered (no per-step trig);
+- attention via scaled_dot_product_attention → XLA fused attention or the
+  Pallas flash kernel;
+- shapes chosen MXU-friendly (head_dim multiple of 128 recommended at scale).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.tensor._ops_common import apply
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer", "llama_tiny", "llama_7b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # parallel hints consumed by the distributed layer (tp/sp shardings)
+    tensor_parallel_degree: int = 1
+    sequence_parallel: bool = False
+    use_recompute: bool = False
+
+
+def _rope_tables(head_dim: int, max_len: int, theta: float):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [max_len, head_dim/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
+    """Rotate half formulation on [B, S, N, H] tensors (reference fused_rope
+    kernel paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu — here one
+    fused XLA elementwise chain; a Pallas variant lives in paddle_tpu.ops)."""
+
+    def _rope(qv, kv, c, s):
+        S = qv.shape[1]
+        c_t = c[position_offset : position_offset + S][None, :, None, :]
+        s_t = s[position_offset : position_offset + S][None, :, None, :]
+
+        def rot(x):
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            xr1 = x1 * c_t - x2 * s_t
+            xr2 = x2 * c_t + x1 * s_t
+            out = jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+            return out
+
+        return rot(qv).astype(qv.dtype), rot(kv).astype(kv.dtype)
+
+    return apply("rotary_pos_emb", _rope, q, k, cos, sin)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        bias = False
+        self.q_proj = nn.Linear(self.hidden_size, self.num_heads * self.head_dim, bias_attr=bias)
+        self.k_proj = nn.Linear(self.hidden_size, self.num_kv_heads * self.head_dim, bias_attr=bias)
+        self.v_proj = nn.Linear(self.hidden_size, self.num_kv_heads * self.head_dim, bias_attr=bias)
+        self.o_proj = nn.Linear(self.num_heads * self.head_dim, self.hidden_size, bias_attr=bias)
+
+    def forward(self, hidden_states, rope_cos, rope_sin, attn_mask=None, kv_cache=None, position_offset=0):
+        b, s, _ = hidden_states.shape
+        q = self.q_proj(hidden_states).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = apply_rotary_pos_emb(q, k, rope_cos, rope_sin, position_offset)
+        if kv_cache is not None:
+            k = paddle.concat([kv_cache[0], k], axis=1)
+            v = paddle.concat([kv_cache[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = paddle.repeat_interleave(k, rep, axis=2)
+            v = paddle.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=kv_cache is None)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP — gate/up fused into one matmul (MXU-friendly)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_up_proj = nn.Linear(config.hidden_size, 2 * config.intermediate_size, bias_attr=False)
+        self.down_proj = nn.Linear(config.intermediate_size, config.hidden_size, bias_attr=False)
+        self.intermediate_size = config.intermediate_size
+
+    def forward(self, x):
+        gate_up = self.gate_up_proj(x)
+        gate, up = paddle.split(gate_up, 2, axis=-1)
+        return self.down_proj(F.silu(gate) * up)
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self._use_recompute = config.use_recompute
+
+    def forward(self, hidden_states, rope_cos, rope_sin, attn_mask=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h, rope_cos, rope_sin, attn_mask)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_tables(head_dim, config.max_position_embeddings, config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+        if config.dtype == "bfloat16":
+            self.to(dtype="bfloat16")
+            # rope tables stay fp32 for precision
+            self.rope_cos._bind(cos)
+            self.rope_sin._bind(sin)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            if self.config.use_recompute and self.training:
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                h = recompute(layer, h, self.rope_cos, self.rope_sin, attn_mask)
+            else:
+                h = layer(h, self.rope_cos, self.rope_sin, attn_mask)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+            if config.dtype == "bfloat16":
+                self.lm_head.to(dtype="bfloat16")
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.model(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = paddle.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.astype("float32").reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            return loss, logits
+        return logits
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    cfg = dict(
+        vocab_size=1024,
+        hidden_size=256,
+        intermediate_size=688,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        max_position_embeddings=512,
+    )
+    cfg.update(kw)
+    return LlamaConfig(**cfg)
+
+
+def llama_7b(**kw) -> LlamaConfig:
+    cfg = dict(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=32,
+        max_position_embeddings=4096,
+    )
+    cfg.update(kw)
+    return LlamaConfig(**cfg)

@@ -90,7 +90,8 @@ class Optimizer:
         raise NotImplementedError
 
     def step(self):
-        self._sync_lr()
+        if not _is_tracer(self._lr_t._value):
+            self._sync_lr()
         lr = self._lr_t._value
         params_grads = [
             (p, p.grad) for p in self._parameter_list if not p.stop_gradient and p.grad is not None

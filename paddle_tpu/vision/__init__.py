@@ -1,0 +1,5 @@
+"""paddle.vision equivalent (reference: python/paddle/vision/)."""
+
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401

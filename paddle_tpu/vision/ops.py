@@ -1,0 +1,306 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — roi_align,
+deform_conv2d, nms, box utilities)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """Non-maximum suppression — data-dependent output, so eager/host-side
+    (the reference's CUDA NMS is also a sync point)."""
+    b = np.asarray(ensure_tensor(boxes)._value)
+    s = np.asarray(ensure_tensor(scores)._value) if scores is not None else np.arange(len(b))[::-1].astype(np.float32)
+    cat = np.asarray(ensure_tensor(category_idxs)._value) if category_idxs is not None else np.zeros(len(b), np.int64)
+    keep_all = []
+    for c in np.unique(cat):
+        idx = np.where(cat == c)[0]
+        order = idx[np.argsort(-s[idx])]
+        keep = []
+        while len(order):
+            i = order[0]
+            keep.append(i)
+            if len(order) == 1:
+                break
+            rest = order[1:]
+            xx1 = np.maximum(b[i, 0], b[rest, 0])
+            yy1 = np.maximum(b[i, 1], b[rest, 1])
+            xx2 = np.minimum(b[i, 2], b[rest, 2])
+            yy2 = np.minimum(b[i, 3], b[rest, 3])
+            inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+            area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            area_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+            iou = inter / (area_i + area_r - inter + 1e-10)
+            order = rest[iou <= iou_threshold]
+        keep_all.extend(keep)
+    keep_all = sorted(keep_all, key=lambda i: -s[i])
+    if top_k is not None:
+        keep_all = keep_all[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep_all, np.int64)))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear grid gather — XLA-friendly static shapes."""
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def _roi(feat, bxs):
+        n_rois = bxs.shape[0]
+        offset = 0.5 if aligned else 0.0
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one_roi(box):
+            x1, y1, x2, y2 = box[0] * spatial_scale - offset, box[1] * spatial_scale - offset, box[2] * spatial_scale - offset, box[3] * spatial_scale - offset
+            rw = jnp.maximum(x2 - x1, 1e-6)
+            rh = jnp.maximum(y2 - y1, 1e-6)
+            bin_w = rw / ow
+            bin_h = rh / oh
+            ys = y1 + (jnp.arange(oh)[:, None, None, None] + (jnp.arange(ratio)[None, :, None, None] + 0.5) / ratio) * bin_h
+            xs = x1 + (jnp.arange(ow)[None, None, :, None] + (jnp.arange(ratio)[None, None, None, :] + 0.5) / ratio) * bin_w
+            ys = jnp.broadcast_to(ys, (oh, ratio, ow, ratio)).reshape(-1)
+            xs = jnp.broadcast_to(xs, (oh, ratio, ow, ratio)).reshape(-1)
+            H, W = feat.shape[2], feat.shape[3]
+            y0 = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+            x0 = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            ly = jnp.clip(ys - y0, 0, 1)
+            lx = jnp.clip(xs - x0, 0, 1)
+            f = feat[0]  # assumes rois refer to batch 0 slice per-roi via boxes_num; simple path
+            v = (
+                f[:, y0, x0] * (1 - ly) * (1 - lx)
+                + f[:, y1i, x0] * ly * (1 - lx)
+                + f[:, y0, x1i] * (1 - ly) * lx
+                + f[:, y1i, x1i] * ly * lx
+            )
+            v = v.reshape(f.shape[0], oh, ratio, ow, ratio).mean(axis=(2, 4))
+            return v
+
+        return jax.vmap(one_roi)(bxs)
+
+    return apply("roi_align", _roi, x, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def _rp(feat, bxs):
+        H, W = feat.shape[2], feat.shape[3]
+
+        def one(box):
+            x1 = jnp.floor(box[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.floor(box[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.ceil(box[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.ceil(box[3] * spatial_scale).astype(jnp.int32)
+            # static grid sampling: sample a dense grid then maxpool regions
+            ys = jnp.linspace(y1.astype(jnp.float32), jnp.maximum(y2 - 1, y1).astype(jnp.float32), oh * 2)
+            xs = jnp.linspace(x1.astype(jnp.float32), jnp.maximum(x2 - 1, x1).astype(jnp.float32), ow * 2)
+            yi = jnp.clip(jnp.round(ys), 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(jnp.round(xs), 0, W - 1).astype(jnp.int32)
+            g = feat[0][:, yi][:, :, xi]
+            return g.reshape(feat.shape[1], oh, 2, ow, 2).max(axis=(2, 4))
+
+        return jax.vmap(one)(bxs)
+
+    return apply("roi_pool", _rp, x, boxes)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, axis=0, name=None):
+    prior_box, target_box = ensure_tensor(prior_box), ensure_tensor(target_box)
+    var = ensure_tensor(prior_box_var) if prior_box_var is not None and not isinstance(prior_box_var, list) else None
+
+    def _coder(pb, tb, *rest):
+        v = rest[0] if rest else (jnp.asarray(prior_box_var, tb.dtype) if isinstance(prior_box_var, list) else jnp.ones((4,), tb.dtype))
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            dx = (tcx - pcx) / pw / v[..., 0]
+            dy = (tcy - pcy) / ph / v[..., 1]
+            dw = jnp.log(tw / pw) / v[..., 2]
+            dh = jnp.log(th / ph) / v[..., 3]
+            return jnp.stack([dx, dy, dw, dh], axis=-1)
+        # decode
+        d = tb
+        cx = d[..., 0] * v[..., 0] * pw + pcx
+        cy = d[..., 1] * v[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2] * v[..., 2]) * pw
+        h = jnp.exp(d[..., 3] * v[..., 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5, cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+    extra = [var] if var is not None else []
+    return apply("box_coder", _coder, prior_box, target_box, *extra)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1, deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 via explicit bilinear sampling (reference CUDA
+    kernel paddle/phi/kernels/gpu/deformable_conv_kernel.cu) — gather-based,
+    static shapes, vmap over batch."""
+    x, offset, weight = ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def _dcn(feat, off, w, *rest):
+        it = iter(rest)
+        b_arr = next(it) if bias is not None else None
+        m_arr = next(it) if mask is not None else None
+        N, C, H, W = feat.shape
+        Cout, Cin_g, kh, kw = w.shape
+        out_h = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        out_w = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        fpad = jnp.pad(feat, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        Hp, Wp = H + 2 * p[0], W + 2 * p[1]
+
+        base_y = jnp.arange(out_h) * s[0]
+        base_x = jnp.arange(out_w) * s[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        # grid positions [kh,kw,out_h,out_w]
+        gy = base_y[None, None, :, None] + ky[:, None, None, None]
+        gx = base_x[None, None, None, :] + kx[None, :, None, None]
+
+        def per_image(fi, oi, mi):
+            # oi: [2*dg*kh*kw, out_h, out_w]
+            oi = oi.reshape(deformable_groups, 2, kh, kw, out_h, out_w)
+
+            def per_dg(fg, og, mg):
+                yy = gy + og[0]
+                xx = gx + og[1]
+                y0 = jnp.floor(yy)
+                x0 = jnp.floor(xx)
+                ly = yy - y0
+                lx = xx - x0
+                y0c = jnp.clip(y0.astype(jnp.int32), 0, Hp - 1)
+                x0c = jnp.clip(x0.astype(jnp.int32), 0, Wp - 1)
+                y1c = jnp.clip(y0c + 1, 0, Hp - 1)
+                x1c = jnp.clip(x0c + 1, 0, Wp - 1)
+                valid = ((yy >= 0) & (yy <= Hp - 1) & (xx >= 0) & (xx <= Wp - 1)).astype(fg.dtype)
+                v = (
+                    fg[:, y0c, x0c] * (1 - ly) * (1 - lx)
+                    + fg[:, y1c, x0c] * ly * (1 - lx)
+                    + fg[:, y0c, x1c] * (1 - ly) * lx
+                    + fg[:, y1c, x1c] * ly * lx
+                ) * valid
+                if mg is not None:
+                    v = v * mg
+                return v  # [C_dg, kh, kw, out_h, out_w]
+
+            cg = C // deformable_groups
+            cols = []
+            for g in range(deformable_groups):
+                mg = mi.reshape(deformable_groups, kh, kw, out_h, out_w)[g] if mi is not None else None
+                cols.append(per_dg(fi[g * cg : (g + 1) * cg], oi[g], mg))
+            col = jnp.concatenate(cols, axis=0)  # [C, kh, kw, oh, ow]
+            # grouped conv as matmul
+            og_list = []
+            cpg = C // groups
+            opg = Cout // groups
+            for g in range(groups):
+                colg = col[g * cpg : (g + 1) * cpg].reshape(cpg * kh * kw, out_h * out_w)
+                wg = w[g * opg : (g + 1) * opg].reshape(opg, cpg * kh * kw)
+                og_list.append(wg @ colg)
+            out = jnp.concatenate(og_list, axis=0).reshape(Cout, out_h, out_w)
+            return out
+
+        mi_arr = m_arr if m_arr is not None else [None] * N
+        outs = []
+        for i in range(N):
+            outs.append(per_image(fpad[i], off[i], m_arr[i] if m_arr is not None else None))
+        out = jnp.stack(outs)
+        if b_arr is not None:
+            out = out + b_arr.reshape(1, -1, 1, 1)
+        return out
+
+    extra = [ensure_tensor(t) for t in (bias, mask) if t is not None]
+    return apply("deform_conv2d", _dcn, x, offset, weight, *extra)
+
+
+class DeformConv2D:
+    """Layer wrapper for deform_conv2d (reference paddle.vision.ops.DeformConv2D)."""
+
+    def __new__(cls, *args, **kwargs):
+        from paddle_tpu.nn import Layer
+        from paddle_tpu.nn import initializer as I
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, deformable_groups=1, groups=1, weight_attr=None, bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+                self._args = (stride, padding, dilation, deformable_groups, groups)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, *ks], attr=weight_attr, default_initializer=I.XavierNormal()
+                )
+                self.bias = self.create_parameter([out_channels], attr=bias_attr, is_bias=True) if bias_attr is not False else None
+
+            def forward(self, x, offset, mask=None):
+                s, p, d, dg, g = self._args
+                return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg, g, mask)
+
+        return _DeformConv2D(*args, **kwargs)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale, pixel_offset=False, rois_num=None, name=None):
+    rois = np.asarray(ensure_tensor(fpn_rois)._value)
+    offset = 1 if pixel_offset else 0
+    ws = rois[:, 2] - rois[:, 0] + offset
+    hs = rois[:, 3] - rois[:, 1] + offset
+    scale = np.sqrt(ws * hs)
+    levels = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    levels = np.clip(levels, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for lvl in range(min_level, max_level + 1):
+        sel = np.where(levels == lvl)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros(0, np.int64)
+    restore = np.argsort(order)
+    return outs, [Tensor(jnp.asarray(np.asarray([len(i)], np.int32))) for i in idxs], Tensor(jnp.asarray(restore.astype(np.int32)))
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError("generate_proposals: planned (RPN-specific; layer on nms/box_coder)")
+
+
+class RoIAlign:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from paddle_tpu.nn import Layer
+
+        class _RoIAlign(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_align(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _RoIAlign()
+
+
+class RoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from paddle_tpu.nn import Layer
+
+        class _RoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_pool(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _RoIPool()
+
+
+PSRoIPool = RoIPool

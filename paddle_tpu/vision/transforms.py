@@ -1,0 +1,268 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) — host-side
+numpy preprocessing feeding the DataLoader."""
+
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad", "RandomResizedCrop",
+    "ColorJitter", "Grayscale", "BrightnessTransform", "ContrastTransform",
+    "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop", "crop", "pad",
+]
+
+
+def _to_np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _to_np(img).astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _to_np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        self.mean = [mean] * 3 if isinstance(mean, numbers.Number) else mean
+        self.std = [std] * 3 if isinstance(std, numbers.Number) else std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def _resize_np(arr, size):
+    """Nearest+linear resize via jax.image on host arrays (HWC)."""
+    import jax.image
+
+    h, w = (size, size) if isinstance(size, int) else size
+    out = jax.image.resize(arr, (h, w) + arr.shape[2:], method="linear")
+    return np.asarray(out)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _to_np(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    return _resize_np(arr, size)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_np(img)
+    return arr[top : top + height, left : left + width]
+
+
+def center_crop(img, output_size):
+    arr = _to_np(img)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    h, w = arr.shape[:2]
+    top = (h - oh) // 2
+    left = (w - ow) // 2
+    return crop(arr, top, left, oh, ow)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0, padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _to_np(img)
+        if self.padding:
+            arr = np.pad(arr, [(self.padding, self.padding), (self.padding, self.padding)] + [(0, 0)] * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        oh, ow = self.size
+        top = pyrandom.randint(0, max(h - oh, 0))
+        left = pyrandom.randint(0, max(w - ow, 0))
+        return arr[top : top + oh, left : left + ow]
+
+
+def hflip(img):
+    return _to_np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_np(img)[::-1].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _to_np(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _to_np(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _to_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_np(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    widths = [(padding[1], padding[3]), (padding[0], padding[2])] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(arr, widths, mode=mode, constant_values=fill)
+    return np.pad(arr, widths, mode=mode)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.args = (padding, fill, padding_mode)
+
+    def __call__(self, img):
+        return pad(img, *self.args)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3), interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = _to_np(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * pyrandom.uniform(*self.scale)
+            ar = pyrandom.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target_area * ar)))
+            ch = int(round(np.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                top = pyrandom.randint(0, h - ch)
+                left = pyrandom.randint(0, w - cw)
+                return _resize_np(arr[top : top + ch, left : left + cw], self.size)
+        return _resize_np(center_crop(arr, min(h, w)), self.size)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = _to_np(img).astype(np.float32)
+        factor = 1 + pyrandom.uniform(-self.value, self.value)
+        return np.clip(arr * factor, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = _to_np(img).astype(np.float32)
+        factor = 1 + pyrandom.uniform(-self.value, self.value)
+        mean = arr.mean()
+        return np.clip((arr - mean) * factor + mean, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        arr = _to_np(img).astype(np.float32)
+        gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+        return np.stack([gray] * self.n, axis=-1)
