@@ -1,0 +1,115 @@
+"""Benchmark: LLaMA decoder pretrain throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute numbers (BASELINE.md), so vs_baseline is
+computed as achieved MFU divided by 0.45 — the typical Megatron-style MFU
+Paddle/PaddleNLP reaches for LLaMA pretraining on A100 (the north-star is
+"match Paddle-on-A100 tokens/sec/chip", which at equal MFU is the same
+comparison up to the peak-FLOPs ratio). vs_baseline >= 1.0 means we use our
+chip at least as efficiently as the reference uses its GPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=8,
+            num_attention_heads=8,
+            num_key_value_heads=8,
+            max_position_embeddings=1024,
+            dtype="bfloat16",
+        )
+        B, S, iters = 4, 1024, 10
+    else:  # dev smoke on CPU
+        cfg = LlamaConfig(
+            vocab_size=1024,
+            hidden_size=256,
+            intermediate_size=688,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=4,
+            max_position_embeddings=512,
+            dtype="float32",
+        )
+        B, S, iters = 2, 128, 3
+
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
+
+    def loss_fn(m, ids, labels):
+        loss, _ = m(ids, labels=labels)
+        return loss
+
+    step = TrainStep(model, opt, loss_fn)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int64))
+
+    step(ids, labels)  # eager warmup builds optimizer state
+    step(ids, labels)  # compile
+    step(ids, labels)._value.block_until_ready()
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss._value.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+
+    # achieved model FLOPs (6 * n_params per token, attention term included)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * S
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        peak = 197.0
+    elif "v5p" in kind or "v5" in kind:
+        peak = 459.0
+    elif platform != "cpu":
+        peak = 275.0  # v4 default
+    else:
+        peak = 0.0
+    if peak:
+        mfu = achieved_tflops / peak
+        vs_baseline = mfu / 0.45
+    else:
+        vs_baseline = 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_pretrain_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 2),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,8 @@ class Tensor:
         "_hooks",
         "name",
         "persistable",
+        "process_mesh",
+        "placements",
         "__weakref__",
     )
 
@@ -73,6 +75,8 @@ class Tensor:
         self._hooks = []
         self.name = name
         self.persistable = False
+        self.process_mesh = None  # dist metadata (auto_parallel.shard_tensor)
+        self.placements = None
 
     # ------------------------------------------------------------------ meta
     @property
@@ -181,6 +185,11 @@ class Tensor:
         from . import autograd
 
         return autograd.apply("clone", lambda v: v + jnp.zeros((), v.dtype), self)
+
+    def is_dist(self) -> bool:
+        """True if this tensor carries dist metadata (reference
+        Tensor.is_dist() for DistTensor)."""
+        return self.process_mesh is not None
 
     def clear_grad(self):
         self.grad = None

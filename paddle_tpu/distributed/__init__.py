@@ -7,6 +7,22 @@ collective executables (see communication.py) for Fleet-style code.
 """
 
 from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from .sharded_step import ShardedTrainStep, shard_batch  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

@@ -1,0 +1,12 @@
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn,
+    placements_to_spec,
+    reshard,
+    shard_layer,
+    shard_tensor,
+    sharding_of,
+    spec_to_placements,
+    unshard_dtensor,
+)

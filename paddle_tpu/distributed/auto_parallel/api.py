@@ -1,0 +1,190 @@
+"""Semi-auto-parallel dygraph API: shard_tensor / reshard / shard_layer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor :765,
+reshard :874, shard_layer :973, dtensor_from_fn) over DistTensor
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39) with explicit
+SPMD rules + reshard function library (reshard/*.cc).
+
+TPU-native: DistTensor(ProcessMesh, placements) ≅ jax.Array with a
+NamedSharding.  The reference's per-op SPMD rules and pairwise reshard
+converters collapse into GSPMD — annotate inputs/outputs, XLA propagates
+shardings and inserts collectives on ICI.  `reshard` is a device_put to the
+target sharding (XLA emits the all-gather/all-to-all/slice program).
+
+`Partial` note: at rest, a single-controller jax.Array cannot carry a
+pending-reduction state, so materializing a Partial placement eagerly folds
+the reduction immediately (semantically the reshard the reference would do on
+first use).  Inside compiled programs (shard_map), real deferred partials
+arise naturally and are reduced by lax.psum.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu._core.tensor import Parameter, Tensor
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh, get_mesh
+
+__all__ = [
+    "shard_tensor",
+    "reshard",
+    "shard_layer",
+    "dtensor_from_fn",
+    "unshard_dtensor",
+    "placements_to_spec",
+    "spec_to_placements",
+    "sharding_of",
+]
+
+
+def placements_to_spec(mesh: ProcessMesh, placements) -> PartitionSpec:
+    """placements (one per mesh dim) → PartitionSpec (one entry per tensor dim).
+
+    Mirrors the conversion the reference does between dim_map and placements
+    (python/paddle/distributed/auto_parallel/placement_type.py)."""
+    by_tensor_dim: dict[int, list[str]] = {}
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            by_tensor_dim.setdefault(pl.dim, []).append(mesh.dim_names[mesh_dim])
+    if not by_tensor_dim:
+        return PartitionSpec()
+    max_dim = max(by_tensor_dim)
+    entries = []
+    for d in range(max_dim + 1):
+        axes = by_tensor_dim.get(d)
+        if axes is None:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(mesh: ProcessMesh, spec: PartitionSpec, ndim: int):
+    placements = [Replicate() for _ in mesh.dim_names]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[mesh.dim_names.index(ax)] = Shard(tdim)
+    return placements
+
+
+def _normalize_placements(mesh: ProcessMesh, placements):
+    if placements is None:
+        return [Replicate() for _ in range(mesh.ndim)]
+    out = list(placements)
+    if len(out) > mesh.ndim:
+        raise ValueError(f"{len(out)} placements for a {mesh.ndim}-d mesh")
+    while len(out) < mesh.ndim:
+        out.append(Replicate())
+    for p in out:
+        if not isinstance(p, Placement):
+            raise TypeError(f"placement must be Shard/Replicate/Partial, got {p!r}")
+    return out
+
+
+def sharding_of(mesh: ProcessMesh, placements) -> NamedSharding:
+    placements = _normalize_placements(mesh, placements)
+    return NamedSharding(mesh.jax_mesh, placements_to_spec(mesh, placements))
+
+
+def _mark_dist(t: Tensor, mesh: ProcessMesh, placements):
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
+
+
+def shard_tensor(data, mesh: ProcessMesh = None, placements=None, dtype=None, stop_gradient=None):
+    """Create a distributed tensor from data + mesh + placements
+    (reference api.py:765)."""
+    if mesh is None:
+        mesh = get_mesh()
+    placements = _normalize_placements(mesh, placements)
+    if isinstance(data, Tensor):
+        val = data._value
+        if stop_gradient is None:
+            stop_gradient = data.stop_gradient
+    else:
+        val = jnp.asarray(data, dtype=None if dtype is None else dtype)
+        if stop_gradient is None:
+            stop_gradient = True
+    if any(p.is_partial() for p in placements):
+        # fold pending reduction eagerly (see module docstring)
+        placements = [Replicate() if p.is_partial() else p for p in placements]
+    val = jax.device_put(val, sharding_of(mesh, placements))
+    if isinstance(data, Parameter):
+        # Parameters shard IN PLACE so optimizer/layer references stay valid
+        # (reference keeps EagerParamBase identity when converting to dist).
+        data._bind(val)
+        data.stop_gradient = stop_gradient
+        return _mark_dist(data, mesh, placements)
+    out = Tensor(val, stop_gradient=stop_gradient)
+    return _mark_dist(out, mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh = None, placements=None) -> Tensor:
+    """Convert a dist tensor to new placements (reference api.py:874; C++
+    pairwise converter library reshard/*.cc → one XLA resharding here)."""
+    if mesh is None:
+        mesh = get_mesh()
+    placements = _normalize_placements(mesh, placements)
+    tgt = [Replicate() if p.is_partial() else p for p in placements]
+    val = jax.device_put(x._value, sharding_of(mesh, tgt))
+    out = Tensor(val, stop_gradient=x.stop_gradient)
+    return _mark_dist(out, mesh, tgt)
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    """Build a dist tensor by calling a creation fn (reference api.py
+    dtensor_from_fn) — created then placed; XLA shards the materialization."""
+    out = fn(*args, **kwargs)
+    return shard_tensor(out, mesh, placements)
+
+
+def unshard_dtensor(x: Tensor) -> Tensor:
+    """Gather a dist tensor back to a replicated dense tensor."""
+    mesh = getattr(x, "process_mesh", None)
+    if mesh is None:
+        return x
+    val = jax.device_put(x._value, sharding_of(mesh, None))
+    out = Tensor(val, stop_gradient=x.stop_gradient)
+    out.process_mesh = None
+    out.placements = None
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Shard a Layer's parameters across a mesh (reference api.py:973).
+
+    shard_fn(sublayer_name, sublayer, mesh) replaces parameters with dist
+    params via shard_tensor; the default replicates every parameter.
+    input_fn/output_fn hook the forward to shard inputs / outputs.
+    """
+    from paddle_tpu.nn import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError("shard_layer expects a paddle_tpu.nn.Layer")
+
+    def _default_shard(name, sub, mesh):
+        for pname, p in list(sub._parameters.items()):
+            if p is None or getattr(p, "process_mesh", None) is not None:
+                continue
+            sharded = shard_tensor(p, mesh, None, stop_gradient=p.stop_gradient)
+            sub._parameters[pname] = sharded
+
+    fn = shard_fn or _default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer

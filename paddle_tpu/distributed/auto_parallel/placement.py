@@ -1,0 +1,81 @@
+"""Placement types for the semi-auto-parallel API.
+
+Reference: paddle/phi/core/distributed/auto_parallel/placement_types.h
+(Shard / Replicate / Partial) and python surface
+python/paddle/distributed/auto_parallel/placement_type.py.
+
+TPU-native mapping: a placements list (one entry per mesh dimension) is
+compiled to a jax.sharding.PartitionSpec — Shard(d) on mesh dim i means
+tensor dim d is partitioned along mesh axis i; Replicate means the mesh axis
+is unused; Partial means the value held on each shard is a partial reduction
+term (pending psum), which GSPMD expresses only transiently — `reshard` to
+Replicate/Shard materializes the reduction.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction placement (reference: partial with reduce_type)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"

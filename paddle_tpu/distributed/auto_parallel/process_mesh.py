@@ -1,0 +1,127 @@
+"""ProcessMesh — logical device mesh for semi-auto parallelism.
+
+Reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h and
+python/paddle/distributed/auto_parallel/process_mesh.py:71.
+
+TPU-native: thin wrapper around jax.sharding.Mesh.  The reference's "process
+ids" become jax device ids; dim_names are the mesh axis names used by
+PartitionSpec / shard_map collectives.  A global default mesh (context
+manager) mirrors the reference's auto_parallel default-mesh stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_default_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = list(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            self._ids = [int(getattr(d, "id", i)) for i, d in enumerate(mesh.devices.flat)]
+            return
+        arr = np.asarray(mesh)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        self._shape = list(arr.shape)
+        self._ids = [int(i) for i in arr.flatten()]
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        devices = np.asarray(jax.devices(), dtype=object)
+        dev_by_id = {int(getattr(d, "id", i)): d for i, d in enumerate(devices)}
+        try:
+            dev_arr = np.array([dev_by_id[i] for i in self._ids], dtype=object).reshape(self._shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        except KeyError:
+            # Process ids beyond local devices (multi-host spec written on one
+            # host): keep the logical mesh; jax_mesh resolves lazily when the
+            # full device set is visible.
+            self._jax_mesh = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._ids)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            raise RuntimeError(
+                "ProcessMesh references device ids not visible to this process"
+            )
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh along one axis (reference process_mesh.py get_mesh_with_dim):
+        moves `dim_name` to the front; with `index`, selects that slice."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        arr = self.mesh.transpose(order)
+        names = [self._dim_names[i] for i in order]
+        if index is not None:
+            return ProcessMesh(arr[index], names[1:])
+        return ProcessMesh(arr, names)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._ids == other._ids
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._ids), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        global _default_mesh
+        self._prev = _default_mesh
+        _default_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _default_mesh
+        _default_mesh = self._prev
+
+
+def set_mesh(mesh):
+    global _default_mesh
+    if isinstance(mesh, Mesh):
+        mesh = ProcessMesh(mesh)
+    _default_mesh = mesh
+    return _default_mesh
+
+
+def get_mesh():
+    return _default_mesh

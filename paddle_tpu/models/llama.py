@@ -23,7 +23,15 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu._core.tensor import Tensor
 from paddle_tpu.tensor._ops_common import apply
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer", "llama_tiny", "llama_7b"]
+__all__ = [
+    "LlamaConfig",
+    "LlamaForCausalLM",
+    "LlamaModel",
+    "LlamaDecoderLayer",
+    "shard_llama",
+    "llama_tiny",
+    "llama_7b",
+]
 
 
 @dataclass
@@ -202,6 +210,51 @@ class LlamaForCausalLM(nn.Layer):
             )
             return loss, logits
         return logits
+
+
+def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):
+    """Apply Megatron-style tensor-parallel placements to a LlamaForCausalLM.
+
+    Capability parity with building the model from fleet mpu layers
+    (reference python/paddle/distributed/fleet/layers/mpu/mp_layers.py:
+    VocabParallelEmbedding :47, ColumnParallelLinear :333,
+    RowParallelLinear :540) — TPU-native, the layer code is unchanged and the
+    parallelism lives entirely in NamedSharding placements; GSPMD inserts the
+    identity/allreduce/split/gather collectives mp_ops.py spells out by hand.
+
+    Linear weights here are [in_features, out_features]:
+      column-parallel (q/k/v, gate_up, lm_head) → Shard(1) on mp
+      row-parallel (o_proj, down_proj)          → Shard(0) on mp
+      vocab-parallel embedding                  → Shard(0) on mp
+      norms                                     → replicated
+    """
+    from paddle_tpu.distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+    if mp_axis not in mesh.dim_names:
+        return model
+    axis_idx = mesh.dim_names.index(mp_axis)
+
+    def place(n_dims_placement):
+        pl = [Replicate()] * mesh.ndim
+        pl[axis_idx] = n_dims_placement
+        return pl
+
+    def shard_param(layer, name, placement):
+        p = layer._parameters.get(name)
+        if p is None:
+            return
+        layer._parameters[name] = shard_tensor(p, mesh, place(placement), stop_gradient=p.stop_gradient)
+
+    shard_param(model.model.embed_tokens, "weight", Shard(0))
+    for blk in model.model.layers:
+        for col in (blk.self_attn.q_proj, blk.self_attn.k_proj, blk.self_attn.v_proj, blk.mlp.gate_up_proj):
+            shard_param(col, "weight", Shard(1))
+            shard_param(col, "bias", Shard(0))
+        for row in (blk.self_attn.o_proj, blk.mlp.down_proj):
+            shard_param(row, "weight", Shard(0))
+    if model.lm_head is not None:
+        shard_param(model.lm_head, "weight", Shard(1))
+    return model
 
 
 def llama_tiny(**kw) -> LlamaConfig:
