@@ -1,0 +1,1 @@
+from .pipeline import PipelineStack  # noqa: F401

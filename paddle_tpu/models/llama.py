@@ -29,6 +29,7 @@ __all__ = [
     "LlamaModel",
     "LlamaDecoderLayer",
     "shard_llama",
+    "pipeline_llama",
     "llama_tiny",
     "llama_7b",
 ]
@@ -174,13 +175,18 @@ class LlamaModel(nn.Layer):
 
     def forward(self, input_ids, attn_mask=None):
         h = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            if self.config.use_recompute and self.training:
-                from paddle_tpu.distributed.fleet.recompute import recompute
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
 
-                h = recompute(layer, h, self.rope_cos, self.rope_sin, attn_mask)
-            else:
-                h = layer(h, self.rope_cos, self.rope_sin, attn_mask)
+        if isinstance(self.layers, PipelineStack):
+            h = self.layers(h, self.rope_cos, self.rope_sin, attn_mask)
+        else:
+            for layer in self.layers:
+                if self.config.use_recompute and self.training:
+                    from paddle_tpu.distributed.fleet.recompute import recompute
+
+                    h = recompute(layer, h, self.rope_cos, self.rope_sin, attn_mask)
+                else:
+                    h = layer(h, self.rope_cos, self.rope_sin, attn_mask)
         return self.norm(h)
 
 
@@ -254,6 +260,26 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):
             shard_param(row, "weight", Shard(0))
     if model.lm_head is not None:
         shard_param(model.lm_head, "weight", Shard(1))
+    return model
+
+
+def pipeline_llama(model: "LlamaForCausalLM", mesh, pp_axis: str = "pp",
+                   num_microbatches=None, use_recompute: bool = False):
+    """Convert the decoder stack to a pipelined stack over the 'pp' mesh axis
+    (reference: PipelineLayer partition, fleet pp_layers.py:237).  Apply AFTER
+    shard_llama (TP placements transfer to the stacked weights) and BEFORE
+    creating the optimizer (parameters are replaced by stacked ones)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
+
+    if pp_axis not in mesh.dim_names:
+        return model
+    model.model.layers = PipelineStack(
+        list(model.model.layers),
+        mesh,
+        pp_axis=pp_axis,
+        num_microbatches=num_microbatches,
+        use_recompute=use_recompute,
+    )
     return model
 
 
