@@ -23,6 +23,32 @@ from .auto_parallel import (  # noqa: F401
     unshard_dtensor,
 )
 from .sharded_step import ShardedTrainStep, shard_batch  # noqa: F401
+from . import communication  # noqa: F401
+from .communication import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    irecv,
+    is_available,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from . import sharding  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

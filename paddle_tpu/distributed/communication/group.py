@@ -1,0 +1,97 @@
+"""Communication groups.
+
+Reference: python/paddle/distributed/communication/group.py:22 (Group),
+backed by C++ ProcessGroups (paddle/fluid/distributed/collective/
+process_group.h:47) with one NCCL communicator per group ring.
+
+TPU-native: a Group names a slice of the device mesh — either a mesh axis
+(the common hybrid-parallel case: the 'dp'/'mp'/'pp' subgroups HCG builds) or
+an explicit rank list.  Collectives over a Group compile to XLA collectives
+on ICI/DCN instead of NCCL rings.  Under single-controller SPMD the "ranks"
+are devices, and the per-rank tensors of the NCCL world are the shards of a
+jax.Array along the group's axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+__all__ = ["Group", "new_group", "get_group", "destroy_process_group", "is_available"]
+
+_group_registry: dict[int, "Group"] = {}
+_next_gid = [0]
+
+
+class Group:
+    def __init__(self, ranks=None, mesh=None, axis=None, gid=None, pg=None, name=None):
+        """Either (mesh, axis) — a mesh-axis group — or explicit ranks."""
+        self.mesh = mesh
+        self.axis = axis
+        if ranks is None and mesh is not None and axis is not None:
+            # ranks along the axis from the caller's perspective: size of axis
+            self._ranks = list(range(mesh.get_dim_size(axis)))
+        else:
+            self._ranks = list(ranks) if ranks is not None else list(range(jax.device_count()))
+        if gid is None:
+            gid = _next_gid[0]
+            _next_gid[0] += 1
+        self.id = gid
+        self.pg = pg
+        self._name = name or f"group_{gid}"
+        _group_registry[gid] = self
+
+    @property
+    def nranks(self):
+        return len(self._ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def ranks(self):
+        return list(self._ranks)
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def rank(self):
+        """Caller's rank in this group.  Single-controller SPMD has no
+        per-device caller; process-level rank is the process index."""
+        import jax
+
+        pid = jax.process_index()
+        return self._ranks.index(pid) if pid in self._ranks else -1
+
+    def get_group_rank(self, rank):
+        return self._ranks.index(rank) if rank in self._ranks else -1
+
+    def is_member(self):
+        return True
+
+    def __repr__(self):
+        ax = f", axis={self.axis!r}" if self.axis else ""
+        return f"Group(id={self.id}, nranks={self.nranks}{ax})"
+
+
+def new_group(ranks=None, backend=None, timeout=None, mesh=None, axis=None):
+    """Create a group (reference communication/group.py new_group)."""
+    return Group(ranks=ranks, mesh=mesh, axis=axis)
+
+
+def get_group(gid: int):
+    return _group_registry.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _group_registry.clear()
+    else:
+        _group_registry.pop(group.id, None)
+
+
+def is_available() -> bool:
+    return True

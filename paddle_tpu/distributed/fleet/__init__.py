@@ -1,6 +1,17 @@
-"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py:167).
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py:167)."""
 
-Filled out incrementally: recompute first (used by models), HCG/engines land
-with the parallel stack."""
-
+from . import base, layers, meta_parallel, utils  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .fleet import (  # noqa: F401
+    DistributedStrategy,
+    HybridParallelOptimizer,
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+    init,
+    is_initialized,
+    make_train_step,
+    worker_index,
+    worker_num,
+)
 from .recompute import recompute, recompute_sequential  # noqa: F401

@@ -1,0 +1,216 @@
+"""Hybrid-parallel process topology.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology (:61) builds an N-d rank grid; HybridCommunicateGroup
+(:174) derives per-axis comm groups over the 5 axes
+[data, pipe, sharding, sep, model] and fused groups (e.g. check group).
+
+TPU-native: the rank grid IS a jax device mesh.  Groups are mesh-axis Groups
+(communication/group.py): collectives over them compile to ICI collectives.
+The combinatorial API (get_comm_list, get_rank_from_stage, axis ranks) is
+kept — auto-tuner, checkpoint reshard and schedulers use that pure logic.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from paddle_tpu.distributed.communication.group import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_HYBRID_ORDER = ["data", "pipe", "sharding", "sep", "model"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or _HYBRID_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        if len(self._parallel_names) != len(self._dims):
+            raise ValueError("names/dims length mismatch")
+        self._world = int(np.prod(self._dims))
+        self._grid = np.arange(self._world).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **coords):
+        if sorted(coords) != sorted(self._parallel_names):
+            raise ValueError("must give every axis coordinate")
+        idx = tuple(coords[n] for n in self._parallel_names)
+        return int(self._grid[idx])
+
+    def get_coord(self, rank):
+        pos = np.argwhere(self._grid == rank)
+        if len(pos) == 0:
+            raise ValueError(f"rank {rank} out of range")
+        return tuple(int(i) for i in pos[0])
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        ax = self._parallel_names.index(axis_name)
+        taken = np.take(self._grid, index, axis=ax)
+        return [int(r) for r in taken.flatten()]
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along `axis_name`: one group per combination of
+        the other axes (reference get_comm_list)."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._grid, ax, -1).reshape(-1, self._dims[ax])
+        return [[int(r) for r in row] for row in moved]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = dict(zip(self._parallel_names, self.get_coord(global_rank)))
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Per-axis communication groups over the hybrid topology.
+
+    Reference topology.py:174 — builds NCCL groups per axis; here each axis
+    is a mesh axis and the Group is a handle onto it.  The 5-axis order
+    [data, pipe, sharding, sep, model] matches the reference (sep added
+    between sharding and model, topology.py:184-246).
+    """
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self._global_rank = global_rank
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+
+        coord = topology.get_coord(global_rank)
+        self._coord = dict(zip(topology.get_hybrid_group_names(), coord))
+
+        self._dp_group = self._make_group("data")
+        self._pp_group = self._make_group("pipe")
+        self._sharding_group = self._make_group("sharding")
+        self._sep_group = self._make_group("sep") if self._sep_degree > 1 else None
+        self._mp_group = self._make_group("model")
+
+    def _make_group(self, axis_name) -> Group:
+        ranks = None
+        for grp in self._topo.get_comm_list(axis_name):
+            if self._global_rank in grp:
+                ranks = grp
+                break
+        g = new_group(ranks=ranks)
+        g.axis = axis_name
+        return g
+
+    # ------------------------------------------------------------- topology
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        from .topology import _HYBRID_ORDER  # noqa
+
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._dp_degree > 1:
+            return "data"
+        if self._pp_degree > 1:
+            return "pipe"
+        if self._mp_degree > 1:
+            return "model"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "single"
+
+    def get_global_rank(self):
+        return self._global_rank
+
+    # --------------------------------------------------------------- per-axis
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self):
+        return self._coord.get("sep", 0)
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    # ---------------------------------------------------------------- pipes
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None  # SPMD pipeline uses ppermute, not explicit p2p rings
+
+    # ------------------------------------------------------------------ mesh
+    def as_process_mesh(self, skip_trivial=True):
+        """The HCG grid as a ProcessMesh ('data'→'dp', 'model'→'mp', …) for
+        the GSPMD engines."""
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+        rename = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+        names = self._topo.get_hybrid_group_names()
+        dims = [self._topo.get_dim(n) for n in names]
+        keep = [(rename.get(n, n), d) for n, d in zip(names, dims) if d > 1 or not skip_trivial]
+        if not keep:
+            keep = [("dp", 1)]
+        shape = [d for _, d in keep]
+        axis_names = [n for n, _ in keep]
+        ids = np.arange(int(np.prod(shape))).reshape(shape)
+        return ProcessMesh(ids, axis_names)

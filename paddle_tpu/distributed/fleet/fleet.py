@@ -1,0 +1,191 @@
+"""Fleet facade.
+
+Reference: python/paddle/distributed/fleet/fleet.py (init :167,
+distributed_model via model.py:32, distributed_optimizer :1307) configured by
+DistributedStrategy (base/distributed_strategy.py over
+distributed_strategy.proto).
+
+TPU-native: fleet.init builds the hybrid topology as ONE device mesh
+(HCG axes → mesh axes) and sets it as the default ProcessMesh.
+distributed_model/distributed_optimizer annotate rather than wrap:
+parallelism executes when the train step is compiled (ShardedTrainStep /
+fleet.make_train_step), where GSPMD+shard_map place every collective the
+reference's meta_parallel engines issue imperatively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = [
+    "DistributedStrategy",
+    "init",
+    "is_initialized",
+    "distributed_model",
+    "distributed_optimizer",
+    "get_hybrid_communicate_group",
+    "make_train_step",
+    "worker_index",
+    "worker_num",
+]
+
+
+class DistributedStrategy:
+    """Strategy knobs (reference: distributed_strategy.proto).  Unknown
+    attributes are accepted and stored, mirroring the protobuf's breadth."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.fuse_all_reduce_ops = True
+        self.find_unused_parameters = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class _FleetEnv:
+    strategy: DistributedStrategy | None = None
+    topology: CommunicateTopology | None = None
+    hcg: HybridCommunicateGroup | None = None
+    mesh = None
+    initialized = False
+
+
+_env = _FleetEnv()
+
+
+def init(role_maker=None, is_collective: bool = True, strategy: DistributedStrategy | None = None, log_level="INFO"):
+    """Initialize fleet (reference fleet.py:167): derive the hybrid topology
+    from the strategy and the visible device count, build HCG + default mesh."""
+    from paddle_tpu.distributed.auto_parallel import set_mesh
+    from paddle_tpu.distributed.env import init_parallel_env
+
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    n_dev = jax.device_count()
+    degrees = {
+        "data": int(hc.get("dp_degree", 1)),
+        "pipe": int(hc.get("pp_degree", 1)),
+        "sharding": int(hc.get("sharding_degree", 1)),
+        "sep": int(hc.get("sep_degree", 1)),
+        "model": int(hc.get("mp_degree", 1)),
+    }
+    known = int(np.prod([d for d in degrees.values() if d > 0]))
+    if degrees["data"] == -1 or (known < n_dev and degrees["data"] == 1):
+        others = int(np.prod([degrees[k] for k in ("pipe", "sharding", "sep", "model")]))
+        degrees["data"] = max(1, n_dev // others)
+    topo = CommunicateTopology(
+        ["data", "pipe", "sharding", "sep", "model"],
+        [degrees[k] for k in ("data", "pipe", "sharding", "sep", "model")],
+    )
+    _env.strategy = strategy
+    _env.topology = topo
+    _env.hcg = HybridCommunicateGroup(topo, global_rank=0)
+    _env.mesh = _env.hcg.as_process_mesh()
+    set_mesh(_env.mesh)
+    _env.initialized = True
+    return None
+
+
+def is_initialized() -> bool:
+    return _env.initialized
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return _env.hcg
+
+
+def fleet_env():
+    return _env
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def distributed_model(model):
+    """Annotate a model for the fleet topology (reference model.py:32 picks
+    the meta_parallel engine).  pp conversion requires the model to expose a
+    pipelineable trunk (see PipelineStack); TP layers (mpu) self-annotate at
+    construction under the fleet mesh."""
+    if not _env.initialized:
+        raise RuntimeError("call fleet.init() first")
+    model._fleet_mesh = _env.mesh
+    return model
+
+
+class HybridParallelOptimizer:
+    """Optimizer wrapper (reference dygraph_optimizer/
+    hybrid_parallel_optimizer.py:270).  Grad clipping across mesh axes is
+    global by construction (grads are global arrays); sharding stages are
+    recorded for the compiled step."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if not _env.initialized:
+        raise RuntimeError("call fleet.init() first")
+    return HybridParallelOptimizer(optimizer, hcg=_env.hcg, strategy=strategy or _env.strategy)
+
+
+def make_train_step(model, optimizer, loss_fn, scaler=None, num_microbatches=None):
+    """Compile the hybrid train step for the fleet topology: batch sharded
+    over data axes (dp and sharding), zero stage from strategy.sharding."""
+    from jax.sharding import PartitionSpec
+
+    from paddle_tpu.distributed.sharded_step import ShardedTrainStep
+
+    if not _env.initialized:
+        raise RuntimeError("call fleet.init() first")
+    mesh = _env.mesh
+    data_axes = tuple(ax for ax in ("dp", "sharding") if ax in mesh.dim_names)
+    batch_spec = PartitionSpec(data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None))
+    zero = 0
+    if _env.strategy is not None and _env.strategy.sharding:
+        zero = int(_env.strategy.sharding_configs.get("stage", 1))
+    elif "sharding" in mesh.dim_names:
+        zero = 1
+    inner = optimizer._inner_opt if isinstance(optimizer, HybridParallelOptimizer) else optimizer
+    dp_axis = "dp" if "dp" in mesh.dim_names else ("sharding" if "sharding" in mesh.dim_names else "dp")
+    return ShardedTrainStep(
+        model, inner, loss_fn, mesh, batch_spec=batch_spec, zero_stage=zero, dp_axis=dp_axis, scaler=scaler
+    )

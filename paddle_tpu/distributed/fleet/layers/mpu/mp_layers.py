@@ -1,0 +1,163 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding (:47), ColumnParallelLinear (:333),
+RowParallelLinear (:540), ParallelCrossEntropy — plus mp_ops.py identity/
+allreduce/split/gather PyLayers.
+
+TPU-native: the layer keeps GLOBAL weight shapes; parallelism is a
+NamedSharding placement on the weight plus sharding constraints on
+activations.  GSPMD then inserts exactly the collectives mp_ops.py writes by
+hand (identity fwd + allreduce bwd for column, allreduce fwd for row, …) —
+on ICI, fused into the step program.  The construction-time arguments
+(gather_output, input_is_parallel, has_bias) keep reference semantics by
+placing or omitting output constraints.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu._core.autograd import apply
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = [
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelCrossEntropy",
+]
+
+
+def _mp_mesh(mesh=None, axis="mp"):
+    from paddle_tpu.distributed.auto_parallel import get_mesh
+
+    m = mesh if mesh is not None else get_mesh()
+    if m is None or axis not in m.dim_names:
+        return None, axis
+    return m, axis
+
+
+def _constraint(x: Tensor, mesh, spec_entries) -> Tensor:
+    """Differentiable sharding constraint on an activation."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh.jax_mesh, PartitionSpec(*spec_entries))
+    return apply("sharding_constraint", lambda v: jax.lax.with_sharding_constraint(v, sh), x)
+
+
+class VocabParallelEmbedding(nn.Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None, mesh=None, mp_axis="mp"):
+        super().__init__()
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+        self.embedding = nn.Embedding(num_embeddings, embedding_dim, weight_attr=weight_attr)
+        self._mesh, self._axis = _mp_mesh(mesh, mp_axis)
+        if self._mesh is not None:
+            idx = self._mesh.dim_names.index(self._axis)
+            pl = [Replicate()] * self._mesh.ndim
+            pl[idx] = Shard(0)  # vocab dim
+            shard_tensor(self.embedding.weight, self._mesh, pl)
+
+    @property
+    def weight(self):
+        return self.embedding.weight
+
+    def forward(self, x):
+        return self.embedding(x)
+
+
+class ColumnParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None,
+                 mesh=None, mp_axis="mp"):
+        super().__init__()
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+        self.linear = nn.Linear(in_features, out_features, weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.gather_output = gather_output
+        self._mesh, self._axis = _mp_mesh(mesh, mp_axis)
+        if self._mesh is not None:
+            idx = self._mesh.dim_names.index(self._axis)
+            pl = [Replicate()] * self._mesh.ndim
+            pl[idx] = Shard(1)  # output-features dim of [in, out] weight
+            shard_tensor(self.linear.weight, self._mesh, pl)
+            if has_bias:
+                plb = [Replicate()] * self._mesh.ndim
+                plb[idx] = Shard(0)
+                shard_tensor(self.linear.bias, self._mesh, plb)
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        out = self.linear(x)
+        if self._mesh is not None:
+            nd = out.ndim
+            if self.gather_output:
+                out = _constraint(out, self._mesh, [None] * nd)
+            else:
+                out = _constraint(out, self._mesh, [None] * (nd - 1) + [self._axis])
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None,
+                 mesh=None, mp_axis="mp"):
+        super().__init__()
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+        self.linear = nn.Linear(in_features, out_features, weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.input_is_parallel = input_is_parallel
+        self._mesh, self._axis = _mp_mesh(mesh, mp_axis)
+        if self._mesh is not None:
+            idx = self._mesh.dim_names.index(self._axis)
+            pl = [Replicate()] * self._mesh.ndim
+            pl[idx] = Shard(0)  # input-features dim
+            shard_tensor(self.linear.weight, self._mesh, pl)
+            # bias replicated (applied after the implicit allreduce)
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        if self._mesh is not None and self.input_is_parallel:
+            nd = x.ndim
+            x = _constraint(x, self._mesh, [None] * (nd - 1) + [self._axis])
+        out = self.linear(x)
+        if self._mesh is not None:
+            out = _constraint(out, self._mesh, [None] * out.ndim)  # replicated (allreduce)
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-parallel softmax cross entropy (reference mp_layers.py
+    ParallelCrossEntropy over c_softmax_with_cross_entropy).  GSPMD computes
+    the partial-max/partial-sum collectives from the logits' sharding."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)

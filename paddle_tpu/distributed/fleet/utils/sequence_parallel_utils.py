@@ -1,0 +1,115 @@
+"""Sequence parallelism inside the TP group (Megatron SP).
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+:85-360 — ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers splitting
+activations on the sequence dim across the mp group, Column/Row
+SequenceParallelLinear, and register_sequence_parallel_allreduce_hooks.
+
+TPU-native: the scatter/gather pair is a pair of sharding constraints —
+GSPMD emits the all-gather before ops needing the full sequence and the
+reduce-scatter after row-parallel matmuls (XLA chooses reduce-scatter over
+allreduce+split exactly like the hand-written version).  The allreduce hooks
+for SP params (layernorms seeing seq-split activations) are unnecessary:
+grads are computed on the global program where the sum over sequence shards
+is part of the einsum — GSPMD reduces correctly by construction.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu._core.autograd import apply
+from paddle_tpu._core.tensor import Tensor
+import paddle_tpu.nn as nn
+
+from ..layers.mpu.mp_layers import ColumnParallelLinear, RowParallelLinear, _constraint, _mp_mesh
+
+__all__ = [
+    "ScatterOp",
+    "GatherOp",
+    "AllGatherOp",
+    "ReduceScatterOp",
+    "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _seq_constraint(x: Tensor, seq_axis: int, shard: bool, mesh=None, mp_axis: str = "mp"):
+    mesh, ax = _mp_mesh(mesh, mp_axis)
+    if mesh is None:
+        return x
+    entries = [None] * x.ndim
+    if shard:
+        entries[seq_axis] = ax
+    return _constraint(x, mesh, entries)
+
+
+class ScatterOp:
+    """Split activation along the sequence dim across mp ranks."""
+
+    @staticmethod
+    def apply(x, axis=1, mesh=None, mp_axis="mp"):
+        return _seq_constraint(x, axis, True, mesh, mp_axis)
+
+
+class GatherOp:
+    """Gather sequence shards back to the full sequence."""
+
+    @staticmethod
+    def apply(x, axis=1, mesh=None, mp_axis="mp"):
+        return _seq_constraint(x, axis, False, mesh, mp_axis)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp:
+    """Partial activations reduced and seq-scattered (row-parallel output)."""
+
+    @staticmethod
+    def apply(x, axis=1, mesh=None, mp_axis="mp"):
+        return _seq_constraint(x, axis, True, mesh, mp_axis)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input arrives sequence-sharded: the
+    all-gather(seq) before the matmul is GSPMD-inserted."""
+
+    def __init__(self, *args, seq_axis: int = 1, **kwargs):
+        kwargs.setdefault("gather_output", False)
+        super().__init__(*args, **kwargs)
+        self._seq_axis = seq_axis
+
+    def forward(self, x):
+        if self._mesh is not None:
+            x = _seq_constraint(x, self._seq_axis, True, self._mesh, self._axis)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output is reduce-scattered on the seq dim."""
+
+    def __init__(self, *args, seq_axis: int = 1, **kwargs):
+        kwargs.setdefault("input_is_parallel", True)
+        super().__init__(*args, **kwargs)
+        self._seq_axis = seq_axis
+
+    def forward(self, x):
+        if self._mesh is not None and self.input_is_parallel:
+            x = _constraint(x, self._mesh, [None] * (x.ndim - 1) + [self._axis])
+        out = self.linear(x)
+        if self._mesh is not None:
+            out = _seq_constraint(out, self._seq_axis, True, self._mesh, self._axis)
+        return out
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True if not hasattr(parameter, "__slots__") else None
+    return parameter
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1, fuse_allreduce=False):
+    """No-op by design: grads of SP-affected params are already globally
+    correct under GSPMD (see module docstring)."""
+    return layer

@@ -1,0 +1,49 @@
+"""DataParallel wrapper.
+
+Reference: python/paddle/distributed/parallel.py (~:371) — wraps a Layer,
+registers EagerReducer bucketed allreduce hooks (reducer.cc) over the DP
+process group.
+
+TPU-native: data parallelism is a batch sharding.  When the train step runs
+with the batch sharded over 'dp' (ShardedTrainStep / fleet.make_train_step),
+gradient averaging is compiled into the step (psum on ICI) — no reducer, no
+buckets, no hooks.  This wrapper exists for API parity: it forwards to the
+inner layer and keeps the reference's helper surface (scale_loss,
+no_sync, state_dict passthrough).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu.nn import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """Identity: the compiled step's pmean already averages over dp."""
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad-accumulation guard (reference suspends allreduce).  Compiled
+        SPMD steps sync only at optimizer.step, so nothing to suspend."""
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -93,7 +93,8 @@ class ShardedTrainStep(TrainStep):
         super().__init__(model, optimizer, loss_fn, scaler=scaler)
         self.mesh = _as_process_mesh(mesh)
         self.batch_spec = batch_spec
-        self.zero_stage = zero_stage
+        # group_sharded_parallel records its level on the optimizer
+        self.zero_stage = getattr(optimizer, "_zero_stage", zero_stage)
         self.dp_axis = dp_axis if dp_axis in self.mesh.dim_names else None
 
     # ---------------------------------------------------------------- state
@@ -112,7 +113,8 @@ class ShardedTrainStep(TrainStep):
         over dp on the largest dim not already sharded and divisible by dp."""
         spec = list(param_sharding.spec)
         spec += [None] * (acc_val.ndim - len(spec))
-        if self.zero_stage >= 1 and self.dp_axis is not None and acc_val.ndim > 0:
+        used = {ax for e in spec if e is not None for ax in (e if isinstance(e, tuple) else (e,))}
+        if self.zero_stage >= 1 and self.dp_axis is not None and self.dp_axis not in used and acc_val.ndim > 0:
             dp = self.mesh.get_dim_size(self.dp_axis)
             cands = sorted(range(acc_val.ndim), key=lambda d: -acc_val.shape[d])
             for d in cands:
