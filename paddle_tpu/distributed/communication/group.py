@@ -14,7 +14,6 @@ jax.Array along the group's axis.
 
 from __future__ import annotations
 
-import numpy as np
 import jax
 
 __all__ = ["Group", "new_group", "get_group", "destroy_process_group", "is_available"]

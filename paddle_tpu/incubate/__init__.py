@@ -1,0 +1,5 @@
+"""paddle.incubate parity namespace."""
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import distributed  # noqa: F401

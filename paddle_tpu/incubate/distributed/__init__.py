@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed parity (models.moe lands here)."""
+
+from . import models  # noqa: F401

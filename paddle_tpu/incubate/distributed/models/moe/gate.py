@@ -1,0 +1,119 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+{naive_gate,gshard_gate,switch_gate}.py).
+
+TPU-first contract: every gate returns **fixed-shape** tensors —
+(combine_weights [T, E, C], dispatch_mask [T, E, C], aux_loss scalar) — so the
+dispatch/combine einsums and the EP all-to-all compile to static XLA programs
+(no variable token counts; overflow tokens are dropped by capacity, matching
+GShard semantics).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor._ops_common import apply
+
+import jax
+import jax.numpy as jnp
+
+
+def _capacity(num_tokens, num_experts, capacity_factor, top_k):
+    cap = int(capacity_factor * top_k * ((num_tokens + num_experts - 1) // num_experts))
+    return max(cap, 4)
+
+
+def _topk_dispatch(logits, top_k, capacity, *, jitter_eps=0.0, compute_aux=True, key=None):
+    """Shared fixed-capacity dispatch math (pure jax).
+
+    logits: [T, E].  Returns combine [T, E, C] f32, dispatch bool [T, E, C],
+    aux loss (load-balancing, GShard eq.4), all static shapes.
+    """
+    t, e = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # iterate top_k choices; positions assigned by prefix-sum per expert
+    expert_prior = jnp.zeros((e,), jnp.int32)
+    total_combine = jnp.zeros((t, e, capacity), jnp.float32)
+    denom = jnp.zeros((t, 1), jnp.float32)
+    aux = jnp.float32(0.0)
+
+    masked = gates
+    for k in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        if k == 0 and compute_aux:
+            # GShard load-balance loss: E * sum_e mean_t(gate_e) * mean_t(is_top1_e)
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(onehot, axis=0)
+            aux = jnp.sum(me * ce) * e
+        # position of each token within its chosen expert (+ tokens routed in
+        # earlier k rounds)
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + expert_prior[None, :].astype(jnp.float32)
+        pos_tok = jnp.sum(pos * onehot, axis=-1)  # [T]
+        keep = pos_tok < capacity
+        gate_val = jnp.sum(gates * onehot, axis=-1) * keep  # [T]
+        pos_clip = jnp.clip(pos_tok, 0, capacity - 1).astype(jnp.int32)
+        cap_onehot = jax.nn.one_hot(pos_clip, capacity, dtype=jnp.float32)  # [T, C]
+        total_combine = total_combine + (gate_val[:, None] * onehot)[:, :, None] * cap_onehot[:, None, :]
+        denom = denom + gate_val[:, None]
+        expert_prior = expert_prior + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)
+
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    total_combine = total_combine / denom[:, :, None]
+    dispatch = total_combine > 0.0
+    return total_combine, dispatch, aux
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.linear = nn.Linear(d_model, num_experts, bias_attr=False)
+        self._loss = None
+
+    def get_loss(self, clear=True):
+        loss = self._loss
+        if clear:
+            self._loss = None
+        return loss
+
+    def dispatch(self, x, capacity=None, compute_aux=True):
+        logits = self.linear(x)  # [T, E]
+        t = x.shape[0]
+        cap = capacity or _capacity(t, self.num_experts, self.capacity_factor, self.top_k)
+
+        out = apply(
+            "moe_gate_dispatch",
+            lambda lg: _topk_dispatch(lg, self.top_k, cap, compute_aux=compute_aux),
+            logits,
+        )
+        combine, dispatch, aux = out
+        self._loss = aux
+        return combine, dispatch, aux
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no aux loss (reference naive_gate.py)."""
+
+    def dispatch(self, x, capacity=None, compute_aux=False):
+        return super().dispatch(x, capacity, compute_aux=False)
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard load-balancing aux loss (reference gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0, group=None):
+        super().__init__(d_model, num_experts, top_k=2, capacity_factor=capacity_factor)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch gate (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=1, capacity_factor=1.25, group=None):
+        super().__init__(d_model, num_experts, top_k=1, capacity_factor=capacity_factor)

@@ -1,0 +1,110 @@
+"""MoELayer — expert-parallel mixture of experts.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(global_scatter/global_gather all-to-all dispatch at :119-190).
+
+TPU-native dataflow (all static shapes, single compiled program):
+  1. gate → combine_weights [T, E, C], dispatch_mask [T, E, C]  (fixed capacity)
+  2. dispatch einsum  [T,E,C] x [T,d] → [E, C, d]
+  3. EP all-to-all over the 'ep' mesh axis: [E=w*le, C, d] → [le, w*C, d]
+     (each rank receives every rank's tokens for its local experts)
+  4. local experts applied to their [w*C, d] slab (static Python loop)
+  5. reverse all-to-all, combine einsum → [T, d]
+
+Under expert parallelism the layer must run inside an SPMD region (shard_map
+with a collective_axis_scope exposing the EP axis) — the fleet engines set
+this up; at world 1 the all-to-alls are identity.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor._ops_common import apply
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.distributed.communication.ops import _axis_for
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+class MoELayer(nn.Layer):
+    """MoELayer(d_model, experts, gate="gshard", moe_group=None, top_k=2).
+
+    `experts`: LayerList (or list) of expert Layers living on this rank
+    (len = num_local_experts); total experts = len(experts) * ep_world.
+    """
+
+    def __init__(
+        self,
+        d_model,
+        experts,
+        gate="gshard",
+        moe_group=None,
+        top_k=2,
+        capacity_factor=2.0,
+        recompute_interval=0,
+    ):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = nn.LayerList(experts) if not isinstance(experts, nn.LayerList) else experts
+        self.moe_group = moe_group
+        self.ep_world = moe_group.nranks if moe_group is not None else 1
+        self.num_local_experts = len(self.experts)
+        self.num_experts = self.num_local_experts * self.ep_world
+
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        elif gate in ("gshard", None):
+            self.gate = GShardGate(d_model, self.num_experts, capacity_factor=capacity_factor)
+        elif gate == "naive":
+            self.gate = NaiveGate(d_model, self.num_experts, top_k=top_k, capacity_factor=capacity_factor)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, self.num_experts, capacity_factor=capacity_factor)
+        else:
+            raise ValueError(f"unknown gate {gate!r}")
+        self.aux_loss = None
+
+    def _a2a(self, x, name):
+        ax = _axis_for(self.moe_group)
+        if ax is None:
+            if self.ep_world > 1:
+                raise RuntimeError(
+                    "MoELayer has an EP group of size "
+                    f"{self.ep_world} but no matching mesh axis is in scope; "
+                    "run the layer inside the distributed step "
+                    "(collective_axis_scope exposing the EP axis)"
+                )
+            return x
+        return apply(name, lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=True), x)
+
+    def forward(self, x):
+        orig_shape = x.shape
+        x2d = x.reshape([-1, self.d_model])
+
+        combine, dispatch, aux = self.gate.dispatch(x2d)
+        self.aux_loss = aux
+
+        # [T, E, C] x [T, d] -> [E, C, d]
+        dispatched = paddle.einsum("tec,td->ecd", dispatch.astype(x2d.dtype), x2d)
+
+        w, le = self.ep_world, self.num_local_experts
+        cap = dispatched.shape[-2]
+        # EP exchange: [w*le, C, d] -> rows regrouped so that this rank holds
+        # [w, le, C, d] from every source rank for its local experts.
+        dispatched = self._a2a(dispatched.reshape([w * le * cap, self.d_model]), "moe_scatter")
+        expert_in = dispatched.reshape([w, le, cap, self.d_model])
+
+        outs = []
+        for i, expert in enumerate(self.experts):
+            slab = expert_in[:, i].reshape([w * cap, self.d_model])
+            outs.append(expert(slab).reshape([w, 1, cap, self.d_model]))
+        expert_out = paddle.concat(outs, axis=1)  # [w, le, C, d]
+
+        gathered = self._a2a(expert_out.reshape([w * le * cap, self.d_model]), "moe_gather")
+        gathered = gathered.reshape([self.num_experts, cap, self.d_model])
+
+        out = paddle.einsum("tec,ecd->td", combine.astype(x2d.dtype), gathered)
+        return out.reshape(orig_shape)

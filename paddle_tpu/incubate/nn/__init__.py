@@ -1,0 +1,4 @@
+"""paddle.incubate.nn parity: fused-op functional API + fused layers."""
+
+from . import functional  # noqa: F401
+from .layers import FusedRMSNorm, FusedLayerNorm  # noqa: F401

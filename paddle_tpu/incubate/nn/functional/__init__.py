@@ -1,0 +1,255 @@
+"""paddle.incubate.nn.functional parity (reference:
+python/paddle/incubate/nn/functional/): fused ops backed by the Pallas
+kernel library (paddle_tpu.ops) on TPU, jnp references elsewhere.
+
+All entry points take/return paddle_tpu Tensors and record on the autograd
+tape; the underlying jax fns carry custom VJPs so backward also runs the
+fused kernels.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import ops as _ops
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+__all__ = [
+    "fused_rms_norm",
+    "fused_layer_norm",
+    "fused_rotary_position_embedding",
+    "fused_matmul_bias",
+    "fused_linear",
+    "fused_linear_activation",
+    "fused_dropout_add",
+    "swiglu",
+    "fused_bias_act",
+    "masked_multihead_attention",
+    "variable_length_memory_efficient_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1, residual=None):
+    """Reference: python/paddle/incubate/nn/functional/fused_rms_norm.py.
+    norm_bias is accepted for signature parity (RMSNorm has no bias; applied
+    additively post-scale when given)."""
+    x = ensure_tensor(x)
+    norm_weight = ensure_tensor(norm_weight)
+    extras = []
+    if norm_bias is not None:
+        extras.append(ensure_tensor(norm_bias))
+    if residual is not None:
+        extras.append(ensure_tensor(residual))
+
+    def _fn(xv, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if norm_bias is not None else None
+        res = rest.pop(0) if residual is not None else None
+        out = _ops.fused_rms_norm(xv, wv, epsilon=epsilon, residual=res)
+        if res is not None:
+            out, pre = out
+            if bv is not None:
+                out = out + bv
+            return out, pre
+        if bv is not None:
+            out = out + bv
+        return out
+
+    return apply("fused_rms_norm", _fn, x, norm_weight, *extras)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1, residual=None):
+    x = ensure_tensor(x)
+    norm_weight = ensure_tensor(norm_weight)
+    args = [x, norm_weight]
+    if norm_bias is not None:
+        args.append(ensure_tensor(norm_bias))
+    if residual is not None:
+        args.append(ensure_tensor(residual))
+
+    def _fn(xv, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if norm_bias is not None else None
+        res = rest.pop(0) if residual is not None else None
+        return _ops.fused_layer_norm(xv, wv, bv, epsilon=epsilon, residual=res)
+
+    return apply("fused_layer_norm", _fn, *args)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True, time_major=False, rotary_emb_base=10000.0):
+    """Reference: python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py.
+
+    Here sin/cos are [S, H/2] tables (built by the model); interleaved-pair
+    ("GPT-NeoX style" pairs) rotation via the Pallas kernel.
+    """
+    q = ensure_tensor(q)
+    args = [q]
+    if k is not None:
+        args.append(ensure_tensor(k))
+    cos_t = ensure_tensor(cos)
+    sin_t = ensure_tensor(sin)
+    args += [cos_t, sin_t]
+
+    def _fn(qv, *rest):
+        rest = list(rest)
+        kv = rest.pop(0) if k is not None else None
+        cv, sv = rest
+        return _ops.fused_rotary_position_embedding(qv, kv, None, cos=cv, sin=sv)
+
+    out = apply("fused_rope", _fn, *args)
+    if k is not None and v is not None:
+        return out[0], out[1], ensure_tensor(v)
+    return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False):
+    """matmul+bias in one op — XLA fuses the epilogue into the MXU matmul, so
+    the jnp form IS the fused kernel on TPU (reference: fused_gemm_epilogue)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    extras = [ensure_tensor(bias)] if bias is not None else []
+
+    def _fn(xv, yv, *rest):
+        if transpose_x:
+            xv = jnp.swapaxes(xv, -1, -2)
+        if transpose_y:
+            yv = jnp.swapaxes(yv, -1, -2)
+        out = jnp.matmul(xv, yv)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return apply("fused_matmul_bias", _fn, x, y, *extras)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation="gelu"):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+
+    def _act(v):
+        if activation == "gelu":
+            return jax.nn.gelu(v)
+        if activation == "relu":
+            return jnp.maximum(v, 0)
+        if activation in ("none", ""):
+            return v
+        raise ValueError(f"unsupported activation {activation}")
+
+    return apply("fused_linear_activation", _act, out)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    x = ensure_tensor(x)
+    extras = [ensure_tensor(bias)] if bias is not None else []
+
+    def _fn(xv, *rest):
+        if rest:
+            xv = xv + rest[0]
+        if act_method == "gelu":
+            return jax.nn.gelu(xv)
+        if act_method == "relu":
+            return jnp.maximum(xv, 0)
+        if act_method in ("swiglu",):
+            a, b = jnp.split(xv, 2, axis=-1)
+            return _ops.swiglu(a, b)
+        raise ValueError(f"unsupported act {act_method}")
+
+    return apply("fused_bias_act", _fn, x, *extras)
+
+
+def swiglu(x, y=None):
+    x = ensure_tensor(x)
+    extras = [ensure_tensor(y)] if y is not None else []
+
+    def _fn(xv, *rest):
+        return _ops.swiglu(xv, rest[0] if rest else None)
+
+    return apply("swiglu", _fn, x, *extras)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", seed=None):
+    """dropout(x) + y fused (reference fused_dropout_add kernel)."""
+    from paddle_tpu.nn.functional.common import dropout
+
+    out = dropout(ensure_tensor(x), p, training=training, mode=mode)
+    return out + ensure_tensor(y)
+
+
+def masked_multihead_attention(x, cache_kv, *, num_heads, head_dim, seq_lens=None, rotary_tables=None, position_offset=0):
+    """Single-token decode attention against a KV cache (reference:
+    paddle.incubate.nn.functional.masked_multihead_attention,
+    paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    x: [B, 3*N*H] fused qkv for the new token; cache_kv: [2, B, N, S_max, H].
+    Returns (out [B, N*H], updated cache).  Decode attention is
+    bandwidth-bound: XLA's gather+matmul on a [S_max, H] cache block is
+    already near roofline, so the jnp form is the TPU kernel.
+    """
+    x = ensure_tensor(x)
+    cache_kv = ensure_tensor(cache_kv)
+
+    def _fn(xv, cache):
+        b = xv.shape[0]
+        qkv = xv.reshape(b, 3, num_heads, head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, N, H]
+        if rotary_tables is not None:
+            cos, sin = rotary_tables
+            c = jax.lax.dynamic_slice_in_dim(jnp.asarray(cos), position_offset, 1, 0)[0]
+            s = jax.lax.dynamic_slice_in_dim(jnp.asarray(sin), position_offset, 1, 0)[0]
+
+            def rot(t):
+                t2 = t.reshape(b, num_heads, head_dim // 2, 2)
+                r1 = t2[..., 0] * c - t2[..., 1] * s
+                r2 = t2[..., 1] * c + t2[..., 0] * s
+                return jnp.stack([r1, r2], -1).reshape(b, num_heads, head_dim)
+
+            q, k = rot(q), rot(k)
+        cache = jax.lax.dynamic_update_slice(
+            cache, jnp.stack([k, v])[:, :, :, None, :], (0, 0, 0, position_offset, 0)
+        )
+        keys = cache[0]  # [B, N, S_max, H]
+        vals = cache[1]
+        scale = 1.0 / math.sqrt(head_dim)
+        logits = jnp.einsum("bnh,bnsh->bns", q.astype(jnp.float32), keys.astype(jnp.float32)) * scale
+        span = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+        valid = span <= position_offset
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bns,bnsh->bnh", probs, vals.astype(jnp.float32))
+        return out.reshape(b, num_heads * head_dim).astype(xv.dtype), cache
+
+    return apply("masked_multihead_attention", _fn, x, cache_kv)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None, kv_seq_lens=None, mask=None, scale=None, causal=False):
+    """Reference: python/paddle/incubate/nn/functional/variable_length_memory_efficient_attention.py.
+    q/k/v: [B, N, S, H].  Variable lengths become an additive mask; the fused
+    path is the flash kernel when lengths are uniform."""
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    extras = []
+    if mask is not None:
+        extras.append(ensure_tensor(mask))
+
+    def _fn(q, k, v, *rest):
+        m = rest[0] if rest else None
+        sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+        logits = jnp.einsum("bnqh,bnkh->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sc
+        if causal:
+            ql, kl = logits.shape[-2], logits.shape[-1]
+            cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+            logits = jnp.where(cm, logits, -1e30)
+        if seq_lens is not None:
+            kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+            lens = jnp.asarray(kv_seq_lens if kv_seq_lens is not None else seq_lens).reshape(-1, 1, 1, 1)
+            logits = jnp.where(kpos < lens, logits, -1e30)
+        if m is not None:
+            logits = logits + m
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bnqk,bnkh->bnqh", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+    return apply("variable_length_memory_efficient_attention", _fn, query, key, value, *extras)

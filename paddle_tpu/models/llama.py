@@ -133,6 +133,12 @@ class LlamaMLP(nn.Layer):
     def forward(self, x):
         gate_up = self.gate_up_proj(x)
         gate, up = paddle.split(gate_up, 2, axis=-1)
+        from paddle_tpu import ops as _ops
+
+        if _ops.use_pallas():
+            import paddle_tpu.incubate.nn.functional as _FF
+
+            return self.down_proj(_FF.swiglu(gate, up))
         return self.down_proj(F.silu(gate) * up)
 
 

@@ -32,6 +32,11 @@ def scaled_dot_product_attention(
     def _sdpa(q, k, v, *rest):
         # jax.nn.dot_product_attention expects BSNH as well.
         mask = rest[0] if rest else None
+        if mask is None:
+            from paddle_tpu import ops as _ops
+
+            if _ops.use_pallas():
+                return _ops.flash_attention(q, k, v, causal=bool(is_causal))
         bias = None
         if mask is not None and mask.dtype != jnp.bool_:
             bias = mask

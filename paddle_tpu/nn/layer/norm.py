@@ -57,6 +57,12 @@ class RMSNorm(Layer):
         self.weight = self.create_parameter([hidden_size], attr=weight_attr, default_initializer=I.Constant(1.0))
 
     def forward(self, x):
+        from paddle_tpu import ops as _ops
+
+        if _ops.use_pallas():
+            import paddle_tpu.incubate.nn.functional as _FF
+
+            return _FF.fused_rms_norm(x, self.weight, epsilon=self._epsilon)
         return F.rms_norm(x, self.weight, self._epsilon)
 
 

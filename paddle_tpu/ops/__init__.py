@@ -1,0 +1,40 @@
+"""paddle_tpu.ops — Pallas TPU kernel library.
+
+This package is the TPU-native analog of the reference's fused CUDA kernels
+(paddle/phi/kernels/fusion/gpu/: fused_rope_kernel.cu, fused_layernorm_kernel.cu,
+fused_rms_norm .. and paddle/phi/kernels/gpu/flash_attn_kernel.cu).  Each op
+ships two implementations:
+
+- a Pallas TPU kernel (MXU/VPU-tiled, VMEM-resident, custom VJP), used when
+  running on TPU hardware;
+- a pure jax/jnp reference with identical semantics, used on CPU test meshes
+  and as the numerics oracle (Pallas kernels are additionally unit-tested in
+  interpreter mode against it).
+
+Dispatch is `use_pallas()`: TPU backend by default, overridable via the flag
+`FLAGS_use_pallas` (paddle_tpu.set_flags) for A/B benchmarking.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu._core import flags as _flags
+
+_flags.define_flag("FLAGS_use_pallas", "auto", "auto|true|false — Pallas kernel dispatch")
+
+
+def use_pallas() -> bool:
+    v = str(_flags.flag("FLAGS_use_pallas")).lower()
+    if v in ("true", "1"):
+        return True
+    if v in ("false", "0"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+from .flash_attention import flash_attention, flash_attention_reference  # noqa: E402,F401
+from .fused_norm import fused_rms_norm, fused_layer_norm  # noqa: E402,F401
+from .fused_rope import fused_rotary_position_embedding  # noqa: E402,F401
+from .swiglu import swiglu  # noqa: E402,F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: E402,F401

@@ -1,0 +1,21 @@
+"""Shared pallas helpers.
+
+The framework runs jax with x64 enabled (paddle int64 semantics), which makes
+bare python-int constants in BlockSpec index maps lower as i64 while traced
+program ids are i32 — Mosaic rejects the mixed tuple.  `imap` wraps an index
+map so every component is cast to int32.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def imap(fn):
+    def wrapped(*idx):
+        out = fn(*idx)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return tuple(jnp.int32(v) for v in out)
+
+    return wrapped

@@ -1,0 +1,91 @@
+"""Fused rotary position embedding (Pallas).
+
+Reference: paddle.incubate.nn.functional.fused_rotary_position_embedding
+(paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu).  One VPU kernel rotates
+q and k in-place-style per (batch, seq-block); backward is the inverse
+rotation (rotation matrices are orthogonal), implemented with the same kernel
+run with negated sin.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops._pl_utils import imap
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
+    # x: [bs, N*H] viewed rows; cos/sin: [bs, H/2]
+    x = x_ref[:].astype(jnp.float32)
+    bs, nh = x.shape
+    half = cos_ref.shape[-1]
+    n = nh // (2 * half)
+    x = x.reshape(bs, n, half, 2)
+    c = cos_ref[:].astype(jnp.float32)[:, None, :]
+    s = sin_ref[:].astype(jnp.float32)[:, None, :]
+    x1 = x[..., 0]
+    x2 = x[..., 1]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(bs, nh)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def _rope_apply(x, cos, sin):
+    """x: [B, S, N, H]; cos/sin: [S, H/2] (fp32 tables)."""
+    b, s, n, h = x.shape
+    x2d = x.reshape(b * s, n * h)
+    cos_r = jnp.tile(cos, (b, 1))
+    sin_r = jnp.tile(sin, (b, 1))
+    bs = min(256, b * s)
+    if (b * s) % bs:
+        bs = b * s
+    out = pl.pallas_call(
+        _rope_kernel,
+        grid=((b * s) // bs,),
+        in_specs=[
+            pl.BlockSpec((bs, n * h), imap(lambda i: (i, 0))),
+            pl.BlockSpec((bs, h // 2), imap(lambda i: (i, 0))),
+            pl.BlockSpec((bs, h // 2), imap(lambda i: (i, 0))),
+        ],
+        out_specs=pl.BlockSpec((bs, n * h), imap(lambda i: (i, 0))),
+        out_shape=jax.ShapeDtypeStruct((b * s, n * h), x.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(x2d, cos_r, sin_r)
+    return out.reshape(b, s, n, h)
+
+
+@jax.custom_vjp
+def _rope(x, cos, sin):
+    return _rope_apply(x, cos, sin)
+
+
+def _rope_fwd(x, cos, sin):
+    return _rope_apply(x, cos, sin), (cos, sin)
+
+
+def _rope_bwd(res, g):
+    cos, sin = res
+    return _rope_apply(g, cos, -sin), None, None
+
+
+_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, *, cos, sin, position_offset=0):
+    """Rotate q (and k) with interleaved-pair RoPE.  q/k: [B, S, N, H];
+    cos/sin: [max_len, H/2] fp32 tables.  v passes through (parity with the
+    reference signature which optionally rotates v — rarely used)."""
+    s = q.shape[1]
+    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, axis=0)
+    sn = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, axis=0)
+    outs = [_rope(q, c, sn)]
+    if k is not None:
+        outs.append(_rope(k, c, sn))
+    if v is not None:
+        outs.append(v)
+    return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,0 +1,151 @@
+"""Ring attention + Ulysses (all-to-all) attention for sequence/context
+parallelism.
+
+The reference's long-context support is the SEP axis (SURVEY.md §5: segment
+parallel engine python/paddle/distributed/fleet/meta_parallel/segment_parallel.py,
+no ring attention in the snapshot) — the TPU build exceeds it with real
+sequence-parallel attention:
+
+- `ring_attention`: blockwise online-softmax attention where K/V shards
+  rotate around the SEP ring via `lax.ppermute` (ICI neighbor exchange),
+  overlapping each hop with the local attention block — memory per chip is
+  O(S/W), full causal semantics.  Differentiable end-to-end (ppermute's
+  transpose is the reverse rotation; XLA schedules the collective-compute
+  overlap).
+- `ulysses_attention`: all-to-all head<->sequence reshard so each rank runs
+  full-sequence attention on N/W heads with the Pallas flash kernel, then
+  reshards back (DeepSpeed-Ulysses pattern on ICI).
+
+Both are pure-jax functions meant to run inside shard_map with the SEP axis
+in scope; q/k/v are the LOCAL sequence shards [B, S_local, N, H].
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _local_block(q, k, v, scale, mode):
+    """One q-shard x kv-chunk attention block in f32.
+
+    q: [B, N, Sq, H]; k/v: [B, N, Sk, H]; mode: 'full' | 'causal' | 'skip'.
+    Returns (numerator [B,N,Sq,H], row max m [B,N,Sq,1], row sum l [B,N,Sq,1]).
+    """
+    s = jnp.einsum("bnqh,bnkh->bnqk", q, k) * scale
+    if mode == "causal":
+        ql, kl = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # guard all-masked rows
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    num = jnp.einsum("bnqk,bnkh->bnqh", p, v)
+    return num, m, l
+
+
+def ring_attention(q, k, v, axis_name, *, causal=True, scale=None):
+    """q/k/v: local shards [B, S_loc, N, H]; returns [B, S_loc, N, H].
+
+    Sequence is sharded contiguously over `axis_name` (rank r owns rows
+    [r*S_loc, (r+1)*S_loc)).  W-1 ppermute hops rotate the K/V shard left;
+    online-softmax merge keeps full-precision statistics.
+    """
+    w = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B, N, S, H]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+
+    b, n, s_loc, h = qt.shape
+    acc = jnp.zeros((b, n, s_loc, h), jnp.float32)
+    m_run = jnp.full((b, n, s_loc, 1), NEG_INF, jnp.float32)
+    l_run = jnp.zeros((b, n, s_loc, 1), jnp.float32)
+
+    perm = [(i, (i + 1) % w) for i in range(w)]  # rotate shards to the right
+
+    def merge(carry, num, m_blk, l_blk, active):
+        acc, m_run, l_run = carry
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc_new = acc * alpha + num * beta
+        l_new = l_run * alpha + l_blk * beta
+        keep = active.reshape(1, 1, 1, 1)
+        return (
+            jnp.where(keep, acc_new, acc),
+            jnp.where(keep, m_new, m_run),
+            jnp.where(keep, l_new, l_run),
+        )
+
+    kv = (kt, vt)
+    carry = (acc, m_run, l_run)
+    for step in range(w):
+        src = (rank - step) % w  # which rank's shard we hold now
+        kc, vc = kv
+        if causal:
+            # diagonal: causal-mask; below diagonal (src < rank): full; above: skip
+            num_c, m_c, l_c = _local_block(qt, kc, vc, scale, "causal")
+            num_f, m_f, l_f = _local_block(qt, kc, vc, scale, "full")
+            is_diag = src == rank
+            num = jnp.where(is_diag, num_c, num_f)
+            m_blk = jnp.where(is_diag, m_c, m_f)
+            l_blk = jnp.where(is_diag, l_c, l_f)
+            active = src <= rank
+        else:
+            num, m_blk, l_blk = _local_block(qt, kc, vc, scale, "full")
+            active = jnp.bool_(True)
+        carry = merge(carry, num, m_blk, l_blk, active)
+        if step + 1 < w:
+            kv = (
+                lax.ppermute(kv[0], axis_name, perm),
+                lax.ppermute(kv[1], axis_name, perm),
+            )
+
+    acc, m_run, l_run = carry
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    out = acc / l_safe
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal=True, scale=None):
+    """DeepSpeed-Ulysses: all-to-all seq<->heads, local full-seq flash
+    attention, all-to-all back.  Heads must divide the axis size.
+    q/k/v: [B, S_loc, N, H] -> returns same."""
+    w = lax.axis_size(axis_name)
+    b, s_loc, n, h = q.shape
+    assert n % w == 0, "num heads must be divisible by sep degree for ulysses"
+
+    def seq_to_heads(x):
+        # [B, S_loc, N, H] -> [B, W*S_loc, N/W, H]: split heads, gather seq
+        x = x.reshape(b, s_loc, w, n // w, h)
+        x = jnp.moveaxis(x, 2, 0)  # [W, B, S_loc, N/W, H]
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        # leading axis now indexes seq chunks in ring order
+        x = jnp.moveaxis(x, 0, 1)  # [B, W, S_loc, N/W, H]
+        return x.reshape(b, w * s_loc, n // w, h)
+
+    def heads_to_seq(x):
+        x = x.reshape(b, w, s_loc, n // w, h)
+        x = jnp.moveaxis(x, 1, 0)
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        x = jnp.moveaxis(x, 0, 2)  # [B, S_loc, W, N/W, H]
+        return x.reshape(b, s_loc, n, h)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    from paddle_tpu.ops import use_pallas
+    from paddle_tpu.ops.flash_attention import flash_attention, flash_attention_reference
+
+    fn = flash_attention if use_pallas() else flash_attention_reference
+    out = fn(qg, kg, vg, causal=causal, scale=scale)
+    return heads_to_seq(out)

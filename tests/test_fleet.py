@@ -227,3 +227,64 @@ def test_group_sharded_levels():
         assert losses[-1] < losses[0]
     finally:
         dist.set_mesh(None)
+
+
+def test_all_reduce_world_in_multi_axis_scope():
+    """group=None inside a 2-axis scope reduces over BOTH axes (the world)."""
+    import jax
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("a", "b"))
+    x = np.arange(4.0, dtype=np.float32).reshape(2, 2, 1)
+
+    def body(xl):
+        t = paddle.to_tensor(xl)
+        with collective_axis_scope({"a": "a", "b": "b"}):
+            dist.all_reduce(t)
+        return t._value
+
+    out = shard_map(body, mesh=mesh, in_specs=P("a", "b"), out_specs=P("a", "b"))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(4, 6.0))
+
+
+def test_all_gather_world_multi_axis_scope_raises():
+    import jax
+    import pytest
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("a", "b"))
+
+    def body(xl):
+        t = paddle.to_tensor(xl)
+        with collective_axis_scope({"a": "a", "b": "b"}):
+            with pytest.raises(RuntimeError, match="ambiguous"):
+                dist.all_gather(None, t)
+        return t._value
+
+    shard_map(body, mesh=mesh, in_specs=P("a", "b"), out_specs=P("a", "b"))(
+        jnp.zeros((2, 2, 1))
+    )
+
+
+def test_all_reduce_prod_signs_and_zeros():
+    mesh = _mesh1d(4)
+    x = np.array([[-2.0], [3.0], [-1.0], [0.5]], np.float32)  # prod = 3.0
+    y = np.array([[-2.0], [0.0], [4.0], [1.0]], np.float32)  # prod = 0.0
+
+    def body(xl):
+        t = paddle.to_tensor(xl)
+        with collective_axis_scope({"x": "x"}):
+            dist.all_reduce(t, op=dist.ReduceOp.PROD)
+        return t._value
+
+    f = shard_map(body, mesh=Mesh(np.array(jax.devices()[:4]), ("x",)), in_specs=P("x"), out_specs=P("x"))
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))).ravel(), np.full(4, 3.0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(y))).ravel(), np.zeros(4), atol=1e-7)
+
+
+def test_hcg_groups_tagged_with_mesh_axes():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"], [2, 2, 1, 1, 2])
+    hcg = HybridCommunicateGroup(topo, global_rank=0)
+    assert hcg.get_model_parallel_group().axis == "mp"
+    assert hcg.get_data_parallel_group().axis == "dp"
+    assert hcg.get_pipe_parallel_group().axis == "pp"

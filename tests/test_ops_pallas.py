@@ -1,0 +1,167 @@
+"""Pallas kernel library numerics (interpret mode on the CPU test mesh) —
+SURVEY.md §4 OpTest analog: each kernel vs a jnp oracle, fwd + grads."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.ops.flash_attention import flash_attention, flash_attention_reference
+
+
+def _rand(*shape, dtype=np.float32, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    b, s, n, h = 1, 256, 2, 64
+    q, k, v = (jnp.asarray(_rand(b, s, n, h, seed=i)) for i in range(3))
+    out = flash_attention(q, k, v, causal=causal)
+    ref = flash_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_gqa():
+    b, s, n, nkv, h = 1, 256, 4, 2, 64
+    q = jnp.asarray(_rand(b, s, n, h, seed=0))
+    k = jnp.asarray(_rand(b, s, nkv, h, seed=1))
+    v = jnp.asarray(_rand(b, s, nkv, h, seed=2))
+    out = flash_attention(q, k, v, causal=True)
+    ref = flash_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_grads():
+    b, s, n, h = 1, 128, 2, 64
+    q, k, v = (jnp.asarray(_rand(b, s, n, h, seed=i)) for i in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(flash_attention_reference(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-4)
+
+
+def test_fused_rms_norm_matches_reference():
+    x = jnp.asarray(_rand(6, 256))
+    w = jnp.asarray(_rand(256, seed=3))
+
+    def ref(x, w, eps=1e-6):
+        var = jnp.mean(x * x, -1, keepdims=True)
+        return x * jax.lax.rsqrt(var + eps) * w
+
+    np.testing.assert_allclose(
+        np.asarray(ops.fused_rms_norm(x, w)), np.asarray(ref(x, w)), atol=1e-5, rtol=1e-5
+    )
+    g1 = jax.grad(lambda x, w: jnp.sum(ops.fused_rms_norm(x, w) ** 2), argnums=(0, 1))(x, w)
+    g2 = jax.grad(lambda x, w: jnp.sum(ref(x, w) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_fused_layer_norm_matches_reference():
+    x = jnp.asarray(_rand(6, 256))
+    w = jnp.asarray(_rand(256, seed=4))
+    b = jnp.asarray(_rand(256, seed=5))
+
+    def ref(x, w, b, eps=1e-5):
+        mean = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+    np.testing.assert_allclose(
+        np.asarray(ops.fused_layer_norm(x, w, b)), np.asarray(ref(x, w, b)), atol=1e-5, rtol=1e-5
+    )
+    g1 = jax.grad(lambda *a: jnp.sum(ops.fused_layer_norm(*a) ** 2), argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2), argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-4)
+
+
+def test_fused_rope_matches_model_rope():
+    from paddle_tpu.models.llama import _rope_tables
+
+    b, s, n, h = 2, 16, 2, 64
+    x = jnp.asarray(_rand(b, s, n, h))
+    cos, sin = _rope_tables(h, 32, 10000.0)
+    out = ops.fused_rotary_position_embedding(x, cos=cos, sin=sin)
+
+    c = cos[:s][None, :, None, :]
+    sn = sin[:s][None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    ref = jnp.stack([x1 * c - x2 * sn, x2 * c + x1 * sn], axis=-1).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    # backward = inverse rotation: grad of sum(out * g) wrt x is rope^{-1}(g)
+    g = jax.grad(lambda x: jnp.sum(ops.fused_rotary_position_embedding(x, cos=cos, sin=sin) * ref))(x)
+    g_ref = jax.grad(lambda x: jnp.sum(
+        jnp.stack([x[..., 0::2] * c - x[..., 1::2] * sn, x[..., 1::2] * c + x[..., 0::2] * sn], -1).reshape(x.shape) * ref
+    ))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5, rtol=1e-5)
+
+
+def test_swiglu():
+    x = jnp.asarray(_rand(4, 256))
+    y = jnp.asarray(_rand(4, 256, seed=7))
+    ref = x * jax.nn.sigmoid(x) * y
+    np.testing.assert_allclose(np.asarray(ops.swiglu(x, y)), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    g1 = jax.grad(lambda x, y: jnp.sum(ops.swiglu(x, y) ** 2), argnums=(0, 1))(x, y)
+    g2 = jax.grad(lambda x, y: jnp.sum((x * jax.nn.sigmoid(x) * y) ** 2), argnums=(0, 1))(x, y)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_incubate_functional_tape():
+    """Fused ops through the Tensor tape: forward values + backward flow."""
+    import paddle_tpu.incubate.nn.functional as FF
+
+    x = paddle.to_tensor(_rand(4, 256))
+    x.stop_gradient = False
+    w = paddle.to_tensor(np.ones(256, np.float32))
+    w.stop_gradient = False
+    out = FF.fused_rms_norm(x, w)
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+    assert out.shape == [4, 256]
+
+    a = paddle.to_tensor(_rand(4, 128, seed=9))
+    b = paddle.to_tensor(_rand(128, 64, seed=10))
+    c = paddle.to_tensor(_rand(64, seed=11))
+    y = FF.fused_matmul_bias(a, b, c)
+    ref = np.asarray(a._value) @ np.asarray(b._value) + np.asarray(c._value)
+    np.testing.assert_allclose(np.asarray(y._value), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_masked_multihead_attention_decode():
+    """Decode-with-cache equals full attention on the prefix."""
+    import paddle_tpu.incubate.nn.functional as FF
+
+    b, n, h, smax = 2, 2, 32, 8
+    np.random.seed(0)
+    cache = paddle.to_tensor(np.zeros((2, b, n, smax, h), np.float32))
+    xs = [_rand(b, 3 * n * h, seed=20 + t) for t in range(4)]
+    outs = []
+    for t, xv in enumerate(xs):
+        out, cache = FF.masked_multihead_attention(
+            paddle.to_tensor(xv), cache, num_heads=n, head_dim=h, position_offset=t
+        )
+        outs.append(np.asarray(out._value))
+
+    # reference: full causal attention over the 4 tokens
+    qkv = np.stack(xs).reshape(4, b, 3, n, h)  # [T, B, 3, N, H]
+    q = np.moveaxis(qkv[:, :, 0], 0, 1)  # [B, T, N, H]
+    k = np.moveaxis(qkv[:, :, 1], 0, 1)
+    v = np.moveaxis(qkv[:, :, 2], 0, 1)
+    ref = np.asarray(
+        flash_attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+    )  # [B, T, N, H]
+    for t in range(4):
+        np.testing.assert_allclose(outs[t], ref[:, t].reshape(b, n * h), atol=1e-4, rtol=1e-4)
