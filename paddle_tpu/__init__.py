@@ -66,6 +66,7 @@ for _sub in (
     "incubate",
     "metric",
     "vision",
+    "inference",
     "linalg",
 ):
     try:

@@ -50,6 +50,7 @@ class _GradState(threading.local):
 
 
 _state = _GradState()
+_static_prog_mod = None  # lazy ref to paddle_tpu.static.program (capture hook)
 
 
 def is_grad_enabled() -> bool:
@@ -160,7 +161,23 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
     return a jax value or a tuple/list of them.  kwargs are static.
     Non-Tensor args and stop_gradient Tensors are closed over (not
     differentiated).  Integer/bool outputs never require grad.
+
+    Inside a static program_guard this funnel records an Operator instead of
+    executing — the whole op surface is static-capturable for free (the
+    reference gets the same dual-mode from its YAML codegen emitting both
+    dygraph ad_funcs and PIR ops).
     """
+    global _static_prog_mod
+    if _static_prog_mod is None:
+        try:
+            from paddle_tpu.static import program as _spm
+
+            _static_prog_mod = _spm
+        except ImportError:
+            _static_prog_mod = False
+    if _static_prog_mod and _static_prog_mod.in_static_capture():
+        return _static_prog_mod.current_main_program().record(name, fn, args, kwargs)
+
     args = _maybe_amp_cast(name, args)
     tensors = [a for a in args if isinstance(a, Tensor)]
     needs_grad = _state.enabled and any(not t.stop_gradient for t in tensors)

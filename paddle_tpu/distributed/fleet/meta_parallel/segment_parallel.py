@@ -27,10 +27,13 @@ def sep_attention(q, k, v, *, causal=True, scale=None, group=None, mode="ring"):
     attention (same signature as F.scaled_dot_product_attention).
     """
     q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
-    ax = _axis_for(group)
-    if ax is None:
-        scope = current_axis_scope()
-        ax = scope.get("sep")
+    if group is not None:
+        from paddle_tpu.distributed.communication.ops import _single_axis
+
+        ax = _single_axis(_axis_for(group), "sep_attention")
+    else:
+        # group=None means the SEP axis specifically, never the whole world
+        ax = current_axis_scope().get("sep")
     if ax is None:
         from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
 

@@ -22,7 +22,9 @@ from paddle_tpu.distributed.communication.ops import _axis_for, _world
 
 def _exchange(x, group, direction):
     """x: [world * chunk, ...] -> all-to-all over leading dim."""
-    ax = _axis_for(group)
+    from paddle_tpu.distributed.communication.ops import _single_axis
+
+    ax = _single_axis(_axis_for(group), f"global_{direction}")
     if ax is None:
         if _world(group) == 1:
             return ensure_tensor(x)

@@ -68,16 +68,18 @@ class MoELayer(nn.Layer):
         self.aux_loss = None
 
     def _a2a(self, x, name):
-        ax = _axis_for(self.moe_group)
-        if ax is None:
-            if self.ep_world > 1:
-                raise RuntimeError(
-                    "MoELayer has an EP group of size "
-                    f"{self.ep_world} but no matching mesh axis is in scope; "
-                    "run the layer inside the distributed step "
-                    "(collective_axis_scope exposing the EP axis)"
-                )
+        if self.moe_group is None or self.ep_world == 1:
             return x
+        ax = _axis_for(self.moe_group)
+        if isinstance(ax, tuple):  # group=None world tuple never applies here
+            ax = None
+        if ax is None:
+            raise RuntimeError(
+                "MoELayer has an EP group of size "
+                f"{self.ep_world} but no matching mesh axis is in scope; "
+                "run the layer inside the distributed step "
+                "(collective_axis_scope exposing the EP axis)"
+            )
         return apply(name, lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=True), x)
 
     def forward(self, x):

@@ -92,12 +92,15 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, posit
     cos_t = ensure_tensor(cos)
     sin_t = ensure_tensor(sin)
     args += [cos_t, sin_t]
+    if position_ids is not None:
+        args.append(ensure_tensor(position_ids))
 
     def _fn(qv, *rest):
         rest = list(rest)
         kv = rest.pop(0) if k is not None else None
-        cv, sv = rest
-        return _ops.fused_rotary_position_embedding(qv, kv, None, cos=cv, sin=sv)
+        cv, sv = rest[0], rest[1]
+        pids = rest[2] if len(rest) > 2 else None
+        return _ops.fused_rotary_position_embedding(qv, kv, None, cos=cv, sin=sv, position_ids=pids)
 
     out = apply("fused_rope", _fn, *args)
     if k is not None and v is not None:

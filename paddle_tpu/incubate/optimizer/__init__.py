@@ -19,7 +19,9 @@ class LookAhead(Optimizer):
         self.alpha = alpha
         self.k = k
         self._step_num = 0
-        self._slow = {}
+        # slow weights snapshot the INITIAL fast weights (reference
+        # lookahead.py) so the first k-step sync interpolates from w_0
+        self._slow = {id(p): p._value for p in inner_optimizer._parameter_list}
 
     @property
     def _parameter_list(self):
@@ -31,8 +33,6 @@ class LookAhead(Optimizer):
         if self._step_num % self.k == 0:
             for p in self.inner_optimizer._parameter_list:
                 key = id(p)
-                if key not in self._slow:
-                    self._slow[key] = p._value
                 slow = self._slow[key] + self.alpha * (p._value - self._slow[key])
                 self._slow[key] = slow
                 p._bind(slow)

@@ -1,0 +1,100 @@
+"""paddle.inference parity — the serving path.
+
+Reference: AnalysisPredictor (paddle/fluid/inference/api/analysis_predictor.cc):
+offline graph analysis + optimized execution with zero-copy IO.
+
+TPU-native: the saved artifact IS the optimized program (StableHLO bytecode
+exported AOT by paddle_tpu.static.save_inference_model — XLA did the fusion/
+placement work the reference's 286 IR passes do).  `Predictor` deserializes
+and executes it with no Python graph in the loop; input/output bindings are
+device buffers (jax arrays), the zero-copy analog.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import jax
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """AnalysisConfig parity (subset: model path + switches that map to XLA)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu" if any(d.platform == "tpu" for d in jax.devices()) else "cpu"
+
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_model(self, model_path, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+
+class Predictor:
+    def __init__(self, path_prefix_or_config):
+        if isinstance(path_prefix_or_config, Config):
+            prefix = path_prefix_or_config.model_path
+        else:
+            prefix = path_prefix_or_config
+        if prefix.endswith(".pdmodel"):
+            prefix = prefix[: -len(".pdmodel")]
+        self.prefix = prefix
+        with open(prefix + ".json") as f:
+            self.manifest = json.load(f)
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax.export.deserialize(bytearray(f.read()))
+        self._input_names = [s["name"] for s in self.manifest["feed"]]
+        self._output_names = [s["name"] for s in self.manifest["fetch"]]
+        self._inputs = {}
+
+    # reference-style handle API
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_input_handle(self, name):
+        pred = self
+
+        class _Handle:
+            def copy_from_cpu(self, arr):
+                pred._inputs[name] = jax.numpy.asarray(arr)
+
+            def reshape(self, shape):
+                pass
+
+        return _Handle()
+
+    def get_output_handle(self, name):
+        pred = self
+
+        class _Handle:
+            def copy_to_cpu(self):
+                return np.asarray(pred._last_outputs[pred._output_names.index(name)])
+
+        return _Handle()
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            vals = [jax.numpy.asarray(a) for a in inputs]
+        else:
+            vals = [self._inputs[n] for n in self._input_names]
+        out = self._exported.call(*vals)
+        self._last_outputs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return [np.asarray(o) for o in self._last_outputs]
+
+    __call__ = run
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

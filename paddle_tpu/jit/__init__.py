@@ -202,16 +202,47 @@ class TrainStep:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save: persist params + a structural descriptor
-    (reference jit/api.py:849 emits .pdmodel/.pdiparams; here the compiled
-    artifact is rebuilt by XLA at load — params are the portable part)."""
+    """paddle.jit.save (reference jit/api.py:849 emits .pdmodel/.pdiparams).
+
+    With input_spec (paddle.static.InputSpec list) the layer's forward is
+    captured into a static Program and exported as the StableHLO deploy
+    artifact (loadable by paddle_tpu.inference.Predictor / jit.load); params
+    are also saved as .pdparams for state_dict-style reload.
+    """
     from paddle_tpu.framework.io_utils import save as fsave
 
     state = {"state_dict": dict(layer.state_dict()), "class": type(layer).__name__}
     fsave(state, path + ".pdparams")
 
+    if input_spec:
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main):
+            feeds = [
+                static.data(s.name or f"x{i}", s.shape, s.dtype)
+                for i, s in enumerate(input_spec)
+            ]
+            was_training = layer.training
+            layer.eval()
+            try:
+                out = layer(*feeds)
+            finally:
+                if was_training:
+                    layer.train()
+            fetch = list(out) if isinstance(out, (tuple, list)) else [out]
+        static.save_inference_model(path, feeds, fetch, program=main)
+
 
 def load(path, **configs):
+    """Returns a Predictor if a .pdmodel artifact exists, else the saved
+    state payload."""
+    import os
+
+    if os.path.exists(path + ".pdmodel"):
+        from paddle_tpu.inference import Predictor
+
+        return Predictor(path)
     from paddle_tpu.framework.io_utils import load as fload
 
     return fload(path + ".pdparams")

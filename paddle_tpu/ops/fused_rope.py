@@ -35,12 +35,10 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
     o_ref[:] = out.astype(o_ref.dtype)
 
 
-def _rope_apply(x, cos, sin):
-    """x: [B, S, N, H]; cos/sin: [S, H/2] (fp32 tables)."""
+def _rope_apply(x, cos_r, sin_r):
+    """x: [B, S, N, H]; cos_r/sin_r: per-token tables [B*S, H/2] fp32."""
     b, s, n, h = x.shape
     x2d = x.reshape(b * s, n * h)
-    cos_r = jnp.tile(cos, (b, 1))
-    sin_r = jnp.tile(sin, (b, 1))
     bs = min(256, b * s)
     if (b * s) % bs:
         bs = b * s
@@ -76,13 +74,22 @@ def _rope_bwd(res, g):
 _rope.defvjp(_rope_fwd, _rope_bwd)
 
 
-def fused_rotary_position_embedding(q, k=None, v=None, *, cos, sin, position_offset=0):
+def fused_rotary_position_embedding(q, k=None, v=None, *, cos, sin, position_offset=0, position_ids=None):
     """Rotate q (and k) with interleaved-pair RoPE.  q/k: [B, S, N, H];
-    cos/sin: [max_len, H/2] fp32 tables.  v passes through (parity with the
-    reference signature which optionally rotates v — rarely used)."""
-    s = q.shape[1]
-    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, axis=0)
-    sn = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, axis=0)
+    cos/sin: [max_len, H/2] fp32 tables.  position_ids [B, S] (packed or
+    left-padded sequences) selects per-token table rows; otherwise absolute
+    position + offset is used.  v passes through (parity with the reference
+    signature which optionally rotates v — rarely used)."""
+    b, s = q.shape[0], q.shape[1]
+    half = cos.shape[-1]
+    if position_ids is not None:
+        c = jnp.take(cos, position_ids.reshape(-1), axis=0)
+        sn = jnp.take(sin, position_ids.reshape(-1), axis=0)
+    else:
+        c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, axis=0)
+        sn = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, axis=0)
+        c = jnp.tile(c, (b, 1))
+        sn = jnp.tile(sn, (b, 1))
     outs = [_rope(q, c, sn)]
     if k is not None:
         outs.append(_rope(k, c, sn))

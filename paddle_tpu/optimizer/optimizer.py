@@ -119,10 +119,130 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from paddle_tpu.static.program import in_static_capture
+
+        if in_static_capture():
+            return self._static_minimize(loss, parameters)
         loss.backward()
         self.step()
         self.clear_grad()
         return None, None
+
+    def _static_minimize(self, loss, parameters=None):
+        """Static-graph path: append backward + one fused update super-op.
+
+        The op's body replays self.step() on traced values — the same Python
+        update math serves eager and static (the reference gets this from its
+        YAML codegen emitting both dygraph ad_func and static op).  Parameter
+        and accumulator mutations during tracing are journaled and rolled
+        back, so the live objects are untouched; the program records
+        param/state write-backs instead.
+        """
+        from paddle_tpu.static.autodiff import append_backward
+        from paddle_tpu.static.program import current_main_program, suspend_capture
+
+        prog = current_main_program()
+        params = [p for p in (parameters or self._parameter_list) if not p.stop_gradient]
+        p_g = append_backward(loss, parameter_list=params)
+        grad_vars = [g for _, g in p_g]
+        param_vars = [prog.var_for_parameter(p) for p in params]
+
+        # discover accumulators with a rolled-back dry trace
+        journal = self._journaled_step(params)
+        acc_items = sorted(self._accumulators.items(), key=lambda kv: (kv[0][0], self._pidx(kv[0][1], params)))
+        acc_tensors = [t for _, t in acc_items]
+        acc_vars = [
+            prog.var_for_state(t, name=f"opt_{name}_{self._pidx(pid, params)}")
+            for (name, pid), t in acc_items
+        ]
+
+        n_p = len(params)
+        n_a = len(acc_tensors)
+
+        def update_fn(*vals):
+            pvals = vals[:n_p]
+            gvals = vals[n_p : 2 * n_p]
+            avals = vals[2 * n_p : 2 * n_p + n_a]
+            with suspend_capture():
+                saved = [(p, p._value, p.grad) for p in params]
+                saved_acc = [(t, t._value) for t in acc_tensors]
+                saved_count = self._step_count
+                try:
+                    for p, pv, gv in zip(params, pvals, gvals):
+                        p._bind(pv)
+                        p.grad = Tensor(gv)
+                    for t, av in zip(acc_tensors, avals):
+                        t._bind(av)
+                    # NOTE: python-level step-count math (e.g. RAdam's
+                    # rectification branch) freezes at trace time in static
+                    # mode, like the reference's non-var step attrs; stateful
+                    # accumulators (beta pows) advance correctly.
+                    self.step()
+                    new_p = tuple(p._value for p in params)
+                    new_a = tuple(t._value for t in acc_tensors)
+                finally:
+                    for (p, pv, g) in saved:
+                        p._bind(pv)
+                        p.grad = g
+                    for (t, av) in saved_acc:
+                        t._bind(av)
+                    self._step_count = saved_count
+            return new_p + new_a
+
+        outs = prog.record(
+            "optimizer_update", update_fn, tuple(param_vars) + tuple(grad_vars) + tuple(acc_vars), {}
+        )
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        for var, out in zip(list(param_vars) + list(acc_vars), outs):
+            prog.add_write(var, out)
+        return None, p_g
+
+    @staticmethod
+    def _pidx(pid, params):
+        for i, p in enumerate(params):
+            if id(p) == pid:
+                return i
+        return -1
+
+    def _journaled_step(self, params):
+        """Run one step() against zero grads purely to CREATE accumulators,
+        then roll back every mutation: params/grads/step count restored from
+        snapshots, pre-existing accumulators restored, newly-created ones
+        reset to the exact fresh init their creation produced (captured by a
+        spy on _acc at creation time, before step() mutates them)."""
+        import jax.numpy as _jnp
+
+        pre_acc_vals = {k: t._value for k, t in self._accumulators.items()}
+        fresh_inits = {}
+        orig_acc = self._acc
+
+        def acc_spy(name, p, init=None, dtype=None):
+            key = (name, id(p))
+            existed = key in self._accumulators
+            t = orig_acc(name, p, init=init, dtype=dtype)
+            if not existed and key not in pre_acc_vals:
+                fresh_inits[key] = t._value
+            return t
+
+        saved = [(p, p._value, p.grad) for p in params]
+        saved_count = self._step_count
+        self._acc = acc_spy
+        try:
+            for p in params:
+                p.grad = Tensor(_jnp.zeros_like(p._value))
+            with no_grad():
+                self.step()
+        finally:
+            del self._acc  # restore the bound method
+            for p, v, g in saved:
+                p._bind(v)
+                p.grad = g
+            self._step_count = saved_count
+            for k, t in self._accumulators.items():
+                if k in pre_acc_vals:
+                    t._bind(pre_acc_vals[k])
+                elif k in fresh_inits:
+                    t._bind(fresh_inits[k])
 
     # ------------------------------------------------------------ state dict
     def state_dict(self) -> dict:

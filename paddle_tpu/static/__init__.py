@@ -1,0 +1,102 @@
+"""paddle.static parity (reference python/paddle/static/) on the TPU-native
+Program IR: capture via the apply() funnel, execution via one jitted XLA
+program per (program, signature) — see program.py / executor.py."""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu._core import dtype as _dtype_mod
+
+from .program import (  # noqa: F401
+    Program,
+    Variable,
+    Operator,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    enable_static,
+    disable_static,
+    in_dynamic_mode,
+    in_static_capture,
+    current_main_program,
+    name_scope,
+    suspend_capture,
+)
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .autodiff import append_backward, gradients  # noqa: F401
+from .io import (  # noqa: F401
+    save,
+    load,
+    save_inference_model,
+    load_inference_model,
+    serialize_program,
+    deserialize_program,
+)
+from . import nn  # noqa: F401
+
+__all__ = [
+    "Program",
+    "Variable",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "data",
+    "InputSpec",
+    "Executor",
+    "global_scope",
+    "scope_guard",
+    "append_backward",
+    "gradients",
+    "save",
+    "load",
+    "save_inference_model",
+    "load_inference_model",
+    "nn",
+    "cpu_places",
+    "device_guard",
+]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference paddle.static.data).  -1 dims are captured
+    as 1 for shape inference; execution re-traces with actual feed shapes."""
+    prog = current_main_program()
+    if prog is None:
+        prog = default_main_program()
+    jdt = _dtype_mod.to_jax_dtype(dtype)
+    dyn = tuple(i for i, d in enumerate(shape) if d is None or d < 0)
+    shape = [1 if (d is None or d < 0) else int(d) for d in shape]
+    v = prog.new_var(jax.ShapeDtypeStruct(tuple(shape), jdt), name=name)
+    v.dynamic_dims = dyn  # export serializes these as symbolic dims
+    prog.add_feed(v)
+    return v
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (used by jit.save signatures)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def cpu_places(device_count=None):
+    from paddle_tpu._core.place import CPUPlace
+
+    return [CPUPlace()]
+
+
+class device_guard:
+    def __init__(self, device=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,80 @@
+"""Program-level autodiff: append_backward / gradients.
+
+Reference: python/paddle/autograd/ir_backward.py:885 (PIR autodiff appending
+grad ops per forward op via VJP interfaces).
+
+TPU-native: the whole recorded prefix is one traceable function, so backward
+is jax.grad of that function — one grad "super-op" appended to the program
+whose outputs are the per-parameter grad Variables.  XLA CSEs the re-executed
+forward against the original, so the compiled step computes the forward once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu._core.tensor import Parameter, Tensor
+
+from .program import Program, Variable, current_main_program
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _grad_superop(prog: Program, target: Variable, wrt_vars, name):
+    """Record one op computing d(target)/d(wrt_vars); returns grad Variables."""
+    fetch = [target._vid]
+    inputs = list(prog.feed_vars) + [prog._var_by_vid[vid] for vid in prog.param_inits]
+    in_vids = [v._vid for v in inputs]
+    run_fn, feed_vids, state_vids = prog.as_function(fetch, feed_vids=[], state_vids=in_vids)
+    wrt_pos = [in_vids.index(v._vid) for v in wrt_vars]
+
+    def fn(*vals):
+        def scalar(*wrt_vals):
+            full = list(vals)
+            for pos, wv in zip(wrt_pos, wrt_vals):
+                full[pos] = wv
+            (out,), _ = run_fn([], full)
+            return out.sum() if out.ndim else out
+
+        grads = jax.grad(scalar, argnums=tuple(range(len(wrt_pos))))(
+            *[vals[p] for p in wrt_pos]
+        )
+        return tuple(grads)
+
+    return prog.record(name, fn, tuple(inputs), {})
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Returns [(parameter Variable, grad Variable)].  parameter_list may hold
+    dygraph Parameters (auto-registered) or Variables."""
+    prog = current_main_program()
+    if prog is None:
+        raise RuntimeError("append_backward requires an active program_guard")
+
+    if parameter_list:
+        wrt = []
+        for p in parameter_list:
+            if isinstance(p, Variable):
+                wrt.append(p)
+            elif isinstance(p, Parameter):
+                wrt.append(prog.var_for_parameter(p))
+            else:
+                raise TypeError(f"bad parameter {p!r}")
+    else:
+        wrt = prog.all_parameters()
+
+    grads = _grad_superop(prog, loss, wrt, "grad")
+    if not isinstance(grads, (tuple, list)):
+        grads = (grads,)
+    return list(zip(wrt, grads))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity: grads of sum(targets) wrt inputs."""
+    prog = current_main_program()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("multiple targets: sum them first")
+    pairs = append_backward(targets[0], parameter_list=list(inputs))
+    return [g for _, g in pairs]

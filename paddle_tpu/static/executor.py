@@ -1,0 +1,144 @@
+"""Static-graph executor.
+
+Reference: StandaloneExecutor + PirInterpreter
+(paddle/fluid/framework/new_executor/pir_interpreter.h:56 Run,
+standalone_executor.cc; python/paddle/base/executor.py:1151 Executor, :2017
+_run_pir_impl).
+
+TPU-native: the "interpreter" executes the Program's op list once under
+jax.jit tracing, producing ONE fused XLA executable per (program version,
+feed signature, fetch set) — dependency analysis, stream assignment, memory
+planning and GC are XLA's job.  Persistent state (parameters + optimizer
+accumulators) lives in a Scope keyed by variable id; state buffers are
+donated to the executable so updates are in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from paddle_tpu._core.tensor import Tensor
+
+from .program import Program, Variable, default_main_program, _st
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+
+
+class Scope:
+    def __init__(self):
+        self._vals: dict[int, jax.Array] = {}
+
+    def find_var(self, vid):
+        return self._vals.get(vid)
+
+    def set_var(self, vid, val):
+        self._vals[vid] = val
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._prev = _global_scope
+        _global_scope = self.scope
+        return self.scope
+
+    def __exit__(self, *exc):
+        global _global_scope
+        _global_scope = self._prev
+
+
+class Executor:
+    """Executor(place).run(program, feed, fetch_list) -> list of np arrays."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def _ensure_state(self, program: Program, scope: Scope):
+        import jax.numpy as jnp
+
+        for vid, init in program.param_inits.items():
+            if scope.find_var(vid) is None:
+                # own copy: state buffers are donated to the executable, and
+                # the init value may still back a live dygraph Parameter
+                scope.set_var(vid, jnp.array(init, copy=True))
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None, return_numpy=True):
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        if not program.global_block().ops and not program.param_inits:
+            return []  # startup program: state materializes lazily below
+
+        self._ensure_state(program, scope)
+
+        fetch_vars = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                fetch_vars.append(f)
+            elif isinstance(f, str):
+                fetch_vars.append(program.global_block().var(f))
+            else:
+                raise TypeError(f"bad fetch entry {f!r}")
+        fetch_vids = tuple(v._vid for v in fetch_vars)
+
+        feed_vals = []
+        for v in program.feed_vars:
+            if v.name not in feed:
+                raise KeyError(f"missing feed '{v.name}'")
+            feed_vals.append(jax.numpy.asarray(feed[v.name], v._value.dtype))
+
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
+        key = (id(program), program.version, sig, fetch_vids)
+        if key not in self._cache:
+            run_fn, feed_vids, state_vids = program.as_function(list(fetch_vids))
+
+            prev = _st.main_program
+            _st.main_program = None  # never capture while executing
+            try:
+                compiled = jax.jit(run_fn, donate_argnums=(1,) if program.writes else ())
+            finally:
+                _st.main_program = prev
+            self._cache[key] = (compiled, state_vids)
+        compiled, state_vids = self._cache[key]
+
+        state_vals = [scope.find_var(vid) for vid in state_vids]
+        prev = _st.main_program
+        _st.main_program = None
+        try:
+            fetches, new_state = compiled(feed_vals, state_vals)
+        finally:
+            _st.main_program = prev
+
+        if program.writes:
+            for vid, val in zip(state_vids, new_state):
+                scope.set_var(vid, val)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return [Tensor(v) for v in fetches]
+
+    def close(self):
+        self._cache.clear()
+
+    def state_dict(self, program: Program, scope=None):
+        """Name-keyed trained values (parameters + optimizer state)."""
+        scope = scope or global_scope()
+        return {
+            var.name: scope.find_var(var._vid)
+            for var in program.all_parameters() + list(program.state_vars.values())
+            if scope.find_var(var._vid) is not None
+        }

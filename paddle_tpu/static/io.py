@@ -1,0 +1,157 @@
+"""Static model serialization — the deploy format.
+
+Reference: python/paddle/static/io.py save/load_inference_model writing
+`.pdmodel` (protobuf program) + `.pdiparams` (params); loaded by the C++
+AnalysisPredictor for serving.
+
+TPU-native format (`.pdmodel` analog): the program is lowered AOT with
+jax.jit(...).lower() and saved as **StableHLO (portable bytecode)** — the IR
+XLA serves directly — plus a JSON manifest (feed/fetch names, shapes,
+dtypes) and an `.npz` of parameters.  Loading deserializes into a callable
+executable without the Python graph (paddle_tpu.inference.Predictor wraps
+it); `load_inference_model` here returns (program-like callable, feed names,
+fetch names) matching the reference's tuple shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+
+from .program import Program, Variable, _st
+from .executor import Executor, global_scope
+
+__all__ = [
+    "save",
+    "load",
+    "save_inference_model",
+    "load_inference_model",
+    "serialize_program",
+    "deserialize_program",
+]
+
+
+def save(program: Program, model_path: str):
+    """paddle.static.save parity: persist parameters+state (pickled npz)."""
+    scope = global_scope()
+    state = {}
+    for vid, init in program.param_inits.items():
+        var = program._var_by_vid[vid]
+        val = scope.find_var(vid)
+        state[var.name] = np.asarray(val if val is not None else init)
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".pdparams.npz", **state)
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    data = np.load(model_path + ".pdparams.npz")
+    scope = global_scope()
+    by_name = {program._var_by_vid[vid].name: vid for vid in program.param_inits}
+    for name in data.files:
+        if name in by_name:
+            scope.set_var(by_name[name], jnp.asarray(data[name]))
+
+
+def _program_callable(program: Program, feed_vars, fetch_vars):
+    run_fn, feed_vids, state_vids = program.as_function(
+        [v._vid for v in fetch_vars], feed_vids=[v._vid for v in feed_vars]
+    )
+    scope = global_scope()
+    state_vals = [
+        scope.find_var(vid) if scope.find_var(vid) is not None else program.param_inits[vid]
+        for vid in state_vids
+    ]
+
+    def fn(*feed_vals):
+        fetches, _ = run_fn(list(feed_vals), state_vals)
+        return tuple(fetches)
+
+    return fn
+
+
+def serialize_program(program: Program, feed_vars, fetch_vars):
+    """Lower + export to StableHLO portable bytecode (the .pdmodel analog).
+    Returns (serialized bytes, stablehlo text for inspection)."""
+    fn = _program_callable(program, feed_vars, fetch_vars)
+    scope = jax.export.SymbolicScope()
+    avals = []
+    for v in feed_vars:
+        dyn = getattr(v, "dynamic_dims", ()) or ()
+        if dyn:
+            # shared symbol per axis position so e.g. batch dims unify
+            dims = ",".join(
+                f"d{i}" if i in dyn else str(d) for i, d in enumerate(v._value.shape)
+            )
+            shape = jax.export.symbolic_shape(dims, scope=scope)
+        else:
+            shape = v._value.shape
+        avals.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
+    prev = _st.main_program
+    _st.main_program = None
+    try:
+        exported = jax.export.export(jax.jit(fn), platforms=["cpu", "tpu"])(*avals)
+    finally:
+        _st.main_program = prev
+    return exported.serialize(), str(exported.mlir_module())
+
+
+def deserialize_program(blob: bytes):
+    return jax.export.deserialize(blob)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
+    """Writes <prefix>.pdmodel (StableHLO bytecode via jax.export),
+    <prefix>.pdmodel.txt (HLO text), <prefix>.json (manifest),
+    <prefix>.pdiparams.npz (parameters, already folded into the HLO as
+    constants for serving; saved separately for inspection/re-export)."""
+    program = program or (feed_vars[0]._program if isinstance(feed_vars[0], Variable) else None)
+    if program is None:
+        from .program import default_main_program
+
+        program = default_main_program()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+
+    blob, text = serialize_program(program, feed_vars, fetch_vars)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path_prefix + ".pdmodel.txt", "w") as f:
+        f.write(text)
+
+    scope = global_scope()
+    params = {}
+    for vid, init in program.param_inits.items():
+        val = scope.find_var(vid)
+        params[program._var_by_vid[vid].name] = np.asarray(val if val is not None else init)
+    np.savez(path_prefix + ".pdiparams.npz", **params)
+
+    manifest = {
+        "feed": [
+            {"name": v.name, "shape": list(v._value.shape), "dtype": str(np.dtype(v._value.dtype))}
+            for v in feed_vars
+        ],
+        "fetch": [
+            {"name": v.name, "shape": list(v._value.shape), "dtype": str(np.dtype(v._value.dtype))}
+            for v in fetch_vars
+        ],
+        "format": "stablehlo-text",
+    }
+    with open(path_prefix + ".json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns (predictor_fn, feed_names, fetch_names): predictor_fn is a
+    compiled callable over np arrays (serving path — no Python graph)."""
+    from paddle_tpu.inference import Predictor
+
+    pred = Predictor(path_prefix)
+    feed_names = [s["name"] for s in pred.manifest["feed"]]
+    fetch_names = [s["name"] for s in pred.manifest["fetch"]]
+    return pred, feed_names, fetch_names

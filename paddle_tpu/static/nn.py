@@ -1,0 +1,58 @@
+"""paddle.static.nn parity: graph-building layer functions (reference
+python/paddle/static/nn/common.py fc/embedding/...).
+
+These are thin functional wrappers creating fresh Parameters per call —
+inside a program_guard the parameters auto-register with the program.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu._core.tensor import Parameter
+
+__all__ = ["fc", "embedding", "batch_norm", "conv2d"]
+
+
+def _make_param(shape, dtype, initializer):
+    from paddle_tpu._core.dtype import to_jax_dtype
+
+    val = initializer._init_value(tuple(shape), to_jax_dtype(dtype))
+    return Parameter(val, stop_gradient=False)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    w = _make_param([in_dim, size], "float32", I.XavierNormal())
+    b = _make_param([size], "float32", I.Constant(0.0))
+    x2 = paddle.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = paddle.matmul(x2, w) + b
+    if activation == "relu":
+        out = F.relu(out)
+    elif activation == "softmax":
+        out = F.softmax(out)
+    elif activation is not None:
+        raise ValueError(f"unsupported activation {activation}")
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None, dtype="float32"):
+    w = _make_param(list(size), dtype, I.XavierNormal())
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def batch_norm(input, momentum=0.9, epsilon=1e-5, data_layout="NCHW", **kwargs):
+    import paddle_tpu.nn as nn
+
+    bn = nn.BatchNorm2D(input.shape[1] if data_layout == "NCHW" else input.shape[-1], momentum, epsilon, data_format=data_layout)
+    return bn(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None, data_format="NCHW"):
+    import paddle_tpu.nn as nn
+
+    conv = nn.Conv2D(input.shape[1], num_filters, filter_size, stride, padding, dilation, groups, data_format=data_format)
+    return conv(input)

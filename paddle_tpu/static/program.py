@@ -1,0 +1,359 @@
+"""Static graph IR: Program / Block / Variable / Operator.
+
+Reference: the PIR program stack (paddle/pir/core/*.h Operation/Value/Block/
+Program; python surface python/paddle/base/framework.py:5655 Program, :1401
+Variable, program_guard :7733).
+
+TPU-native redesign (SURVEY.md §7): the IR's only lowering target is XLA, so
+an op is simply (traceable jax fn, input vars/constants, static attrs) and
+InferMeta is jax.eval_shape.  Capture rides the SAME funnel as eager — every
+framework op goes through `_core.autograd.apply`, which, inside a
+program_guard, appends an Operator instead of executing (so the whole tensor/
+nn surface is static-capturable with no per-op work, like the reference's
+single YAML registry feeding both dygraph and PIR codegen).  Parameters
+(dygraph `Parameter` objects touched during capture) auto-register as program
+inputs with their init value recorded for the startup program.  Programs
+compile to a single XLA executable per (feed signature, fetch set) in the
+Executor.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Parameter, Tensor
+
+__all__ = [
+    "Variable",
+    "Operator",
+    "Block",
+    "Program",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "in_static_capture",
+    "current_main_program",
+    "enable_static",
+    "disable_static",
+    "in_dynamic_mode",
+    "name_scope",
+]
+
+_vid_counter = itertools.count()
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program: `_value` is a jax.ShapeDtypeStruct.
+
+    Subclasses Tensor so the whole op surface (which reads ._value
+    shape/dtype and routes compute through apply) treats it uniformly.
+    """
+
+    __slots__ = ("_vid", "_program", "is_parameter", "dynamic_dims")
+
+    def __init__(self, aval, name="", program=None, persistable=False, is_parameter=False):
+        # bypass Tensor.__init__ value coercion
+        self._value = aval
+        self.stop_gradient = True
+        self.name = name or f"var_{next(_vid_counter)}"
+        self.grad = None
+        self._grad_node = None
+        self._out_index = None
+        self._hooks = []
+        self._vid = next(_vid_counter)
+        self._program = program
+        self.persistable = persistable
+        self.is_parameter = is_parameter
+        self.dynamic_dims = ()  # axis positions declared as -1/None in data()
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value inside a static Program; "
+            "fetch it through Executor.run"
+        )
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={list(self._value.shape)}, dtype={self._value.dtype})"
+
+
+@dataclass
+class Operator:
+    """One recorded op: jax fn + where its inputs come from.
+
+    arg_spec entries: ('var', vid) for Variable inputs, ('const', value) for
+    captured concrete values / python args.
+    """
+
+    type: str
+    fn: Any
+    arg_spec: list
+    kwargs: dict
+    out_vids: list
+    out_tree: Any
+
+    def input_vids(self):
+        return [s[1] for s in self.arg_spec if s[0] == "var"]
+
+
+class Block:
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.ops: list[Operator] = []
+        self.vars: dict[str, Variable] = {}
+
+    def var(self, name):
+        return self.vars[name]
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if v.is_parameter]
+
+
+class Program:
+    """A captured computation: feed vars -> ops -> any var fetchable.
+
+    `param_inits` maps parameter vid -> concrete init value (the startup
+    program's content); `writes` maps vid -> vid (state updates applied to the
+    scope after each run — optimizer param/accumulator updates).
+    """
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.feed_vars: list[Variable] = []
+        self.param_vars: dict[int, Variable] = {}  # id(Parameter) -> Variable
+        self.param_inits: dict[int, Any] = {}  # vid -> concrete init value
+        self.state_vars: dict[int, Variable] = {}  # id(Tensor) -> Variable (opt state)
+        self.writes: dict[int, int] = {}  # target vid -> source vid
+        self.version = 0
+        self._var_by_vid: dict[int, Variable] = {}
+        self.random_seed = None
+
+    # ------------------------------------------------------------- structure
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def all_parameters(self):
+        return [self._var_by_vid[vid] for vid in self.param_inits if self._var_by_vid[vid].is_parameter]
+
+    # -------------------------------------------------------------- capture
+    def _register_var(self, var: Variable):
+        var._program = self
+        self.global_block().vars[var.name] = var
+        self._var_by_vid[var._vid] = var
+        self.version += 1
+        return var
+
+    def new_var(self, aval, name="", persistable=False, is_parameter=False):
+        return self._register_var(
+            Variable(aval, name=name, program=self, persistable=persistable, is_parameter=is_parameter)
+        )
+
+    def add_feed(self, var: Variable):
+        self.feed_vars.append(var)
+        return var
+
+    def var_for_parameter(self, p: Parameter) -> Variable:
+        key = id(p)
+        if key not in self.param_vars:
+            aval = jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+            v = self.new_var(aval, name=p.name or f"param_{len(self.param_vars)}", persistable=True, is_parameter=True)
+            self.param_vars[key] = v
+            self.param_inits[v._vid] = p._value
+        return self.param_vars[key]
+
+    def var_for_state(self, t: Tensor, name="") -> Variable:
+        """Non-parameter persistent state (optimizer accumulators)."""
+        key = id(t)
+        if key not in self.state_vars:
+            aval = jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
+            v = self.new_var(aval, name=name or f"state_{len(self.state_vars)}", persistable=True)
+            self.state_vars[key] = v
+            self.param_inits[v._vid] = t._value
+        return self.state_vars[key]
+
+    def record(self, type_, fn, args, kwargs):
+        """Append an Operator; returns output Variable(s).  Called by
+        _core.autograd.apply when this program is being captured."""
+        arg_spec = []
+        in_avals = []
+        var_slots = []
+        for i, a in enumerate(args):
+            if isinstance(a, Variable):
+                arg_spec.append(("var", a._vid))
+                in_avals.append(jax.ShapeDtypeStruct(a._value.shape, a._value.dtype))
+                var_slots.append(i)
+            elif isinstance(a, Parameter):
+                v = self.var_for_parameter(a)
+                arg_spec.append(("var", v._vid))
+                in_avals.append(jax.ShapeDtypeStruct(a._value.shape, a._value.dtype))
+                var_slots.append(i)
+            elif isinstance(a, Tensor):
+                arg_spec.append(("const", a._value))
+            else:
+                arg_spec.append(("const", a))
+
+        n_args = len(args)
+        slot_set = set(var_slots)
+
+        def g(*var_vals):
+            it = iter(var_vals)
+            full = [next(it) if i in slot_set else arg_spec[i][1] for i in range(n_args)]
+            with suspend_capture():
+                return fn(*full, **kwargs)
+
+        out_shape = jax.eval_shape(g, *in_avals)
+        flat, tree = jax.tree_util.tree_flatten(out_shape)
+        outs = [self.new_var(jax.ShapeDtypeStruct(o.shape, o.dtype)) for o in flat]
+        op = Operator(type_, g, arg_spec, dict(kwargs), [o._vid for o in outs], tree)
+        self.current_block().ops.append(op)
+        self.version += 1
+        return jax.tree_util.tree_unflatten(tree, outs)
+
+    def add_write(self, target: Variable, source: Variable):
+        self.writes[target._vid] = source._vid
+        self.version += 1
+
+    # ------------------------------------------------------------ execution
+    def as_function(self, fetch_vids, feed_vids=None, state_vids=None):
+        """Build fn(feed_vals, state_vals) -> (fetches, write_values)."""
+        feed_vids = feed_vids if feed_vids is not None else [v._vid for v in self.feed_vars]
+        state_vids = state_vids if state_vids is not None else list(self.param_inits.keys())
+        ops = list(self.global_block().ops)
+        writes = dict(self.writes)
+
+        def run(feed_vals, state_vals):
+            env = {}
+            for vid, val in zip(feed_vids, feed_vals):
+                env[vid] = val
+            for vid, val in zip(state_vids, state_vals):
+                env[vid] = val
+            for op in ops:
+                var_vals = [env[s[1]] for s in op.arg_spec if s[0] == "var"]
+                out = op.fn(*var_vals)
+                flat = jax.tree_util.tree_leaves(out)
+                for vid, v in zip(op.out_vids, flat):
+                    env[vid] = v
+            fetches = [env[vid] for vid in fetch_vids]
+            new_state = [env.get(writes.get(vid, -1), env[vid]) for vid in state_vids]
+            return fetches, new_state
+
+        return run, feed_vids, state_vids
+
+    # --------------------------------------------------------------- extras
+    def clone(self, for_test=False):
+        p = Program.__new__(Program)
+        p.blocks = [Block(p, 0)]
+        p.blocks[0].ops = list(self.global_block().ops)
+        p.blocks[0].vars = dict(self.global_block().vars)
+        p.feed_vars = list(self.feed_vars)
+        p.param_vars = dict(self.param_vars)
+        p.param_inits = dict(self.param_inits)
+        p.state_vars = dict(self.state_vars)
+        p.writes = {} if for_test else dict(self.writes)
+        p.version = self.version
+        p._var_by_vid = dict(self._var_by_vid)
+        p.random_seed = self.random_seed
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = [f"Program(version={self.version})"]
+        for v in self.feed_vars:
+            lines.append(f"  feed {v!r}")
+        for op in self.global_block().ops:
+            ins = ", ".join(str(s[1]) if s[0] == "var" else "<const>" for s in op.arg_spec)
+            lines.append(f"  {op.type}({ins}) -> {op.out_vids}")
+        for t, s in self.writes.items():
+            lines.append(f"  write var{t} <- var{s}")
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+
+# ------------------------------------------------------------------ context
+
+class _StaticState(threading.local):
+    def __init__(self):
+        self.main_program = None
+        self.startup_program = None
+        self.static_mode = False
+        self.suspended = 0
+        self.default_main = Program()
+        self.default_startup = Program()
+
+
+_st = _StaticState()
+
+
+@contextlib.contextmanager
+def suspend_capture():
+    """Run eagerly (on values or tracers) while a program_guard is active —
+    used while tracing a recorded op's body (e.g. the optimizer-update
+    super-op replays Optimizer.step through the eager path)."""
+    _st.suspended += 1
+    try:
+        yield
+    finally:
+        _st.suspended -= 1
+
+
+def in_static_capture():
+    return _st.main_program is not None and not _st.suspended
+
+
+def current_main_program():
+    return _st.main_program
+
+
+def default_main_program():
+    return _st.default_main
+
+
+def default_startup_program():
+    return _st.default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev = (_st.main_program, _st.startup_program)
+    _st.main_program = main_program
+    _st.startup_program = startup_program
+    try:
+        yield
+    finally:
+        _st.main_program, _st.startup_program = prev
+
+
+def enable_static():
+    """Reference paddle.enable_static: subsequent API calls build the default
+    main program until disable_static()."""
+    _st.static_mode = True
+    _st.main_program = _st.default_main
+    _st.startup_program = _st.default_startup
+
+
+def disable_static():
+    _st.static_mode = False
+    _st.main_program = None
+    _st.startup_program = None
+
+
+def in_dynamic_mode():
+    return _st.main_program is None
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield

@@ -165,3 +165,19 @@ def test_masked_multihead_attention_decode():
     )  # [B, T, N, H]
     for t in range(4):
         np.testing.assert_allclose(outs[t], ref[:, t].reshape(b, n * h), atol=1e-4, rtol=1e-4)
+
+
+def test_fused_rope_position_ids():
+    from paddle_tpu.models.llama import _rope_tables
+
+    b, s, n, h = 2, 8, 2, 32
+    x = jnp.asarray(_rand(b, s, n, h, seed=30))
+    cos, sin = _rope_tables(h, 64, 10000.0)
+    pids = jnp.asarray(np.array([[5, 6, 7, 8, 9, 10, 11, 12], [0, 1, 2, 3, 4, 5, 6, 7]]))
+    out = ops.fused_rotary_position_embedding(x, cos=cos, sin=sin, position_ids=pids)
+
+    c = cos[np.asarray(pids).reshape(-1)].reshape(b, s, 1, h // 2)
+    sn = sin[np.asarray(pids).reshape(-1)].reshape(b, s, 1, h // 2)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    ref = jnp.stack([x1 * c - x2 * sn, x2 * c + x1 * sn], axis=-1).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)

