@@ -67,6 +67,17 @@ for _sub in (
     "metric",
     "vision",
     "inference",
+    "hapi",
+    "profiler",
+    "distribution",
+    "sparse",
+    "fft",
+    "signal",
+    "text",
+    "audio",
+    "geometric",
+    "quantization",
+    "onnx",
     "linalg",
 ):
     try:
@@ -102,18 +113,9 @@ def is_compiled_with_cinn() -> bool:
     return False
 
 
-_static_mode = False
-
-
-def in_dynamic_mode() -> bool:
-    return not _static_mode
-
-
-def disable_static():
-    global _static_mode
-    _static_mode = False
-
-
-def enable_static():
-    global _static_mode
-    _static_mode = True
+try:
+    from .hapi import Model, summary, flops  # noqa: F401,E402
+    from .hapi import callbacks  # noqa: F401,E402
+except ImportError:
+    pass
+from .static.program import enable_static, disable_static, in_dynamic_mode  # noqa: F401,E402

@@ -51,6 +51,7 @@ class _GradState(threading.local):
 
 _state = _GradState()
 _static_prog_mod = None  # lazy ref to paddle_tpu.static.program (capture hook)
+_profiler_mod = None  # lazy ref to paddle_tpu.profiler (host event hook)
 
 
 def is_grad_enabled() -> bool:
@@ -155,19 +156,9 @@ def _check_nan_inf(name, vals):
 
 
 def apply(name, fn, *args, n_outputs=None, **kwargs):
-    """Execute op `fn` over Tensor/raw args, recording a grad node if needed.
-
-    fn receives raw jax values positionally (same order as args) and must
-    return a jax value or a tuple/list of them.  kwargs are static.
-    Non-Tensor args and stop_gradient Tensors are closed over (not
-    differentiated).  Integer/bool outputs never require grad.
-
-    Inside a static program_guard this funnel records an Operator instead of
-    executing — the whole op surface is static-capturable for free (the
-    reference gets the same dual-mode from its YAML codegen emitting both
-    dygraph ad_funcs and PIR ops).
-    """
-    global _static_prog_mod
+    """Profiler/static-capture wrapper around the eager funnel; see
+    _apply_impl for the semantics."""
+    global _static_prog_mod, _profiler_mod
     if _static_prog_mod is None:
         try:
             from paddle_tpu.static import program as _spm
@@ -178,6 +169,32 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
     if _static_prog_mod and _static_prog_mod.in_static_capture():
         return _static_prog_mod.current_main_program().record(name, fn, args, kwargs)
 
+    if _profiler_mod is None:
+        try:
+            from paddle_tpu import profiler as _pm
+
+            _profiler_mod = _pm
+        except ImportError:
+            _profiler_mod = False
+    if _profiler_mod and _profiler_mod._active_profiler is not None:
+        with _profiler_mod.RecordEvent(f"op::{name}"):
+            return _apply_impl(name, fn, *args, n_outputs=n_outputs, **kwargs)
+    return _apply_impl(name, fn, *args, n_outputs=n_outputs, **kwargs)
+
+
+def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
+    """Execute op `fn` over Tensor/raw args, recording a grad node if needed.
+
+    fn receives raw jax values positionally (same order as args) and must
+    return a jax value or a tuple/list of them.  kwargs are static.
+    Non-Tensor args and stop_gradient Tensors are closed over (not
+    differentiated).  Integer/bool outputs never require grad.
+
+    Inside a static program_guard the `apply` wrapper records an Operator
+    instead of executing — the whole op surface is static-capturable for free
+    (the reference gets the same dual-mode from its YAML codegen emitting
+    both dygraph ad_funcs and PIR ops).
+    """
     args = _maybe_amp_cast(name, args)
     tensors = [a for a in args if isinstance(a, Tensor)]
     needs_grad = _state.enabled and any(not t.stop_gradient for t in tensors)

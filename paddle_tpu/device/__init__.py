@@ -146,3 +146,37 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+
+# ------------------------------------------------------------- memory stats
+# Reference: paddle/fluid/memory/stats.h peak trackers surfaced as
+# paddle.device.cuda.max_memory_allocated etc.  TPU-native: PJRT device
+# memory_stats plus live-buffer accounting.
+
+def memory_stats(device=None):
+    d = jax.devices()[0] if device is None else device
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    st = memory_stats(device)
+    if "bytes_in_use" in st:
+        return int(st["bytes_in_use"])
+    return int(sum(v.nbytes for v in jax.live_arrays()))
+
+
+def max_memory_allocated(device=None):
+    st = memory_stats(device)
+    return int(st.get("peak_bytes_in_use", memory_allocated(device)))
+
+
+def max_memory_reserved(device=None):
+    st = memory_stats(device)
+    return int(st.get("bytes_reserved", st.get("bytes_limit", 0)))
+
+
+def empty_cache():
+    pass  # XLA/PJRT owns the arena; freeing is GC-driven

@@ -48,6 +48,8 @@ from .communication import (  # noqa: F401
     wait,
 )
 from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import utils  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,

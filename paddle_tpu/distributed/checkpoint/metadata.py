@@ -1,0 +1,55 @@
+"""Checkpoint metadata (reference:
+python/paddle/distributed/checkpoint/metadata.py — LocalTensorMetadata/
+LocalTensorIndex/Metadata keyed by (tensor_name, global_offset)).
+
+The global metadata maps every saved shard of every tensor to
+(file, key, global_offset, local_shape) so a loader under ANY topology can
+assemble exactly the regions it needs.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, asdict
+
+
+@dataclass
+class ShardRecord:
+    file: str  # npz file (relative to checkpoint dir)
+    key: str  # array key inside the npz
+    global_offset: list  # start index per dim
+    local_shape: list  # shard shape
+
+
+@dataclass
+class TensorMetadata:
+    name: str
+    global_shape: list
+    dtype: str
+    shards: list = field(default_factory=list)  # list[ShardRecord]
+
+
+@dataclass
+class Metadata:
+    tensors: dict = field(default_factory=dict)  # name -> TensorMetadata
+    flat_mapping: dict = field(default_factory=dict)  # state_dict key path info
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "tensors": {k: asdict(v) for k, v in self.tensors.items()},
+                "flat_mapping": self.flat_mapping,
+            },
+            indent=1,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Metadata":
+        raw = json.loads(text)
+        md = cls()
+        md.flat_mapping = raw.get("flat_mapping", {})
+        for k, tv in raw["tensors"].items():
+            tm = TensorMetadata(tv["name"], tv["global_shape"], tv["dtype"])
+            tm.shards = [ShardRecord(**s) for s in tv["shards"]]
+            md.tensors[k] = tm
+        return md

@@ -1,0 +1,6 @@
+"""paddle.hapi: high-level Model API (reference python/paddle/hapi/)."""
+
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
+from . import callbacks  # noqa: F401

@@ -1,0 +1,79 @@
+"""paddle.flops (reference python/paddle/hapi/dynamic_flops.py): FLOPs
+estimate per layer via forward hooks."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _shape(x):
+    return list(x.shape) if isinstance(x, Tensor) else None
+
+
+def _count(layer, inputs, output):
+    x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+    out = output[0] if isinstance(output, (tuple, list)) else output
+    ish, osh = _shape(x), _shape(out)
+    if isinstance(layer, nn.Linear):
+        return int(np.prod(osh)) * layer.weight.shape[0] * 2
+    name = type(layer).__name__
+    if name.startswith("Conv"):
+        w = layer.weight
+        kernel = int(np.prod(w.shape[1:]))
+        return int(np.prod(osh)) * kernel * 2
+    if "Norm" in name:
+        return int(np.prod(ish or [0])) * 7
+    if name in ("ReLU", "GELU", "Sigmoid", "Tanh", "Softmax", "SiLU"):
+        return int(np.prod(ish or [0]))
+    if "Pool" in name:
+        return int(np.prod(osh or [0]))
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    total = [0]
+    rows = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def register(layer, prefix=""):
+        subs = dict(layer.named_children()) if hasattr(layer, "named_children") else {}
+        if not subs:
+            def hook(l, inputs, output, prefix=prefix):
+                counter = custom_ops.get(type(l))
+                n = counter(l, inputs, output) if counter else _count(l, inputs, output)
+                total[0] += n
+                rows.append((prefix or type(l).__name__, n))
+
+            hooks.append(layer.register_forward_post_hook(hook))
+        for name, sub in subs.items():
+            register(sub, f"{prefix}.{name}" if prefix else name)
+
+    register(net)
+    try:
+        x = paddle.zeros(list(input_size))
+        from paddle_tpu._core.autograd import no_grad
+
+        was_training = net.training
+        net.eval()
+        try:
+            with no_grad():
+                net(x)
+        finally:
+            if was_training:
+                net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+
+    if print_detail:
+        for name, n in rows:
+            print(f"{name:<50}{n:>16,}")
+    print(f"Total GFLOPs: {total[0] / 1e9:.4f}")
+    return total[0]

@@ -1,0 +1,194 @@
+"""paddle.Model — high-level train/eval/predict loop.
+
+Reference: python/paddle/hapi/model.py:1054 fit, :1756 evaluate/predict.
+
+TPU-native: train_batch compiles the whole imperative step (forward +
+backward + optimizer) into one donated-state XLA program via jit.TrainStep;
+eval/predict run a jitted forward.  Metrics update on host between steps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu._core.autograd import no_grad
+
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._train_step = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        from paddle_tpu.jit import TrainStep
+
+        if optimizer is not None and loss is not None:
+            def loss_fn(net, *batch):
+                *xs, y = batch
+                out = net(*xs)
+                return self._loss(out, y)
+
+            self._train_step = TrainStep(self.network, optimizer, loss_fn)
+        return self
+
+    # ---------------------------------------------------------- single step
+    def train_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        batch = [paddle.to_tensor(np.asarray(x)) for x in inputs + labels]
+        loss = self._train_step(*batch)
+        return [float(loss.item())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        out = self.network(*[paddle.to_tensor(np.asarray(x)) for x in inputs])
+        loss = None
+        if self._loss is not None and labels:
+            loss = float(self._loss(out, paddle.to_tensor(np.asarray(labels[0]))).item())
+        self.network.train()
+        return loss, out
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self.network(*[paddle.to_tensor(np.asarray(x)) for x in _to_list(inputs)])
+        self.network.train()
+        return out
+
+    # ------------------------------------------------------------ main loop
+    def _loader(self, data, batch_size, shuffle, drop_last=False):
+        from paddle_tpu.io import DataLoader
+
+        if data is None:
+            return None
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
+            shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        assert self._train_step is not None, "call prepare(optimizer, loss) first"
+        loader = self._loader(train_data, batch_size, shuffle, drop_last)
+        eval_loader = self._loader(eval_data, batch_size, False)
+
+        cbks = CallbackList(
+            (callbacks or []) + ([ProgBarLogger(log_freq, verbose)] if verbose else []),
+            model=self,
+            params={"epochs": epochs, "steps": len(loader) if hasattr(loader, "__len__") else None},
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = {"loss": []}
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            epoch_losses = []
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                xs, ys = batch[:-1], batch[-1:]
+                (lv,) = self.train_batch(xs, ys)
+                epoch_losses.append(lv)
+                cbks.on_train_batch_end(step, {"loss": lv})
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            logs = {"loss": float(np.mean(epoch_losses))} if epoch_losses else {}
+            history["loss"].append(logs.get("loss"))
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size=batch_size, verbose=0, callbacks=cbks)
+                logs.update(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None, num_samples=None):
+        loader = self._loader(eval_data, batch_size, False)
+        cbks = callbacks if isinstance(callbacks, CallbackList) else CallbackList(_to_list(callbacks), model=self)
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            xs, ys = batch[:-1], batch[-1:]
+            loss, out = self.eval_batch(xs, ys)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                label = paddle.to_tensor(np.asarray(ys[0])) if ys else None
+                m.update(*[x for x in _to_list(m.compute(out, label))])
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[f"eval_{m.name()}" if isinstance(m.name(), str) else "eval_metric"] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            if self._loss is not None and len(batch) > 1:
+                batch = batch[:-1]  # dataset yields (inputs..., label); drop label
+            out = self.predict_batch(batch)
+            outs.append(np.asarray(out._value) if isinstance(out, Tensor) else out)
+        if stack_outputs and outs:
+            return [np.concatenate(outs, axis=0)]
+        return outs
+
+    # --------------------------------------------------------------- state
+    def save(self, path, training=True):
+        state = {"model": dict(self.network.state_dict())}
+        if training and self._optimizer is not None:
+            state["opt"] = self._optimizer.state_dict()
+        paddle.save(state, path + ".pdparams")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state["model"])
+        if not reset_optimizer and self._optimizer is not None and "opt" in state:
+            self._optimizer.set_state_dict(state["opt"])
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,69 @@
+"""paddle.summary (reference python/paddle/hapi/model_summary.py): per-layer
+output shapes + parameter counts via forward hooks."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        subs = dict(layer.named_children()) if hasattr(layer, "named_children") else {}
+        if not subs:
+            def hook(l, inputs, output, prefix=prefix):
+                out = output[0] if isinstance(output, (tuple, list)) else output
+                shape = list(out.shape) if isinstance(out, Tensor) else "?"
+                n_params = int(sum(np.prod(p.shape) for p in l.parameters(include_sublayers=False)))
+                rows.append((prefix or type(l).__name__, type(l).__name__, shape, n_params))
+
+            hooks.append(layer.register_forward_post_hook(hook))
+        for name, sub in subs.items():
+            register(sub, f"{prefix}.{name}" if prefix else name)
+
+    register(net, "")
+
+    try:
+        if input is not None:
+            x = input if isinstance(input, (list, tuple)) else [input]
+        else:
+            sizes = input_size if isinstance(input_size, list) and isinstance(input_size[0], (list, tuple)) else [input_size]
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+            x = [paddle.zeros(list(s), dtype=dt or "float32") for s, dt in zip(sizes, dts)]
+        was_training = net.training
+        net.eval()
+        try:
+            from paddle_tpu._core.autograd import no_grad
+
+            with no_grad():
+                net(*x)
+        finally:
+            if was_training:
+                net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = int(sum(np.prod(p.shape) for p in net.parameters()))
+    trainable = int(sum(np.prod(p.shape) for p in net.parameters() if not p.stop_gradient))
+
+    width = 90
+    lines = ["-" * width]
+    lines.append(f"{'Layer (type)':<40}{'Output Shape':<30}{'Param #':>12}")
+    lines.append("=" * width)
+    for name, cls, shape, n in rows:
+        lines.append(f"{name + ' (' + cls + ')':<40}{str(shape):<30}{n:>12,}")
+    lines.append("=" * width)
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    lines.append("-" * width)
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

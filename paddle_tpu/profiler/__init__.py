@@ -1,0 +1,251 @@
+"""paddle.profiler parity.
+
+Reference: python/paddle/profiler/profiler.py:346 (Profiler with
+HostTracer + CudaTracer/CUPTI, chrome-trace export, statistics tables,
+schedules) over paddle/fluid/platform/profiler/.
+
+TPU-native composition:
+- **Host tracer**: RecordEvent instrumentation (used by the op funnel when a
+  profiler is active) collecting ns-resolution host spans.
+- **Device tracer**: jax.profiler start/stop_trace — XLA's XPlane/TensorBoard
+  trace IS the CUPTI analog (per-kernel device timeline compiled in by XLA).
+- Export: chrome trace JSON from host spans (device timeline lives in the
+  XPlane dump directory), `summary()` statistics table aggregated by event.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from enum import Enum
+
+import jax
+
+__all__ = [
+    "Profiler",
+    "RecordEvent",
+    "ProfilerTarget",
+    "ProfilerState",
+    "make_scheduler",
+    "export_chrome_tracing",
+    "load_profiler_result",
+]
+
+_active_profiler = None  # checked by the op funnel (cheap global)
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+@dataclass
+class _Span:
+    name: str
+    start_ns: int
+    end_ns: int
+    tid: int
+    category: str = "host"
+
+
+class _HostEventBuffer:
+    def __init__(self):
+        self.spans = []
+        self._lock = threading.Lock()
+
+    def add(self, span):
+        with self._lock:
+            self.spans.append(span)
+
+
+class RecordEvent:
+    """Host span (reference platform/profiler RecordEvent).  Also annotates
+    the XLA device trace via jax.profiler.TraceAnnotation so host spans line
+    up with device kernels in TensorBoard."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        prof = _active_profiler
+        self._t0 = time.perf_counter_ns()
+        if prof is not None:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        return self
+
+    def end(self):
+        prof = _active_profiler
+        if prof is not None and self._t0 is not None:
+            prof._buffer.add(
+                _Span(self.name, self._t0, time.perf_counter_ns(), threading.get_ident())
+            )
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0):
+    """Reference profiler.make_scheduler: step -> ProfilerState."""
+
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self.scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo, repeat=1)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._buffer = _HostEventBuffer()
+        self._step = 0
+        self._recording = False
+        self._xplane_dir = None
+        self._step_spans = []
+        self._step_t0 = None
+
+    # ---------------------------------------------------------------- state
+    def start(self):
+        global _active_profiler
+        if self.scheduler is not None:
+            state = self.scheduler(0)
+            _active_profiler = (
+                self if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) else None
+            )
+        else:
+            _active_profiler = self
+        self._recording = True
+        if not self.timer_only and ProfilerTarget.TPU in self.targets:
+            self._xplane_dir = os.path.abspath("profiler_log/xplane")
+            os.makedirs(self._xplane_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._xplane_dir)
+            except Exception:
+                self._xplane_dir = None
+        self._step_t0 = time.perf_counter_ns()
+        return self
+
+    def stop(self):
+        global _active_profiler
+        if self._xplane_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._xplane_dir = None
+        self._recording = False
+        _active_profiler = None
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter_ns()
+        if self._step_t0 is not None:
+            self._step_spans.append((self._step, now - self._step_t0))
+        self._step_t0 = now
+        self._step += 1
+        if self.scheduler is not None:
+            state = self.scheduler(self._step)
+            global _active_profiler
+            if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                _active_profiler = self
+            else:
+                _active_profiler = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # --------------------------------------------------------------- export
+    def export_chrome_tracing(self, path, *args):
+        export_chrome_tracing(self, path)
+
+    def export(self, path, format="json"):
+        export_chrome_tracing(self, path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        agg = {}
+        for s in self._buffer.spans:
+            tot, cnt = agg.get(s.name, (0, 0))
+            agg[s.name] = (tot + (s.end_ns - s.start_ns), cnt + 1)
+        width = 78
+        lines = ["-" * width, f"{'Event':<40}{'Calls':>8}{'Total(ms)':>14}{'Avg(us)':>14}", "=" * width]
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name[:39]:<40}{cnt:>8}{tot / 1e6:>14.3f}{tot / cnt / 1e3:>14.1f}")
+        if self._step_spans:
+            tot = sum(d for _, d in self._step_spans)
+            lines.append("=" * width)
+            lines.append(
+                f"steps: {len(self._step_spans)}  avg step: {tot / len(self._step_spans) / 1e6:.3f} ms"
+            )
+        lines.append("-" * width)
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+def export_chrome_tracing(profiler: Profiler, path: str):
+    events = []
+    for s in profiler._buffer.spans:
+        events.append(
+            {
+                "name": s.name,
+                "cat": s.category,
+                "ph": "X",
+                "ts": s.start_ns / 1e3,
+                "dur": (s.end_ns - s.start_ns) / 1e3,
+                "pid": 0,
+                "tid": s.tid % 10_000,
+            }
+        )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

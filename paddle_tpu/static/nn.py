@@ -28,7 +28,8 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation
         in_dim *= int(d)
     w = _make_param([in_dim, size], "float32", I.XavierNormal())
     b = _make_param([size], "float32", I.Constant(0.0))
-    x2 = paddle.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    # -1 for the leading (batch) dim so dynamic feed shapes replay correctly
+    x2 = paddle.reshape(x, [-1] + list(x.shape[1:num_flatten_dims]) + [in_dim])
     out = paddle.matmul(x2, w) + b
     if activation == "relu":
         out = F.relu(out)

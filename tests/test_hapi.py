@@ -1,0 +1,92 @@
+"""hapi Model.fit/evaluate/predict + summary + flops (reference
+python/paddle/hapi/model.py:1054)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _XorDataset(Dataset):
+    def __init__(self, n=128):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, 8)).astype(np.float32)
+        w = rng.standard_normal((8, 1)).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64).reshape(-1)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _net():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+def test_model_fit_loss_decreases(capsys):
+    paddle.seed(0)
+    model = paddle.Model(_net())
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), metrics=Accuracy())
+    ds = _XorDataset()
+    history = model.fit(ds, ds, batch_size=32, epochs=3, verbose=0)
+    assert history["loss"][-1] < history["loss"][0]
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert logs["eval_acc"] > 0.8
+
+
+def test_model_predict_and_save_load(tmp_path):
+    paddle.seed(1)
+    model = paddle.Model(_net())
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = _XorDataset(32)
+    outs = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert outs[0].shape == (32, 2)
+
+    path = str(tmp_path / "m")
+    model.save(path)
+    model2 = paddle.Model(_net())
+    opt2 = paddle.optimizer.SGD(learning_rate=0.01, parameters=model2.parameters())
+    model2.prepare(opt2, nn.CrossEntropyLoss())
+    model2.load(path)
+    o1 = model.predict(ds, batch_size=16, stack_outputs=True)[0]
+    o2 = model2.predict(ds, batch_size=16, stack_outputs=True)[0]
+    np.testing.assert_allclose(o1, o2, atol=1e-6)
+
+
+def test_early_stopping_fires():
+    paddle.seed(2)
+    model = paddle.Model(_net())
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = _XorDataset(32)
+    es = paddle.callbacks.EarlyStopping(monitor="eval_loss", patience=1)
+    model.fit(ds, ds, batch_size=16, epochs=10, verbose=0, callbacks=[es])
+    assert model.stop_training
+
+
+def test_summary_and_flops(capsys):
+    net = _net()
+    info = paddle.summary(net, (4, 8))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+
+    n = paddle.flops(net, [4, 8])
+    # two matmuls dominate: 4*32*8*2 + 4*2*32*2
+    assert n >= 4 * 32 * 8 * 2
+
+
+def test_predict_keeps_ragged_tail():
+    paddle.seed(4)
+    model = paddle.Model(_net())
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = _XorDataset(33)
+    outs = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert outs[0].shape == (33, 2)

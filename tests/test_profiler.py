@@ -1,0 +1,53 @@
+"""Profiler + memory stats (reference python/paddle/profiler/)."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+
+
+def test_profiler_records_ops_and_exports(tmp_path):
+    p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    with p:
+        x = paddle.ones([8, 8])
+        for _ in range(3):
+            x = paddle.matmul(x, x) * 0.5
+        with profiler.RecordEvent("my_region"):
+            _ = paddle.sum(x)
+    spans = p._buffer.spans
+    names = {s.name for s in spans}
+    assert "op::matmul" in names and "my_region" in names
+
+    path = str(tmp_path / "trace.json")
+    p.export_chrome_tracing(path)
+    data = json.load(open(path))
+    assert len(data["traceEvents"]) >= 4
+    table = p.summary()
+    assert "op::matmul" in table
+
+
+def test_scheduler_states():
+    sch = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sch(i) for i in range(4)]
+    assert states[0] == profiler.ProfilerState.CLOSED
+    assert states[1] == profiler.ProfilerState.READY
+    assert states[2] == profiler.ProfilerState.RECORD
+    assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+
+
+def test_profiler_inactive_has_no_overhead_hook():
+    x = paddle.ones([4])
+    y = paddle.exp(x)  # no profiler active: no spans recorded anywhere
+    assert profiler._active_profiler is None
+
+
+def test_memory_stats():
+    import paddle_tpu.device as device
+
+    x = paddle.ones([1024, 1024])
+    allocated = device.memory_allocated()
+    assert allocated > 0
+    assert device.max_memory_allocated() >= 0
