@@ -1,0 +1,100 @@
+// Host event recorder — low-overhead profiler spans.
+//
+// Capability parity with the reference's HostEventRecorder
+// (paddle/fluid/platform/profiler/host_event_recorder.h: thread-local
+// chunked event buffers merged at collection).  One lock-free-per-thread
+// design is overkill for the Python-driven funnel, so this keeps a
+// mutex-guarded growable buffer of {name_id, start_ns, end_ns, tid} with an
+// interned name table; ~100ns per record vs ~1us for the Python path.
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint32_t name_id;
+  uint64_t start_ns;
+  uint64_t end_ns;
+  uint64_t tid;
+};
+
+struct Recorder {
+  std::mutex mu;
+  std::vector<Event> events;
+  std::vector<std::string> names;
+  std::map<std::string, uint32_t> name_ids;
+};
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000ULL + ts.tv_nsec;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* phe_create() { return new Recorder(); }
+
+void phe_destroy(void* h) { delete static_cast<Recorder*>(h); }
+
+uint64_t phe_now_ns() { return now_ns(); }
+
+uint32_t phe_intern(void* h, const char* name) {
+  auto* r = static_cast<Recorder*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->name_ids.find(name);
+  if (it != r->name_ids.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(r->names.size());
+  r->names.emplace_back(name);
+  r->name_ids[name] = id;
+  return id;
+}
+
+void phe_record(void* h, uint32_t name_id, uint64_t start_ns, uint64_t end_ns, uint64_t tid) {
+  auto* r = static_cast<Recorder*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  r->events.push_back({name_id, start_ns, end_ns, tid});
+}
+
+uint64_t phe_count(void* h) {
+  auto* r = static_cast<Recorder*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  return r->events.size();
+}
+
+// dump into caller arrays (each of length >= count); returns copied count
+uint64_t phe_dump(void* h, uint32_t* name_ids, uint64_t* starts, uint64_t* ends,
+                  uint64_t* tids, uint64_t cap, int clear) {
+  auto* r = static_cast<Recorder*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  uint64_t n = r->events.size() < cap ? r->events.size() : cap;
+  for (uint64_t i = 0; i < n; ++i) {
+    name_ids[i] = r->events[i].name_id;
+    starts[i] = r->events[i].start_ns;
+    ends[i] = r->events[i].end_ns;
+    tids[i] = r->events[i].tid;
+  }
+  if (clear) r->events.clear();
+  return n;
+}
+
+// name table lookup: copies name `id` into buf, returns its length
+uint32_t phe_name(void* h, uint32_t id, char* buf, uint32_t cap) {
+  auto* r = static_cast<Recorder*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  if (id >= r->names.size()) return 0;
+  const std::string& s = r->names[id];
+  uint32_t n = static_cast<uint32_t>(s.size()) < cap ? s.size() : cap;
+  memcpy(buf, s.data(), n);
+  return static_cast<uint32_t>(s.size());
+}
+
+}  // extern "C"

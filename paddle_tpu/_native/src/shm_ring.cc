@@ -1,0 +1,249 @@
+// Shared-memory ring buffer — DataLoader worker transport.
+//
+// Capability parity with the reference's shared-memory dataloader queues
+// (python/paddle/io/dataloader/dataloader_iter.py multi-process workers +
+// paddle/fluid/memory shared storage): worker processes push serialized
+// sample batches into a POSIX shm ring; the trainer process pops them
+// without a pickle-through-pipe round trip.  Process-shared pthread
+// mutex/condvars in the shm header give blocking push/pop with backpressure.
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;   // data bytes
+  uint64_t head;       // read offset
+  uint64_t tail;       // write offset
+  uint64_t used;       // bytes used
+  uint32_t n_items;
+  uint32_t closed;
+  uint32_t poisoned;
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  uint64_t cap;
+  std::string name;
+  bool owner;
+};
+
+// item framing: u64 length then payload (wrapping)
+void ring_write(Ring* r, const uint8_t* src, uint64_t n) {
+  uint64_t tail = r->hdr->tail;
+  uint64_t first = std::min(n, r->cap - tail);
+  memcpy(r->data + tail, src, first);
+  if (n > first) memcpy(r->data, src + first, n - first);
+  r->hdr->tail = (tail + n) % r->cap;
+  r->hdr->used += n;
+}
+
+void ring_read(Ring* r, uint8_t* dst, uint64_t n) {
+  uint64_t head = r->hdr->head;
+  uint64_t first = std::min(n, r->cap - head);
+  memcpy(dst, r->data + head, first);
+  if (n > first) memcpy(dst + first, r->data, n - first);
+  r->hdr->head = (head + n) % r->cap;
+  r->hdr->used -= n;
+}
+
+// read the next item's length header without advancing head
+uint64_t ring_peek_len(Ring* r) {
+  uint64_t head = r->hdr->head;
+  uint8_t buf[8];
+  uint64_t first = std::min<uint64_t>(8, r->cap - head);
+  memcpy(buf, r->data + head, first);
+  if (8 > first) memcpy(buf + first, r->data, 8 - first);
+  uint64_t len;
+  memcpy(&len, buf, 8);
+  return len;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptr_ring_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, total) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<Header*>(mem);
+  memset(hdr, 0, sizeof(Header));
+  hdr->capacity = capacity;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+
+  auto* r = new Ring{hdr, reinterpret_cast<uint8_t*>(hdr + 1), capacity, name, true};
+  return r;
+}
+
+void* ptr_ring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  fstat(fd, &st);
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<Header*>(mem);
+  auto* r = new Ring{hdr, reinterpret_cast<uint8_t*>(hdr + 1), hdr->capacity, name, false};
+  return r;
+}
+
+static int lock_robust(Header* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock: ring state
+    // (head/tail/used/n_items) may be mid-update and the item framing
+    // unrecoverable — poison by closing so both sides fail loudly instead
+    // of reading garbage lengths
+    pthread_mutex_consistent(&hdr->mu);
+    hdr->closed = 1;
+    hdr->poisoned = 1;
+    pthread_cond_broadcast(&hdr->not_empty);
+    pthread_cond_broadcast(&hdr->not_full);
+    return 0;
+  }
+  return rc;
+}
+
+// returns 0 ok, -1 closed, -2 timeout, -3 item larger than capacity, -5 poisoned
+int ptr_ring_push(void* h, const uint8_t* data, uint64_t len, int timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  Header* hdr = r->hdr;
+  uint64_t need = len + 8;
+  if (need > r->cap) return -3;
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) { ts.tv_sec++; ts.tv_nsec -= 1000000000L; }
+  if (lock_robust(hdr) != 0) return -1;
+  if (hdr->poisoned) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -5;
+  }
+  while (hdr->capacity - hdr->used < need && !hdr->closed) {
+    if (timeout_ms >= 0) {
+      if (pthread_cond_timedwait(&hdr->not_full, &hdr->mu, &ts) == ETIMEDOUT) {
+        pthread_mutex_unlock(&hdr->mu);
+        return -2;
+      }
+    } else {
+      pthread_cond_wait(&hdr->not_full, &hdr->mu);
+    }
+  }
+  if (hdr->closed) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -1;
+  }
+  uint64_t len64 = len;
+  ring_write(r, reinterpret_cast<uint8_t*>(&len64), 8);
+  ring_write(r, data, len);
+  hdr->n_items++;
+  pthread_cond_signal(&hdr->not_empty);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+// returns item length, 0 if none & closed, -2 timeout, -4 cap too small, -5 poisoned
+int64_t ptr_ring_pop(void* h, uint8_t* out, uint64_t cap, int timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  Header* hdr = r->hdr;
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) { ts.tv_sec++; ts.tv_nsec -= 1000000000L; }
+  if (lock_robust(hdr) != 0) return 0;
+  if (hdr->poisoned) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -5;
+  }
+  while (hdr->n_items == 0) {
+    if (hdr->closed) {
+      pthread_mutex_unlock(&hdr->mu);
+      return 0;
+    }
+    if (timeout_ms >= 0) {
+      if (pthread_cond_timedwait(&hdr->not_empty, &hdr->mu, &ts) == ETIMEDOUT) {
+        pthread_mutex_unlock(&hdr->mu);
+        return -2;
+      }
+    } else {
+      pthread_cond_wait(&hdr->not_empty, &hdr->mu);
+    }
+  }
+  uint64_t len = ring_peek_len(r);
+  if (len > cap) {  // caller buffer too small: header NOT consumed, caller
+    pthread_mutex_unlock(&hdr->mu);  // re-queries next_size and retries
+    return -4;
+  }
+  uint64_t skip;
+  ring_read(r, reinterpret_cast<uint8_t*>(&skip), 8);
+  ring_read(r, out, len);
+  hdr->n_items--;
+  pthread_cond_signal(&hdr->not_full);
+  pthread_mutex_unlock(&hdr->mu);
+  return static_cast<int64_t>(len);
+}
+
+// peek next item's size (0 if empty)
+uint64_t ptr_ring_next_size(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  if (lock_robust(r->hdr) != 0) return 0;
+  uint64_t len = 0;
+  if (r->hdr->n_items > 0) len = ring_peek_len(r);
+  pthread_mutex_unlock(&r->hdr->mu);
+  return len;
+}
+
+void ptr_ring_close(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  lock_robust(r->hdr);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void ptr_ring_destroy(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  uint64_t total = sizeof(Header) + r->cap;
+  bool owner = r->owner;
+  std::string name = r->name;
+  munmap(r->hdr, total);
+  if (owner) shm_unlink(name.c_str());
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,309 @@
+// TCPStore — rendezvous key-value store.
+//
+// Capability parity with the reference's bootstrap store
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp):
+// rank0 hosts a tiny TCP server; all ranks SET/GET/ADD/WAIT keys to
+// exchange addresses and barrier before collective init.  Redesigned (not
+// translated): single poll()-driven server thread, length-prefixed binary
+// protocol, blocking GET with deadline implemented server-side via deferred
+// replies (no client polling).
+//
+// C ABI (ctypes): pts_store_* functions at the bottom.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+#include <algorithm>
+#include <atomic>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDelete = 5 };
+
+struct Pending {  // a blocked GET/WAIT
+  int fd;
+  std::string key;
+  int64_t deadline_ms;
+};
+
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool send_blob(int fd, const std::string& v) {
+  uint32_t len = static_cast<uint32_t>(v.size());
+  return send_all(fd, &len, 4) && (len == 0 || send_all(fd, v.data(), len));
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread thr;
+  std::atomic<bool> stop{false};
+  std::map<std::string, std::string> kv;
+  std::vector<Pending> pending;
+  std::mutex mu;
+
+  void flush_pending() {
+    int64_t now = now_ms();
+    for (auto it = pending.begin(); it != pending.end();) {
+      auto kvit = kv.find(it->key);
+      if (kvit != kv.end()) {
+        send_blob(it->fd, kvit->second);
+        it = pending.erase(it);
+      } else if (it->deadline_ms > 0 && now > it->deadline_ms) {
+        uint32_t timeout_marker = 0xFFFFFFFFu;
+        send_all(it->fd, &timeout_marker, 4);
+        it = pending.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  // one request per poll wakeup per client; clients are ranks (few dozens)
+  bool handle(int fd) {
+    uint8_t cmd;
+    if (!recv_all(fd, &cmd, 1)) return false;
+    uint32_t klen;
+    if (!recv_all(fd, &klen, 4) || klen > 1 << 20) return false;
+    std::string key(klen, 0);
+    if (klen && !recv_all(fd, &key[0], klen)) return false;
+
+    switch (cmd) {
+      case kSet: {
+        uint32_t vlen;
+        if (!recv_all(fd, &vlen, 4) || vlen > 1u << 30) return false;
+        std::string val(vlen, 0);
+        if (vlen && !recv_all(fd, &val[0], vlen)) return false;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = std::move(val);
+        }
+        uint8_t ok = 1;
+        return send_all(fd, &ok, 1);
+      }
+      case kGet: {
+        int64_t timeout_ms;
+        if (!recv_all(fd, &timeout_ms, 8)) return false;
+        std::lock_guard<std::mutex> g(mu);
+        auto it = kv.find(key);
+        if (it != kv.end()) return send_blob(fd, it->second);
+        pending.push_back({fd, key, timeout_ms > 0 ? now_ms() + timeout_ms : 0});
+        return true;
+      }
+      case kAdd: {
+        int64_t delta;
+        if (!recv_all(fd, &delta, 8)) return false;
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string v(8, 0);
+          memcpy(&v[0], &cur, 8);
+          kv[key] = v;
+        }
+        return send_all(fd, &cur, 8);
+      }
+      case kDelete: {
+        std::lock_guard<std::mutex> g(mu);
+        kv.erase(key);
+        uint8_t ok = 1;
+        return send_all(fd, &ok, 1);
+      }
+      default:
+        return false;
+    }
+  }
+
+  void run() {
+    std::vector<int> clients;
+    while (!stop) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd, POLLIN, 0});
+      for (int c : clients) fds.push_back({c, POLLIN, 0});
+      int rc = ::poll(fds.data(), fds.size(), 50);
+      if (rc < 0) continue;
+      if (fds[0].revents & POLLIN) {
+        int c = ::accept(listen_fd, nullptr, nullptr);
+        if (c >= 0) {
+          int one = 1;
+          setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          clients.push_back(c);
+        }
+      }
+      for (size_t i = 1; i < fds.size(); ++i) {
+        if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+          if (!handle(fds[i].fd)) {
+            // purge pending GETs for this fd before the number can be reused
+            // by a future accept(), else the deferred reply would be written
+            // into an unrelated client's stream
+            {
+              std::lock_guard<std::mutex> g(mu);
+              int dead = fds[i].fd;
+              pending.erase(
+                  std::remove_if(pending.begin(), pending.end(),
+                                 [dead](const Pending& p) { return p.fd == dead; }),
+                  pending.end());
+            }
+            ::close(fds[i].fd);
+            clients.erase(std::find(clients.begin(), clients.end(), fds[i].fd));
+          }
+        }
+      }
+      std::lock_guard<std::mutex> g(mu);
+      flush_pending();
+    }
+    for (int c : clients) ::close(c);
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_start(int port, int* out_port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(port);
+  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &len);
+  s->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = s->port;
+  s->thr = std::thread([s] { s->run(); });
+  return s;
+}
+
+void pts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop = true;
+  s->thr.join();
+  ::close(s->listen_fd);
+  delete s;
+}
+
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  int64_t deadline = now_ms() + timeout_ms;
+  while (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    if (now_ms() > deadline) {
+      ::close(fd);
+      return nullptr;
+    }
+    usleep(50 * 1000);
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void pts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+int pts_set(void* h, const char* key, const uint8_t* val, uint32_t len) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = kSet;
+  uint32_t klen = strlen(key);
+  if (!send_all(c->fd, &cmd, 1) || !send_all(c->fd, &klen, 4) ||
+      !send_all(c->fd, key, klen) || !send_all(c->fd, &len, 4) ||
+      (len && !send_all(c->fd, val, len)))
+    return -1;
+  uint8_t ok;
+  return recv_all(c->fd, &ok, 1) ? 0 : -1;
+}
+
+// returns value length, or -1 on error, -2 on timeout. caller passes cap.
+int64_t pts_get(void* h, const char* key, uint8_t* out, uint32_t cap, int64_t timeout_ms) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = kGet;
+  uint32_t klen = strlen(key);
+  if (!send_all(c->fd, &cmd, 1) || !send_all(c->fd, &klen, 4) ||
+      !send_all(c->fd, key, klen) || !send_all(c->fd, &timeout_ms, 8))
+    return -1;
+  uint32_t vlen;
+  if (!recv_all(c->fd, &vlen, 4)) return -1;
+  if (vlen == 0xFFFFFFFFu) return -2;
+  std::vector<uint8_t> tmp(vlen);
+  if (vlen && !recv_all(c->fd, tmp.data(), vlen)) return -1;
+  memcpy(out, tmp.data(), vlen < cap ? vlen : cap);
+  return vlen;
+}
+
+int64_t pts_add(void* h, const char* key, int64_t delta) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = kAdd;
+  uint32_t klen = strlen(key);
+  if (!send_all(c->fd, &cmd, 1) || !send_all(c->fd, &klen, 4) ||
+      !send_all(c->fd, key, klen) || !send_all(c->fd, &delta, 8))
+    return INT64_MIN;
+  int64_t v;
+  return recv_all(c->fd, &v, 8) ? v : INT64_MIN;
+}
+
+}  // extern "C"

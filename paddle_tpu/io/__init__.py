@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import bisect
 import itertools
+import os
 import math
 import queue
 import threading
@@ -326,7 +327,7 @@ class DataLoader:
         num_workers=0,
         use_buffer_reader=True,
         prefetch_factor=2,
-        use_shared_memory=True,
+        use_shared_memory=None,
         timeout=0,
         worker_init_fn=None,
         persistent_workers=False,
@@ -334,6 +335,12 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        # use_shared_memory=True explicitly OPTS IN to fork()-based workers
+        # over the native shm ring (reference default is shared memory; here
+        # the default None/False keeps the fork-free thread path because
+        # forking a JAX-initialized multithreaded parent is only safe when
+        # dataset code stays out of the runtime — caller's judgement)
+        self._use_shared_memory = bool(use_shared_memory)
         self.prefetch_factor = prefetch_factor
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -364,6 +371,12 @@ class DataLoader:
                 yield self.collate_fn(batch)
             return
         if self.num_workers > 0:
+            if self._use_shared_memory and hasattr(os, "fork"):
+                from paddle_tpu import _native  # lazy: builds the .so on first use
+
+                if _native.AVAILABLE:
+                    yield from self._iter_mp_shm()
+                    return
             # thread-pool fetch + bounded prefetch queue
             pool = ThreadPoolExecutor(max_workers=self.num_workers)
             try:
@@ -384,6 +397,120 @@ class DataLoader:
         else:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _iter_mp_shm(self):
+        """True multi-process workers over the native shared-memory ring
+        (reference: python/paddle/io/dataloader/dataloader_iter.py fork
+        workers + shared-memory queues; ring in paddle_tpu/_native/shm_ring.cc).
+
+        Workers fork and fetch raw samples for their strided subset of
+        batches, pushing pickled (batch_index, samples) items; the parent
+        pops, reorders, runs collate_fn, and yields in sampler order.
+        collate_fn runs in the PARENT so forked children never touch the
+        JAX/XLA runtime (fork after XLA thread init is not safe); dataset
+        __getitem__ must likewise be fork-safe (numpy/PIL/IO — same caveat
+        as the reference's fork-mode workers).  A shared consumed-counter
+        paces workers to a bounded read-ahead window so the parent's reorder
+        buffer cannot grow past ~nw * (prefetch_factor + 1) batches."""
+        import mmap
+        import pickle
+        import struct
+        import time
+        import traceback
+        import uuid
+
+        from paddle_tpu import _native
+
+        batches = list(self.batch_sampler)
+        n = len(batches)
+        if n == 0:
+            return
+        nw = min(self.num_workers, n)
+        window = nw * (self.prefetch_factor + 1)
+        ring_name = f"/pt_dl_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        ring = _native.ShmRing(ring_name, 128 << 20)
+        # anonymous shared page: [0:8] = number of batches consumed by parent
+        consumed = mmap.mmap(-1, 8)
+        consumed[0:8] = struct.pack("Q", 0)
+        pids = []
+        try:
+            for wid in range(nw):
+                pid = os.fork()
+                if pid == 0:  # worker
+                    try:
+                        wring = _native.ShmRing(ring_name, create=False)
+                        for k in range(wid, n, nw):
+                            # pace: never run more than `window` batches ahead
+                            while k - struct.unpack("Q", consumed[0:8])[0] >= window:
+                                time.sleep(0.002)
+                            samples = [self.dataset[i] for i in batches[k]]
+                            payload = pickle.dumps((k, samples), protocol=pickle.HIGHEST_PROTOCOL)
+                            wring.push(payload, timeout_ms=60_000)
+                    except BaseException:
+                        try:
+                            err = pickle.dumps((-1, (wid, traceback.format_exc())))
+                            wring.push(err, timeout_ms=1000)
+                        except BaseException:
+                            pass
+                        os._exit(1)
+                    os._exit(0)
+                pids.append(pid)
+
+            live = set(pids)
+            holdback = {}
+            next_k = 0
+            while next_k < n:
+                if next_k in holdback:
+                    yield self.collate_fn(holdback.pop(next_k))
+                    next_k += 1
+                    consumed[0:8] = struct.pack("Q", next_k)
+                    continue
+                try:
+                    payload = ring.pop(timeout_ms=1000)
+                except TimeoutError:
+                    # reap exited workers (each at most once) to detect failures
+                    for pid in list(live):
+                        done, status = os.waitpid(pid, os.WNOHANG)
+                        if done:
+                            live.discard(pid)
+                            if os.waitstatus_to_exitcode(status) != 0:
+                                raise RuntimeError(
+                                    "DataLoader worker died without reporting "
+                                    "an exception"
+                                ) from None
+                    if not live:
+                        raise RuntimeError(
+                            f"DataLoader workers exited but only {next_k}/{n} "
+                            "batches arrived"
+                        ) from None
+                    continue
+                if payload is None:
+                    raise RuntimeError("DataLoader ring closed early")
+                k, samples = pickle.loads(payload)
+                if k == -1:
+                    wid, tb = samples
+                    raise RuntimeError(
+                        f"DataLoader worker {wid} raised:\n{tb}"
+                    ) from None
+                if k == next_k:
+                    yield self.collate_fn(samples)
+                    next_k += 1
+                    consumed[0:8] = struct.pack("Q", next_k)
+                else:
+                    holdback[k] = samples
+        finally:
+            # close first so workers blocked in push() exit immediately;
+            # advance the pacing counter so sleepers re-check and hit the
+            # closed ring
+            consumed[0:8] = struct.pack("Q", n + window)
+            ring.close()
+            for pid in pids:
+                try:
+                    os.waitpid(pid, 0)
+                except ChildProcessError:
+                    pass
+            ring.destroy()
+            consumed.close()
 
     def __iter__(self):
         return iter(self._iter_batches())

@@ -1,0 +1,236 @@
+"""Tests for the native C++ runtime substrate (paddle_tpu/_native).
+
+Covers the TPU-native equivalents of the reference's C++ runtime pieces:
+TCPStore rendezvous (paddle/phi/core/distributed/store/tcp_store.h:121),
+shared-memory DataLoader transport (python/paddle/io/dataloader worker
+queues), and the host event recorder
+(paddle/fluid/platform/profiler/host_event_recorder.h).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+
+pytestmark = pytest.mark.skipif(not _native.AVAILABLE, reason="native lib unavailable")
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        srv = _native.TCPStoreServer()
+        try:
+            cli = _native.TCPStoreClient(port=srv.port)
+            cli.set("k1", b"hello")
+            assert cli.get("k1") == b"hello"
+            assert cli.add("ctr", 3) == 3
+            assert cli.add("ctr", 4) == 7
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_get_blocks_until_set(self):
+        srv = _native.TCPStoreServer()
+        try:
+            cli = _native.TCPStoreClient(port=srv.port)
+            result = {}
+
+            def setter():
+                c2 = _native.TCPStoreClient(port=srv.port)
+                c2.set("late", b"v")
+                c2.close()
+
+            t = threading.Timer(0.2, setter)
+            t.start()
+            result["v"] = cli.get("late", timeout_ms=5000)
+            t.join()
+            assert result["v"] == b"v"
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_get_timeout(self):
+        srv = _native.TCPStoreServer()
+        try:
+            cli = _native.TCPStoreClient(port=srv.port)
+            with pytest.raises(TimeoutError):
+                cli.get("never", timeout_ms=200)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_rendezvous_barrier_pattern(self):
+        # the init_parallel_env bootstrap pattern: ranks add() then wait
+        srv = _native.TCPStoreServer()
+        try:
+            nranks = 4
+            def rank(r, out):
+                c = _native.TCPStoreClient(port=srv.port)
+                c.set(f"rank/{r}", str(r).encode())
+                c.add("arrived", 1)
+                for p in range(nranks):
+                    out[r].append(int(c.get(f"rank/{p}", timeout_ms=5000)))
+                c.close()
+
+            outs = [[] for _ in range(nranks)]
+            ts = [threading.Thread(target=rank, args=(r, outs)) for r in range(nranks)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            for r in range(nranks):
+                assert outs[r] == list(range(nranks))
+        finally:
+            srv.stop()
+
+
+class TestShmRing:
+    def test_push_pop_order(self):
+        ring = _native.ShmRing(f"/pt_test_{os.getpid()}_a", 1 << 20)
+        try:
+            for i in range(100):
+                ring.push(f"item-{i}".encode())
+            for i in range(100):
+                assert ring.pop(timeout_ms=1000) == f"item-{i}".encode()
+        finally:
+            ring.close()
+            ring.destroy()
+
+    def test_pop_timeout(self):
+        ring = _native.ShmRing(f"/pt_test_{os.getpid()}_b", 1 << 16)
+        try:
+            with pytest.raises(TimeoutError):
+                ring.pop(timeout_ms=100)
+        finally:
+            ring.close()
+            ring.destroy()
+
+    def test_wraparound_large_items(self):
+        ring = _native.ShmRing(f"/pt_test_{os.getpid()}_c", 1 << 16)
+        try:
+            blob = os.urandom(20_000)
+            # more total bytes than capacity → must wrap; interleave push/pop
+            for _ in range(10):
+                ring.push(blob, timeout_ms=1000)
+                assert ring.pop(timeout_ms=1000) == blob
+        finally:
+            ring.close()
+            ring.destroy()
+
+    def test_pop_buffer_growth_preserves_data(self):
+        # item pushed while pop is blocked with a too-small initial buffer:
+        # the -4 retry path must not consume the length header
+        ring = _native.ShmRing(f"/pt_test_{os.getpid()}_e", 1 << 22)
+        try:
+            blob = os.urandom(300_000)  # > the 64KB floor buffer in pop()
+            results = []
+
+            def consumer():
+                results.append(ring.pop(timeout_ms=5000))
+                results.append(ring.pop(timeout_ms=5000))
+
+            t = threading.Thread(target=consumer)
+            t.start()
+            import time
+
+            time.sleep(0.1)  # let pop block on the empty ring first
+            ring.push(blob)
+            ring.push(b"after")
+            t.join()
+            assert results[0] == blob
+            assert results[1] == b"after"
+        finally:
+            ring.close()
+            ring.destroy()
+
+    def test_cross_process(self):
+        name = f"/pt_test_{os.getpid()}_d"
+        ring = _native.ShmRing(name, 1 << 20)
+        try:
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    w = _native.ShmRing(name, create=False)
+                    for i in range(50):
+                        w.push(f"{i}".encode(), timeout_ms=5000)
+                    os._exit(0)
+                except BaseException:
+                    os._exit(1)
+            got = [int(ring.pop(timeout_ms=5000)) for _ in range(50)]
+            _, status = os.waitpid(pid, 0)
+            assert os.waitstatus_to_exitcode(status) == 0
+            assert got == list(range(50))
+        finally:
+            ring.close()
+            ring.destroy()
+
+
+class TestMpDataLoader:
+    def test_mp_shm_dataloader_order_and_values(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Sq(Dataset):
+            def __len__(self):
+                return 37
+
+            def __getitem__(self, i):
+                return np.asarray([i * i], np.int64)
+
+        dl = DataLoader(Sq(), batch_size=5, num_workers=3, use_shared_memory=True)
+        flat = []
+        for batch in dl:
+            arr = np.asarray(batch)
+            flat.extend(int(v) for v in arr.reshape(-1))
+        assert flat == [i * i for i in range(37)]
+
+    def test_worker_exception_propagates_with_traceback(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("corrupt sample 5")
+                return np.asarray([i], np.int64)
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2, use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="corrupt sample 5"):
+            list(dl)
+
+    def test_mp_shm_dataloader_two_epochs(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Ds(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.asarray([i], np.float32)
+
+        dl = DataLoader(Ds(), batch_size=4, num_workers=2, use_shared_memory=True)
+        for _ in range(2):
+            n = sum(np.asarray(b).size for b in dl)
+            assert n == 10
+
+
+class TestHostEventRecorder:
+    def test_record_dump(self):
+        rec = _native.HostEventRecorder()
+        nid = rec.intern("matmul")
+        t0 = rec.now_ns()
+        rec.record(nid, t0, t0 + 100, tid=7)
+        rec.record(rec.intern("add"), t0 + 200, t0 + 250, tid=7)
+        events = rec.dump()
+        assert [e[0] for e in events] == ["matmul", "add"]
+        assert events[0][2] - events[0][1] == 100
+        assert events[0][3] == 7
+        assert rec.dump() == []  # cleared
+
+    def test_many_events(self):
+        rec = _native.HostEventRecorder()
+        nid = rec.intern("op")
+        for i in range(10_000):
+            rec.record(nid, i, i + 1)
+        assert len(rec.dump()) == 10_000
