@@ -1,0 +1,66 @@
+"""paddle.distribution equivalent (reference:
+python/paddle/distribution/__init__.py — 17 exports + 13 transforms).
+Implemented TPU-first on jnp/jax.scipy with functional PRNG sampling and
+reparameterized rsample; also includes Gamma/Exponential/Poisson/StudentT/
+Binomial/MultivariateNormal/ContinuousBernoulli which later reference
+snapshots export."""
+
+from .distribution import (  # noqa: F401
+    Distribution,
+    ExponentialFamily,
+    Independent,
+    TransformedDistribution,
+)
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Beta,
+    Binomial,
+    Categorical,
+    Cauchy,
+    ContinuousBernoulli,
+    Dirichlet,
+    Exponential,
+    Gamma,
+    Geometric,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    MultivariateNormal,
+    Normal,
+    Poisson,
+    StudentT,
+    Uniform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import *  # noqa: F401,F403
+from . import transform  # noqa: F401
+
+__all__ = [
+    "Bernoulli",
+    "Beta",
+    "Binomial",
+    "Categorical",
+    "Cauchy",
+    "ContinuousBernoulli",
+    "Dirichlet",
+    "Distribution",
+    "Exponential",
+    "ExponentialFamily",
+    "Gamma",
+    "Geometric",
+    "Gumbel",
+    "Independent",
+    "Laplace",
+    "LogNormal",
+    "Multinomial",
+    "MultivariateNormal",
+    "Normal",
+    "Poisson",
+    "StudentT",
+    "TransformedDistribution",
+    "Uniform",
+    "kl_divergence",
+    "register_kl",
+]
+__all__ += transform.__all__

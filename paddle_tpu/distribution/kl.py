@@ -1,0 +1,215 @@
+"""KL-divergence registry (reference: python/paddle/distribution/kl.py —
+register_kl decorator + dispatch by most-derived matching pair, plus the
+exponential-family Bregman fallback)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _t, _v
+from .distributions import (
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Gamma,
+    Geometric,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    MultivariateNormal,
+    Normal,
+    Poisson,
+    Uniform,
+)
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering fn(p, q) for the class pair (reference kl.py:64)."""
+
+    def wrap(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return wrap
+
+
+def _dispatch(p, q):
+    matches = [
+        (pc, qc)
+        for (pc, qc) in _REGISTRY
+        if isinstance(p, pc) and isinstance(q, qc)
+    ]
+    if not matches:
+        return None
+    # most-derived match: minimal by (mro distance)
+    def score(pair):
+        pc, qc = pair
+        return (type(p).__mro__.index(pc), type(q).__mro__.index(qc))
+
+    return _REGISTRY[min(matches, key=score)]
+
+
+def kl_divergence(p, q):
+    """KL(p || q) (reference kl.py:29)."""
+    fn = _dispatch(p, q)
+    if fn is not None:
+        return fn(p, q)
+    if isinstance(p, ExponentialFamily) and type(p) is type(q):
+        return _kl_expfamily(p, q)
+    raise NotImplementedError(
+        f"no KL rule registered for ({type(p).__name__}, {type(q).__name__})"
+    )
+
+
+def _kl_expfamily(p, q):
+    """Bregman divergence of the log-normalizer (reference kl.py:207)."""
+    p_nat = tuple(_v(t) for t in p._natural_parameters)
+    q_nat = tuple(_v(t) for t in q._natural_parameters)
+    p_log_norm = p._log_normalizer(*p_nat)
+    grads = jax.grad(lambda ps: jnp.sum(p._log_normalizer(*ps)))(p_nat)
+    q_log_norm = q._log_normalizer(*q_nat)
+    kl = q_log_norm - p_log_norm
+    for pn, qn, g in zip(p_nat, q_nat, grads):
+        kl = kl - (qn - pn) * g
+    return _t(kl)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    vr = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _t(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    r = jnp.log((q.high - q.low) / (p.high - p.low))
+    return _t(jnp.where((q.low <= p.low) & (p.high <= q.high), r, jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = 1e-8
+    pp = jnp.clip(p.probs, eps, 1 - eps)
+    qq = jnp.clip(q.probs, eps, 1 - eps)
+    return _t(pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return _t(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def lbeta(a, b):
+        return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    return _t(
+        lbeta(qa, qb)
+        - lbeta(pa, pb)
+        + (pa - qa) * jsp.digamma(pa)
+        + (pb - qb) * jsp.digamma(pb)
+        + (qa - pa + qb - pb) * jsp.digamma(pa + pb)
+    )
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    pa, qa = p.concentration, q.concentration
+    pa0 = jnp.sum(pa, -1)
+    return _t(
+        jsp.gammaln(pa0)
+        - jsp.gammaln(jnp.sum(qa, -1))
+        - jnp.sum(jsp.gammaln(pa), -1)
+        + jnp.sum(jsp.gammaln(qa), -1)
+        + jnp.sum((pa - qa) * (jsp.digamma(pa) - jsp.digamma(pa0)[..., None]), -1)
+    )
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    pa, pb, qa, qb = p.concentration, p.rate, q.concentration, q.rate
+    return _t(
+        (pa - qa) * jsp.digamma(pa)
+        - jsp.gammaln(pa)
+        + jsp.gammaln(qa)
+        + qa * (jnp.log(pb) - jnp.log(qb))
+        + pa * (qb - pb) / pb
+    )
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    # log(b2/b1) + |μ1−μ2|/b2 + (b1/b2)·exp(−|μ1−μ2|/b1) − 1
+    scale_ratio = p.scale / q.scale
+    loc_diff = jnp.abs(p.loc - q.loc)
+    return _t(
+        -jnp.log(scale_ratio)
+        + loc_diff / q.scale
+        + scale_ratio * jnp.exp(-loc_diff / p.scale)
+        - 1
+    )
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return _t(
+        (jnp.log(p.probs) - jnp.log(q.probs))
+        + (1 - p.probs) / p.probs * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs))
+    )
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _t(p.rate * (jnp.log(p.rate) - jnp.log(q.rate)) - p.rate + q.rate)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    vr = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _t(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    # KL for Gumbel(loc, scale): standard closed form
+    _E = 0.5772156649015329
+    beta_ratio = p.scale / q.scale
+    return _t(
+        jnp.log(q.scale)
+        - jnp.log(p.scale)
+        + _E * (beta_ratio - 1)
+        + jnp.exp((q.loc - p.loc) / q.scale) * jnp.exp(jsp.gammaln(beta_ratio + 1))
+        - 1
+        + (p.loc - q.loc) / q.scale
+    )
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.loc.shape[-1]
+    q_tril = q.scale_tril
+    p_tril = p.scale_tril
+    diff = q.loc - p.loc
+    # tr(Σq⁻¹ Σp) via triangular solves
+    m = jax.scipy.linalg.solve_triangular(q_tril, p_tril, lower=True)
+    tr = jnp.sum(m**2, axis=(-2, -1))
+    y = jax.scipy.linalg.solve_triangular(q_tril, diff[..., None], lower=True)[..., 0]
+    maha = jnp.sum(y**2, -1)
+    logdet_q = jnp.sum(jnp.log(jnp.diagonal(q_tril, axis1=-2, axis2=-1)), -1)
+    logdet_p = jnp.sum(jnp.log(jnp.diagonal(p_tril, axis1=-2, axis2=-1)), -1)
+    return _t(0.5 * (tr + maha - d) + logdet_q - logdet_p)

@@ -1,0 +1,299 @@
+"""Bijective transforms for TransformedDistribution (reference:
+python/paddle/distribution/transform.py — 13 exported transforms).  Pure
+jnp so forward/inverse/log-det are traceable and differentiable."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _t, _v
+
+__all__ = [
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+class Transform:
+    """Base transform (reference transform.py:46)."""
+
+    _event_dim = 0
+
+    def forward(self, x):
+        return _t(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._fldj(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _v(y)
+        return _t(-self._fldj(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks on raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right-inverse (positive branch), matching reference
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not bijective; maps reals → simplex via softmax, inverse = log
+    (reference transform.py SoftmaxTransform)."""
+
+    _event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform is not injective")
+
+
+class StickBreakingTransform(Transform):
+    """Reals^(K-1) → K-simplex (reference transform.py StickBreakingTransform)."""
+
+    _event_dim = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zp = jnp.concatenate([jnp.zeros_like(z[..., :1]), z], -1)
+        cum = jnp.cumprod(1 - zp[..., :-1], -1)
+        head = z * cum
+        last = jnp.prod(1 - zp[..., 1:], -1, keepdims=True)
+        return jnp.concatenate([head, last], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate([jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        offset = z.shape[-1] - jnp.cumsum(jnp.ones_like(z), -1) + 1
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        # Jacobian is lower-triangular with diag dy_k/dx_k =
+        # z_k(1-z_k)·Π_{j<k}(1-z_j), so
+        # log|det| = Σ_k [log z_k + log(1-z_k) + Σ_{j<k} log(1-z_j)]
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        xo = x - jnp.log(offset)
+        z = jax.nn.sigmoid(xo)
+        log1mz = jnp.log1p(-z)
+        excl_cum = jnp.cumsum(log1mz, -1) - log1mz  # Σ_{j<k} log(1-z_j)
+        log_dz = -jax.nn.softplus(-xo) - jax.nn.softplus(xo)  # log z + log(1-z)
+        return jnp.sum(log_dz + excl_cum, -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_dim = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[: len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[: len(shape) - n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Promote a transform to treat trailing dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._event_dim = base._event_dim + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self.base._fldj(x)
+        return jnp.sum(ldj, axis=tuple(range(-self.rank, 0)))
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms to slices along an axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis)) for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_dim = max([t._event_dim for t in self.transforms], default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t._fldj(x)
+            # reduce per-transform jacobian to this chain's event rank
+            extra = self._event_dim - t._event_dim
+            if extra > 0 and ldj.ndim >= extra:
+                ldj = jnp.sum(ldj, axis=tuple(range(-extra, 0)))
+            total = ldj if total is None else total + ldj
+            x = t._forward(x)
+        return total if total is not None else jnp.zeros_like(x)
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)

@@ -1,0 +1,129 @@
+"""paddle.fft equivalent (reference: python/paddle/fft.py — 22 public
+functions over phi pocketfft/cuFFT kernels).  On TPU the whole family maps
+directly onto XLA's FFT HLO via jnp.fft; norm/axis/n semantics follow the
+reference (numpy conventions)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("forward", "backward", "ortho")
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.fft(_v(x), n, axis, _norm(norm)))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.ifft(_v(x), n, axis, _norm(norm)))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.rfft(_v(x), n, axis, _norm(norm)))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.irfft(_v(x), n, axis, _norm(norm)))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.hfft(_v(x), n, axis, _norm(norm)))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.ihfft(_v(x), n, axis, _norm(norm)))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.fft2(_v(x), s, axes, _norm(norm)))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.ifft2(_v(x), s, axes, _norm(norm)))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.rfft2(_v(x), s, axes, _norm(norm)))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.irfft2(_v(x), s, axes, _norm(norm)))
+
+
+def _swap_norm(norm):
+    # hfft/ihfft are forward-like transforms built from irfft/rfft, so the
+    # backward and forward normalizations trade places (same identity scipy
+    # uses: hfftn(x) = irfftn(conj(x)) with swapped norm)
+    return {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.fftn(_v(x), s, axes, _norm(norm)))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.ifftn(_v(x), s, axes, _norm(norm)))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.rfftn(_v(x), s, axes, _norm(norm)))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.irfftn(_v(x), s, axes, _norm(norm)))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    xc = _v(x)
+    return Tensor(jnp.fft.irfftn(jnp.conj(xc), s, axes, _swap_norm(_norm(norm))))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    xc = _v(x)
+    return Tensor(jnp.conj(jnp.fft.rfftn(xc, s, axes, _swap_norm(_norm(norm)))))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d)
+    return Tensor(out.astype(to_jax_dtype(dtype)) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d)
+    return Tensor(out.astype(to_jax_dtype(dtype)) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.fftshift(_v(x), axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.ifftshift(_v(x), axes))

@@ -1,0 +1,167 @@
+"""paddle.signal equivalent (reference: python/paddle/signal.py — frame,
+overlap_add, stft, istft over phi frame/overlap_add kernels + fft).
+
+TPU-first: frame is a strided gather (one XLA gather, no data copy loops),
+overlap_add is a segment-sum scatter, stft/istft compose them with the fft
+module so the whole pipeline stays fusible under jit."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (reference signal.py:12).
+
+    axis=-1: [..., seq] → [..., frame_length, num_frames]
+    axis=0:  [seq, ...] → [num_frames, frame_length, ...]
+    """
+    xv = _v(x)
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    seq = xv.shape[axis]
+    if frame_length > seq:
+        raise ValueError(f"frame_length ({frame_length}) > sequence length ({seq})")
+    n_frames = 1 + (seq - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    offsets = jnp.arange(frame_length)
+    gather_idx = starts[:, None] + offsets[None, :]  # [n_frames, frame_length]
+    if axis == 0:  # checked first: for 1-D input axis 0 and -1 coincide but
+        # paddle's output layout differs by the axis argument
+        out = jnp.take(xv, gather_idx, axis=0)  # [n_frames, frame_length, ...]
+        return Tensor(out)
+    if axis in (-1, xv.ndim - 1):
+        out = jnp.take(xv, gather_idx, axis=-1)  # [..., n_frames, frame_length]
+        return Tensor(jnp.swapaxes(out, -1, -2))  # [..., frame_length, n_frames]
+    raise ValueError("axis must be 0 or -1")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.py:110).
+
+    axis=-1: [..., frame_length, num_frames] → [..., seq]
+    axis=0:  [num_frames, frame_length, ...] → [seq, ...]
+    """
+    xv = _v(x)
+    if axis == 0:
+        n_frames, frame_length = xv.shape[0], xv.shape[1]
+        seq = (n_frames - 1) * hop_length + frame_length
+        pos = (jnp.arange(n_frames) * hop_length)[:, None] + jnp.arange(frame_length)[None, :]
+        flat_pos = pos.reshape(-1)
+        flat = xv.reshape((n_frames * frame_length,) + xv.shape[2:])
+        out = jnp.zeros((seq,) + xv.shape[2:], xv.dtype).at[flat_pos].add(flat)
+        return Tensor(out)
+    if axis in (-1, xv.ndim - 1):
+        frame_length, n_frames = xv.shape[-2], xv.shape[-1]
+        frames = jnp.swapaxes(xv, -1, -2)  # [..., n_frames, frame_length]
+        lead = frames.shape[:-2]
+        seq = (n_frames - 1) * hop_length + frame_length
+        pos = (jnp.arange(n_frames) * hop_length)[:, None] + jnp.arange(frame_length)[None, :]
+        flat_pos = pos.reshape(-1)
+        flat = frames.reshape(lead + (-1,))
+        out = jnp.zeros(lead + (seq,), xv.dtype).at[..., flat_pos].add(flat)
+        return Tensor(out)
+    raise ValueError("axis must be 0 or -1")
+
+
+def stft(
+    x,
+    n_fft,
+    hop_length=None,
+    win_length=None,
+    window=None,
+    center=True,
+    pad_mode="reflect",
+    normalized=False,
+    onesided=True,
+    name=None,
+):
+    """Short-time Fourier transform (reference signal.py:191).
+
+    x: [..., seq] real or complex → [..., n_fft(/2+1), num_frames] complex.
+    """
+    xv = _v(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = _v(window)
+    else:
+        w = jnp.ones(win_length, jnp.real(xv).dtype)
+    if win_length < n_fft:  # centre-pad window to n_fft
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+    if center:
+        pad = n_fft // 2
+        pad_widths = [(0, 0)] * (xv.ndim - 1) + [(pad, pad)]
+        xv = jnp.pad(xv, pad_widths, mode=pad_mode)
+    frames = _v(frame(Tensor(xv), n_fft, hop_length, axis=-1))  # [..., n_fft, n_frames]
+    frames = frames * w[:, None]
+    if jnp.iscomplexobj(xv):
+        if onesided:
+            raise ValueError("stft of a complex signal requires onesided=False")
+        spec = jnp.fft.fft(frames, n=n_fft, axis=-2)
+    elif not onesided:
+        spec = jnp.fft.fft(frames, n=n_fft, axis=-2)
+    else:
+        spec = jnp.fft.rfft(frames, n=n_fft, axis=-2)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.real(spec).dtype))
+    return Tensor(spec)
+
+
+def istft(
+    x,
+    n_fft,
+    hop_length=None,
+    win_length=None,
+    window=None,
+    center=True,
+    normalized=False,
+    onesided=True,
+    length=None,
+    return_complex=False,
+    name=None,
+):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py:336)."""
+    spec = _v(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = _v(window)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided and return_complex:
+        raise ValueError("istft: onesided=True cannot produce complex output")
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)  # [..., n_fft, n_frames]
+    else:
+        frames = jnp.fft.ifft(spec, n=n_fft, axis=-2)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * w[:, None]
+    out = _v(overlap_add(Tensor(frames), hop_length, axis=-1))
+    # normalize by the summed squared window envelope
+    wsq = jnp.broadcast_to((w**2)[:, None], (n_fft, frames.shape[-1]))
+    envelope = _v(overlap_add(Tensor(wsq), hop_length, axis=-1))
+    out = out / jnp.where(envelope > 1e-11, envelope, 1.0)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:-pad] if pad else out
+    if length is not None:
+        out = out[..., :length]
+    return Tensor(out)

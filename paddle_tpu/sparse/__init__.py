@@ -1,0 +1,518 @@
+"""paddle.sparse equivalent (reference: python/paddle/sparse/__init__.py —
+35 exports; COO/CSR tensor types in paddle/phi/core/sparse_coo_tensor.h,
+sparse kernels in paddle/phi/kernels/sparse/).
+
+TPU-first design: COO is (indices [sparse_dim, nnz], values [nnz, *dense]),
+CSR is (crows, cols, values) — all plain jnp arrays, so every op here is
+traceable/differentiable through values.  Compute maps to XLA-friendly
+primitives: scatter for densify, segment_sum for reductions and SpMM rows,
+gather for elementwise; there is deliberately NO CUDA-style sparse kernel
+emulation — on TPU the fast path for moderate density IS a dense op over a
+scattered buffer, and ops document when they take it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core.tensor import Tensor
+
+from . import nn  # noqa: F401
+
+__all__ = [
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "cast", "neg", "deg2rad",
+    "rad2deg", "expm1", "isnan",
+    "coalesce", "transpose", "sum", "reshape", "slice",
+    "mv", "matmul", "masked_matmul", "addmm",
+    "add", "subtract", "multiply", "divide", "is_same_shape",
+    "pca_lowrank",
+    "SparseCooTensor", "SparseCsrTensor",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("expected dense input")
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference paddle/phi/core/sparse_coo_tensor.h:37)."""
+
+    is_sparse_coo = True
+    is_sparse_csr = False
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = jnp.asarray(indices, jnp.int64 if np.asarray(indices).dtype == np.int64 else jnp.int32)
+        self._values = values if isinstance(values, jnp.ndarray) else jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = bool(coalesced)
+
+    # paddle Tensor-like surface ------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return Tensor(self._values)
+
+    @property
+    def sparse_dim(self):
+        return int(self._indices.shape[0])
+
+    @property
+    def dense_dim(self):
+        return self.ndim - self.sparse_dim
+
+    def to_dense(self):
+        sd = self.sparse_dim
+        idx = tuple(self._indices[i] for i in range(sd))
+        vals = self._values
+        if vals.dtype == jnp.bool_:  # scatter-add has no bool variant
+            dense = jnp.zeros(self._shape, jnp.int8).at[idx].add(vals.astype(jnp.int8))
+            return Tensor(dense.astype(jnp.bool_))
+        dense = jnp.zeros(self._shape, vals.dtype)
+        return Tensor(dense.at[idx].add(vals))
+
+    def to_sparse_csr(self):
+        if self.sparse_dim != 2 or self.dense_dim != 0:
+            raise ValueError("to_sparse_csr requires a 2-D COO matrix")
+        c = coalesce(self)
+        rows, cols = c._indices[0], c._indices[1]
+        m = self._shape[0]
+        crows = jnp.zeros(m + 1, jnp.int64).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows)
+        return SparseCsrTensor(crows, cols, c._values, self._shape)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (
+            f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+            f"dtype={self._values.dtype})"
+        )
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (reference paddle/phi/core/sparse_csr_tensor.h:30)."""
+
+    is_sparse_coo = False
+    is_sparse_csr = True
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int64)
+        self._cols = jnp.asarray(cols, jnp.int64)
+        self._values = values if isinstance(values, jnp.ndarray) else jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def _row_indices(self):
+        # expand crows → per-nnz row ids: row[i] = #{j : crows[j+1] <= i}
+        nnz = self._cols.shape[0]
+        pos = jnp.arange(nnz)
+        return jnp.searchsorted(self._crows[1:], pos, side="right")
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = self._row_indices()
+        return SparseCooTensor(jnp.stack([rows, self._cols]), self._values, self._shape, True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (
+            f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+            f"dtype={self._values.dtype})"
+        )
+
+
+# creation -----------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    """reference python/paddle/sparse/creation.py:60"""
+    idx = _v(indices).astype(jnp.int64)
+    vals = _v(values)
+    if dtype is not None:
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        sparse_max = jnp.max(idx, axis=1) + 1
+        shape = tuple(int(s) for s in np.asarray(sparse_max)) + vals.shape[1:]
+    return coalesce(SparseCooTensor(idx, vals, shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    """reference python/paddle/sparse/creation.py:150"""
+    vals = _v(values)
+    if dtype is not None:
+        vals = vals.astype(to_jax_dtype(dtype))
+    return SparseCsrTensor(_v(crows), _v(cols), vals, shape)
+
+
+def _dense_to_coo(x, sparse_dim):
+    xv = _v(x)
+    lead = xv.shape[:sparse_dim]
+    flat = xv.reshape(lead + (-1,)) if xv.ndim > sparse_dim else xv
+    mask = np.asarray(jnp.any(flat != 0, axis=-1) if xv.ndim > sparse_dim else (xv != 0))
+    idx = np.stack(np.nonzero(mask)).astype(np.int64)
+    vals = np.asarray(xv)[tuple(idx)]
+    return SparseCooTensor(jnp.asarray(idx), jnp.asarray(vals), xv.shape, True)
+
+
+def _dense_to_csr(x):
+    return _dense_to_coo(x, 2).to_sparse_csr()
+
+
+# patch dense Tensor with conversion methods (reference
+# tensor_patch_methods.py:1157)
+Tensor.to_sparse_coo = lambda self, sparse_dim: _dense_to_coo(self, sparse_dim)
+Tensor.to_sparse_csr = lambda self: _dense_to_csr(self)
+
+
+# unary --------------------------------------------------------------------
+
+def _unary(fn, zero_preserving=True):
+    def op(x, *args, **kwargs):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, fn(x._values, *args), x._shape, x._coalesced)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, fn(x._values, *args), x._shape)
+        return Tensor(fn(_v(x), *args))
+
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)  # noqa: A001
+neg = _unary(jnp.negative)
+expm1 = _unary(jnp.expm1)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    vd = to_jax_dtype(value_dtype) if value_dtype else None
+    if isinstance(x, SparseCooTensor):
+        idx = x._indices.astype(to_jax_dtype(index_dtype)) if index_dtype else x._indices
+        return SparseCooTensor(idx, x._values.astype(vd) if vd else x._values, x._shape, x._coalesced)
+    idx_d = to_jax_dtype(index_dtype) if index_dtype else None
+    return SparseCsrTensor(
+        x._crows.astype(idx_d) if idx_d else x._crows,
+        x._cols.astype(idx_d) if idx_d else x._cols,
+        x._values.astype(vd) if vd else x._values,
+        x._shape,
+    )
+
+
+# structural ---------------------------------------------------------------
+
+def coalesce(x):
+    """Sort indices and merge duplicates (reference sparse/unary.py coalesce)."""
+    if isinstance(x, SparseCsrTensor):
+        return x
+    if x._coalesced:
+        return x
+    sd = x.sparse_dim
+    strides = np.cumprod([1] + list(x._shape[:sd][::-1]))[::-1][1:]  # row-major keys
+    keys = jnp.zeros(x._indices.shape[1], jnp.int64)
+    for i in range(sd):
+        keys = keys + x._indices[i].astype(jnp.int64) * int(strides[i])
+    order = jnp.argsort(keys)
+    keys_s = keys[order]
+    vals_s = x._values[order]
+    uniq, inv = jnp.unique(keys_s, return_inverse=True, size=keys_s.shape[0], fill_value=-1)
+    merged = jax.ops.segment_sum(vals_s, inv, num_segments=keys_s.shape[0])
+    n_uniq = int(jnp.sum(uniq >= 0))
+    uniq = uniq[:n_uniq]
+    merged = merged[:n_uniq]
+    idx = []
+    rem = uniq
+    for i in range(sd):
+        idx.append(rem // int(strides[i]))
+        rem = rem % int(strides[i])
+    return SparseCooTensor(jnp.stack(idx), merged, x._shape, True)
+
+
+def transpose(x, perm):
+    """reference sparse/unary.py transpose — permutes sparse dims."""
+    if isinstance(x, SparseCsrTensor):
+        return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
+    sd = x.sparse_dim
+    if sorted(perm[:sd]) != list(range(sd)):
+        raise ValueError("transpose across sparse/dense boundary unsupported")
+    new_idx = jnp.stack([x._indices[p] for p in perm[:sd]])
+    dense_perm = [p - sd for p in perm[sd:]]
+    vals = jnp.transpose(x._values, [0] + [d + 1 for d in dense_perm]) if x.dense_dim else x._values
+    new_shape = tuple(x._shape[p] for p in perm)
+    return coalesce(SparseCooTensor(new_idx, vals, new_shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    """reference sparse/unary.py sum."""
+    if isinstance(x, SparseCsrTensor):
+        d = jnp.sum(x._values)
+        if axis is None:
+            return Tensor(d)
+        return sum(x.to_sparse_coo(), axis, dtype, keepdim)
+    if axis is None:
+        out = jnp.sum(x._values)
+        return Tensor(out.astype(to_jax_dtype(dtype)) if dtype else out)
+    ax = axis if axis >= 0 else axis + x.ndim
+    sd = x.sparse_dim
+    if ax >= sd:
+        vals = jnp.sum(x._values, axis=ax - sd + 1, keepdims=keepdim)
+        shape = list(x._shape)
+        if keepdim:
+            shape[ax] = 1
+        else:
+            shape.pop(ax)
+        return SparseCooTensor(x._indices, vals, shape, x._coalesced)
+    keep = [i for i in range(sd) if i != ax]
+    new_idx = x._indices[jnp.asarray(keep)] if keep else jnp.zeros((1, x._indices.shape[1]), x._indices.dtype)
+    shape = list(x._shape)
+    if keepdim:
+        shape[ax] = 1
+        new_idx = jnp.insert(new_idx, ax, jnp.zeros_like(x._indices[0]), axis=0)
+    else:
+        shape.pop(ax)
+        if not keep:
+            shape = [1] + shape if not shape[:0] else shape
+    return coalesce(SparseCooTensor(new_idx, x._values, shape))
+
+
+def reshape(x, shape):
+    """reference sparse/unary.py reshape — re-linearize sparse indices."""
+    if isinstance(x, SparseCsrTensor):
+        return reshape(x.to_sparse_coo(), shape).to_sparse_csr()
+    if x.dense_dim:
+        raise ValueError("reshape with dense dims unsupported")
+    old_shape = x._shape
+    total = int(np.prod(old_shape))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = total // known
+    strides_old = np.cumprod([1] + list(old_shape[::-1]))[::-1][1:]
+    flat = jnp.zeros(x._indices.shape[1], jnp.int64)
+    for i in range(len(old_shape)):
+        flat = flat + x._indices[i].astype(jnp.int64) * int(strides_old[i])
+    strides_new = np.cumprod([1] + list(shape[::-1]))[::-1][1:]
+    idx = []
+    rem = flat
+    for s in strides_new:
+        idx.append(rem // int(s))
+        rem = rem % int(s)
+    return SparseCooTensor(jnp.stack(idx), x._values, tuple(shape), x._coalesced)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """reference sparse/unary.py slice (COO, sparse dims only)."""
+    if isinstance(x, SparseCsrTensor):
+        return slice(x.to_sparse_coo(), axes, starts, ends).to_sparse_csr()
+    shape = list(x._shape)
+    mask = jnp.ones(x._indices.shape[1], bool)
+    shifts = {}
+    for ax, st, en in zip(axes, starts, ends):
+        ax = ax if ax >= 0 else ax + x.ndim
+        st = max(st + shape[ax], 0) if st < 0 else min(st, shape[ax])
+        en = max(en + shape[ax], 0) if en < 0 else min(en, shape[ax])
+        mask = mask & (x._indices[ax] >= st) & (x._indices[ax] < en)
+        shifts[ax] = st
+        shape[ax] = en - st
+    keep = np.asarray(mask)
+    idx = np.asarray(x._indices)[:, keep]
+    for ax, st in shifts.items():
+        idx[ax] -= st
+    vals = x._values[jnp.asarray(np.nonzero(keep)[0])]
+    return SparseCooTensor(jnp.asarray(idx), vals, shape, x._coalesced)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+# binary -------------------------------------------------------------------
+
+def _coo_binary(x, y, fn):
+    x, y = coalesce(x), coalesce(y)
+    if x._shape != y._shape:
+        raise ValueError("shape mismatch")
+    # union of patterns via merged keys
+    sd = x.sparse_dim
+    strides = np.cumprod([1] + list(x._shape[:sd][::-1]))[::-1][1:]
+
+    def keys(t):
+        k = jnp.zeros(t._indices.shape[1], jnp.int64)
+        for i in range(sd):
+            k = k + t._indices[i].astype(jnp.int64) * int(strides[i])
+        return k
+
+    kx, ky = keys(x), keys(y)
+    all_k = jnp.concatenate([kx, ky])
+    uniq = np.unique(np.asarray(all_k))
+    pos_x = np.searchsorted(uniq, np.asarray(kx))
+    pos_y = np.searchsorted(uniq, np.asarray(ky))
+    dense_shape = x._values.shape[1:]
+    vx = jnp.zeros((len(uniq),) + dense_shape, x._values.dtype).at[jnp.asarray(pos_x)].set(x._values)
+    vy = jnp.zeros((len(uniq),) + dense_shape, y._values.dtype).at[jnp.asarray(pos_y)].set(y._values)
+    out = fn(vx, vy)
+    idx = []
+    rem = jnp.asarray(uniq)
+    for i in range(sd):
+        idx.append(rem // int(strides[i]))
+        rem = rem % int(strides[i])
+    return SparseCooTensor(jnp.stack(idx), out, x._shape, True)
+
+
+def _binary(x, y, fn):
+    if isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor):
+        return _coo_binary(x.to_sparse_coo(), y.to_sparse_coo(), fn).to_sparse_csr()
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _coo_binary(x, y, fn)
+    raise TypeError("sparse binary ops need two sparse tensors of the same format")
+
+
+def add(x, y, name=None):
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _binary(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    return _binary(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _binary(x, y, jnp.divide)
+
+
+# matmul family ------------------------------------------------------------
+
+def _coo_spmm(x, dense):
+    """SpMM rows = segment_sum(vals · dense[cols]) — XLA-friendly SpMM."""
+    rows, cols = x._indices[0], x._indices[1]
+    gathered = x._values[:, None] * dense[cols]
+    return jax.ops.segment_sum(gathered, rows, num_segments=x._shape[0])
+
+
+def matmul(x, y, name=None):
+    """reference sparse/binary.py matmul: sparse @ dense (COO/CSR 2D)."""
+    if isinstance(x, SparseCsrTensor):
+        return matmul(x.to_sparse_coo(), y, name)
+    yv = _v(y)
+    if isinstance(x, SparseCooTensor):
+        if x.ndim != 2:
+            raise ValueError("matmul supports 2-D sparse")
+        return Tensor(_coo_spmm(coalesce(x), yv))
+    raise TypeError("matmul: x must be sparse")
+
+
+def mv(x, vec, name=None):
+    out = matmul(x, _v(vec)[:, None])
+    return Tensor(out._value[:, 0])
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense sampled at mask's pattern (SDDMM, reference
+    sparse/binary.py masked_matmul)."""
+    xv, yv = _v(x), _v(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        rows, cols = coo._indices[0], coo._indices[1]
+        vals = jnp.sum(xv[rows] * yv[:, cols].T, axis=-1)
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    rows, cols = mask._indices[0], mask._indices[1]
+    vals = jnp.sum(xv[rows] * yv[:, cols].T, axis=-1)
+    return SparseCooTensor(mask._indices, vals, mask._shape, mask._coalesced)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta·input + alpha·(x @ y) (reference sparse/multiary.py:21)."""
+    prod = matmul(x, y)
+    return Tensor(beta * _v(input) + alpha * prod._value)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA on a sparse matrix via SpMM power iterations
+    (reference sparse/unary.py pca_lowrank)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    m, n = x._shape
+    q = q or min(6, m, n)
+    key = jax.random.key(0)
+    xv = x.to_dense()._value
+    if center:
+        xv = xv - jnp.mean(xv, axis=0, keepdims=True)
+    g = jax.random.normal(key, (n, q), xv.dtype)
+    y = xv @ g
+    for _ in range(niter):
+        y = xv @ (xv.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ xv
+    u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return Tensor(qmat @ u), Tensor(s), Tensor(vt.T)

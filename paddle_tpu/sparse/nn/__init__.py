@@ -1,0 +1,176 @@
+"""sparse.nn layers (reference: python/paddle/sparse/nn/__init__.py — 11
+layer exports over layer/activation.py, layer/norm.py, layer/conv.py,
+layer/pooling.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax",
+    "BatchNorm", "SyncBatchNorm",
+    "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+    "MaxPool3D",
+]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """Batch norm over sparse values (reference sparse/nn/layer/norm.py:
+    BatchNorm normalizes the channel axis of stored values)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC", name=None):
+        super().__init__()
+        from paddle_tpu.nn.initializer import Constant
+
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self.weight = self.create_parameter([num_features], default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], default_initializer=Constant(0.0))
+        self._mean = jnp.zeros(num_features)
+        self._variance = jnp.ones(num_features)
+
+    def forward(self, x):
+        import paddle_tpu.sparse as sp
+
+        vals = x._values
+        if self.training:
+            mean = jnp.mean(vals, axis=0)
+            var = jnp.var(vals, axis=0)
+            self._mean = self._momentum * self._mean + (1 - self._momentum) * mean
+            self._variance = self._momentum * self._variance + (1 - self._momentum) * var
+        else:
+            mean, var = self._mean, self._variance
+        w = self.weight._value
+        b = self.bias._value
+        out = (vals - mean) / jnp.sqrt(var + self._epsilon) * w + b
+        if isinstance(x, sp.SparseCsrTensor):
+            return sp.SparseCsrTensor(x._crows, x._cols, out, x._shape)
+        return sp.SparseCooTensor(x._indices, out, x._shape, x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica batch norm: identical math; under pjit/shard_map the
+    mean/var reductions become XLA collectives automatically (no manual
+    NCCL sync as in reference sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer.weight.shape[0], layer._momentum, layer._epsilon)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            return new
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride, padding,
+                 dilation, groups, subm, nd, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        from paddle_tpu.nn.initializer import XavierUniform
+
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * nd
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._subm = subm
+        self._nd = nd
+        wshape = tuple(kernel_size) + (in_channels // groups, out_channels)
+        self.weight = self.create_parameter(list(wshape), default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True
+        )
+
+    def forward(self, x):
+        fn = {
+            (2, False): F.conv2d,
+            (2, True): F.subm_conv2d,
+            (3, False): F.conv3d,
+            (3, True): F.subm_conv3d,
+        }[(self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._dilation, self._groups)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, False, 3, padding_mode, weight_attr, bias_attr)
+
+
+class SubmConv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", key=None, weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, True, 3, padding_mode, weight_attr, bias_attr)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, False, 2, padding_mode, weight_attr, bias_attr)
+
+
+class SubmConv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", key=None, weight_attr=None,
+                 bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, True, 2, padding_mode, weight_attr, bias_attr)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride, self._padding)

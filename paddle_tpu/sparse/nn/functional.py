@@ -1,0 +1,190 @@
+"""sparse.nn.functional (reference: python/paddle/sparse/nn/functional/ —
+activation.py, conv.py, pooling.py, transformer.py).
+
+TPU mapping: activations act on values; sparse 3D/2D convolution densifies
+the sparse voxel grid and runs one XLA conv (the MXU path — for the
+moderate densities these layers see on TPU, a dense conv beats gather-
+scatter kernel emulation), then re-sparsifies; submanifold variants sample
+the dense output at the input's active sites, preserving the pattern the
+way the reference's subm kernels do."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+
+
+def _sp():
+    import paddle_tpu.sparse as sp
+
+    return sp
+
+
+def relu(x, name=None):
+    return _value_act(x, jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return _value_act(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _value_act(x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def _value_act(x, fn):
+    sp = _sp()
+    if isinstance(x, sp.SparseCooTensor):
+        return sp.SparseCooTensor(x._indices, fn(x._values), x._shape, x._coalesced)
+    if isinstance(x, sp.SparseCsrTensor):
+        return sp.SparseCsrTensor(x._crows, x._cols, fn(x._values), x._shape)
+    return Tensor(fn(x._value))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored entries (reference
+    sparse/nn/functional/activation.py softmax: only the last axis, treating
+    absent entries as -inf)."""
+    sp = _sp()
+    if axis not in (-1, None) and axis != len(x.shape) - 1:
+        raise ValueError("sparse softmax supports the last axis only")
+    if isinstance(x, sp.SparseCsrTensor):
+        coo = x.to_sparse_coo()
+        out = softmax(coo, axis)
+        return sp.SparseCsrTensor(x._crows, x._cols, out._values, x._shape)
+    rows = x._indices[0]
+    n_rows = x._shape[0]
+    if x.sparse_dim != 2:
+        # flatten leading sparse dims into row keys (row-major)
+        rows = jnp.zeros_like(x._indices[0])
+        mult = 1
+        for i in reversed(range(x.sparse_dim - 1)):
+            rows = rows + x._indices[i] * mult
+            mult *= x._shape[i]
+        n_rows = mult
+    row_max = jax.ops.segment_max(x._values, rows, num_segments=n_rows)
+    shifted = x._values - row_max[rows]
+    ex = jnp.exp(shifted)
+    denom = jax.ops.segment_sum(ex, rows, num_segments=n_rows)
+    return sp.SparseCooTensor(x._indices, ex / denom[rows], x._shape, x._coalesced)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None, attn_mask=None, name=None):
+    """Sparse-pattern attention (reference
+    sparse/nn/functional/transformer.py attention): scores computed only at
+    sparse_mask's positions via SDDMM, softmax over stored entries, then
+    SpMM with value."""
+    sp = _sp()
+    q = query._value if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._value if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    b, h, seq, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    coo = sparse_mask.to_sparse_coo() if isinstance(sparse_mask, sp.SparseCsrTensor) else sparse_mask
+    # batched: mask pattern shared across (b, h)
+    rows, cols = coo._indices[-2], coo._indices[-1]
+    # SDDMM: compute scores only at stored positions — O(nnz·d), never the
+    # dense n×n QK^T
+    sampled = jnp.sum(q[:, :, rows, :] * k[:, :, cols, :], axis=-1) * scale
+    if key_padding_mask is not None:
+        kpm = key_padding_mask._value if isinstance(key_padding_mask, Tensor) else jnp.asarray(key_padding_mask)
+        sampled = sampled + kpm[:, None, cols]
+    if attn_mask is not None:
+        am = attn_mask._value if isinstance(attn_mask, Tensor) else jnp.asarray(attn_mask)
+        sampled = sampled + am[rows, cols]
+    row_max = jax.ops.segment_max(sampled.reshape(b * h, -1).T, rows, num_segments=seq)
+    ex = jnp.exp(sampled.reshape(b * h, -1).T - row_max[rows])
+    denom = jax.ops.segment_sum(ex, rows, num_segments=seq)
+    probs = (ex / denom[rows]).T.reshape(b, h, -1)
+    gathered = probs[..., None] * v[:, :, cols]
+    out = jax.vmap(jax.vmap(lambda g: jax.ops.segment_sum(g, rows, num_segments=seq)))(gathered)
+    return Tensor(out)
+
+
+def _conv_dense(x, weight, bias, stride, padding, dilation, groups, nd, subm):
+    """Shared dense-path sparse conv: densify → lax.conv_general_dilated →
+    (subm: sample at input sites | conv: re-sparsify nonzeros)."""
+    sp = _sp()
+    dense = x.to_dense()._value  # [N, *spatial, C]
+    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    # paddle sparse conv weight layout: [*kernel, C_in/groups, C_out]
+    kdims = w.shape[:nd]
+    cin, cout = w.shape[nd], w.shape[nd + 1]
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(dilation, int):
+        dilation = (dilation,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    pad = [(p, p) for p in padding]
+    spec_in = "N" + "DHW"[-nd:] + "C"
+    spec_w = "DHW"[-nd:] + "IO"
+    spec_out = "N" + "DHW"[-nd:] + "C"
+    out = jax.lax.conv_general_dilated(
+        dense, w,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=(spec_in, spec_w, spec_out),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        bv = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + bv
+    if subm:
+        # sample at the input's active sites (pattern-preserving)
+        sd = x.sparse_dim
+        idx = tuple(x._indices[i] for i in range(sd))
+        vals = out[idx]
+        return sp.SparseCooTensor(x._indices, vals, out.shape, x._coalesced)
+    return Tensor(out).to_sparse_coo(nd + 1)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NDHWC", name=None):
+    """reference sparse/nn/functional/conv.py conv3d."""
+    return _conv_dense(x, weight, bias, stride, padding, dilation, groups, 3, False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NDHWC", key=None, name=None):
+    return _conv_dense(x, weight, bias, stride, padding, dilation, groups, 3, True)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NHWC", name=None):
+    return _conv_dense(x, weight, bias, stride, padding, dilation, groups, 2, False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NHWC", key=None, name=None):
+    return _conv_dense(x, weight, bias, stride, padding, dilation, groups, 2, True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NDHWC", name=None):
+    """reference sparse/nn/functional/pooling.py max_pool3d.  Pools over
+    ACTIVE sites only, like the reference's sparse kernel: empty sites are
+    scattered as -inf so they never win the max, and windows containing no
+    active site stay empty in the output."""
+    import numpy as np
+
+    sp = _sp()
+    sd = x.sparse_dim
+    neg = jnp.full(x._shape, -jnp.inf, x._values.dtype)
+    idx = tuple(x._indices[i] for i in range(sd))
+    dense = neg.at[idx].max(x._values)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * 3
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+    dims = (1,) + tuple(kernel_size) + (1,)
+    strides = (1,) + tuple(stride) + (1,)
+    pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    out = jax.lax.reduce_window(dense, -jnp.inf, jax.lax.max, dims, strides, pads)
+    # re-sparsify: a site is active if any channel is finite (can't use
+    # to_sparse_coo — it would drop legitimate zero values)
+    active = np.asarray(jnp.any(jnp.isfinite(out), axis=-1))
+    new_idx = np.stack(np.nonzero(active)).astype(np.int64)
+    vals = jnp.where(jnp.isfinite(out), out, 0)[tuple(new_idx)]
+    return sp.SparseCooTensor(jnp.asarray(new_idx), vals, out.shape, True)

@@ -1,0 +1,280 @@
+"""paddle.distribution parity tests: log_prob/entropy/mean/variance checked
+against scipy.stats, KL against numerical integration or closed forms,
+transforms against autodiff jacobians (reference test model:
+test/distribution/test_distribution_*.py)."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RTOL = 1e-5
+ATOL = 1e-6
+
+
+def npv(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(7)
+
+
+class TestScalarDistributions:
+    CASES = [
+        (lambda: D.Normal(1.5, 2.0), st.norm(1.5, 2.0), np.linspace(-4, 6, 11)),
+        (lambda: D.Uniform(-1.0, 3.0), st.uniform(-1.0, 4.0), np.linspace(-0.9, 2.9, 7)),
+        (lambda: D.Laplace(0.5, 1.5), st.laplace(0.5, 1.5), np.linspace(-3, 4, 9)),
+        (lambda: D.Gumbel(0.3, 1.2), st.gumbel_r(0.3, 1.2), np.linspace(-2, 5, 9)),
+        (lambda: D.Cauchy(0.0, 2.0), st.cauchy(0.0, 2.0), np.linspace(-5, 5, 9)),
+        (lambda: D.Beta(2.0, 3.0), st.beta(2.0, 3.0), np.linspace(0.05, 0.95, 9)),
+        (lambda: D.Gamma(2.5, 1.5), st.gamma(2.5, scale=1 / 1.5), np.linspace(0.1, 6, 9)),
+        (lambda: D.Exponential(0.7), st.expon(scale=1 / 0.7), np.linspace(0.1, 5, 9)),
+        (lambda: D.LogNormal(0.2, 0.8), st.lognorm(0.8, scale=np.exp(0.2)), np.linspace(0.2, 5, 9)),
+        (lambda: D.StudentT(5.0, 0.5, 2.0), st.t(5.0, 0.5, 2.0), np.linspace(-4, 5, 9)),
+    ]
+
+    @pytest.mark.parametrize("mk,ref,xs", CASES, ids=lambda c: str(c)[:24])
+    def test_log_prob(self, mk, ref, xs):
+        d = mk()
+        np.testing.assert_allclose(npv(d.log_prob(xs)), ref.logpdf(xs), rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("mk,ref,xs", CASES, ids=lambda c: str(c)[:24])
+    def test_entropy(self, mk, ref, xs):
+        d = mk()
+        np.testing.assert_allclose(npv(d.entropy()), ref.entropy(), rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "mk,ref",
+        [(c[0], c[1]) for c in CASES if "Cauchy" not in repr(c[0]())],
+        ids=lambda c: str(c)[:24],
+    )
+    def test_mean_var(self, mk, ref):
+        d = mk()
+        np.testing.assert_allclose(npv(d.mean), ref.mean(), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(npv(d.variance), ref.var(), rtol=1e-4, atol=1e-6)
+
+    def test_sample_statistics(self):
+        d = D.Normal(np.float32(2.0), np.float32(0.5))
+        s = npv(d.sample((20000,)))
+        assert abs(s.mean() - 2.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_rsample_gradient_flows(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu._core import random as rng
+
+        def f(mu):
+            with rng.key_scope(jax.random.key(0)):
+                d = D.Normal(mu, 1.0)
+                return jnp.mean(npv_traced(d.rsample((64,))))
+
+        def npv_traced(t):
+            return t._value
+
+        g = jax.grad(f)(jnp.float32(0.3))
+        np.testing.assert_allclose(g, 1.0, rtol=1e-4)
+
+
+class TestDiscrete:
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        ref = st.bernoulli(0.3)
+        np.testing.assert_allclose(npv(d.log_prob(1.0)), ref.logpmf(1), rtol=1e-5)
+        np.testing.assert_allclose(npv(d.entropy()), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(npv(d.mean), 0.3, rtol=1e-6)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5]))
+        d = D.Categorical(logits)
+        np.testing.assert_allclose(npv(d.log_prob(np.array(2))), np.log(0.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            npv(d.entropy()), -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)), rtol=1e-5
+        )
+        s = npv(d.sample((8000,)))
+        freq = np.bincount(s, minlength=3) / 8000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    def test_int_params_accepted(self):
+        # constructors must cast python-int params to float for sampling
+        for d in [D.Normal(0, 1), D.Uniform(0, 1), D.Laplace(0, 1), D.Gumbel(0, 1), D.Cauchy(0, 2)]:
+            s = npv(d.sample((4,)))
+            assert s.shape == (4,)
+
+    def test_geometric_mean_matches_samples(self):
+        d = D.Geometric(0.5)
+        s = npv(d.sample((20000,)))
+        np.testing.assert_allclose(npv(d.mean), s.mean(), atol=0.05)
+        np.testing.assert_allclose(npv(d.mean), 1.0, atol=1e-6)
+
+    def test_geometric(self):
+        d = D.Geometric(0.25)
+        ref = st.geom(0.25, loc=-1)  # scipy counts trials; shift to failures
+        for k in [0, 1, 2, 5]:
+            np.testing.assert_allclose(npv(d.log_prob(float(k))), ref.logpmf(k), rtol=1e-5)
+
+    def test_poisson(self):
+        d = D.Poisson(3.5)
+        ref = st.poisson(3.5)
+        ks = np.arange(0, 10, dtype=np.float32)
+        np.testing.assert_allclose(npv(d.log_prob(ks)), ref.logpmf(ks), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(npv(d.entropy()), ref.entropy(), rtol=1e-3)
+
+    def test_binomial(self):
+        d = D.Binomial(10, 0.4)
+        ref = st.binom(10, 0.4)
+        ks = np.arange(0, 11, dtype=np.float32)
+        np.testing.assert_allclose(npv(d.log_prob(ks)), ref.logpmf(ks), rtol=1e-4, atol=1e-5)
+
+    def test_multinomial(self):
+        p = np.array([0.3, 0.3, 0.4])
+        d = D.Multinomial(6, p)
+        ref = st.multinomial(6, p)
+        x = np.array([2.0, 1.0, 3.0])
+        np.testing.assert_allclose(npv(d.log_prob(x)), ref.logpmf(x), rtol=1e-5)
+        s = npv(d.sample((50,)))
+        assert s.shape == (50, 3)
+        np.testing.assert_allclose(s.sum(-1), 6)
+
+
+class TestMultivariate:
+    def test_dirichlet(self):
+        a = np.array([2.0, 3.0, 5.0])
+        d = D.Dirichlet(a)
+        ref = st.dirichlet(a)
+        x = np.array([0.2, 0.3, 0.5])
+        np.testing.assert_allclose(npv(d.log_prob(x)), ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(npv(d.entropy()), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(npv(d.mean), a / a.sum(), rtol=1e-6)
+
+    def test_mvn(self):
+        mu = np.array([1.0, -0.5])
+        cov = np.array([[2.0, 0.3], [0.3, 1.0]])
+        d = D.MultivariateNormal(mu, covariance_matrix=cov)
+        ref = st.multivariate_normal(mu, cov)
+        x = np.array([0.5, 0.5])
+        np.testing.assert_allclose(npv(d.log_prob(x)), ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(npv(d.entropy()), ref.entropy(), rtol=1e-5)
+        s = npv(d.sample((30000,)))
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.06)
+
+
+class TestKL:
+    def test_normal_normal_closed_form(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(npv(D.kl_divergence(p, q)), expected, rtol=1e-5)
+
+    @pytest.mark.parametrize(
+        "p,q,dist",
+        [
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0), (st.beta(2, 3), st.beta(3, 2))),
+            (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0), (st.gamma(2.0), st.gamma(3.0, scale=0.5))),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0), (st.laplace(0, 1), st.laplace(1, 2))),
+        ],
+    )
+    def test_kl_vs_numeric(self, p, q, dist):
+        sp, sq = dist
+        xs = np.linspace(1e-4, 0.9999, 200001) if isinstance(p, D.Beta) else np.linspace(-20, 30, 200001)
+        px = sp.pdf(xs)
+        integrand = np.where(px > 0, px * (sp.logpdf(xs) - sq.logpdf(xs)), 0.0)
+        numeric = np.trapezoid(integrand, xs)
+        np.testing.assert_allclose(npv(D.kl_divergence(p, q)), numeric, rtol=1e-2, atol=1e-4)
+
+    def test_kl_expfamily_fallback_matches_closed_form(self):
+        from paddle_tpu.distribution.kl import _kl_expfamily
+
+        p, q = D.Normal(0.3, 1.2), D.Normal(-0.5, 0.8)
+        np.testing.assert_allclose(
+            npv(_kl_expfamily(p, q)), npv(D.kl_divergence(p, q)), rtol=1e-4
+        )
+
+    def test_registry_dispatch_custom(self):
+        class MyNormal(D.Normal):
+            pass
+
+        @D.register_kl(MyNormal, MyNormal)
+        def _kl_mine(p, q):
+            return paddle.to_tensor(42.0)
+
+        assert float(D.kl_divergence(MyNormal(0.0, 1.0), MyNormal(0.0, 1.0))) == 42.0
+        # base pair still uses the builtin rule
+        assert float(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.0, 1.0))) == 0.0
+
+
+class TestTransforms:
+    @pytest.mark.parametrize(
+        "t,xs",
+        [
+            (D.ExpTransform(), np.linspace(-2, 2, 7)),
+            (D.AffineTransform(1.0, 3.0), np.linspace(-2, 2, 7)),
+            (D.SigmoidTransform(), np.linspace(-3, 3, 7)),
+            (D.TanhTransform(), np.linspace(-2, 2, 7)),
+            (D.PowerTransform(3.0), np.linspace(0.2, 2, 7)),
+        ],
+    )
+    def test_roundtrip_and_jacobian(self, t, xs):
+        y = npv(t.forward(xs.astype(np.float32)))
+        back = npv(t.inverse(y))
+        np.testing.assert_allclose(back, xs, rtol=1e-4, atol=1e-5)
+        # |dy/dx| from finite differences
+        import jax
+
+        f = lambda x: t._forward(x)
+        fd = np.asarray(jax.vmap(jax.grad(f))(np.float32(xs)))
+        np.testing.assert_allclose(
+            npv(t.forward_log_det_jacobian(xs.astype(np.float32))),
+            np.log(np.abs(fd)),
+            rtol=1e-3,
+            atol=1e-4,
+        )
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = np.float32(0.5)
+        np.testing.assert_allclose(npv(chain.forward(x)), np.exp(1.0), rtol=1e-5)
+        np.testing.assert_allclose(npv(chain.inverse(np.exp(1.0))), 0.5, rtol=1e-5)
+        np.testing.assert_allclose(
+            npv(chain.forward_log_det_jacobian(x)), np.log(2.0) + 1.0, rtol=1e-5
+        )
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.3, -0.2, 0.8], np.float32)
+        y = npv(t.forward(x))
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(npv(t.inverse(y)), x, rtol=1e-4, atol=1e-5)
+        # jacobian vs autodiff det of the (K-1)x(K-1) leading block
+        import jax
+        import jax.numpy as jnp
+
+        J = jax.jacfwd(lambda v: t._forward(v)[:-1])(x)
+        np.testing.assert_allclose(
+            npv(t.forward_log_det_jacobian(x)),
+            np.log(np.abs(np.linalg.det(np.asarray(J)))),
+            rtol=1e-4,
+        )
+
+    def test_transformed_distribution_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.2, 0.8), [D.ExpTransform()])
+        ref = st.lognorm(0.8, scale=np.exp(0.2))
+        xs = np.linspace(0.2, 5, 9).astype(np.float32)
+        np.testing.assert_allclose(npv(td.log_prob(xs)), ref.logpdf(xs), rtol=1e-4)
+        s = npv(td.sample((4000,)))
+        assert (s > 0).all()
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        d = D.Independent(D.Normal(np.zeros((3, 4)), np.ones((3, 4))), 1)
+        assert d.batch_shape == (3,)
+        assert d.event_shape == (4,)
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        expected = st.norm(0, 1).logpdf(x).sum(-1)
+        np.testing.assert_allclose(npv(d.log_prob(x)), expected, rtol=1e-4)
+        np.testing.assert_allclose(npv(d.entropy()), st.norm(0, 1).entropy() * 4 * np.ones(3), rtol=1e-5)

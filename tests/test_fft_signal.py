@@ -1,0 +1,149 @@
+"""paddle.fft / paddle.signal parity tests vs numpy.fft / scipy.fft /
+scipy.signal (reference model: test/legacy_test/test_fft.py,
+test_signal.py, test_stft_op.py)."""
+
+import numpy as np
+import pytest
+import scipy.fft as sfft
+import scipy.signal as ssig
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+def npv(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+RNG = np.random.default_rng(0)
+X1 = RNG.normal(size=32).astype(np.float32)
+XC = (RNG.normal(size=32) + 1j * RNG.normal(size=32)).astype(np.complex64)
+X2 = RNG.normal(size=(8, 16)).astype(np.float32)
+
+
+class TestFFT:
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_ifft_roundtrip(self, norm):
+        y = fft.fft(XC, norm=norm)
+        np.testing.assert_allclose(npv(y), np.fft.fft(XC, norm=norm), rtol=1e-4, atol=1e-4)
+        back = fft.ifft(y, norm=norm)
+        np.testing.assert_allclose(npv(back), XC, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_rfft_irfft(self, norm):
+        y = fft.rfft(X1, norm=norm)
+        np.testing.assert_allclose(npv(y), np.fft.rfft(X1, norm=norm), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(npv(fft.irfft(y, norm=norm)), X1, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_hfft_ihfft(self, norm):
+        h = XC[:17]
+        np.testing.assert_allclose(npv(fft.hfft(h, norm=norm)), np.fft.hfft(h, norm=norm), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(npv(fft.ihfft(X1, norm=norm)), np.fft.ihfft(X1, norm=norm), rtol=1e-4, atol=1e-4)
+
+    def test_fft2_family(self):
+        np.testing.assert_allclose(npv(fft.fft2(X2)), np.fft.fft2(X2), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(npv(fft.rfft2(X2)), np.fft.rfft2(X2), rtol=1e-3, atol=1e-3)
+        c = np.fft.rfft2(X2)
+        np.testing.assert_allclose(npv(fft.irfft2(c)), X2, rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_hfft2_vs_scipy(self, norm):
+        h = (RNG.normal(size=(6, 9)) + 1j * RNG.normal(size=(6, 9))).astype(np.complex64)
+        np.testing.assert_allclose(
+            npv(fft.hfft2(h, norm=norm)), sfft.hfft2(np.asarray(h, np.complex128), norm=norm),
+            rtol=1e-3, atol=1e-3,
+        )
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_ihfftn_vs_scipy(self, norm):
+        np.testing.assert_allclose(
+            npv(fft.ihfftn(X2, norm=norm)), sfft.ihfftn(np.asarray(X2, np.float64), norm=norm),
+            rtol=1e-3, atol=1e-4,
+        )
+
+    def test_fftn_ifftn(self):
+        x3 = RNG.normal(size=(4, 5, 6)).astype(np.float32)
+        np.testing.assert_allclose(npv(fft.fftn(x3)), np.fft.fftn(x3), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            npv(fft.ifftn(fft.fftn(x3))), x3.astype(np.complex64), rtol=1e-3, atol=1e-4
+        )
+
+    def test_freq_shift(self):
+        np.testing.assert_allclose(npv(fft.fftfreq(10, 0.1)), np.fft.fftfreq(10, 0.1), rtol=1e-6)
+        np.testing.assert_allclose(npv(fft.rfftfreq(10, 0.1)), np.fft.rfftfreq(10, 0.1), rtol=1e-6)
+        np.testing.assert_allclose(npv(fft.fftshift(X1)), np.fft.fftshift(X1))
+        np.testing.assert_allclose(npv(fft.ifftshift(np.fft.fftshift(X1))), X1)
+
+
+class TestSignal:
+    def test_frame_axis_last(self):
+        x = np.arange(10, dtype=np.float32)
+        f = npv(signal.frame(x, 4, 2))
+        assert f.shape == (4, 4)  # frame_length x num_frames
+        np.testing.assert_allclose(f[:, 0], [0, 1, 2, 3])
+        np.testing.assert_allclose(f[:, 1], [2, 3, 4, 5])
+        np.testing.assert_allclose(f[:, 3], [6, 7, 8, 9])
+
+    def test_frame_axis0(self):
+        x = np.arange(10, dtype=np.float32)
+        f = npv(signal.frame(x, 4, 2, axis=0))
+        assert f.shape == (4, 4)  # num_frames x frame_length
+        np.testing.assert_allclose(f[0], [0, 1, 2, 3])
+        np.testing.assert_allclose(f[1], [2, 3, 4, 5])
+
+    def test_frame_batched(self):
+        x = RNG.normal(size=(3, 20)).astype(np.float32)
+        f = npv(signal.frame(x, 5, 3))
+        assert f.shape == (3, 5, 6)
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = np.arange(12, dtype=np.float32)
+        f = signal.frame(x, 4, 4)
+        back = npv(signal.overlap_add(f, 4))
+        np.testing.assert_allclose(back, x)
+
+    def test_overlap_add_sums_overlap(self):
+        frames = np.ones((4, 3), np.float32)  # frame_length 4, 3 frames
+        out = npv(signal.overlap_add(frames, 2))
+        # length = 2*2+4 = 8; middles overlap twice
+        np.testing.assert_allclose(out, [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_stft_matches_scipy(self):
+        x = RNG.normal(size=512).astype(np.float64)
+        n_fft, hop = 64, 16
+        w = np.hanning(n_fft).astype(np.float64)
+        mine = npv(signal.stft(x, n_fft, hop_length=hop, window=w, center=True, pad_mode="reflect"))
+        _, _, ref = ssig.stft(
+            x, window=w, nperseg=n_fft, noverlap=n_fft - hop, boundary="even",
+            padded=False, return_onesided=True,
+        )
+        # scipy scales by 1/win.sum(); align scaling
+        ref = ref * w.sum()
+        n = min(mine.shape[-1], ref.shape[-1])
+        np.testing.assert_allclose(mine[..., 1:n-1], ref[..., 1:n-1], rtol=1e-4, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        x = RNG.normal(size=400).astype(np.float32)
+        n_fft, hop = 64, 16
+        w = np.hanning(n_fft).astype(np.float32)
+        spec = signal.stft(x, n_fft, hop_length=hop, window=w)
+        back = npv(signal.istft(spec, n_fft, hop_length=hop, window=w, length=400))
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+
+    def test_istft_invalid_combo_raises(self):
+        spec = signal.stft(RNG.normal(size=256).astype(np.float32), 32)
+        with pytest.raises(ValueError):
+            signal.istft(spec, 32, onesided=True, return_complex=True)
+
+    def test_stft_complex_requires_twosided(self):
+        xc = (RNG.normal(size=256) + 1j * RNG.normal(size=256)).astype(np.complex64)
+        with pytest.raises(ValueError):
+            signal.stft(xc, 32)
+        spec = npv(signal.stft(xc, 32, onesided=False))
+        assert spec.shape[0] == 32
+
+    def test_stft_batched_onesided_shape(self):
+        x = RNG.normal(size=(2, 256)).astype(np.float32)
+        spec = npv(signal.stft(x, 32, hop_length=8))
+        assert spec.shape[0] == 2 and spec.shape[1] == 17

@@ -1,0 +1,290 @@
+"""paddle.sparse parity tests (reference model: test/legacy_test/
+test_sparse_*_op.py — COO/CSR creation, unary/binary, matmul family,
+sparse conv/pool/softmax/attention), checked against dense numpy."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def npv(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def rand_coo(rng, shape, density=0.3):
+    dense = rng.normal(size=shape).astype(np.float32)
+    dense[rng.random(shape) > density] = 0.0
+    return dense
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        idx = [[0, 1, 2], [1, 2, 0]]
+        vals = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+        d = s.to_dense()
+        expected = np.zeros((3, 3), np.float32)
+        expected[0, 1], expected[1, 2], expected[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(npv(d), expected)
+        assert s.nnz() == 3
+
+    def test_coo_duplicate_indices_coalesce(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [2.0, 5.0], [2, 2])
+        np.testing.assert_allclose(npv(s.to_dense())[0, 1], 7.0)
+        assert s.nnz() == 1
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 1]
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+        d = npv(s.to_dense())
+        expected = np.zeros((3, 4), np.float32)
+        expected[0, 1], expected[0, 3], expected[1, 2], expected[2, 0], expected[2, 1] = 1, 2, 3, 4, 5
+        np.testing.assert_allclose(d, expected)
+
+    def test_dense_to_sparse_and_back(self):
+        rng = np.random.default_rng(0)
+        dense = rand_coo(rng, (5, 6))
+        t = paddle.to_tensor(dense)
+        coo = t.to_sparse_coo(2)
+        np.testing.assert_allclose(npv(coo.to_dense()), dense)
+        csr = t.to_sparse_csr()
+        np.testing.assert_allclose(npv(csr.to_dense()), dense)
+        coo2 = csr.to_sparse_coo()
+        np.testing.assert_allclose(npv(coo2.to_dense()), dense)
+
+    def test_coo_with_dense_dim(self):
+        idx = [[0, 2]]
+        vals = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, [3, 2])
+        d = npv(s.to_dense())
+        np.testing.assert_allclose(d[0], [1, 2])
+        np.testing.assert_allclose(d[2], [3, 4])
+        np.testing.assert_allclose(d[1], [0, 0])
+
+
+class TestUnary:
+    def test_value_ops_match_dense(self):
+        rng = np.random.default_rng(1)
+        dense = np.abs(rand_coo(rng, (4, 5))) * 0.5
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        for name in ["sin", "tanh", "sqrt", "square", "log1p", "abs", "expm1", "neg"]:
+            out = getattr(sparse, name)(s)
+            ref = getattr(np, name if name != "neg" else "negative")(dense)
+            # zero-preserving ops keep zeros at empty sites
+            ref_sparse = np.where(dense != 0, ref, 0)
+            np.testing.assert_allclose(npv(out.to_dense()), ref_sparse, rtol=1e-5, atol=1e-6)
+
+    def test_pow_cast(self):
+        dense = np.array([[0.0, 2.0], [3.0, 0.0]], np.float32)
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        np.testing.assert_allclose(npv(sparse.pow(s, 2).to_dense()), dense**2)
+        c = sparse.cast(s, value_dtype="float64")
+        assert str(c.dtype) == "float64"
+
+    def test_transpose(self):
+        rng = np.random.default_rng(2)
+        dense = rand_coo(rng, (3, 5))
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        np.testing.assert_allclose(npv(sparse.transpose(s, [1, 0]).to_dense()), dense.T)
+
+    def test_sum(self):
+        rng = np.random.default_rng(3)
+        dense = rand_coo(rng, (4, 6))
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        np.testing.assert_allclose(npv(sparse.sum(s)), dense.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            npv(sparse.sum(s, axis=0).to_dense()), dense.sum(0), rtol=1e-5
+        )
+
+    def test_reshape(self):
+        rng = np.random.default_rng(4)
+        dense = rand_coo(rng, (4, 6))
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        r = sparse.reshape(s, [2, 12])
+        np.testing.assert_allclose(npv(r.to_dense()), dense.reshape(2, 12))
+
+    def test_slice(self):
+        rng = np.random.default_rng(5)
+        dense = rand_coo(rng, (5, 7))
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        out = sparse.slice(s, [0, 1], [1, 2], [4, 6])
+        np.testing.assert_allclose(npv(out.to_dense()), dense[1:4, 2:6])
+
+    def test_isnan(self):
+        dense = np.array([[0.0, np.nan], [1.0, 0.0]], np.float32)
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        out = sparse.isnan(s)
+        assert npv(out.to_dense())[0, 1]
+
+
+class TestBinary:
+    @pytest.mark.parametrize("op,ref", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ])
+    def test_elementwise_union_pattern(self, op, ref):
+        rng = np.random.default_rng(6)
+        a, b = rand_coo(rng, (4, 5)), rand_coo(rng, (4, 5))
+        sa = paddle.to_tensor(a).to_sparse_coo(2)
+        sb = paddle.to_tensor(b).to_sparse_coo(2)
+        out = getattr(sparse, op)(sa, sb)
+        np.testing.assert_allclose(npv(out.to_dense()), ref(a, b), rtol=1e-5, atol=1e-6)
+
+    def test_csr_add(self):
+        rng = np.random.default_rng(7)
+        a, b = rand_coo(rng, (3, 4)), rand_coo(rng, (3, 4))
+        out = sparse.add(paddle.to_tensor(a).to_sparse_csr(), paddle.to_tensor(b).to_sparse_csr())
+        assert out.is_sparse_csr
+        np.testing.assert_allclose(npv(out.to_dense()), a + b, rtol=1e-5)
+
+    def test_is_same_shape(self):
+        a = paddle.to_tensor(np.eye(3, dtype=np.float32)).to_sparse_coo(2)
+        b = paddle.to_tensor(np.eye(3, dtype=np.float32)).to_sparse_coo(2)
+        assert sparse.is_same_shape(a, b)
+
+
+class TestMatmul:
+    def test_spmm_coo(self):
+        rng = np.random.default_rng(8)
+        a = rand_coo(rng, (5, 7))
+        b = rng.normal(size=(7, 3)).astype(np.float32)
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        np.testing.assert_allclose(npv(sparse.matmul(s, paddle.to_tensor(b))), a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_spmm_csr(self):
+        rng = np.random.default_rng(9)
+        a = rand_coo(rng, (4, 6))
+        b = rng.normal(size=(6, 2)).astype(np.float32)
+        s = paddle.to_tensor(a).to_sparse_csr()
+        np.testing.assert_allclose(npv(sparse.matmul(s, paddle.to_tensor(b))), a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_mv(self):
+        rng = np.random.default_rng(10)
+        a = rand_coo(rng, (5, 5))
+        v = rng.normal(size=5).astype(np.float32)
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        np.testing.assert_allclose(npv(sparse.mv(s, paddle.to_tensor(v))), a @ v, rtol=1e-4, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        y = rng.normal(size=(6, 5)).astype(np.float32)
+        mask_dense = (rng.random((4, 5)) < 0.4).astype(np.float32)
+        mask = paddle.to_tensor(mask_dense).to_sparse_coo(2)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        np.testing.assert_allclose(npv(out.to_dense()), (x @ y) * mask_dense, rtol=1e-4, atol=1e-5)
+
+    def test_addmm(self):
+        rng = np.random.default_rng(12)
+        a = rand_coo(rng, (3, 4))
+        y = rng.normal(size=(4, 2)).astype(np.float32)
+        inp = rng.normal(size=(3, 2)).astype(np.float32)
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        out = sparse.addmm(paddle.to_tensor(inp), s, paddle.to_tensor(y), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(npv(out), 0.5 * inp + 2.0 * (a @ y), rtol=1e-4, atol=1e-5)
+
+    def test_pca_lowrank(self):
+        rng = np.random.default_rng(13)
+        a = rand_coo(rng, (20, 8), density=0.5)
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        u, sig, v = sparse.pca_lowrank(s, q=4)
+        assert npv(u).shape == (20, 4) and npv(sig).shape == (4,) and npv(v).shape == (8, 4)
+
+
+class TestSparseNN:
+    def test_relu_softmax(self):
+        rng = np.random.default_rng(14)
+        dense = rand_coo(rng, (4, 5))
+        s = paddle.to_tensor(dense).to_sparse_coo(2)
+        out = sparse.nn.functional.relu(s)
+        np.testing.assert_allclose(npv(out.to_dense()), np.maximum(dense, 0), rtol=1e-6)
+
+        sm = sparse.nn.functional.softmax(s)
+        d = npv(sm.to_dense())
+        # stored entries per row sum to 1
+        for r in range(4):
+            nz = dense[r] != 0
+            if nz.any():
+                np.testing.assert_allclose(d[r][nz].sum(), 1.0, rtol=1e-5)
+                ref = np.exp(dense[r][nz] - dense[r][nz].max())
+                np.testing.assert_allclose(d[r][nz], ref / ref.sum(), rtol=1e-5)
+
+    def test_conv3d_matches_dense(self):
+        import jax
+
+        rng = np.random.default_rng(15)
+        x = rand_coo(rng, (1, 4, 4, 4, 2), density=0.4)
+        w = rng.normal(size=(3, 3, 3, 2, 5)).astype(np.float32) * 0.1
+        s = paddle.to_tensor(x).to_sparse_coo(4)
+        out = sparse.nn.functional.conv3d(s, paddle.to_tensor(w), padding=1)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        )
+        np.testing.assert_allclose(npv(out.to_dense()), np.asarray(ref), rtol=1e-3, atol=1e-4)
+
+    def test_subm_conv3d_preserves_pattern(self):
+        rng = np.random.default_rng(16)
+        x = rand_coo(rng, (1, 4, 4, 4, 2), density=0.3)
+        s = paddle.to_tensor(x).to_sparse_coo(4)
+        layer = sparse.nn.SubmConv3D(2, 6, 3, padding=1)
+        out = layer(s)
+        assert out.nnz() == s.nnz()
+        np.testing.assert_array_equal(np.asarray(out._indices), np.asarray(s._indices))
+
+    def test_maxpool3d(self):
+        rng = np.random.default_rng(17)
+        x = np.abs(rand_coo(rng, (1, 4, 4, 4, 3), density=0.5))
+        s = paddle.to_tensor(x).to_sparse_coo(4)
+        out = sparse.nn.functional.max_pool3d(s, 2, stride=2)
+        d = npv(out.to_dense())
+        assert d.shape == (1, 2, 2, 2, 3)
+        ref2 = np.zeros_like(d)
+        for i in range(2):
+            for j in range(2):
+                for k in range(2):
+                    ref2[0, i, j, k] = x[0, 2*i:2*i+2, 2*j:2*j+2, 2*k:2*k+2].max(axis=(0, 1, 2))
+        np.testing.assert_allclose(d, ref2, rtol=1e-6)
+
+    def test_maxpool3d_negative_values_survive(self):
+        # a lone negative active site must win its window (empty sites are
+        # not zeros)
+        x = np.zeros((1, 2, 2, 2, 1), np.float32)
+        x[0, 0, 0, 0, 0] = -5.0
+        s = sparse.sparse_coo_tensor(
+            np.array([[0], [0], [0], [0]]), np.array([[-5.0]], np.float32), [1, 2, 2, 2, 1]
+        )
+        out = sparse.nn.functional.max_pool3d(s, 2, stride=2)
+        assert out.nnz() == 1
+        np.testing.assert_allclose(npv(out.values()), [[-5.0]])
+
+    def test_batchnorm(self):
+        rng = np.random.default_rng(18)
+        x = rand_coo(rng, (1, 3, 3, 3, 4), density=0.6)
+        s = paddle.to_tensor(x).to_sparse_coo(4)
+        bn = sparse.nn.BatchNorm(4)
+        out = bn(s)
+        vals = npv(out.values())
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(vals.std(0), 1.0, atol=1e-2)
+
+    def test_attention(self):
+        rng = np.random.default_rng(19)
+        b, h, n, d = 1, 2, 8, 4
+        q = rng.normal(size=(b, h, n, d)).astype(np.float32)
+        k = rng.normal(size=(b, h, n, d)).astype(np.float32)
+        v = rng.normal(size=(b, h, n, d)).astype(np.float32)
+        mask_dense = np.ones((n, n), np.float32)  # full mask → dense attention
+        mask = paddle.to_tensor(mask_dense).to_sparse_coo(2)
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), mask
+        )
+        # reference: dense softmax attention
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = p @ v
+        np.testing.assert_allclose(npv(out), ref, rtol=1e-3, atol=1e-4)
