@@ -1,0 +1,8 @@
+"""paddle.audio equivalent (reference: python/paddle/audio/__init__.py —
+functional, features, backends (wave IO), datasets (ESC50, TESS))."""
+
+from . import features, functional  # noqa: F401
+from .backends import load, save  # noqa: F401
+from . import backends, datasets  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "datasets", "load", "save"]

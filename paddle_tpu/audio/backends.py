@@ -1,0 +1,97 @@
+"""paddle.audio.backends equivalent (reference:
+python/paddle/audio/backends/ — wave_backend.py load/save/info over the
+stdlib wave module; the reference likewise falls back to a pure wave
+backend when paddleaudio is absent)."""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "list_available_backends", "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError("only wave_backend is available")
+
+
+def info(filepath):
+    """reference audio/backends/wave_backend.py info."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(
+            sample_rate=f.getframerate(),
+            num_samples=f.getnframes(),
+            num_channels=f.getnchannels(),
+            bits_per_sample=f.getsampwidth() * 8,
+        )
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True, channels_first=True):
+    """Load wav → (Tensor [C, T] float32 in [-1,1], sample_rate)
+    (reference wave_backend.py load)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtypes = {1: np.uint8, 2: np.int16, 4: np.int32}
+    if width not in dtypes:
+        raise NotImplementedError(
+            f"{8 * width}-bit PCM wav is not supported (8/16/32-bit only)"
+        )
+    dtype = dtypes[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.int16) - 128  # 8-bit wav is unsigned
+        scale = 1 << 7
+    else:
+        scale = 1 << (8 * width - 1)
+    if normalize:
+        out = (data.astype(np.float32)) / scale
+    else:
+        out = data
+    out = out.T if channels_first else out
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16", bits_per_sample=16):
+    """Save float waveform in [-1,1] to PCM wav (reference
+    wave_backend.py save)."""
+    data = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    width = bits_per_sample // 8
+    if width != 2:
+        raise NotImplementedError("only 16-bit PCM save is supported")
+    scaled = np.clip(data, -1.0, 1.0) * ((1 << 15) - 1)
+    pcm = scaled.astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

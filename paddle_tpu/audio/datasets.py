@@ -1,0 +1,115 @@
+"""paddle.audio.datasets equivalent (reference:
+python/paddle/audio/datasets/ — AudioClassificationDataset base, ESC50,
+TESS).  Downloads are impossible in a zero-egress environment, so datasets
+load from a local `data_dir`; the archive layout matches the reference's
+extracted download."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+from . import backends, features
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+class AudioClassificationDataset(Dataset):
+    """reference audio/datasets/dataset.py:24 — wav files + labels with an
+    optional on-the-fly feature transform."""
+
+    _feat_types = ("raw", "melspectrogram", "mfcc", "logmelspectrogram", "spectrogram")
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None, archive=None, **kwargs):
+        if feat_type not in self._feat_types:
+            raise ValueError(f"feat_type must be one of {self._feat_types}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._feat_layer = None  # built once on first use (per fixed sr)
+        self._feat_sr = None
+
+    def _feature(self, waveform, sr):
+        if self.feat_type == "raw":
+            return waveform
+        if self._feat_layer is None or self._feat_sr != sr:
+            layer_cls = {
+                "melspectrogram": features.MelSpectrogram,
+                "logmelspectrogram": features.LogMelSpectrogram,
+                "mfcc": features.MFCC,
+                "spectrogram": features.Spectrogram,
+            }[self.feat_type]
+            cfg = dict(self.feat_config)
+            if self.feat_type != "spectrogram":
+                cfg.setdefault("sr", sr)
+            self._feat_layer = layer_cls(**cfg)
+            self._feat_sr = sr
+        return self._feat_layer(waveform)
+
+    def __getitem__(self, idx):
+        wav, sr = backends.load(self.files[idx])
+        mono = wav._value[0]
+        from paddle_tpu._core.tensor import Tensor
+
+        feat = self._feature(Tensor(mono), sr)
+        return np.asarray(feat._value), np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py:26).
+    Expects the extracted archive at data_dir (meta/esc50.csv + audio/)."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw", data_dir=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "ESC50 requires a local copy (no network): pass data_dir "
+                "pointing at the extracted ESC-50 archive"
+            )
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta, newline="") as f:
+            for row in csv.DictReader(f):
+                in_fold = int(row["fold"]) == split
+                if (mode == "train") != in_fold:  # train: folds != split
+                    files.append(os.path.join(data_dir, "audio", row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference audio/datasets/tess.py:26).
+    Expects extracted wavs under data_dir, emotion label in the filename."""
+
+    emotions = ("angry", "disgust", "fear", "happy", "neutral", "ps", "sad")
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw", data_dir=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "TESS requires a local copy (no network): pass data_dir "
+                "pointing at the extracted TESS archive"
+            )
+        files, labels = [], []
+        all_wavs = sorted(
+            os.path.join(root, f)
+            for root, _, fs in os.walk(data_dir)
+            for f in fs
+            if f.lower().endswith(".wav")
+        )
+        for i, path in enumerate(all_wavs):
+            emo = os.path.splitext(os.path.basename(path))[0].split("_")[-1].lower()
+            if emo not in self.emotions:
+                continue
+            fold = i % n_folds + 1
+            if (mode == "train") != (fold == split):
+                files.append(path)
+                labels.append(self.emotions.index(emo))
+        super().__init__(files, labels, feat_type, **kwargs)

@@ -1,0 +1,101 @@
+"""paddle.audio.features equivalent (reference:
+python/paddle/audio/features/layers.py — Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC layers composing signal.stft with the functional
+feature math; the whole pipeline is jnp and jit-fusible)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu import signal
+
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """reference audio/features/layers.py:24"""
+
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = F.get_window(window, self.win_length, True, dtype)
+
+    def forward(self, x):
+        spec = signal.stft(
+            x, self.n_fft, self.hop_length, self.win_length,
+            window=self.fft_window, center=self.center, pad_mode=self.pad_mode,
+        )
+        return Tensor(jnp.abs(spec._value) ** self.power)
+
+
+class MelSpectrogram(Layer):
+    """reference audio/features/layers.py:106"""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.fbank_matrix = F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype
+        )
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        mel = jnp.matmul(self.fbank_matrix._value, spec._value)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    """reference audio/features/layers.py:206"""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """reference audio/features/layers.py:309"""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype
+        )
+        self.dct_matrix = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)._value  # [..., n_mels, n_frames]
+        mfcc = jnp.einsum("mk,...mt->...kt", self.dct_matrix._value, logmel)
+        return Tensor(mfcc)

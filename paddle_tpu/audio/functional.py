@@ -1,0 +1,197 @@
+"""paddle.audio.functional equivalent (reference:
+python/paddle/audio/functional/functional.py + window.py — 8 exports).
+Pure jnp feature math (slaney + htk mel scales, matching librosa
+conventions like the reference)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.dtype import to_jax_dtype
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "create_dct", "power_to_db", "get_window",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk=False):
+    """reference audio/functional/functional.py:22"""
+    f = _v(freq)
+    fa = jnp.asarray(f, jnp.float32) if not isinstance(f, jnp.ndarray) else f
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + fa / 700.0)
+    else:
+        f_sp = 200.0 / 3
+        mels = fa / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = min_log_hz / f_sp
+        logstep = math.log(6.4) / 27.0
+        log_t = min_log_mel + jnp.log(fa / min_log_hz + 1e-10) / logstep
+        out = jnp.where(fa > min_log_hz, log_t, mels)
+    if isinstance(freq, Tensor):
+        return Tensor(out)
+    return float(out) if out.ndim == 0 else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    """reference audio/functional/functional.py:78"""
+    m = _v(mel)
+    ma = jnp.asarray(m, jnp.float32) if not isinstance(m, jnp.ndarray) else m
+    if htk:
+        out = 700.0 * (jnp.power(10.0, ma / 2595.0) - 1.0)
+    else:
+        f_sp = 200.0 / 3
+        freqs = ma * f_sp
+        min_log_hz = 1000.0
+        min_log_mel = min_log_hz / f_sp
+        logstep = math.log(6.4) / 27.0
+        log_t = min_log_hz * jnp.exp(logstep * (ma - min_log_mel))
+        out = jnp.where(ma > min_log_mel, log_t, freqs)
+    if isinstance(mel, Tensor):
+        return Tensor(out)
+    return float(out) if out.ndim == 0 else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    """reference audio/functional/functional.py:131"""
+    min_mel = _v(hz_to_mel(f_min, htk))
+    max_mel = _v(hz_to_mel(f_max, htk))
+    mels = jnp.linspace(min_mel, max_mel, n_mels)
+    return Tensor(jnp.asarray(_v(mel_to_hz(Tensor(mels), htk)), to_jax_dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """reference audio/functional/functional.py:163"""
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=to_jax_dtype(dtype)))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, 1+n_fft//2] (reference functional.py:185)."""
+    if f_max is None:
+        f_max = sr / 2
+    fftfreqs = _v(fft_frequencies(sr, n_fft, dtype))
+    mel_f = _v(mel_frequencies(n_mels + 2, f_min, f_max, htk, dtype))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]  # [n_mels+2, n_freq]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2 : n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(to_jax_dtype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:252)."""
+    n = jnp.arange(n_mels, dtype=jnp.float64)
+    k = jnp.arange(n_mfcc, dtype=jnp.float64)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(math.sqrt(1.0 / (4 * n_mels)))
+        dct = dct.at[:, 1:].multiply(math.sqrt(1.0 / (2 * n_mels)))
+    else:
+        dct = dct / 2  # match torchaudio's norm=None scaling used by reference
+    return Tensor(dct.astype(to_jax_dtype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Power spectrogram → dB (reference functional.py:285)."""
+    x = _v(spect)
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window function by name (reference audio/functional/window.py:318):
+    hamming, hann, blackman, bartlett, kaiser, gaussian, exponential,
+    taylor, bohman, nuttall, cosine, tukey, triang."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + 1 if fftbins else win_length
+
+    t = jnp.arange(n, dtype=jnp.float64)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * t / (n - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+             + 0.08 * jnp.cos(4 * math.pi * t / (n - 1)))
+    elif name == "bartlett":
+        w = 1 - jnp.abs(2 * t / (n - 1) - 1)
+    elif name == "nuttall":
+        a = (0.3635819, 0.4891775, 0.1365995, 0.0106411)
+        fac = 2 * math.pi * t / (n - 1)
+        w = a[0] - a[1] * jnp.cos(fac) + a[2] * jnp.cos(2 * fac) - a[3] * jnp.cos(3 * fac)
+    elif name == "bohman":
+        fac = jnp.abs(2 * t / (n - 1) - 1)
+        w = (1 - fac) * jnp.cos(math.pi * fac) + jnp.sin(math.pi * fac) / math.pi
+        w = jnp.where(fac < 1, w, 0)
+    elif name == "cosine":
+        w = jnp.sin(math.pi / n * (t + 0.5))
+    elif name == "triang":
+        if n % 2 == 0:
+            w = (2 * t + 1) / n
+            w = jnp.where(t < n // 2, w, 2 - (2 * t + 1) / n)
+        else:
+            w = 2 * (t + 1) / (n + 1)
+            w = jnp.where(t < n // 2, w, 2 - 2 * (t + 1) / (n + 1))
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        from jax.scipy.special import i0
+
+        alpha = (n - 1) / 2.0
+        w = i0(beta * jnp.sqrt(jnp.clip(1 - ((t - alpha) / alpha) ** 2, 0, 1))) / i0(
+            jnp.asarray(beta, jnp.float64)
+        )
+    elif name == "gaussian":
+        std = args[0] if args else 1.0
+        w = jnp.exp(-0.5 * ((t - (n - 1) / 2) / std) ** 2)
+    elif name == "exponential":
+        center = args[0] if args else None
+        tau = args[1] if len(args) > 1 else 1.0
+        c = (n - 1) / 2 if center is None else center
+        w = jnp.exp(-jnp.abs(t - c) / tau)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        if alpha <= 0:
+            w = jnp.ones(n)
+        elif alpha >= 1:
+            w = 0.5 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+        else:
+            edge = alpha * (n - 1) / 2
+            w = jnp.where(
+                t < edge,
+                0.5 * (1 + jnp.cos(math.pi * (2 * t / (alpha * (n - 1)) - 1))),
+                jnp.where(
+                    t <= (n - 1) * (1 - alpha / 2),
+                    1.0,
+                    0.5 * (1 + jnp.cos(math.pi * (2 * t / (alpha * (n - 1)) - 2 / alpha + 1))),
+                ),
+            )
+    else:
+        raise ValueError(f"unsupported window: {name!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(w.astype(to_jax_dtype(dtype)))

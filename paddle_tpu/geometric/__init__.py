@@ -1,0 +1,255 @@
+"""paddle.geometric equivalent (reference:
+python/paddle/geometric/__init__.py — 11 exports: segment math, graph
+message passing, reindex, neighbor sampling).
+
+TPU-first: every op is a jax.ops.segment_* / gather composition — graph
+message passing on TPU is exactly the gather→combine→segment-reduce
+pattern XLA schedules well; no CUDA scatter-atomics emulation.  Neighbor
+sampling is host-side numpy (it is data preparation, not compute)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(segment_ids, out_size=None):
+    if out_size is not None:
+        return int(out_size)
+    if isinstance(segment_ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ops under jit need a static segment count: pass "
+            "out_size=<num_segments> (max(segment_ids)+1 cannot be read "
+            "from a traced array)"
+        )
+    ids = np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+# segment math (reference python/paddle/geometric/math.py) -----------------
+
+def segment_sum(data, segment_ids, out_size=None, name=None):
+    d, ids = _v(data), _v(segment_ids)
+    n = _num_segments(ids, out_size)
+    return Tensor(jax.ops.segment_sum(d, ids, num_segments=n))
+
+
+def segment_mean(data, segment_ids, out_size=None, name=None):
+    d, ids = _v(data), _v(segment_ids)
+    n = _num_segments(ids, out_size)
+    tot = jax.ops.segment_sum(d, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, d.dtype), ids, num_segments=n)
+    cnt = cnt.reshape(cnt.shape + (1,) * (tot.ndim - cnt.ndim))
+    return Tensor(tot / jnp.maximum(cnt, 1))
+
+
+def segment_min(data, segment_ids, out_size=None, name=None):
+    d, ids = _v(data), _v(segment_ids)
+    n = _num_segments(ids, out_size)
+    out = jax.ops.segment_min(d, ids, num_segments=n)
+    # empty segments: paddle fills 0
+    has = jax.ops.segment_sum(jnp.ones(ids.shape), ids, num_segments=n) > 0
+    has = has.reshape(has.shape + (1,) * (out.ndim - has.ndim))
+    return Tensor(jnp.where(has, out, 0))
+
+
+def segment_max(data, segment_ids, out_size=None, name=None):
+    d, ids = _v(data), _v(segment_ids)
+    n = _num_segments(ids, out_size)
+    out = jax.ops.segment_max(d, ids, num_segments=n)
+    has = jax.ops.segment_sum(jnp.ones(ids.shape), ids, num_segments=n) > 0
+    has = has.reshape(has.shape + (1,) * (out.ndim - has.ndim))
+    return Tensor(jnp.where(has, out, 0))
+
+
+# message passing (reference geometric/message_passing/send_recv.py) -------
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled via sum/count
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _reduce(msgs, dst, n, pool_type):
+    if pool_type == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(dst.shape, msgs.dtype), dst, num_segments=n)
+        cnt = cnt.reshape(cnt.shape + (1,) * (tot.ndim - cnt.ndim))
+        return tot / jnp.maximum(cnt, 1)
+    fn = _REDUCERS[pool_type]
+    out = fn(msgs, dst, num_segments=n)
+    if pool_type in ("min", "max"):
+        has = jax.ops.segment_sum(jnp.ones(dst.shape), dst, num_segments=n) > 0
+        has = has.reshape(has.shape + (1,) * (out.ndim - has.ndim))
+        out = jnp.where(has, out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather x at src, reduce into dst (reference
+    geometric/message_passing/send_recv.py:30)."""
+    xv, src, dst = _v(x), _v(src_index), _v(dst_index)
+    n = out_size or xv.shape[0]
+    return Tensor(_reduce(xv[src], dst, int(n), reduce_op))
+
+
+_MSG_OPS = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """Combine src features with edge features, reduce into dst (reference
+    send_recv.py:156)."""
+    xv, yv = _v(x), _v(y)
+    src, dst = _v(src_index), _v(dst_index)
+    msgs = _MSG_OPS[message_op](xv[src], yv)
+    n = out_size or xv.shape[0]
+    return Tensor(_reduce(msgs, dst, int(n), reduce_op))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints, no reduce (reference
+    geometric/message_passing/send_recv.py:330)."""
+    xv, yv = _v(x), _v(y)
+    src, dst = _v(src_index), _v(dst_index)
+    return Tensor(_MSG_OPS[message_op](xv[src], yv[dst]))
+
+
+# reindex (reference geometric/reindex.py) ---------------------------------
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Compact global ids to local contiguous ids (reference reindex.py:26).
+
+    Returns (reindex_src, reindex_dst, out_nodes): out_nodes = unique nodes
+    in order [x, new neighbors]; reindex_src maps neighbors to local ids;
+    reindex_dst repeats each x-node id count[i] times."""
+    xa = np.asarray(_v(x))
+    nbr = np.asarray(_v(neighbors))
+    cnt = np.asarray(_v(count))
+    id_map = {int(v): i for i, v in enumerate(xa)}
+    out = list(xa)
+    src_local = np.empty(len(nbr), np.int64)
+    for i, v in enumerate(nbr):
+        vi = int(v)
+        if vi not in id_map:
+            id_map[vi] = len(out)
+            out.append(vi)
+        src_local[i] = id_map[vi]
+    dst_local = np.repeat(np.arange(len(xa), dtype=np.int64), cnt)
+    return (
+        Tensor(jnp.asarray(src_local)),
+        Tensor(jnp.asarray(dst_local)),
+        Tensor(jnp.asarray(np.asarray(out, np.int64))),
+    )
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are lists per edge type
+    (reference reindex.py:150)."""
+    xa = np.asarray(_v(x))
+    id_map = {int(v): i for i, v in enumerate(xa)}
+    out = list(xa)
+    srcs, dsts = [], []
+    for nbr_t, cnt_t in zip(neighbors, count):
+        nbr = np.asarray(_v(nbr_t))
+        cnt = np.asarray(_v(cnt_t))
+        src_local = np.empty(len(nbr), np.int64)
+        for i, v in enumerate(nbr):
+            vi = int(v)
+            if vi not in id_map:
+                id_map[vi] = len(out)
+                out.append(vi)
+            src_local[i] = id_map[vi]
+        srcs.append(src_local)
+        dsts.append(np.repeat(np.arange(len(xa), dtype=np.int64), cnt))
+    return (
+        Tensor(jnp.asarray(np.concatenate(srcs))),
+        Tensor(jnp.asarray(np.concatenate(dsts))),
+        Tensor(jnp.asarray(np.asarray(out, np.int64))),
+    )
+
+
+# sampling (reference geometric/sampling/neighbors.py) ---------------------
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling from CSC graph (reference
+    sampling/neighbors.py:30)."""
+    rowa = np.asarray(_v(row))
+    ptr = np.asarray(_v(colptr))
+    nodes = np.asarray(_v(input_nodes))
+    eida = np.asarray(_v(eids)) if eids is not None else None
+    rng = np.random.default_rng()
+    out_nbr, out_cnt, out_eids = [], [], []
+    for nid in nodes:
+        lo, hi = int(ptr[nid]), int(ptr[nid + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_nbr.append(rowa[sel])
+        out_cnt.append(len(sel))
+        if return_eids and eida is not None:
+            out_eids.append(eida[sel])
+    nbrs = np.concatenate(out_nbr) if out_nbr else np.empty(0, rowa.dtype)
+    res = (Tensor(jnp.asarray(nbrs)), Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.empty(0, np.int64)
+        return res + (Tensor(jnp.asarray(e)),)
+    return res
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False, name=None):
+    """Weighted (without replacement) neighbor sampling (reference
+    sampling/neighbors.py:170)."""
+    rowa = np.asarray(_v(row))
+    ptr = np.asarray(_v(colptr))
+    w = np.asarray(_v(edge_weight))
+    nodes = np.asarray(_v(input_nodes))
+    eida = np.asarray(_v(eids)) if eids is not None else None
+    rng = np.random.default_rng()
+    out_nbr, out_cnt, out_eids = [], [], []
+    for nid in nodes:
+        lo, hi = int(ptr[nid]), int(ptr[nid + 1])
+        deg = hi - lo
+        if deg == 0:
+            out_cnt.append(0)
+            continue
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            p = w[lo:hi] / w[lo:hi].sum()
+            sel = lo + rng.choice(deg, size=sample_size, replace=False, p=p)
+        out_nbr.append(rowa[sel])
+        out_cnt.append(len(sel))
+        if return_eids and eida is not None:
+            out_eids.append(eida[sel])
+    nbrs = np.concatenate(out_nbr) if out_nbr else np.empty(0, rowa.dtype)
+    res = (Tensor(jnp.asarray(nbrs)), Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.empty(0, np.int64)
+        return res + (Tensor(jnp.asarray(e)),)
+    return res

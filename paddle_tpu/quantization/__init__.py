@@ -1,0 +1,261 @@
+"""paddle.quantization equivalent (reference:
+python/paddle/quantization/__init__.py — QuantConfig, BaseQuanter,
+BaseObserver, quanter factory, QAT, PTQ; observers/abs_max.py,
+quanters/abs_max.py).
+
+TPU-first: fake-quantization is a pure jnp round-clip with a
+straight-through estimator via jax.custom_vjp, so QAT steps stay fully
+jit-compilable; observers accumulate ranges as host-side state between
+compiled steps (the same split the reference makes between pass-collected
+statistics and kernel compute)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = [
+    "QuantConfig", "BaseQuanter", "BaseObserver", "quanter", "QAT", "PTQ",
+    "AbsMaxObserver", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
+    "QuantedConv2D",
+]
+
+
+# straight-through fake quant -------------------------------------------------
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through: pass gradient inside the clip range, zero outside
+    mask = (jnp.abs(x) <= jnp.maximum(scale, 1e-9)).astype(g.dtype)
+    return g * mask, None, None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+class BaseObserver(Layer):
+    """Collects tensor statistics to derive scales (reference
+    quantization/base_observer.py:22)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class BaseQuanter(BaseObserver):
+    """Trainable/simulated quantizer applied during QAT (reference
+    quantization/base_quanter.py:22)."""
+
+
+class AbsMaxObserver(BaseObserver):
+    """Running abs-max observer (reference
+    quantization/observers/abs_max.py:30)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 1e-9
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        self._max = max(self._max, float(jnp.max(jnp.abs(xv))))
+        return x
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._max, jnp.float32))
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT fake-quant with moving-average abs-max (reference
+    quantization/quanters/abs_max.py:32)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32", name=None):
+        super().__init__(quant_bits)
+        self._moving_rate = moving_rate
+        self._scale = 1e-9
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.training:
+            cur = float(jax.lax.stop_gradient(jnp.max(jnp.abs(xv))))
+            r = self._moving_rate
+            self._scale = r * self._scale + (1 - r) * cur
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        return Tensor(_fake_quant(xv, jnp.asarray(self._scale, xv.dtype), qmax))
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+
+class _QuanterFactory:
+    """Partial-binding factory (reference quantization/factory.py:49)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+
+def quanter(cls_or_name, *args, **kwargs):
+    """Decorator/factory helper (reference factory.py:76): returns a
+    factory whose instances are created per quantified tensor."""
+    if isinstance(cls_or_name, type):
+        return _QuanterFactory(cls_or_name, *args, **kwargs)
+
+    def wrap(cls):
+        return cls
+
+    return wrap
+
+
+class QuantConfig:
+    """Maps layers/types/names to (activation, weight) quanter factories
+    (reference quantization/config.py:57)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global_act = activation
+        self._global_wt = weight
+        self._layer_cfg = {}  # id(layer) -> (act, wt)
+        self._type_cfg = {}  # layer type -> (act, wt)
+        self._name_cfg = {}  # layer full name -> (act, wt)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._name_cfg[n] = (activation, weight)
+
+    def _lookup(self, layer, name):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        if name in self._name_cfg:
+            return self._name_cfg[name]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global_act or self._global_wt:
+            return (self._global_act, self._global_wt)
+        return None
+
+
+class _QuantedWrapper(Layer):
+    """Wraps a layer with activation/weight quanters (reference
+    quantization/wrapper.py ObserveWrapper + imperative quant layers)."""
+
+    def __init__(self, layer, act_factory, wt_factory):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = act_factory._instance(layer) if act_factory else None
+        self.weight_quanter = wt_factory._instance(layer) if wt_factory else None
+
+    def forward(self, x, *args, **kwargs):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._inner, "weight"):
+            orig = self._inner.weight
+            q = self.weight_quanter(orig)
+            try:
+                self._inner.weight = q
+                return self._inner(x, *args, **kwargs)
+            finally:
+                self._inner.weight = orig
+        return self._inner(x, *args, **kwargs)
+
+
+QuantedLinear = _QuantedWrapper
+QuantedConv2D = _QuantedWrapper
+
+
+def _swap_layers(model, config, factory_filter):
+    from paddle_tpu import nn
+
+    quantable = (nn.Linear, nn.Conv2D) if hasattr(nn, "Conv2D") else (nn.Linear,)
+    for name, sub in list(model._sub_layers.items()):
+        cfg = config._lookup(sub, name)
+        if cfg is not None and isinstance(sub, quantable):
+            act, wt = cfg
+            model._sub_layers[name] = _QuantedWrapper(sub, factory_filter(act), factory_filter(wt))
+        else:
+            _swap_layers(sub, config, factory_filter)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference quantization/qat.py:24):
+    quantize() swaps quantable layers for fake-quant wrappers."""
+
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _swap_layers(model, self._config, lambda f: f)
+
+    def convert(self, model, inplace=False):
+        """Freeze observers into plain dequant-scale layers (keeps the fake
+        quant path; deployment lowering happens at jit.save)."""
+        for sub in model.sublayers(True) if hasattr(model, "sublayers") else []:
+            if isinstance(sub, (BaseQuanter, BaseObserver)):
+                sub.eval()
+        return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference quantization/ptq.py:22):
+    quantize() installs observers; after calibration forwards, convert()
+    freezes scales."""
+
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _swap_layers(model, self._config, lambda f: f)
+
+    def convert(self, model, inplace=True):
+        for sub in model.sublayers(True) if hasattr(model, "sublayers") else []:
+            if isinstance(sub, (BaseQuanter, BaseObserver)):
+                sub.eval()
+        return model

@@ -1,0 +1,106 @@
+"""paddle.text equivalent (reference: python/paddle/text/__init__.py —
+viterbi_decode/ViterbiDecoder + 7 datasets).
+
+TPU-first: the Viterbi forward recursion runs as a lax.scan over time with
+batched max/argmax (one compiled kernel, no per-step Python), and the
+backtrace is a second scan over the stored argmax pointers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+__all__ = [
+    "viterbi_decode", "ViterbiDecoder",
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+]
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding (reference python/paddle/text/viterbi_decode.py:25).
+
+    potentials: [B, T, N] emissions; transition_params: [N, N];
+    lengths: [B].  Returns (scores [B], paths [B, max_len])."""
+    pot = potentials._value if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    trans = (
+        transition_params._value
+        if isinstance(transition_params, Tensor)
+        else jnp.asarray(transition_params)
+    )
+    lens = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    b, t, n = pot.shape
+
+    if include_bos_eos_tag:
+        # last tag = BOS, second-to-last = EOS (reference semantics)
+        bos, eos = n - 1, n - 2
+        init = pot[:, 0] + trans[bos][None, :]
+    else:
+        init = pot[:, 0]
+
+    def step(carry, inputs):
+        alpha, step_i = carry
+        emit = inputs  # [B, N]
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new_alpha = jnp.max(scores, axis=1) + emit
+        # sequences shorter than step_i keep their alpha frozen
+        active = (step_i < lens)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return (new_alpha, step_i + 1), best_prev
+
+    (alpha, _), pointers = jax.lax.scan(
+        step, (init, jnp.ones((), jnp.int32)), jnp.swapaxes(pot[:, 1:], 0, 1)
+    )
+    # pointers: [T-1, B, N]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+
+    # backtrace: walk pointers from each sequence's end
+    def back(carry, ptr_t):
+        tag, step_i = carry
+        # ptr_t: [B, N]; step_i counts down from t-1
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        active = (step_i < lens)  # pointer at step_i maps tag at step_i to step_i-1
+        new_tag = jnp.where(active, prev, tag)
+        return (new_tag, step_i - 1), new_tag
+
+    (first_tag, _), rev_tags = jax.lax.scan(
+        back, (last_tag, jnp.asarray(t - 1, jnp.int32)), pointers, reverse=True
+    )
+    # rev_tags[k] is the tag at time k (scan in reverse emits per input row)
+    paths = jnp.concatenate([rev_tags, last_tag[None]], axis=0)  # [T, B]
+    paths = jnp.swapaxes(paths, 0, 1)  # [B, T]
+    # positions beyond each length are padded with 0
+    mask = jnp.arange(t)[None, :] < lens[:, None]
+    paths = jnp.where(mask, paths, 0)
+    return Tensor(scores), Tensor(paths)
+
+
+class ViterbiDecoder(Layer):
+    """reference python/paddle/text/viterbi_decode.py:100"""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)

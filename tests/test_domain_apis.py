@@ -1,0 +1,315 @@
+"""Tests for geometric, audio, text (viterbi), quantization (reference
+models: test/legacy_test/test_graph_send_recv_op.py, test_segment_ops.py,
+test/legacy_test/test_audio_functions.py, test_viterbi_decode_op.py,
+test/quantization/)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import audio, geometric, quantization, text
+
+
+def npv(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+class TestGeometric:
+    DATA = np.array([[1, 2, 3], [3, 2, 1], [4, 5, 6]], np.float32)
+    IDS = np.array([0, 0, 1])
+
+    def test_segment_ops(self):
+        np.testing.assert_allclose(npv(geometric.segment_sum(self.DATA, self.IDS)), [[4, 4, 4], [4, 5, 6]])
+        np.testing.assert_allclose(npv(geometric.segment_mean(self.DATA, self.IDS)), [[2, 2, 2], [4, 5, 6]])
+        np.testing.assert_allclose(npv(geometric.segment_min(self.DATA, self.IDS)), [[1, 2, 1], [4, 5, 6]])
+        np.testing.assert_allclose(npv(geometric.segment_max(self.DATA, self.IDS)), [[3, 2, 3], [4, 5, 6]])
+
+    def test_segment_empty_segment_fills_zero(self):
+        data = np.array([[1.0, 2.0]], np.float32)
+        ids = np.array([2])
+        out = npv(geometric.segment_max(data, ids))
+        np.testing.assert_allclose(out, [[0, 0], [0, 0], [1, 2]])
+
+    def test_send_u_recv(self):
+        x = np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        out = npv(geometric.send_u_recv(x, src, dst, "sum"))
+        expected = np.zeros((3, 3), np.float32)
+        for s, d in zip(src, dst):
+            expected[d] += x[s]
+        np.testing.assert_allclose(out, expected)
+
+    def test_send_u_recv_mean_max(self):
+        x = np.array([[1.0], [3.0], [5.0]], np.float32)
+        src = np.array([0, 1])
+        dst = np.array([2, 2])
+        np.testing.assert_allclose(npv(geometric.send_u_recv(x, src, dst, "mean")), [[0], [0], [2]])
+        np.testing.assert_allclose(npv(geometric.send_u_recv(x, src, dst, "max")), [[0], [0], [3]])
+
+    def test_send_ue_recv(self):
+        x = np.array([[1.0, 1.0], [2.0, 2.0]], np.float32)
+        e = np.array([[0.5, 0.5], [1.0, 1.0]], np.float32)
+        src = np.array([0, 1])
+        dst = np.array([1, 0])
+        out = npv(geometric.send_ue_recv(x, e, src, dst, "mul", "sum"))
+        np.testing.assert_allclose(out, [[2.0, 2.0], [0.5, 0.5]])
+
+    def test_send_uv(self):
+        x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        y = np.array([[10.0], [20.0], [30.0]], np.float32)
+        src = np.array([0, 2])
+        dst = np.array([1, 0])
+        out = npv(geometric.send_uv(x, y, src, dst, "add"))
+        np.testing.assert_allclose(out, [[21.0], [13.0]])
+
+    def test_reindex_graph(self):
+        x = np.array([0, 5, 9])
+        neighbors = np.array([5, 9, 7, 0, 7])
+        count = np.array([2, 2, 1])
+        src, dst, nodes = geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(npv(nodes), [0, 5, 9, 7])
+        np.testing.assert_array_equal(npv(src), [1, 2, 3, 0, 3])
+        np.testing.assert_array_equal(npv(dst), [0, 0, 1, 1, 2])
+
+    def test_sample_neighbors(self):
+        # CSC: node 0 has nbrs [1,2,3], node 1 has [0], node 2 has []
+        row = np.array([1, 2, 3, 0])
+        colptr = np.array([0, 3, 4, 4])
+        nbrs, cnt = geometric.sample_neighbors(row, colptr, np.array([0, 1, 2]), sample_size=2)
+        c = npv(cnt)
+        assert c[0] == 2 and c[1] == 1 and c[2] == 0
+        assert set(npv(nbrs)[:2]).issubset({1, 2, 3})
+
+    def test_weighted_sample_neighbors(self):
+        row = np.array([1, 2, 3])
+        colptr = np.array([0, 3])
+        w = np.array([0.1, 0.1, 10.0], np.float32)
+        nbrs, cnt = geometric.weighted_sample_neighbors(row, colptr, w, np.array([0]), sample_size=1)
+        assert npv(cnt)[0] == 1
+
+
+class TestAudioFunctional:
+    def test_mel_hz_roundtrip(self):
+        freqs = np.array([100.0, 440.0, 1000.0, 4000.0], np.float32)
+        mel = audio.functional.hz_to_mel(paddle.to_tensor(freqs))
+        back = audio.functional.mel_to_hz(mel)
+        np.testing.assert_allclose(npv(back), freqs, rtol=1e-3)
+        # htk scale known value: 1000 Hz ≈ 999.99 mel? (2595*log10(1+1000/700))
+        m = audio.functional.hz_to_mel(1000.0, htk=True)
+        np.testing.assert_allclose(m, 2595 * np.log10(1 + 1000 / 700), rtol=1e-5)
+
+    def test_fbank_matches_librosa_formula(self):
+        fb = npv(audio.functional.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # each filter has unit-ish area under slaney norm; just check nonzero rows
+        assert (fb.sum(1) > 0).all()
+
+    def test_window_functions(self):
+        import scipy.signal as ss
+
+        for name in ["hann", "hamming", "blackman", "bartlett", "nuttall", "cosine"]:
+            w = npv(audio.functional.get_window(name, 32))
+            ref = ss.get_window(name, 32, fftbins=True)
+            np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+        w = npv(audio.functional.get_window(("kaiser", 12.0), 32))
+        ref = ss.get_window(("kaiser", 12.0), 32)
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+        w = npv(audio.functional.get_window(("gaussian", 7), 32))
+        ref = ss.get_window(("gaussian", 7), 32)
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+    def test_power_to_db(self):
+        x = np.array([1.0, 10.0, 100.0], np.float32)
+        db = npv(audio.functional.power_to_db(paddle.to_tensor(x), top_db=None))
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_create_dct_ortho(self):
+        import scipy.fft as sfft
+
+        d = npv(audio.functional.create_dct(13, 40))
+        # columns should match scipy dct-II ortho basis
+        eye = np.eye(40)
+        ref = sfft.dct(eye, type=2, norm="ortho")[:, :13]
+        np.testing.assert_allclose(d, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestAudioFeatures:
+    def test_melspectrogram_pipeline(self):
+        sig = np.sin(2 * np.pi * 440 * np.arange(8000) / 8000).astype(np.float32)
+        mel = audio.features.MelSpectrogram(sr=8000, n_fft=256, hop_length=64, n_mels=32, f_min=0.0)
+        out = npv(mel(paddle.to_tensor(sig[None])))
+        assert out.shape[0] == 1 and out.shape[1] == 32
+        assert np.isfinite(out).all() and out.max() > 0
+
+    def test_mfcc_shape(self):
+        sig = np.random.default_rng(0).normal(size=4000).astype(np.float32)
+        mfcc = audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256, hop_length=128, n_mels=32, f_min=0.0)
+        out = npv(mfcc(paddle.to_tensor(sig[None])))
+        assert out.shape[0] == 1 and out.shape[1] == 13
+        assert np.isfinite(out).all()
+
+    def test_wav_save_load_roundtrip(self):
+        sig = (0.5 * np.sin(2 * np.pi * 220 * np.arange(1600) / 8000)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.wav")
+            audio.save(p, paddle.to_tensor(sig[None]), 8000)
+            back, sr = audio.load(p)
+            assert sr == 8000
+            np.testing.assert_allclose(npv(back)[0], sig, atol=1e-3)
+            meta = audio.backends.info(p)
+            assert meta.sample_rate == 8000 and meta.num_channels == 1
+
+
+class TestViterbi:
+    def _brute_force(self, pot, trans, length, include_bos_eos):
+        import itertools
+
+        n = pot.shape[-1]
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(n), repeat=length):
+            s = 0.0
+            if include_bos_eos:
+                s += trans[n - 1, path[0]]
+            s += pot[0, path[0]]
+            for i in range(1, length):
+                s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+            if include_bos_eos:
+                s += trans[path[-1], n - 2]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("include", [True, False])
+    def test_matches_brute_force(self, include):
+        rng = np.random.default_rng(3)
+        b, t, n = 2, 5, 4
+        pot = rng.normal(size=(b, t, n)).astype(np.float32)
+        trans = rng.normal(size=(n, n)).astype(np.float32)
+        lens = np.array([5, 3], np.int64)
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans), paddle.to_tensor(lens), include
+        )
+        for i in range(b):
+            ref_s, ref_p = self._brute_force(pot[i], trans, int(lens[i]), include)
+            np.testing.assert_allclose(npv(scores)[i], ref_s, rtol=1e-4)
+            assert list(npv(paths)[i][: lens[i]]) == ref_p
+
+    def test_decoder_layer(self):
+        rng = np.random.default_rng(4)
+        trans = paddle.to_tensor(rng.normal(size=(3, 3)).astype(np.float32))
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rng.normal(size=(1, 4, 3)).astype(np.float32))
+        scores, paths = dec(pot, paddle.to_tensor(np.array([4], np.int64)))
+        assert npv(paths).shape == (1, 4)
+
+    def test_dataset_requires_local_file(self):
+        with pytest.raises(RuntimeError, match="local copy"):
+            text.UCIHousing(data_file=None)
+
+    def test_uci_housing_parsing(self):
+        rng = np.random.default_rng(5)
+        raw = rng.normal(size=(50, 14))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "housing.data")
+            np.savetxt(p, raw)
+            ds = text.UCIHousing(data_file=p, mode="train")
+            assert len(ds) == 40
+            x, y = ds[0]
+            assert x.shape == (13,) and y.shape == (1,)
+
+
+class TestQuantization:
+    def test_fake_quant_levels(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization import _fake_quant
+
+        x = jnp.linspace(-2, 2, 101)
+        out = np.asarray(_fake_quant(x, jnp.asarray(1.0), 127.0))
+        # values clamp to [-scale*(128/127), scale] and lie on the grid
+        assert out.max() <= 1.0 + 1e-6
+        grid = out * 127
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+
+    def test_straight_through_gradient(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization import _fake_quant
+
+        g = jax.grad(lambda x: jnp.sum(_fake_quant(x, jnp.asarray(1.0), 127.0)))(
+            jnp.array([-2.0, -0.5, 0.5, 2.0])
+        )
+        np.testing.assert_allclose(np.asarray(g), [0, 1, 1, 0])
+
+    def test_qat_quantize_and_train(self):
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.quantization import (
+            QAT,
+            FakeQuanterWithAbsMaxObserver,
+            QuantConfig,
+            quanter,
+        )
+
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 1)
+
+            def forward(self, x):
+                return self.fc2(paddle.tanh(self.fc1(x)))
+
+        model = Net()
+        q = quanter(FakeQuanterWithAbsMaxObserver, moving_rate=0.9, quant_bits=8)
+        cfg = QuantConfig(activation=q, weight=q)
+        qat = QAT(cfg)
+        qmodel = qat.quantize(model, inplace=False)
+        # quantable layers got wrapped
+        from paddle_tpu.quantization import _QuantedWrapper
+
+        assert isinstance(qmodel._sub_layers["fc1"], _QuantedWrapper)
+
+        optimizer = opt.Adam(1e-2, parameters=qmodel.parameters())
+        x = paddle.randn([32, 8])
+        y = paddle.randn([32, 1])
+        losses = []
+        for _ in range(25):
+            loss = paddle.mean((qmodel(x) - y) ** 2)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        # observer collected a scale
+        s = float(npv(qmodel._sub_layers["fc1"].activation_quanter.scales()))
+        assert s > 0.1
+
+    def test_ptq_calibration(self):
+        from paddle_tpu.quantization import PTQ, AbsMaxObserver, QuantConfig, quanter
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        model = Net()
+        cfg = QuantConfig(activation=quanter(AbsMaxObserver), weight=None)
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        for _ in range(3):
+            qmodel(paddle.randn([8, 4]))
+        qmodel = ptq.convert(qmodel)
+        obs = qmodel._sub_layers["fc"].activation_quanter
+        assert float(npv(obs.scales())) > 0
