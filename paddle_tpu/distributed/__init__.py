@@ -50,6 +50,9 @@ from .communication import (  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import utils  # noqa: F401
+from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,

@@ -1,0 +1,22 @@
+"""paddle.distributed.auto_tuner equivalent (reference:
+python/paddle/distributed/auto_tuner/ — tuner.py AutoTuner, search.py
+GridSearch, prune.py rule registry, memory_cost_model.py, recorder.py).
+
+Searches hybrid-parallel configs (dp/mp/pp/sharding/micro-batch) for a
+model + mesh, pruning invalid or memory-infeasible points with a TPU HBM
+model, and records trial results.  TPU-first: the memory model counts
+bf16 params/grads/master-weights and activation bytes per microbatch the
+way a ShardedTrainStep lays them out (zero-1 optimizer sharding over dp,
+params over mp, stacked stages over pp)."""
+
+from .tuner import AutoTuner  # noqa: F401
+from .search import GridSearch  # noqa: F401
+from .prune import register_prune, prune_by_memory, prune_by_mp, prune_by_pp  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+from .memory_cost_model import get_metric_memory  # noqa: F401
+
+__all__ = [
+    "AutoTuner", "GridSearch", "HistoryRecorder",
+    "register_prune", "prune_by_memory", "prune_by_mp", "prune_by_pp",
+    "get_metric_memory",
+]

@@ -1,0 +1,61 @@
+"""Per-chip HBM estimate for a transformer under a hybrid-parallel config
+(reference: python/paddle/distributed/auto_tuner/memory_cost_model.py
+get_metric_memory).
+
+Accounts bytes the way the TPU ShardedTrainStep lays state out:
+- params: bf16, sharded over mp, stacked stages over pp, and (if sharding
+  aka zero-1/3) over the sharding axis
+- grads: bf16 like params (over dp with zero-2+ they shard too)
+- optimizer moments + fp32 master weights: sharded over dp*sharding (zero-1)
+- activations: per microbatch, seq*hidden*layers-per-stage terms with the
+  1F1B in-flight multiplier, divided by mp (tensor-parallel activations)
+"""
+
+from __future__ import annotations
+
+__all__ = ["get_metric_memory"]
+
+
+def _param_count(model_cfg):
+    if "num_params" in model_cfg:
+        return float(model_cfg["num_params"])
+    h = model_cfg.get("hidden_size", 1024)
+    l = model_cfg.get("num_layers", 12)
+    v = model_cfg.get("vocab_size", 32000)
+    inter = model_cfg.get("intermediate_size", 4 * h)
+    per_layer = 4 * h * h + 3 * h * inter  # attn qkv/o + swiglu mlp
+    return float(l * per_layer + v * h)
+
+
+def get_metric_memory(model_cfg, cfg):
+    """Estimated bytes per chip."""
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    dp = cfg.get("dp_degree", 1)
+    sh = cfg.get("sharding_degree", 1)
+    stage = cfg.get("sharding_stage", 1)
+    mbs = cfg.get("micro_batch_size", 1)
+    recompute = cfg.get("use_recompute", False)
+
+    n_params = _param_count(model_cfg)
+    base = n_params / (mp * pp)  # per mp x pp shard, before data-axis sharding
+    bytes_params = base * 2 / (max(dp * sh, 1) if stage >= 3 else 1)  # bf16
+    bytes_grads = base * 2 / (max(dp * sh, 1) if stage >= 2 else 1)
+    # zero-1: moments (2x fp32) + master weights (fp32) sharded over dp*sh
+    bytes_opt = base * 12 / max(dp * sh, 1)
+
+    h = model_cfg.get("hidden_size", 1024)
+    l = model_cfg.get("num_layers", 12)
+    s = model_cfg.get("seq_length", 2048)
+    layers_per_stage = max(l // pp, 1)
+    # bf16 activations per layer ≈ s*h*(16 + 2*inter/h) bytes without
+    # recompute; with full recompute only boundary activations persist
+    inter = model_cfg.get("intermediate_size", 4 * h)
+    act_per_layer = s * h * (16 + 2 * inter / h) * 2 / mp
+    if recompute:
+        act_per_layer = s * h * 4 / mp  # boundary only
+    inflight = min(pp, cfg.get("num_micro_batches", pp))  # 1F1B warmup depth
+    bytes_act = mbs * act_per_layer * layers_per_stage * max(inflight, 1)
+
+    overhead = 1.5 * (1024**3)  # XLA workspace + framework
+    return bytes_params + bytes_grads + bytes_opt + bytes_act + overhead

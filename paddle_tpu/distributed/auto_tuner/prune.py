@@ -1,0 +1,86 @@
+"""Prune rules (reference: python/paddle/distributed/auto_tuner/prune.py —
+a registry of predicate functions applied to candidate configs)."""
+
+from __future__ import annotations
+
+_PRUNES = []
+
+__all__ = ["register_prune", "prune_by_mp", "prune_by_pp", "prune_by_memory", "run_prunes"]
+
+
+def register_prune(fn):
+    """reference prune.py register_prune decorator."""
+    _PRUNES.append(fn)
+    return fn
+
+
+def run_prunes(tuner_cfg, cfg, history):
+    """True = prune (reject) this candidate."""
+    return any(p(tuner_cfg, cfg, history) for p in _PRUNES)
+
+
+@register_prune
+def prune_by_num_gpus(tuner_cfg, cfg, history):
+    n = tuner_cfg.get("num_gpus") or tuner_cfg.get("num_devices", 8)
+    degree = (
+        cfg.get("dp_degree", 1)
+        * cfg.get("mp_degree", 1)
+        * cfg.get("pp_degree", 1)
+        * cfg.get("sharding_degree", 1)
+    )
+    return degree != n
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, cfg, history):
+    """mp must divide head count and hidden size (reference prune.py
+    prune_by_mp)."""
+    mp = cfg.get("mp_degree", 1)
+    model = tuner_cfg.get("model_cfg", {})
+    heads = model.get("num_attention_heads")
+    hidden = model.get("hidden_size")
+    if heads and heads % mp != 0:
+        return True
+    if hidden and hidden % mp != 0:
+        return True
+    vocab = model.get("vocab_size")
+    if vocab and vocab % mp != 0:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, cfg, history):
+    """pp must divide layer count; micro-batches must divide per-dp batch
+    (reference prune.py prune_by_pp / prune_by_mbs)."""
+    pp = cfg.get("pp_degree", 1)
+    layers = tuner_cfg.get("model_cfg", {}).get("num_layers")
+    if layers and layers % pp != 0:
+        return True
+    gbs = tuner_cfg.get("model_cfg", {}).get("global_batch_size")
+    dp = cfg.get("dp_degree", 1) * cfg.get("sharding_degree", 1)
+    mbs = cfg.get("micro_batch_size", 1)
+    if gbs:
+        if gbs % dp != 0:
+            return True
+        if (gbs // dp) % mbs != 0:
+            return True
+    return False
+
+
+@register_prune
+def prune_by_memory(tuner_cfg, cfg, history):
+    """Reject configs whose estimated per-chip HBM exceeds the budget
+    (reference prune.py prune_by_memory + memory_cost_model.py)."""
+    from .memory_cost_model import get_metric_memory
+
+    budget = tuner_cfg.get("max_mem_usage_gb", tuner_cfg.get("hbm_gb", 16))
+    est = get_metric_memory(tuner_cfg.get("model_cfg", {}), cfg)
+    return est > budget * (1024**3)
+
+
+@register_prune
+def prune_by_history(tuner_cfg, cfg, history):
+    """Skip configs already tried (reference prune.py history check)."""
+    key = tuple(sorted(cfg.items()))
+    return any(tuple(sorted((k, v) for k, v in h.items() if k in cfg)) == key for h in history)

@@ -1,0 +1,61 @@
+"""Trial recorder (reference: python/paddle/distributed/auto_tuner/
+recorder.py — HistoryRecorder storing per-config metrics, sort + csv
+export)."""
+
+from __future__ import annotations
+
+import csv
+
+__all__ = ["HistoryRecorder"]
+
+
+class HistoryRecorder:
+    """reference recorder.py HistoryRecorder."""
+
+    def __init__(self):
+        self.history = []
+
+    def add_cfg(self, **kwargs):
+        self.history.append(dict(kwargs))
+
+    def sort_metric(self, direction="max", metric="throughput"):
+        self.history.sort(
+            key=lambda c: c.get(metric) if c.get(metric) is not None else float("-inf"),
+            reverse=(direction == "max"),
+        )
+
+    def get_best(self, metric="throughput", direction="max"):
+        valid = [c for c in self.history if c.get(metric) is not None and not c.get("error")]
+        if not valid:
+            return None, True
+        best = (max if direction == "max" else min)(valid, key=lambda c: c[metric])
+        return best, False
+
+    def store_history(self, path="./history.csv"):
+        if not self.history:
+            return
+        keys = sorted({k for c in self.history for k in c})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for c in self.history:
+                w.writerow(c)
+
+    def load_history(self, path="./history.csv"):
+        def conv(v):
+            if v == "":
+                return None
+            if v in ("True", "False"):
+                return v == "True"
+            for cast in (int, float):
+                try:
+                    return cast(v)
+                except ValueError:
+                    continue
+            return v
+
+        try:
+            with open(path, newline="") as f:
+                self.history = [{k: conv(v) for k, v in row.items()} for row in csv.DictReader(f)]
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,62 @@
+"""Search algorithms (reference: python/paddle/distributed/auto_tuner/
+search.py — GridSearch over default_candidates, pruned)."""
+
+from __future__ import annotations
+
+import itertools
+
+from .prune import run_prunes
+
+__all__ = ["GridSearch", "default_candidates"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg):
+    """reference utils.py default_candidates: derive axis candidates from
+    device count + model config."""
+    n = tuner_cfg.get("num_gpus") or tuner_cfg.get("num_devices", 8)
+    cands = {
+        "dp_degree": tuner_cfg.get("dp_degree", "auto"),
+        "mp_degree": tuner_cfg.get("mp_degree", "auto"),
+        "pp_degree": tuner_cfg.get("pp_degree", "auto"),
+        "sharding_degree": tuner_cfg.get("sharding_degree", "auto"),
+        "sharding_stage": tuner_cfg.get("sharding_stage", [1]),
+        "micro_batch_size": tuner_cfg.get("micro_batch_size", "auto"),
+        "use_recompute": tuner_cfg.get("use_recompute", [False, True]),
+    }
+    out = {}
+    for k, v in cands.items():
+        if v == "auto":
+            if k == "micro_batch_size":
+                gbs = tuner_cfg.get("model_cfg", {}).get("global_batch_size", 8)
+                out[k] = _divisors(gbs)
+            else:
+                out[k] = _divisors(n)
+        elif isinstance(v, (list, tuple)):
+            out[k] = list(v)
+        else:
+            out[k] = [v]
+    return out
+
+
+class GridSearch:
+    """reference search.py GridSearch: iterate the cartesian product in a
+    fixed priority order, yielding unpruned configs."""
+
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = tuner_cfg
+        cands = tuner_cfg.get("candidates") or default_candidates(tuner_cfg)
+        keys = list(cands.keys())
+        self._configs = [dict(zip(keys, vals)) for vals in itertools.product(*cands.values())]
+        self._idx = 0
+
+    def search_once(self, history_cfgs):
+        while self._idx < len(self._configs):
+            cfg = self._configs[self._idx]
+            self._idx += 1
+            if not run_prunes(self.tuner_cfg, cfg, history_cfgs):
+                return cfg
+        return None

@@ -1,0 +1,33 @@
+"""AutoTuner driver (reference:
+python/paddle/distributed/auto_tuner/tuner.py:19)."""
+
+from __future__ import annotations
+
+from .search import GridSearch, default_candidates
+
+__all__ = ["AutoTuner"]
+
+
+class AutoTuner:
+    """reference tuner.py:19 — search_once()/add_cfg() protocol."""
+
+    def __init__(self, tuner_cfg):
+        self.cur_task_id = 1
+        self.task_limit = tuner_cfg.get("task_limit", 100)
+        algo = tuner_cfg.get("search_algo", {"name": "grid"})
+        name = algo["name"] if isinstance(algo, dict) else algo
+        if name != "grid":
+            raise NotImplementedError(f"search_algo {name!r} (grid only)")
+        tuner_cfg.setdefault("candidates", default_candidates(tuner_cfg))
+        self.algo = GridSearch(tuner_cfg)
+        self.history_cfgs = []
+
+    def search_once(self):
+        if self.cur_task_id > self.task_limit:
+            return None
+        cfg = self.algo.search_once(self.history_cfgs)
+        self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg):
+        self.history_cfgs.append(cfg)

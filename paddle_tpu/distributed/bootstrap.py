@@ -1,0 +1,50 @@
+"""Shared TCPStore bootstrap helpers used by launch, rpc, and elastic
+(reference: the TCPStore-based rendezvous in
+paddle/phi/core/distributed/store/tcp_store.h + the barrier patterns in
+python/paddle/distributed/parallel.py).
+
+One implementation of: 'rank 0 hosts the store, everyone connects' and the
+counter-plus-done-key barrier, so the three consumers cannot drift."""
+
+from __future__ import annotations
+
+__all__ = ["host_or_connect", "store_barrier", "register_member", "list_members"]
+
+
+def host_or_connect(endpoint, is_host, timeout_ms=120_000):
+    """Return (server_or_None, client). The host starts a TCPStoreServer on
+    the endpoint's port; everyone (host included) connects a client."""
+    from paddle_tpu import _native
+
+    host, port = endpoint.split(":")
+    server = None
+    if is_host:
+        server = _native.TCPStoreServer(int(port))
+    client = _native.TCPStoreClient(host, int(port), timeout_ms=timeout_ms)
+    return server, client
+
+
+def store_barrier(client, key, n, timeout_ms=600_000):
+    """All n participants call; returns when everyone arrived."""
+    arrived = client.add(f"barrier/{key}/count", 1)
+    if arrived >= n:
+        client.set(f"barrier/{key}/done", b"1")
+    else:
+        client.get(f"barrier/{key}/done", timeout_ms=timeout_ms)
+
+
+def register_member(client, namespace, member_id):
+    """Atomically append member_id to a membership list (per-index keys —
+    the store only has set/get/add, so read-modify-write of one list key
+    would lose concurrent registrations)."""
+    idx = client.add(f"{namespace}/count", 1) - 1
+    client.set(f"{namespace}/member/{idx}", str(member_id).encode())
+    return idx
+
+
+def list_members(client, namespace, timeout_ms=5_000):
+    n = client.add(f"{namespace}/count", 0)
+    out = []
+    for i in range(int(n)):
+        out.append(client.get(f"{namespace}/member/{i}", timeout_ms=timeout_ms).decode())
+    return out

@@ -1,0 +1,133 @@
+"""Elastic training manager (reference:
+python/paddle/distributed/fleet/elastic/manager.py:126 ElasticManager —
+etcd-registered membership, fault detect, scale up/down, relaunch).
+
+TPU-native redesign: membership lives in the framework's native TCPStore
+(the launcher's rendezvous store) instead of etcd — each node heartbeats a
+key; the manager watches peer heartbeats and reports JOIN/GONE transitions
+so the launcher can relaunch with a new world spec.  np can be a range
+("2:4") exactly like the reference."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+def _parse_np(np_spec):
+    """'4' → (4, 4); '2:4' → (2, 4) (reference manager.py np range parse)."""
+    if isinstance(np_spec, int):
+        return np_spec, np_spec
+    parts = str(np_spec).split(":")
+    if len(parts) == 1:
+        n = int(parts[0])
+        return n, n
+    return int(parts[0]), int(parts[1])
+
+
+class ElasticManager:
+    """reference manager.py:126 — here backed by TCPStore heartbeats."""
+
+    def __init__(self, endpoint, node_id, np_spec, heartbeat_interval=2.0,
+                 timeout=10.0, is_host=False):
+        from paddle_tpu.distributed.bootstrap import host_or_connect
+
+        self.node_id = str(node_id)
+        self.min_np, self.max_np = _parse_np(np_spec)
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        self._server, self._cli = host_or_connect(endpoint, is_host, timeout_ms=60_000)
+        self._stop = threading.Event()
+        self._thread = None
+        self._known = set()
+        self._transitions = []
+        self._lock = threading.Lock()
+
+    # membership ----------------------------------------------------------
+    def register(self):
+        from paddle_tpu.distributed.bootstrap import register_member
+
+        self._cli.set(f"elastic/alive/{self.node_id}", str(time.time()).encode())
+        # per-index keys via an atomic counter: a read-modify-write of one
+        # list key would lose concurrent registrations
+        register_member(self._cli, "elastic/registry", self.node_id)
+
+    def _members(self):
+        from paddle_tpu.distributed.bootstrap import list_members
+
+        try:
+            return set(list_members(self._cli, "elastic/registry"))
+        except Exception:
+            return set()
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self._cli.set(f"elastic/alive/{self.node_id}", str(time.time()).encode())
+            now = time.time()
+            current = set()
+            for m in self._members():
+                try:
+                    ts = float(self._cli.get(f"elastic/alive/{m}", timeout_ms=1000).decode())
+                    if now - ts < self.timeout:
+                        current.add(m)
+                except Exception:
+                    pass
+            with self._lock:
+                joined = current - self._known
+                gone = self._known - current
+                for m in joined:
+                    self._transitions.append(("JOIN", m))
+                for m in gone:
+                    self._transitions.append(("GONE", m))
+                self._known = current
+            self._stop.wait(self.interval)
+
+    def start(self):
+        self.register()
+        with self._lock:
+            self._known = {self.node_id}
+        self._thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._thread.start()
+
+    def pop_transitions(self):
+        with self._lock:
+            out, self._transitions = self._transitions, []
+            return out
+
+    def peek_transitions(self):
+        with self._lock:
+            return list(self._transitions)
+
+    def world(self):
+        with self._lock:
+            return sorted(self._known)
+
+    # policy --------------------------------------------------------------
+    def exit_status(self):
+        """RESTART if membership changed but still viable; HOLD if below
+        min_np; COMPLETED if unchanged (reference manager exit logic)."""
+        n = len(self.world())
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        # peek — the launcher owns consumption via pop_transitions()
+        if self.peek_transitions():
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._cli.close()
+        if self._server:
+            self._server.stop()

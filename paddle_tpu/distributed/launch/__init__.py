@@ -1,0 +1,5 @@
+"""paddle.distributed.launch equivalent — see main.py."""
+
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

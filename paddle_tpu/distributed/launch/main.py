@@ -1,0 +1,229 @@
+"""Distributed launcher (reference: python/paddle/distributed/launch/main.py:20
+`launch()`, controllers/collective.py, controllers/master.py).
+
+`python -m paddle_tpu.distributed.launch [--nnodes N] [--nproc_per_node P]
+[--master host:port] [--rank R] [--log_dir dir] [--elastic_level L]
+script.py args...`
+
+TPU-native redesign: the HTTP/etcd master is replaced by the framework's
+own native TCPStore (paddle_tpu/_native/tcp_store.cc) — rank 0's launcher
+hosts it; every launcher registers its pod, barriers, then spawns local
+worker processes with the PADDLE_* / jax.distributed environment.  On TPU
+pods the normal deployment is one process per host (nproc_per_node=1) and
+XLA owns intra-host chips; nproc_per_node>1 is the CPU/debug path."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None, help="rank0 endpoint host:port (TCPStore)")
+    p.add_argument("--nnodes", type=int, default=1, help="number of nodes (pods)")
+    p.add_argument("--rank", type=int, default=None, help="this node's rank; -1 = auto-assign")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default=None)
+    p.add_argument("--devices", default=None, help="visible device ids, comma-separated")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help=">0: restart failed local workers up to this many times")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class _Master:
+    """Rendezvous over the native TCPStore: node rank assignment + barrier +
+    worker endpoint exchange (reference controllers/master.py HTTP/etcd
+    masters).
+
+    Exactly one launcher — the one started with --rank 0 — hosts the store
+    (it must be up before peers can connect, so it always claims rank 0
+    first).  Other launchers either pass an explicit distinct rank or omit
+    --rank to auto-assign; auto-assigned ranks start at 1 because rank 0
+    is always the host's.  Mixing auto-assign with explicit ranks > 0 is
+    not supported."""
+
+    def __init__(self, endpoint, nnodes, is_host):
+        from paddle_tpu.distributed.bootstrap import host_or_connect
+
+        self.nnodes = nnodes
+        self.server, self.client = host_or_connect(endpoint, is_host)
+
+    def assign_rank(self, requested):
+        if requested is not None and requested >= 0:
+            return requested
+        # counter yields 1, 2, ... — rank 0 is always the hosting launcher
+        return self.client.add("launch/next_rank", 1)
+
+    def barrier(self, key, n):
+        from paddle_tpu.distributed.bootstrap import store_barrier
+
+        store_barrier(self.client, f"launch/{key}", n)
+
+    def put(self, key, value: str):
+        self.client.set(key, value.encode())
+
+    def get(self, key) -> str:
+        return self.client.get(key, timeout_ms=600_000).decode()
+
+    def close(self):
+        self.client.close()
+        if self.server:
+            self.server.stop()
+
+
+def _local_ip():
+    import socket
+
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+_CACHED_FREE_PORT = None
+
+
+def _free_port():
+    # one stable port per launcher process so all local workers agree
+    global _CACHED_FREE_PORT
+    if _CACHED_FREE_PORT is None:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            _CACHED_FREE_PORT = s.getsockname()[1]
+    return _CACHED_FREE_PORT
+
+
+def _worker_env(args, node_rank, local_rank, world_size, master_host):
+    env = dict(os.environ)
+    global_rank = node_rank * args.nproc_per_node + local_rank
+    coord_port = int(os.environ.get("PADDLE_COORD_PORT", "8476"))
+    env.update(
+        PADDLE_TRAINER_ID=str(global_rank),
+        PADDLE_TRAINERS_NUM=str(world_size),
+        PADDLE_LOCAL_RANK=str(local_rank),
+        PADDLE_LOCAL_SIZE=str(args.nproc_per_node),
+        PADDLE_NNODES=str(args.nnodes),
+        PADDLE_NODE_RANK=str(node_rank),
+        PADDLE_MASTER=f"{master_host}:{coord_port}",
+        MASTER_ADDR=master_host,
+        MASTER_PORT=str(coord_port),
+        RANK=str(global_rank),
+        WORLD_SIZE=str(world_size),
+        PADDLE_JOB_ID=args.job_id or "default",
+        POD_IP=os.environ.get("POD_IP", _local_ip()),
+        PADDLE_MASTER_ENDPOINT=(args.master if args.master else f"{master_host}:{_free_port()}"),
+    )
+    if args.devices is not None:
+        devs = args.devices.split(",")
+        env["TPU_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+    return env
+
+
+def _spawn(args, node_rank, world_size, master_host):
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for lr in range(args.nproc_per_node):
+        env = _worker_env(args, node_rank, lr, world_size, master_host)
+        grank = env["PADDLE_TRAINER_ID"]
+        logf = open(os.path.join(args.log_dir, f"workerlog.{grank}"), "ab")
+        cmd = [sys.executable, "-u", args.script, *args.script_args]
+        procs.append((subprocess.Popen(cmd, env=env, stdout=logf, stderr=subprocess.STDOUT), logf))
+    return procs
+
+
+def _kill(procs):
+    for p, _ in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p, logf in procs:
+        try:
+            p.wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+        logf.close()
+
+
+def launch(argv=None):
+    """Entry (reference launch/main.py:20)."""
+    args = _parse_args(argv)
+    args.job_id = args.job_id or f"job-{uuid.uuid4().hex[:8]}"
+    world_size = args.nnodes * args.nproc_per_node
+
+    if args.nnodes > 1:
+        if args.master is None:
+            raise SystemExit("--master host:port is required for multi-node launch")
+        host = args.master.split(":")[0]
+        # node rank 0 hosts the store; detect by explicit --rank 0 or local ip
+        is_host = args.rank == 0
+        master = _Master(args.master, args.nnodes, is_host)
+        node_rank = master.assign_rank(args.rank)
+        master.put(f"launch/node/{node_rank}", os.uname().nodename)
+        master.barrier("start", args.nnodes)
+        master_host = host
+    else:
+        master = None
+        node_rank = 0
+        master_host = "127.0.0.1"
+
+    attempts = 0
+    status = 0
+    while True:
+        procs = _spawn(args, node_rank, world_size, master_host)
+        status = _watch(procs)
+        if status == 0:
+            break
+        attempts += 1
+        if attempts > args.elastic_level:
+            break
+        print(f"[launch] workers failed (exit {status}); restart {attempts}/{args.elastic_level}",
+              flush=True)
+        time.sleep(2)
+
+    if master:
+        master.barrier("finish", args.nnodes)
+        master.close()
+    return status
+
+
+def _watch(procs):
+    """Monitor workers; on any failure kill the rest (reference
+    controllers/controller.py watch loop)."""
+    try:
+        while True:
+            alive = False
+            for p, _ in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    _kill(procs)
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        _kill(procs)
+        return 130
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

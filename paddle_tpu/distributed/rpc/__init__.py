@@ -1,0 +1,207 @@
+"""paddle.distributed.rpc equivalent (reference:
+python/paddle/distributed/rpc/rpc.py — init_rpc, rpc_sync, rpc_async,
+shutdown, get_worker_info/get_all_worker_infos, over a brpc agent).
+
+TPU-native redesign: workers exchange endpoints through the framework's
+native TCPStore, then serve pickled fn calls over a plain TCP socket
+thread — RPC here is control-plane (orchestration, PS-style coordination),
+never tensor compute, so a simple length-prefixed pickle protocol is the
+right weight."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+
+__all__ = [
+    "init_rpc", "shutdown", "rpc_sync", "rpc_async",
+    "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+    "WorkerInfo",
+]
+
+_DEFAULT_TIMEOUT = 30.0
+
+
+class WorkerInfo:
+    """reference rpc.py WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank}, ip={self.ip}, port={self.port})"
+
+
+class _State:
+    store = None
+    server_sock = None
+    server_thread = None
+    workers = {}  # name -> WorkerInfo
+    current = None
+    stopping = False
+
+
+_S = _State()
+
+
+def _recv_all(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(conn, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(conn):
+    (n,) = struct.unpack("<Q", _recv_all(conn, 8))
+    return pickle.loads(_recv_all(conn, n))
+
+
+def _serve_loop(sock):
+    while not _S.stopping:
+        try:
+            conn, _ = sock.accept()
+        except OSError:
+            return
+        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+
+
+def _handle(conn):
+    try:
+        with conn:
+            fn, args, kwargs = _recv_msg(conn)
+            try:
+                result = fn(*args, **kwargs)
+                reply = ("ok", result)
+            except Exception as e:  # noqa: BLE001 — errors travel to caller
+                reply = ("err", e)
+            try:
+                _send_msg(conn, reply)
+            except Exception:  # unpicklable result/exception
+                _send_msg(
+                    conn,
+                    ("err", RuntimeError(f"rpc reply not picklable: {reply[1]!r:.500}")),
+                )
+    except (ConnectionError, EOFError, OSError):
+        pass
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """reference rpc.py:73 — register this worker, exchange infos, barrier."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (
+        int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) if world_size is None else world_size
+    )
+    master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT", "127.0.0.1:18765")
+
+    from paddle_tpu.distributed.bootstrap import host_or_connect
+
+    if rank == 0:
+        try:
+            _S.store_server, _S.store = host_or_connect(master_endpoint, True, timeout_ms=60_000)
+        except OSError:
+            _S.store_server = None  # another rank-0 process already hosts it
+            _, _S.store = host_or_connect(master_endpoint, False, timeout_ms=60_000)
+    else:
+        _S.store_server, _S.store = host_or_connect(master_endpoint, False, timeout_ms=60_000)
+
+    # serve on an ephemeral port
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("0.0.0.0", 0))
+    sock.listen(64)
+    my_port = sock.getsockname()[1]
+    my_ip = os.environ.get("POD_IP")
+    if not my_ip:
+        if world_size > 1:  # must advertise a reachable address
+            my_ip = socket.gethostbyname(socket.gethostname())
+        else:
+            my_ip = "127.0.0.1"
+    _S.server_sock = sock
+    _S.stopping = False
+    _S.server_thread = threading.Thread(target=_serve_loop, args=(sock,), daemon=True)
+    _S.server_thread.start()
+
+    info = (name, rank, my_ip, my_port)
+    _S.store.set(f"rpc/worker/{rank}", pickle.dumps(info))
+    _S.current = WorkerInfo(*info)
+
+    for r in range(world_size):
+        w = pickle.loads(_S.store.get(f"rpc/worker/{r}", timeout_ms=120_000))
+        _S.workers[w[0]] = WorkerInfo(*w)
+
+
+def get_worker_info(name):
+    return _S.workers[name]
+
+
+def get_all_worker_infos():
+    return list(_S.workers.values())
+
+
+def get_current_worker_info():
+    return _S.current
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """reference rpc.py:183 — returns a Future."""
+    w = _S.workers[to]
+    fut = Future()
+
+    def call():
+        try:
+            with socket.create_connection((w.ip, w.port), timeout=timeout) as conn:
+                conn.settimeout(timeout)
+                _send_msg(conn, (fn, tuple(args or ()), dict(kwargs or {})))
+                status, payload = _recv_msg(conn)
+            if status == "ok":
+                fut.set_result(payload)
+            else:
+                fut.set_exception(payload)
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=call, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """reference rpc.py:143."""
+    return rpc_async(to, fn, args, kwargs, timeout).result(timeout)
+
+
+def shutdown():
+    """reference rpc.py shutdown — barrier then stop serving."""
+    if _S.store is None:
+        return
+    from paddle_tpu.distributed.bootstrap import store_barrier
+
+    try:
+        store_barrier(_S.store, "rpc/shutdown", len(_S.workers), timeout_ms=30_000)
+    except Exception:
+        pass
+    _S.stopping = True
+    try:
+        _S.server_sock.close()
+    except Exception:
+        pass
+    _S.store.close()
+    server = getattr(_S, "store_server", None)
+    if server is not None:
+        server.stop()
+    _S.store = None
+    _S.workers.clear()
