@@ -79,6 +79,8 @@ for _sub in (
     "quantization",
     "onnx",
     "linalg",
+    "utils",
+    "decomposition",
 ):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)

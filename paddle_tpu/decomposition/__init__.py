@@ -1,0 +1,206 @@
+"""paddle.decomposition equivalent (reference:
+python/paddle/decomposition/decomp.py:192 `decompose` — rewrite composite
+ops in a program into the primitive set, using the composite rules in
+paddle/fluid/primitive/composite/ and generated VJP rules).
+
+TPU-native redesign: the primitive set IS jax's primitive set.  Each
+Operator in a static Program carries a traceable `fn`; `decompose` traces
+it with jax.make_jaxpr, inlines higher-order primitives (pjit /
+custom_jvp / custom_vjp / remat), and splices one Operator per remaining
+jaxpr equation back into the block — preserving the op's output Variables
+so feeds/fetches/writes stay valid.  Composite ops like softmax or
+layer_norm therefore decompose into exp/div/reduce/… exactly as the
+reference's composite rules would, but mechanically and for every op."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.static.program import Operator, Program, Variable, suspend_capture
+
+__all__ = ["decompose", "decompose_op", "is_primitive_op"]
+
+# higher-order primitives whose inner jaxpr we inline
+_INLINE = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "remat2": "jaxpr",
+    "checkpoint": "jaxpr",
+}
+
+
+def is_primitive_op(program, op) -> bool:
+    """True if the op's fn traces to a single first-order equation."""
+    try:
+        jaxpr = _op_jaxpr(program, op)
+    except Exception:  # non-traceable (callbacks etc.) — leave as-is
+        return True
+    eqns = jaxpr.jaxpr.eqns
+    return len(eqns) <= 1 and (not eqns or eqns[0].primitive.name not in _INLINE)
+
+
+def _op_jaxpr(program, op):
+    in_avals = []
+    for kind, val in op.arg_spec:
+        if kind == "var":
+            v = program._var_by_vid[val]
+            in_avals.append(jax.ShapeDtypeStruct(v._value.shape, v._value.dtype))
+    return jax.make_jaxpr(op.fn)(*in_avals)
+
+
+def _prim_fn(primitive, params):
+    if primitive.multiple_results:
+        return lambda *a: tuple(primitive.bind(*a, **params))
+    return lambda *a: primitive.bind(*a, **params)
+
+
+def _emit(program, type_, fn, in_entries, out_vars=None):
+    """Append an Operator with explicit inputs; returns output Variables.
+
+    in_entries: list of ('var', Variable) | ('const', value).
+    out_vars: existing Variables to write (splice back into old vids)."""
+    arg_spec = []
+    in_avals = []
+    var_slots = []
+    for i, (kind, val) in enumerate(in_entries):
+        if kind == "var":
+            arg_spec.append(("var", val._vid))
+            in_avals.append(jax.ShapeDtypeStruct(val._value.shape, val._value.dtype))
+            var_slots.append(i)
+        else:
+            arg_spec.append(("const", val))
+    slot_set = set(var_slots)
+    n_args = len(in_entries)
+
+    def g(*var_vals):
+        it = iter(var_vals)
+        full = [next(it) if i in slot_set else arg_spec[i][1] for i in range(n_args)]
+        with suspend_capture():
+            return fn(*full)
+
+    out_shape = jax.eval_shape(g, *in_avals)
+    flat, tree = jax.tree_util.tree_flatten(out_shape)
+    if out_vars is None:
+        outs = [program.new_var(jax.ShapeDtypeStruct(o.shape, o.dtype)) for o in flat]
+    else:
+        outs = out_vars
+    op = Operator(type_, g, arg_spec, {}, [o._vid for o in outs], tree)
+    return op, outs
+
+
+def _flatten_jaxpr(program, closed_jaxpr, in_entries, final_out_vars, new_ops):
+    """Record one Operator per first-order eqn; inline higher-order eqns.
+
+    in_entries: program-level ('var', Variable)/('const', value) per invar.
+    final_out_vars: existing Variables for the jaxpr's outvars (or None)."""
+    jaxpr = closed_jaxpr.jaxpr
+    env = {}
+    for var, entry in zip(jaxpr.invars, in_entries):
+        env[var] = entry
+    for var, const in zip(jaxpr.constvars, closed_jaxpr.consts):
+        env[var] = ("const", const)
+
+    def read(v):
+        if isinstance(v, jax.extend.core.Literal):
+            return ("const", v.val)
+        return env[v]
+
+    outvar_set = {id(v): i for i, v in enumerate(jaxpr.outvars) if not isinstance(v, jax.extend.core.Literal)}
+
+    for eqn in jaxpr.eqns:
+        ins = [read(v) for v in eqn.invars]
+        name = eqn.primitive.name
+        if name in _INLINE:
+            inner = eqn.params[_INLINE[name]]
+            if hasattr(inner, "jaxpr"):
+                inner_closed = inner
+            else:  # plain Jaxpr
+                inner_closed = jax.extend.core.ClosedJaxpr(inner, ())
+            results = _flatten_jaxpr(program, inner_closed, ins, None, new_ops)
+            for v, r in zip(eqn.outvars, results):
+                env[v] = r
+            continue
+        # final outputs that map 1:1 to an existing Variable reuse it
+        outs_spec = None
+        if final_out_vars is not None and len(eqn.outvars) == 1:
+            ov = eqn.outvars[0]
+            if id(ov) in outvar_set and _last_def(jaxpr, ov) is eqn:
+                outs_spec = [final_out_vars[outvar_set[id(ov)]]]
+        op, outs = _emit(program, name, _prim_fn(eqn.primitive, dict(eqn.params)), ins, outs_spec)
+        new_ops.append(op)
+        if eqn.primitive.multiple_results:
+            for v, o in zip(eqn.outvars, outs):
+                env[v] = ("var", o)
+        else:
+            env[eqn.outvars[0]] = ("var", outs[0])
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _last_def(jaxpr, var):
+    last = None
+    for eqn in jaxpr.eqns:
+        if any(v is var for v in eqn.outvars):
+            last = eqn
+    return last
+
+
+def decompose_op(program, op, new_ops, closed=None):
+    """Decompose one Operator; appends primitive Operators to new_ops."""
+    if closed is None:
+        closed = _op_jaxpr(program, op)
+    in_entries = []
+    for kind, val in op.arg_spec:
+        if kind == "var":
+            in_entries.append(("var", program._var_by_vid[val]))
+    out_vars = [program._var_by_vid[vid] for vid in op.out_vids]
+    results = _flatten_jaxpr(program, closed, in_entries, out_vars, new_ops)
+    # any outvar not spliced in place gets an identity copy into the old var
+    for entry, var in zip(results, out_vars):
+        if entry[0] == "var" and entry[1] is var:
+            continue
+        if entry[0] == "const":
+            cop, _ = _emit(program, "broadcast_in_dim",
+                           lambda c=entry[1]: jnp.asarray(c), [], [var])
+        else:
+            cop, _ = _emit(program, "copy", lambda x: x, [("var", entry[1])], [var])
+        new_ops.append(cop)
+
+
+def decompose(program: Program, src_vars=None, blacklist=None, whitelist=None):
+    """Rewrite composite ops into jax-primitive ops, in place (reference
+    decomp.py:192).  whitelist: only these op types; blacklist: never these.
+    Returns the program's dst vars for parity with the reference signature
+    (src_vars pass through — vids are preserved)."""
+    blacklist = set(blacklist or ())
+    whitelist = set(whitelist) if whitelist else None
+    block = program.global_block()
+    new_list = []
+    for op in block.ops:
+        eligible = op.type not in blacklist and (whitelist is None or op.type in whitelist)
+        if not eligible:
+            new_list.append(op)
+            continue
+        try:
+            closed = _op_jaxpr(program, op)  # traced once, reused below
+        except Exception:
+            new_list.append(op)  # untraceable op stays composite
+            continue
+        eqns = closed.jaxpr.eqns
+        if len(eqns) <= 1 and (not eqns or eqns[0].primitive.name not in _INLINE):
+            new_list.append(op)  # already primitive — keep op + its kwargs
+            continue
+        try:
+            ops_out = []
+            decompose_op(program, op, ops_out, closed)
+        except Exception:
+            new_list.append(op)
+            continue
+        new_list.extend(ops_out)
+    block.ops = new_list
+    program.version += 1
+    return src_vars if src_vars is not None else program

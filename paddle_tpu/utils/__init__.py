@@ -1,0 +1,17 @@
+"""paddle.utils equivalent (reference: python/paddle/utils/) — currently
+cpp_extension (custom C++ op build/load) plus small helpers."""
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]
+
+
+def run_check():
+    """paddle.utils.run_check equivalent: verify the device stack works."""
+    import jax
+    import jax.numpy as jnp
+
+    n = len(jax.devices())
+    out = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    assert float(out[0, 0]) == 8.0
+    print(f"paddle_tpu is installed successfully! {n} device(s) available.")

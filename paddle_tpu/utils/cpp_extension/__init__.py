@@ -1,0 +1,247 @@
+"""paddle.utils.cpp_extension equivalent (reference:
+python/paddle/utils/cpp_extension/cpp_extension.py — setup/CppExtension/
+CUDAExtension/BuildExtension/load building custom C++ ops).
+
+TPU-native design: a custom C++ op cannot be a device kernel (TPU kernels
+are Pallas/XLA), so loaded ops run as **host callbacks** — the C++ fn is
+compiled to a .so with g++, bound via ctypes, and wrapped in
+jax.pure_callback so it composes with jit/vmap; a paired `<name>_grad`
+symbol (reference PD_BUILD_GRAD_OP) becomes the op's custom_vjp.  This is
+the honest mapping of the reference's CPU custom-op path; performance-
+critical custom TPU ops should be written as Pallas kernels instead
+(paddle_tpu/ops/)."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["load", "setup", "CppExtension", "CUDAExtension", "get_build_directory", "CustomOpModule"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_DTYPES = {
+    np.dtype("float32"): 0,
+    np.dtype("float64"): 1,
+    np.dtype("int32"): 2,
+    np.dtype("int64"): 3,
+    np.dtype("bool"): 4,
+}
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "extensions"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class _PTExtTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("ndim", ctypes.c_int32),
+        ("dtype", ctypes.c_int32),
+    ]
+
+
+def _build(name, sources, extra_cxx_flags=()):
+    h = hashlib.sha256()
+    for s in sources:
+        h.update(open(s, "rb").read())
+    # the injected ABI header is part of the binary contract
+    h.update(open(os.path.join(_HERE, "paddle_tpu_ext.h"), "rb").read())
+    h.update(" ".join(extra_cxx_flags).encode())
+    out = os.path.join(get_build_directory(), f"{name}-{h.hexdigest()[:16]}.so")
+    if not os.path.exists(out):
+        tmp = f"{out}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+            f"-I{_HERE}", *extra_cxx_flags, *sources, "-o", tmp,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"custom op build failed:\n{e.stderr.decode(errors='replace')}"
+            ) from None
+        os.replace(tmp, out)
+    return out
+
+
+def _make_tensor_array(arrays, keepalive):
+    arr_t = (_PTExtTensor * len(arrays))()
+    for i, a in enumerate(arrays):
+        a = np.ascontiguousarray(a)
+        keepalive.append(a)
+        shape = (ctypes.c_int64 * a.ndim)(*a.shape)
+        keepalive.append(shape)
+        arr_t[i].data = a.ctypes.data_as(ctypes.c_void_p)
+        arr_t[i].shape = shape
+        arr_t[i].ndim = a.ndim
+        arr_t[i].dtype = _DTYPES[a.dtype]
+    return arr_t
+
+
+class _LoadedOp:
+    """One custom op: host callback + optional custom vjp."""
+
+    def __init__(self, lib, name, infer_shape, infer_dtype, n_outputs, grad_sym):
+        self._fn = getattr(lib, name)
+        self._fn.restype = ctypes.c_int
+        self._grad = grad_sym
+        if self._grad is not None:
+            self._grad.restype = ctypes.c_int
+        self.name = name
+        self.infer_shape = infer_shape or (lambda *shapes: [shapes[0]] * n_outputs)
+        self.infer_dtype = infer_dtype or (lambda *dts: [dts[0]] * n_outputs)
+        self.n_outputs = n_outputs
+        self._callable = self._build_callable()
+
+    def _host_call(self, fn, inputs, out_shapes, out_dtypes):
+        keep = []
+        ins = _make_tensor_array(inputs, keep)
+        # np.zeros buffers are already contiguous, so _make_tensor_array
+        # passes them through and the C op writes them in place
+        outs_np = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+        outs = _make_tensor_array(outs_np, keep)
+        rc = fn(ins, len(inputs), outs, len(outs_np))
+        if rc != 0:
+            raise RuntimeError(f"custom op {self.name} returned {rc}")
+        return outs_np
+
+    def _build_callable(self):
+        def forward_host(*inputs):
+            shapes = self.infer_shape(*[tuple(i.shape) for i in inputs])
+            dtypes = self.infer_dtype(*[i.dtype for i in inputs])
+            return tuple(self._host_call(self._fn, list(inputs), shapes, dtypes))
+
+        def apply(*inputs):
+            arrs = [i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+            out = _callback(*arrs)
+            res = [Tensor(o) for o in out]
+            return res[0] if self.n_outputs == 1 else res
+
+        def _cb_fwd(*arrs):
+            shapes = self.infer_shape(*[tuple(a.shape) for a in arrs])
+            dtypes = self.infer_dtype(*[np.dtype(a.dtype) for a in arrs])
+            out_spec = tuple(jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes))
+            return jax.pure_callback(forward_host, out_spec, *arrs, vmap_method="sequential")
+
+        if self._grad is None:
+            _callback = _cb_fwd
+        else:
+            grad_c = self._grad
+
+            @jax.custom_vjp
+            def _callback(*arrs):
+                return _cb_fwd(*arrs)
+
+            def fwd(*arrs):
+                outs = _cb_fwd(*arrs)
+                return outs, (arrs, outs)
+
+            def bwd(res, cts):
+                arrs, outs = res
+
+                def grad_host(*all_ins):
+                    n_x = len(arrs)
+                    xs = all_ins[:n_x]
+                    rest = all_ins[n_x:]
+                    shapes = [tuple(x.shape) for x in xs]
+                    dts = [x.dtype for x in xs]
+                    return tuple(
+                        self._host_call(grad_c, list(all_ins), shapes, dts)
+                    )
+
+                spec = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
+                grads = jax.pure_callback(
+                    grad_host, spec, *arrs, *outs, *cts, vmap_method="sequential"
+                )
+                return tuple(grads)
+
+            _callback.defvjp(fwd, bwd)
+
+        return apply
+
+    def __call__(self, *inputs):
+        return self._callable(*inputs)
+
+
+class CustomOpModule:
+    """Namespace of loaded ops (mirror of the reference's generated python
+    module from load(), extension_utils.py _generate_python_module)."""
+
+    def __init__(self):
+        self._ops = {}
+
+    def _add(self, op):
+        self._ops[op.name] = op
+        setattr(self, op.name, op)
+
+
+def load(name, sources, extra_cxx_flags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False, op_names=None, infer_shape=None, infer_dtype=None,
+         n_outputs=1):
+    """Build + load custom ops (reference cpp_extension.py:797).
+
+    op_names: list of exported op symbols; defaults to [name].  For each op,
+    a `<op>_grad` symbol (if present) becomes its vjp:
+        grad(ins..., outs..., out_grads...) -> input grads.
+    """
+    flags = list(extra_cxx_flags or [])
+    for p in extra_include_paths or []:
+        flags.append(f"-I{p}")
+    path = _build(name, sources, flags)
+    lib = ctypes.CDLL(path)
+    module = CustomOpModule()
+    for op_name in op_names or [name]:
+        grad_sym = None
+        try:
+            grad_sym = getattr(lib, f"{op_name}_grad")
+        except AttributeError:
+            pass
+        module._add(
+            _LoadedOp(lib, op_name, infer_shape, infer_dtype, n_outputs, grad_sym)
+        )
+    return module
+
+
+def CppExtension(sources, *args, **kwargs):
+    """reference cpp_extension.py:239 — returns a setuptools Extension."""
+    from setuptools import Extension
+
+    kwargs.setdefault("include_dirs", []).append(_HERE)
+    kwargs.setdefault("language", "c++")
+    name = kwargs.pop("name", "paddle_tpu_custom_ops")
+    return Extension(name, sources, *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """CUDA has no meaning on TPU; accepted for API compat and built as a
+    plain C++ extension with .cu files rejected (reference :289)."""
+    cu = [s for s in sources if s.endswith(".cu")]
+    if cu:
+        raise ValueError(
+            f"CUDA sources {cu} cannot target TPU — port device code to a "
+            "Pallas kernel (paddle_tpu/ops) and keep host code in .cc files"
+        )
+    return CppExtension(sources, *args, **kwargs)
+
+
+def setup(**attr):
+    """reference cpp_extension.py:79 — delegates to setuptools.setup with
+    the C++ build configured."""
+    from setuptools import setup as _setup
+
+    attr.setdefault("ext_modules", [])
+    return _setup(**attr)

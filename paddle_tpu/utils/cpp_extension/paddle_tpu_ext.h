@@ -1,0 +1,37 @@
+// Custom-op C ABI for paddle_tpu (reference: paddle/phi/api/ext/op_meta_info.h
+// PD_BUILD_OP + paddle/phi/capi/ C ABI — redesigned for the TPU runtime:
+// custom C++ ops execute as host callbacks alongside the XLA program, so the
+// ABI is a plain tensor-view struct, no device context).
+//
+// A custom op source defines:
+//   extern "C" int my_op(const PTExtTensor* inputs, int n_inputs,
+//                        PTExtTensor* outputs, int n_outputs);
+// returning 0 on success.  Outputs are pre-allocated by the framework using
+// the shape inference declared at load() time.
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+typedef enum {
+  PT_FLOAT32 = 0,
+  PT_FLOAT64 = 1,
+  PT_INT32 = 2,
+  PT_INT64 = 3,
+  PT_BOOL = 4,
+} PTExtDtype;
+
+typedef struct {
+  void* data;           // contiguous row-major buffer
+  const int64_t* shape; // dims
+  int32_t ndim;
+  int32_t dtype;        // PTExtDtype
+} PTExtTensor;
+
+static inline int64_t pt_numel(const PTExtTensor* t) {
+  int64_t n = 1;
+  for (int i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+}  // extern "C"
