@@ -1,0 +1,282 @@
+"""paddle.vision.datasets equivalent (reference:
+python/paddle/vision/datasets/ — MNIST/FashionMNIST (mnist.py), Cifar10/
+Cifar100 (cifar.py), Flowers (flowers.py), DatasetFolder/ImageFolder
+(folder.py), VOC2012 (voc2012.py)).
+
+No network in this environment: every dataset takes the same archive files
+the reference downloads (image_path/label_path/data_file) and parses them
+identically; constructing without the files raises with the expected
+layout."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = [
+    "MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+    "DatasetFolder", "ImageFolder", "VOC2012",
+]
+
+
+def _require(path, name, what):
+    if path is None or not os.path.exists(path):
+        raise RuntimeError(
+            f"{name} requires a local copy (no network): pass {what}"
+        )
+
+
+class MNIST(Dataset):
+    """reference vision/datasets/mnist.py:27 — idx-format image/label
+    files (optionally .gz)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        _require(image_path, self.NAME, "image_path (idx3-ubyte[.gz])")
+        _require(label_path, self.NAME, "label_path (idx1-ubyte[.gz])")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+        return data.reshape(n, rows, cols).astype(np.float32)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic}")
+            return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    """reference vision/datasets/mnist.py FashionMNIST — same idx format."""
+
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """reference vision/datasets/cifar.py:29 — python-pickle batch archive
+    (cifar-10-python.tar.gz)."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        _require(data_file, type(self).__name__, "data_file (the python-version tar.gz)")
+        self.transform = transform
+        wanted = self._train_members if mode == "train" else self._test_members
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in wanted:
+                    batch = pickle.loads(tf.extractfile(member).read(), encoding="bytes")
+                    images.append(batch[b"data"])
+                    labels.extend(batch[self._label_key])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32).astype(np.float32)
+        self.images = data
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    """reference vision/datasets/cifar.py Cifar100."""
+
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """reference vision/datasets/flowers.py:33 — 102flowers images +
+    imagelabels.mat + setid.mat."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        _require(data_file, "Flowers", "data_file (102flowers.tgz)")
+        _require(label_file, "Flowers", "label_file (imagelabels.mat)")
+        _require(setid_file, "Flowers", "setid_file (setid.mat)")
+        import scipy.io as sio
+
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"][0]
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        self.labels = labels
+        # keep one open handle: gzip tars have no random access, so
+        # reopening per item would decompress half the archive each time
+        self._tar = tarfile.open(data_file)
+        self._members = {
+            os.path.basename(m.name): m.name
+            for m in self._tar.getmembers()
+            if m.name.endswith(".jpg")
+        }
+
+    def __getitem__(self, idx):
+        flower_id = int(self.indexes[idx])
+        name = f"image_{flower_id:05d}.jpg"
+        raw = self._tar.extractfile(self._members[name]).read()
+        img = _decode_image(raw)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[flower_id - 1] - 1, np.int64)
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+def _decode_image(raw):
+    try:
+        from PIL import Image
+        import io
+
+        return np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+    except ImportError:
+        raise RuntimeError("image decoding requires Pillow") from None
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif", ".tiff", ".webp", ".npy")
+
+
+def _walk_files(root, extensions, is_valid_file):
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            path = os.path.join(dirpath, fn)
+            ok = is_valid_file(path) if is_valid_file else fn.lower().endswith(extensions)
+            if ok:
+                yield path
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (reference
+    vision/datasets/folder.py:60)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        _require(root, "DatasetFolder", "root directory")
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = tuple(extensions) if extensions else _IMG_EXTS
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _walk_files(os.path.join(root, c), extensions, is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        with open(path, "rb") as f:
+            return _decode_image(f.read())
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat/unlabelled image tree (reference vision/datasets/folder.py:253)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        _require(root, "ImageFolder", "root directory")
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = tuple(extensions) if extensions else _IMG_EXTS
+        self.samples = list(_walk_files(root, extensions, is_valid_file))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """reference vision/datasets/voc2012.py:28 — segmentation pairs from
+    the VOCtrainval tar."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        _require(data_file, "VOC2012", "data_file (VOCtrainval_11-May-2012.tar)")
+        self.transform = transform
+        base = "VOCdevkit/VOC2012"
+        # reference voc2012.py split map: train->trainval, valid->val,
+        # test->train (VOC's real test set is not in the trainval archive)
+        split = {"train": "trainval", "valid": "val", "test": "train"}[mode]
+        self._tar = tarfile.open(data_file)
+        lst = self._tar.extractfile(f"{base}/ImageSets/Segmentation/{split}.txt").read().decode()
+        self.names = [n.strip() for n in lst.splitlines() if n.strip()]
+        self._base = base
+
+    def __getitem__(self, idx):
+        name = self.names[idx]
+        img = _decode_image(self._tar.extractfile(f"{self._base}/JPEGImages/{name}.jpg").read())
+        lbl = _decode_image(self._tar.extractfile(f"{self._base}/SegmentationClass/{name}.png").read())
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.names)

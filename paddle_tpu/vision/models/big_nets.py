@@ -1,0 +1,461 @@
+"""DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2 (reference:
+python/paddle/vision/models/{densenet,googlenet,inceptionv3,
+shufflenetv2}.py — standard architectures on this framework's nn layers)."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+from .mobilenet import _ConvBNReLU as _ConvBNAct
+
+__all__ = [
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201", "densenet264",
+    "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
+
+
+# DenseNet ------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return paddle.concat([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False), nn.AvgPool2D(2, 2),
+        )
+
+
+_DENSE_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseNet(nn.Layer):
+    """reference vision/models/densenet.py:300"""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = _DENSE_CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1),
+        ]
+        ch = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)
+
+
+# GoogLeNet -----------------------------------------------------------------
+
+class _BasicConv(nn.Sequential):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding, bias_attr=False),
+            nn.BatchNorm2D(out_c), nn.ReLU(),
+        )
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, c1, 1)
+        self.b2 = nn.Sequential(_BasicConv(in_c, c3r, 1), _BasicConv(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_BasicConv(in_c, c5r, 1), _BasicConv(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1), _BasicConv(in_c, proj, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """reference vision/models/googlenet.py:113 — returns (main, aux1, aux2)
+    like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BasicConv(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2, padding=1),
+            _BasicConv(64, 64, 1), _BasicConv(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, padding=1),
+        )
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (train-time deep supervision)
+            self.aux1 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), _BasicConv(512, 128, 1), nn.Flatten(),
+                nn.Linear(128 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes),
+            )
+            self.aux2 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), _BasicConv(528, 128, 1), nn.Flatten(),
+                nn.Linear(128 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return GoogLeNet(**kwargs)
+
+
+# InceptionV3 ---------------------------------------------------------------
+
+class _IncA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BasicConv(in_c, 48, 1), _BasicConv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(
+            _BasicConv(in_c, 64, 1), _BasicConv(64, 96, 3, padding=1),
+            _BasicConv(96, 96, 3, padding=1),
+        )
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BasicConv(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class _IncB(nn.Layer):  # grid reduction
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BasicConv(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(
+            _BasicConv(in_c, 64, 1), _BasicConv(64, 96, 3, padding=1),
+            _BasicConv(96, 96, 3, stride=2),
+        )
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _BasicConv(in_c, c7, 1),
+            _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv(c7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b7d = nn.Sequential(
+            _BasicConv(in_c, c7, 1),
+            _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv(c7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BasicConv(in_c, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class _IncD(nn.Layer):  # grid reduction 2
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BasicConv(in_c, 192, 1), _BasicConv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _BasicConv(in_c, 192, 1),
+            _BasicConv(192, 192, (1, 7), padding=(0, 3)),
+            _BasicConv(192, 192, (7, 1), padding=(3, 0)),
+            _BasicConv(192, 192, 3, stride=2),
+        )
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 320, 1)
+        self.b3_stem = _BasicConv(in_c, 384, 1)
+        self.b3_a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = nn.Sequential(_BasicConv(in_c, 448, 1), _BasicConv(448, 384, 3, padding=1))
+        self.bd_a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BasicConv(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.bd_stem(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s), self.bd_a(d), self.bd_b(d), self.bp(x)],
+            axis=1,
+        )
+
+
+class InceptionV3(nn.Layer):
+    """reference vision/models/inceptionv3.py:493"""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BasicConv(3, 32, 3, stride=2), _BasicConv(32, 32, 3),
+            _BasicConv(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _BasicConv(64, 80, 1), _BasicConv(80, 192, 3), nn.MaxPool2D(3, 2),
+        )
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return InceptionV3(**kwargs)
+
+
+# ShuffleNetV2 --------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = paddle.reshape(x, [n, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(in_c // 2, branch_c, 1, act=act),
+                _ConvBNAct(branch_c, branch_c, 3, stride=1, groups=branch_c, act=None),
+                _ConvBNAct(branch_c, branch_c, 1, act=act),
+            )
+        else:
+            self.branch1 = nn.Sequential(
+                _ConvBNAct(in_c, in_c, 3, stride=stride, groups=in_c, act=None),
+                _ConvBNAct(in_c, branch_c, 1, act=act),
+            )
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(in_c, branch_c, 1, act=act),
+                _ConvBNAct(branch_c, branch_c, 3, stride=stride, groups=branch_c, act=None),
+                _ConvBNAct(branch_c, branch_c, 1, act=act),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference vision/models/shufflenetv2.py:173"""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        chans = _SHUFFLE_CFG[scale]
+        self.conv1 = _ConvBNAct(3, chans[0], 3, stride=2, act=act_layer)
+        self.pool1 = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = chans[0]
+        for stage_i, repeat in enumerate([4, 8, 4]):
+            out_c = chans[stage_i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2, act_layer)]
+            for _ in range(repeat - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act_layer))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(in_c, chans[-1], 1, act=act_layer)
+        if with_pool:
+            self.pool_last = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool_last(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

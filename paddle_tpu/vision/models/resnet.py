@@ -13,6 +13,12 @@ __all__ = [
     "resnet50",
     "resnet101",
     "resnet152",
+    "resnext50_32x4d",
+    "resnext50_64x4d",
+    "resnext101_32x4d",
+    "resnext101_64x4d",
+    "resnext152_32x4d",
+    "resnext152_64x4d",
     "wide_resnet50_2",
     "wide_resnet101_2",
 ]
@@ -129,33 +135,59 @@ class ResNet(nn.Layer):
         return x
 
 
-def _resnet(block, depth, width=64, **kwargs):
+def _resnet(block, depth, width=64, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable offline")
     return ResNet(block, depth, width=width, **kwargs)
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, **kwargs)
+    return _resnet(BasicBlock, 18, **kwargs, pretrained=pretrained)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, **kwargs)
+    return _resnet(BasicBlock, 34, **kwargs, pretrained=pretrained)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, **kwargs)
+    return _resnet(BottleneckBlock, 50, **kwargs, pretrained=pretrained)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, **kwargs)
+    return _resnet(BottleneckBlock, 101, **kwargs, pretrained=pretrained)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, **kwargs)
+    return _resnet(BottleneckBlock, 152, **kwargs, pretrained=pretrained)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=128, **kwargs)
+    return _resnet(BottleneckBlock, 50, width=128, **kwargs, pretrained=pretrained)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, width=128, **kwargs)
+    return _resnet(BottleneckBlock, 101, width=128, **kwargs, pretrained=pretrained)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, groups=32, **kwargs, pretrained=pretrained)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, groups=64, **kwargs, pretrained=pretrained)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, groups=32, **kwargs, pretrained=pretrained)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, groups=64, **kwargs, pretrained=pretrained)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=32, **kwargs, pretrained=pretrained)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=64, **kwargs, pretrained=pretrained)

@@ -1,0 +1,184 @@
+"""Vision model zoo + datasets tests (reference model:
+test/legacy_test/test_vision_models.py — build each family, forward a
+small input, check output shape; dataset parsers against synthesized
+archives)."""
+
+import gzip
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models
+
+
+def npv(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def _check(model, num_classes=10, size=64, in_ch=3):
+    model.eval()
+    x = paddle.randn([1, in_ch, size, size])
+    out = model(x)
+    if isinstance(out, tuple):
+        out = out[0]
+    assert tuple(out.shape) == (1, num_classes)
+    assert np.isfinite(npv(out)).all()
+
+
+class TestModelZoo:
+    def test_lenet(self):
+        m = models.LeNet(num_classes=10)
+        m.eval()
+        out = m(paddle.randn([2, 1, 28, 28]))
+        assert tuple(out.shape) == (2, 10)
+
+    def test_alexnet(self):
+        _check(models.alexnet(num_classes=10), size=224)
+
+    @pytest.mark.parametrize("factory", [models.vgg11, models.vgg16])
+    def test_vgg(self, factory):
+        _check(factory(num_classes=10, batch_norm=True), size=64)
+
+    def test_squeezenet(self):
+        _check(models.squeezenet1_0(num_classes=10), size=96)
+        _check(models.squeezenet1_1(num_classes=10), size=96)
+
+    def test_mobilenets(self):
+        _check(models.mobilenet_v1(num_classes=10, scale=0.25), size=64)
+        _check(models.mobilenet_v2(num_classes=10, scale=0.25), size=64)
+        _check(models.mobilenet_v3_small(num_classes=10, scale=0.5), size=64)
+        _check(models.mobilenet_v3_large(num_classes=10, scale=0.35), size=64)
+
+    def test_shufflenet(self):
+        _check(models.shufflenet_v2_x0_25(num_classes=10), size=64)
+        _check(models.shufflenet_v2_swish(num_classes=10), size=64)
+
+    def test_densenet(self):
+        _check(models.densenet121(num_classes=10), size=64)
+
+    def test_googlenet_aux_outputs(self):
+        m = models.googlenet(num_classes=10)
+        m.eval()
+        out, aux1, aux2 = m(paddle.randn([1, 3, 96, 96]))
+        assert tuple(out.shape) == (1, 10)
+        assert tuple(aux1.shape) == (1, 10) and tuple(aux2.shape) == (1, 10)
+
+    def test_inception_v3(self):
+        _check(models.inception_v3(num_classes=10), size=160)
+
+    def test_resnext(self):
+        _check(models.resnext50_32x4d(num_classes=10), size=64)
+
+    def test_export_list_complete(self):
+        # every reference export name must exist
+        ref = ['ResNet','resnet18','resnet34','resnet50','resnet101','resnet152',
+               'resnext50_32x4d','resnext50_64x4d','resnext101_32x4d','resnext101_64x4d',
+               'resnext152_32x4d','resnext152_64x4d','wide_resnet50_2','wide_resnet101_2',
+               'VGG','vgg11','vgg13','vgg16','vgg19','MobileNetV1','mobilenet_v1',
+               'MobileNetV2','mobilenet_v2','MobileNetV3Small','MobileNetV3Large',
+               'mobilenet_v3_small','mobilenet_v3_large','LeNet','DenseNet','densenet121',
+               'densenet161','densenet169','densenet201','densenet264','AlexNet','alexnet',
+               'InceptionV3','inception_v3','SqueezeNet','squeezenet1_0','squeezenet1_1',
+               'GoogLeNet','googlenet','ShuffleNetV2','shufflenet_v2_x0_25',
+               'shufflenet_v2_x0_33','shufflenet_v2_x0_5','shufflenet_v2_x1_0',
+               'shufflenet_v2_x1_5','shufflenet_v2_x2_0','shufflenet_v2_swish']
+        missing = [n for n in ref if not hasattr(models, n)]
+        assert missing == []
+
+    def test_train_step_on_mobilenet(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        m = models.mobilenet_v2(num_classes=4, scale=0.25)
+        optimizer = opt.Adam(1e-3, parameters=m.parameters())
+        ce = nn.CrossEntropyLoss()
+        x = paddle.randn([4, 3, 32, 32])
+        y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        losses = []
+        for _ in range(8):
+            loss = ce(m(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestDatasets:
+    def _write_mnist(self, d, n=12):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 255, (n, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, n, dtype=np.uint8)
+        ip = os.path.join(d, "imgs.idx3-ubyte.gz")
+        lp = os.path.join(d, "labels.idx1-ubyte.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+        return ip, lp, imgs, labels
+
+    def test_mnist_parsing(self):
+        with tempfile.TemporaryDirectory() as d:
+            ip, lp, imgs, labels = self._write_mnist(d)
+            ds = datasets.MNIST(image_path=ip, label_path=lp)
+            assert len(ds) == 12
+            x, y = ds[3]
+            np.testing.assert_allclose(x, imgs[3].astype(np.float32))
+            assert int(y) == int(labels[3])
+
+    def test_mnist_requires_files(self):
+        with pytest.raises(RuntimeError, match="local copy"):
+            datasets.MNIST()
+
+    def test_cifar10_parsing(self):
+        import pickle
+        import tarfile
+
+        rng = np.random.default_rng(1)
+        with tempfile.TemporaryDirectory() as d:
+            arch = os.path.join(d, "cifar-10-python.tar.gz")
+            with tarfile.open(arch, "w:gz") as tf:
+                for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+                    batch = {
+                        b"data": rng.integers(0, 255, (4, 3072), dtype=np.uint8),
+                        b"labels": list(rng.integers(0, 10, 4)),
+                    }
+                    raw = pickle.dumps(batch)
+                    p = os.path.join(d, name)
+                    with open(p, "wb") as f:
+                        f.write(raw)
+                    tf.add(p, arcname=f"cifar-10-batches-py/{name}")
+            train = datasets.Cifar10(data_file=arch, mode="train")
+            test = datasets.Cifar10(data_file=arch, mode="test")
+            assert len(train) == 20 and len(test) == 4
+            x, y = train[0]
+            assert x.shape == (3, 32, 32)
+
+    def test_dataset_folder(self):
+        with tempfile.TemporaryDirectory() as d:
+            for cls in ["cat", "dog"]:
+                os.makedirs(os.path.join(d, cls))
+                for i in range(3):
+                    np.save(os.path.join(d, cls, f"{i}.npy"),
+                            np.zeros((4, 4, 3), np.float32))
+            ds = datasets.DatasetFolder(d)
+            assert ds.classes == ["cat", "dog"]
+            assert len(ds) == 6
+            img, target = ds[0]
+            assert img.shape == (4, 4, 3) and int(target) == 0
+            img, target = ds[5]
+            assert int(target) == 1
+
+    def test_image_folder(self):
+        with tempfile.TemporaryDirectory() as d:
+            for i in range(4):
+                np.save(os.path.join(d, f"{i}.npy"), np.ones((2, 2), np.float32))
+            ds = datasets.ImageFolder(d)
+            assert len(ds) == 4
+            (img,) = ds[1]
+            assert img.shape == (2, 2)
