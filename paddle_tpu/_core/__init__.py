@@ -1,8 +1,13 @@
-import jax as _jax
+"""Core runtime init.
 
-# Paddle dtype semantics: int64 creation defaults, float64 available.  XLA
-# still computes the hot path in bf16/f32 (models pass explicit dtypes);
-# x64 here is about API parity, not compute width.
-_jax.config.update("jax_enable_x64", True)
+Dtype-width policy (TPU-native): 64-bit types are NOT enabled.  TPU has no
+f64 ALU and emulates i64; worse, with jax_enable_x64 the Mosaic kernel
+lowerer itself re-traces helper functions under the global flag and emits
+64->32-bit converts that its own conversion helper cannot lower (infinite
+recursion — observed on real v5e, see tests/test_ops_pallas.py's jaxpr
+scan).  Paddle's int64/float64 dtype *names* remain on the API surface for
+parity (reference: python/paddle/framework/dtype.py) but map to their 32-bit
+widths at the jax boundary (_core/dtype.py:to_jax_dtype).
+"""
 
 from . import autograd, dtype, flags, place, random, tensor  # noqa: F401

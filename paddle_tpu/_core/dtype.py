@@ -135,11 +135,24 @@ def to_paddle_dtype(obj) -> DType:
     raise ValueError(f"Unsupported dtype: {obj!r}")
 
 
+# TPU-native width policy: 64-bit dtypes exist on the API surface (paddle
+# parity) but compute in their 32-bit widths — TPU has no f64 and emulates
+# i64, and jax runs without x64 (see _core/__init__.py).  The mapping is done
+# here, at the single jax boundary, so no "explicitly requested dtype int64"
+# warnings and no accidental 64-bit values reach XLA or Mosaic.
+_JAX_NARROW = {
+    "int64": np.dtype(np.int32),
+    "float64": np.dtype(np.float32),
+    "complex128": np.dtype(np.complex64),
+}
+
+
 def to_jax_dtype(obj):
-    """Coerce to a numpy dtype usable by jax.numpy."""
+    """Coerce to a numpy dtype usable by jax.numpy (64-bit narrowed to 32)."""
     if obj is None:
         return None
-    return to_paddle_dtype(obj).np_dtype
+    dt = to_paddle_dtype(obj)
+    return _JAX_NARROW.get(dt.name, dt.np_dtype)
 
 
 def is_floating_dtype(dt) -> bool:

@@ -93,8 +93,8 @@ def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
 
 def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
     """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:252)."""
-    n = jnp.arange(n_mels, dtype=jnp.float64)
-    k = jnp.arange(n_mfcc, dtype=jnp.float64)
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
     dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
     if norm == "ortho":
         dct = dct.at[:, 0].multiply(math.sqrt(1.0 / (4 * n_mels)))
@@ -130,7 +130,7 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
         name, args = window, []
     n = win_length + 1 if fftbins else win_length
 
-    t = jnp.arange(n, dtype=jnp.float64)
+    t = jnp.arange(n, dtype=jnp.float32)
     if name in ("hann", "hanning"):
         w = 0.5 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
     elif name == "hamming":
@@ -163,7 +163,7 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
 
         alpha = (n - 1) / 2.0
         w = i0(beta * jnp.sqrt(jnp.clip(1 - ((t - alpha) / alpha) ** 2, 0, 1))) / i0(
-            jnp.asarray(beta, jnp.float64)
+            jnp.asarray(beta, jnp.float32)
         )
     elif name == "gaussian":
         std = args[0] if args else 1.0

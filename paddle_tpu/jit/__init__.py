@@ -150,11 +150,27 @@ class TrainStep:
 
     def __call__(self, *batch):
         if self._compiled is None:
-            # warmup eagerly: materializes accumulators
-            loss = self._eager_step(*batch)
+            if self.scaler is not None and self.scaler.is_enable():
+                # scaler state is created by its python bookkeeping; one
+                # eager step materializes it alongside the accumulators.
+                # Run it on the host CPU backend — eager per-op dispatch on a
+                # remote-attached TPU pays one XLA compile round-trip per op.
+                with jax.default_device(jax.devices("cpu")[0]):
+                    loss = self._eager_step(*batch)
+                self._state = self._collect_state()
+                self._build()
+                return loss
+            # Materialize optimizer accumulators WITHOUT an eager
+            # forward/backward (which would dispatch hundreds of per-op XLA
+            # compiles — ruinous on remote-attached TPUs).  The zero-grad
+            # journaled step runs on the host CPU backend; the compiled step
+            # transfers the fresh state to the accelerator on first call.
+            cpu = jax.devices("cpu")[0]
+            params = [p for p in self.optimizer._parameter_list if not p.stop_gradient]
+            with jax.default_device(cpu):
+                self.optimizer._journaled_step(params)
             self._state = self._collect_state()
             self._build()
-            return loss
         batch_vals = jax.tree_util.tree_map(_unwrap, batch, is_leaf=lambda x: isinstance(x, Tensor))
         key = rng_mod.next_key()
         if self.optimizer._lr_scheduler is not None:

@@ -1,9 +1,11 @@
 """Shared pallas helpers.
 
-The framework runs jax with x64 enabled (paddle int64 semantics), which makes
-bare python-int constants in BlockSpec index maps lower as i64 while traced
-program ids are i32 — Mosaic rejects the mixed tuple.  `imap` wraps an index
-map so every component is cast to int32.
+Mosaic requires every index-map component to be i32 (mixed-width index
+tuples are rejected, and in this jax version a 64->32-bit convert inside
+Mosaic lowering recurses forever).  `imap` wraps an index map so every
+component is cast to int32; together with the framework-wide no-64-bit
+policy (_core/dtype.py) this keeps kernel traces Mosaic-cleanly 32-bit —
+enforced by the jaxpr scan in tests/test_ops_pallas.py.
 """
 
 from __future__ import annotations

@@ -27,6 +27,14 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def _mask_val():
+    # Explicit f32: under global x64 a bare Python float becomes an f64
+    # constant inside the kernel trace, which Mosaic cannot lower (infinite
+    # recursion in its f64->f32 conversion helper).  tests/test_ops_pallas.py
+    # scans every kernel jaxpr for 64-bit types to keep this class of bug out.
+    return jnp.float32(DEFAULT_MASK_VALUE)
+
+
 def _block_sizes(seq_q, seq_k):
     bq = min(128, seq_q)
     bk = min(128, seq_k)
@@ -43,7 +51,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
     bq, head_dim = q_ref.shape
     seq_k = k_ref.shape[0]
     qi = pl.program_id(2)  # q-block index
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[:].astype(jnp.float32) * jnp.float32(scale)
 
     num_kv = seq_k // block_k
     if causal:
@@ -51,7 +59,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
         num_kv_dyn = jnp.int32((qi + 1) * bq + block_k - 1) // jnp.int32(block_k)
         num_kv_dyn = jnp.minimum(num_kv_dyn, num_kv)
     else:
-        num_kv_dyn = num_kv
+        num_kv_dyn = jnp.int32(num_kv)
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
@@ -63,7 +71,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(q_pos >= k_pos, s, _mask_val())
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)  # [bq, bk]
@@ -77,9 +85,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
     acc0 = jnp.zeros((bq, head_dim), jnp.float32)
     m0 = jnp.full((bq, 1), DEFAULT_MASK_VALUE, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kv_dyn, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(jnp.int32(0), num_kv_dyn, body, (acc0, m0, l0))
 
-    l_safe = jnp.where(l == 0.0, 1.0, l)
+    l_safe = jnp.where(l == 0.0, jnp.float32(1.0), l)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
     lse = (m + jnp.log(l_safe)).astype(jnp.float32)  # [bq, 1]
     lse_ref[:] = jnp.broadcast_to(lse, lse_ref.shape)
@@ -126,12 +134,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     do = do_ref[:].astype(jnp.float32)
     lse = lse_ref[:, :1]  # [bq, 1]
     delta = delta_ref[:, :1]  # [bq, 1]
+    scale = jnp.float32(scale)
 
     num_kv = seq_k // block_k
     if causal:
         num_kv_dyn = jnp.minimum(jnp.int32((qi + 1) * bq + block_k - 1) // jnp.int32(block_k), num_kv)
     else:
-        num_kv_dyn = num_kv
+        num_kv_dyn = jnp.int32(num_kv)
 
     def body(j, dq):
         k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -140,13 +149,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(q_pos >= k_pos, s, _mask_val())
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, num_kv_dyn, body, jnp.zeros((bq, head_dim), jnp.float32))
+    dq = jax.lax.fori_loop(jnp.int32(0), num_kv_dyn, body, jnp.zeros((bq, head_dim), jnp.float32))
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
@@ -156,13 +165,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     ki = pl.program_id(2)
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
+    scale = jnp.float32(scale)
 
     num_q = seq_q // block_q
     if causal:
         # q blocks starting before this kv block contribute nothing
         start_q = jnp.int32(ki * bk) // jnp.int32(block_q)
     else:
-        start_q = 0
+        start_q = jnp.int32(0)
 
     def body(i, carry):
         dk, dv = carry
@@ -174,7 +184,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(q_pos >= k_pos, s, _mask_val())
         p = jnp.exp(s - lse)  # [bq_blk, bk]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -184,7 +194,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
     dk0 = jnp.zeros((bk, head_dim), jnp.float32)
     dv0 = jnp.zeros((bk, head_dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(start_q, jnp.int32(num_q), body, (dk0, dv0))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 

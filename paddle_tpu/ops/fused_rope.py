@@ -1,60 +1,37 @@
-"""Fused rotary position embedding (Pallas).
+"""Fused rotary position embedding.
 
 Reference: paddle.incubate.nn.functional.fused_rotary_position_embedding
-(paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu).  One VPU kernel rotates
-q and k in-place-style per (batch, seq-block); backward is the inverse
-rotation (rotation matrices are orthogonal), implemented with the same kernel
-run with negated sin.
+(paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu).  The reference fuses
+the interleaved-pair rotation into one CUDA kernel; on TPU the rotation is a
+pure elementwise chain that XLA fuses into the surrounding matmuls on its
+own, so the TPU-native implementation is jnp with a hand-written inverse
+VJP (rotation matrices are orthogonal — the backward is the same rotation
+with negated sin, cheaper than the autodiff transpose and recompute-free).
+
+A Pallas kernel was deliberately NOT used here: the interleaved pair layout
+requires splitting the 128-lane minor dimension ([.., H] -> [.., H/2, 2]),
+a shape cast Mosaic cannot lower (infer-vector-layout: unsupported shape
+cast), and rope is bandwidth-bound so a kernel buys nothing over XLA fusion.
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-
-from paddle_tpu.ops._pl_utils import imap
-
-
-def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
-    # x: [bs, N*H] viewed rows; cos/sin: [bs, H/2]
-    x = x_ref[:].astype(jnp.float32)
-    bs, nh = x.shape
-    half = cos_ref.shape[-1]
-    n = nh // (2 * half)
-    x = x.reshape(bs, n, half, 2)
-    c = cos_ref[:].astype(jnp.float32)[:, None, :]
-    s = sin_ref[:].astype(jnp.float32)[:, None, :]
-    x1 = x[..., 0]
-    x2 = x[..., 1]
-    r1 = x1 * c - x2 * s
-    r2 = x2 * c + x1 * s
-    out = jnp.stack([r1, r2], axis=-1).reshape(bs, nh)
-    o_ref[:] = out.astype(o_ref.dtype)
 
 
 def _rope_apply(x, cos_r, sin_r):
     """x: [B, S, N, H]; cos_r/sin_r: per-token tables [B*S, H/2] fp32."""
     b, s, n, h = x.shape
-    x2d = x.reshape(b * s, n * h)
-    bs = min(256, b * s)
-    if (b * s) % bs:
-        bs = b * s
-    out = pl.pallas_call(
-        _rope_kernel,
-        grid=((b * s) // bs,),
-        in_specs=[
-            pl.BlockSpec((bs, n * h), imap(lambda i: (i, 0))),
-            pl.BlockSpec((bs, h // 2), imap(lambda i: (i, 0))),
-            pl.BlockSpec((bs, h // 2), imap(lambda i: (i, 0))),
-        ],
-        out_specs=pl.BlockSpec((bs, n * h), imap(lambda i: (i, 0))),
-        out_shape=jax.ShapeDtypeStruct((b * s, n * h), x.dtype),
-        interpret=jax.default_backend() != "tpu",
-    )(x2d, cos_r, sin_r)
-    return out.reshape(b, s, n, h)
+    xf = x.astype(jnp.float32).reshape(b * s, n, h // 2, 2)
+    c = cos_r[:, None, :]
+    sn = sin_r[:, None, :]
+    x1 = xf[..., 0]
+    x2 = xf[..., 1]
+    r1 = x1 * c - x2 * sn
+    r2 = x2 * c + x1 * sn
+    out = jnp.stack([r1, r2], axis=-1).reshape(b, s, n, h)
+    return out.astype(x.dtype)
 
 
 @jax.custom_vjp
@@ -81,7 +58,6 @@ def fused_rotary_position_embedding(q, k=None, v=None, *, cos, sin, position_off
     position + offset is used.  v passes through (parity with the reference
     signature which optionally rotates v — rarely used)."""
     b, s = q.shape[0], q.shape[1]
-    half = cos.shape[-1]
     if position_ids is not None:
         c = jnp.take(cos, position_ids.reshape(-1), axis=0)
         sn = jnp.take(sin, position_ids.reshape(-1), axis=0)

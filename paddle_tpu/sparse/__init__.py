@@ -51,7 +51,7 @@ class SparseCooTensor:
     is_sparse_csr = False
 
     def __init__(self, indices, values, shape, coalesced=False):
-        self._indices = jnp.asarray(indices, jnp.int64 if np.asarray(indices).dtype == np.int64 else jnp.int32)
+        self._indices = jnp.asarray(indices, jnp.int32)
         self._values = values if isinstance(values, jnp.ndarray) else jnp.asarray(values)
         self._shape = tuple(int(s) for s in shape)
         self._coalesced = bool(coalesced)
@@ -102,7 +102,7 @@ class SparseCooTensor:
         c = coalesce(self)
         rows, cols = c._indices[0], c._indices[1]
         m = self._shape[0]
-        crows = jnp.zeros(m + 1, jnp.int64).at[rows + 1].add(1)
+        crows = jnp.zeros(m + 1, jnp.int32).at[rows + 1].add(1)
         crows = jnp.cumsum(crows)
         return SparseCsrTensor(crows, cols, c._values, self._shape)
 
@@ -123,8 +123,8 @@ class SparseCsrTensor:
     is_sparse_csr = True
 
     def __init__(self, crows, cols, values, shape):
-        self._crows = jnp.asarray(crows, jnp.int64)
-        self._cols = jnp.asarray(cols, jnp.int64)
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
         self._values = values if isinstance(values, jnp.ndarray) else jnp.asarray(values)
         self._shape = tuple(int(s) for s in shape)
 
@@ -179,7 +179,7 @@ class SparseCsrTensor:
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
     """reference python/paddle/sparse/creation.py:60"""
-    idx = _v(indices).astype(jnp.int64)
+    idx = _v(indices).astype(jnp.int32)
     vals = _v(values)
     if dtype is not None:
         vals = vals.astype(to_jax_dtype(dtype))
@@ -269,6 +269,30 @@ def cast(x, index_dtype=None, value_dtype=None):
 
 # structural ---------------------------------------------------------------
 
+def _flat_keys_np(indices, strides, nd):
+    """Row-major flat keys on the HOST in int64.
+
+    Sparse structural ops are host-resident (indices drive data-dependent
+    shapes, so they cannot be traced anyway); int64 host math avoids the
+    silent int32 overflow a >2^31-element sparse shape would hit under the
+    framework's device-side no-64-bit policy (_core/dtype.py).
+    """
+    idx = np.asarray(indices).astype(np.int64)
+    keys = np.zeros(idx.shape[1], np.int64)
+    for i in range(nd):
+        keys += idx[i] * np.int64(strides[i])
+    return keys
+
+
+def _unflatten_np(keys, strides, nd):
+    idx = []
+    rem = np.asarray(keys, np.int64)
+    for i in range(nd):
+        idx.append(rem // np.int64(strides[i]))
+        rem = rem % np.int64(strides[i])
+    return np.stack(idx)
+
+
 def coalesce(x):
     """Sort indices and merge duplicates (reference sparse/unary.py coalesce)."""
     if isinstance(x, SparseCsrTensor):
@@ -277,23 +301,12 @@ def coalesce(x):
         return x
     sd = x.sparse_dim
     strides = np.cumprod([1] + list(x._shape[:sd][::-1]))[::-1][1:]  # row-major keys
-    keys = jnp.zeros(x._indices.shape[1], jnp.int64)
-    for i in range(sd):
-        keys = keys + x._indices[i].astype(jnp.int64) * int(strides[i])
-    order = jnp.argsort(keys)
-    keys_s = keys[order]
-    vals_s = x._values[order]
-    uniq, inv = jnp.unique(keys_s, return_inverse=True, size=keys_s.shape[0], fill_value=-1)
-    merged = jax.ops.segment_sum(vals_s, inv, num_segments=keys_s.shape[0])
-    n_uniq = int(jnp.sum(uniq >= 0))
-    uniq = uniq[:n_uniq]
-    merged = merged[:n_uniq]
-    idx = []
-    rem = uniq
-    for i in range(sd):
-        idx.append(rem // int(strides[i]))
-        rem = rem % int(strides[i])
-    return SparseCooTensor(jnp.stack(idx), merged, x._shape, True)
+    keys = _flat_keys_np(x._indices, strides, sd)
+    order = np.argsort(keys, kind="stable")
+    uniq, inv = np.unique(keys[order], return_inverse=True)
+    vals_s = x._values[jnp.asarray(order)]
+    merged = jax.ops.segment_sum(vals_s, jnp.asarray(inv, jnp.int32), num_segments=len(uniq))
+    return SparseCooTensor(jnp.asarray(_unflatten_np(uniq, strides, sd), jnp.int32), merged, x._shape, True)
 
 
 def transpose(x, perm):
@@ -356,16 +369,10 @@ def reshape(x, shape):
         known = int(np.prod([s for s in shape if s != -1]))
         shape[shape.index(-1)] = total // known
     strides_old = np.cumprod([1] + list(old_shape[::-1]))[::-1][1:]
-    flat = jnp.zeros(x._indices.shape[1], jnp.int64)
-    for i in range(len(old_shape)):
-        flat = flat + x._indices[i].astype(jnp.int64) * int(strides_old[i])
+    flat = _flat_keys_np(x._indices, strides_old, len(old_shape))
     strides_new = np.cumprod([1] + list(shape[::-1]))[::-1][1:]
-    idx = []
-    rem = flat
-    for s in strides_new:
-        idx.append(rem // int(s))
-        rem = rem % int(s)
-    return SparseCooTensor(jnp.stack(idx), x._values, tuple(shape), x._coalesced)
+    idx = _unflatten_np(flat, strides_new, len(shape))
+    return SparseCooTensor(jnp.asarray(idx, jnp.int32), x._values, tuple(shape), x._coalesced)
 
 
 def slice(x, axes, starts, ends):  # noqa: A001
@@ -403,28 +410,16 @@ def _coo_binary(x, y, fn):
     # union of patterns via merged keys
     sd = x.sparse_dim
     strides = np.cumprod([1] + list(x._shape[:sd][::-1]))[::-1][1:]
-
-    def keys(t):
-        k = jnp.zeros(t._indices.shape[1], jnp.int64)
-        for i in range(sd):
-            k = k + t._indices[i].astype(jnp.int64) * int(strides[i])
-        return k
-
-    kx, ky = keys(x), keys(y)
-    all_k = jnp.concatenate([kx, ky])
-    uniq = np.unique(np.asarray(all_k))
-    pos_x = np.searchsorted(uniq, np.asarray(kx))
-    pos_y = np.searchsorted(uniq, np.asarray(ky))
+    kx = _flat_keys_np(x._indices, strides, sd)
+    ky = _flat_keys_np(y._indices, strides, sd)
+    uniq = np.unique(np.concatenate([kx, ky]))
+    pos_x = np.searchsorted(uniq, kx)
+    pos_y = np.searchsorted(uniq, ky)
     dense_shape = x._values.shape[1:]
     vx = jnp.zeros((len(uniq),) + dense_shape, x._values.dtype).at[jnp.asarray(pos_x)].set(x._values)
     vy = jnp.zeros((len(uniq),) + dense_shape, y._values.dtype).at[jnp.asarray(pos_y)].set(y._values)
     out = fn(vx, vy)
-    idx = []
-    rem = jnp.asarray(uniq)
-    for i in range(sd):
-        idx.append(rem // int(strides[i]))
-        rem = rem % int(strides[i])
-    return SparseCooTensor(jnp.stack(idx), out, x._shape, True)
+    return SparseCooTensor(jnp.asarray(_unflatten_np(uniq, strides, sd), jnp.int32), out, x._shape, True)
 
 
 def _binary(x, y, fn):

@@ -70,7 +70,7 @@ def full(shape, fill_value, dtype=None, name=None):
         dt = to_jax_dtype(dtype)
     else:
         dt = _default_float() if isinstance(fill_value, float) else (
-            jnp.bool_ if isinstance(fill_value, bool) else jnp.int64
+            jnp.bool_ if isinstance(fill_value, bool) else jnp.int32
         )
     return Tensor(jnp.full(_shape_list(shape), fill_value, dt))
 

@@ -710,7 +710,7 @@ def crop(x, shape=None, offsets=None, name=None):
 
 def numel(x, name=None):
     x = ensure_tensor(x)
-    return Tensor(jnp.asarray(x.size, jnp.int64))
+    return Tensor(jnp.asarray(x.size, jnp.int32))
 
 
 def rank(x):

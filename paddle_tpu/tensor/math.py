@@ -204,7 +204,7 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
     x = ensure_tensor(x)
     ax = _axis_arg(axis)
     return apply(
-        "count_nonzero", lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype(jnp.int64), x
+        "count_nonzero", lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype(jnp.int32), x
     )
 
 
@@ -386,7 +386,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
     v = input._value
     lo, hi = (float(jnp.min(v)), float(jnp.max(v))) if min == 0 and max == 0 else (min, max)
     hist, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))
-    return Tensor(hist.astype(jnp.int64))
+    return Tensor(hist.astype(jnp.int32))
 
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):

@@ -88,7 +88,7 @@ def standard_gamma(alpha, name=None):
 def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
     if high is None:
         low, high = 0, low
-    dt = to_jax_dtype(dtype) or jnp.int64
+    dt = to_jax_dtype(dtype) or jnp.int32
     return Tensor(jax.random.randint(rng.next_key(), _shape_list(shape), low, high, dt))
 
 
@@ -120,7 +120,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         g = jax.random.gumbel(rng.next_key(), v.shape)
         scores = jnp.log(jnp.maximum(probs, 1e-30)) + g
         out = jnp.argsort(-scores, axis=-1)[..., :num_samples]
-    return Tensor(out.astype(jnp.int64))
+    return Tensor(out.astype(jnp.int32))
 
 
 def bernoulli(x, name=None):
@@ -144,7 +144,7 @@ def poisson(x, name=None):
 def binomial(count, prob, name=None):
     count, prob = ensure_tensor(count), ensure_tensor(prob)
     return Tensor(
-        jax.random.binomial(rng.next_key(), count._value.astype(jnp.float32), prob._value).astype(jnp.int64)
+        jax.random.binomial(rng.next_key(), count._value.astype(jnp.float32), prob._value).astype(jnp.int32)
     )
 
 

@@ -41,7 +41,7 @@ def argsort(x, axis=-1, descending=False, stable=False, name=None):
 
     def _as(v):
         idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
-        return idx.astype(jnp.int64)
+        return idx.astype(jnp.int32)
 
     return apply("argsort", _as, x)
 
@@ -68,7 +68,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
         else:
             vals, idx = jax.lax.top_k(-vm, kk)
             vals = -vals
-        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
 
     return apply("topk", _topk, x)
 
@@ -105,7 +105,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         vals = jnp.sort(v, axis=axis)
         idxs = jnp.argsort(v, axis=axis, stable=True)
         sel_v = jnp.take(vals, k - 1, axis=axis)
-        sel_i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int64)
+        sel_i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int32)
         if keepdim:
             sel_v = jnp.expand_dims(sel_v, axis)
             sel_i = jnp.expand_dims(sel_i, axis)
@@ -144,7 +144,7 @@ def masked_select(x, mask, name=None):
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     sorted_sequence, values = ensure_tensor(sorted_sequence), ensure_tensor(values)
     side = "right" if right else "left"
-    dt = jnp.int32 if out_int32 else jnp.int64
+    dt = jnp.int32  # out_int32 kept for API parity; int64 narrows to int32 anyway
 
     def _ss(seq, v):
         if seq.ndim == 1:
@@ -161,7 +161,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     x, sorted_sequence = ensure_tensor(x), ensure_tensor(sorted_sequence)
     side = "right" if right else "left"
-    dt = jnp.int32 if out_int32 else jnp.int64
+    dt = jnp.int32  # out_int32 kept for API parity; int64 narrows to int32 anyway
     return apply(
         "bucketize", lambda v, seq: jnp.searchsorted(seq, v, side=side).astype(dt), x, sorted_sequence
     )

