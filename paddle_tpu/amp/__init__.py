@@ -80,8 +80,10 @@ amp_guard = auto_cast
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
-    """O2: cast model params to low precision; optimizers keep fp32 master
-    weights (our optimizers always compute in fp32 — multi_precision built in).
+    """O2: cast model params to low precision; the Optimizer base then keeps
+    a persistent fp32 master weight per low-precision param (updates apply to
+    the master, the model copy is the cast-down view — see
+    optimizer/optimizer.py step()).
     """
     single_model = not isinstance(models, (list, tuple))
     model_list = [models] if single_model else list(models)

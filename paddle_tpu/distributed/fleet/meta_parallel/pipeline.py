@@ -1,31 +1,46 @@
-"""Pipeline parallelism — SPMD GPipe engine over a 'pp' mesh axis.
+"""Pipeline parallelism — SPMD pipeline engine over a 'pp' mesh axis.
 
 Reference counterpart: fleet PipelineLayer partitioning
 (python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:237,
 SegmentLayers:92) + the 1F1B runtime engine
 (meta_parallel/pipeline_parallel.py:648 train_batch, :431
 forward_backward_pipeline) + p2p send/recv
-(pp_utils/p2p_communication.py:313,512).
+(pp_utils/p2p_communication.py:313,512) + the schedule pass family
+(python/paddle/distributed/passes/pipeline_scheduler_pass.py:47-566 —
+FThenB / 1F1B variants as data, not code).
 
 TPU-native redesign: instead of per-rank processes exchanging activations
-over NCCL p2p with a hand-written 1F1B schedule, the pipeline is ONE SPMD
-program:
+over NCCL p2p with a hand-written fwd/bwd interleave, the pipeline is ONE
+SPMD program:
 
 - The N identical blocks' parameters are stacked [n_stages, layers_per_stage,
   ...] and sharded over the 'pp' mesh axis — each stage's weights live on its
   own devices, like the reference's per-rank layer partition.
-- The microbatch rotation runs inside shard_map (manual over 'pp' only; dp/mp
-  stay GSPMD-auto), activations moving stage-to-stage via lax.ppermute on ICI
-  — the p2p_communication.py equivalent.
-- The backward schedule is not hand-written: differentiating the pipelined
-  forward (jax.vjp) yields reverse ppermutes, i.e. the backward pipeline,
-  with XLA overlapping the collectives (the reference's comm/compute overlap).
-- Activation recompute per layer (jax.checkpoint) replaces the reference's
-  RecomputeFunction inside pipeline stages.
+- The microbatch rotation is a single `lax.scan` over T = M + S - 1 ticks
+  inside shard_map (manual over 'pp' only; dp/mp stay GSPMD-auto); per tick
+  each stage computes its chunk and the boundary activation hops one stage
+  via lax.ppermute on ICI — the p2p_communication.py equivalent.  scan keeps
+  compile time independent of the microbatch count (the unrolled round-1
+  engine retraced every tick).
+- Schedules are DATA, selecting the autodiff memory profile:
+  * "1F1B" (default): each tick's stage computation is wrapped in
+    jax.checkpoint, so the forward stores only the per-tick boundary
+    activations; the backward then recomputes one stage-tick and
+    backpropagates it, tick by tick in reverse — the bounded-activation
+    1F1B profile (peak residency: boundary tensors + ONE stage's
+    activations), without hand-writing the backward schedule.
+  * "FThenB": no per-tick checkpoint; XLA stores every stage's internals for
+    the whole forward (GPipe memory, fewest recompute FLOPs).
+  The bubble fraction (S-1)/(M+S-1) is schedule-intrinsic and identical for
+  both — raise num_microbatches to shrink it.
+- Activation recompute per layer (use_recompute=True, jax.checkpoint inside
+  the stage) replaces the reference's RecomputeFunction inside stages.
 
 Constraints (same as the reference's uniform SegmentLayers path): all blocks
 structurally identical, block output shape == input shape, and
-len(blocks) % pp_degree == 0.
+len(blocks) % pp_degree == 0.  num_microbatches may exceed the stage count
+(steady-state 1F1B, reference pipeline_parallel.py:431) — it must divide the
+batch.
 """
 
 from __future__ import annotations
@@ -42,16 +57,20 @@ from paddle_tpu.nn import Layer
 
 __all__ = ["PipelineStack"]
 
+_SCHEDULES = ("1F1B", "FThenB")
+
 
 class PipelineStack(Layer):
     """Replaces a LayerList of identical blocks with a pipelined stack."""
 
     def __init__(self, blocks, mesh, pp_axis: str = "pp", num_microbatches=None,
-                 use_recompute: bool = False):
+                 use_recompute: bool = False, schedule: str = "1F1B"):
         super().__init__()
         from paddle_tpu.distributed.auto_parallel import ProcessMesh
         from paddle_tpu.distributed.auto_parallel.api import placements_to_spec
 
+        if schedule not in _SCHEDULES:
+            raise ValueError(f"schedule must be one of {_SCHEDULES}, got {schedule!r}")
         blocks = list(blocks)
         if not blocks:
             raise ValueError("PipelineStack needs at least one block")
@@ -66,8 +85,11 @@ class PipelineStack(Layer):
                 f"{self._n_layers} blocks not divisible into {self._n_stages} stages"
             )
         self._layers_per_stage = self._n_layers // self._n_stages
+        if num_microbatches is not None and num_microbatches < 1:
+            raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
         self._num_microbatches = num_microbatches
         self._use_recompute = use_recompute
+        self._schedule = schedule
 
         # Template block: bypass Layer registration so its params stay out of
         # this layer's state_dict (they become dead storage bound over by the
@@ -104,6 +126,11 @@ class PipelineStack(Layer):
     def stacked_parameters(self):
         return [self._parameters[self._mangle(k)] for k in self._keys]
 
+    def bubble_fraction(self, num_microbatches=None) -> float:
+        """Pipeline bubble (S-1)/(M+S-1) — reference pipeline math."""
+        m = num_microbatches or self._num_microbatches or self._n_stages
+        return (self._n_stages - 1) / (m + self._n_stages - 1)
+
     # ------------------------------------------------------------------ fwd
     def forward(self, h, *bcast):
         S = self._n_stages
@@ -134,6 +161,7 @@ class PipelineStack(Layer):
         tpl_tensors = self._tpl_tensors
         bcast_template = self._bcast_template
         use_recompute = self._use_recompute
+        per_tick_remat = self._schedule == "1F1B"
 
         def layer_call(params_i, h_val, bcast_vals):
             originals = [t._value for t in tpl_tensors]
@@ -165,17 +193,39 @@ class PipelineStack(Layer):
                     h_val = call(params_i, h_val)
                 return h_val
 
+            if per_tick_remat:
+                stage_fn = jax.checkpoint(stage_fn)
+
             T = M + S - 1
-            buf = jnp.zeros_like(x[0])
-            outs = []
-            for t in range(T):
-                inp = jnp.where(stage == 0, x[min(t, M - 1)], buf)
+            ring = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                buf, out = carry
+                # stage 0 feeds microbatch t (last one repeated through the
+                # drain ticks — the classic warmup/drain bubble); others eat
+                # the boundary activation that just hopped in on the ring.
+                m_in = jnp.clip(t, 0, M - 1)
+                inp = jnp.where(stage == 0, lax.dynamic_index_in_dim(x, m_in, 0, keepdims=False), buf)
                 y = stage_fn(inp)
-                outs.append(jnp.where(stage == S - 1, y, jnp.zeros_like(y)))
-                if t < T - 1:
-                    buf = lax.ppermute(y, pp, [(i, (i + 1) % S) for i in range(S)])
-            res = jnp.stack([outs[m + S - 1] for m in range(M)])
-            return lax.psum(res, pp)
+                # last stage owns microbatch t-(S-1)'s output
+                m_out = jnp.clip(t - (S - 1), 0, M - 1)
+                cur = lax.dynamic_index_in_dim(out, m_out, 0, keepdims=False)
+                write = jnp.logical_and(stage == S - 1, t >= S - 1)
+                out = lax.dynamic_update_index_in_dim(
+                    out, jnp.where(write, y, cur), m_out, 0
+                )
+                buf = lax.ppermute(y, pp, ring)
+                return (buf, out), None
+
+            # carries become pp-varying inside the loop; type them so upfront
+            carry0 = (
+                lax.pvary(jnp.zeros_like(x[0]), (pp,)),
+                lax.pvary(jnp.zeros_like(x), (pp,)),
+            )
+            (_, out), _ = lax.scan(tick, carry0, jnp.arange(T, dtype=jnp.int32))
+            # outputs live on the last stage; psum replicates them over pp
+            # (non-last stages contributed zeros)
+            return lax.psum(out, pp)
 
         def fn(*vals):
             in_specs = tuple(PartitionSpec(pp) for _ in range(n_keys)) + tuple(

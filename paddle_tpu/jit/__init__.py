@@ -26,6 +26,17 @@ from paddle_tpu._core.tensor import Parameter, Tensor
 __all__ = ["to_static", "TrainStep", "not_to_static", "save", "load", "ignore_module"]
 
 
+def _host_device():
+    """default_device(cpu) context, or a no-op if no cpu backend exists
+    (jax_platforms pinned to an accelerator plugin only)."""
+    import contextlib
+
+    try:
+        return jax.default_device(jax.devices("cpu")[0])
+    except RuntimeError:
+        return contextlib.nullcontext()
+
+
 def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
 
@@ -155,7 +166,7 @@ class TrainStep:
                 # eager step materializes it alongside the accumulators.
                 # Run it on the host CPU backend — eager per-op dispatch on a
                 # remote-attached TPU pays one XLA compile round-trip per op.
-                with jax.default_device(jax.devices("cpu")[0]):
+                with _host_device():
                     loss = self._eager_step(*batch)
                 self._state = self._collect_state()
                 self._build()
@@ -163,11 +174,12 @@ class TrainStep:
             # Materialize optimizer accumulators WITHOUT an eager
             # forward/backward (which would dispatch hundreds of per-op XLA
             # compiles — ruinous on remote-attached TPUs).  The zero-grad
-            # journaled step runs on the host CPU backend; the compiled step
-            # transfers the fresh state to the accelerator on first call.
-            cpu = jax.devices("cpu")[0]
+            # journaled step runs on the host CPU backend (only effective for
+            # host-built, uncommitted params — state already device_put to an
+            # accelerator stays there); the compiled step transfers fresh
+            # state to the accelerator on first call.
             params = [p for p in self.optimizer._parameter_list if not p.stop_gradient]
-            with jax.default_device(cpu):
+            with _host_device():
                 self.optimizer._journaled_step(params)
             self._state = self._collect_state()
             self._build()

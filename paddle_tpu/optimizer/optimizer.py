@@ -81,7 +81,7 @@ class Optimizer:
             if init is None:
                 v = jnp.zeros(p._value.shape, dtype or p._value.dtype)
             else:
-                v = init
+                v = init() if callable(init) else init
             self._accumulators[key] = Tensor(v)
         return self._accumulators[key]
 
@@ -103,10 +103,34 @@ class Optimizer:
                 if g is None:
                     continue
                 gv = g._value.astype(jnp.float32) if g._value.dtype == jnp.float16 else g._value
-                if self._weight_decay and self._wd_is_l2 and not self._decoupled_wd():
-                    gv = gv + self._weight_decay * p._value.astype(gv.dtype)
-                new_val = self._single_update(p, gv, lr)
-                p._bind(new_val.astype(p._value.dtype) if new_val.dtype != p._value.dtype else new_val)
+                use_l2 = self._weight_decay and self._wd_is_l2 and not self._decoupled_wd()
+                if p._value.dtype in (jnp.bfloat16, jnp.float16):
+                    # Persistent fp32 master weights (reference multi_precision,
+                    # python/paddle/optimizer/adamw.py + fleet/utils/
+                    # mix_precision_utils.py): update the fp32 master, cast down
+                    # for the model copy.  Without this, updates smaller than
+                    # the bf16 ulp are lost — always on for low-precision
+                    # params (the reference's opt-in flag is kept in optimizer
+                    # signatures for API parity only).
+                    low_dtype = p._value.dtype
+                    mw = self._acc("master_weight", p, init=lambda p=p: p._value.astype(jnp.float32))
+                    if use_l2:
+                        # decay term from the fp32 master, not the quantized copy
+                        gv = gv.astype(jnp.float32) + self._weight_decay * mw._value
+                    orig_val = p._value
+                    try:
+                        p._bind(mw._value)  # _single_update reads the master
+                        new32 = self._single_update(p, gv, lr).astype(jnp.float32)
+                    except Exception:
+                        p._bind(orig_val)
+                        raise
+                    mw._bind(new32)
+                    p._bind(new32.astype(low_dtype))
+                else:
+                    if use_l2:
+                        gv = gv + self._weight_decay * p._value.astype(gv.dtype)
+                    new_val = self._single_update(p, gv, lr)
+                    p._bind(new_val.astype(p._value.dtype) if new_val.dtype != p._value.dtype else new_val)
         self._step_count += 1
 
     def _decoupled_wd(self) -> bool:

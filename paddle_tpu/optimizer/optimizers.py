@@ -44,8 +44,8 @@ class Adam(Optimizer):
     def _update_moments(self, p, g):
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
-        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
         g32 = g.astype(jnp.float32)
         new_m = self._beta1 * m._value + (1 - self._beta1) * g32
         new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g32)
@@ -96,7 +96,7 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _single_update(self, p, g, lr):
-        acc = self._acc("moment", p, init=jnp.full(p._value.shape, self._init_acc, jnp.float32))
+        acc = self._acc("moment", p, init=lambda: jnp.full(p._value.shape, self._init_acc, jnp.float32))
         new_acc = acc._value + jnp.square(g.astype(jnp.float32))
         acc._bind(new_acc)
         return p._value.astype(jnp.float32) - lr * g.astype(jnp.float32) / (jnp.sqrt(new_acc) + self._eps)
@@ -159,8 +159,8 @@ class Lamb(Optimizer):
         g32 = g.astype(jnp.float32)
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
-        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
         new_m = self._beta1 * m._value + (1 - self._beta1) * g32
         new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g32)
         new_b1p, new_b2p = b1p._value * self._beta1, b2p._value * self._beta2
@@ -186,7 +186,7 @@ class Adamax(Optimizer):
         g32 = g.astype(jnp.float32)
         m = self._acc("moment", p, dtype=jnp.float32)
         u = self._acc("inf_norm", p, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
         new_m = self._beta1 * m._value + (1 - self._beta1) * g32
         new_u = jnp.maximum(self._beta2 * u._value, jnp.abs(g32))
         new_b1p = b1p._value * self._beta1
@@ -234,7 +234,7 @@ class Rprop(Optimizer):
     def _single_update(self, p, g, lr):
         g32 = g.astype(jnp.float32)
         prev_g = self._acc("prev_grad", p, dtype=jnp.float32)
-        step_size = self._acc("step_size", p, init=jnp.full(p._value.shape, float(lr), jnp.float32))
+        step_size = self._acc("step_size", p, init=lambda: jnp.full(p._value.shape, float(lr), jnp.float32))
         sign = jnp.sign(g32 * prev_g._value)
         factor = jnp.where(sign > 0, self._eta_plus, jnp.where(sign < 0, self._eta_minus, 1.0))
         new_step = jnp.clip(step_size._value * factor, self._lr_min, self._lr_max)
