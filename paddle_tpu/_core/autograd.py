@@ -47,9 +47,38 @@ __all__ = [
 class _GradState(threading.local):
     def __init__(self):
         self.enabled = True
+        self.touch_recorders = []  # stack of lists capturing Tensor inputs
 
 
 _state = _GradState()
+
+
+class TouchRecorder:
+    """Collects op-input Tensors (and the ids of Tensors CREATED meanwhile,
+    so callers can filter out branch-local intermediates)."""
+
+    def __init__(self):
+        self.inputs: list = []
+        self.created: set = set()
+
+    def external_inputs(self):
+        out, seen = [], set()
+        for t in self.inputs:
+            if id(t) not in seen and id(t) not in self.created:
+                seen.add(id(t))
+                out.append(t)
+        return out
+
+
+@contextlib.contextmanager
+def record_touched_tensors(rec: "TouchRecorder"):
+    """Record every Tensor that flows into an op while active (used by
+    control-flow capture to discover closure-captured inputs)."""
+    _state.touch_recorders.append(rec)
+    try:
+        yield rec
+    finally:
+        _state.touch_recorders.pop()
 _static_prog_mod = None  # lazy ref to paddle_tpu.static.program (capture hook)
 _profiler_mod = None  # lazy ref to paddle_tpu.profiler (host event hook)
 
@@ -197,6 +226,9 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
     """
     args = _maybe_amp_cast(name, args)
     tensors = [a for a in args if isinstance(a, Tensor)]
+    if _state.touch_recorders:
+        # append raw; consumers dedupe by id() (Tensor __eq__ is elementwise)
+        _state.touch_recorders[-1].inputs.extend(tensors)
     needs_grad = _state.enabled and any(not t.stop_gradient for t in tensors)
 
     if not needs_grad:
@@ -204,9 +236,16 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
         out = fn(*vals, **kwargs)
         if flags.flag("FLAGS_check_nan_inf"):
             _check_nan_inf(name, jax.tree_util.tree_leaves(out))
+
+        def _mk(v):
+            t = Tensor(v, stop_gradient=True)
+            if _state.touch_recorders:
+                for rec in _state.touch_recorders:
+                    rec.created.add(id(t))
+            return t
+
         return jax.tree_util.tree_map(
-            lambda v: Tensor(v, stop_gradient=True), out,
-            is_leaf=lambda x: not isinstance(x, (tuple, list, dict)),
+            _mk, out, is_leaf=lambda x: not isinstance(x, (tuple, list, dict))
         )
 
     # Partition: differentiable (float tensors with stop_gradient=False) vs closed-over.
@@ -242,6 +281,9 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
             t._out_index = i
         out_tensors.append(t)
         node.out_refs.append(weakref.ref(t))
+    if _state.touch_recorders:
+        for rec in _state.touch_recorders:
+            rec.created.update(id(t) for t in out_tensors)
     return jax.tree_util.tree_unflatten(out_tree, out_tensors)
 
 

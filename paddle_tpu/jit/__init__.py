@@ -49,7 +49,12 @@ class _StaticFunction:
     """Compiled wrapper around a function or Layer.forward."""
 
     def __init__(self, fn, layer=None, full_graph=True, backend=None):
-        self._fn = fn
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        # AST-mode dy2static (reference ast_transformer.py): rewrite python
+        # if/while/and/or/not over tensors into lax control flow converters;
+        # falls back to the original fn when source is unavailable.
+        self._fn = ast_transform(fn)
         self._layer = layer
         self._compiled = None
         self._train_mode = None

@@ -1,0 +1,517 @@
+"""dy2static: AST-mode capture of Python control flow over tensors.
+
+Reference: the AST transformer pipeline
+(python/paddle/jit/dy2static/ast_transformer.py, transformers for
+ifelse/loop/logical ops, runtime converters in convert_operators.py) whose
+output runs as a run_program op.  The reference also ships SOT bytecode
+capture (python/paddle/jit/sot/translate.py:99) — here AST mode is the
+shipped capture tier (SURVEY.md §7 hard-parts: AST first).
+
+TPU-native redesign: the rewritten function still executes EAGERLY op-by-op
+through the normal funnel — the transform only replaces Python `if`/`while`
+statements and `and`/`or`/`not` expressions with runtime converters that
+dispatch on the value: concrete values keep exact Python semantics; traced
+values (inside jax.jit via paddle.jit.to_static) lower to lax.cond /
+lax.while_loop through paddle_tpu.static.nn.cond/while_loop.  There is no
+separate "static program" artifact — jax.jit IS the program capture.
+
+Branch/loop bodies communicate through `nonlocal` rebinding plus get/set
+closures (the reference's ast transform uses the same nonlocal pattern), so
+arbitrary assignments inside branches work.  Unsupported in traced branches:
+`return`/`break`/`continue` inside a tensor-conditioned block (those Ifs are
+left untransformed and raise the standard tracer-bool error if reached under
+tracing) and variables created in only one branch.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = [
+    "ast_transform",
+    "convert_ifelse",
+    "convert_while",
+    "convert_logical_and",
+    "convert_logical_or",
+    "convert_logical_not",
+]
+
+_UNDEF = object()
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _tensorish(v):
+    return isinstance(v, (Tensor, jax.Array)) or _is_tracer(v)
+
+
+# --------------------------------------------------------------------------
+# runtime converters (reference convert_operators.py)
+# --------------------------------------------------------------------------
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
+    pv = _unwrap(pred)
+    if not _is_tracer(pv):
+        (true_fn if bool(pv) else false_fn)()
+        return
+
+    from paddle_tpu.static.control_flow import cond as _cond
+
+    orig = get_args()
+
+    def branch(fn):
+        def run():
+            set_args(orig)
+            fn()  # mutates enclosing locals via nonlocal
+            vals = get_args()
+            out = []
+            for name, o, v in zip(names, orig, vals):
+                if v is _UNDEF and o is _UNDEF:
+                    out.append(None)
+                    continue
+                if v is _UNDEF:
+                    raise ValueError(
+                        f"dy2static: '{name}' deleted inside a traced branch"
+                    )
+                out.append(Tensor(jnp.asarray(_unwrap(v))))
+            return tuple(out)
+
+        return run
+
+    try:
+        sel = _cond(Tensor(pv, stop_gradient=True), branch(true_fn), branch(false_fn))
+    finally:
+        set_args(orig)
+    new_vals = []
+    for name, o, v in zip(names, orig, sel if isinstance(sel, (tuple, list)) else (sel,)):
+        new_vals.append(o if v is None else v)
+    set_args(tuple(new_vals))
+
+
+def convert_while(test_fn, body_fn, get_args, set_args, names):
+    # concrete path: exact python semantics
+    first = _unwrap(test_fn())
+    if not _is_tracer(first):
+        if not bool(first):
+            return
+        while True:
+            body_fn()
+            c = _unwrap(test_fn())
+            if _is_tracer(c):
+                raise ValueError(
+                    "dy2static: while condition became traced mid-loop; make "
+                    "loop state tensors before the loop"
+                )
+            if not bool(c):
+                break
+        return
+
+    from jax import lax
+
+    orig = get_args()
+    for name, v in zip(names, orig):
+        if v is _UNDEF:
+            raise ValueError(
+                f"dy2static: '{name}' must be defined before a traced while loop"
+            )
+        if not (_tensorish(v) or isinstance(v, (int, float, bool))):
+            raise ValueError(
+                f"dy2static: traced while loop state '{name}' must be a tensor "
+                f"or number, got {type(v).__name__}"
+            )
+
+    def to_vals(vars_):
+        return tuple(jnp.asarray(_unwrap(v)) for v in vars_)
+
+    def c(vals):
+        set_args(tuple(Tensor(v) for v in vals))
+        r = _unwrap(test_fn())
+        return r.reshape(()) != 0 if getattr(r, "dtype", None) != jnp.bool_ else r.reshape(())
+
+    def b(vals):
+        set_args(tuple(Tensor(v) for v in vals))
+        body_fn()
+        return to_vals(get_args())
+
+    res = lax.while_loop(c, b, to_vals(orig))
+    set_args(tuple(Tensor(v, stop_gradient=True) for v in res))
+
+
+def convert_return_ifelse(pred, t_fn, f_fn):
+    """Value-returning if/else where both paths return (return transformer
+    analog of reference dy2static's RETURN handling)."""
+    pv = _unwrap(pred)
+    if not _is_tracer(pv):
+        return (t_fn if bool(pv) else f_fn)()
+    from paddle_tpu.static.control_flow import cond as _cond
+
+    return _cond(Tensor(pv, stop_gradient=True), t_fn, f_fn)
+
+
+def convert_logical_and(x, y_fn):
+    xv = _unwrap(x)
+    if not _tensorish(xv):
+        return x and y_fn()
+    y = y_fn()
+    return Tensor(jnp.logical_and(jnp.asarray(xv) != 0, jnp.asarray(_unwrap(y)) != 0))
+
+
+def convert_logical_or(x, y_fn):
+    xv = _unwrap(x)
+    if not _tensorish(xv):
+        return x or y_fn()
+    y = y_fn()
+    return Tensor(jnp.logical_or(jnp.asarray(xv) != 0, jnp.asarray(_unwrap(y)) != 0))
+
+
+def convert_logical_not(x):
+    xv = _unwrap(x)
+    if not _tensorish(xv):
+        return not x
+    return Tensor(jnp.logical_not(jnp.asarray(xv) != 0))
+
+
+# --------------------------------------------------------------------------
+# AST transformer
+# --------------------------------------------------------------------------
+
+
+def _assigned_names(nodes):
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store) and node.id not in out:
+                out.append(node.id)
+
+        def visit_FunctionDef(self, node):
+            pass  # don't descend into nested defs
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_For(self, node):
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Name) and node.target.id not in out:
+                out.append(node.target.id)
+            self.generic_visit(node)
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _has_escape(nodes):
+    """Return anywhere, or break/continue NOT enclosed by a nested loop
+    (those belong to the inner loop, not to the block being converted)."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            found[0] = True
+
+        def visit_Raise(self, node):
+            # a raise cannot be traced into lax.cond; leave the python `if`
+            found[0] = True
+
+        def visit_Break(self, node):
+            found[0] = True
+
+        def visit_Continue(self, node):
+            found[0] = True
+
+        def visit_For(self, node):
+            # break/continue inside are local; returns/raises still escape
+            if _has_return(node.body + node.orelse):
+                found[0] = True
+
+        def visit_While(self, node):
+            if _has_return(node.body + node.orelse):
+                found[0] = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    for n in nodes:
+        V().visit(n)
+    return found[0]
+
+
+def _has_return(nodes):
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            found[0] = True
+
+        def visit_Raise(self, node):
+            found[0] = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    for n in nodes:
+        V().visit(n)
+    return found[0]
+
+
+def _make_getset(names, uid):
+    """Source for get/set closures over `names` (UnboundLocal-safe get)."""
+    get_lines = [f"def _pt_get_{uid}():", "    _pt_vals = []"]
+    for n in names:
+        get_lines += [
+            "    try:",
+            f"        _pt_vals.append({n})",
+            "    except (NameError, UnboundLocalError):",
+            "        _pt_vals.append(_pt_rt._UNDEF)",
+        ]
+    get_lines.append("    return tuple(_pt_vals)")
+    set_lines = [f"def _pt_set_{uid}(_pt_vals):"]
+    if names:
+        set_lines.append(f"    nonlocal {', '.join(names)}")
+        for i, n in enumerate(names):
+            set_lines += [
+                f"    if _pt_vals[{i}] is not _pt_rt._UNDEF:",
+                f"        {n} = _pt_vals[{i}]",
+            ]
+    else:
+        set_lines.append("    pass")
+    return "\n".join(get_lines), "\n".join(set_lines)
+
+
+def _all_paths_return(stmts):
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _all_paths_return(last.body) and _all_paths_return(last.orelse)
+    return False
+
+
+_RET_UID = [0]
+
+
+def _merge_returns(stmts):
+    """Rewrite `if c: ... return A` (+ trailing code as the implicit else)
+    into `return convert_return_ifelse(c, t_fn, f_fn)` when both paths
+    return.  Recurses into nested bodies first."""
+    out = []
+    i = 0
+    while i < len(stmts):
+        st = stmts[i]
+        for attr in ("body", "orelse", "finalbody"):
+            if hasattr(st, attr) and getattr(st, attr):
+                setattr(st, attr, _merge_returns(getattr(st, attr)))
+        if isinstance(st, ast.If) and _all_paths_return(st.body):
+            trailing = stmts[i + 1 :]
+            orelse = st.orelse if st.orelse else trailing
+            if _all_paths_return(orelse):
+                _RET_UID[0] += 1
+                uid = _RET_UID[0]
+                t_def = ast.parse(f"def _pt_rett_{uid}():\n    pass").body[0]
+                t_def.body = list(st.body)
+                f_def = ast.parse(f"def _pt_retf_{uid}():\n    pass").body[0]
+                f_def.body = list(orelse)
+                ret = ast.parse(
+                    f"return _pt_rt.convert_return_ifelse(_pt_rtest_{uid}, _pt_rett_{uid}, _pt_retf_{uid})"
+                ).body[0]
+                assign = ast.Assign(
+                    targets=[ast.Name(id=f"_pt_rtest_{uid}", ctx=ast.Store())], value=st.test
+                )
+                for n in (assign, t_def, f_def, ret):
+                    ast.copy_location(n, st)
+                    ast.fix_missing_locations(n)
+                out += [assign, t_def, f_def, ret]
+                if not st.orelse:
+                    return out  # trailing stmts consumed as the else branch
+                i += 1
+                continue
+        out.append(st)
+        i += 1
+    return out
+
+
+def _init_guard(name):
+    """`try: name = name / except: name = _UNDEF` — binds `name` in the
+    enclosing scope so the branch functions' `nonlocal` declarations compile,
+    without disturbing an existing value."""
+    return ast.parse(
+        f"try:\n    {name} = {name}\n"
+        f"except (NameError, UnboundLocalError):\n    {name} = _pt_rt._UNDEF"
+    ).body[0]
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, fn_locals):
+        self._uid = 0
+        self._fn_locals = fn_locals  # names assigned anywhere in the function
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    # ---- logical ops in any expression position
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "convert_logical_and" if isinstance(node.op, ast.And) else "convert_logical_or"
+        expr = node.values[0]
+        for right in node.values[1:]:
+            lam = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=right,
+            )
+            expr = ast.Call(
+                func=ast.Attribute(value=ast.Name(id="_pt_rt", ctx=ast.Load()), attr=op, ctx=ast.Load()),
+                args=[expr, lam],
+                keywords=[],
+            )
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                ast.Call(
+                    func=ast.Attribute(value=ast.Name(id="_pt_rt", ctx=ast.Load()), attr="convert_logical_not", ctx=ast.Load()),
+                    args=[node.operand],
+                    keywords=[],
+                ),
+                node,
+            )
+        return node
+
+    # ---- if statements
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node  # python `if` kept; traced use raises tracer-bool
+        uid = self._next()
+        names = _assigned_names(node.body + node.orelse)
+        get_src, set_src = _make_getset(names, uid)
+        true_def = ast.parse(f"def _pt_true_{uid}():\n    pass").body[0]
+        false_def = ast.parse(f"def _pt_false_{uid}():\n    pass").body[0]
+        nl = [ast.Nonlocal(names=list(names))] if names else []
+        true_def.body = nl + (node.body or [ast.Pass()])
+        false_def.body = list(nl) + (node.orelse or [ast.Pass()])
+        get_def = ast.parse(get_src).body[0]
+        set_def = ast.parse(set_src).body[0]
+        call = ast.parse(
+            f"_pt_rt.convert_ifelse(_pt_test_{uid}, _pt_true_{uid}, _pt_false_{uid}, "
+            f"_pt_get_{uid}, _pt_set_{uid}, {tuple(names)!r})"
+        ).body[0]
+        assign_test = ast.Assign(
+            targets=[ast.Name(id=f"_pt_test_{uid}", ctx=ast.Store())], value=node.test
+        )
+        out = [_init_guard(n) for n in names]
+        out += [assign_test, true_def, false_def, get_def, set_def, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    # ---- while statements
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        uid = self._next()
+        # loop state = names assigned in the body; condition-only reads stay
+        # plain closures (rebinding them to Tensors would break later python
+        # uses like range(n))
+        names = _assigned_names(node.body)
+        get_src, set_src = _make_getset(names, uid)
+        test_def = ast.parse(f"def _pt_test_{uid}():\n    pass").body[0]
+        test_def.body = [ast.Return(value=node.test)]
+        body_def = ast.parse(f"def _pt_body_{uid}():\n    pass").body[0]
+        nl = [ast.Nonlocal(names=list(_assigned_names(node.body)))] if _assigned_names(node.body) else []
+        body_def.body = nl + (node.body or [ast.Pass()])
+        get_def = ast.parse(get_src).body[0]
+        set_def = ast.parse(set_src).body[0]
+        call = ast.parse(
+            f"_pt_rt.convert_while(_pt_test_{uid}, _pt_body_{uid}, "
+            f"_pt_get_{uid}, _pt_set_{uid}, {tuple(names)!r})"
+        ).body[0]
+        out = [_init_guard(n) for n in names]
+        out += [test_def, body_def, get_def, set_def, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+
+def ast_transform(fn):
+    """Rewrite fn's control flow; returns the transformed function (or fn
+    unchanged when source is unavailable / transform fails)."""
+    func = fn.__func__ if inspect.ismethod(fn) else fn
+    if getattr(func, "_pt_dy2static_done", False) or getattr(func, "_not_to_static", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return fn
+        # strip decorators (they already ran to produce `fn`)
+        fdef.decorator_list = []
+        fdef.body = _merge_returns(fdef.body)
+        fn_locals = set(_assigned_names(fdef.body))
+        fn_locals.update(a.arg for a in fdef.args.args)
+        fn_locals.update(a.arg for a in fdef.args.posonlyargs)
+        fn_locals.update(a.arg for a in fdef.args.kwonlyargs)
+        if fdef.args.vararg:
+            fn_locals.add(fdef.args.vararg.arg)
+        if fdef.args.kwarg:
+            fn_locals.add(fdef.args.kwarg.arg)
+        new_tree = _ControlFlowTransformer(fn_locals).visit(tree)
+        ast.fix_missing_locations(new_tree)
+        code = compile(new_tree, filename=f"<dy2static {func.__name__}>", mode="exec")
+        from paddle_tpu.jit import dy2static as _rt
+
+        # keep the ORIGINAL globals mapping live: names defined after
+        # decoration (forward refs, recursion, monkeypatching) must resolve
+        glb = func.__globals__
+        glb["_pt_rt"] = _rt
+        # free variables: rebuild with the original closure cells
+        fcode = next(
+            c for c in code.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == func.__name__
+        )
+        closure = func.__closure__
+        if closure is not None and fcode.co_freevars != func.__code__.co_freevars:
+            # transform changed the free-variable set; bail out
+            return fn
+        new_func = types.FunctionType(fcode, glb, func.__name__, func.__defaults__, closure)
+        new_func.__kwdefaults__ = func.__kwdefaults__
+        new_func._pt_dy2static_done = True
+        new_func.__wrapped__ = func
+        if inspect.ismethod(fn):
+            return types.MethodType(new_func, fn.__self__)
+        return new_func
+    except (OSError, TypeError, SyntaxError, StopIteration):
+        return fn

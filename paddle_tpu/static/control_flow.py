@@ -1,0 +1,206 @@
+"""Static control-flow ops: cond / while_loop / case / switch_case.
+
+Reference: paddle.static.nn.cond / while_loop
+(python/paddle/static/nn/control_flow.py) executed by IfInstruction /
+WhileInstruction sub-interpreters
+(paddle/fluid/framework/new_executor/instruction/if_instruction.cc:1,
+while_instruction.cc).
+
+TPU-native redesign: there is no sub-interpreter — data-dependent branches
+lower to `lax.cond` / `lax.while_loop` inside the traced program, the only
+control flow XLA can compile.  Semantics:
+
+- Concrete (eager) predicates take the plain Python branch: full tape
+  autograd, zero overhead — paddle dygraph parity.
+- Traced predicates (inside jit / to_static / TrainStep):
+  * `cond` discovers the Tensors each branch closes over by running a
+    recording pass (paddle's static mode likewise builds both branch
+    programs), then registers the whole lax.cond as ONE tape op via the
+    apply() funnel — gradients flow into both branches' captures
+    (jax.vjp of lax.cond backpropagates the taken branch and produces
+    zeros for the other, matching the reference's select-grad semantics).
+  * `while_loop` lowers to lax.while_loop.  XLA cannot reverse-differentiate
+    a dynamic-trip-count loop (the reference's while_grad replays a stack of
+    per-iteration states — unbounded memory the TPU path deliberately
+    avoids); outputs are stop_gradient and training loops should use
+    fixed-length scans (lax.scan via paddle ops) or bounded unrolling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu._core.autograd import apply, no_grad, record_touched_tensors
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Print"]
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _pred_value(pred):
+    v = pred._value if isinstance(pred, Tensor) else pred
+    if hasattr(v, "reshape") and getattr(v, "ndim", 0) > 0:
+        v = v.reshape(())
+    return v
+
+
+def _run_branch(fn, out_template=None):
+    out = fn() if fn is not None else None
+    flat, tree = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    return [_unwrap(v) for v in flat], tree
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run true_fn or false_fn depending on pred (scalar bool Tensor)."""
+    pv = _pred_value(pred)
+    if not _is_tracer(pv):
+        # eager: plain python dispatch, tape records the taken branch
+        if bool(pv):
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    # Traced predicate: discover each branch's Tensor captures by running it
+    # once under a recorder (outputs discarded), then trace both branches
+    # inside lax.cond over the explicit capture list.  Branch-local
+    # intermediates are filtered out (recorder tracks creations).
+    from paddle_tpu._core.autograd import TouchRecorder
+
+    recorder = TouchRecorder()
+    with record_touched_tensors(recorder), no_grad():
+        t_out, t_tree = _run_branch(true_fn)
+        f_out, f_tree = _run_branch(false_fn)
+    if t_tree != f_tree:
+        raise ValueError(
+            f"cond branches must return the same structure: {t_tree} vs {f_tree}"
+        )
+    for tv, fv in zip(t_out, f_out):
+        if jnp.shape(tv) != jnp.shape(fv) or jnp.result_type(tv) != jnp.result_type(fv):
+            raise ValueError(
+                "cond branches must return matching shapes/dtypes: "
+                f"{jnp.shape(tv)}/{jnp.result_type(tv)} vs {jnp.shape(fv)}/{jnp.result_type(fv)}"
+            )
+    captured = recorder.external_inputs()
+
+    tree = t_tree
+
+    def cond_val(pv_, *cap_vals):
+        def run(fn):
+            originals = [t._value for t in captured]
+            try:
+                for t, v in zip(captured, cap_vals):
+                    t._bind(v)
+                with no_grad():
+                    flat, _ = _run_branch(fn)
+                return tuple(flat)
+            finally:
+                for t, v in zip(captured, originals):
+                    t._bind(v)
+
+        return lax.cond(pv_ != 0, lambda _: run(true_fn), lambda _: run(false_fn), None)
+
+    out_flat = apply("cond", cond_val, Tensor(pv, stop_gradient=True), *captured)
+    if not isinstance(out_flat, (tuple, list)):
+        out_flat = (out_flat,)
+    return jax.tree_util.tree_unflatten(tree, list(out_flat))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Repeat body_fn while cond_fn(*vars) holds (reference while_loop).
+
+    Differentiable when fully eager; under tracing it lowers to
+    lax.while_loop, whose outputs are stop_gradient (see module docstring).
+    """
+    loop_vars = list(loop_vars)
+    vals = [_unwrap(v) for v in loop_vars]
+
+    traced = any(_is_tracer(v) for v in vals)
+    if not traced:
+        # probe the condition once; if concrete, run the pure-python loop
+        c0 = cond_fn(*loop_vars)
+        c0v = _pred_value(c0)
+        if not _is_tracer(c0v):
+            cur = loop_vars
+            cont = bool(c0v)
+            while cont:
+                out = body_fn(*cur)
+                cur = list(out) if isinstance(out, (tuple, list)) else [out]
+                cont = bool(_pred_value(cond_fn(*cur)))
+            return cur
+        traced = True
+
+    def to_val_tuple(vars_):
+        return tuple(_unwrap(v) for v in vars_)
+
+    def wrap_all(vals_):
+        return [Tensor(v) for v in vals_]
+
+    def c(vs):
+        with no_grad():
+            r = cond_fn(*wrap_all(vs))
+        rv = _pred_value(r)
+        return rv != 0 if rv.dtype != jnp.bool_ else rv
+
+    def b(vs):
+        with no_grad():
+            out = body_fn(*wrap_all(vs))
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        return tuple(_unwrap(v) for v in out)
+
+    with no_grad():
+        res = lax.while_loop(c, b, to_val_tuple(loop_vars))
+    return [Tensor(v, stop_gradient=True) for v in res]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred holds wins (reference static/nn/control_flow.py
+    case) — built as a nested cond chain."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(pairs):
+        (pred, fn), rest = pairs[0], pairs[1:]
+        if not rest:
+            if default is None:
+                return cond(pred, fn, fn)
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (reference switch_case)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    iv = branch_index if isinstance(branch_index, Tensor) else Tensor(jnp.asarray(branch_index))
+
+    def build(pairs):
+        (idx, fn), rest = pairs[0], pairs[1:]
+        pred = iv.equal(Tensor(jnp.asarray(idx, iv._value.dtype)))
+        if not rest:
+            if default is None:
+                return cond(pred, fn, fn)
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+
+    return build(items)
+
+
+def Print(input, first_n=-1, message=None, **kwargs):  # noqa: N802
+    """reference static Print op — host callback debug print."""
+    msg = message or ""
+    jax.debug.print(msg + "{x}", x=_unwrap(input))
+    return input

@@ -12,7 +12,18 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.nn import initializer as I
 from paddle_tpu._core.tensor import Parameter
 
-__all__ = ["fc", "embedding", "batch_norm", "conv2d"]
+from paddle_tpu.static.control_flow import (  # noqa: F401
+    Print,
+    case,
+    cond,
+    switch_case,
+    while_loop,
+)
+
+__all__ = [
+    "fc", "embedding", "batch_norm", "conv2d",
+    "cond", "while_loop", "case", "switch_case", "Print",
+]
 
 
 def _make_param(shape, dtype, initializer):

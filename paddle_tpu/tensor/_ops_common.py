@@ -68,3 +68,26 @@ def binary(name, jfn, doc=""):
     op.__name__ = name
     op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting (paddle.{name})."
     return op
+
+
+class DynamicShapeError(RuntimeError):
+    """Raised when a data-dependent-output-shape op is used under tracing.
+
+    XLA requires static shapes (SURVEY.md §7 design stance); the reference's
+    CUDA kernels can size outputs at runtime, this framework cannot.  Eager
+    calls still work (concrete values); under jit/to_static use the suggested
+    static-shape alternative.
+    """
+
+
+def reject_tracers(op_name: str, hint: str, *tensors):
+    import jax
+
+    for t in tensors:
+        v = t._value if isinstance(t, Tensor) else t
+        if isinstance(v, jax.core.Tracer):
+            raise DynamicShapeError(
+                f"paddle.{op_name} has a data-dependent output shape and "
+                f"cannot run under jit/to_static (XLA needs static shapes). "
+                f"{hint}"
+            )

@@ -242,9 +242,15 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=N
 
 
 def eig(x, name=None):
+    """Non-symmetric eigendecomposition.  Host LAPACK only: XLA:TPU has no
+    nonsymmetric eig (the reference's is cuSOLVER); documented eager-only —
+    use eigh for the hermitian case under jit."""
     x = ensure_tensor(x)
     import numpy as np
 
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers("eig", "Use paddle.linalg.eigh for hermitian matrices under jit.", x)
     w, v = np.linalg.eig(np.asarray(x._value))
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
 
@@ -253,6 +259,9 @@ def eigvals(x, name=None):
     x = ensure_tensor(x)
     import numpy as np
 
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers("eigvals", "Use paddle.linalg.eigvalsh for hermitian matrices under jit.", x)
     return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._value))))
 
 

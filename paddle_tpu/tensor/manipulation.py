@@ -412,10 +412,18 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=Tru
 
 def masked_select(x, mask, name=None):
     x, mask = ensure_tensor(x), ensure_tensor(mask)
-    # Data-dependent shape: eager-only (reference has the same dynamic output).
-    v = np.asarray(x._value)
-    m = np.asarray(mask._value)
-    return Tensor(jnp.asarray(np.broadcast_to(v, np.broadcast_shapes(v.shape, m.shape))[np.broadcast_to(m, np.broadcast_shapes(v.shape, m.shape))]))
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers(
+        "masked_select",
+        "Use paddle.where / masked_fill (static shape) or move the select "
+        "outside the compiled region.",
+        x, mask,
+    )
+    shape = jnp.broadcast_shapes(x._value.shape, mask._value.shape)
+    v = jnp.broadcast_to(x._value, shape)
+    m = jnp.broadcast_to(mask._value, shape)
+    return Tensor(v[m])  # concrete boolean index: stays on device
 
 
 def masked_fill(x, mask, value, name=None):
@@ -432,17 +440,43 @@ def masked_fill_(x, mask, value, name=None):
 
 
 def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x with consecutive values (traceable:
+    cumsum+gather keeps the output shape static — the round-1 numpy
+    implementation broke under jit)."""
     x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
-    v = np.asarray(x._value).copy()
-    m = np.broadcast_to(np.asarray(mask._value), v.shape)
-    vals = np.asarray(value._value).reshape(-1)
-    v[m] = vals[: int(m.sum())]
-    return Tensor(jnp.asarray(v))
+    if not any(
+        isinstance(t._value, jax.core.Tracer) for t in (x, mask, value)
+    ):
+        needed = int(jnp.sum(jnp.broadcast_to(mask._value, x._value.shape)))
+        if int(value._value.size) < needed:
+            raise ValueError(
+                f"masked_scatter: value has {int(value._value.size)} elements "
+                f"but mask selects {needed}"
+            )
+
+    def _ms(v, m, vals):
+        mb = jnp.broadcast_to(m, v.shape).reshape(-1)
+        flat = v.reshape(-1)
+        vflat = vals.reshape(-1)
+        # k-th True position reads vals[k]
+        pos = jnp.cumsum(mb.astype(jnp.int32)) - 1
+        picked = jnp.take(vflat, jnp.clip(pos, 0, vflat.shape[0] - 1))
+        return jnp.where(mb, picked, flat).reshape(v.shape)
+
+    return apply("masked_scatter", _ms, x, mask, value)
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):
     x = ensure_tensor(x)
     if isinstance(repeats, Tensor):
+        from paddle_tpu.tensor._ops_common import reject_tracers
+
+        reject_tracers(
+            "repeat_interleave",
+            "A tensor `repeats` makes the output length data-dependent; use "
+            "an int repeats (static) under jit.",
+            repeats,
+        )
         reps = repeats
         return apply(
             "repeat_interleave",
@@ -464,6 +498,14 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
     x = ensure_tensor(x)
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers(
+        "unique",
+        "The number of unique values is data-dependent; sort + compare "
+        "neighbors (static shape) or run unique outside the compiled region.",
+        x,
+    )
     res = np.unique(
         np.asarray(x._value),
         return_index=return_index,
@@ -479,6 +521,14 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
     x = ensure_tensor(x)
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers(
+        "unique_consecutive",
+        "The run count is data-dependent; compare neighbors (static shape) "
+        "or run it outside the compiled region.",
+        x,
+    )
     arr = np.asarray(x._value)
     if axis is None:
         arr = arr.reshape(-1)
@@ -550,13 +600,21 @@ def view_as(x, other, name=None):
 
 
 def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view as a gather (XLA has no strides — SURVEY.md §7 hard
+    parts; the gather formulation is traceable and differentiable)."""
     x = ensure_tensor(x)
-    arr = np.lib.stride_tricks.as_strided(
-        np.asarray(x._value).reshape(-1)[offset:],
-        shape=shape,
-        strides=[s * x._value.dtype.itemsize for s in stride],
-    )
-    return Tensor(jnp.asarray(arr.copy()))
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+
+    def _as_strided(v):
+        flat = v.reshape(-1)
+        idx = jnp.asarray(offset, jnp.int32)
+        for dim, (n, st) in enumerate(zip(shape, stride)):
+            ax_idx = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), dim)
+            idx = idx + ax_idx * jnp.int32(st)
+        return jnp.take(flat, idx)
+
+    return apply("as_strided", _as_strided, x)
 
 
 def unfold(x, axis, size, step, name=None):

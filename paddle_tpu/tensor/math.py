@@ -391,20 +391,29 @@ def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
     x = ensure_tensor(x)
-    import numpy as np
+    args = [x] + ([ensure_tensor(weights)] if weights is not None else [])
 
-    h, edges = np.histogramdd(
-        np.asarray(x._value),
-        bins=bins,
-        range=ranges,
-        density=density,
-        weights=None if weights is None else np.asarray(ensure_tensor(weights)._value),
-    )
-    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+    def _hdd(v, *w):
+        h, edges = jnp.histogramdd(
+            v, bins=bins, range=ranges, density=density,
+            weights=w[0] if w else None,
+        )
+        return (h, *edges)
+
+    out = apply("histogramdd", _hdd, *args)
+    return out[0], list(out[1:])
 
 
 def bincount(x, weights=None, minlength=0, name=None):
     x = ensure_tensor(x)
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers(
+        "bincount",
+        "The output length is max(x)+1 (data-dependent); under jit use "
+        "paddle.scatter/segment ops with a static length.",
+        x,
+    )
     v = x._value
     length = int(jnp.maximum(jnp.max(v) + 1 if v.size else 0, minlength))
     w = ensure_tensor(weights)._value if weights is not None else None
@@ -494,14 +503,16 @@ def signbit(x, name=None):
 
 
 def combinations(x, r=2, with_replacement=False, name=None):
+    """Traceable: index combinations are static in len(x), values gathered."""
     import itertools
 
     import numpy as np
 
     x = ensure_tensor(x)
-    arr = np.asarray(x._value)
-    it = itertools.combinations_with_replacement(arr, r) if with_replacement else itertools.combinations(arr, r)
-    combos = list(it)
-    if not combos:
+    n = int(x._value.shape[0])
+    rng = range(n)
+    it = itertools.combinations_with_replacement(rng, r) if with_replacement else itertools.combinations(rng, r)
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+    if idx.size == 0:
         return Tensor(jnp.zeros((0, r), x._value.dtype))
-    return Tensor(jnp.asarray(np.stack([np.stack(c) for c in combos])))
+    return apply("combinations", lambda v: jnp.take(v, jnp.asarray(idx), axis=0), x)

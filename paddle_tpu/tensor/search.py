@@ -90,12 +90,18 @@ def where_(condition, x, y, name=None):
 
 def nonzero(x, as_tuple=False):
     x = ensure_tensor(x)
-    # Data-dependent output shape: eager only (XLA needs static shapes).
-    arr = np.asarray(x._value)
-    nz = np.nonzero(arr)
+    from paddle_tpu.tensor._ops_common import reject_tracers
+
+    reject_tracers(
+        "nonzero",
+        "The count of nonzeros is data-dependent; use boolean masks "
+        "(paddle.where with full shapes) inside compiled code.",
+        x,
+    )
+    nz = jnp.nonzero(x._value)  # concrete: executes on device
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(n.astype(np.int64))[:, None]) for n in nz)
-    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+        return tuple(Tensor(n.astype(jnp.int32)[:, None]) for n in nz)
+    return Tensor(jnp.stack(nz, axis=1).astype(jnp.int32))
 
 
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
@@ -115,24 +121,37 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value per slice; ties pick the LARGEST value, index is
+    its LAST occurrence (reference mode kernel semantics).  Traceable: an
+    O(n^2) pairwise-count formulation replaces the round-1 numpy loop."""
     x = ensure_tensor(x)
-    arr = np.asarray(x._value)
-    vm = np.moveaxis(arr, axis, -1)
-    flat = vm.reshape(-1, vm.shape[-1])
-    vals = np.empty(flat.shape[0], arr.dtype)
-    idxs = np.empty(flat.shape[0], np.int64)
-    for i, row in enumerate(flat):
-        uniq, counts = np.unique(row, return_counts=True)
-        best = uniq[np.where(counts == counts.max())[0][-1]]
-        vals[i] = best
-        idxs[i] = np.where(row == best)[0][-1]
-    out_shape = vm.shape[:-1]
-    v_out = vals.reshape(out_shape)
-    i_out = idxs.reshape(out_shape)
+
+    def _mode(v):
+        vm = jnp.moveaxis(v, axis, -1)
+        eq = vm[..., :, None] == vm[..., None, :]
+        counts = eq.sum(-1)  # occurrences of each element
+        maxc = counts.max(-1, keepdims=True)
+        is_best = counts == maxc
+        # largest value among max-count candidates
+        if jnp.issubdtype(vm.dtype, jnp.inexact):
+            lowest = jnp.asarray(-jnp.inf, vm.dtype)
+        else:
+            lowest = jnp.iinfo(vm.dtype).min
+        best = jnp.max(jnp.where(is_best, vm, lowest), axis=-1, keepdims=True)
+        # last occurrence index of the winning value
+        hit = vm == best
+        n = vm.shape[-1]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        idx = jnp.max(jnp.where(hit, pos, -1), axis=-1)
+        return best[..., 0], idx
+
+    v_out, i_out = apply("mode", _mode, x)
     if keepdim:
-        v_out = np.expand_dims(v_out, axis)
-        i_out = np.expand_dims(i_out, axis)
-    return Tensor(jnp.asarray(v_out)), Tensor(jnp.asarray(i_out))
+        from .manipulation import unsqueeze
+
+        v_out = unsqueeze(v_out, axis)
+        i_out = unsqueeze(i_out, axis)
+    return v_out, i_out
 
 
 def masked_select(x, mask, name=None):

@@ -1,0 +1,13 @@
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def entry(x):
+    if x.sum() > 0:
+        return _helper(x)
+    return x
+
+
+def _helper(x):  # defined AFTER entry is decorated
+    return x * 2

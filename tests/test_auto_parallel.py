@@ -89,6 +89,7 @@ def test_shard_layer_default_replicates(mesh):
         assert all(pl.is_replicated() for pl in p.placements)
 
 
+@pytest.mark.slow
 def test_sharded_train_step_tp_dp():
     """Full distributed train step: dp=2 x mp=4 TP llama + zero-1, matches
     the single-device step numerically."""

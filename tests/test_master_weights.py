@@ -41,6 +41,7 @@ def test_tiny_updates_not_lost():
     assert float(master[0]) != 1.0
 
 
+@pytest.mark.slow
 def test_bf16_tracks_fp32_adamw():
     """200 steps of bf16-with-master AdamW stays close to a pure fp32 run."""
     rng = np.random.default_rng(0)

@@ -3,6 +3,7 @@ python/paddle/incubate/distributed/models/moe/ and
 test/collective MoE worker scripts)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax import shard_map
@@ -69,6 +70,7 @@ def test_moe_layer_matches_dense_topk_with_high_capacity():
     np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_matches_world1():
     """EP over 4 ranks == same computation at world 1 (batch gathered)."""
     d, n_exp = 8, 4

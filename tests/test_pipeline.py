@@ -54,6 +54,7 @@ def _copy_blocks(blocks, h):
     return out
 
 
+@pytest.mark.slow
 def test_pipeline_stack_gradients_match():
     mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
     blocks = _blocks(8, 16, seed=1)
@@ -85,6 +86,7 @@ def test_pipeline_stack_gradients_match():
             np.testing.assert_allclose(g[li], bg, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_3d_hybrid_train_step():
     """dp2 x pp2 x mp2 llama training step matches single-device numerics."""
     from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny, pipeline_llama, shard_llama
@@ -124,6 +126,7 @@ def test_llama_3d_hybrid_train_step():
 
 
 @pytest.mark.parametrize("schedule,M", [("1F1B", 8), ("1F1B", 16), ("FThenB", 8)])
+@pytest.mark.slow
 def test_pipeline_microbatch_schedules_match_sequential(schedule, M):
     """num_microbatches > stages (steady-state 1F1B, reference
     pipeline_parallel.py:431) and the FThenB schedule produce identical

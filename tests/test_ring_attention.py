@@ -2,6 +2,7 @@
 (long-context SEP axis — SURVEY.md §5)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax import shard_map
@@ -30,6 +31,7 @@ def _run_sharded(fn, q, k, v, w=4):
     )(q, k, v)
 
 
+@pytest.mark.slow
 def test_ring_attention_causal_matches_reference():
     q, k, v = _qkv()
     out = _run_sharded(lambda a, b, c, ax: ring_attention(a, b, c, ax, causal=True), q, k, v)
@@ -37,6 +39,7 @@ def test_ring_attention_causal_matches_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_noncausal_matches_reference():
     q, k, v = _qkv(seed=1)
     out = _run_sharded(lambda a, b, c, ax: ring_attention(a, b, c, ax, causal=False), q, k, v)
@@ -44,6 +47,7 @@ def test_ring_attention_noncausal_matches_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match():
     q, k, v = _qkv(s=32, seed=2)
     mesh = _mesh(4)

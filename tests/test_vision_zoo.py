@@ -36,30 +36,37 @@ class TestModelZoo:
         out = m(paddle.randn([2, 1, 28, 28]))
         assert tuple(out.shape) == (2, 10)
 
+    @pytest.mark.slow
     def test_alexnet(self):
         _check(models.alexnet(num_classes=10), size=224)
 
     @pytest.mark.parametrize("factory", [models.vgg11, models.vgg16])
+    @pytest.mark.slow
     def test_vgg(self, factory):
         _check(factory(num_classes=10, batch_norm=True), size=64)
 
+    @pytest.mark.slow
     def test_squeezenet(self):
         _check(models.squeezenet1_0(num_classes=10), size=96)
         _check(models.squeezenet1_1(num_classes=10), size=96)
 
+    @pytest.mark.slow
     def test_mobilenets(self):
         _check(models.mobilenet_v1(num_classes=10, scale=0.25), size=64)
         _check(models.mobilenet_v2(num_classes=10, scale=0.25), size=64)
         _check(models.mobilenet_v3_small(num_classes=10, scale=0.5), size=64)
         _check(models.mobilenet_v3_large(num_classes=10, scale=0.35), size=64)
 
+    @pytest.mark.slow
     def test_shufflenet(self):
         _check(models.shufflenet_v2_x0_25(num_classes=10), size=64)
         _check(models.shufflenet_v2_swish(num_classes=10), size=64)
 
+    @pytest.mark.slow
     def test_densenet(self):
         _check(models.densenet121(num_classes=10), size=64)
 
+    @pytest.mark.slow
     def test_googlenet_aux_outputs(self):
         m = models.googlenet(num_classes=10)
         m.eval()
@@ -67,6 +74,7 @@ class TestModelZoo:
         assert tuple(out.shape) == (1, 10)
         assert tuple(aux1.shape) == (1, 10) and tuple(aux2.shape) == (1, 10)
 
+    @pytest.mark.slow
     def test_inception_v3(self):
         _check(models.inception_v3(num_classes=10), size=160)
 
@@ -89,6 +97,7 @@ class TestModelZoo:
         missing = [n for n in ref if not hasattr(models, n)]
         assert missing == []
 
+    @pytest.mark.slow
     def test_train_step_on_mobilenet(self):
         import paddle_tpu.nn as nn
         import paddle_tpu.optimizer as opt
