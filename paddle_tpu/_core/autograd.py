@@ -177,10 +177,24 @@ def _maybe_amp_cast(name, args):
     return tuple(cast(a) for a in args)
 
 
+def _nanfail(ok, name):
+    if not bool(ok):
+        raise FloatingPointError(f"NaN/Inf detected in output of op '{name}'")
+
+
 def _check_nan_inf(name, vals):
+    """FLAGS_check_nan_inf: eager values checked synchronously; traced values
+    get an in-graph host callback so the check ALSO fires inside compiled
+    steps (reference runs it in-kernel, paddle/phi/kernels/
+    check_numerics_kernel.h — round-1 skipped tracers, making the flag dead
+    on the only path that matters)."""
+    import functools as _ft
+
     for v in vals:
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
-            if not isinstance(v, jax.core.Tracer) and bool(jnp.any(~jnp.isfinite(v))):
+            if isinstance(v, jax.core.Tracer):
+                jax.debug.callback(_ft.partial(_nanfail, name=name), jnp.all(jnp.isfinite(v)))
+            elif bool(jnp.any(~jnp.isfinite(v))):
                 raise FloatingPointError(f"NaN/Inf detected in output of op '{name}'")
 
 

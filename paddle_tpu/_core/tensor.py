@@ -210,18 +210,48 @@ class Tensor:
 
     # --------------------------------------------------------------- device
     def to(self, *args, **kwargs):
-        """to(place)/to(dtype)/to(place, dtype) — device moves are handled by
-        jax.device_put; dtype converts via cast."""
+        """to(place)/to(dtype)/to(place, dtype) — dtype converts via cast,
+        device moves via jax.device_put.  Unknown strings (typo'd dtypes)
+        raise instead of silently no-op'ing (round-1 weak #10)."""
         target_dtype = None
+        target_device = None
+        known_devices = ("cpu", "gpu", "tpu", "xpu", "npu", "ipu")
         for a in list(args) + list(kwargs.values()):
-            if isinstance(a, (str, dtype_mod.DType)):
+            if isinstance(a, Place):
+                target_device = a
+            elif isinstance(a, (str, dtype_mod.DType)) or (
+                not isinstance(a, bool) and hasattr(a, "name")
+            ):
                 try:
                     target_dtype = dtype_mod.to_paddle_dtype(a)
+                    continue
                 except ValueError:
-                    pass  # a device string
+                    pass
+                dev = str(a).split(":")[0].lower()
+                if dev in known_devices:
+                    target_device = str(a)
+                else:
+                    raise ValueError(
+                        f"Tensor.to(): {a!r} is neither a known dtype nor a "
+                        f"device string (expected one of {known_devices})"
+                    )
         out = self
         if target_dtype is not None and target_dtype != self.dtype:
             out = out.astype(target_dtype)
+        if target_device is not None:
+            dev = str(target_device).split(":")[0].lower()
+            import jax as _jax
+
+            try:
+                # map gpu/xpu/etc onto the accelerator backend if present
+                plat = "cpu" if dev == "cpu" else _jax.default_backend()
+                moved = _jax.device_put(out._value, _jax.devices(plat)[0])
+                if out is self:
+                    out = Tensor(moved, stop_gradient=self.stop_gradient)
+                else:
+                    out._bind(moved)
+            except RuntimeError:
+                pass  # backend unavailable: keep placement
         return out
 
     def cpu(self):

@@ -99,8 +99,17 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
 
 
 class GradScaler:
-    """Dynamic loss scaling (reference grad_scaler.py).  On bfloat16 runs,
-    construct with enable=False (scaling unnecessary)."""
+    """Dynamic loss scaling (reference grad_scaler.py).
+
+    TPU-native redesign: the scaler STATE (loss scale, good/bad step
+    counters) lives in device scalar Tensors and every decision is a traced
+    select — so the scaler works identically eagerly and inside a compiled
+    TrainStep (the reference's python-bool bookkeeping would freeze at trace
+    time).  A skipped step (inf/nan grads) is expressed as
+    where(found_inf, old, updated) over params and accumulators, matching
+    the reference's found_inf kernel path.  On bfloat16 runs, construct with
+    enable=False (scaling unnecessary).
+    """
 
     def __init__(
         self,
@@ -113,44 +122,68 @@ class GradScaler:
         use_dynamic_loss_scaling=True,
     ):
         self._enable = enable
-        self._scale = float(init_loss_scaling)
-        self._incr_ratio = incr_ratio
-        self._decr_ratio = decr_ratio
-        self._incr_every = incr_every_n_steps
-        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every = int(incr_every_n_steps)
+        self._decr_every = int(decr_every_n_nan_or_inf)
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
-        self._found_inf = False
+        self._scale_t = Tensor(jnp.asarray(float(init_loss_scaling), jnp.float32))
+        self._good_t = Tensor(jnp.asarray(0, jnp.int32))
+        self._bad_t = Tensor(jnp.asarray(0, jnp.int32))
+        self._found_t = Tensor(jnp.asarray(False))
+
+    def state_tensors(self):
+        """Device-state exposed to compiled train steps (TrainStep donates
+        and threads these alongside params/accumulators)."""
+        return [self._scale_t, self._good_t, self._bad_t]
 
     def scale(self, var):
         if not self._enable:
             return var
         from paddle_tpu.tensor._ops_common import apply
 
-        s = self._scale
-        return apply("amp_scale", lambda v: v * jnp.asarray(s, v.dtype), var)
+        return apply(
+            "amp_scale", lambda v, s: v * s.astype(v.dtype), var, self._scale_t
+        )
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
+        inv = 1.0 / self._scale_t._value
+        found = jnp.asarray(False)
         for p in optimizer._parameter_list:
             if p.grad is not None:
                 g = p.grad._value.astype(jnp.float32) * inv
-                if not _is_tracer(g):
-                    found = found or bool(jnp.any(~jnp.isfinite(g)))
+                found = jnp.logical_or(found, jnp.any(~jnp.isfinite(g)))
                 p.grad = Tensor(g)
-        self._found_inf = found
+        self._found_t = Tensor(found)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
+        found = self._found_t._value
+        if not _is_tracer(found):
+            if not bool(found):
+                optimizer.step()
+            self.update()
+            return
+        # traced: run the update unconditionally, then select old state back
+        # where inf was found (the skip, expressed functionally)
+        params = [p for p in optimizer._parameter_list if not p.stop_gradient]
+        snap_p = [(p, p._value) for p in params]
+        snap_a = {k: t._value for k, t in optimizer._accumulators.items()}
+        optimizer.step()
+        for p, old in snap_p:
+            p._bind(jnp.where(found, old, p._value))
+        for k, t in optimizer._accumulators.items():
+            old = snap_a.get(k)
+            if old is None:
+                old = jnp.zeros_like(t._value)  # created this step
+            p_val = t._value
+            if old.shape == p_val.shape:
+                t._bind(jnp.where(found, old, p_val))
         self.update()
 
     def minimize(self, optimizer, scaled_loss):
@@ -160,18 +193,19 @@ class GradScaler:
     def update(self):
         if not self._enable or not self._dynamic:
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
+        found = self._found_t._value
+        good = self._good_t._value
+        bad = self._bad_t._value
+        scale = self._scale_t._value
+        bad2 = jnp.where(found, bad + 1, 0)
+        good2 = jnp.where(found, 0, good + 1)
+        dec = bad2 >= self._decr_every
+        inc = jnp.logical_and(~found, good2 >= self._incr_every)
+        new_scale = jnp.where(dec, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        new_scale = jnp.where(inc, new_scale * self._incr_ratio, new_scale)
+        self._scale_t._bind(new_scale)
+        self._bad_t._bind(jnp.where(dec, 0, bad2).astype(jnp.int32))
+        self._good_t._bind(jnp.where(inc, 0, good2).astype(jnp.int32))
 
     def is_enable(self):
         return self._enable
@@ -180,24 +214,25 @@ class GradScaler:
         return self._dynamic
 
     def get_loss_scaling(self):
-        return self._scale
+        v = self._scale_t._value
+        return v if _is_tracer(v) else float(v)
 
     def set_init_loss_scaling(self, v):
-        self._scale = float(v)
+        self._scale_t._bind(jnp.asarray(float(v), jnp.float32))
 
     def state_dict(self):
         return {
-            "scale": self._scale,
+            "scale": self.get_loss_scaling(),
             "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
-            "incr_count": self._good_steps,
-            "decr_count": self._bad_steps,
+            "incr_count": int(self._good_t._value) if not _is_tracer(self._good_t._value) else 0,
+            "decr_count": int(self._bad_t._value) if not _is_tracer(self._bad_t._value) else 0,
         }
 
     def load_state_dict(self, state):
-        self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("incr_count", 0)
-        self._bad_steps = state.get("decr_count", 0)
+        self._scale_t._bind(jnp.asarray(float(state.get("scale", self.get_loss_scaling())), jnp.float32))
+        self._good_t._bind(jnp.asarray(int(state.get("incr_count", 0)), jnp.int32))
+        self._bad_t._bind(jnp.asarray(int(state.get("decr_count", 0)), jnp.int32))
 
 
 def _is_tracer(x):

@@ -36,6 +36,7 @@ from paddle_tpu._core.autograd import apply
 from paddle_tpu._core.tensor import Tensor
 
 from .group import Group
+from .watchdog import static_check as _static_check
 
 __all__ = [
     "ReduceOp",
@@ -171,6 +172,7 @@ def _no_multihost():
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    _static_check("all_reduce", tensor, group)
     ax = _axis_for(group)
     if ax is not None:
         red = _reduce_fn(op)
@@ -185,6 +187,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
+    _static_check("all_gather", tensor, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "all_gather")
     if ax is not None:
@@ -212,6 +215,7 @@ def all_gather_object(object_list, obj, group=None):
 
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    _static_check("broadcast", tensor, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "broadcast")
     if ax is not None:
@@ -279,6 +283,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    _static_check("reduce_scatter", tensor, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "reduce_scatter")
     if ax is not None:
@@ -374,13 +379,21 @@ def barrier(group=None):
         return _Task()
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    from .watchdog import comm_watch
+
+    with comm_watch("barrier", group=group):
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
     return _Task()
 
 
 def wait(tensor, group=None, use_calc_stream=True):
     """Stream-sync placeholder: XLA's async collectives are ordered by the
-    compiler; block on the value instead (reference waits on comm stream)."""
+    compiler; block on the value instead (reference waits on comm stream).
+    The block is watchdog-guarded: on a multi-host mesh a dead peer turns
+    this wait into the visible hang (reference CommTask::IsTimeout)."""
     if isinstance(tensor, Tensor) and hasattr(tensor._value, "block_until_ready"):
-        tensor._value.block_until_ready()
+        from .watchdog import comm_watch
+
+        with comm_watch("wait", group=group):
+            tensor._value.block_until_ready()
     return _Task(tensor)

@@ -1,0 +1,226 @@
+"""Comm watchdog + cross-rank consistency checks.
+
+Reference: every async NCCL collective is wrapped in a CommTask with
+IsTimeout/AbortComm (paddle/phi/core/distributed/comm_task.h:127,147),
+monitored by a background CommTaskManager (comm_task_manager.h:37); static
+and on-device dynamic cross-rank shape/dtype checks live in
+paddle/phi/core/distributed/check/{static_check,nccl_dynamic_check}.cc.
+
+TPU-native redesign: collectives are compiled into SPMD programs, so a hung
+collective shows up as a host thread blocked in a device wait (a missing /
+crashed peer host never arrives at the XLA collective).  The watchdog
+therefore wraps the HOST blocking points — barriers, rendezvous waits,
+compiled-step executions on multi-host meshes — in `comm_watch(...)`
+contexts tracked by a daemon CommTaskManager that logs a loud diagnostic
+(task name, group, elapsed, creation stack) when a task exceeds its timeout
+and optionally aborts the process so a stuck multi-host job fails fast
+instead of hanging silently.
+
+Cross-rank static checks (`static_check`) exchange a shape/dtype digest
+through the rendezvous TCPStore before a collective (enabled via
+FLAGS_check_collective_shapes) — the analog of static_check.cc, catching
+mismatched-shape collective calls across ranks at the API layer since
+mismatches inside a compiled SPMD program are impossible by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+__all__ = [
+    "CommTask",
+    "CommTaskManager",
+    "comm_watch",
+    "static_check",
+    "default_timeout",
+    "set_rendezvous_store",
+    "get_rendezvous_store",
+]
+
+_DEFAULT_TIMEOUT = float(os.environ.get("FLAGS_comm_timeout_s", "1800"))
+
+
+def default_timeout() -> float:
+    return _DEFAULT_TIMEOUT
+
+
+class CommTask:
+    __slots__ = ("name", "group_desc", "timeout", "started", "done", "stack", "reported")
+
+    def __init__(self, name, group_desc, timeout):
+        self.name = name
+        self.group_desc = group_desc
+        self.timeout = timeout
+        self.started = time.monotonic()
+        self.done = False
+        self.reported = False
+        self.stack = traceback.format_stack(limit=12)
+
+    def is_timeout(self) -> bool:
+        return not self.done and (time.monotonic() - self.started) > self.timeout
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.started
+
+
+class CommTaskManager:
+    """Background scanner (reference comm_task_manager.h:37)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self, scan_interval=2.0):
+        self._tasks: list[CommTask] = []
+        self._tasks_lock = threading.Lock()
+        self._interval = scan_interval
+        self._thread = None
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle_tpu_comm_watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def register(self, task: CommTask):
+        with self._tasks_lock:
+            self._tasks.append(task)
+        self._ensure_thread()
+
+    def complete(self, task: CommTask):
+        task.done = True
+        with self._tasks_lock:
+            try:
+                self._tasks.remove(task)
+            except ValueError:
+                pass
+
+    def _loop(self):
+        import sys
+
+        while True:
+            time.sleep(self._interval)
+            with self._tasks_lock:
+                tasks = list(self._tasks)
+            for t in tasks:
+                if t.is_timeout() and not t.reported:
+                    t.reported = True
+                    msg = (
+                        f"\n[paddle_tpu comm watchdog] collective task "
+                        f"'{t.name}' (group={t.group_desc}) has been blocked "
+                        f"for {t.elapsed():.0f}s (timeout {t.timeout:.0f}s) — "
+                        f"a peer rank is likely hung or dead.\nTask created at:\n"
+                        + "".join(t.stack[:-1])
+                    )
+                    print(msg, file=sys.stderr, flush=True)
+                    if os.environ.get("FLAGS_comm_timeout_abort", "0") in ("1", "true", "True"):
+                        print(
+                            "[paddle_tpu comm watchdog] FLAGS_comm_timeout_abort "
+                            "set: aborting process.",
+                            file=sys.stderr,
+                            flush=True,
+                        )
+                        os._exit(124)
+
+
+class comm_watch:
+    """Context manager guarding one blocking comm operation."""
+
+    def __init__(self, name, group=None, timeout=None):
+        desc = "world"
+        if group is not None:
+            desc = getattr(group, "_name", None) or f"ranks={getattr(group, 'ranks', '?')}"
+        self._task = CommTask(name, desc, timeout if timeout is not None else _DEFAULT_TIMEOUT)
+
+    def __enter__(self):
+        CommTaskManager.instance().register(self._task)
+        return self._task
+
+    def __exit__(self, *exc):
+        CommTaskManager.instance().complete(self._task)
+        return False
+
+
+# -------------------------------------------------------------------------
+# cross-rank static checks
+# -------------------------------------------------------------------------
+
+_store = None
+_check_seq = [0]
+
+
+def set_rendezvous_store(store):
+    """Called by the launcher / init_parallel_env with the TCPStore client."""
+    global _store
+    _store = store
+
+
+def get_rendezvous_store():
+    return _store
+
+
+def _checks_enabled() -> bool:
+    try:
+        from paddle_tpu._core import flags
+
+        if flags.flag("FLAGS_check_collective_shapes"):
+            return True
+    except Exception:
+        pass
+    return os.environ.get("FLAGS_check_collective_shapes", "0") in ("1", "true", "True")
+
+
+def static_check(op_name, tensor, group=None, rank=None, world=None, timeout=30.0):
+    """Exchange (shape, dtype) digests through the store; raise on mismatch.
+
+    Reference static_check.cc CheckShape/CheckDataType.  No-op unless
+    FLAGS_check_collective_shapes is set and a store + multi-process world
+    exist.
+    """
+    if not _checks_enabled() or _store is None:
+        return
+    import jax
+
+    rank = jax.process_index() if rank is None else rank
+    world = jax.process_count() if world is None else world
+    if world <= 1:
+        return
+    v = tensor._value if hasattr(tensor, "_value") else tensor
+    digest = f"{tuple(v.shape)}|{v.dtype}"
+    _check_seq[0] += 1
+    seq = _check_seq[0]
+    key = f"ccheck/{op_name}/{seq}/{rank}"
+    _store.set(key, digest.encode())
+    for r in range(world):
+        if r == rank:
+            continue
+        k = f"ccheck/{op_name}/{seq}/{r}"
+        try:
+            # native TCPStoreClient.get blocks server-side up to timeout_ms
+            try:
+                other = _store.get(k, timeout_ms=int(timeout * 1000))
+            except TypeError:
+                other = _store.get(k)
+        except (TimeoutError, KeyError):
+            raise TimeoutError(
+                f"static_check: rank {r} never published its shape/dtype "
+                f"for {op_name} (seq {seq})"
+            )
+        if isinstance(other, str):
+            other = other.encode()
+        if other.decode() != digest:
+            raise RuntimeError(
+                f"cross-rank mismatch in {op_name}: rank {rank} has {digest}, "
+                f"rank {r} has {other.decode()} — collective would deadlock "
+                f"or corrupt data"
+            )

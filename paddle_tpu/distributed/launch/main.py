@@ -56,9 +56,12 @@ class _Master:
 
     def __init__(self, endpoint, nnodes, is_host):
         from paddle_tpu.distributed.bootstrap import host_or_connect
+        from paddle_tpu.distributed.communication.watchdog import set_rendezvous_store
 
         self.nnodes = nnodes
         self.server, self.client = host_or_connect(endpoint, is_host)
+        # cross-rank static checks (watchdog.static_check) ride this store
+        set_rendezvous_store(self.client)
 
     def assign_rank(self, requested):
         if requested is not None and requested >= 0:

@@ -151,6 +151,8 @@ class TrainStep:
     def _collect_state(self):
         tensors = list(self.model.state_dict().values())
         tensors += self.optimizer.opt_state_tensors()
+        if self.scaler is not None and self.scaler.is_enable():
+            tensors += self.scaler.state_tensors()
         return tensors
 
     def _eager_step(self, *batch):
@@ -166,23 +168,14 @@ class TrainStep:
 
     def __call__(self, *batch):
         if self._compiled is None:
-            if self.scaler is not None and self.scaler.is_enable():
-                # scaler state is created by its python bookkeeping; one
-                # eager step materializes it alongside the accumulators.
-                # Run it on the host CPU backend — eager per-op dispatch on a
-                # remote-attached TPU pays one XLA compile round-trip per op.
-                with _host_device():
-                    loss = self._eager_step(*batch)
-                self._state = self._collect_state()
-                self._build()
-                return loss
             # Materialize optimizer accumulators WITHOUT an eager
             # forward/backward (which would dispatch hundreds of per-op XLA
             # compiles — ruinous on remote-attached TPUs).  The zero-grad
             # journaled step runs on the host CPU backend (only effective for
             # host-built, uncommitted params — state already device_put to an
             # accelerator stays there); the compiled step transfers fresh
-            # state to the accelerator on first call.
+            # state to the accelerator on first call.  GradScaler state is
+            # device tensors (amp/__init__.py) and joins the state list.
             params = [p for p in self.optimizer._parameter_list if not p.stop_gradient]
             with _host_device():
                 self.optimizer._journaled_step(params)

@@ -308,7 +308,17 @@ def flash_attention(q, k, v, *, causal=False, scale=None):
     seq_q, seq_k = qt.shape[2], kt.shape[2]
     block_q, block_k = _block_sizes(seq_q, seq_k)
     if seq_q % block_q or seq_k % block_k:
-        # padding keys changes non-causal softmax; fall back to reference
+        # padding keys changes non-causal softmax; fall back to the full
+        # O(S^2)-memory reference — fine for tests, a cliff in real use
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: seq lengths ({seq_q}, {seq_k}) are not "
+            f"multiples of the ({block_q}, {block_k}) block; falling back to "
+            "full-softmax attention (O(S^2) memory). Pad sequences to a "
+            "multiple of 128 for the Pallas kernel.",
+            stacklevel=2,
+        )
         return flash_attention_reference(q, k, v, causal=causal, scale=scale)
     out = _flash_bnsh(qt, kt, vt, float(scale), bool(causal), block_q, block_k)
     return jnp.swapaxes(out, 1, 2)

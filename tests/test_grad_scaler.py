@@ -1,0 +1,82 @@
+"""GradScaler inside a COMPILED TrainStep (round-1 weak #8: the scaler
+branch was never compiled by any test).  The scaler state is device tensors
+so dynamic loss scaling works identically eagerly and under jit."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.jit import TrainStep
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+
+
+def test_compiled_scaler_step_trains():
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0**10, incr_every_n_steps=3)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((32, 8)).astype(np.float32))
+    y = paddle.to_tensor((np.asarray(x._value) @ rng.standard_normal((8, 1))).astype(np.float32))
+
+    step = TrainStep(m, opt, lambda mm, a, b: ((mm(a) - b) ** 2).mean(), scaler=scaler)
+    losses = [float(step(x, y)) for _ in range(8)]
+    assert losses[-1] < losses[0] * 0.7, losses
+    # dynamic growth: incr_every=3 good steps doubles the scale at least once
+    assert scaler.get_loss_scaling() > 2.0**10
+
+
+def test_compiled_scaler_skips_on_inf():
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0**8, decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.full((4, 8), np.inf, np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    step = TrainStep(m, opt, lambda mm, a, b: ((mm(a) - b) ** 2).mean(), scaler=scaler)
+    before = [np.asarray(p._value).copy() for p in m.parameters()]
+    step(x, y)  # inf loss -> inf grads -> skip + scale halves
+    after = [np.asarray(p._value) for p in m.parameters()]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    assert scaler.get_loss_scaling() == 2.0**7
+
+
+def test_eager_scaler_matches_semantics():
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=4.0, incr_every_n_steps=2)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    for i in range(2):
+        loss = ((m(x) - y) ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        opt.clear_grad()
+    assert scaler.get_loss_scaling() == 8.0  # one doubling after 2 good steps
+
+
+def test_check_nan_inf_fires_inside_jit():
+    """FLAGS_check_nan_inf must catch NaN on the COMPILED path (round-1 weak
+    #7: the check skipped tracers)."""
+    import jax
+    import paddle_tpu._core.flags as flags
+    from paddle_tpu.jit import to_static
+
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        @to_static
+        def f(a):
+            return paddle.log(a)  # log(-1) -> nan
+
+        with pytest.raises(Exception) as ei:
+            out = f(paddle.to_tensor(np.array([-1.0], np.float32)))
+            jax.block_until_ready(out._value)
+        assert "NaN/Inf" in str(ei.value)
+    finally:
+        flags.set_flags({"FLAGS_check_nan_inf": False})

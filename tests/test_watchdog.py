@@ -1,0 +1,78 @@
+"""Comm watchdog + cross-rank static checks (reference comm_task.h:127
+CommTask/IsTimeout, comm_task_manager.h:37, static_check.cc)."""
+
+import io
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.communication import watchdog as wd
+
+
+def test_watchdog_reports_hung_task(capsys):
+    """A deliberately hung comm task is detected and reported."""
+    mgr = wd.CommTaskManager.instance()
+    mgr._interval = 0.05
+    task = wd.CommTask("fake_all_reduce", "ranks=[0,1]", timeout=0.1)
+    mgr.register(task)
+    try:
+        deadline = time.time() + 5
+        while not task.reported and time.time() < deadline:
+            time.sleep(0.05)
+        assert task.reported, "watchdog never flagged the hung task"
+        err = capsys.readouterr().err
+        assert "fake_all_reduce" in err and "blocked" in err
+    finally:
+        mgr.complete(task)
+
+
+def test_watchdog_quiet_on_completed_task(capsys):
+    with wd.comm_watch("quick_barrier", timeout=0.2):
+        pass
+    time.sleep(0.3)
+    assert "quick_barrier" not in capsys.readouterr().err
+
+
+class _FakeStore:
+    def __init__(self):
+        self.kv = {}
+
+    def set(self, k, v):
+        self.kv[k] = v
+
+    def get(self, k, timeout_ms=0):
+        if k not in self.kv:
+            raise TimeoutError(k)
+        return self.kv[k]
+
+
+def test_static_check_catches_cross_rank_mismatch(monkeypatch):
+    import paddle_tpu._core.flags as flags
+
+    store = _FakeStore()
+    wd.set_rendezvous_store(store)
+    flags.set_flags({"FLAGS_check_collective_shapes": True})
+    try:
+        t_rank0 = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        t_rank1 = paddle.to_tensor(np.zeros((2, 8), np.float32))
+        # simulate rank 1 publishing first (same seq counter on both "ranks")
+        seq = wd._check_seq[0] + 1
+        store.set(f"ccheck/all_reduce/{seq}/1", b"(2, 8)|float32")
+        with pytest.raises(RuntimeError, match="cross-rank mismatch"):
+            wd.static_check("all_reduce", t_rank0, rank=0, world=2, timeout=1)
+        # matching shapes pass
+        seq = wd._check_seq[0] + 1
+        store.set(f"ccheck/all_reduce/{seq}/1", b"(4, 4)|float32")
+        wd.static_check("all_reduce", t_rank0, rank=0, world=2, timeout=1)
+    finally:
+        flags.set_flags({"FLAGS_check_collective_shapes": False})
+        wd.set_rendezvous_store(None)
+
+
+def test_static_check_disabled_is_noop():
+    wd.set_rendezvous_store(None)
+    t = paddle.to_tensor(np.zeros(3, np.float32))
+    wd.static_check("all_reduce", t, rank=0, world=2)  # must not raise
