@@ -214,14 +214,15 @@ class Tensor:
         device moves via jax.device_put.  Unknown strings (typo'd dtypes)
         raise instead of silently no-op'ing (round-1 weak #10)."""
         target_dtype = None
-        target_device = None
+        target_device = None  # Place | device string
         known_devices = ("cpu", "gpu", "tpu", "xpu", "npu", "ipu")
         for a in list(args) + list(kwargs.values()):
-            if isinstance(a, Place):
+            if isinstance(a, Tensor):
+                # to(other): adopt the other tensor's dtype (paddle overload)
+                target_dtype = a.dtype
+            elif isinstance(a, Place):
                 target_device = a
-            elif isinstance(a, (str, dtype_mod.DType)) or (
-                not isinstance(a, bool) and hasattr(a, "name")
-            ):
+            elif isinstance(a, (str, dtype_mod.DType)):
                 try:
                     target_dtype = dtype_mod.to_paddle_dtype(a)
                     continue
@@ -239,13 +240,17 @@ class Tensor:
         if target_dtype is not None and target_dtype != self.dtype:
             out = out.astype(target_dtype)
         if target_device is not None:
-            dev = str(target_device).split(":")[0].lower()
             import jax as _jax
 
             try:
-                # map gpu/xpu/etc onto the accelerator backend if present
-                plat = "cpu" if dev == "cpu" else _jax.default_backend()
-                moved = _jax.device_put(out._value, _jax.devices(plat)[0])
+                if isinstance(target_device, Place):
+                    dev_obj = target_device.jax_device()
+                else:
+                    name, _, idx = str(target_device).partition(":")
+                    plat = "cpu" if name.lower() == "cpu" else _jax.default_backend()
+                    devs = _jax.devices(plat)
+                    dev_obj = devs[int(idx) % len(devs)] if idx else devs[0]
+                moved = _jax.device_put(out._value, dev_obj)
                 if out is self:
                     out = Tensor(moved, stop_gradient=self.stop_gradient)
                 else:

@@ -10,3 +10,4 @@ from .api import (  # noqa: F401
     spec_to_placements,
     unshard_dtensor,
 )
+from .engine import Engine, Strategy  # noqa: F401

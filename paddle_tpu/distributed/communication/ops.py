@@ -237,6 +237,7 @@ def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    _static_check("reduce", tensor, group)
     """All ranks reduce; only dst keeps the result (reference reduce).  In
     SPMD the masked variant costs the same as all_reduce."""
     ax = _axis_for(group)
@@ -262,6 +263,7 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _static_check("scatter", tensor, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "scatter")
     if ax is not None:
@@ -311,6 +313,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    _static_check("alltoall", in_tensor_list[0] if in_tensor_list else None, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "alltoall")
     if ax is not None:
@@ -332,6 +335,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    _static_check("alltoall_single", in_tensor, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "alltoall_single")
     if ax is not None:
@@ -351,6 +355,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    _static_check("send", tensor, group)
     ax = _axis_for(group)
     if ax is not None:
         raise NotImplementedError(
@@ -363,6 +368,7 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    _static_check("recv", tensor, group)
     return send(tensor, src, group, sync_op)
 
 

@@ -57,7 +57,9 @@ class CommTask:
         self.started = time.monotonic()
         self.done = False
         self.reported = False
-        self.stack = traceback.format_stack(limit=12)
+        # frame summaries only; formatting happens in the timeout report
+        # (this runs on every watched wait — keep it cheap)
+        self.stack = traceback.extract_stack(limit=12)
 
     def is_timeout(self) -> bool:
         return not self.done and (time.monotonic() - self.started) > self.timeout
@@ -120,7 +122,7 @@ class CommTaskManager:
                         f"'{t.name}' (group={t.group_desc}) has been blocked "
                         f"for {t.elapsed():.0f}s (timeout {t.timeout:.0f}s) — "
                         f"a peer rank is likely hung or dead.\nTask created at:\n"
-                        + "".join(t.stack[:-1])
+                        + "".join(traceback.format_list(t.stack[:-1]))
                     )
                     print(msg, file=sys.stderr, flush=True)
                     if os.environ.get("FLAGS_comm_timeout_abort", "0") in ("1", "true", "True"):
@@ -156,7 +158,7 @@ class comm_watch:
 # -------------------------------------------------------------------------
 
 _store = None
-_check_seq = [0]
+_check_seq: dict = {}  # (op_name, group_id) -> sequence counter
 
 
 def set_rendezvous_store(store):
@@ -185,26 +187,41 @@ def static_check(op_name, tensor, group=None, rank=None, world=None, timeout=30.
 
     Reference static_check.cc CheckShape/CheckDataType.  No-op unless
     FLAGS_check_collective_shapes is set and a store + multi-process world
-    exist.
+    exist.  Scoped to the GROUP's ranks (keys carry the group id and a
+    per-(op, group) sequence number so unrelated collectives never compare).
     """
     if not _checks_enabled() or _store is None:
         return
     import jax
 
     rank = jax.process_index() if rank is None else rank
-    world = jax.process_count() if world is None else world
-    if world <= 1:
+    if group is not None:
+        peers = list(getattr(group, "ranks", []) or [])
+        gid = getattr(group, "id", "g")
+        if peers and rank not in peers:
+            return  # this process doesn't participate
+    else:
+        world = jax.process_count() if world is None else world
+        peers = list(range(world))
+        gid = "world"
+    if len(peers) <= 1 or tensor is None:
         return
+    if isinstance(tensor, (list, tuple)):
+        if not tensor:
+            return
+        tensor = tensor[0]
     v = tensor._value if hasattr(tensor, "_value") else tensor
     digest = f"{tuple(v.shape)}|{v.dtype}"
-    _check_seq[0] += 1
-    seq = _check_seq[0]
-    key = f"ccheck/{op_name}/{seq}/{rank}"
+    seq_key = (op_name, gid)
+    _check_seq.setdefault(seq_key, 0)
+    _check_seq[seq_key] += 1
+    seq = _check_seq[seq_key]
+    key = f"ccheck/{gid}/{op_name}/{seq}/{rank}"
     _store.set(key, digest.encode())
-    for r in range(world):
+    for r in peers:
         if r == rank:
             continue
-        k = f"ccheck/{op_name}/{seq}/{r}"
+        k = f"ccheck/{gid}/{op_name}/{seq}/{r}"
         try:
             # native TCPStoreClient.get blocks server-side up to timeout_ms
             try:

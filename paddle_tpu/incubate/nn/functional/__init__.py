@@ -28,6 +28,8 @@ __all__ = [
     "swiglu",
     "fused_bias_act",
     "masked_multihead_attention",
+    "block_multihead_attention",
+    "fused_ec_moe",
     "variable_length_memory_efficient_attention",
 ]
 
@@ -256,3 +258,83 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
         return jnp.einsum("bnqk,bnkh->bnqh", probs, v.astype(jnp.float32)).astype(q.dtype)
 
     return apply("variable_length_memory_efficient_attention", _fn, query, key, value, *extras)
+
+
+def block_multihead_attention(
+    qkv,
+    key_cache,
+    value_cache,
+    block_tables,
+    seq_lens,
+    *,
+    num_heads,
+    num_kv_heads=None,
+    head_dim,
+    rotary_tables=None,
+    scale=None,
+):
+    """Paged-KV decode attention (reference:
+    python/paddle/incubate/nn/functional/block_multihead_attention.py,
+    kernel paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
+
+    One decode token per sequence.  qkv: [B, (N+2*Nkv)*H] fused projection;
+    key_cache/value_cache: [num_blocks, Nkv, block_size, H] paged pools;
+    block_tables: [B, max_blocks]; seq_lens: [B] length INCLUDING this token.
+    Returns (out [B, N*H], key_cache', value_cache').  The reference's
+    encoder/decoder seq-len bookkeeping collapses: prefill runs through the
+    normal flash path, only decode is paged (see models/llama.py generate).
+    """
+    from paddle_tpu.ops import paged_attention as pa
+
+    qkv = ensure_tensor(qkv)
+    key_cache = ensure_tensor(key_cache)
+    value_cache = ensure_tensor(value_cache)
+    block_tables = ensure_tensor(block_tables)
+    seq_lens = ensure_tensor(seq_lens)
+    nkv = num_kv_heads or num_heads
+
+    def _fn(qkv_v, kc, vc, bt, lens):
+        b = qkv_v.shape[0]
+        splits = [num_heads * head_dim, nkv * head_dim, nkv * head_dim]
+        q = qkv_v[:, : splits[0]].reshape(b, num_heads, head_dim)
+        k = qkv_v[:, splits[0] : splits[0] + splits[1]].reshape(b, nkv, head_dim)
+        v = qkv_v[:, splits[0] + splits[1] :].reshape(b, nkv, head_dim)
+        pos = lens - 1  # slot of this token
+        if rotary_tables is not None:
+            cos, sin = rotary_tables
+            q = pa.rope_rotate_by_position(q, cos, sin, pos)
+            k = pa.rope_rotate_by_position(k, cos, sin, pos)
+        kc = pa.paged_write(kc, k, bt, pos)
+        vc = pa.paged_write(vc, v, bt, pos)
+        out = pa.paged_decode_attention(q, kc, vc, bt, lens, scale=scale)
+        return out.reshape(b, num_heads * head_dim), kc, vc
+
+    return apply("block_multihead_attention", _fn, qkv, key_cache, value_cache, block_tables, seq_lens)
+
+
+def fused_ec_moe(x, gate_weight, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type="gelu"):
+    """Fused expert-computation MoE (reference:
+    python/paddle/incubate/nn/functional/fused_ec_moe.py, CUDA kernel
+    fused_ec_moe under phi/kernels/fusion): every token runs EVERY expert's
+    FFN via batched matmuls and the outputs are mixed by softmax gate
+    weights.  On TPU the two einsums land directly on the MXU with the gate
+    mix fused by XLA — the dense-MoE tier used for small expert counts
+    (capacity-dispatch MoE lives in incubate.distributed MoELayer)."""
+    x = ensure_tensor(x)
+    args = [x, ensure_tensor(gate_weight), ensure_tensor(bmm0_weight), ensure_tensor(bmm0_bias),
+            ensure_tensor(bmm1_weight), ensure_tensor(bmm1_bias)]
+
+    def _fn(xv, gw, w0, b0, w1, b1):
+        # xv: [B, S, D]; gw: [D, E]; w0: [E, D, Dff]; w1: [E, Dff, D]
+        probs = jax.nn.softmax(xv.astype(jnp.float32) @ gw.astype(jnp.float32), axis=-1)
+        h = jnp.einsum("bsd,edf->bsef", xv, w0) + b0[None, None]
+        if act_type == "gelu":
+            h = jax.nn.gelu(h)
+        elif act_type == "relu":
+            h = jnp.maximum(h, 0)
+        else:
+            raise ValueError(f"unsupported act {act_type}")
+        eo = jnp.einsum("bsef,efd->bsed", h, w1) + b1[None, None]
+        return jnp.einsum("bsed,bse->bsd", eo.astype(jnp.float32), probs).astype(xv.dtype)
+
+    return apply("fused_ec_moe", _fn, *args)

@@ -113,7 +113,19 @@ class LlamaAttention(nn.Layer):
             rep = self.num_heads // self.num_kv_heads
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=kv_cache is None)
+        if kv_cache is not None and s > 1 and kv_cache[0].shape[1] > 0:
+            # jax's causal mask is top-left aligned: with L cached keys a
+            # multi-token chunk would mask the cache out — reject rather
+            # than silently compute wrong logits
+            raise NotImplementedError(
+                "chunked prefill (multi-token input on a non-empty cache) is "
+                "not supported; decode one token at a time"
+            )
+        # empty-cache prefill is causal; a cached single-token decode
+        # attends to everything it has
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=(kv_cache is None) or s > 1
+        )
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if new_cache is not None:
@@ -151,15 +163,24 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self._use_recompute = config.use_recompute
 
-    def forward(self, hidden_states, rope_cos, rope_sin, attn_mask=None):
+    def forward(self, hidden_states, rope_cos, rope_sin, attn_mask=None, kv_cache=None, position_offset=0):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
-        h = self.self_attn(h, rope_cos, rope_sin, attn_mask)
+        new_cache = None
+        if kv_cache is not None:
+            h, new_cache = self.self_attn(
+                h, rope_cos, rope_sin, attn_mask, kv_cache=kv_cache, position_offset=position_offset
+            )
+        else:
+            h = self.self_attn(h, rope_cos, rope_sin, attn_mask)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
         h2 = self.mlp(h2)
-        return residual + h2
+        out = residual + h2
+        if new_cache is not None:
+            return out, new_cache
+        return out
 
 
 class LlamaModel(nn.Layer):
@@ -196,6 +217,47 @@ class LlamaModel(nn.Layer):
         return self.norm(h)
 
 
+def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens):
+    """One decoder layer on one new token against the paged KV pools.
+
+    h: Tensor [B, 1, D]; kc/vc: [num_blocks, Nkv, bs, H] pools (raw arrays);
+    tables: [B, max_blocks]; lens: [B] lengths INCLUDING this token.
+    Returns (Tensor h', kc', vc').
+    """
+    from paddle_tpu.ops import paged_attention as pa
+
+    attn = layer.self_attn
+    residual = h
+    x = layer.input_layernorm(h)
+    b = int(x.shape[0])
+    n, nkv, hd = attn.num_heads, attn.num_kv_heads, attn.head_dim
+    qv = attn.q_proj(x)._value.reshape(b, n, hd)
+    kv_ = attn.k_proj(x)._value.reshape(b, nkv, hd)
+    vv = attn.v_proj(x)._value.reshape(b, nkv, hd)
+    pos = lens - 1
+    qv = pa.rope_rotate_by_position(qv, cos, sin, pos)
+    kv_ = pa.rope_rotate_by_position(kv_, cos, sin, pos)
+    kc = pa.paged_write(kc, kv_, tables, pos)
+    vc = pa.paged_write(vc, vv, tables, pos)
+    o = pa.paged_decode_attention(qv, kc, vc, tables, lens)
+    out = attn.o_proj(Tensor(o.reshape(b, 1, n * hd)))
+    h = residual + out
+    residual = h
+    h2 = layer.post_attention_layernorm(h)
+    h2 = layer.mlp(h2)
+    return residual + h2, kc, vc
+
+
+def _model_forward_cached(model: "LlamaModel", input_ids, caches, position_offset=0):
+    """Thread per-layer naive KV caches (prefill or decode)."""
+    h = model.embed_tokens(input_ids)
+    new_caches = []
+    for layer, c in zip(model.layers, caches):
+        h, nc = layer(h, model.rope_cos, model.rope_sin, None, kv_cache=c, position_offset=position_offset)
+        new_caches.append(nc)
+    return model.norm(h), new_caches
+
+
 class LlamaForCausalLM(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -222,6 +284,114 @@ class LlamaForCausalLM(nn.Layer):
             )
             return loss, logits
         return logits
+
+    def _logits(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return paddle.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+
+    @paddle.no_grad()
+    def generate(self, input_ids, max_new_tokens=16, cache: str = "paged", block_size: int = 16):
+        """Greedy incremental decode (serving path).
+
+        cache="naive": per-layer concat caches (reference use_cache
+        semantics; shapes grow each step, eager).
+        cache="paged": block-pooled KV (reference block_multihead_attention,
+        paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu):
+        static shapes, so every decode step reuses ONE compiled program;
+        pool memory is allocated per block_size-token page.
+        """
+        import numpy as np
+
+        import jax
+
+        cfg = self.config
+        b, s0 = int(input_ids.shape[0]), int(input_ids.shape[1])
+        n_layers = cfg.num_hidden_layers
+        nkv = cfg.num_key_value_heads
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+
+        # prefill with naive caches (causal), collect per-layer K/V
+        empty = [
+            (
+                paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype),
+                paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype),
+            )
+            for _ in range(n_layers)
+        ]
+        h, caches = _model_forward_cached(self.model, input_ids, empty, 0)
+        next_tok = paddle.argmax(self._logits(h[:, -1:, :]), axis=-1)
+        out_tokens = [next_tok]
+
+        if cache == "naive":
+            cur = caches
+            for step in range(1, max_new_tokens):
+                h, cur = _model_forward_cached(self.model, next_tok, cur, s0 + step - 1)
+                next_tok = paddle.argmax(self._logits(h), axis=-1)
+                out_tokens.append(next_tok)
+            return paddle.concat(out_tokens, axis=1)
+
+        if cache != "paged":
+            raise ValueError(f"cache must be 'naive' or 'paged', got {cache!r}")
+
+        # ---- paged: pour prefill K/V into block pools -------------------
+        max_len = s0 + max_new_tokens
+        blocks_per_seq = -(-max_len // block_size)
+        num_blocks = b * blocks_per_seq
+        # seq i owns blocks [i*bps, (i+1)*bps) — a trivial allocator; real
+        # serving shares the pool across requests via these same tables
+        tables = jnp.asarray(
+            np.arange(num_blocks, dtype=np.int32).reshape(b, blocks_per_seq)
+        )
+        pools = []
+        pad = blocks_per_seq * block_size - s0
+        for (k, v) in caches:
+            kc = jnp.moveaxis(k._value, 1, 2)  # [B, Nkv, S, H]
+            vc = jnp.moveaxis(v._value, 1, 2)
+            kc = jnp.pad(kc, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            vc = jnp.pad(vc, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            # [B, Nkv, bps*bs, H] -> [B*bps, Nkv, bs, H] pool layout
+            kc = kc.reshape(b, nkv, blocks_per_seq, block_size, head_dim)
+            vc = vc.reshape(b, nkv, blocks_per_seq, block_size, head_dim)
+            pools.append(
+                (
+                    jnp.moveaxis(kc, 1, 2).reshape(num_blocks, nkv, block_size, head_dim),
+                    jnp.moveaxis(vc, 1, 2).reshape(num_blocks, nkv, block_size, head_dim),
+                )
+            )
+
+        state = list(self.state_dict().values())
+
+        def step_fn(state_vals, pool_vals, tok, lens):
+            originals = [t._value for t in state]
+            try:
+                for t, v in zip(state, state_vals):
+                    t._bind(v)
+                with paddle.no_grad():
+                    hh = self.model.embed_tokens(Tensor(tok))
+                    new_pools = []
+                    for layer, (kc, vc) in zip(self.model.layers, pool_vals):
+                        hh, kc, vc = _decode_layer_paged(
+                            layer, hh, self.model.rope_cos._value,
+                            self.model.rope_sin._value, kc, vc, tables, lens,
+                        )
+                        new_pools.append((kc, vc))
+                    hh = self.model.norm(hh)
+                    logits = self._logits(hh)
+                return jnp.argmax(logits._value[:, -1, :], axis=-1).astype(tok.dtype)[:, None], new_pools
+            finally:
+                for t, v in zip(state, originals):
+                    t._bind(v)
+
+        jit_step = jax.jit(step_fn, donate_argnums=(1,))
+        lens = jnp.full((b,), s0, jnp.int32)
+        tok = next_tok._value
+        state_vals = [t._value for t in state]
+        for step in range(1, max_new_tokens):
+            lens = lens + 1  # the new token occupies slot lens (0-based)
+            tok, pools = jit_step(state_vals, pools, tok, lens)
+            out_tokens.append(Tensor(tok))
+        return paddle.concat(out_tokens, axis=1)
 
 
 def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):

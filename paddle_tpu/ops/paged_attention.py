@@ -1,0 +1,111 @@
+"""Paged-KV (block) attention for serving.
+
+Reference: the block attention serving tier —
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and
+python/paddle/incubate/nn/functional/block_multihead_attention.py: the KV
+cache is a pool of fixed-size blocks; each sequence owns a block table
+mapping its logical positions onto pool blocks, so cache memory is allocated
+per-16-token page instead of per-max-seq-len (vLLM-style paging).
+
+TPU-native design: the pool is ONE [num_blocks, Nkv, block_size, H] array per
+K and V; block writes are scatter-at-index updates and decode attention
+gathers each sequence's pages with jnp.take on the block table.  Both lower
+to XLA dynamic-scatter/gather which on TPU are HBM-bandwidth-bound copies —
+the same roofline the hand-written CUDA kernel targets — and the whole
+decode step (gather + QK^T + softmax + PV) fuses into one executable.
+Everything is shape-static: max_blocks_per_seq bounds the gather and a
+length mask handles raggedness, so the step jits once and is reused for the
+whole decode.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "alloc_paged_cache",
+    "paged_write",
+    "paged_decode_attention",
+]
+
+
+def rope_rotate_by_position(t, cos, sin, positions):
+    """Interleaved-pair rotation of per-token heads by gathered positions.
+
+    t: [B, N, H]; cos/sin: [max_len, H/2] tables; positions: [B] int32.
+    The SINGLE rope implementation for decode paths (model prefill uses the
+    same pair convention in models/llama.py apply_rotary_pos_emb) — change
+    rope semantics here and there together.
+    """
+    b, n, h = t.shape
+    c = jnp.take(jnp.asarray(cos), positions, axis=0)[:, None, :]  # [B,1,H/2]
+    s = jnp.take(jnp.asarray(sin), positions, axis=0)[:, None, :]
+    t2 = t.astype(jnp.float32).reshape(b, n, h // 2, 2)
+    r1 = t2[..., 0] * c - t2[..., 1] * s
+    r2 = t2[..., 1] * c + t2[..., 0] * s
+    return jnp.stack([r1, r2], -1).reshape(b, n, h).astype(t.dtype)
+
+
+def alloc_paged_cache(num_blocks, num_kv_heads, block_size, head_dim, dtype=jnp.bfloat16):
+    """One K and one V pool: [num_blocks, Nkv, block_size, H]."""
+    shape = (num_blocks, num_kv_heads, block_size, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def paged_write(cache, new, block_tables, positions):
+    """Write one token per sequence into its page.
+
+    cache: [num_blocks, Nkv, bs, H]; new: [B, Nkv, H];
+    block_tables: [B, max_blocks] int32; positions: [B] int32 (token index
+    within the sequence).  Returns the updated cache.
+    """
+    bs = cache.shape[2]
+    block_idx = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1
+    )[:, 0]  # [B] physical block per sequence
+    slot = positions % bs  # [B]
+    # scatter: cache[block_idx[b], :, slot[b], :] = new[b]
+    return cache.at[block_idx, :, slot, :].set(new)
+
+
+def paged_gather(cache, block_tables):
+    """Materialize each sequence's logical cache view.
+
+    cache: [num_blocks, Nkv, bs, H]; block_tables: [B, max_blocks] ->
+    [B, Nkv, max_blocks*bs, H].
+    """
+    pages = jnp.take(cache, block_tables, axis=0)  # [B, max_blocks, Nkv, bs, H]
+    b, mb, nkv, bs, h = pages.shape
+    return jnp.moveaxis(pages, 2, 1).reshape(b, nkv, mb * bs, h)
+
+
+def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens, *, scale=None):
+    """Single-token decode attention over the paged cache.
+
+    q: [B, N, H] (the new token's queries, rope already applied);
+    key_cache/value_cache: [num_blocks, Nkv, bs, H]; block_tables:
+    [B, max_blocks]; seq_lens: [B] VALID length (including the new token).
+    GQA: N may be a multiple of Nkv.  Returns [B, N, H].
+    """
+    b, n, h = q.shape
+    nkv = key_cache.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(h)
+    keys = paged_gather(key_cache, block_tables)  # [B, Nkv, S, H]
+    vals = paged_gather(value_cache, block_tables)
+    if n != nkv:
+        group = n // nkv
+        keys = jnp.repeat(keys, group, axis=1)
+        vals = jnp.repeat(vals, group, axis=1)
+    logits = jnp.einsum(
+        "bnh,bnsh->bns", q.astype(jnp.float32), keys.astype(jnp.float32)
+    ) * jnp.float32(scale)
+    span = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+    logits = jnp.where(span < seq_lens[:, None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bns,bnsh->bnh", probs, vals.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -59,13 +59,13 @@ def test_static_check_catches_cross_rank_mismatch(monkeypatch):
         t_rank0 = paddle.to_tensor(np.zeros((4, 4), np.float32))
         t_rank1 = paddle.to_tensor(np.zeros((2, 8), np.float32))
         # simulate rank 1 publishing first (same seq counter on both "ranks")
-        seq = wd._check_seq[0] + 1
-        store.set(f"ccheck/all_reduce/{seq}/1", b"(2, 8)|float32")
+        seq = wd._check_seq.get(("all_reduce", "world"), 0) + 1
+        store.set(f"ccheck/world/all_reduce/{seq}/1", b"(2, 8)|float32")
         with pytest.raises(RuntimeError, match="cross-rank mismatch"):
             wd.static_check("all_reduce", t_rank0, rank=0, world=2, timeout=1)
         # matching shapes pass
-        seq = wd._check_seq[0] + 1
-        store.set(f"ccheck/all_reduce/{seq}/1", b"(4, 4)|float32")
+        seq = wd._check_seq.get(("all_reduce", "world"), 0) + 1
+        store.set(f"ccheck/world/all_reduce/{seq}/1", b"(4, 4)|float32")
         wd.static_check("all_reduce", t_rank0, rank=0, world=2, timeout=1)
     finally:
         flags.set_flags({"FLAGS_check_collective_shapes": False})
