@@ -222,6 +222,8 @@ class Tensor:
                 target_dtype = a.dtype
             elif isinstance(a, Place):
                 target_device = a
+            elif isinstance(a, np.dtype) or (isinstance(a, type) and issubclass(a, np.generic)):
+                target_dtype = dtype_mod.to_paddle_dtype(a)
             elif isinstance(a, (str, dtype_mod.DType)):
                 try:
                     target_dtype = dtype_mod.to_paddle_dtype(a)
@@ -249,7 +251,15 @@ class Tensor:
                     name, _, idx = str(target_device).partition(":")
                     plat = "cpu" if name.lower() == "cpu" else _jax.default_backend()
                     devs = _jax.devices(plat)
-                    dev_obj = devs[int(idx) % len(devs)] if idx else devs[0]
+                    if idx:
+                        if int(idx) >= len(devs):
+                            raise IndexError(
+                                f"Tensor.to(): device index {idx} out of range "
+                                f"({len(devs)} {plat} devices)"
+                            )
+                        dev_obj = devs[int(idx)]
+                    else:
+                        dev_obj = devs[0]
                 moved = _jax.device_put(out._value, dev_obj)
                 if out is self:
                     out = Tensor(moved, stop_gradient=self.stop_gradient)

@@ -180,3 +180,9 @@ def max_memory_reserved(device=None):
 
 def empty_cache():
     pass  # XLA/PJRT owns the arena; freeing is GC-driven
+
+from .plugin import (  # noqa: F401,E402
+    load_custom_device_plugin,
+    registered_custom_devices,
+    scan_custom_device_plugins,
+)

@@ -1,0 +1,58 @@
+"""Pluggable device backends.
+
+Reference: the custom-device C-ABI (paddle/phi/backends/device_ext.h —
+DeviceInterface function table covering device/memory/stream/event/
+collective hooks) with runtime .so discovery from CUSTOM_DEVICE_ROOT
+(paddle/phi/backends/custom/custom_device.cc:1059 LoadCustomRuntimeLib,
+device_manager.h:296).
+
+TPU-native redesign: PJRT IS the pluggable-device ABI on the XLA stack — a
+vendor backend ships a PJRT plugin .so and every op, allocator, stream and
+collective arrives through it, the same coverage device_ext.h enumerates by
+hand.  This module is the discovery/registration point: explicit
+`load_custom_device_plugin(name, path)` or scanning PADDLE_CUSTOM_DEVICE_ROOT
+(CUSTOM_DEVICE_ROOT honored too) for `libpjrt_<name>.so`.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["load_custom_device_plugin", "scan_custom_device_plugins", "registered_custom_devices"]
+
+_registered: dict[str, str] = {}
+
+
+def load_custom_device_plugin(name: str, library_path: str, options=None):
+    """Register a PJRT plugin as backend `name` (then paddle.set_device(name))."""
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(f"PJRT plugin library not found: {library_path}")
+    from jax._src import xla_bridge
+
+    xla_bridge.register_plugin(name, library_path=library_path, options=options)
+    _registered[name] = library_path
+    return name
+
+
+def scan_custom_device_plugins(root=None):
+    """Discover `libpjrt_<name>.so` under the plugin root (reference
+    CUSTOM_DEVICE_ROOT scan).  Returns the registered backend names."""
+    root = root or os.environ.get("PADDLE_CUSTOM_DEVICE_ROOT") or os.environ.get("CUSTOM_DEVICE_ROOT")
+    if not root or not os.path.isdir(root):
+        return []
+    found = []
+    for fn in sorted(os.listdir(root)):
+        if fn.startswith("libpjrt_") and fn.endswith(".so"):
+            name = fn[len("libpjrt_") : -3]
+            try:
+                load_custom_device_plugin(name, os.path.join(root, fn))
+                found.append(name)
+            except Exception as e:  # a broken plugin must not kill startup
+                import warnings
+
+                warnings.warn(f"custom device plugin {fn}: registration failed: {e}")
+    return found
+
+
+def registered_custom_devices():
+    return dict(_registered)

@@ -163,6 +163,7 @@ class Engine:
 
         loader = self._as_loader(eval_data, batch_size, collate_fn)
         fwd = self._compiled_forward()
+        was_training = getattr(self._model, "training", False)
         self._model.eval()
         losses = []
         try:
@@ -174,7 +175,8 @@ class Engine:
                     out = fwd(*inputs)
                     losses.append(float(np.asarray(self._loss(out, labels).astype("float32")._value)))
         finally:
-            self._model.train()
+            if was_training:
+                self._model.train()
         return {"loss": losses}
 
     def predict(self, test_data, batch_size=None, steps=None, verbose=0, collate_fn=None):
@@ -182,6 +184,7 @@ class Engine:
 
         loader = self._as_loader(test_data, batch_size, collate_fn, labeled=False)
         fwd = self._compiled_forward()
+        was_training = getattr(self._model, "training", False)
         self._model.eval()
         outs = []
         try:
@@ -192,7 +195,8 @@ class Engine:
                     inputs = [b if isinstance(b, Tensor) else Tensor(np.asarray(b)) for b in batch]
                     outs.append(fwd(*inputs))
         finally:
-            self._model.train()
+            if was_training:
+                self._model.train()
         return outs
 
     # ---------------------------------------------------------------- saving
@@ -226,11 +230,12 @@ class Engine:
             n = arrays[0].shape[0]
             bs = batch_size or n
 
-            def gen():
-                for i in range(0, n, bs):
-                    yield tuple(a[i : i + bs] for a in arrays)
+            class _ArrayLoader:
+                def __iter__(self):  # re-iterable: fit() loops it per epoch
+                    for i in range(0, n, bs):
+                        yield tuple(a[i : i + bs] for a in arrays)
 
-            return gen()
+            return _ArrayLoader()
         raise TypeError(f"unsupported data type {type(data)}")
 
     @property

@@ -133,6 +133,10 @@ def _single_axis(ax, opname):
     return ax
 
 
+def _my_rank():
+    return jax.process_index()
+
+
 def _world(group):
     if group is not None:
         return group.nranks
@@ -237,9 +241,9 @@ def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    _static_check("reduce", tensor, group)
     """All ranks reduce; only dst keeps the result (reference reduce).  In
     SPMD the masked variant costs the same as all_reduce."""
+    _static_check("reduce", tensor, group)
     ax = _axis_for(group)
     ax = _single_axis(ax, "reduce")
     if ax is not None:
@@ -354,8 +358,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     _no_multihost()
 
 
-def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    _static_check("send", tensor, group)
+def _p2p_impl(tensor, group):
     ax = _axis_for(group)
     if ax is not None:
         raise NotImplementedError(
@@ -367,9 +370,14 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     _no_multihost()
 
 
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    _static_check("p2p", tensor, group, peers_hint=sorted([_my_rank(), dst]))
+    return _p2p_impl(tensor, group)
+
+
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
-    _static_check("recv", tensor, group)
-    return send(tensor, src, group, sync_op)
+    _static_check("p2p", tensor, group, peers_hint=sorted([src, _my_rank()]))
+    return _p2p_impl(tensor, group)
 
 
 def isend(tensor, dst=0, group=None):

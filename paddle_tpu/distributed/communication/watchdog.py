@@ -182,7 +182,7 @@ def _checks_enabled() -> bool:
     return os.environ.get("FLAGS_check_collective_shapes", "0") in ("1", "true", "True")
 
 
-def static_check(op_name, tensor, group=None, rank=None, world=None, timeout=30.0):
+def static_check(op_name, tensor, group=None, rank=None, world=None, timeout=30.0, peers_hint=None):
     """Exchange (shape, dtype) digests through the store; raise on mismatch.
 
     Reference static_check.cc CheckShape/CheckDataType.  No-op unless
@@ -195,9 +195,21 @@ def static_check(op_name, tensor, group=None, rank=None, world=None, timeout=30.
     import jax
 
     rank = jax.process_index() if rank is None else rank
-    if group is not None:
+    if peers_hint is not None:
+        # point-to-point: exactly the two endpoints compare, keyed by pair
+        peers = sorted(set(int(r) for r in peers_hint))
+        gid = "p2p_" + "_".join(str(r) for r in peers)
+        if rank not in peers:
+            return
+    elif group is not None:
+        if getattr(group, "mesh", None) is not None:
+            # mesh-axis group: the collective compiles into one SPMD program
+            # where cross-rank shape mismatch is impossible by construction,
+            # and group.ranks are axis-local indices (not process ranks)
+            return
         peers = list(getattr(group, "ranks", []) or [])
-        gid = getattr(group, "id", "g")
+        gid = getattr(group, "id", None)
+        gid = "g" if gid is None else gid
         if peers and rank not in peers:
             return  # this process doesn't participate
     else:

@@ -101,3 +101,21 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         out = (out,) if not isinstance(out, tuple) else out
         i += seg_size
     return out[0] if isinstance(out, tuple) and len(out) == 1 else out
+
+
+def recompute_wrap(layer):
+    """Wrap a Layer so its forward runs under activation recompute
+    (distributed passes' recompute target helper).  The wrapper IS a Layer
+    registering the inner one as a sublayer — parameters stay visible to
+    state_dict()/parameters()/optimizers."""
+    from paddle_tpu.nn import Layer
+
+    class RecomputeWrapper(Layer):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, *args, **kwargs):
+            return recompute(self.inner, *args, **kwargs)
+
+    return RecomputeWrapper(layer)

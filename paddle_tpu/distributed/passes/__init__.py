@@ -1,0 +1,185 @@
+"""Distributed program passes.
+
+Reference: python/paddle/distributed/passes/ (22 files) — PassBase/
+PassManager over static Programs: auto_parallel_amp, auto_parallel_fp16,
+auto_parallel_recompute, auto_parallel_sharding, auto_parallel_grad_clip,
+auto_parallel_gradient_merge, pipeline_scheduler_pass (FThenB/1F1B/VPP).
+
+TPU-native redesign: there is no per-rank ProgramDesc to rewrite — the
+"program" is (model, optimizer, step options) that jit compiles as one
+piece, so a pass is a TRANSFORMATION OF THAT TRIPLE applied before
+compilation.  The pass surface (names, ordering, PassManager) matches the
+reference so strategy configs port over; the mechanics are the framework's
+native features (amp.decorate, recompute wrappers, ZeRO accumulator
+sharding, GradientMergeOptimizer, PipelineStack schedules).
+"""
+
+from __future__ import annotations
+
+__all__ = ["PassBase", "PassManager", "PassContext", "new_pass", "register_pass"]
+
+_PASS_REGISTRY: dict = {}
+
+
+class PassContext:
+    """What a pass may transform: the (model, optimizer, attrs) triple."""
+
+    def __init__(self, model=None, optimizer=None, **attrs):
+        self.model = model
+        self.optimizer = optimizer
+        self.attrs = dict(attrs)
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self, **attrs):
+        self.attrs = dict(attrs)
+
+    def check(self, ctx: PassContext) -> bool:
+        return True
+
+    def apply(self, ctx: PassContext) -> PassContext:
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name, attrs=None):
+    if name not in _PASS_REGISTRY:
+        raise ValueError(f"unknown pass {name!r}; registered: {sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name](**(attrs or {}))
+
+
+class PassManager:
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, ctx_or_model, optimizer=None, **attrs):
+        ctx = ctx_or_model if isinstance(ctx_or_model, PassContext) else PassContext(ctx_or_model, optimizer, **attrs)
+        for p in self._passes:
+            if p.check(ctx):
+                ctx = p.apply(ctx) or ctx
+        return ctx
+
+
+# --------------------------------------------------------------- the passes
+
+
+@register_pass("auto_parallel_amp")
+class AmpPass(PassBase):
+    """O1 autocast around the step (reference auto_parallel_amp.py)."""
+
+    def apply(self, ctx):
+        ctx.attrs["amp_level"] = self.attrs.get("level", "O1")
+        ctx.attrs["amp_dtype"] = self.attrs.get("dtype", "bfloat16")
+        ctx.attrs["amp_enabled"] = True
+        return ctx
+
+
+@register_pass("auto_parallel_fp16")
+class Fp16Pass(PassBase):
+    """O2: decorate params to the low dtype; the optimizer base keeps fp32
+    masters (reference auto_parallel_fp16.py + mix_precision_utils)."""
+
+    def apply(self, ctx):
+        from paddle_tpu import amp
+
+        dtype = self.attrs.get("dtype", "bfloat16")
+        amp.decorate(ctx.model, level="O2", dtype=dtype)
+        ctx.attrs["amp_level"] = "O2"
+        ctx.attrs["amp_dtype"] = dtype
+        return ctx
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Wrap the named sublayers (or every direct child of `model.layers`)
+    in activation recompute (reference auto_parallel_recompute.py)."""
+
+    def apply(self, ctx):
+        from paddle_tpu.distributed.fleet.recompute import recompute_wrap
+
+        targets = self.attrs.get("layers")
+        model = ctx.model
+        if targets is None and hasattr(model, "config") and hasattr(model.config, "use_recompute"):
+            model.config.use_recompute = True
+            return ctx
+        for name in targets or []:
+            sub = model
+            parts = name.split(".")
+            for p_ in parts[:-1]:
+                sub = getattr(sub, p_)
+            setattr(sub, parts[-1], recompute_wrap(getattr(sub, parts[-1])))
+        return ctx
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """ZeRO stage on the optimizer state (reference auto_parallel_sharding.py
+    — here it sets the accumulator-sharding policy ShardedTrainStep reads)."""
+
+    def apply(self, ctx):
+        stage = int(self.attrs.get("stage", 1))
+        ctx.optimizer._zero_stage = stage
+        ctx.attrs["sharding_stage"] = stage
+        return ctx
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """Swap the optimizer for the k-step merging wrapper (reference
+    auto_parallel_gradient_merge.py)."""
+
+    def apply(self, ctx):
+        from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+        k = int(self.attrs.get("k_steps", 1))
+        if k > 1:
+            ctx.optimizer = GradientMergeOptimizer(ctx.optimizer, k_steps=k, avg=self.attrs.get("avg", True))
+        return ctx
+
+
+@register_pass("auto_parallel_grad_clip")
+class GradClipPass(PassBase):
+    """Global-norm clip on the optimizer (reference auto_parallel_grad_clip.py
+    — GSPMD makes the cross-axis norm a plain compiled reduction)."""
+
+    def apply(self, ctx):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        ctx.optimizer._grad_clip = ClipGradByGlobalNorm(float(self.attrs.get("clip_norm", 1.0)))
+        return ctx
+
+
+@register_pass("pipeline_scheduler")
+class PipelineSchedulerPass(PassBase):
+    """Select the pipeline schedule (reference pipeline_scheduler_pass.py
+    FThenB/1F1B) on every PipelineStack in the model."""
+
+    def apply(self, ctx):
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
+
+        schedule = self.attrs.get("schedule", "1F1B")
+        n = 0
+        for sub in ctx.model.sublayers(include_self=True):
+            if isinstance(sub, PipelineStack):
+                if schedule not in ("1F1B", "FThenB"):
+                    raise ValueError(f"unknown pipeline schedule {schedule!r}")
+                sub._schedule = schedule
+                if "num_microbatches" in self.attrs:
+                    sub._num_microbatches = int(self.attrs["num_microbatches"])
+                n += 1
+        ctx.attrs["pipeline_stacks"] = n
+        return ctx

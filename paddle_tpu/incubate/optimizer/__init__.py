@@ -7,7 +7,7 @@ import paddle_tpu as paddle
 from paddle_tpu._core.tensor import Tensor
 from paddle_tpu.optimizer.optimizer import Optimizer
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "LARS", "GradientMergeOptimizer", "DistributedFusedLamb"]
 
 
 class LookAhead(Optimizer):
@@ -80,3 +80,232 @@ class ModelAverage(Optimizer):
     def clear_grad(self):
         for p in self._parameter_list:
             p.clear_grad()
+
+
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (reference
+    python/paddle/incubate/optimizer/... lars_momentum op,
+    paddle/phi/kernels/gpu/lars_momentum_kernel.cu): momentum SGD with a
+    per-layer trust ratio ||w|| / (||g|| + wd*||w||)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _single_update(self, p, g, lr):
+        import jax.numpy as jnp
+
+        g32 = g.astype(jnp.float32)
+        master = p._value.astype(jnp.float32)
+        wd = self._wd
+        if any(tag in (p.name or "") for tag in self._exclude):
+            wd = 0.0
+        w_norm = jnp.linalg.norm(master)
+        g_norm = jnp.linalg.norm(g32)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + wd * w_norm + self._eps),
+            1.0,
+        )
+        vel = self._acc("velocity", p, dtype=jnp.float32)
+        new_v = self._momentum * vel._value + lr * trust * (g32 + wd * master)
+        vel._bind(new_v)
+        return master - new_v
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads over k_steps micro-steps, apply the inner optimizer
+    on the k-th (reference python/paddle/incubate/optimizer/gradient_merge.py
+    and the auto-parallel gradient_merge pass).
+
+    Fully functional/trace-stable: the micro-step counter is DEVICE state and
+    the apply-vs-skip decision is a traced select (snapshot params/
+    accumulators, run the inner step, keep the old state where the counter
+    says skip) — so one compiled TrainStep serves every micro-step, exactly
+    like the GradScaler's functional skip.  Eagerly the same math runs on
+    concrete values.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        import jax.numpy as jnp
+
+        self.inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = avg
+        self._micro_t = Tensor(jnp.asarray(0, jnp.int32))
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner"], item)
+
+    def step(self):
+        import jax
+
+        import jax.numpy as jnp
+
+        inner = self.inner
+        k = self._k
+        new_micro = self._micro_t._value + 1
+        apply_pred = (new_micro % k) == 0
+        params = [p for p in inner._parameter_list if not p.stop_gradient]
+
+        if not isinstance(apply_pred, jax.core.Tracer):
+            # eager: exact python semantics (incl. inner._step_count cadence)
+            do_apply = bool(apply_pred)
+            for p in params:
+                if p.grad is None:
+                    continue
+                acc = inner._acc("grad_merge", p, dtype=jnp.float32)
+                new = acc._value + p.grad._value.astype(jnp.float32)
+                if do_apply:
+                    p.grad = Tensor(new / k if self._avg else new)
+                    acc._bind(jnp.zeros_like(new))
+                else:
+                    acc._bind(new)
+                    p.grad = None  # consumed into the merge buffer
+            if do_apply:
+                inner.step()
+            self._micro_t._bind(new_micro % k)
+            return
+
+        # traced (inside a compiled step): functional skip — accumulate
+        # always, run the inner update, select old state back where the
+        # counter says skip.  Freshly-created accumulators are restored to
+        # their captured INIT value on skip (an _acc spy records it), so a
+        # skipped micro-step cannot pollute Adam moments / master weights.
+        # Python-level inner._step_count freezes at trace time (same caveat
+        # as static capture, optimizer.py _static_minimize note).
+        for p in params:
+            if p.grad is None:
+                continue
+            acc = inner._acc("grad_merge", p, dtype=jnp.float32)
+            new = acc._value + p.grad._value.astype(jnp.float32)
+            acc._bind(jnp.where(apply_pred, jnp.zeros_like(new), new))
+            p.grad = Tensor(new / k if self._avg else new)
+        snap_p = [(p, p._value) for p in params]
+        snap_a = {kk: t._value for kk, t in inner._accumulators.items()}
+        fresh_inits = {}
+        orig_acc_fn = inner._acc
+
+        def acc_spy(name, p, init=None, dtype=None):
+            key = (name, id(p))
+            existed = key in inner._accumulators
+            t = orig_acc_fn(name, p, init=init, dtype=dtype)
+            if not existed and key not in snap_a:
+                fresh_inits[key] = t._value
+            return t
+
+        inner._acc = acc_spy
+        try:
+            inner.step()
+        finally:
+            del inner._acc
+        for p, old in snap_p:
+            p._bind(jnp.where(apply_pred, p._value, old))
+        for kk, t in inner._accumulators.items():
+            old = snap_a.get(kk, fresh_inits.get(kk))
+            if old is not None and old.shape == t._value.shape:
+                t._bind(jnp.where(apply_pred, t._value, old))
+        self._micro_t._bind(new_micro % k)
+
+    def _journaled_step(self, params):
+        """Zero-grad dry run through OUR step() (so the grad_merge
+        accumulators exist before a TrainStep collects state), then roll
+        every mutation back — the Optimizer._journaled_step contract."""
+        import jax.numpy as jnp
+
+        from paddle_tpu._core.autograd import no_grad
+
+        inner = self.inner
+        pre_acc = {k: t._value for k, t in inner._accumulators.items()}
+        fresh = {}
+        orig_acc_fn = inner._acc
+
+        def spy(name, p, init=None, dtype=None):
+            key = (name, id(p))
+            existed = key in inner._accumulators
+            t = orig_acc_fn(name, p, init=init, dtype=dtype)
+            if not existed and key not in pre_acc:
+                fresh[key] = t._value
+            return t
+
+        saved = [(p, p._value, p.grad) for p in params]
+        saved_micro = self._micro_t._value
+        saved_count = inner._step_count
+        inner._acc = spy
+        try:
+            for p in params:
+                p.grad = Tensor(jnp.zeros_like(p._value))
+            with no_grad():
+                self.step()
+        finally:
+            del inner._acc
+            for p, v, g in saved:
+                p._bind(v)
+                p.grad = g
+            inner._step_count = saved_count
+            self._micro_t._bind(saved_micro)
+            for k, t in inner._accumulators.items():
+                if k in pre_acc:
+                    t._bind(pre_acc[k])
+                elif k in fresh:
+                    t._bind(fresh[k])
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def opt_state_tensors(self):
+        return self.inner.opt_state_tensors() + [self._micro_t]
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def set_state_dict(self, state):
+        return self.inner.set_state_dict(state)
+
+
+class DistributedFusedLamb:
+    """Reference python/paddle/incubate/optimizer/distributed_fused_lamb.py:
+    a CUDA kernel fusing multi-tensor LAMB with ZeRO-sharded states and
+    fused allreduce.  TPU-native: the python Lamb update is already fused by
+    XLA across the whole parameter sweep inside a compiled step, grads are
+    reduce-scattered by GSPMD, and state sharding comes from
+    ShardedTrainStep's accumulator policy — so this class delegates every
+    Optimizer duty to Lamb (clip_after_allreduce etc. accepted; the XLA
+    schedule subsumes them)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, clip_after_allreduce=True,
+                 is_grad_scaled_by_nranks=True, alignment=128, nproc_per_node=None,
+                 use_master_param_norm=True, gradient_accumulation_steps=1,
+                 use_master_acc_grad=True, name=None):
+        from paddle_tpu.optimizer.optimizers import Lamb
+
+        impl = Lamb(
+            learning_rate=learning_rate,
+            lamb_weight_decay=lamb_weight_decay,
+            beta1=beta1, beta2=beta2, epsilon=epsilon,
+            parameters=parameters, grad_clip=grad_clip,
+            exclude_from_weight_decay_fn=exclude_from_weight_decay_fn,
+        )
+        if gradient_accumulation_steps > 1:
+            impl = GradientMergeOptimizer(impl, gradient_accumulation_steps)
+        self._impl = impl
+
+    def __getattr__(self, item):
+        # full delegation: the live impl owns all optimizer state
+        return getattr(self.__dict__["_impl"], item)
+
+    def __setattr__(self, key, value):
+        if key == "_impl":
+            object.__setattr__(self, key, value)
+        else:
+            setattr(self._impl, key, value)

@@ -286,7 +286,10 @@ class Layer:
             if name in state_dict:
                 src = state_dict[name]
                 arr = src._value if isinstance(src, Tensor) else jnp.asarray(np.asarray(src))
-                t.set_value(arr)
+                # copy-by-value (paddle assign semantics): sharing the source
+                # array would alias it into this layer, and a donated compiled
+                # step (TrainStep) would delete it out from under the source
+                t.set_value(jnp.copy(arr))
             else:
                 missing.append(name)
         for name in state_dict:

@@ -1,0 +1,263 @@
+"""paddle.onnx.export equivalent.
+
+Reference: python/paddle/onnx/export.py (delegates to the external
+paddle2onnx converter over a saved static Program).  TPU-native: the model's
+forward is traced to a JAXPR — the same capture jit/to_static uses — and the
+jaxpr's primitives are converted to ONNX ops directly; serialization is the
+self-contained writer in _proto.py (no onnx/protobuf dependency, matching
+this image).  Covered: the MLP/transformer primitive families (dot_general,
+elementwise, activations, reductions, reshape/transpose/broadcast/concat/
+slice, select, cast, softmax patterns emerge from these).  Unsupported
+primitives raise with the op name — the honest boundary, like paddle2onnx's
+unconvertible-op errors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+__all__ = ["export"]
+
+
+def _np(v):
+    return np.asarray(v)
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.names = {}
+        self.counter = [0]
+
+    def fresh(self, hint="t"):
+        self.counter[0] += 1
+        return f"{hint}_{self.counter[0]}"
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+
+        if isinstance(var, Literal):
+            n = self.fresh("const")
+            self.initializers.append(P.tensor_proto(n, _np(var.val)))
+            return n
+        if var not in self.names:
+            self.names[var] = self.fresh("v")
+        return self.names[var]
+
+    def add_const(self, arr, hint="const"):
+        n = self.fresh(hint)
+        self.initializers.append(P.tensor_proto(n, _np(arr)))
+        return n
+
+    def emit(self, op, inputs, n_out=1, attrs=(), hint=None):
+        outs = [self.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, inputs, outs, attrs=list(attrs)))
+        return outs[0] if n_out == 1 else outs
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "neg": "Neg",
+    "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
+    "ceil": "Ceil", "round": "Round", "erf": "Erf", "logistic": "Sigmoid",
+    "sin": "Sin", "cos": "Cos", "not": "Not", "and": "And", "or": "Or",
+}
+_COMPARE = {"eq": "Equal", "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual", "le": "LessOrEqual"}
+_REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax", "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}
+
+
+def _convert_eqn(cv: _Converter, eqn):
+    prim = eqn.primitive.name
+    ins = [cv.name_of(v) for v in eqn.invars]
+    out = eqn.outvars[0]
+
+    def bind(name):
+        cv.names[out] = name
+
+    if prim in _ELEMENTWISE:
+        bind(cv.emit(_ELEMENTWISE[prim], ins))
+    elif prim in _COMPARE:
+        bind(cv.emit(_COMPARE[prim], ins))
+    elif prim in _REDUCE:
+        keep = P.attr_int("keepdims", 0)
+        if prim == "reduce_sum":
+            # opset 13: ReduceSum takes axes as an input; the others keep the
+            # axes ATTRIBUTE until opset 18
+            axes = cv.add_const(np.asarray(eqn.params["axes"], np.int64), "axes")
+            bind(cv.emit("ReduceSum", [ins[0], axes], attrs=[keep]))
+        else:
+            bind(cv.emit(_REDUCE[prim], [ins[0]],
+                         attrs=[P.attr_ints("axes", eqn.params["axes"]), keep]))
+    elif prim == "integer_pow":
+        y = cv.add_const(np.asarray(float(eqn.params["y"]), _np(eqn.invars[0].aval.dtype).dtype), "exp")
+        bind(cv.emit("Pow", [ins[0], y]))
+    elif prim == "rsqrt":
+        s = cv.emit("Sqrt", [ins[0]])
+        one = cv.add_const(np.asarray(1.0, eqn.invars[0].aval.dtype), "one")
+        bind(cv.emit("Div", [one, s]))
+    elif prim == "convert_element_type":
+        to = P.np_to_onnx_dtype(np.dtype(eqn.params["new_dtype"]))
+        bind(cv.emit("Cast", ins, attrs=[P.attr_int("to", to)]))
+    elif prim == "reshape":
+        shape = cv.add_const(np.asarray(eqn.params["new_sizes"], np.int64), "shape")
+        bind(cv.emit("Reshape", [ins[0], shape]))
+    elif prim == "transpose":
+        bind(cv.emit("Transpose", ins, attrs=[P.attr_ints("perm", eqn.params["permutation"])]))
+    elif prim == "broadcast_in_dim":
+        in_aval = eqn.invars[0].aval
+        shape = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        # insert singleton axes so ranks match, then Expand
+        mid_shape = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            mid_shape[dst] = in_aval.shape[src] if in_aval.shape else 1
+        rs = cv.add_const(np.asarray(mid_shape, np.int64), "shape")
+        mid = cv.emit("Reshape", [ins[0], rs])
+        tgt = cv.add_const(np.asarray(shape, np.int64), "shape")
+        bind(cv.emit("Expand", [mid, tgt]))
+    elif prim == "dot_general":
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        l_aval, r_aval = eqn.invars[0].aval, eqn.invars[1].aval
+        lr, rr = len(l_aval.shape), len(r_aval.shape)
+        # support the matmul-like family: single contraction, batch prefix
+        if len(lc) == 1 and len(rc) == 1 and list(lb) == list(range(len(lb))) and list(rb) == list(range(len(rb))):
+            a, b = ins
+            if lc[0] != lr - 1:  # contract dim must be last for lhs
+                perm = [d for d in range(lr) if d != lc[0]] + [lc[0]]
+                a = cv.emit("Transpose", [a], attrs=[P.attr_ints("perm", perm)])
+            if rc[0] != len(lb):  # contract dim must be first non-batch for rhs
+                perm = list(rb) + [rc[0]] + [d for d in range(rr) if d != rc[0] and d not in rb]
+                b = cv.emit("Transpose", [b], attrs=[P.attr_ints("perm", perm)])
+            bind(cv.emit("MatMul", [a, b]))
+        else:
+            raise NotImplementedError(
+                f"onnx export: dot_general with dimension_numbers {eqn.params['dimension_numbers']}"
+            )
+    elif prim == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("onnx export: select_n with >2 cases")
+        # jax select_n(pred, false, true) -> Where(pred, true, false)
+        bind(cv.emit("Where", [ins[0], ins[2], ins[1]]))
+    elif prim == "concatenate":
+        bind(cv.emit("Concat", ins, attrs=[P.attr_int("axis", eqn.params["dimension"])]))
+    elif prim == "slice":
+        starts = cv.add_const(np.asarray(eqn.params["start_indices"], np.int64), "starts")
+        ends = cv.add_const(np.asarray(eqn.params["limit_indices"], np.int64), "ends")
+        axes = cv.add_const(np.asarray(range(len(eqn.params["start_indices"])), np.int64), "axes")
+        args = [ins[0], starts, ends, axes]
+        if eqn.params.get("strides") is not None:
+            args.append(cv.add_const(np.asarray(eqn.params["strides"], np.int64), "steps"))
+        bind(cv.emit("Slice", args))
+    elif prim == "squeeze":
+        axes = cv.add_const(np.asarray(eqn.params["dimensions"], np.int64), "axes")
+        bind(cv.emit("Squeeze", [ins[0], axes]))
+    elif prim == "rev":
+        raise NotImplementedError("onnx export: lax.rev")
+    elif prim == "gather":
+        # one-axis take: common embedding/index_select pattern
+        dn = eqn.params["dimension_numbers"]
+        if len(dn.start_index_map) == 1 and len(dn.collapsed_slice_dims) == 1 \
+                and dn.start_index_map == dn.collapsed_slice_dims:
+            axis = dn.start_index_map[0]
+            idx = ins[1]
+            # jax indices carry a trailing singleton dim; squeeze it
+            idx_aval = eqn.invars[1].aval
+            if idx_aval.shape and idx_aval.shape[-1] == 1:
+                ax = cv.add_const(np.asarray([len(idx_aval.shape) - 1], np.int64), "axes")
+                idx = cv.emit("Squeeze", [idx, ax])
+            bind(cv.emit("Gather", [ins[0], idx], attrs=[P.attr_int("axis", axis)]))
+        else:
+            raise NotImplementedError(f"onnx export: general gather {dn}")
+    elif prim == "stop_gradient":
+        bind(cv.emit("Identity", ins))
+    elif prim == "custom_jvp_call" or prim == "custom_vjp_call" or prim == "pjit" or prim == "jit":
+        # inline the sub-jaxpr
+        sub = eqn.params.get("call_jaxpr") or eqn.params.get("jaxpr")
+        jaxpr = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        consts = getattr(sub, "consts", getattr(sub, "literals", []))
+        for cvv, cval in zip(jaxpr.constvars, consts):
+            cv.names[cvv] = cv.add_const(cval, "w")
+        for iv, n in zip(jaxpr.invars, ins):
+            cv.names[iv] = n
+        for sub_eqn in jaxpr.eqns:
+            _convert_eqn(cv, sub_eqn)
+        for ov_out, ov_in in zip(eqn.outvars, jaxpr.outvars):
+            cv.names[ov_out] = cv.name_of(ov_in)
+        return
+    else:
+        raise NotImplementedError(f"onnx export: unsupported primitive '{prim}'")
+
+    # multi-output prims in the supported set are single-output; map extras
+    for extra in eqn.outvars[1:]:
+        cv.names[extra] = cv.name_of(out)
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export a Layer (or callable) to `path + '.onnx'`.
+
+    input_spec: list of paddle.static.InputSpec (or Tensors/arrays giving
+    example shapes).  Returns the output path.
+    """
+    import jax
+
+    from paddle_tpu._core.autograd import no_grad
+    from paddle_tpu._core.tensor import Tensor
+    from paddle_tpu.static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+
+    examples = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            from paddle_tpu._core.dtype import to_jax_dtype
+
+            shape = [1 if d in (None, -1) else int(d) for d in s.shape]
+            examples.append(jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            examples.append(jax.ShapeDtypeStruct(s._value.shape, s._value.dtype))
+        else:
+            a = np.asarray(s)
+            examples.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        def fwd(*vals):
+            with no_grad():
+                out = layer(*[Tensor(v) for v in vals])
+            leaves = jax.tree_util.tree_leaves(out, is_leaf=lambda x: isinstance(x, Tensor))
+            return [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+        closed = jax.make_jaxpr(fwd)(*examples)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    cv = _Converter()
+    jaxpr = closed.jaxpr
+    graph_inputs = []
+    for i, (var, ex) in enumerate(zip(jaxpr.invars, examples)):
+        n = f"input_{i}"
+        cv.names[var] = n
+        graph_inputs.append(P.value_info(n, P.np_to_onnx_dtype(ex.dtype), ex.shape))
+    for cvv, cval in zip(jaxpr.constvars, closed.consts):
+        cv.names[cvv] = cv.add_const(cval, "w")
+    for eqn in jaxpr.eqns:
+        _convert_eqn(cv, eqn)
+    graph_outputs = []
+    for i, ov in enumerate(jaxpr.outvars):
+        n = cv.name_of(ov)
+        graph_outputs.append(P.value_info(n, P.np_to_onnx_dtype(ov.aval.dtype), ov.aval.shape))
+
+    g = P.graph(cv.nodes, "paddle_tpu_graph", cv.initializers, graph_inputs, graph_outputs)
+    buf = P.model(g, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(buf)
+    return out_path

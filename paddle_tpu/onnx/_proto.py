@@ -1,0 +1,221 @@
+"""Minimal protobuf wire-format writer + the ONNX message subset.
+
+The reference's paddle.onnx.export delegates to the external paddle2onnx
+package (python/paddle/onnx/export.py); this image has no onnx/protobuf
+libraries, so the exporter serializes ModelProto directly — the wire format
+(varints + length-delimited fields, field numbers from onnx.proto3) is
+stable and self-contained.  A reader (`parse_model`) decodes the same subset
+for verification.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# onnx TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL = 1, 2, 3, 6, 7, 9
+FLOAT16, DOUBLE, BFLOAT16 = 10, 11, 16
+
+_NP2ONNX = {
+    "float32": FLOAT,
+    "uint8": UINT8,
+    "int8": INT8,
+    "int32": INT32,
+    "int64": INT64,
+    "bool": BOOL,
+    "float16": FLOAT16,
+    "float64": DOUBLE,
+    "bfloat16": BFLOAT16,
+}
+
+
+def np_to_onnx_dtype(dt) -> int:
+    name = str(dt)
+    if name not in _NP2ONNX:
+        raise ValueError(f"onnx export: unsupported dtype {name}")
+    return _NP2ONNX[name]
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, data: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(data)) + data
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode())
+
+
+def f_float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", float(v))
+
+
+# ---------------------------------------------------------------- messages
+
+
+def tensor_proto(name, arr) -> bytes:
+    import numpy as np
+
+    a = np.asarray(arr)
+    dt = np_to_onnx_dtype(a.dtype)
+    body = b"".join(f_varint(1, int(d)) for d in a.shape)
+    body += f_varint(2, dt)
+    body += f_string(8, name)
+    body += f_bytes(9, a.tobytes())  # raw_data
+    return body
+
+
+def attr_int(name, v) -> bytes:
+    return f_string(1, name) + f_varint(3, v) + f_varint(20, 2)  # type=INT
+
+
+def attr_ints(name, vals) -> bytes:
+    return f_string(1, name) + b"".join(f_varint(8, v) for v in vals) + f_varint(20, 7)
+
+
+def attr_float(name, v) -> bytes:
+    return f_string(1, name) + f_float(2, v) + f_varint(20, 1)
+
+
+def attr_string(name, s) -> bytes:
+    return f_string(1, name) + f_bytes(4, s.encode()) + f_varint(20, 3)
+
+
+def node(op_type, inputs, outputs, name="", attrs=()) -> bytes:
+    body = b"".join(f_string(1, i) for i in inputs)
+    body += b"".join(f_string(2, o) for o in outputs)
+    if name:
+        body += f_string(3, name)
+    body += f_string(4, op_type)
+    body += b"".join(f_bytes(5, a) for a in attrs)
+    return body
+
+
+def value_info(name, dtype_onnx, shape) -> bytes:
+    dims = b"".join(f_bytes(1, f_varint(1, int(d))) for d in shape)  # dim_value
+    shape_proto = dims
+    tensor_type = f_varint(1, dtype_onnx) + f_bytes(2, shape_proto)
+    type_proto = f_bytes(1, tensor_type)
+    return f_string(1, name) + f_bytes(2, type_proto)
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    body = b"".join(f_bytes(1, n) for n in nodes)
+    body += f_string(2, name)
+    body += b"".join(f_bytes(5, t) for t in initializers)
+    body += b"".join(f_bytes(11, vi) for vi in inputs)
+    body += b"".join(f_bytes(12, vi) for vi in outputs)
+    return body
+
+
+def model(graph_bytes, opset=13, producer="paddle_tpu") -> bytes:
+    opset_id = f_string(1, "") + f_varint(2, opset)
+    body = f_varint(1, 8)  # ir_version 8
+    body += f_string(2, producer)
+    body += f_bytes(7, graph_bytes)
+    body += f_bytes(8, opset_id)
+    return body
+
+
+# ---------------------------------------------------------------- reader
+
+
+def _read_varint(buf, i):
+    shift = 0
+    out = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def parse_fields(buf):
+    """Decode one message level -> list of (field, wire, value)."""
+    i = 0
+    out = []
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i : i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i : i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i : i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.append((field, wire, v))
+    return out
+
+
+def parse_model(buf):
+    """Structural decode of a serialized ModelProto (verification aid)."""
+    out = {"nodes": [], "initializers": [], "inputs": [], "outputs": [], "opset": None}
+    for field, _, v in parse_fields(buf):
+        if field == 7:  # graph
+            for gf, _, gv in parse_fields(v):
+                if gf == 1:
+                    nd = {"inputs": [], "outputs": [], "op_type": None}
+                    for nf, _, nv in parse_fields(gv):
+                        if nf == 1:
+                            nd["inputs"].append(nv.decode())
+                        elif nf == 2:
+                            nd["outputs"].append(nv.decode())
+                        elif nf == 4:
+                            nd["op_type"] = nv.decode()
+                    out["nodes"].append(nd)
+                elif gf == 5:
+                    name = dims = dtype = None
+                    dims = []
+                    for tf, _, tv in parse_fields(gv):
+                        if tf == 1:
+                            dims.append(tv)
+                        elif tf == 2:
+                            dtype = tv
+                        elif tf == 8:
+                            name = tv.decode()
+                    out["initializers"].append({"name": name, "dims": dims, "dtype": dtype})
+                elif gf == 11:
+                    out["inputs"].append(_vi_name(gv))
+                elif gf == 12:
+                    out["outputs"].append(_vi_name(gv))
+        elif field == 8:
+            for of, _, ov in parse_fields(v):
+                if of == 2:
+                    out["opset"] = ov
+    return out
+
+
+def _vi_name(buf):
+    for f, _, v in parse_fields(buf):
+        if f == 1:
+            return v.decode()
+    return None

@@ -107,3 +107,5 @@ def _patch_operators():
 
 _patch_methods()
 _patch_operators()
+
+from .array import array_length, array_read, array_write, create_array  # noqa: F401,E402

@@ -1,0 +1,54 @@
+"""TensorArray ops (reference paddle/phi/core/tensor_array.cc + python
+paddle.tensor.array_* in python/paddle/tensor/array.py).
+
+TPU-native: in dygraph a TensorArray is a python list of Tensors (exactly
+the reference's dygraph behavior); under jit/to_static, writes at traced
+indices are rejected with guidance to use lax.scan-style ops — XLA has no
+dynamically-sized containers (the reference's static-graph TensorArray is
+the LoDTensorArray variable consumed by while_op, which this framework's
+while_loop replaces with carried state)."""
+
+from __future__ import annotations
+
+import jax
+
+from ._ops_common import Tensor, ensure_tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = list(initialized_list) if initialized_list else []
+    return [ensure_tensor(x) for x in arr]
+
+
+def _concrete_index(i, op):
+    v = i._value if isinstance(i, Tensor) else i
+    if isinstance(v, jax.core.Tracer):
+        raise RuntimeError(
+            f"{op} with a traced index is not supported under jit (XLA has no "
+            "dynamic containers); carry state through static.nn.while_loop / "
+            "lax.scan instead"
+        )
+    return int(v)
+
+
+def array_write(x, i, array=None):
+    x = ensure_tensor(x)
+    if array is None:
+        array = []
+    idx = _concrete_index(i, "array_write")
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[_concrete_index(i, "array_read")]
+
+
+def array_length(array):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(len(array), jnp.int32))

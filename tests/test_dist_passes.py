@@ -1,0 +1,85 @@
+"""Distributed pass framework (reference python/paddle/distributed/passes/
+PassManager + named passes; see paddle_tpu/distributed/passes/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.passes import PassContext, PassManager, new_pass
+
+
+def _ctx():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    return PassContext(m, opt)
+
+
+def test_pass_registry_and_unknown():
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("nope")
+
+
+def test_fp16_pass_casts_params():
+    ctx = _ctx()
+    PassManager([new_pass("auto_parallel_fp16", {"dtype": "bfloat16"})]).apply(ctx)
+    import jax.numpy as jnp
+
+    assert all(p._value.dtype == jnp.bfloat16 for p in ctx.model.parameters())
+    assert ctx.attrs["amp_level"] == "O2"
+
+
+def test_gradient_merge_and_clip_passes():
+    ctx = _ctx()
+    pm = PassManager([
+        new_pass("auto_parallel_grad_clip", {"clip_norm": 0.5}),
+        new_pass("auto_parallel_gradient_merge", {"k_steps": 2}),
+        new_pass("auto_parallel_sharding", {"stage": 2}),
+    ])
+    assert pm.names == ["auto_parallel_grad_clip", "auto_parallel_gradient_merge", "auto_parallel_sharding"]
+    ctx = pm.apply(ctx)
+    from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+    assert isinstance(ctx.optimizer, GradientMergeOptimizer)
+    assert ctx.optimizer.inner._grad_clip is not None
+    assert ctx.optimizer._zero_stage == 2
+    # the transformed triple still trains
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    for _ in range(4):
+        loss = ((ctx.model(x) - y) ** 2).mean()
+        loss.backward()
+        ctx.optimizer.step()
+        ctx.optimizer.clear_grad()
+
+
+def test_pipeline_scheduler_pass():
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
+
+    class Blk(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    paddle.seed(0)
+    stack = PipelineStack([Blk() for _ in range(4)], mesh, pp_axis="pp")
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.layers = stack
+
+        def forward(self, x):
+            return self.layers(x)
+
+    m = M()
+    ctx = PassContext(m, None)
+    PassManager([new_pass("pipeline_scheduler", {"schedule": "FThenB", "num_microbatches": 8})]).apply(ctx)
+    assert stack._schedule == "FThenB" and stack._num_microbatches == 8
+    assert ctx.attrs["pipeline_stacks"] == 1

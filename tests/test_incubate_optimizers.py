@@ -1,0 +1,89 @@
+"""Incubate optimizer tier (reference python/paddle/incubate/optimizer/):
+LARS, GradientMerge, DistributedFusedLamb."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.optimizer import LARS, DistributedFusedLamb, GradientMergeOptimizer
+
+
+def _fit(opt_factory, steps=60):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = opt_factory(m)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((32, 8)).astype(np.float32))
+    y = paddle.to_tensor((np.asarray(x._value) @ rng.standard_normal((8, 1))).astype(np.float32))
+    losses = []
+    for _ in range(steps):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._value))
+    return losses
+
+
+def test_lars_trains():
+    losses = _fit(lambda m: LARS(learning_rate=1.0, lars_coeff=0.05, parameters=m.parameters()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_gradient_merge_matches_large_batch():
+    # k accumulated micro-steps on the same batch == one step at same grads
+    paddle.seed(1)
+    m1 = nn.Linear(4, 1)
+    m2 = nn.Linear(4, 1)
+    m2.set_state_dict({k: v for k, v in m1.state_dict().items()})
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+
+    o1 = paddle.optimizer.SGD(0.1, parameters=m1.parameters())
+    for _ in range(2):  # plain: 2 full steps
+        l = ((m1(x) - y) ** 2).mean()
+        l.backward(); o1.step(); o1.clear_grad()
+
+    o2 = GradientMergeOptimizer(paddle.optimizer.SGD(0.1, parameters=m2.parameters()), k_steps=2, avg=True)
+    for _ in range(4):  # merged: 4 micro-steps -> 2 applies (same grads, avg)
+        l = ((m2(x) - y) ** 2).mean()
+        l.backward(); o2.step(); o2.clear_grad()
+
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(np.asarray(p1._value), np.asarray(p2._value), rtol=1e-5, atol=1e-6)
+
+
+def test_distributed_fused_lamb_trains_and_accumulates():
+    losses = _fit(lambda m: DistributedFusedLamb(learning_rate=0.05, parameters=m.parameters(),
+                                                 gradient_accumulation_steps=2), steps=40)
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_gradient_merge_inside_compiled_trainstep():
+    """The micro-step cadence is DEVICE state: one compiled TrainStep must
+    apply the inner step exactly every k-th call."""
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(2)
+    m = nn.Linear(4, 1)
+    ref = nn.Linear(4, 1)
+    ref.set_state_dict({k: v for k, v in m.state_dict().items()})
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+
+    opt = GradientMergeOptimizer(paddle.optimizer.SGD(0.1, parameters=m.parameters()), k_steps=2)
+    step = TrainStep(m, opt, lambda mm, a, b: ((mm(a) - b) ** 2).mean())
+    p0 = np.asarray(m.parameters()[0]._value).copy()
+    step(x, y)  # micro 1: accumulate only
+    p1 = np.asarray(m.parameters()[0]._value)
+    np.testing.assert_array_equal(p0, p1)
+    step(x, y)  # micro 2: apply
+    p2 = np.asarray(m.parameters()[0]._value)
+    assert not np.allclose(p1, p2)
+
+    # numerics: equals one plain step with the same (averaged) grads
+    o_ref = paddle.optimizer.SGD(0.1, parameters=ref.parameters())
+    l = ((ref(x) - y) ** 2).mean()
+    l.backward(); o_ref.step(); o_ref.clear_grad()
+    np.testing.assert_allclose(p2, np.asarray(ref.parameters()[0]._value), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,59 @@
+"""ONNX export (reference python/paddle/onnx/export.py — delegation to
+paddle2onnx; here a self-contained jaxpr->ONNX converter, see
+paddle_tpu/onnx/__init__.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export
+from paddle_tpu.onnx._proto import parse_model
+
+
+def test_export_mlp_structure(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    from paddle_tpu.static import InputSpec
+
+    p = export(m, str(tmp_path / "mlp"), input_spec=[InputSpec([1, 8], "float32", "x")])
+    buf = open(p, "rb").read()
+    model = parse_model(buf)
+    ops = [n["op_type"] for n in model["nodes"]]
+    assert "MatMul" in ops and ("Max" in ops or "Relu" in ops), ops
+    assert model["opset"] == 13
+    assert model["inputs"] == ["input_0"]
+    assert len(model["outputs"]) == 1
+    # weights became initializers: 2 kernels + 2 biases at least
+    w_inits = [i for i in model["initializers"] if i["dims"]]
+    assert len(w_inits) >= 4
+
+
+def test_export_softmax_classifier(tmp_path):
+    paddle.seed(1)
+
+    class Clf(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(6, 3)
+
+        def forward(self, x):
+            return nn.functional.softmax(self.fc(x), axis=-1)
+
+    from paddle_tpu.static import InputSpec
+
+    p = export(Clf(), str(tmp_path / "clf"), input_spec=[InputSpec([2, 6], "float32", "x")])
+    model = parse_model(open(p, "rb").read())
+    ops = [n["op_type"] for n in model["nodes"]]
+    assert "Exp" in ops and any(o.startswith("Reduce") for o in ops), ops
+
+
+def test_export_unsupported_raises(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle.linalg.qr(x)[0]
+
+    from paddle_tpu.static import InputSpec
+
+    with pytest.raises(NotImplementedError, match="unsupported primitive"):
+        export(Weird(), str(tmp_path / "w"), input_spec=[InputSpec([3, 3], "float32", "x")])

@@ -1,0 +1,63 @@
+"""Minimal PS tier: sparse tables, pull/push, SparseEmbedding layer
+(reference paddle/fluid/distributed/ps/ — see scope decision in
+paddle_tpu/distributed/ps/__init__.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import PsClient, PsServer, SparseEmbedding, SparseTable
+
+
+def test_table_pull_push_sgd():
+    t = SparseTable(dim=4, lr=0.5)
+    rows = t.pull([3, 7, 3])
+    assert rows.shape == (3, 4)
+    np.testing.assert_array_equal(rows[0], rows[2])  # same id, same row
+    before = t.pull([3])[0].copy()
+    t.push([3], np.ones((1, 4), np.float32))
+    after = t.pull([3])[0]
+    np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+    assert t.n_rows() == 2
+
+
+def test_sparse_embedding_trains():
+    # learn to map id -> target vector through the PS table
+    t = SparseTable(dim=8, lr=0.3)
+    emb = SparseEmbedding(PsClient(table=t), dim=8)
+    target = np.zeros((2, 8), np.float32)
+    target[0, 0] = 1.0
+    target[1, 1] = 1.0
+    ids = paddle.to_tensor(np.array([5, 9], np.int32))
+    losses = []
+    for _ in range(60):
+        e = emb(ids)
+        loss = ((e - paddle.to_tensor(target)) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss._value))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_ps_state_roundtrip():
+    t = SparseTable(dim=2)
+    t.pull([1, 2, 3])
+    sd = t.state_dict()
+    t2 = SparseTable(dim=2)
+    t2.set_state_dict(sd)
+    np.testing.assert_array_equal(t.pull([2]), t2.pull([2]))
+
+
+def test_ps_over_rpc():
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("ps_worker0", rank=0, world_size=1, master_endpoint="127.0.0.1:29621")
+    try:
+        PsServer.register_table(SparseTable(dim=4, name="emb_rpc"))
+        client = PsClient(server="ps_worker0", table_name="emb_rpc")
+        rows = client.pull([11, 12])
+        assert rows.shape == (2, 4)
+        client.push([11], np.ones((1, 4), np.float32))
+        rows2 = client.pull([11])
+        assert not np.allclose(rows[0], rows2[0])
+    finally:
+        rpc.shutdown()
