@@ -1,0 +1,237 @@
+"""ProcessGroup: eager cross-process collectives with the async-Task API.
+
+Reference: paddle/fluid/distributed/collective/process_group.h:47 (async
+ops returning event-backed Tasks), process_group_nccl.h:37 (per-device comm
+streams, ring ids), nccl_comm_context.h.
+
+TPU-native redesign (SURVEY.md §7 "ProcessGroup-on-XLA"): there are no
+comm streams to manage — an eager collective outside any compiled program
+is itself a tiny COMPILED COLLECTIVE EXECUTABLE.  For a group spanning the
+multi-controller world (jax.distributed initialized, one process per host):
+
+  local value --make_array(global mesh over the ring)--> global jax.Array
+  --cached jitted psum/all_gather/...--> async result --Task
+
+The executable is cached per (op, shape, dtype, ring) — the KernelKey-style
+dispatch cache the survey calls for — so repeated small collectives (global
+norm terms, scalar broadcasts) pay dispatch, not compilation.  XLA runs the
+collective asynchronously; Task.wait blocks on the result buffer (watchdog-
+guarded), Task.is_completed polls it — the event-backed Task contract.
+
+Single-process groups short-circuit (the reference's nranks==1 fast path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ProcessGroup", "P2POp", "batch_isend_irecv"]
+
+
+class Task:
+    """Async collective handle (reference process_group.h Task)."""
+
+    def __init__(self, result=None, group=None, name="collective"):
+        self._result = result
+        self._group = group
+        self._name = name
+
+    def wait(self, timeout=None):
+        if self._result is not None and hasattr(self._result, "block_until_ready"):
+            from paddle_tpu.distributed.communication.watchdog import comm_watch
+
+            with comm_watch(self._name, group=self._group, timeout=timeout):
+                self._result.block_until_ready()
+        return True
+
+    def is_completed(self):
+        r = self._result
+        if r is None or not hasattr(r, "is_ready"):
+            return True
+        return bool(r.is_ready())
+
+    def result(self):
+        return self._result
+
+
+class ProcessGroup:
+    """A ring of PROCESSES (multi-controller) issuing compiled collectives."""
+
+    def __init__(self, ranks=None, ring_id=0, name=None):
+        self.ranks = list(ranks) if ranks is not None else list(range(jax.process_count()))
+        self.ring_id = ring_id
+        self._name = name or f"pg_{ring_id}"
+        self._cache: dict = {}  # (op, shape, dtype) -> compiled fn
+        self._mesh = None
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    size = nranks
+
+    def rank(self):
+        return self.ranks.index(jax.process_index()) if jax.process_index() in self.ranks else -1
+
+    # ------------------------------------------------------------- plumbing
+    def _ring_mesh(self):
+        """One mesh axis over the ring's processes (one device per process:
+        the process-leader device, matching one-NCCL-rank-per-proc)."""
+        if self._mesh is None:
+            devs = []
+            for r in self.ranks:
+                cands = [d for d in jax.devices() if d.process_index == r]
+                if not cands:
+                    raise RuntimeError(f"process {r} has no devices visible")
+                devs.append(cands[0])
+            self._mesh = jax.sharding.Mesh(np.asarray(devs), ("ring",))
+        return self._mesh
+
+    def _global(self, value):
+        """Lift the local value to a ring-global array [nranks, ...]."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self._ring_mesh()
+        sharding = NamedSharding(mesh, PartitionSpec("ring"))
+        local = jnp.asarray(value)[None]
+        return jax.make_array_from_single_device_arrays(
+            (self.nranks,) + tuple(local.shape[1:]), sharding, [local]
+        )
+
+    def _compiled(self, op_name, builder, value):
+        key = (op_name, tuple(value.shape), str(value.dtype), tuple(self.ranks))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._cache[key] = fn
+        return fn
+
+    def cache_size(self):
+        return len(self._cache)
+
+    def _run(self, op_name, value, body, out_spec):
+        """Compile-and-cache a shard_map collective over the ring."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if self.nranks == 1:
+            return value, None
+        mesh = self._ring_mesh()
+
+        def builder():
+            from jax import shard_map
+
+            f = shard_map(
+                body, mesh=mesh, in_specs=PartitionSpec("ring"),
+                out_specs=out_spec, axis_names={"ring"},
+            )
+            return jax.jit(f)
+
+        fn = self._compiled(op_name, builder, value)
+        garr = self._global(value)
+        out = fn(garr)
+        return out, out
+
+    # ----------------------------------------------------------- collectives
+    def allreduce(self, tensor, op="sum"):
+        from paddle_tpu._core.tensor import Tensor
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "allreduce")
+        red = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin, "avg": lax.pmean}[op]
+
+        def body(x):  # x: [1, ...] local slice
+            return red(x, "ring")
+
+        out, _ = self._run(f"allreduce_{op}", v, body, PartitionSpec("ring"))
+        # every slice holds the reduction; read the local one
+        local = out.addressable_shards[0].data[0]
+        if isinstance(tensor, Tensor):
+            tensor._bind(local)
+        return Task(local, self, "allreduce")
+
+    def allgather(self, tensor):
+        from paddle_tpu._core.tensor import Tensor
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v[None], self, "allgather")
+
+        def body(x):
+            return lax.all_gather(x[0], "ring")
+
+        out, _ = self._run("allgather", v, body, PartitionSpec("ring"))
+        return Task(out.addressable_shards[0].data, self, "allgather")
+
+    def broadcast(self, tensor, src=0):
+        from paddle_tpu._core.tensor import Tensor
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "broadcast")
+        src_idx = self.ranks.index(src)
+
+        def body(x):
+            return lax.all_gather(x[0], "ring")[src_idx][None]
+
+        out, _ = self._run(f"broadcast_{src_idx}", v, body, PartitionSpec("ring"))
+        local = out.addressable_shards[0].data[0]
+        if isinstance(tensor, Tensor):
+            tensor._bind(local)
+        return Task(local, self, "broadcast")
+
+    def reduce_scatter(self, tensor, op="sum"):
+        """Input [nranks*chunk, ...] per rank; each keeps its reduced chunk."""
+        from paddle_tpu._core.tensor import Tensor
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "reduce_scatter")
+
+        def body(x):
+            return lax.psum_scatter(x[0], "ring", scatter_dimension=0, tiled=True)[None]
+
+        out, _ = self._run("reduce_scatter", v, body, PartitionSpec("ring"))
+        return Task(out.addressable_shards[0].data[0], self, "reduce_scatter")
+
+    def barrier(self):
+        t = self.allreduce(jnp.zeros((), jnp.int32))
+        t.wait()
+        return t
+
+
+class P2POp:
+    """Batched p2p descriptor (reference batch_isend_irecv)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op  # "isend" | "irecv"
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Reference communication/batch_isend_irecv.py.  On the SPMD path p2p is
+    ppermute inside programs; eagerly, world-1 is a no-op and multi-host p2p
+    maps to a ring ppermute executable per batch (future work beyond the
+    single-host image).  Returns Tasks."""
+    tasks = []
+    for p in p2p_op_list:
+        world = p.group.nranks if p.group is not None else jax.process_count()
+        if world != 1:
+            raise NotImplementedError(
+                "eager multi-host batch_isend_irecv: use the SPMD pipeline "
+                "engine (ppermute) or ProcessGroup collectives"
+            )
+        tasks.append(Task(p.tensor._value if hasattr(p.tensor, "_value") else p.tensor))
+    return tasks

@@ -167,12 +167,19 @@ def _reduce_fn(op):
     }[op]
 
 
-def _no_multihost():
-    raise NotImplementedError(
-        "eager cross-process collectives need a multi-controller runtime; "
-        "run collectives inside the distributed step (axis mode) or launch "
-        "one process (world size 1)"
-    )
+def _process_group_for(group):
+    """Multi-controller ring for eager collectives (jax.distributed world)."""
+    from paddle_tpu.distributed.collective import ProcessGroup
+
+    key = tuple(group.ranks) if group is not None else None
+    pg = _pg_cache.get(key)
+    if pg is None:
+        pg = ProcessGroup(ranks=list(group.ranks) if group is not None else None)
+        _pg_cache[key] = pg
+    return pg
+
+
+_pg_cache: dict = {}
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -187,7 +194,8 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return _Task(tensor)
     if _world(group) == 1:
         return _Task(tensor)
-    _no_multihost()
+    op_name = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max", ReduceOp.MIN: "min", ReduceOp.AVG: "avg"}.get(op, "sum")
+    return _process_group_for(group).allreduce(tensor, op_name)
 
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
@@ -208,14 +216,24 @@ def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True, axis=0):
         from paddle_tpu.tensor.manipulation import unsqueeze
 
         return unsqueeze(tensor, 0)
-    _no_multihost()
+    task = _process_group_for(group).allgather(tensor)
+    gathered = Tensor(task.result())
+    if tensor_list is not None:
+        for i in range(gathered.shape[0]):
+            tensor_list.append(gathered[i])
+        return _Task(tensor_list)
+    return gathered
 
 
 def all_gather_object(object_list, obj, group=None):
     if _world(group) == 1:
         object_list.append(obj)
         return _Task(object_list)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
@@ -237,7 +255,7 @@ def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
         return _Task(tensor)
     if _world(group) == 1:
         return _Task(tensor)
-    _no_multihost()
+    return _process_group_for(group).broadcast(tensor, src=src)
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -263,7 +281,11 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         return _Task(tensor)
     if _world(group) == 1:
         return _Task(tensor)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -285,7 +307,11 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor._bind(tensor_list[0]._value)
         return _Task(tensor)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -313,7 +339,11 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group
             src = src[0]
         tensor._bind(src._value)
         return _Task(tensor)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
@@ -335,7 +365,11 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _world(group) == 1:
         out_tensor_list.extend(in_tensor_list)
         return _Task(out_tensor_list)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
@@ -355,7 +389,11 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     if _world(group) == 1:
         out_tensor._bind(in_tensor._value)
         return _Task(out_tensor)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def _p2p_impl(tensor, group):
@@ -367,7 +405,11 @@ def _p2p_impl(tensor, group):
         )
     if _world(group) == 1:
         return _Task(tensor)
-    _no_multihost()
+    raise NotImplementedError(
+        "this collective has no eager multi-controller path yet; run it "
+        "inside the distributed step (axis mode) or use "
+        "paddle_tpu.distributed.collective.ProcessGroup directly"
+    )
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
