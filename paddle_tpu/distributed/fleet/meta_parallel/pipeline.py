@@ -57,14 +57,25 @@ from paddle_tpu.nn import Layer
 
 __all__ = ["PipelineStack"]
 
-_SCHEDULES = ("1F1B", "FThenB")
+_SCHEDULES = ("1F1B", "FThenB", "VPP")
 
 
 class PipelineStack(Layer):
-    """Replaces a LayerList of identical blocks with a pipelined stack."""
+    """Replaces a LayerList of identical blocks with a pipelined stack.
+
+    schedule="VPP" (interleaved virtual pipeline, reference
+    PipelineParallelWithInterleave pipeline_parallel.py:890 + the VPP
+    scheduler pass): each device owns `num_virtual_stages` non-contiguous
+    layer chunks (chunk c on device c % S) and the rotation is a circular
+    token ring — each device carries ONE (microbatch, chunk) token per tick,
+    device 0 injects a fresh microbatch whenever a completed token returns.
+    T = M*v + S - 1 ticks, so the bubble shrinks v-fold to
+    (S-1)/(M*v + S-1) at the cost of v x more ppermute hops — the VPP
+    trade exactly."""
 
     def __init__(self, blocks, mesh, pp_axis: str = "pp", num_microbatches=None,
-                 use_recompute: bool = False, schedule: str = "1F1B"):
+                 use_recompute: bool = False, schedule: str = "1F1B",
+                 num_virtual_stages: int = 1):
         super().__init__()
         from paddle_tpu.distributed.auto_parallel import ProcessMesh
         from paddle_tpu.distributed.auto_parallel.api import placements_to_spec
@@ -80,9 +91,14 @@ class PipelineStack(Layer):
         self._pp_axis = pp_axis
         self._n_stages = mesh.get_dim_size(pp_axis)
         self._n_layers = len(blocks)
-        if self._n_layers % self._n_stages != 0:
+        self._n_virtual = int(num_virtual_stages) if schedule == "VPP" else 1
+        if self._n_virtual < 1:
+            raise ValueError("num_virtual_stages must be >= 1")
+        n_chunks = self._n_stages * self._n_virtual
+        if self._n_layers % n_chunks != 0:
             raise ValueError(
-                f"{self._n_layers} blocks not divisible into {self._n_stages} stages"
+                f"{self._n_layers} blocks not divisible into {n_chunks} "
+                f"chunks ({self._n_stages} stages x {self._n_virtual} virtual)"
             )
         self._layers_per_stage = self._n_layers // self._n_stages
         if num_microbatches is not None and num_microbatches < 1:
@@ -105,9 +121,19 @@ class PipelineStack(Layer):
                 raise ValueError("pipeline blocks must be structurally identical")
 
         jmesh = mesh.jax_mesh
-        S, Lps = self._n_stages, self._layers_per_stage
+        S, Lps, v = self._n_stages, self._layers_per_stage, self._n_virtual
+        # VPP block order: device d holds chunks {d, S+d, 2S+d, ...}; its
+        # local [v, Lpc] layout maps (j, i) -> block (j*S + d)*Lpc + i.
+        # v == 1 reduces to the contiguous [S, Lps] split.
+        lpc = Lps // v
+        order = [
+            (j * S + d) * lpc + i
+            for d in range(S)
+            for j in range(v)
+            for i in range(lpc)
+        ]
         for key, tpl in zip(self._keys, self._tpl_tensors):
-            vals = [st[key]._value for st in states]
+            vals = [states[b][key]._value for b in order]
             stacked = jnp.stack(vals).reshape((S, Lps) + vals[0].shape)
             if getattr(tpl, "process_mesh", None) is not None and tpl.placements:
                 block_spec = list(placements_to_spec(tpl.process_mesh, tpl.placements))
@@ -127,9 +153,10 @@ class PipelineStack(Layer):
         return [self._parameters[self._mangle(k)] for k in self._keys]
 
     def bubble_fraction(self, num_microbatches=None) -> float:
-        """Pipeline bubble (S-1)/(M+S-1) — reference pipeline math."""
+        """Pipeline bubble (S-1)/(M*v + S-1) — reference pipeline math; the
+        interleaved factor v divides the bubble (pipeline_parallel.py:890)."""
         m = num_microbatches or self._num_microbatches or self._n_stages
-        return (self._n_stages - 1) / (m + self._n_stages - 1)
+        return (self._n_stages - 1) / (m * self._n_virtual + self._n_stages - 1)
 
     # ------------------------------------------------------------------ fwd
     def forward(self, h, *bcast):
@@ -161,7 +188,85 @@ class PipelineStack(Layer):
         tpl_tensors = self._tpl_tensors
         bcast_template = self._bcast_template
         use_recompute = self._use_recompute
-        per_tick_remat = self._schedule == "1F1B"
+        per_tick_remat = self._schedule in ("1F1B", "VPP")
+        n_virtual = self._n_virtual
+        lpc = Lps // n_virtual
+
+        def pipe_vpp(stacked, x, bcast_vals, stage):
+            """Circular token ring (see class docstring): each device carries
+            one (microbatch m, chunk c) token; device 0 injects when a
+            completed (c == V) token returns.  T = M*v + S - 1 ticks."""
+            V = S * n_virtual
+            ring = [(i, (i + 1) % S) for i in range(S)]
+            wlocal = [w[0] for w in stacked]  # [v*lpc, ...] local chunks
+
+            def layer_call_local(params_i, h_val):
+                originals = [t._value for t in tpl_tensors]
+                try:
+                    for tt, vv in zip(tpl_tensors, params_i):
+                        tt._bind(vv)
+                    it = iter(bcast_vals)
+                    args = [Tensor(next(it)) if b is not None else None for b in bcast_template]
+                    with no_grad():
+                        out = template(Tensor(h_val), *args)
+                    return out._value if isinstance(out, Tensor) else out
+                finally:
+                    for tt, vv in zip(tpl_tensors, originals):
+                        tt._bind(vv)
+
+            def chunk_fn(chunk_local, h_val):
+                # run the lpc layers of local chunk `chunk_local` (traced idx)
+                for i in range(lpc):
+                    li = chunk_local * lpc + i
+                    params_i = [
+                        lax.dynamic_index_in_dim(w, li, 0, keepdims=False)
+                        for w in wlocal
+                    ]
+                    h_val = layer_call_local(params_i, h_val)
+                return h_val
+
+            if per_tick_remat:
+                chunk_fn = jax.checkpoint(chunk_fn)
+
+            T = M * n_virtual + S - 1
+
+            def tick(carry, t):
+                h, m_idx, c_idx, next_m, out = carry
+                dead = c_idx >= V
+                inject = jnp.logical_and(jnp.logical_and(stage == 0, dead), next_m < M)
+                m_new = jnp.where(inject, next_m, m_idx)
+                c_new = jnp.where(inject, 0, c_idx)
+                h_in = jnp.where(
+                    inject,
+                    lax.dynamic_index_in_dim(x, jnp.clip(next_m, 0, M - 1), 0, keepdims=False),
+                    h,
+                )
+                next_m2 = jnp.where(inject, next_m + 1, next_m)
+                active = c_new < V
+                chunk_local = jnp.clip(c_new // S, 0, n_virtual - 1)
+                y = chunk_fn(chunk_local, h_in)
+                y = jnp.where(active, y, h_in)
+                c_after = jnp.where(active, c_new + 1, c_new)
+                done_now = jnp.logical_and(active, c_after == V)
+                m_out = jnp.clip(m_new, 0, M - 1)
+                cur = lax.dynamic_index_in_dim(out, m_out, 0, keepdims=False)
+                out = lax.dynamic_update_index_in_dim(
+                    out, jnp.where(done_now, y, cur), m_out, 0
+                )
+                h_next = lax.ppermute(y, pp, ring)
+                m_next = lax.ppermute(m_new, pp, ring)
+                c_next = lax.ppermute(c_after, pp, ring)
+                return (h_next, m_next, c_next, next_m2, out), None
+
+            carry0 = (
+                lax.pvary(jnp.zeros_like(x[0]), (pp,)),
+                lax.pvary(jnp.asarray(-1, jnp.int32), (pp,)),
+                lax.pvary(jnp.asarray(V, jnp.int32), (pp,)),  # dead: inject
+                lax.pvary(jnp.asarray(0, jnp.int32), (pp,)),
+                lax.pvary(jnp.zeros_like(x), (pp,)),
+            )
+            (_, _, _, _, out), _ = lax.scan(tick, carry0, jnp.arange(T, dtype=jnp.int32))
+            return lax.psum(out, pp)
 
         def layer_call(params_i, h_val, bcast_vals):
             originals = [t._value for t in tpl_tensors]
@@ -195,6 +300,9 @@ class PipelineStack(Layer):
 
             if per_tick_remat:
                 stage_fn = jax.checkpoint(stage_fn)
+
+            if n_virtual > 1:
+                return pipe_vpp(stacked, x, bcast_vals, stage)
 
             T = M + S - 1
             ring = [(i, (i + 1) % S) for i in range(S)]
