@@ -200,20 +200,6 @@ class PipelineStack(Layer):
             ring = [(i, (i + 1) % S) for i in range(S)]
             wlocal = [w[0] for w in stacked]  # [v*lpc, ...] local chunks
 
-            def layer_call_local(params_i, h_val):
-                originals = [t._value for t in tpl_tensors]
-                try:
-                    for tt, vv in zip(tpl_tensors, params_i):
-                        tt._bind(vv)
-                    it = iter(bcast_vals)
-                    args = [Tensor(next(it)) if b is not None else None for b in bcast_template]
-                    with no_grad():
-                        out = template(Tensor(h_val), *args)
-                    return out._value if isinstance(out, Tensor) else out
-                finally:
-                    for tt, vv in zip(tpl_tensors, originals):
-                        tt._bind(vv)
-
             def chunk_fn(chunk_local, h_val):
                 # run the lpc layers of local chunk `chunk_local` (traced idx)
                 for i in range(lpc):
@@ -222,13 +208,16 @@ class PipelineStack(Layer):
                         lax.dynamic_index_in_dim(w, li, 0, keepdims=False)
                         for w in wlocal
                     ]
-                    h_val = layer_call_local(params_i, h_val)
+                    h_val = layer_call(params_i, h_val, bcast_vals)
                 return h_val
 
             if per_tick_remat:
                 chunk_fn = jax.checkpoint(chunk_fn)
 
-            T = M * n_virtual + S - 1
+            # the last microbatch is injected at ((M-1)//S)*V + (M-1)%S and
+            # computes its final chunk V-1 ticks later; for M % S == 0 this
+            # reduces to M*v + S - 1
+            T = ((M - 1) // S) * V + ((M - 1) % S) + V
 
             def tick(carry, t):
                 h, m_idx, c_idx, next_m, out = carry

@@ -3,3 +3,4 @@
 from .io_utils import load, save  # noqa: F401
 from paddle_tpu._core.random import seed  # noqa: F401
 from paddle_tpu._core.random import get_rng_state, set_rng_state  # noqa: F401
+from . import op_registry  # noqa: F401,E402

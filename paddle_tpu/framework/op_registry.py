@@ -1,0 +1,199 @@
+"""Op registry + codegen.
+
+Reference: the single YAML op registry feeding four generators
+(paddle/phi/api/yaml/ops.yaml + generator/api_gen.py, eager_gen.py,
+python_c_gen.py, op_gen.py) — SURVEY.md:35 calls it the most load-bearing
+design idea.
+
+TPU-native redesign: the registry's C++ outputs (kernel dispatch, generated
+GradNodes, pybind wrappers, PIR dialect) are all subsumed — jnp IS the
+kernel library, jax.vjp the grad codegen, the apply() funnel the dual
+eager/static dispatch.  What REMAINS load-bearing is the metadata and the
+python-surface codegen, built here:
+
+- `OpInfo` per public op: module, signature, category, AMP class (from the
+  dispatcher's white/black lists), dynamic-shape flag (ops that raise
+  DynamicShapeError under tracing), Tensor-method availability.
+- `build_registry()` introspects the live op surface (the schemas stay in
+  sync with the code by construction — no drift between YAML and impl).
+- Codegen consumers:
+  * `generate_inplace_variants()` emits the `op_` in-place API tier
+    (reference: generated inplace ad_funcs) — bind-back wrappers over the
+    functional ops, installed as module fns + Tensor methods;
+  * `generate_markdown()` renders the op table (docs artifact).
+Tests assert registry/app surface consistency (tests/test_op_registry.py).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+
+__all__ = [
+    "OpInfo",
+    "build_registry",
+    "get_op_info",
+    "all_ops",
+    "generate_inplace_variants",
+    "generate_markdown",
+]
+
+
+@dataclass
+class OpInfo:
+    name: str
+    module: str
+    category: str
+    signature: str
+    amp_class: str = "neutral"  # white | black | neutral
+    dynamic_shape: bool = False  # raises DynamicShapeError under tracing
+    has_tensor_method: bool = False
+    inplace_variant: str | None = None
+    doc: str = ""
+
+
+# Ops whose output shape depends on data (documented DynamicShapeError
+# under tracing — kept in sync by tests/test_op_traceability.py)
+_DYNAMIC_SHAPE_OPS = {
+    "masked_select", "nonzero", "unique", "unique_consecutive", "bincount",
+    "eig", "eigvals",
+}
+
+_registry: dict[str, OpInfo] | None = None
+
+
+def _op_modules():
+    from paddle_tpu.tensor import (
+        creation, einsum, linalg, logic, manipulation, math, random, search, stat,
+    )
+
+    return {
+        "math": math, "manipulation": manipulation, "linalg": linalg,
+        "logic": logic, "search": search, "stat": stat, "creation": creation,
+        "random": random, "einsum": einsum,
+    }
+
+
+def build_registry() -> dict[str, OpInfo]:
+    global _registry
+    if _registry is not None:
+        return _registry
+    from paddle_tpu import amp
+    from paddle_tpu._core.tensor import Tensor
+
+    white, black = amp.white_list(), amp.black_list()
+    reg: dict[str, OpInfo] = {}
+    for cat, mod in _op_modules().items():
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            # factory-made ops (binary()/unary() helpers) carry the helper's
+            # module; accept anything defined inside the framework
+            if not getattr(fn, "__module__", "").startswith("paddle_tpu"):
+                continue
+            try:
+                sig = str(inspect.signature(fn))
+            except (TypeError, ValueError):
+                sig = "(...)"
+            if name in reg:
+                continue
+            reg[name] = OpInfo(
+                name=name,
+                module=mod.__name__,
+                category=cat,
+                signature=sig,
+                amp_class="white" if name in white else ("black" if name in black else "neutral"),
+                dynamic_shape=name in _DYNAMIC_SHAPE_OPS,
+                has_tensor_method=hasattr(Tensor, name),
+                inplace_variant=name + "_" if hasattr(mod, name + "_") else None,
+                doc=(fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__ else "",
+            )
+    _registry = reg
+    return reg
+
+
+def get_op_info(name: str) -> OpInfo:
+    reg = build_registry()
+    if name not in reg:
+        raise KeyError(f"unknown op {name!r}")
+    return reg[name]
+
+
+def all_ops() -> list[str]:
+    return sorted(build_registry())
+
+
+# ------------------------------------------------------------------ codegen
+
+# The in-place tier (reference: inplace ad_funcs generated from the
+# `inplace:` YAML field).  Each entry maps to its functional base op.
+_INPLACE_BASES = [
+    "add", "subtract", "multiply", "divide", "remainder", "clip", "scale",
+    "exp", "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "tanh",
+    "abs", "neg",
+]
+
+
+def _make_inplace(base_fn, name):
+    def inplace(x, *args, **kwargs):
+        from paddle_tpu.tensor._ops_common import inplace_from
+
+        return inplace_from(x, base_fn, *args, **kwargs)
+
+    inplace.__name__ = name
+    inplace.__qualname__ = name
+    inplace.__doc__ = (
+        f"In-place variant of `{base_fn.__name__}` (generated by the op "
+        f"registry; functional under the hood — XLA buffer donation makes "
+        f"the compiled form genuinely in-place)."
+    )
+    return inplace
+
+
+def generate_inplace_variants() -> list[str]:
+    """Install `op_` functions + Tensor methods for the in-place tier.
+
+    Returns the generated names.  Idempotent; existing hand-written
+    variants are left untouched.
+    """
+    from paddle_tpu._core.tensor import Tensor
+
+    generated = []
+    mods = _op_modules()
+    for base in _INPLACE_BASES:
+        target = None
+        for mod in mods.values():
+            if hasattr(mod, base):
+                target = mod
+                break
+        if target is None:
+            continue
+        name = base + "_"
+        if not hasattr(target, name):
+            fn = _make_inplace(getattr(target, base), name)
+            setattr(target, name, fn)
+            generated.append(name)
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(target, name))
+    global _registry
+    _registry = None  # registry reflects the new surface on next build
+    return generated
+
+
+def generate_markdown() -> str:
+    """Render the registry as a markdown table (docs artifact)."""
+    lines = [
+        "| op | category | amp | traced | method | inplace |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name in all_ops():
+        i = get_op_info(name)
+        lines.append(
+            f"| {i.name} | {i.category} | {i.amp_class} | "
+            f"{'dynamic-shape (eager only)' if i.dynamic_shape else 'yes'} | "
+            f"{'yes' if i.has_tensor_method else ''} | {i.inplace_variant or ''} |"
+        )
+    return "\n".join(lines)

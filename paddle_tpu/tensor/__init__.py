@@ -109,3 +109,7 @@ _patch_methods()
 _patch_operators()
 
 from .array import array_length, array_read, array_write, create_array  # noqa: F401,E402
+
+# generated in-place op tier (framework/op_registry codegen)
+from paddle_tpu.framework.op_registry import generate_inplace_variants as _gen_inplace  # noqa: E402
+_gen_inplace()

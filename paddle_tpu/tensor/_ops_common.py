@@ -91,3 +91,28 @@ def reject_tracers(op_name: str, hint: str, *tensors):
                 f"cannot run under jit/to_static (XLA needs static shapes). "
                 f"{hint}"
             )
+
+
+def inplace_from(x, base_fn, *args, **kwargs):
+    """In-place rebind helper: runs the functional op on an ALIAS carrying
+    x's old autograd identity (rebinding x's own node onto itself would
+    self-loop the tape), then binds the result back into x.  With autograd
+    ON, leaf tensors requiring grad reject in-place ops (their pre-op value
+    is needed for their own grad accumulation — reference semantics); under
+    no_grad() leaf mutation is the normal manual-optimizer pattern."""
+    from paddle_tpu._core.autograd import is_grad_enabled
+
+    if is_grad_enabled() and not x.stop_gradient and x._grad_node is None:
+        raise RuntimeError(
+            f"{base_fn.__name__}_: a leaf Tensor that requires grad cannot "
+            f"be used in an in-place operation; use the functional form or "
+            f"wrap the update in paddle.no_grad()"
+        )
+    alias = Tensor(x._value, stop_gradient=x.stop_gradient)
+    alias._grad_node = x._grad_node
+    alias._out_index = x._out_index
+    out = base_fn(alias, *args, **kwargs)
+    x._bind(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x

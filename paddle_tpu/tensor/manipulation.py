@@ -27,10 +27,9 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._bind(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    return x
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, reshape, shape)
 
 
 def transpose(x, perm, name=None):
@@ -124,10 +123,9 @@ def squeeze(x, axis=None, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
-    out = squeeze(x, axis)
-    x._bind(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    return x
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, squeeze, axis)
 
 
 def unsqueeze(x, axis, name=None):
@@ -144,10 +142,9 @@ def unsqueeze(x, axis, name=None):
 
 
 def unsqueeze_(x, axis, name=None):
-    out = unsqueeze(x, axis)
-    x._bind(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    return x
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, unsqueeze, axis)
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -226,10 +223,9 @@ def cast(x, dtype):
 
 
 def cast_(x, dtype):
-    out = cast(x, dtype)
-    x._bind(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    return x
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, cast, dtype)
 
 
 astype = cast
@@ -300,10 +296,9 @@ def scatter(x, index, updates, overwrite=True, name=None):
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
-    out = scatter(x, index, updates, overwrite)
-    x._bind(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    return x
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, scatter, index, updates, overwrite)
 
 
 def scatter_nd(index, updates, shape, name=None):
@@ -433,10 +428,9 @@ def masked_fill(x, mask, value, name=None):
 
 
 def masked_fill_(x, mask, value, name=None):
-    out = masked_fill(x, mask, value)
-    x._bind(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    return x
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, masked_fill, mask, value)
 
 
 def masked_scatter(x, mask, value, name=None):
@@ -697,7 +691,17 @@ def _setitem_(x, idx, value):
     def _set(v, u):
         return v.at[nidx].set(u.astype(v.dtype))
 
-    out = apply("setitem", _set, x, value)
+    from paddle_tpu._core.autograd import is_grad_enabled
+
+    if is_grad_enabled() and not x.stop_gradient and x._grad_node is None:
+        raise RuntimeError(
+            "in-place __setitem__ on a leaf Tensor that requires grad would "
+            "lose its gradient; use paddle.no_grad() or the functional "
+            "put_along_axis/scatter"
+        )
+    alias = Tensor(x._value, stop_gradient=x.stop_gradient)
+    alias._grad_node, alias._out_index = x._grad_node, x._out_index
+    out = apply("setitem", _set, alias, value)
     x._bind(out._value)
     x._grad_node, x._out_index = out._grad_node, out._out_index
     return x

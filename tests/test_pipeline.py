@@ -224,3 +224,26 @@ def test_vpp_interleaved_matches_sequential():
     jaxpr = str(jax.make_jaxpr(fn)(*[p._value for p in stack.stacked_parameters()],
                                    jnp.zeros((M, 1, 16), jnp.float32)))
     assert f"length={M * v + S - 1}" in jaxpr
+
+
+@pytest.mark.slow
+def test_vpp_ragged_microbatch_count():
+    """M not a multiple of S: trailing microbatches are injected a ring-cycle
+    late; the tick count must cover them (round-2 review repro: S=4, v=2,
+    M=6 silently returned zeros)."""
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    M = 6
+    blocks = _blocks(8, 16, seed=6)
+    x_np = np.random.default_rng(6).normal(size=(M, 16)).astype(np.float32)
+
+    ref_blocks = _copy_blocks(blocks, 16)
+    h = paddle.to_tensor(x_np)
+    for b in ref_blocks:
+        h = b(h)
+
+    stack = PipelineStack(
+        _copy_blocks(blocks, 16), mesh, pp_axis="pp", num_microbatches=M,
+        schedule="VPP", num_virtual_stages=2,
+    )
+    out = stack(paddle.to_tensor(x_np))
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(h._value), rtol=1e-5, atol=1e-5)
