@@ -6,3 +6,13 @@ from .llama import (  # noqa: F401
     llama_7b,
     llama_tiny,
 )
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
+    ErnieConfig,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    bert_tiny,
+)

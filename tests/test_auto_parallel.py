@@ -128,3 +128,50 @@ def test_sharded_train_step_tp_dp():
     got = run(True)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
     assert got[-1] < got[0]
+
+
+@pytest.mark.slow
+def test_global_norm_clip_across_mesh_axes():
+    """HybridParallelOptimizer glue: ClipGradByGlobalNorm inside a tp x dp
+    sharded step must clip by the same global norm as single-device
+    (reference hybrid_parallel_optimizer.py:270 cross-axis norm; GSPMD makes
+    the norm a compiled cross-shard reduction here)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.sharded_step import ShardedTrainStep
+    from paddle_tpu.distributed.auto_parallel.api import _mark_dist
+    from paddle_tpu.distributed.auto_parallel.placement import Replicate, Shard
+
+    def build():
+        paddle.seed(11)
+        m = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 8))
+        return m
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((8, 8)).astype(np.float32) * 10  # big grads -> clip active
+    y = rng.standard_normal((8, 8)).astype(np.float32)
+
+    # single-device reference
+    ref = build()
+    ref_opt = paddle.optimizer.SGD(
+        0.1, parameters=ref.parameters(), grad_clip=nn.ClipGradByGlobalNorm(0.5)
+    )
+    loss = ((ref(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+    loss.backward()
+    ref_opt.step()
+    ref_opt.clear_grad()
+
+    # tp2 x dp4 sharded step with the same clip
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    m2 = build()
+    _mark_dist(m2[0].weight, mesh, [Replicate(), Shard(1)])
+    _mark_dist(m2[2].weight, mesh, [Shard(0), Replicate()])
+    opt2 = paddle.optimizer.SGD(
+        0.1, parameters=m2.parameters(), grad_clip=nn.ClipGradByGlobalNorm(0.5)
+    )
+    step = ShardedTrainStep(m2, opt2, lambda mm, a, b: ((mm(a) - b) ** 2).mean(), mesh)
+    step(paddle.to_tensor(x), paddle.to_tensor(y))
+
+    for p_ref, p_sh in zip(ref.parameters(), m2.parameters()):
+        np.testing.assert_allclose(
+            np.asarray(p_ref._value), np.asarray(p_sh._value), rtol=2e-4, atol=2e-5
+        )

@@ -1,0 +1,58 @@
+"""BERT/ERNIE family (BASELINE.md finetune north-stars) on the nn stack."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import BertForMaskedLM, BertForSequenceClassification, bert_tiny
+
+
+def _batch(vocab, b=4, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, vocab, (b, s)).astype(np.int32)
+    ids[:, -3:] = 0  # padding tail exercises the attention mask
+    return paddle.to_tensor(ids)
+
+
+def test_sequence_classification_finetune_loss_decreases():
+    paddle.seed(0)
+    cfg = bert_tiny()
+    m = BertForSequenceClassification(cfg, num_classes=3)
+    opt = paddle.optimizer.AdamW(5e-4, parameters=m.parameters())
+    ids = _batch(cfg.vocab_size)
+    labels = paddle.to_tensor(np.array([0, 1, 2, 1], np.int32))
+    step = TrainStep(m, opt, lambda mm, i, l: mm(i, labels=l)[0])
+    losses = [float(step(ids, labels)) for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_masked_lm_and_padding_mask():
+    paddle.seed(1)
+    cfg = bert_tiny()
+    m = BertForMaskedLM(cfg)
+    m.eval()
+    ids = _batch(cfg.vocab_size, seed=1)
+    with paddle.no_grad():
+        logits = m(ids)
+    assert list(logits.shape) == [4, 16, cfg.vocab_size]
+    assert np.isfinite(np.asarray(logits._value, np.float32)).all()
+    # padded positions must not influence the [CLS] pooled output
+    ids2 = np.asarray(ids._value).copy()
+    ids2[:, -3:] = 0  # same padding, different garbage beyond mask is absent
+    clf = BertForSequenceClassification(cfg)
+    clf.eval()
+    with paddle.no_grad():
+        mask = (ids2 != 0).astype(np.int32)
+        a = np.asarray(clf(paddle.to_tensor(ids2), attention_mask=paddle.to_tensor(mask))._value)
+        ids3 = ids2.copy()
+        ids3[:, -1] = 7  # perturb a PADDED position; mask still marks it pad
+        b = np.asarray(clf(paddle.to_tensor(ids3), attention_mask=paddle.to_tensor(mask))._value)
+    # the masked position cannot reach [CLS] through attention
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_ernie_alias():
+    from paddle_tpu.models import ErnieForSequenceClassification, ErnieModel
+
+    assert ErnieModel is not None and ErnieForSequenceClassification is not None
