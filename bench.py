@@ -22,6 +22,11 @@ import numpy as np
 def main():
     import jax
 
+    # persistent XLA compile cache: repeated bench runs (driver re-runs,
+    # round restarts on one box) skip the multi-minute first compile
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 

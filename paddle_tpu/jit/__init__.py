@@ -1,14 +1,27 @@
 """paddle.jit equivalent (reference: python/paddle/jit/api.py:240 to_static,
 python/paddle/jit/sot bytecode capture).
 
-TPU-native design: because every op in this framework is jax-traceable and the
-autograd tape composes with tracing, "dynamic-to-static" needs no AST rewrite
-or CPython frame hook — jax.jit IS the graph capture.  `to_static` wraps a
-callable (or Layer) so calls are traced once per input signature and run as a
-single compiled XLA program; `TrainStep` functionalizes a full imperative
-train step (forward, loss.backward(), optimizer.step()) into one compiled,
-donated-state program — the replacement for the reference's C++ eager hot
-path + fused optimizer kernels.
+TPU-native design: because every op in this framework is jax-traceable and
+the autograd tape composes with tracing, "dynamic-to-static" needs no CPython
+frame hook — jax.jit IS the graph capture.  `to_static` wraps a callable (or
+Layer) so calls are traced once per input signature and run as one compiled
+XLA program, with the AST-mode dy2static transformer (jit/dy2static)
+rewriting python control flow over tensors into lax.cond/while_loop.
+`TrainStep` functionalizes a full imperative train step (forward,
+loss.backward(), optimizer.step()) into one compiled, donated-state program —
+the replacement for the reference's C++ eager hot path + fused optimizer
+kernels.
+
+CAPTURE-TIER SCOPE: the reference ships TWO capture modes — AST transform
+(full graph) and SOT bytecode interception with guard-based graph breaks
+(python/paddle/jit/sot/translate.py:99, eval_frame.c).  SOT exists because
+the reference's eager tier cannot be traced directly, so unsupported
+constructs need transparent fallback mid-function.  Here the eager tier IS
+the traceable tier: every op works under jax tracing, untraceable constructs
+(data-dependent shapes) raise documented errors naming the fix, and AST mode
+covers control flow — so a bytecode tier would add CPython-version-coupled
+machinery without new capability.  Decision: AST-only, revisit only if a
+concrete workload needs guard-based partial graphs.
 """
 
 from __future__ import annotations

@@ -61,8 +61,67 @@ def _run_branch(fn, out_template=None):
     return [_unwrap(v) for v in flat], tree
 
 
+def _discover_captures(fns, prog):
+    """Find the Variables of `prog` that the closures reference, by replaying
+    them into a sacrificial Program (the reference's static mode likewise
+    builds both branch sub-programs — if_instruction.cc runs them in sub-
+    interpreters; here the discovery program is discarded and the real op
+    replays the closures under lax control flow)."""
+    from paddle_tpu._core.autograd import TouchRecorder, record_touched_tensors
+    from paddle_tpu.static.program import Program, program_guard
+
+    temp = Program()
+    rec = TouchRecorder()
+    with record_touched_tensors(rec), program_guard(temp):
+        for fn in fns:
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:
+                    pass  # discovery only; the real trace surfaces errors
+    seen, out = set(), []
+    for t in rec.inputs:
+        if getattr(t, "_program", None) is prog and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+    return out
+
+
+def _static_cond(pred, true_fn, false_fn):
+    from paddle_tpu._core.autograd import apply
+    from paddle_tpu.static.program import current_main_program
+
+    prog = current_main_program()
+    captured = _discover_captures([true_fn, false_fn], prog)
+
+    def cond_replay(pred_v, *cap_vals):
+        originals = [t._value for t in captured]
+        try:
+            for t, v in zip(captured, cap_vals):
+                t._bind(v)
+            # suspend_capture is active inside Operator replay, so this runs
+            # the eager/traced cond (lax.cond on tracers)
+            out = cond(Tensor(pred_v, stop_gradient=True), true_fn, false_fn)
+            flat, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            return tuple(_unwrap(v) for v in flat)
+        finally:
+            for t, v in zip(captured, originals):
+                t._bind(v)
+
+    out = apply("cond", cond_replay, pred, *captured)
+    if isinstance(out, (tuple, list)) and len(out) == 1:
+        return out[0]
+    return out
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     """Run true_fn or false_fn depending on pred (scalar bool Tensor)."""
+    from paddle_tpu.static.program import in_static_capture
+
+    if in_static_capture():
+        return _static_cond(pred, true_fn, false_fn)
     pv = _pred_value(pred)
     if not _is_tracer(pv):
         # eager: plain python dispatch, tape records the taken branch
@@ -121,6 +180,36 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     Differentiable when fully eager; under tracing it lowers to
     lax.while_loop, whose outputs are stop_gradient (see module docstring).
     """
+    from paddle_tpu.static.program import current_main_program, in_static_capture
+
+    if in_static_capture():
+        from paddle_tpu._core.autograd import apply
+
+        prog = current_main_program()
+        loop_vars = list(loop_vars)
+        n_loop = len(loop_vars)
+        captured = [
+            t for t in _discover_captures(
+                [lambda: cond_fn(*loop_vars), lambda: body_fn(*loop_vars)], prog
+            )
+            if all(t is not lv for lv in loop_vars)
+        ]
+
+        def wl_replay(*vals):
+            lvs = [Tensor(v) for v in vals[:n_loop]]
+            originals = [t._value for t in captured]
+            try:
+                for t, v in zip(captured, vals[n_loop:]):
+                    t._bind(v)
+                res = while_loop(cond_fn, body_fn, lvs)
+                return tuple(_unwrap(v) for v in res)
+            finally:
+                for t, v in zip(captured, originals):
+                    t._bind(v)
+
+        out = apply("while_loop", wl_replay, *loop_vars, *captured)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
     loop_vars = list(loop_vars)
     vals = [_unwrap(v) for v in loop_vars]
 

@@ -187,6 +187,14 @@ class Program:
     def record(self, type_, fn, args, kwargs):
         """Append an Operator; returns output Variable(s).  Called by
         _core.autograd.apply when this program is being captured."""
+        from paddle_tpu._core import autograd as _ag
+
+        if _ag._state.touch_recorders:
+            # control-flow capture discovery (static.control_flow): log the
+            # Variable inputs so branch closures' dependencies are found
+            _ag._state.touch_recorders[-1].inputs.extend(
+                a for a in args if isinstance(a, Tensor)
+            )
         arg_spec = []
         in_avals = []
         var_slots = []

@@ -199,3 +199,29 @@ def test_jit_save_dynamic_batch(tmp_path):
         (ov,) = pred.run([xv])
         ref = np.asarray(layer(paddle.to_tensor(xv))._value)
         np.testing.assert_allclose(ov, ref, atol=1e-5)
+
+
+def test_static_program_cond_and_while():
+    """cond/while_loop recorded into a static Program (reference
+    if_instruction.cc / while_instruction.cc sub-interpreters; here ONE
+    operator replaying the branches under lax control flow)."""
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            y = static.nn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+            i0 = paddle.zeros([], dtype="int32")
+            s0 = paddle.ones([])
+            iv, sv = static.nn.while_loop(
+                lambda i, s: s < x.sum() + 10, lambda i, s: (i + 1, s * 2.0), [i0, s0]
+            )
+        exe = static.Executor()
+        out = exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[y, sv])
+        np.testing.assert_allclose(out[0], 2 * np.ones(4, np.float32))
+        assert float(out[1]) == 16.0
+        out2 = exe.run(main, feed={"x": -np.ones(4, np.float32)}, fetch_list=[y, sv])
+        np.testing.assert_allclose(out2[0], -2 * np.ones(4, np.float32))
+        assert float(out2[1]) == 8.0
+    finally:
+        paddle.disable_static()
