@@ -88,7 +88,7 @@ for _sub in (
         pass
 
 try:
-    from .framework.io_utils import load, save  # noqa: F401,E402
+    from .framework.io_utils import load, save, wait_async_save  # noqa: F401,E402
 except ImportError:
     pass
 try:

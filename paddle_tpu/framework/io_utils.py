@@ -14,7 +14,7 @@ import numpy as np
 
 from paddle_tpu._core.tensor import Tensor
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "wait_async_save"]
 
 _MAGIC = b"PDTPU1\x00"
 
@@ -45,13 +45,77 @@ def _from_portable(obj, return_numpy=False):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
+_async_saves: list = []  # (thread, path, error_box)
+_path_locks: dict = {}
+_path_locks_guard = None
+
+
+def _lock_for(path):
+    global _path_locks_guard
+    import threading
+
+    if _path_locks_guard is None:
+        _path_locks_guard = threading.Lock()
+    with _path_locks_guard:
+        return _path_locks.setdefault(os.path.abspath(path), threading.Lock())
+
+
+def save(obj, path, protocol=4, async_save=False, **configs):
+    """paddle.save (reference python/paddle/framework/io.py:721).
+
+    async_save=True EXCEEDS the reference (SURVEY.md §5 notes the reference
+    has no async checkpointing): the device->host snapshot happens
+    synchronously (so training may immediately mutate the live state), the
+    pickle+disk write runs on a background thread — orbax-style.  Writes go
+    to a temp file then os.replace (atomic: a crash mid-write keeps the
+    previous checkpoint intact) and same-path saves are serialized.  Call
+    `wait_async_save()` to join outstanding writes — it re-raises the first
+    background error (a silently lost checkpoint is worse than a crash)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        pickle.dump(_to_portable(obj), f, protocol=protocol)
+    portable = _to_portable(obj)  # snapshot: host copies of device arrays
+
+    def _write():
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with _lock_for(path):
+            with open(tmp, "wb") as f:
+                f.write(_MAGIC)
+                pickle.dump(portable, f, protocol=protocol)
+            os.replace(tmp, path)
+
+    import threading
+
+    if not async_save:
+        _write()
+        return
+
+    err: list = []
+
+    def _guarded():
+        try:
+            _write()
+        except BaseException as e:  # re-raised by wait_async_save
+            err.append(e)
+
+    t = threading.Thread(target=_guarded, name=f"paddle_tpu_save:{os.path.basename(path)}")
+    t.start()
+    _async_saves.append((t, path, err))
+
+
+def wait_async_save():
+    """Join all outstanding async checkpoint writes; re-raise the first
+    background failure."""
+    global _async_saves
+    pending, _async_saves = _async_saves, []
+    first_err = None
+    for t, path, err in pending:
+        t.join()
+        if err and first_err is None:
+            first_err = (path, err[0])
+    if first_err is not None:
+        path, e = first_err
+        raise RuntimeError(f"async checkpoint write to {path!r} failed") from e
 
 
 def load(path, return_numpy=False, **configs):

@@ -42,6 +42,7 @@ __all__ = [
     "DataLoader",
     "default_collate_fn",
     "get_worker_info",
+    "InMemoryDataset",
 ]
 
 
@@ -514,3 +515,44 @@ class DataLoader:
 
     def __iter__(self):
         return iter(self._iter_batches())
+
+
+class InMemoryDataset(Dataset):
+    """paddle.distributed.InMemoryDataset lineage (reference
+    paddle/fluid/framework/data_feed.cc + fleet/dataset/): loads the whole
+    sample stream into host memory once, then supports global shuffle and
+    epoch-wise iteration — the PS-mode feed.  TPU-native: samples live as a
+    python list feeding the normal DataLoader; the protobuf feed/pipe
+    readers collapse to a user-supplied parse function."""
+
+    def __init__(self, parse_fn=None):
+        self._samples = []
+        self._parse = parse_fn
+
+    def load_into_memory(self, files_or_samples):
+        for item in files_or_samples:
+            if isinstance(item, str):
+                with open(item) as f:
+                    for line in f:
+                        line = line.rstrip("\n")
+                        if not line:
+                            continue
+                        self._samples.append(self._parse(line) if self._parse else line)
+            else:
+                self._samples.append(self._parse(item) if self._parse else item)
+        return self
+
+    def global_shuffle(self, seed=0):
+        import random as _random
+
+        _random.Random(seed).shuffle(self._samples)
+        return self
+
+    def release_memory(self):
+        self._samples = []
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        return self._samples[idx]

@@ -16,3 +16,10 @@ from .bert import (  # noqa: F401
     ErnieModel,
     bert_tiny,
 )
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_tiny,
+    shard_gpt,
+)

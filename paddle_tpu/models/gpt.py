@@ -1,0 +1,138 @@
+"""GPT decoder family (learned positions, pre-LN).
+
+Capability target: the reference's auto-parallel e2e tests are built on a
+GPT pattern (test/auto_parallel/get_gpt_model.py) and PaddleNLP's GPT-2/3
+models ride the same fleet stack; this is that family on the framework's nn
+tier.  TPU-first: causal attention through scaled_dot_product_attention
+(flash kernel on TPU), bf16-friendly, trains under jit.TrainStep and shards
+with shard_gpt (Megatron placements like shard_llama)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "shard_gpt"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.1
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = nn.MultiHeadAttention(cfg.hidden_size, cfg.num_attention_heads, cfg.dropout)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc_in = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, h):
+        # is_causal routes to the flash kernel (no O(s^2) materialized mask)
+        h = h + self.attn(self.ln_1(h), is_causal=True)
+        h = h + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(h)))))
+        return h
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        if s > self.config.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings} (jax would silently "
+                f"clamp the position lookup)"
+            )
+        pos = paddle.arange(s, dtype="int32").unsqueeze(0).expand([b, s])
+        h = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.h:
+            h = blk(h)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.config = cfg
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        # weight-tied head (GPT-2 convention)
+        logits = paddle.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits[:, :-1].reshape([-1, self.config.vocab_size]).astype("float32"),
+                labels[:, 1:].reshape([-1]),
+            )
+            return loss, logits
+        return logits
+
+
+def shard_gpt(model: "GPTForCausalLM", mesh, mp_axis: str = "mp"):
+    """Megatron placements: fc_in + qkv column-sharded, fc_out/out_proj
+    row-sharded, embeddings vocab-sharded (reference mp_layers.py roles).
+    Parameters are PHYSICALLY placed (shard_tensor device_put) like
+    shard_llama — not just annotated — so eager use is sharded too."""
+    from paddle_tpu.distributed.auto_parallel import Replicate, Shard, shard_tensor
+
+    if mp_axis not in mesh.dim_names:
+        return model
+    axis_idx = mesh.dim_names.index(mp_axis)
+
+    def place(p):
+        pl = [Replicate()] * mesh.ndim
+        pl[axis_idx] = p
+        return pl
+
+    def shard_param(layer, name, p):
+        param = layer._parameters.get(name)
+        if param is not None:
+            layer._parameters[name] = shard_tensor(
+                param, mesh, place(p), stop_gradient=param.stop_gradient
+            )
+
+    shard_param(model.gpt.wte, "weight", Shard(0))
+    for blk in model.gpt.h:
+        for col in (blk.attn.q_proj, blk.attn.k_proj, blk.attn.v_proj, blk.fc_in):
+            shard_param(col, "weight", Shard(1))
+            shard_param(col, "bias", Shard(0))
+        for row in (blk.attn.out_proj, blk.fc_out):
+            shard_param(row, "weight", Shard(0))
+    return model
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    cfg = dict(
+        vocab_size=512,
+        hidden_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=256,
+        max_position_embeddings=128,
+        dropout=0.0,
+    )
+    cfg.update(kw)
+    return GPTConfig(**cfg)

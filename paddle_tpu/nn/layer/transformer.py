@@ -41,7 +41,7 @@ class MultiHeadAttention(Layer):
         b, s, _ = t.shape
         return t.reshape([b, s, self.num_heads, self.head_dim])
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None, is_causal=False):
         key = query if key is None else key
         value = query if value is None else value
         q = self._shape(self.q_proj(query))
@@ -51,7 +51,8 @@ class MultiHeadAttention(Layer):
             k = paddle.concat([cache[0], k], axis=1)
             v = paddle.concat([cache[1], v], axis=1)
         out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout, training=self.training
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            is_causal=is_causal, training=self.training
         )
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.embed_dim])

@@ -79,9 +79,15 @@ def _discover_captures(fns, prog):
                     fn()
                 except Exception:
                     pass  # discovery only; the real trace surfaces errors
+    from paddle_tpu._core.tensor import Parameter
+
     seen, out = set(), []
     for t in rec.inputs:
-        if getattr(t, "_program", None) is prog and id(t) not in seen:
+        is_prog_var = getattr(t, "_program", None) is prog
+        # Parameters are captured too: Program.record registers them as
+        # state vars (var_for_parameter) so optimizer updates reach the
+        # branches — dropping them would bake weights in as constants
+        if (is_prog_var or isinstance(t, Parameter)) and id(t) not in seen:
             seen.add(id(t))
             out.append(t)
     return out
@@ -100,20 +106,17 @@ def _static_cond(pred, true_fn, false_fn):
             for t, v in zip(captured, cap_vals):
                 t._bind(v)
             # suspend_capture is active inside Operator replay, so this runs
-            # the eager/traced cond (lax.cond on tracers)
+            # the eager/traced cond (lax.cond on tracers); the branch's
+            # ORIGINAL pytree structure (dict/nested) is preserved
             out = cond(Tensor(pred_v, stop_gradient=True), true_fn, false_fn)
-            flat, tree = jax.tree_util.tree_flatten(
-                out, is_leaf=lambda x: isinstance(x, Tensor)
+            return jax.tree_util.tree_map(
+                _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor)
             )
-            return tuple(_unwrap(v) for v in flat)
         finally:
             for t, v in zip(captured, originals):
                 t._bind(v)
 
-    out = apply("cond", cond_replay, pred, *captured)
-    if isinstance(out, (tuple, list)) and len(out) == 1:
-        return out[0]
-    return out
+    return apply("cond", cond_replay, pred, *captured)
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):

@@ -90,3 +90,29 @@ def test_predict_keeps_ragged_tail():
     ds = _XorDataset(33)
     outs = model.predict(ds, batch_size=16, stack_outputs=True)
     assert outs[0].shape == (33, 2)
+
+
+def test_async_save_and_in_memory_dataset(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, InMemoryDataset
+
+    # async checkpoint: snapshot-now, write-later
+    state = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32))}
+    p = str(tmp_path / "ck.pdparams")
+    paddle.save(state, p, async_save=True)
+    state["w"]._bind(state["w"]._value * 0)  # mutate AFTER snapshot
+    paddle.wait_async_save()
+    loaded = paddle.load(p)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]._value), np.arange(6, dtype=np.float32))
+
+    # InMemoryDataset feed
+    f = tmp_path / "data.txt"
+    f.write_text("1 2\n3 4\n5 6\n")
+    ds = InMemoryDataset(parse_fn=lambda line: np.asarray([int(v) for v in line.split()], np.int32))
+    ds.load_into_memory([str(f)])
+    ds.global_shuffle(seed=1)
+    assert len(ds) == 3
+    rows = [tuple(np.asarray(b)[0].tolist()) for b in DataLoader(ds, batch_size=1)]
+    assert sorted(rows) == [(1, 2), (3, 4), (5, 6)]
