@@ -332,6 +332,7 @@ class DataLoader:
         timeout=0,
         worker_init_fn=None,
         persistent_workers=False,
+        prefetch_to_device=0,
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
@@ -343,6 +344,12 @@ class DataLoader:
         # dataset code stays out of the runtime — caller's judgement)
         self._use_shared_memory = bool(use_shared_memory)
         self.prefetch_factor = prefetch_factor
+        # TPU-first input pipeline: stage the next N batches onto the device
+        # asynchronously so host->HBM transfer overlaps the current step's
+        # compute (jax dispatch is async; holding a window of device-resident
+        # batches keeps the feed ahead of the MXU).  Reference analog:
+        # use_buffer_reader's DoubleBuffer layer; 0 disables.
+        self.prefetch_to_device = int(prefetch_to_device)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -514,7 +521,36 @@ class DataLoader:
             consumed.close()
 
     def __iter__(self):
+        if self.prefetch_to_device > 0:
+            return iter(self._iter_device_prefetch())
         return iter(self._iter_batches())
+
+    def _iter_device_prefetch(self):
+        import collections
+
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu._core.tensor import Tensor
+
+        def to_device(batch):
+            def put(x):
+                if isinstance(x, Tensor):
+                    return Tensor(jnp.asarray(x._value), stop_gradient=x.stop_gradient)
+                if isinstance(x, np.ndarray):
+                    return Tensor(jnp.asarray(x))
+                return x
+            return jax.tree_util.tree_map(
+                put, batch, is_leaf=lambda v: isinstance(v, (Tensor, np.ndarray))
+            )
+
+        window = collections.deque()
+        for batch in self._iter_batches():
+            window.append(to_device(batch))  # async dispatch: transfer starts now
+            if len(window) > self.prefetch_to_device:
+                yield window.popleft()
+        while window:
+            yield window.popleft()
 
 
 class InMemoryDataset(Dataset):

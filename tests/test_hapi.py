@@ -116,3 +116,20 @@ def test_async_save_and_in_memory_dataset(tmp_path):
     assert len(ds) == 3
     rows = [tuple(np.asarray(b)[0].tolist()) for b in DataLoader(ds, batch_size=1)]
     assert sorted(rows) == [(1, 2), (3, 4), (5, 6)]
+
+
+def test_dataloader_prefetch_to_device():
+    import numpy as np
+
+    import jax
+    from paddle_tpu.io import DataLoader, TensorDataset
+    import paddle_tpu as paddle
+
+    xs = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(12, 2))
+    ys = paddle.to_tensor(np.arange(12, dtype=np.int32))
+    dl = DataLoader(TensorDataset([xs, ys]), batch_size=4, prefetch_to_device=2)
+    seen = []
+    for xb, yb in dl:
+        assert isinstance(xb._value, jax.Array)  # already device-resident
+        seen.append(np.asarray(yb._value))
+    np.testing.assert_array_equal(np.concatenate(seen), np.arange(12))
