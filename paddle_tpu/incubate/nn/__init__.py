@@ -1,4 +1,11 @@
 """paddle.incubate.nn parity: fused-op functional API + fused layers."""
 
 from . import functional  # noqa: F401
-from .layers import FusedRMSNorm, FusedLayerNorm  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedFeedForward,
+    FusedLayerNorm,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedRMSNorm,
+    FusedTransformerEncoderLayer,
+)

@@ -29,3 +29,104 @@ class FusedLayerNorm(nn.Layer):
 
     def forward(self, x, residual=None):
         return F.fused_layer_norm(x, self.weight, self.bias, epsilon=self.epsilon, residual=residual)
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Reference python/paddle/incubate/nn/layer/fused_transformer.py
+    FusedMultiHeadAttention: pre/post-LN + qkv + attention + out proj in one
+    block.  TPU-native: the fusion is XLA's (norm+matmul epilogues) plus the
+    flash kernel via scaled_dot_product_attention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0, attn_dropout_rate=0.0,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.attn = nn.MultiHeadAttention(embed_dim, num_heads, attn_dropout_rate)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        out = self.attn(x, attn_mask=attn_mask)
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """Reference FusedFeedForward: LN + linear + act + linear + residual."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.linear1 = nn.Linear(d_model, dim_feedforward)
+        self.linear2 = nn.Linear(dim_feedforward, d_model)
+        self.drop_act = nn.Dropout(act_dropout_rate if act_dropout_rate is not None else dropout_rate)
+        self.drop = nn.Dropout(dropout_rate)
+        self.act = activation
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        h = self.drop_act(getattr(F, self.act)(self.linear1(x)))
+        out = residual + self.drop(self.linear2(h))
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """Reference FusedTransformerEncoderLayer = FusedMHA + FusedFFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before,
+        )
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+        )
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Reference FusedMultiTransformer (the serving decoder stack op,
+    python/paddle/incubate/nn/layer/fused_transformer.py:1380): N pre-LN
+    decoder blocks in one module.  TPU-native: blocks are python, the fusion
+    is whole-graph XLA under jit; causal decode attention rides the flash /
+    paged kernels."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1, epsilon=1e-5):
+        super().__init__()
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate,
+                activation=activation, normalize_before=normalize_before,
+            )
+            for _ in range(num_layers)
+        ])
+
+    def forward(self, x, attn_mask=None, caches=None):
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x

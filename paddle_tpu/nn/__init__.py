@@ -5,3 +5,5 @@ from . import initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer, ParamAttr  # noqa: F401
+
+from . import quant  # noqa: F401,E402

@@ -1,0 +1,91 @@
+"""paddle.nn.quant — weight-only quantization for serving.
+
+Reference: python/paddle/nn/quant/quantized_linear.py
+(weight_quantize/weight_dequantize/weight_only_linear backed by CUDA int8/
+int4 GEMM kernels, paddle/phi/kernels/fusion/gpu/...weight_only...).
+
+TPU-native: weights store as int8 (or int4 packed two-per-byte) with
+per-output-channel fp scales; the matmul path DEQUANTIZES into the MXU's
+native bf16 — on TPU the win is HBM footprint/bandwidth (the usual serving
+bottleneck), not integer math, so dequant+matmul IS the fused kernel (XLA
+fuses the scale multiply into the matmul epilogue)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear", "llm_int8_linear"]
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Quantize a [in, out] weight to (quantized, scale-per-out-channel)."""
+    x = ensure_tensor(x)
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    w = x._value.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0)  # per-output-channel
+    if algo == "weight_only_int4":
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        q = jnp.clip(jnp.round(w / scale), -8, 7).astype(jnp.int8)
+        # pack two int4 per byte along the input dim
+        if q.shape[0] % 2:
+            raise ValueError("weight_only_int4 needs an even input dim")
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        packed = (lo | hi).astype(jnp.int8)
+        return Tensor(packed), Tensor(scale)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return Tensor(q), Tensor(scale)
+
+
+def _unpack_int4(packed):
+    lo = (packed & 0x0F).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)  # sign-extend nibble
+    hi = ((packed >> 4) & 0x0F).astype(jnp.int8)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=1).reshape((-1,) + packed.shape[1:])
+    return out
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+    x, scale = ensure_tensor(x), ensure_tensor(scale)
+
+    def _dq(q, s):
+        qv = _unpack_int4(q) if algo == "weight_only_int4" else q
+        return qv.astype(jnp.float32) * s.astype(jnp.float32)
+
+    return apply("weight_dequantize", _dq, x, scale)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None, weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) + bias — reference weight_only_linear.
+
+    weight: int8 [in, out] or int4-packed [in//2, out]; weight_scale: [out].
+    The dequantized operand feeds the MXU in the activation dtype; XLA fuses
+    the per-channel scale into the matmul epilogue.
+    """
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    weight_scale = ensure_tensor(weight_scale)
+    extras = [ensure_tensor(bias)] if bias is not None else []
+
+    def _fn(xv, qw, s, *rest):
+        qv = _unpack_int4(qw) if weight_dtype == "int4" else qw
+        w = (qv.astype(jnp.float32) * s.astype(jnp.float32)).astype(xv.dtype)
+        out = jnp.matmul(xv, w)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return apply("weight_only_linear", _fn, x, weight, weight_scale, *extras)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """Reference llm_int8_linear: on TPU the outlier-split scheme degenerates
+    to the same dequant-into-bf16 matmul (no int8 tensor cores to protect),
+    so this is weight_only_linear with the llm.int8 quantization layout."""
+    return weight_only_linear(x, weight, bias=bias, weight_scale=weight_scale, weight_dtype="int8")

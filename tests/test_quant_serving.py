@@ -1,0 +1,65 @@
+"""Weight-only quantization (reference python/paddle/nn/quant/
+quantized_linear.py) + fused transformer layer classes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.quant import weight_dequantize, weight_only_linear, weight_quantize
+
+
+def test_int8_roundtrip_and_linear():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    x = rng.standard_normal((4, 16)).astype(np.float32)
+    qw, scale = weight_quantize(paddle.to_tensor(w), algo="weight_only_int8")
+    assert str(qw._value.dtype) == "int8" and list(scale.shape) == [8]
+    wd = np.asarray(weight_dequantize(qw, scale)._value)
+    np.testing.assert_allclose(wd, w, atol=np.abs(w).max() / 127 + 1e-6)
+    y = weight_only_linear(paddle.to_tensor(x), qw, weight_scale=scale)
+    np.testing.assert_allclose(np.asarray(y._value), x @ w, rtol=0.05, atol=0.05)
+
+
+def test_int4_pack_roundtrip_and_linear():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    x = rng.standard_normal((2, 16)).astype(np.float32)
+    qw, scale = weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    assert list(qw.shape) == [8, 8]  # packed two-per-byte on input dim
+    wd = np.asarray(weight_dequantize(qw, scale, algo="weight_only_int4")._value)
+    assert wd.shape == w.shape
+    np.testing.assert_allclose(wd, w, atol=np.abs(w).max() / 7 + 1e-6)
+    y = weight_only_linear(paddle.to_tensor(x), qw, weight_scale=scale, weight_dtype="int4")
+    # exact vs the dequantized weight (quant error itself is checked above)
+    np.testing.assert_allclose(np.asarray(y._value), x @ wd, rtol=1e-4, atol=1e-4)
+
+
+def test_weight_only_linear_under_jit():
+    from paddle_tpu.jit import to_static
+
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((8, 4)).astype(np.float32)
+    qw, scale = weight_quantize(paddle.to_tensor(w))
+
+    @to_static
+    def f(a):
+        return weight_only_linear(a, qw, weight_scale=scale)
+
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(f(x)._value), np.asarray(x._value) @ w, rtol=0.05, atol=0.05
+    )
+
+
+def test_fused_transformer_layers():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer, FusedTransformerEncoderLayer
+
+    paddle.seed(0)
+    layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    x = paddle.to_tensor(np.random.default_rng(3).standard_normal((2, 8, 32)).astype(np.float32))
+    y = layer(x)
+    assert list(y.shape) == [2, 8, 32]
+    stack = FusedMultiTransformer(32, 4, 64, num_layers=2, dropout_rate=0.0)
+    z = stack(x)
+    assert np.isfinite(np.asarray(z._value)).all()
