@@ -78,6 +78,7 @@ for _sub in (
     "geometric",
     "quantization",
     "onnx",
+    "cost_model",
     "linalg",
     "utils",
     "decomposition",

@@ -1,0 +1,93 @@
+"""Cost model: profiled op table + analytical estimates.
+
+Reference: python/paddle/cost_model/ (CostModel.profile_measure over a
+static Program + static_op_benchmark.json, the profiled per-op latency
+table consumed by auto-parallel planners) and
+paddle/fluid/framework/ir/cost_model.cc.
+
+TPU-native: two tiers —
+- `OpCostModel.measure(fn, *args)` profiles a jitted callable on the LIVE
+  device (compile once, time steady-state) and records it in the table;
+  tables round-trip to JSON like static_op_benchmark.json.
+- `flops_time(flops, bytes)` gives the roofline estimate from the device's
+  peak FLOPs/HBM bandwidth — the planner's fallback when no profile exists
+  (auto_tuner's memory model is the capacity side of the same planning).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = ["OpCostModel", "device_peaks"]
+
+# (peak TFLOP/s bf16, HBM GB/s) per device kind — public spec sheet numbers
+_PEAKS = {
+    "tpu v5 lite": (197.0, 819.0),
+    "tpu v5e": (197.0, 819.0),
+    "tpu v5p": (459.0, 2765.0),
+    "tpu v4": (275.0, 1228.0),
+    "cpu": (0.5, 50.0),
+}
+
+
+def device_peaks():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in _PEAKS.items():
+        if k in kind:
+            return v
+    return _PEAKS["cpu"] if jax.default_backend() == "cpu" else (100.0, 500.0)
+
+
+class OpCostModel:
+    """Profiled per-op latency table (static_op_benchmark.json analog)."""
+
+    def __init__(self):
+        self.table: dict[str, dict] = {}
+
+    def measure(self, name, fn, *args, iters=10, warmup=2):
+        """Profile a jax-jittable callable; records and returns seconds/call."""
+        import jax
+
+        jfn = jax.jit(fn)
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        for _ in range(warmup):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        self.table[name] = {"time_s": dt, "device": str(jax.devices()[0].device_kind)}
+        return dt
+
+    def query(self, name, default=None):
+        entry = self.table.get(name)
+        if entry is None:
+            if default is not None:
+                return default
+            raise KeyError(f"no profile for op {name!r}")
+        return entry["time_s"]
+
+    def flops_time(self, flops, mem_bytes=0):
+        """Roofline estimate: max(compute-bound, bandwidth-bound) seconds."""
+        peak_tflops, hbm_gbs = device_peaks()
+        t_compute = flops / (peak_tflops * 1e12)
+        t_mem = mem_bytes / (hbm_gbs * 1e9)
+        return max(t_compute, t_mem)
+
+    # ---------------------------------------------------------------- io
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.table, f, indent=1)
+
+    @classmethod
+    def load(cls, path):
+        m = cls()
+        with open(path) as f:
+            m.table = json.load(f)
+        return m

@@ -51,3 +51,28 @@ def test_memory_stats():
     allocated = device.memory_allocated()
     assert allocated > 0
     assert device.max_memory_allocated() >= 0
+
+
+def test_op_cost_model_profile_and_roofline(tmp_path):
+    """Cost model (reference python/paddle/cost_model/ +
+    static_op_benchmark.json): profiled table + roofline estimates."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.cost_model import OpCostModel, device_peaks
+
+    m = OpCostModel()
+    x = jnp.ones((128, 128), jnp.float32)
+    dt = m.measure("matmul_128", lambda a: a @ a, x, iters=3, warmup=1)
+    assert dt > 0 and m.query("matmul_128") == dt
+    # roofline: compute- vs bandwidth-bound regimes ordered sensibly
+    t_small = m.flops_time(1e6, 1e4)
+    t_big = m.flops_time(1e12, 1e9)
+    assert t_big > t_small > 0
+    peaks = device_peaks()
+    assert peaks[0] > 0 and peaks[1] > 0
+    p = tmp_path / "op_table.json"
+    m.save(str(p))
+    m2 = OpCostModel.load(str(p))
+    assert m2.query("matmul_128") == dt
+    assert m2.query("missing", default=1.0) == 1.0
