@@ -5,12 +5,20 @@ from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: 
 from .fleet import (  # noqa: F401
     DistributedStrategy,
     HybridParallelOptimizer,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
     distributed_model,
     distributed_optimizer,
     get_hybrid_communicate_group,
     init,
+    init_server,
+    init_worker,
     is_initialized,
+    is_server,
+    is_worker,
     make_train_step,
+    run_server,
+    stop_worker,
     worker_index,
     worker_num,
 )

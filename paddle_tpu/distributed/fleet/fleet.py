@@ -79,6 +79,12 @@ def init(role_maker=None, is_collective: bool = True, strategy: DistributedStrat
     from paddle_tpu.distributed.env import init_parallel_env
 
     init_parallel_env()
+    _env.role_maker = role_maker
+    if role_maker is not None and not is_collective and role_maker.is_server():
+        # PS mode server: no collective topology to build
+        _env.strategy = strategy or DistributedStrategy()
+        _env.initialized = True
+        return None
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
     n_dev = jax.device_count()
@@ -189,3 +195,98 @@ def make_train_step(model, optimizer, loss_fn, scaler=None, num_microbatches=Non
     return ShardedTrainStep(
         model, inner, loss_fn, mesh, batch_spec=batch_spec, zero_stage=zero, dp_axis=dp_axis, scaler=scaler
     )
+
+
+# ---------------------------------------------------------------- PS mode
+# Reference: fleet's parameter-server runtime (fleet.init(role_maker) with
+# PaddleCloudRoleMaker, runtime/the_one_ps.py init_server/run_server/
+# init_worker/stop_worker).  TPU-native scope: the PS tier serves host
+# sparse-embedding tables (distributed/ps/, scope decision documented
+# there); the role surface below wires fleet's API onto it.
+
+
+class PaddleCloudRoleMaker:
+    """Env-var driven role assignment (reference
+    fleet/base/role_maker.py PaddleCloudRoleMaker): TRAINING_ROLE=TRAINER|
+    PSERVER, PADDLE_TRAINER_ID / PADDLE_PSERVER_ID."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+
+        self._is_collective = is_collective
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._index = int(
+            os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PADDLE_PSERVER_ID", "0"))
+        )
+
+    def is_server(self):
+        return self._role == "PSERVER"
+
+    def is_worker(self):
+        return self._role == "TRAINER"
+
+    def role_index(self):
+        return self._index
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=False, current_id=0, role="TRAINER", **kwargs):
+        self._is_collective = is_collective
+        self._role = role.upper()
+        self._index = int(current_id)
+
+
+def _role():
+    return getattr(_env, "role_maker", None)
+
+
+def is_server() -> bool:
+    r = _role()
+    return bool(r and r.is_server())
+
+
+def is_worker() -> bool:
+    r = _role()
+    return r.is_worker() if r else True
+
+
+def init_server(*model_dirs, **kwargs):
+    """Start serving registered SparseTables over rpc (the_one_ps
+    init_server analog).  Tables register via PsServer.register_table."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PsServer
+
+    name = kwargs.get("name", f"pserver{_role().role_index() if _role() else 0}")
+    if not rpc.get_all_worker_infos():
+        rpc.init_rpc(
+            name,
+            rank=kwargs.get("rank"),
+            world_size=kwargs.get("world_size"),
+            master_endpoint=kwargs.get("master_endpoint"),
+        )
+    _env.ps_server = PsServer()
+    return _env.ps_server
+
+
+def run_server():
+    """Block serving rpc requests until shutdown (reference run_server)."""
+    import time
+
+    while getattr(_env, "ps_server", None) is not None:
+        time.sleep(0.2)
+
+
+def init_worker(scopes=None):
+    """Worker-side PS setup: nothing to prefetch on the TPU path (pull
+    happens per batch through SparseEmbedding)."""
+    return None
+
+
+def stop_worker():
+    from paddle_tpu.distributed import rpc
+
+    _env.ps_server = None
+    try:
+        rpc.shutdown()
+    except Exception:
+        pass

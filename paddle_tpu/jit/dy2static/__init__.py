@@ -164,6 +164,59 @@ def convert_return_ifelse(pred, t_fn, f_fn):
     return _cond(Tensor(pv, stop_gradient=True), t_fn, f_fn)
 
 
+def convert_range_for(range_args, body_fn, get_args, set_args, names, target_idx):
+    """`for t in range(...)` (reference convert_operators' for->while):
+    concrete bounds keep exact python semantics; traced bounds lower to
+    lax.while_loop with the loop target carried as state."""
+    args = [_unwrap(a) for a in range_args]
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+
+    traced = any(_is_tracer(v) for v in (start, stop, step))
+    if not traced:
+        for i in range(int(start), int(stop), int(step)):
+            vals = list(get_args())
+            vals[target_idx] = i
+            set_args(tuple(vals))
+            body_fn()
+        return
+
+    from jax import lax
+
+    orig = list(get_args())
+    for name, v in zip(names, orig):
+        if v is _UNDEF and name != names[target_idx]:
+            raise ValueError(
+                f"dy2static: '{name}' must be defined before a traced for loop"
+            )
+    orig[target_idx] = jnp.asarray(start, jnp.int32)
+
+    def to_vals(vars_):
+        return tuple(jnp.asarray(_unwrap(v)) for v in vars_)
+
+    step_v = jnp.asarray(step, jnp.int32)
+    stop_v = jnp.asarray(stop, jnp.int32)
+
+    def c(vals):
+        i = vals[target_idx]
+        return jnp.where(step_v > 0, i < stop_v, i > stop_v)
+
+    def b(vals):
+        set_args(tuple(Tensor(v) for v in vals))
+        body_fn()
+        out = list(to_vals(get_args()))
+        out[target_idx] = vals[target_idx] + step_v
+        return tuple(out)
+
+    res = lax.while_loop(c, b, to_vals(orig))
+    final = [Tensor(v, stop_gradient=True) for v in res]
+    set_args(tuple(final))
+
+
 def convert_logical_and(x, y_fn):
     xv = _unwrap(x)
     if not _tensorish(xv):
@@ -430,6 +483,44 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         out = [_init_guard(n) for n in names]
         out += [assign_test, true_def, false_def, get_def, set_def, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    # ---- for-range statements (reference for->while transform)
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and isinstance(node.target, ast.Name)
+            and not node.orelse
+        )
+        if not is_range or _has_escape(node.body):
+            return node
+        uid = self._next()
+        names = _assigned_names(node.body)
+        tgt = node.target.id
+        if tgt in names:
+            names.remove(tgt)
+        names = [tgt] + names  # target first (target_idx=0)
+        get_src, set_src = _make_getset(names, uid)
+        body_def = ast.parse(f"def _pt_body_{uid}():\n    pass").body[0]
+        nl_names = _assigned_names(node.body) + [tgt]
+        body_def.body = [ast.Nonlocal(names=sorted(set(nl_names)))] + (node.body or [ast.Pass()])
+        get_def = ast.parse(get_src).body[0]
+        set_def = ast.parse(set_src).body[0]
+        args_tuple = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
+        call = ast.parse(
+            f"_pt_rt.convert_range_for(_PT_ARGS_, _pt_body_{uid}, "
+            f"_pt_get_{uid}, _pt_set_{uid}, {tuple(names)!r}, 0)"
+        ).body[0]
+        call.value.args[0] = args_tuple
+        out = [_init_guard(n) for n in names]
+        out += [body_def, get_def, set_def, call]
         for n in out:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)

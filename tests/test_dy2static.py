@@ -186,3 +186,31 @@ def test_while_invariant_stays_python():
 
     r = f(paddle.to_tensor(np.array(2.0, np.float32)))
     assert float(r._value) == 7.0
+
+
+@to_static
+def _tensor_range_loop(n, x):
+    acc = paddle.zeros([])
+    for i in range(n):  # n is a Tensor -> traced while_loop
+        acc = acc + x.sum() + i
+    return acc
+
+
+def test_for_over_tensor_range():
+    n = paddle.to_tensor(np.array(4, np.int32))
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    # sum over i in 0..3 of (2 + i) = 8 + 6 = 14
+    assert float(_tensor_range_loop(n, x)._value) == 14.0
+
+
+@to_static
+def _python_range_loop(x):
+    acc = 0.0
+    for i in range(3):  # concrete: exact python semantics
+        acc = acc + i
+    return x + acc
+
+
+def test_for_over_python_range_preserved():
+    r = _python_range_loop(paddle.to_tensor(np.zeros(1, np.float32)))
+    assert float(r._value[0]) == 3.0

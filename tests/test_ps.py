@@ -61,3 +61,42 @@ def test_ps_over_rpc():
         assert not np.allclose(rows[0], rows2[0])
     finally:
         rpc.shutdown()
+
+
+def test_fleet_ps_mode_roles():
+    """fleet PS-mode surface (reference fleet.init(role_maker) +
+    the_one_ps init_server/init_worker)."""
+    import paddle_tpu.distributed.fleet as fleet
+
+    rm = fleet.UserDefinedRoleMaker(current_id=0, role="PSERVER")
+    fleet.init(role_maker=rm, is_collective=False)
+    assert fleet.is_server() and not fleet.is_worker()
+
+    rm2 = fleet.UserDefinedRoleMaker(current_id=1, role="TRAINER")
+    fleet.init(role_maker=rm2, is_collective=True)
+    assert fleet.is_worker() and not fleet.is_server()
+    assert fleet.init_worker() is None
+
+
+def test_fleet_ps_server_serves_tables():
+    import threading
+    import time
+
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PsClient, PsServer, SparseTable
+
+    rm = fleet.UserDefinedRoleMaker(current_id=0, role="PSERVER")
+    fleet.init(role_maker=rm, is_collective=False)
+    PsServer.register_table(SparseTable(dim=4, name="fleet_emb"))
+    fleet.init_server(name="fleet_ps0", rank=0, world_size=1, master_endpoint="127.0.0.1:29631")
+    t = threading.Thread(target=fleet.run_server, daemon=True)
+    t.start()
+    try:
+        client = PsClient(server="fleet_ps0", table_name="fleet_emb")
+        rows = client.pull([1, 2])
+        assert rows.shape == (2, 4)
+    finally:
+        fleet.stop_worker()
+        t.join(timeout=5)
+        assert not t.is_alive()
