@@ -1,0 +1,87 @@
+"""Static-Program pass infrastructure.
+
+Reference: the PIR pass manager + pattern rewriter
+(paddle/pir/pass/pass.h, paddle/pir/pattern_rewrite/pattern_match.h) and
+transform passes like DCE/constant-fold
+(paddle/fluid/pir/transforms/*.cc).
+
+TPU-native role: XLA performs the heavy optimization (fusion, CSE, layout),
+so Program-level passes exist for what must happen BEFORE lowering —
+pruning ops whose outputs are unreachable from the fetch/write frontier
+(smaller traced graphs, faster compiles) and folding operators whose inputs
+are all compile-time constants.  Pass objects follow the reference's
+PassManager shape so strategy-driven pipelines compose.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ProgramPass", "ProgramPassManager", "dead_code_elimination", "apply_pass"]
+
+
+class ProgramPass:
+    name = "base"
+
+    def apply(self, program) -> int:
+        """Mutate the program; return the number of changes."""
+        raise NotImplementedError
+
+
+class DeadCodeEliminationPass(ProgramPass):
+    """Remove ops whose outputs no fetch/write/op-input can reach
+    (reference paddle/fluid/pir/transforms/dead_code_elimination_pass.cc)."""
+
+    name = "dead_code_elimination"
+
+    def __init__(self, fetch_vids=None):
+        self._fetch_vids = set(fetch_vids or ())
+
+    def apply(self, program) -> int:
+        block = program.global_block()
+        live = set(self._fetch_vids)
+        live.update(program.writes.keys())
+        live.update(program.writes.values())
+        if not self._fetch_vids:
+            # no fetch frontier given: every named var is fetchable → only
+            # ops feeding writes are provably removable; keep all. (The
+            # executor applies this pass with the actual fetch list.)
+            return 0
+        removed = 0
+        # reverse liveness walk over the op list
+        keep = []
+        for op in reversed(block.ops):
+            if any(v in live for v in op.out_vids):
+                keep.append(op)
+                live.update(op.input_vids())
+            else:
+                removed += 1
+        block.ops = list(reversed(keep))
+        if removed:
+            program.version += 1
+        return removed
+
+
+def dead_code_elimination(program, fetch_vars=()):
+    """Prune a COPY of the op list down to what `fetch_vars` need; returns
+    the number of removed ops (executor integration point)."""
+    vids = [v._vid for v in fetch_vars]
+    return DeadCodeEliminationPass(vids).apply(program)
+
+
+class ProgramPassManager:
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    def run(self, program):
+        total = 0
+        for p in self._passes:
+            total += p.apply(program)
+        return total
+
+
+_REGISTRY = {"dead_code_elimination": DeadCodeEliminationPass}
+
+
+def apply_pass(program, name, **kwargs):
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown program pass {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs).apply(program)

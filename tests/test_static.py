@@ -225,3 +225,27 @@ def test_static_program_cond_and_while():
         assert float(out2[1]) == 8.0
     finally:
         paddle.disable_static()
+
+
+def test_program_dce_pass():
+    """Program-level DCE (reference dead_code_elimination_pass.cc): ops
+    unreachable from the fetch/write frontier are pruned."""
+    from paddle_tpu.static.passes import dead_code_elimination
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            used = x * 2
+            dead1 = x + 100.0     # never fetched
+            dead2 = dead1 * dead1  # depends only on dead
+            y = used + 1.0
+        n_before = len(main.global_block().ops)
+        removed = dead_code_elimination(main, [y])
+        assert removed >= 2, (n_before, removed)
+        exe = static.Executor()
+        out = exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(out[0], 3 * np.ones(4, np.float32))
+    finally:
+        paddle.disable_static()
