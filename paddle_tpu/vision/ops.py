@@ -304,3 +304,67 @@ class RoIPool:
 
 
 PSRoIPool = RoIPool
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode a YOLO detection head to boxes+scores (reference
+    paddle.vision.ops.yolo_box, phi yolo_box kernel) — pure tensor math, so
+    it is jit-traceable on TPU (the PP-YOLO family's decode stage).
+
+    x: [N, C, H, W] with C = len(anchors)/2 * (5 + class_num);
+    img_size: [N, 2] (h, w).  Returns (boxes [N, M, 4] xyxy, scores
+    [N, M, class_num]) with below-threshold rows zeroed (static shape — the
+    reference zeroes them too; NMS prunes downstream).
+    """
+    from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+    import jax.numpy as jnp
+    import jax
+
+    x = ensure_tensor(x)
+    img_size = ensure_tensor(img_size)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = an.shape[0]
+
+    def _decode(xv, imgs):
+        n, c, h, w = xv.shape
+        xv = xv.reshape(n, na, 5 + class_num + (1 if iou_aware else 0), h, w)
+        if iou_aware:
+            iou_p = jax.nn.sigmoid(xv[:, :, -1])
+            xv = xv[:, :, :-1]
+        tx, ty, tw, th, obj = xv[:, :, 0], xv[:, :, 1], xv[:, :, 2], xv[:, :, 3], xv[:, :, 4]
+        cls = xv[:, :, 5:]
+        gx = jax.lax.broadcasted_iota(jnp.float32, (n, na, h, w), 3)
+        gy = jax.lax.broadcasted_iota(jnp.float32, (n, na, h, w), 2)
+        bx = (jax.nn.sigmoid(tx) * scale_x_y - 0.5 * (scale_x_y - 1.0) + gx) / w
+        by = (jax.nn.sigmoid(ty) * scale_x_y - 0.5 * (scale_x_y - 1.0) + gy) / h
+        aw = an[:, 0].reshape(1, na, 1, 1)
+        ah = an[:, 1].reshape(1, na, 1, 1)
+        input_w = w * downsample_ratio
+        input_h = h * downsample_ratio
+        bw = jnp.exp(tw) * aw / input_w
+        bh = jnp.exp(th) * ah / input_h
+        conf = jax.nn.sigmoid(obj)
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * iou_p ** iou_aware_factor
+        probs = jax.nn.sigmoid(cls) * conf[:, :, None]
+        imgs_f = imgs.astype(jnp.float32)
+        im_h = imgs_f[:, 0].reshape(n, 1, 1, 1)
+        im_w = imgs_f[:, 1].reshape(n, 1, 1, 1)
+        x0 = (bx - bw / 2) * im_w
+        y0 = (by - bh / 2) * im_h
+        x1 = (bx + bw / 2) * im_w
+        y1 = (by + bh / 2) * im_h
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, im_w - 1)
+            y0 = jnp.clip(y0, 0, im_h - 1)
+            x1 = jnp.clip(x1, 0, im_w - 1)
+            y1 = jnp.clip(y1, 0, im_h - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(n, -1, 4)
+        keep = (conf > conf_thresh).reshape(n, -1, 1)
+        boxes = jnp.where(keep, boxes, 0.0)
+        scores = jnp.where(keep, probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num), 0.0)
+        return boxes, scores
+
+    return apply("yolo_box", _decode, x, img_size)

@@ -191,3 +191,33 @@ class TestDatasets:
             assert len(ds) == 4
             (img,) = ds[1]
             assert img.shape == (2, 2)
+
+
+def test_yolo_box_decode():
+    """yolo_box (PP-YOLO decode, reference paddle.vision.ops.yolo_box):
+    center cell of a uniform head decodes to the expected normalized box,
+    traceable under jit."""
+    import jax
+    import paddle_tpu.vision.ops as V
+
+    n, na, cls_n, h, w = 1, 2, 3, 4, 4
+    c = na * (5 + cls_n)
+    x = np.zeros((n, c, h, w), np.float32)  # sigmoid(0)=0.5 centers
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = V.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img),
+        anchors=[8, 8, 16, 16], class_num=cls_n, conf_thresh=0.1,
+        downsample_ratio=16,
+    )
+    assert list(boxes.shape) == [1, na * h * w, 4]
+    assert list(scores.shape) == [1, na * h * w, cls_n]
+    b = np.asarray(boxes._value)
+    # first anchor at cell (0,0): center (0.5/4, 0.5/4)*64 = 8, w=h=8/64*64=8
+    np.testing.assert_allclose(b[0, 0], [4.0, 4.0, 12.0, 12.0], atol=1e-4)
+    # conf=0.5 > 0.1 so scores kept: sigmoid(0)*0.5 = 0.25
+    np.testing.assert_allclose(np.asarray(scores._value)[0, 0], 0.25 * np.ones(cls_n), atol=1e-5)
+    # traceable
+    jitted = jax.jit(lambda a, s: V.yolo_box(
+        paddle.Tensor(a), paddle.Tensor(s), anchors=[8, 8, 16, 16],
+        class_num=cls_n, conf_thresh=0.1, downsample_ratio=16)[0]._value)
+    np.testing.assert_allclose(np.asarray(jitted(x, img)), b, atol=1e-5)
