@@ -9,7 +9,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool", "yolo_box"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
@@ -318,10 +318,6 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     [N, M, class_num]) with below-threshold rows zeroed (static shape — the
     reference zeroes them too; NMS prunes downstream).
     """
-    from paddle_tpu.tensor._ops_common import apply, ensure_tensor
-    import jax.numpy as jnp
-    import jax
-
     x = ensure_tensor(x)
     img_size = ensure_tensor(img_size)
     an = np.asarray(anchors, np.float32).reshape(-1, 2)
@@ -329,10 +325,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
 
     def _decode(xv, imgs):
         n, c, h, w = xv.shape
-        xv = xv.reshape(n, na, 5 + class_num + (1 if iou_aware else 0), h, w)
         if iou_aware:
-            iou_p = jax.nn.sigmoid(xv[:, :, -1])
-            xv = xv[:, :, :-1]
+            # reference channel layout: the na IoU channels come FIRST, then
+            # the na*(5+class_num) box channels (yolo_box kernel)
+            iou_p = jax.nn.sigmoid(xv[:, :na].reshape(n, na, h, w))
+            xv = xv[:, na:]
+        xv = xv.reshape(n, na, 5 + class_num, h, w)
         tx, ty, tw, th, obj = xv[:, :, 0], xv[:, :, 1], xv[:, :, 2], xv[:, :, 3], xv[:, :, 4]
         cls = xv[:, :, 5:]
         gx = jax.lax.broadcasted_iota(jnp.float32, (n, na, h, w), 3)

@@ -221,3 +221,22 @@ def test_yolo_box_decode():
         paddle.Tensor(a), paddle.Tensor(s), anchors=[8, 8, 16, 16],
         class_num=cls_n, conf_thresh=0.1, downsample_ratio=16)[0]._value)
     np.testing.assert_allclose(np.asarray(jitted(x, img)), b, atol=1e-5)
+
+
+def test_yolo_box_iou_aware_layout():
+    """iou_aware=True: the na IoU channels come FIRST in C (reference
+    kernel layout); conf = obj^(1-f) * iou^f."""
+    import paddle_tpu.vision.ops as V
+
+    n, na, cls_n, h, w = 1, 2, 2, 2, 2
+    c = na + na * (5 + cls_n)
+    x = np.zeros((n, c, h, w), np.float32)
+    x[:, :na] = 100.0  # iou logits -> sigmoid ~ 1.0
+    img = np.array([[32, 32]], np.int32)
+    _, scores = V.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), anchors=[8, 8, 16, 16],
+        class_num=cls_n, conf_thresh=0.1, downsample_ratio=16,
+        iou_aware=True, iou_aware_factor=0.5,
+    )
+    # conf = 0.5^0.5 * 1^0.5 ~ 0.7071; score = sigmoid(0)*conf ~ 0.3536
+    np.testing.assert_allclose(np.asarray(scores._value)[0, 0], 0.3536 * np.ones(cls_n), atol=2e-3)
