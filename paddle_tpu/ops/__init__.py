@@ -13,6 +13,13 @@ ships two implementations:
 
 Dispatch is `use_pallas()`: TPU backend by default, overridable via the flag
 `FLAGS_use_pallas` (paddle_tpu.set_flags) for A/B benchmarking.
+
+This library also plays the role of the reference's KPS tier
+(paddle/phi/kernels/primitive/, Backend::KPS — the "write once, run
+per-backend" kernel-authoring primitives): Pallas IS the portable
+kernel-authoring layer on the XLA stack (same kernel source lowers to TPU
+Mosaic or interpret-mode CPU; GPU Triton lowering exists upstream), so no
+separate primitive API is reproduced.
 """
 
 from __future__ import annotations

@@ -249,3 +249,30 @@ def test_program_dce_pass():
         np.testing.assert_allclose(out[0], 3 * np.ones(4, np.float32))
     finally:
         paddle.disable_static()
+
+
+def test_bert_jit_save_predictor_roundtrip(tmp_path):
+    """Serving integration: jit.save a BERT classifier -> inference
+    Predictor reproduces eager logits (reference save_inference_model +
+    AnalysisPredictor path)."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models import BertForSequenceClassification, bert_tiny
+    import paddle_tpu.jit as jit
+
+    paddle.seed(0)
+    m = BertForSequenceClassification(bert_tiny(), num_classes=2)
+    m.eval()
+    ids = np.random.default_rng(0).integers(1, 1000, (2, 16)).astype(np.int32)
+    with paddle.no_grad():
+        ref = np.asarray(m(paddle.to_tensor(ids))._value)
+
+    path = str(tmp_path / "bert_clf")
+    jit.save(m, path, input_spec=[static.InputSpec([2, 16], "int32", "ids")])
+    cfg = Config(path + ".pdmodel", path + ".pdparams")
+    pred = create_predictor(cfg)
+    in_names = pred.get_input_names()
+    h = pred.get_input_handle(in_names[0])
+    h.copy_from_cpu(ids)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
