@@ -247,3 +247,34 @@ def test_vpp_ragged_microbatch_count():
     )
     out = stack(paddle.to_tensor(x_np))
     np.testing.assert_allclose(np.asarray(out._value), np.asarray(h._value), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_1f1b_memory_profile_below_fthenb():
+    """The 1F1B schedule's bounded-activation claim, measured: XLA's memory
+    analysis of the compiled backward shows smaller temp usage than FThenB
+    at high microbatch count (per-tick remat stores boundary activations
+    only; FThenB stores every stage's internals)."""
+    import jax
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    H, M = 256, 32
+    temps = {}
+    for sched in ("FThenB", "1F1B"):
+        paddle.seed(0)
+        stack = PipelineStack(
+            _blocks(4, H, seed=9), mesh, pp_axis="pp",
+            num_microbatches=M, schedule=sched,
+        )
+        stack._bcast_template = []
+        fn = stack._make_fn(M)
+        params = [p._value for p in stack.stacked_parameters()]
+        x = jnp.zeros((M, 4, H), jnp.float32)
+
+        def loss(params_, xv):
+            out = fn(*params_, xv)
+            return (out * out).sum()
+
+        g = jax.jit(jax.grad(loss))
+        temps[sched] = g.lower(params, x).compile().memory_analysis().temp_size_in_bytes
+    assert temps["1F1B"] < 0.75 * temps["FThenB"], temps
