@@ -104,3 +104,5 @@ class ViterbiDecoder(Layer):
 
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)
+
+from .tokenizer import BasicTokenizer, BertTokenizer, WordPieceTokenizer  # noqa: F401,E402

@@ -1,0 +1,154 @@
+"""Tokenizers for the text pipeline.
+
+Reference: the strings tensor ops + faster_tokenizer integration
+(paddle/phi/kernels/strings/, python/paddle/incubate's faster tokenizer
+wrapping a C++ WordPiece) feeding BERT/ERNIE pipelines.
+
+TPU-native scope: tokenization is host-side data preparation (strings never
+reach the device), so a string TENSOR type adds nothing on TPU — the
+capability is the tokenizer itself producing int32 id arrays for the input
+pipeline.  BasicTokenizer + WordPieceTokenizer implement the BERT algorithm
+(lowercase/punct split, greedy longest-match-first subwords with ##
+continuation), and BertTokenizer packages them with padding/truncation into
+DataLoader-ready numpy batches.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+import numpy as np
+
+__all__ = ["BasicTokenizer", "WordPieceTokenizer", "BertTokenizer"]
+
+
+def _is_punct(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+class BasicTokenizer:
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text):
+        if self.do_lower_case:
+            text = text.lower()
+            text = "".join(
+                c for c in unicodedata.normalize("NFD", text)
+                if unicodedata.category(c) != "Mn"
+            )
+        out = []
+        word = []
+        for ch in text:
+            if ch.isspace():
+                if word:
+                    out.append("".join(word))
+                    word = []
+            elif _is_punct(ch):
+                if word:
+                    out.append("".join(word))
+                    word = []
+                out.append(ch)
+            else:
+                word.append(ch)
+        if word:
+            out.append("".join(word))
+        return out
+
+
+class WordPieceTokenizer:
+    def __init__(self, vocab, unk_token="[UNK]", max_input_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, word):
+        if len(word) > self.max_chars:
+            return [self.unk_token]
+        tokens = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            tokens.append(cur)
+            start = end
+        return tokens
+
+
+class BertTokenizer:
+    """End-to-end text -> padded int32 id batches (BERT convention:
+    [CLS] tokens [SEP], token_type, attention mask)."""
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 cls_token="[CLS]", sep_token="[SEP]", pad_token="[PAD]"):
+        if isinstance(vocab, (list, tuple)):
+            vocab = {t: i for i, t in enumerate(vocab)}
+        self.vocab = dict(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordPieceTokenizer(self.vocab, unk_token)
+        self.cls_token, self.sep_token, self.pad_token, self.unk_token = (
+            cls_token, sep_token, pad_token, unk_token,
+        )
+
+    @property
+    def vocab_size(self):
+        return len(self.vocab)
+
+    def tokenize(self, text):
+        out = []
+        for word in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(word))
+        return out
+
+    def convert_tokens_to_ids(self, tokens):
+        unk = self.vocab[self.unk_token]
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids):
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def __call__(self, texts, text_pairs=None, max_length=None, padding=True,
+                 truncation=True, return_attention_mask=True):
+        single = isinstance(texts, str)
+        texts = [texts] if single else list(texts)
+        pairs = [text_pairs] if isinstance(text_pairs, str) else (list(text_pairs) if text_pairs else None)
+        encoded, type_ids = [], []
+        for i, t in enumerate(texts):
+            toks = [self.cls_token] + self.tokenize(t) + [self.sep_token]
+            types = [0] * len(toks)
+            if pairs is not None:
+                ptoks = self.tokenize(pairs[i]) + [self.sep_token]
+                toks += ptoks
+                types += [1] * len(ptoks)
+            if truncation and max_length and len(toks) > max_length:
+                toks, types = toks[:max_length], types[:max_length]
+            encoded.append(self.convert_tokens_to_ids(toks))
+            type_ids.append(types)
+        width = max_length if (padding and max_length) else max(len(e) for e in encoded)
+        pad_id = self.vocab[self.pad_token]
+        n = len(encoded)
+        ids = np.full((n, width), pad_id, np.int32)
+        tty = np.zeros((n, width), np.int32)
+        mask = np.zeros((n, width), np.int32)
+        for i, (e, ty) in enumerate(zip(encoded, type_ids)):
+            ids[i, : len(e)] = e
+            tty[i, : len(ty)] = ty
+            mask[i, : len(e)] = 1
+        out = {"input_ids": ids, "token_type_ids": tty}
+        if return_attention_mask:
+            out["attention_mask"] = mask
+        return out

@@ -84,3 +84,34 @@ def test_gpt_trains_and_shards():
     step2 = ShardedTrainStep(m2, opt2, lambda mm, i: mm(i, labels=i)[0], mesh)
     losses2 = [float(step2(ids)) for _ in range(5)]
     np.testing.assert_allclose(losses2, losses, rtol=2e-3, atol=2e-3)
+
+
+def test_bert_tokenizer_feeds_model():
+    """WordPiece tokenizer (the strings/faster_tokenizer workload, host
+    side) feeding the BERT classifier end to end."""
+    from paddle_tpu.text import BertTokenizer
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "cat", "sat", "mat",
+             "un", "##able", "##happy", "on", "!"]
+    tok = BertTokenizer(vocab)
+    assert tok.tokenize("The cat sat!") == ["the", "cat", "sat", "!"]
+    assert tok.tokenize("unhappy") == ["un", "##happy"]
+    assert tok.tokenize("zebra") == ["[UNK]"]
+
+    enc = tok(["the cat sat on the mat", "unhappy cat"], max_length=12)
+    assert enc["input_ids"].shape == (2, 12)
+    assert enc["attention_mask"][0].sum() == 8  # CLS + 6 toks + SEP
+    # pair encoding sets token types
+    enc2 = tok("the cat", text_pairs="sat on", max_length=10)
+    assert enc2["token_type_ids"].max() == 1
+
+    cfg = bert_tiny(vocab_size=len(vocab) + 10)
+    m = BertForSequenceClassification(cfg)
+    m.eval()
+    with paddle.no_grad():
+        logits = m(
+            paddle.to_tensor(enc["input_ids"]),
+            token_type_ids=paddle.to_tensor(enc["token_type_ids"]),
+            attention_mask=paddle.to_tensor(enc["attention_mask"]),
+        )
+    assert np.isfinite(np.asarray(logits._value)).all()
