@@ -1,0 +1,59 @@
+"""LLaMA pretraining on a TPU mesh — the flagship hybrid-parallel recipe.
+
+Single chip:            python examples/pretrain_llama.py
+Virtual 8-device mesh:  python examples/pretrain_llama.py --virtual-mesh
+Real pod: run one process per host under `python -m paddle_tpu.distributed.launch`.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual-mesh", action="store_true", help="8 virtual CPU devices (dp2 x pp2 x mp2)")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    if args.virtual_mesh:
+        import os
+
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny, pipeline_llama, shard_llama
+
+    paddle.seed(0)
+    cfg = llama_tiny(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (8, 64)).astype(np.int32))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (8, 64)).astype(np.int32))
+
+    if args.virtual_mesh and jax.device_count() >= 8:
+        from paddle_tpu.distributed import ProcessMesh, ShardedTrainStep
+
+        mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2), ["dp", "pp", "mp"])
+        shard_llama(model, mesh, mp_axis="mp")
+        pipeline_llama(model, mesh, pp_axis="pp", num_microbatches=2)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters(), weight_decay=0.01)
+        step = ShardedTrainStep(model, opt, lambda m, i, l: m(i, labels=l)[0], mesh)
+    else:
+        from paddle_tpu.jit import TrainStep
+
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters(), weight_decay=0.01)
+        step = TrainStep(model, opt, lambda m, i, l: m(i, labels=l)[0])
+
+    for s in range(args.steps):
+        loss = step(ids, labels)
+        print(f"step {s}: loss {float(loss.astype('float32')):.4f}")
+
+
+if __name__ == "__main__":
+    main()

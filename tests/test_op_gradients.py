@@ -1,0 +1,48 @@
+"""Numeric-vs-analytic gradient audit over a representative op sample
+(reference OpTest.check_grad, test/legacy_test/op_test.py:2944)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.op_test import check_grad, check_output
+
+R = np.random.default_rng(0)
+
+
+GRAD_CASES = [
+    ("matmul", lambda a, b: paddle.matmul(a, b), (R.standard_normal((3, 4)), R.standard_normal((4, 2)))),
+    ("add_bcast", lambda a, b: a + b, (R.standard_normal((3, 4)), R.standard_normal((4,)))),
+    ("mul", lambda a, b: a * b, (R.standard_normal((3, 3)), R.standard_normal((3, 3)))),
+    ("tanh", lambda a: paddle.tanh(a), (R.standard_normal((5,)),)),
+    ("sigmoid", lambda a: paddle.nn.functional.sigmoid(a), (R.standard_normal((5,)),)),
+    ("softmax", lambda a: paddle.nn.functional.softmax(a, axis=-1), (R.standard_normal((2, 6)),)),
+    ("mean", lambda a: a.mean(), (R.standard_normal((4, 4)),)),
+    ("logsumexp", lambda a: paddle.logsumexp(a), (R.standard_normal((6,)),)),
+    ("layer_norm_fn", lambda a: paddle.nn.functional.layer_norm(a, (6,)), (R.standard_normal((3, 6)),)),
+    ("gelu", lambda a: paddle.nn.functional.gelu(a), (R.standard_normal((5,)),)),
+    ("exp", lambda a: paddle.exp(a), (0.3 * R.standard_normal((4,)),)),
+    ("sqrt", lambda a: paddle.sqrt(a), (np.abs(R.standard_normal((4,))) + 0.5,)),
+    ("transpose_reshape", lambda a: paddle.reshape(paddle.transpose(a, [1, 0]), [-1]) * 2.0, (R.standard_normal((3, 4)),)),
+    ("concat", lambda a, b: paddle.concat([a, b], axis=0).sum(axis=0), (R.standard_normal((2, 3)), R.standard_normal((2, 3)))),
+    ("gather", lambda a: paddle.gather(a, paddle.to_tensor(np.array([2, 0], np.int32))), (R.standard_normal((4, 3)),)),
+    ("masked_scatter", lambda a, v: paddle.masked_scatter(a, paddle.to_tensor(np.array([True, False, True, False])), v), (R.standard_normal((4,)), R.standard_normal((4,)))),
+    ("where", lambda a, b: paddle.where(paddle.to_tensor(np.array([True, False, True])), a, b), (R.standard_normal((3,)), R.standard_normal((3,)))),
+    ("maximum", lambda a, b: paddle.maximum(a, b), (R.standard_normal((4,)), R.standard_normal((4,)) + 2.0)),
+    ("pow", lambda a: paddle.pow(a, 3.0), (np.abs(R.standard_normal((4,))) + 0.5,)),
+    ("cross_entropy", lambda a: paddle.nn.functional.cross_entropy(a, paddle.to_tensor(np.array([1, 0], np.int32))), (R.standard_normal((2, 4)),)),
+]
+
+
+@pytest.mark.parametrize("name,fn,arrays", GRAD_CASES, ids=[c[0] for c in GRAD_CASES])
+def test_numeric_vs_analytic_grad(name, fn, arrays):
+    check_grad(fn, *arrays)
+
+
+def test_check_output_utility():
+    check_output(
+        lambda a, b: paddle.matmul(a, b),
+        lambda a, b: a @ b,
+        R.standard_normal((3, 4)).astype(np.float32),
+        R.standard_normal((4, 2)).astype(np.float32),
+    )
