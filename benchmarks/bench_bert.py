@@ -1,0 +1,56 @@
+"""BERT-base finetune throughput (BASELINE.md row 3)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    on_accel = jax.devices()[0].platform != "cpu"
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import BertConfig, BertForSequenceClassification, bert_tiny
+
+    paddle.seed(0)
+    cfg = BertConfig(num_hidden_layers=12) if on_accel else bert_tiny()
+    B, S = (32, 128) if on_accel else (4, 32)
+    iters = 10 if on_accel else 2
+    import contextlib
+
+    cpu = None
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        pass
+    with (jax.default_device(cpu) if cpu else contextlib.nullcontext()):
+        model = BertForSequenceClassification(cfg)
+    opt = paddle.optimizer.AdamW(2e-5, parameters=model.parameters())
+    step = TrainStep(model, opt, lambda m, i, y: m(i, labels=y)[0])
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32))
+    y = paddle.to_tensor(rng.integers(0, 2, (B,)).astype(np.int32))
+    step(ids, y)
+    step(ids, y)._value.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, y)
+    loss._value.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bert_finetune_tokens_per_sec",
+        "value": round(B * S * iters / dt, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

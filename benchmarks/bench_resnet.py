@@ -1,0 +1,60 @@
+"""ResNet-50 ImageNet-shape training throughput (BASELINE.md row 2).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} like
+bench.py; vs_baseline tracks images/sec against the Paddle-on-A100
+reference point once recorded (none published in-repo — BASELINE.md)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    on_accel = jax.devices()[0].platform != "cpu"
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50, resnet18
+
+    paddle.seed(0)
+    cpu = None
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        pass
+    import contextlib
+
+    with (jax.default_device(cpu) if cpu else contextlib.nullcontext()):
+        model = resnet50() if on_accel else resnet18()
+    B, H = (64, 224) if on_accel else (4, 64)
+    iters = 10 if on_accel else 2
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    step = TrainStep(model, opt, lambda m, x, y: ce(m(x), y))
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((B, 3, H, H)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int32))
+    step(x, y)
+    step(x, y)._value.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss._value.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "resnet_train_images_per_sec",
+        "value": round(B * iters / dt, 2),
+        "unit": "images/s",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
