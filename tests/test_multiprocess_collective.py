@@ -1,0 +1,72 @@
+"""REAL multi-controller collectives: 2 local processes, jax.distributed +
+gloo CPU collectives, the eager ProcessGroup ring (reference test pattern:
+TestDistBase/start_local_trainers spawning workers over localhost NCCL,
+test/legacy_test/test_dist_base.py:962)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    rank, world, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port, num_processes=world, process_id=rank
+    )
+    sys.path.insert(0, "__REPO__")
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.collective import ProcessGroup
+
+    pg = ProcessGroup()
+    assert pg.nranks == world
+    t = pg.allreduce(jnp.full((4,), float(rank + 1), jnp.float32))
+    t.wait()
+    assert np.allclose(np.asarray(t.result()), sum(range(1, world + 1)))
+    g = np.asarray(pg.allgather(jnp.full((2,), float(rank), jnp.float32)).result())
+    assert np.allclose(g[:, 0], np.arange(world))
+    b = np.asarray(pg.broadcast(jnp.full((2,), float(rank), jnp.float32), src=1).result())
+    assert np.allclose(b, 1.0)
+    # executable cache reuse across calls: repeating a shape adds no entry
+    before = pg.cache_size()
+    pg.allreduce(jnp.ones((4,), jnp.float32)).wait()
+    assert pg.cache_size() == before, (before, pg.cache_size())
+    print("rank " + str(rank) + " OK", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_process_group(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.replace("__REPO__", repo))
+    world, port = 2, "29751"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers manage their own platform config
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append((p.returncode, out))
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+    assert any("rank 0 OK" in o for _, o in outs)
+    assert any("rank 1 OK" in o for _, o in outs)
