@@ -38,6 +38,40 @@ _WORKER = textwrap.dedent(
     before = pg.cache_size()
     pg.allreduce(jnp.ones((4,), jnp.float32)).wait()
     assert pg.cache_size() == before, (before, pg.cache_size())
+
+    # the public communication API routes its multi-process branch here
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    t = paddle.to_tensor(np.full(3, float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    assert np.allclose(np.asarray(t._value), 3.0)
+
+    # Fleet-style imperative multi-controller DP: each rank computes grads
+    # on its batch shard, grad-allreduce(avg), identical updates everywhere
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)  # same init on both ranks
+    model = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    rng = np.random.default_rng(rank)  # DIFFERENT data per rank
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((8, 1), np.float32))
+    losses = []
+    for step in range(4):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        for p in model.parameters():
+            dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._value))
+    # weights must be bit-identical across ranks after synced updates
+    wsum = float(np.asarray(model.parameters()[0]._value).sum())
+    t2 = paddle.to_tensor(np.full(1, wsum, np.float32))
+    dist.all_reduce(t2, op=dist.ReduceOp.MAX)
+    assert abs(float(t2._value[0]) - wsum) < 1e-6, "weights diverged across ranks"
+    assert losses[-1] < losses[0]
     print("rank " + str(rank) + " OK", flush=True)
     """
 )
