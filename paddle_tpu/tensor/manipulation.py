@@ -490,14 +490,34 @@ def repeat_interleave(x, repeats, axis=None, name=None):
     )
 
 
-def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None, size=None, fill_value=None):
+    """Unique values (reference paddle.unique).  TPU extension beyond the
+    reference: pass `size=N` (a static bound on the unique count) to make
+    the op jit-traceable — outputs are padded to N with `fill_value`
+    (default: the max value), the jnp.unique(size=...) contract."""
     x = ensure_tensor(x)
+    if size is not None:
+        if axis is not None:
+            raise ValueError("unique(size=...) supports axis=None only")
+
+        def _u(v):
+            flat = v.reshape(-1)
+            res = jnp.unique(
+                flat, return_index=return_index, return_inverse=return_inverse,
+                return_counts=return_counts, size=int(size), fill_value=fill_value,
+            )
+            return res if isinstance(res, tuple) else (res,)
+
+        outs = apply("unique", _u, x)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return outs[0] if len(outs) == 1 else tuple(outs)
     from paddle_tpu.tensor._ops_common import reject_tracers
 
     reject_tracers(
         "unique",
-        "The number of unique values is data-dependent; sort + compare "
-        "neighbors (static shape) or run unique outside the compiled region.",
+        "The number of unique values is data-dependent; pass size=N (static "
+        "bound, padded outputs) to run under jit, or run unique outside the "
+        "compiled region.",
         x,
     )
     res = np.unique(

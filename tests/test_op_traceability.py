@@ -152,3 +152,24 @@ def test_dynamic_op_raises_documented_error(name, fn, inputs):
     # traced raises the documented error
     with pytest.raises(DynamicShapeError):
         _run_jitted(fn, inputs)
+
+
+def test_unique_with_static_size_traces():
+    """TPU extension: unique(size=N) is jit-traceable with padded outputs."""
+    x = np.array([3, 1, 3, 2, 1], np.int32)
+
+    def fn(v):
+        u = paddle.unique(Tensor(v), size=5)
+        return u._value
+
+    out = jax.jit(fn)(jnp.asarray(x))
+    got = np.asarray(out)
+    assert set(got[:3].tolist()) == {1, 2, 3}
+    assert got.shape == (5,)  # padded to the static bound
+    # inverse under jit too
+    def fn2(v):
+        u, inv = paddle.unique(Tensor(v), return_inverse=True, size=5)
+        return u._value, inv._value
+
+    u2, inv = jax.jit(fn2)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(u2)[np.asarray(inv).reshape(-1)], x)
