@@ -36,15 +36,21 @@ class ProcessMesh:
         if dim_names is None:
             dim_names = [f"d{i}" for i in range(arr.ndim)]
         self._dim_names = list(dim_names)
-        devices = np.asarray(jax.devices(), dtype=object)
-        dev_by_id = {int(getattr(d, "id", i)): d for i, d in enumerate(devices)}
+        devices = list(jax.devices())
         try:
-            dev_arr = np.array([dev_by_id[i] for i in self._ids], dtype=object).reshape(self._shape)
+            if all(0 <= i < len(devices) for i in self._ids):
+                # paddle ProcessMesh ids are LOGICAL ranks: index positionally
+                # into the global device order (multi-host global device ids
+                # are not contiguous — e.g. cpu procs offset by 2048)
+                dev_arr = np.array([devices[i] for i in self._ids], dtype=object).reshape(self._shape)
+            else:
+                dev_by_id = {int(getattr(d, "id", i)): d for i, d in enumerate(devices)}
+                dev_arr = np.array([dev_by_id[i] for i in self._ids], dtype=object).reshape(self._shape)
             self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
         except KeyError:
-            # Process ids beyond local devices (multi-host spec written on one
-            # host): keep the logical mesh; jax_mesh resolves lazily when the
-            # full device set is visible.
+            # Process ids beyond the visible device set (multi-host spec
+            # written on one host): keep the logical mesh; jax_mesh resolves
+            # lazily when the full device set is visible.
             self._jax_mesh = None
 
     # ------------------------------------------------------------ properties
