@@ -6,7 +6,11 @@ import json
 import sys
 import time
 
+import os
+
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -33,7 +37,12 @@ def main():
     with (jax.default_device(cpu) if cpu else contextlib.nullcontext()):
         model = BertForSequenceClassification(cfg)
     opt = paddle.optimizer.AdamW(2e-5, parameters=model.parameters())
-    step = TrainStep(model, opt, lambda m, i, y: m(i, labels=y)[0])
+
+    def loss_fn(m, i, y):
+        with paddle.amp.auto_cast(enable=on_accel):
+            return m(i, labels=y)[0]
+
+    step = TrainStep(model, opt, loss_fn)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32))
     y = paddle.to_tensor(rng.integers(0, 2, (B,)).astype(np.int32))

@@ -10,7 +10,11 @@ import json
 import sys
 import time
 
+import os
+
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -37,7 +41,14 @@ def main():
     iters = 10 if on_accel else 2
     opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
     ce = paddle.nn.CrossEntropyLoss()
-    step = TrainStep(model, opt, lambda m, x, y: ce(m(x), y))
+
+    def loss_fn(m, x, y):
+        # AMP O1 (bf16 matmul/conv inputs, fp32 loss) — the config the
+        # reference's A100 ResNet baseline uses (fp16 AMP there).
+        with paddle.amp.auto_cast(enable=on_accel):
+            return ce(m(x), y)
+
+    step = TrainStep(model, opt, loss_fn)
     rng = np.random.default_rng(0)
     x = paddle.to_tensor(rng.standard_normal((B, 3, H, H)).astype(np.float32))
     y = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int32))

@@ -4,9 +4,14 @@ Reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h and
 python/paddle/distributed/auto_parallel/process_mesh.py:71.
 
 TPU-native: thin wrapper around jax.sharding.Mesh.  The reference's "process
-ids" become jax device ids; dim_names are the mesh axis names used by
-PartitionSpec / shard_map collectives.  A global default mesh (context
-manager) mirrors the reference's auto_parallel default-mesh stack.
+ids" are LOGICAL ranks: id i selects the i-th device of jax.devices() (global
+device order), NOT the device whose .id equals i — multi-host global device
+ids are non-contiguous (e.g. per-process offsets of 2048 on CPU), so only
+positional indexing gives every process the same mesh.  Ids outside
+range(len(jax.devices())) fall back to lookup by literal device .id.
+dim_names are the mesh axis names used by PartitionSpec / shard_map
+collectives.  A global default mesh (context manager) mirrors the
+reference's auto_parallel default-mesh stack.
 """
 
 from __future__ import annotations

@@ -82,7 +82,12 @@ def test_two_process_process_group(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
     script.write_text(_WORKER.replace("__REPO__", repo))
-    world, port = 2, "29751"
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+    world, port = 2, str(free_port)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers manage their own platform config
     procs = [
@@ -145,7 +150,12 @@ def test_two_process_global_mesh_spmd_training(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "spmd_worker.py"
     script.write_text(_SPMD_WORKER.replace("__REPO__", repo))
-    world, port = 2, "29791"
+    import socket
+
+    with socket.socket() as s:  # grab a free port; stale 29791 binds hung this test
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+    world, port = 2, str(free_port)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     procs = [
