@@ -122,3 +122,31 @@ try:
 except ImportError:
     pass
 from .static.program import enable_static, disable_static, in_dynamic_mode  # noqa: F401,E402
+
+# Framework defaults / dtype info / compat surface (reference top-level names)
+from .framework.defaults import (  # noqa: F401,E402
+    LazyGuard,
+    batch,
+    check_shape,
+    create_parameter,
+    disable_signal_handler,
+    finfo,
+    get_default_dtype,
+    iinfo,
+    set_default_dtype,
+    set_printoptions,
+)
+from ._core.place import CUDAPinnedPlace, CUDAPlace  # noqa: F401,E402
+from .nn.layer.layers import ParamAttr  # noqa: F401,E402
+from .distributed import DataParallel  # noqa: F401,E402
+
+# CUDA-named RNG state APIs are the generic device generator state here.
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def tolist(x):
+    """paddle.tolist parity: nested Python list of the tensor's values."""
+    from ._core.tensor import Tensor
+
+    return x.tolist() if isinstance(x, Tensor) else Tensor(x).tolist()

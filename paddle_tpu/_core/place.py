@@ -16,6 +16,8 @@ __all__ = [
     "Place",
     "TPUPlace",
     "CPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
     "CustomPlace",
     "set_device",
     "get_device",
@@ -78,6 +80,23 @@ class CustomPlace(Place):
     def __init__(self, device_type: str, device_id: int = 0):
         super().__init__(device_id)
         self.device_type = device_type
+
+
+class CUDAPlace(Place):
+    """API-compat alias: reference code written against paddle.CUDAPlace(i)
+    (paddle/phi/common/place.h GPUPlace) runs unchanged — the i-th
+    accelerator here is the i-th device of the default (TPU) backend."""
+
+    device_type = "accel"
+
+    def jax_device(self) -> jax.Device:
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """API-compat alias: pinned host memory is a CUDA-transfer concept; on
+    TPU/PJRT host staging is managed by the runtime, so this is CPUPlace."""
 
 
 def _accel_type() -> str:

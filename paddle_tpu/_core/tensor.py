@@ -328,6 +328,20 @@ class Parameter(Tensor):
         self.is_distributed = False
         self.persistable = True
 
+    def initialize(self):
+        """Materialize this parameter on the default accelerator.
+
+        Reference: EagerParamBase.initialize() after paddle.LazyGuard.  Under
+        LazyGuard params live in host RAM (jax.default_device(cpu)); this
+        pushes the value to the accelerator — or, if the param was given a
+        sharding via shard_tensor first, to its sharded placement.
+        """
+        v = self._value
+        if hasattr(v, "sharding") and getattr(v, "_committed", False):
+            return self  # already placed deliberately
+        self._bind(jax.device_put(v, jax.devices()[0]))
+        return self
+
 
 def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)

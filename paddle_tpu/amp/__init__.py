@@ -239,3 +239,18 @@ def _is_tracer(x):
     import jax
 
     return isinstance(x, jax.core.Tracer)
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native compute dtype (MXU); always true, and true on
+    the XLA CPU backend too (reference: paddle.amp.is_bfloat16_supported)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """fp16 storage/compute is supported by XLA on TPU, though bf16 is
+    preferred (no loss scaling needed)."""
+    return True
+
+
+__all__ += ["is_bfloat16_supported", "is_float16_supported"]

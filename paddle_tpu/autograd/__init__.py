@@ -10,6 +10,34 @@ from paddle_tpu._core.autograd import (  # noqa: F401
 )
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from . import functional  # noqa: F401
+from .functional import hessian, jacobian  # noqa: F401
+
+_saved_tensor_hooks_stack = []
+
+
+def _current_saved_tensor_hooks():
+    return _saved_tensor_hooks_stack[-1] if _saved_tensor_hooks_stack else None
+
+
+class saved_tensors_hooks:
+    """Pack/unpack hooks for tensors saved by PyLayer.save_for_backward
+    (reference: python/paddle/autograd/saved_tensors_hooks.py).
+
+    The hook pair active at save time is captured with the saved tensors and
+    applied on retrieval — the reference's offload-to-host use case.  Inside
+    a compiled TrainStep, activation residency is XLA's job; use
+    paddle.distributed.fleet.recompute / jax.checkpoint there instead.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook, self.unpack_hook = pack_hook, unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks_stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks_stack.pop()
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):

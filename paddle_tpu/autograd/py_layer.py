@@ -23,14 +23,28 @@ class PyLayerContext:
         self._materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        from . import _current_saved_tensor_hooks
+
+        hooks = _current_saved_tensor_hooks()
+        if hooks is not None:
+            self._saved = tuple(hooks[0](t) for t in tensors)
+            self._saved_unpack = hooks[1]  # pair captured at save time
+        else:
+            self._saved = tuple(tensors)
+            self._saved_unpack = None
+
+    def _unpacked(self):
+        unpack = getattr(self, "_saved_unpack", None)
+        if unpack is not None:
+            return tuple(unpack(t) for t in self._saved)
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
     def mark_not_inplace(self, *args):
         self.not_inplace_tensors = args

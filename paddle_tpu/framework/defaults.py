@@ -1,0 +1,203 @@
+"""Framework-level defaults and dtype-info utilities.
+
+Reference surface: paddle.get_default_dtype / set_default_dtype
+(python/paddle/base/framework.py), paddle.finfo / paddle.iinfo
+(paddle/fluid/pybind/pybind.cc finfo/iinfo bindings), paddle.set_printoptions
+(python/paddle/tensor/to_string.py), paddle.batch (python/paddle/batch.py),
+paddle.check_shape (python/paddle/base/data_feeder.py:227),
+paddle.disable_signal_handler.
+
+TPU-native: the default dtype is the existing FLAGS_default_dtype flag (one
+source of truth with the creation ops); finfo/iinfo delegate to ml_dtypes via
+jnp so bfloat16/float8 variants are covered, which numpy alone is not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu._core import flags as _flags
+from paddle_tpu._core.dtype import to_jax_dtype
+
+__all__ = [
+    "get_default_dtype",
+    "set_default_dtype",
+    "finfo",
+    "iinfo",
+    "set_printoptions",
+    "batch",
+    "check_shape",
+    "disable_signal_handler",
+]
+
+
+def get_default_dtype():
+    """Default float dtype used by creation ops when dtype=None."""
+    return str(_flags.flag("FLAGS_default_dtype"))
+
+
+def set_default_dtype(d):
+    jd = to_jax_dtype(d)  # framework-wide width policy: float64 narrows to float32
+    name = jnp.dtype(jd).name
+    if name not in ("float16", "bfloat16", "float32"):
+        raise TypeError(f"set_default_dtype only supports float dtypes, got {d}")
+    _flags.set_flags({"FLAGS_default_dtype": name})
+
+
+class finfo:
+    """Floating-point type info (paddle.finfo parity: eps/min/max/tiny/
+    smallest_normal/resolution/bits/dtype fields)."""
+
+    def __init__(self, dtype):
+        fi = jnp.finfo(to_jax_dtype(dtype))
+        self.dtype = str(np.dtype(fi.dtype).name) if fi.dtype != jnp.bfloat16 else "bfloat16"
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+        self.bits = int(fi.bits)
+
+    def __repr__(self):
+        return (
+            f"finfo(resolution={self.resolution}, min={self.min}, max={self.max}, "
+            f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})"
+        )
+
+
+class iinfo:
+    """Integer type info (paddle.iinfo parity: min/max/bits/dtype)."""
+
+    def __init__(self, dtype):
+        ii = jnp.iinfo(to_jax_dtype(dtype))
+        self.dtype = str(np.dtype(ii.dtype).name)
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+        self.bits = int(ii.bits)
+
+    def __repr__(self):
+        return f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, dtype={self.dtype})"
+
+
+_print_opts = {}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, sci_mode=None, linewidth=None):
+    """Tensor print formatting (paddle.set_printoptions parity); backed by
+    numpy printoptions since Tensor.__repr__ renders via numpy."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+        _print_opts["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+        _print_opts["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+        _print_opts["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+        _print_opts["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+        _print_opts["sci_mode"] = bool(sci_mode)
+    np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batched-reader decorator (reference: python/paddle/batch.py): wraps a
+    sample generator factory into a mini-batch generator factory."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == int(batch_size):
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    if int(batch_size) <= 0:
+        raise ValueError("batch_size should be a positive integer")
+    return batch_reader
+
+
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple), expected_element_type=(int,), expected_tensor_dtype=("int32", "int64")):
+    """Static-graph shape-argument validation (reference:
+    python/paddle/base/data_feeder.py:227).  Accepts list/tuple of ints or a
+    1-D integer Tensor; raises TypeError otherwise."""
+    from paddle_tpu._core.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        if str(shape.dtype).split(".")[-1] not in expected_tensor_dtype:
+            raise TypeError(f"{op_name}: shape tensor dtype must be one of {expected_tensor_dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"{op_name}: shape must be {expected_shape_type}, got {type(shape)}")
+    for item in shape:
+        if isinstance(item, Tensor):
+            continue
+        if not isinstance(item, expected_element_type) or isinstance(item, bool):
+            raise TypeError(f"{op_name}: shape element must be {expected_element_type}, got {type(item)}")
+
+
+def disable_signal_handler():
+    """API-compat: the reference uninstalls its C++ fault signal handlers
+    (paddle/fluid/platform/init.cc).  This runtime installs none — XLA/PJRT
+    handle their own — so there is nothing to disable."""
+    return None
+
+
+class LazyGuard:
+    """Deferred parameter materialization (reference:
+    python/paddle/nn/initializer/lazy_init.py:91 LazyGuard).
+
+    The reference builds layers with zero-memory params and materializes via
+    param.initialize().  TPU-native equivalent: inside the guard all arrays
+    (including initializer outputs) are created on the HOST cpu backend —
+    no HBM is touched — and Parameter.initialize() (or the first compiled
+    step, which device_puts its donated state) moves them to the chip,
+    optionally through a sharding.  This is the host-init + shard-on-entry
+    pattern large-model JAX code uses.
+    """
+
+    def __init__(self):
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+
+        self._ctx = jax.default_device(jax.devices("cpu")[0])
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        ctx, self._ctx = self._ctx, None
+        return ctx.__exit__(*exc)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """Standalone parameter factory (reference: paddle.create_parameter,
+    python/paddle/tensor/creation.py)."""
+    from paddle_tpu._core.dtype import to_jax_dtype
+    from paddle_tpu._core.tensor import Parameter
+    from paddle_tpu.nn import initializer as I
+    from paddle_tpu.nn.layer.layers import ParamAttr
+
+    attr = ParamAttr._to_attr(attr)
+    # precedence: explicit ParamAttr > set_global_initializer > layer default
+    init = attr.initializer or I._default_init(is_bias) or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    value = init._init_value(tuple(int(s) for s in shape), to_jax_dtype(dtype))
+    p = Parameter(value, trainable=attr.trainable, name=name or attr.name or "")
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p
+
+
+__all__ += ["LazyGuard", "create_parameter"]

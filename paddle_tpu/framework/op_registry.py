@@ -134,6 +134,17 @@ _INPLACE_BASES = [
     "add", "subtract", "multiply", "divide", "remainder", "clip", "scale",
     "exp", "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "tanh",
     "abs", "neg",
+    # full reference in-place tier (python/paddle/__init__.py `*_` exports)
+    "acos", "asin", "atan", "atanh", "asinh", "acosh", "cos", "cosh", "sin",
+    "sinh", "tan", "erf", "expm1", "log", "log2", "log10", "log1p", "logit",
+    "lgamma", "digamma", "multigammaln", "polygamma", "i0", "frac", "trunc",
+    "square", "nan_to_num", "hypot", "ldexp", "gcd", "lcm", "addmm",
+    "cumsum", "cumprod", "renorm", "index_fill", "masked_scatter",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "floor_divide", "floor_mod", "mod", "tril", "triu",
+    "pow", "lerp", "fill_diagonal", "put_along_axis", "index_add",
 ]
 
 

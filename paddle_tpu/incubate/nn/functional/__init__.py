@@ -338,3 +338,157 @@ def fused_ec_moe(x, gate_weight, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
         return jnp.einsum("bsed,bse->bsd", eo.astype(jnp.float32), probs).astype(xv.dtype)
 
     return apply("fused_ec_moe", _fn, *args)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None, dropout_rate=0.0, attn_dropout_rate=0.0, ln_epsilon=1e-5, training=True, mode="upscale_in_train", ring_id=-1, add_residual=True, num_heads=-1, transpose_qkv_wb=False, name=None):
+    """One-call MHA block (reference:
+    python/paddle/incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention): [pre-LN] -> qkv matmul -> attention ->
+    out proj -> [residual add] -> [post-LN].  XLA fuses the epilogues; the
+    attention core is scaled_dot_product_attention (flash kernel on TPU)."""
+    import paddle_tpu.nn.functional as NF
+    from paddle_tpu.tensor import linalg as L
+    from paddle_tpu.tensor import manipulation as M
+    from paddle_tpu.tensor import math as TM
+
+    x = ensure_tensor(x)
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = NF.layer_norm(x, x.shape[-1:], weight=pre_ln_scale, bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    qkvw = ensure_tensor(qkv_weight)
+    B, S, E = x.shape
+    if transpose_qkv_wb:
+        # weight [E, 3*E]
+        if num_heads <= 0:
+            raise ValueError("transpose_qkv_wb=True requires num_heads > 0")
+        qkv = L.matmul(x, qkvw)
+        nh = num_heads
+        hd = E // nh
+        qkv = M.reshape(qkv, [B, S, 3, nh, hd])
+    else:
+        # weight [3, n_heads, head_dim, E]
+        nh, hd = qkvw.shape[1], qkvw.shape[2]
+        w2 = M.reshape(qkvw, [3 * nh * hd, E])
+        qkv = L.matmul(x, M.transpose(w2, [1, 0]))
+        qkv = M.reshape(qkv, [B, S, 3, nh, hd])
+    if qkv_bias is not None:
+        qkv = TM.add(qkv, M.reshape(ensure_tensor(qkv_bias), [1, 1, 3, nh, hd]))
+    q = M.squeeze(M.slice(qkv, [2], [0], [1]), [2])
+    k = M.squeeze(M.slice(qkv, [2], [1], [2]), [2])
+    v = M.squeeze(M.slice(qkv, [2], [2], [3]), [2])
+    out = NF.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate if training else 0.0, is_causal=False, training=training)
+    out = M.reshape(out, [B, S, nh * hd])
+    out = L.matmul(out, ensure_tensor(linear_weight))
+    if linear_bias is not None:
+        out = TM.add(out, ensure_tensor(linear_bias))
+    if dropout_rate:
+        out = NF.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = TM.add(residual, out)
+    if not pre_layer_norm and ln_scale is not None:
+        out = NF.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None, linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None, ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5, pre_layer_norm=False, training=True, mode="upscale_in_train", ring_id=-1, add_residual=True, name=None):
+    """Reference: fused_feedforward — [pre-LN] -> linear1 -> act -> dropout ->
+    linear2 -> dropout -> residual -> [post-LN]."""
+    import paddle_tpu.nn.functional as NF
+    from paddle_tpu.tensor import linalg as L
+    from paddle_tpu.tensor import math as TM
+
+    x = ensure_tensor(x)
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = NF.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias, epsilon=ln1_epsilon)
+    h = L.matmul(x, ensure_tensor(linear1_weight))
+    if linear1_bias is not None:
+        h = TM.add(h, ensure_tensor(linear1_bias))
+    h = getattr(NF, activation)(h)
+    if dropout1_rate:
+        h = NF.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = L.matmul(h, ensure_tensor(linear2_weight))
+    if linear2_bias is not None:
+        h = TM.add(h, ensure_tensor(linear2_bias))
+    if dropout2_rate:
+        h = NF.dropout(h, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = TM.add(residual, h)
+    if not pre_layer_norm and ln2_scale is not None:
+        h = NF.layer_norm(h, h.shape[-1:], weight=ln2_scale, bias=ln2_bias, epsilon=ln2_epsilon)
+    return h
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None, ln_bias=None, dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train", name=None):
+    """Reference: fused_bias_dropout_residual_layer_norm — (x+bias) ->
+    dropout -> +residual -> LN; the canonical transformer epilogue."""
+    import paddle_tpu.nn.functional as NF
+    from paddle_tpu.tensor import math as TM
+
+    x, residual = ensure_tensor(x), ensure_tensor(residual)
+    if bias is not None:
+        x = TM.add(x, ensure_tensor(bias))
+    if dropout_rate:
+        x = NF.dropout(x, dropout_rate, training=training, mode=mode)
+    out = TM.add(x, residual)
+    if ln_scale is not None:
+        out = NF.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True, epsilon=1e-05, cache_kvs=None, pre_caches=None, seq_lens=None, rotary_embs=None, time_step=None, attn_mask=None, dropout_rate=0.0, activation="gelu", training=False, mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None):
+    """Reference: fused_multi_transformer (the serving decoder stack op) —
+    applies L fused transformer layers in sequence."""
+    out = ensure_tensor(x)
+    L_layers = len(qkv_weights)
+    if not trans_qkvw:
+        # [E, 3*E]-layout weights carry no head count; the [3, nh, hd, E]
+        # layout (trans_qkvw=True, the reference default) is required here
+        raise ValueError(
+            "fused_multi_transformer requires trans_qkvw=True weights "
+            "([3, num_heads, head_dim, embed_dim]); the flat [E, 3E] layout "
+            "does not encode the head count"
+        )
+    for i in range(L_layers):
+        out = fused_multi_head_attention(
+            out,
+            qkv_weights[i],
+            linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            pre_ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask,
+            dropout_rate=dropout_rate,
+            training=training,
+            mode=mode,
+            transpose_qkv_wb=not trans_qkvw,
+            num_heads=(qkv_weights[i].shape[1] if trans_qkvw else -1),
+        )
+        out = fused_feedforward(
+            out,
+            ffn1_weights[i],
+            ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln1_epsilon=epsilon,
+            dropout1_rate=dropout_rate,
+            dropout2_rate=dropout_rate,
+            activation=activation,
+            pre_layer_norm=pre_layer_norm,
+            training=training,
+            mode=mode,
+        )
+    return out, cache_kvs
+
+
+__all__ += [
+    "fused_multi_head_attention",
+    "fused_feedforward",
+    "fused_bias_dropout_residual_layer_norm",
+    "fused_multi_transformer",
+]

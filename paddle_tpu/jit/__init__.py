@@ -68,6 +68,7 @@ class _StaticFunction:
         # if/while/and/or/not over tensors into lax control flow converters;
         # falls back to the original fn when source is unavailable.
         self._fn = ast_transform(fn)
+        self._orig_fn = fn
         self._layer = layer
         self._compiled = None
         self._train_mode = None
@@ -78,6 +79,8 @@ class _StaticFunction:
         return list(self._layer.state_dict().values())
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:  # jit.enable_to_static(False) escape hatch
+            return self._orig_fn(*args, **kwargs)
         layer = self._layer
         state = self._state_tensors()
         static_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, Tensor)}
@@ -285,3 +288,38 @@ def load(path, **configs):
     from paddle_tpu.framework.io_utils import load as fload
 
     return fload(path + ".pdparams")
+
+
+# ----------------------------------------------------------- compat surface
+# TranslatedLayer is what jit.load returns in the reference
+# (python/paddle/jit/translated_layer.py); here load() returns the Predictor
+# over the saved StableHLO artifact, so the name aliases that type for
+# isinstance checks on loaded models.
+from paddle_tpu.inference import Predictor as TranslatedLayer  # noqa: E402
+
+
+def enable_to_static(flag: bool = True):
+    """Globally toggle to_static capture (reference:
+    python/paddle/jit/api.py enable_to_static); when off, decorated functions
+    run eagerly — the debugging escape hatch."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+_to_static_enabled = True
+
+
+_dy2static_log_level = 0
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Log transformed code of dy2static (reference jit/api.py). Level > 0
+    prints the AST-transformed source when to_static compiles a function."""
+    global _dy2static_log_level
+    _dy2static_log_level = int(level)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Verbosity for dy2static logging (reference parity)."""
+    global _dy2static_log_level
+    _dy2static_log_level = max(_dy2static_log_level, int(level))

@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exporting
+tensor/linalg.py).  The implementations live in paddle_tpu.tensor.linalg."""
+
+from paddle_tpu.tensor.linalg import *  # noqa: F401,F403
+from paddle_tpu.tensor import linalg as _impl
+
+__all__ = [n for n in dir(_impl) if not n.startswith("_")]

@@ -1,7 +1,8 @@
 """paddle.nn.functional surface (reference: python/paddle/nn/functional/__init__.py)."""
 
 from .activation import *  # noqa: F401,F403
-from .attention import flash_attention, scaled_dot_product_attention, sdpa_reference  # noqa: F401
+from .attention import flash_attention, scaled_dot_product_attention, sdpa_reference, sparse_attention  # noqa: F401
+from .vision import affine_grid, grid_sample  # noqa: F401
 from .common import *  # noqa: F401,F403
 from .conv import (  # noqa: F401
     conv1d,

@@ -182,3 +182,24 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     return apply(
         "thresholded_relu", lambda v: jnp.where(v > threshold, v, jnp.asarray(value, v.dtype)), x
     )
+
+
+# in-place activation tier (reference: `*_` exports of nn.functional)
+def _act_inplace(base):
+    def fn(x, *args, **kwargs):
+        from paddle_tpu.tensor._ops_common import inplace_from
+
+        return inplace_from(x, base, *args, **kwargs)
+
+    fn.__name__ = base.__name__ + "_"
+    fn.__doc__ = f"In-place variant of {base.__name__} (rebinds the wrapper; XLA donation makes the compiled form truly in-place)."
+    return fn
+
+
+relu_ = _act_inplace(relu)
+elu_ = _act_inplace(elu)
+leaky_relu_ = _act_inplace(leaky_relu)
+hardtanh_ = _act_inplace(hardtanh)
+softmax_ = _act_inplace(softmax)
+tanh_ = _act_inplace(tanh)
+thresholded_relu_ = _act_inplace(thresholded_relu)

@@ -334,3 +334,91 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     if prior_dist is not None:
         return apply("label_smooth", _ls, label, ensure_tensor(prior_dist))
     return apply("label_smooth", _ls, label)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[..., j] = j < x[...] (reference: paddle.nn.functional.sequence_mask,
+    python/paddle/nn/functional/extension.py)."""
+    from paddle_tpu._core.dtype import to_jax_dtype
+
+    x = ensure_tensor(x)
+    if maxlen is None:
+        import numpy as np
+
+        maxlen = int(np.asarray(jnp.max(x._value)))  # data-dependent: eager only
+    m = int(maxlen)
+    dt = to_jax_dtype(dtype)
+
+    def _fn(v):
+        j = jnp.arange(m, dtype=jnp.int32)
+        return (j[None, :] < v.reshape(-1, 1).astype(jnp.int32)).reshape(*v.shape, m).astype(dt)
+
+    return apply("sequence_mask", _fn, x)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm of (x - y) along the last axis (reference:
+    python/paddle/nn/functional/distance.py)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    pf = float(p)
+
+    def _fn(a, b):
+        d = jnp.abs(a - b) + jnp.asarray(epsilon, a.dtype)
+        if pf == float("inf"):
+            out = jnp.max(d, axis=-1, keepdims=keepdim)
+        elif pf == 0.0:
+            out = jnp.sum((d != 0).astype(a.dtype), axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(d**pf, axis=-1, keepdims=keepdim) ** (1.0 / pf)
+        return out
+
+    return apply("pairwise_distance", _fn, x, y)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: paddle.nn.functional.gather_tree,
+    paddle/phi/kernels/cpu/gather_tree_kernel.cc): walk parent pointers from
+    the last step to recover full predicted sequences.
+    ids/parents: [max_time, batch, beam]."""
+    ids, parents = ensure_tensor(ids), ensure_tensor(parents)
+
+    def _fn(idv, parv):
+        T = idv.shape[0]
+        beams = jnp.arange(idv.shape[2], dtype=parv.dtype)
+        init_parent = jnp.broadcast_to(beams, idv.shape[1:])
+
+        # walk from last step backwards gathering tokens along parent chain
+        def scan_body(parent, t):
+            tok = jnp.take_along_axis(idv[t], parent.astype(jnp.int32), axis=-1)
+            new_parent = jnp.take_along_axis(parv[t], parent.astype(jnp.int32), axis=-1)
+            return new_parent, tok
+
+        ts = jnp.arange(T - 1, -1, -1)
+        _, toks = jax.lax.scan(scan_body, init_parent, ts)
+        return jnp.flip(toks, axis=0)
+
+    return apply("gather_tree", _fn, ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (reference: paddle/phi/kernels/gpu/temporal_shift
+    kernel): shift a slice of channels one step forward/backward in time."""
+    x = ensure_tensor(x)
+
+    def _fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        NT, C, H, W = v.shape
+        N = NT // int(seg_num)
+        v5 = v.reshape(N, int(seg_num), C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.concatenate([v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        keep = v5[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply("temporal_shift", _fn, x)

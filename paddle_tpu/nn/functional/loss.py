@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -407,3 +408,258 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
         return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
 
     return apply("dice_loss", _dice, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+    """Reference: python/paddle/nn/functional/loss.py multi_margin_loss —
+    mean_j max(0, margin - x_y + x_j)^p over j != y."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    extras = [ensure_tensor(weight)] if weight is not None else []
+
+    def _fn(x, y, *w):
+        C = x.shape[1]
+        xy = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, jnp.asarray(margin, x.dtype) - xy + x)
+        if int(p) == 2:
+            m = m * m
+        if w:
+            m = m * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), C, dtype=x.dtype)
+        m = m * (1.0 - onehot)
+        return _reduce(jnp.sum(m, axis=1) / C, reduction)
+
+    return apply("multi_margin_loss", _fn, input, label, *extras)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None, margin=1.0, swap=False, reduction="mean", name=None):
+    """Reference: python/paddle/nn/functional/loss.py — triplet loss with a
+    user distance callable (defaults to pairwise L2)."""
+    from .common import pairwise_distance
+
+    input, positive, negative = ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative)
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = ensure_tensor(dist(input, positive))
+    d_neg = ensure_tensor(dist(input, negative))
+    if swap:
+        d_neg2 = ensure_tensor(dist(positive, negative))
+        from paddle_tpu.tensor.math import minimum
+
+        d_neg = minimum(d_neg, d_neg2)
+
+    def _fn(dp, dn):
+        return _reduce(jnp.maximum(0.0, dp - dn + jnp.asarray(margin, dp.dtype)), reduction)
+
+    return apply("triplet_margin_with_distance_loss", _fn, d_pos, d_neg)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference: python/paddle/nn/functional/loss.py npair_loss (N-pair
+    paper, Sohn 2016): softmax CE over anchor@positive^T similarities with
+    same-label targets + L2 on the embeddings."""
+    anchor, positive, labels = ensure_tensor(anchor), ensure_tensor(positive), ensure_tensor(labels)
+
+    def _fn(a, pos, y):
+        yf = y.reshape(-1, 1).astype(jnp.float32)
+        same = (yf == yf.T).astype(jnp.float32)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        sim = a.astype(jnp.float32) @ pos.astype(jnp.float32).T
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = jnp.asarray(l2_reg, jnp.float32) * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(pos * pos, axis=1))) / 2.0
+        return (ce + reg).astype(a.dtype)
+
+    return apply("npair_loss", _fn, anchor, positive, labels)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (reference:
+    python/paddle/nn/functional/loss.py hsigmoid_loss,
+    paddle/phi/kernels/cpu/hsigmoid_loss_kernel.cc).
+
+    Default tree: complete binary tree over num_classes leaves — inner node
+    path/codes derive from the label's binary route, exactly the reference's
+    default layout.  Custom trees come in via path_table/path_code.
+    """
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    extras = [weight] + ([ensure_tensor(bias)] if bias is not None else [])
+
+    if path_table is None:
+        # default complete-binary-tree: code length = ceil(log2(C)); node ids
+        # follow the heap layout the reference uses (root = class C offset).
+        C = int(num_classes)
+        depth = max(1, int(np.ceil(np.log2(C))))
+
+        def _route(y):
+            # heap position of leaf y is (y + C - 1) in a 1-indexed heap of
+            # inner nodes [0, C-2]; walk up collecting (parent, is_right)
+            nodes, codes = [], []
+            n = y + C - 1
+            for _ in range(depth):
+                parent = (n - 1) // 2
+                codes.append(n % 2 == 0)  # right child has even heap index
+                nodes.append(parent)
+                n = parent
+                if parent == 0:
+                    break
+            while len(nodes) < depth:
+                nodes.append(-1)
+                codes.append(False)
+            return nodes[::-1], codes[::-1]
+
+        tbl = np.full((C, depth), -1, np.int32)
+        cde = np.zeros((C, depth), np.float32)
+        for y in range(C):
+            nn_, cc_ = _route(y)
+            tbl[y, : len(nn_)] = nn_
+            cde[y, : len(cc_)] = [1.0 if c else 0.0 for c in cc_]
+        path_table_arr, path_code_arr = jnp.asarray(tbl), jnp.asarray(cde)
+    else:
+        path_table_arr = ensure_tensor(path_table)._value
+        path_code_arr = ensure_tensor(path_code)._value.astype(jnp.float32)
+
+    def _fn(x, y, wv, *b):
+        # per-sample paths: [B, D]
+        if path_table is not None:
+            tb = path_table_arr
+            cd = path_code_arr
+        else:
+            tb = jnp.take(path_table_arr, y.astype(jnp.int32), axis=0)
+            cd = jnp.take(path_code_arr, y.astype(jnp.int32), axis=0)
+        valid = (tb >= 0).astype(jnp.float32)
+        tb_c = jnp.maximum(tb, 0).astype(jnp.int32)
+        w = jnp.take(wv, tb_c, axis=0)  # [B, D, F]
+        logit = jnp.einsum("bdf,bf->bd", w.astype(jnp.float32), x.astype(jnp.float32))
+        if b:
+            logit = logit + jnp.take(b[0].reshape(-1), tb_c).astype(jnp.float32)
+        # BCE with code as target: -[c*log(sig) + (1-c)*log(1-sig)]
+        loss = jnp.maximum(logit, 0.0) - logit * cd + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        return jnp.sum(loss * valid, axis=1, keepdims=True).astype(x.dtype)
+
+    return apply("hsigmoid_loss", _fn, input, label, *extras)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (reference: python/paddle/nn/functional/loss.py
+    rnnt_loss over warprnnt): forward algorithm on the (T, U) lattice with a
+    lax.scan over time — log-space alpha recursion, jit-friendly.
+
+    input: [B, T, U+1, D] log-probs or logits (normalized here), label [B, U].
+    """
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    input_lengths, label_lengths = ensure_tensor(input_lengths), ensure_tensor(label_lengths)
+
+    def _fn(logits, y, tlen, ulen):
+        B, T, U1, D = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # emission probs: p(y_u | t, u) and blank probs p(blank | t, u)
+        yb = jnp.pad(y.astype(jnp.int32), ((0, 0), (0, 1)))  # [B, U+1]
+        p_emit = jnp.take_along_axis(logp, yb[:, None, :, None], axis=3)[..., 0]  # [B,T,U+1]
+        if float(fastemit_lambda) > 0.0:
+            # FastEmit (Yu et al. 2021): scale emission-arc GRADIENTS by
+            # (1+lambda) without changing the loss value — value-preserving
+            # gradient boost via stop_gradient.
+            lam = jnp.float32(fastemit_lambda)
+            p_emit = p_emit + lam * (p_emit - jax.lax.stop_gradient(p_emit))
+        p_blank = logp[..., int(blank)]  # [B, T, U+1]
+        neg_inf = jnp.float32(-1e30)
+
+        # alpha[u] over scan of t; within each t, a cumulative scan over u
+        def time_step(alpha, t):
+            # blank transition from (t-1, u); emit transition from (t, u-1)
+            from_blank = alpha + p_blank[:, t - 1, :]
+
+            # sequential in u: alpha_new[u] = logaddexp(from_blank[u], alpha_new[u-1] + emit[t, u-1])
+            def u_scan(carry, u):
+                val = jnp.logaddexp(from_blank[:, u], carry + p_emit[:, t, u - 1])
+                return val, val
+
+            a0 = from_blank[:, 0]
+            _, rest = jax.lax.scan(u_scan, a0, jnp.arange(1, U1))
+            alpha_new = jnp.concatenate([a0[:, None], rest.T], axis=1)
+            return alpha_new, None
+
+        # t = 0 row: only emit transitions
+        def u_scan0(carry, u):
+            val = carry + p_emit[:, 0, u - 1]
+            return val, val
+
+        a00 = jnp.zeros((B,), jnp.float32)
+        _, rest0 = jax.lax.scan(u_scan0, a00, jnp.arange(1, U1))
+        alpha = jnp.concatenate([a00[:, None], rest0.T], axis=1)
+
+        def body(alpha, t):
+            new, _ = time_step(alpha, t)
+            return new, new
+
+        _, alphas = jax.lax.scan(body, alpha, jnp.arange(1, T))
+        all_alphas = jnp.concatenate([alpha[None], alphas], axis=0)  # [T, B, U+1]
+        # final: alpha[tlen-1, ulen] + blank at (tlen-1, ulen)
+        ti = jnp.clip(tlen.astype(jnp.int32) - 1, 0, T - 1)
+        ui = jnp.clip(ulen.astype(jnp.int32), 0, U)
+        bidx = jnp.arange(B)
+        final_alpha = all_alphas[ti, bidx, ui]
+        final_blank = p_blank[bidx, ti, ui]
+        nll = -(final_alpha + final_blank)
+        return _reduce(nll, reduction).astype(logits.dtype)
+
+    return apply("rnnt_loss", _fn, input, label, input_lengths, label_lengths)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0, scale=64.0, group=None, return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (reference:
+    python/paddle/nn/functional/loss.py margin_cross_entropy,
+    paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu):
+    logit_y -> cos(m1*theta + m2) - m3, scaled.  Class-parallel sharding is
+    expressed via GSPMD on the logits instead of a manual comm group."""
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+
+    def _fn(x, y):
+        xf = x.astype(jnp.float32)
+        yi = y.astype(jnp.int32).reshape(-1)
+        cos_y = jnp.clip(jnp.take_along_axis(xf, yi[:, None], axis=1), -1.0, 1.0)
+        theta = jnp.arccos(cos_y)
+        target = jnp.cos(jnp.float32(margin1) * theta + jnp.float32(margin2)) - jnp.float32(margin3)
+        onehot = jax.nn.one_hot(yi, x.shape[1], dtype=jnp.float32)
+        out = (xf * (1 - onehot) + target * onehot) * jnp.float32(scale)
+        logp = jax.nn.log_softmax(out, axis=1)
+        nll = -jnp.take_along_axis(logp, yi[:, None], axis=1)[:, 0]
+        loss = _reduce(nll, reduction)
+        if return_softmax:
+            return loss.astype(x.dtype), jnp.exp(logp).astype(x.dtype)
+        return loss.astype(x.dtype)
+
+    return apply("margin_cross_entropy", _fn, logits, label, n_outputs=2 if return_softmax else None)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC negative-class sampling (reference:
+    python/paddle/nn/functional/common.py class_center_sample,
+    paddle/phi/kernels/gpu/class_center_sample_kernel.cu): keep all positive
+    classes, sample negatives to num_samples total; returns (remapped_label,
+    sampled_class_centers).  Host-side sampling op (data-dependent sizes),
+    eager only — like the reference's usage in the data path."""
+    import numpy as np  # host op
+
+    label = ensure_tensor(label)
+    y = np.asarray(label._value).astype(np.int64)
+    C, S = int(num_classes), int(num_samples)
+    pos = np.unique(y)
+    if len(pos) >= S:
+        sampled = pos
+    else:
+        # fresh negatives every call, seeded from the framework PRNG stream
+        # so paddle.seed reproduces runs
+        from paddle_tpu._core import random as _rng
+
+        seed_bits = int(np.asarray(jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1)))
+        rng_ = np.random.default_rng(seed_bits)
+        neg_pool = np.setdiff1d(np.arange(C, dtype=np.int64), pos, assume_unique=True)
+        extra = rng_.choice(neg_pool, size=S - len(pos), replace=False)
+        sampled = np.concatenate([pos, extra])
+    remap = -np.ones(C, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    from paddle_tpu.tensor._ops_common import Tensor as _T
+
+    return _T(jnp.asarray(remap[y].astype(np.int32))), _T(jnp.asarray(sampled.astype(np.int32)))

@@ -29,6 +29,15 @@ def _pads(padding, n):
     raise ValueError(f"bad padding {padding}")
 
 
+def _ceil_extra(n, k, s, p):
+    """Extra right-padding making reduce_window emit the ceil-mode output
+    size: out = ceil((n + 2p - k)/s) + 1 (reference pooling ceil semantics)."""
+    import math
+
+    out = math.ceil(max(n + 2 * p - k, 0) / s) + 1
+    return max((out - 1) * s + k - (n + 2 * p), 0)
+
+
 def _pool(x, kernel, stride, padding, nd, data_format, reducer, init, ceil_mode=False, count_include_pad=True, is_avg=False):
     x = ensure_tensor(x)
     ks = _tuple(kernel, nd)
@@ -44,6 +53,12 @@ def _pool(x, kernel, stride, padding, nd, data_format, reducer, init, ceil_mode=
     if isinstance(pd, str):
         pad_full = pd
     else:
+        if ceil_mode:
+            sp_shape = x.shape[1 : 1 + nd] if channel_last else x.shape[2 : 2 + nd]
+            pd = [
+                (lo, hi + _ceil_extra(int(n), k, s, lo))
+                for (lo, hi), n, k, s in zip(pd, sp_shape, ks, st)
+            ]
         pad_full = ([(0, 0)] + list(pd) + [(0, 0)]) if channel_last else ([(0, 0), (0, 0)] + list(pd))
 
     def _p(v):
@@ -60,28 +75,121 @@ def _pool(x, kernel, stride, padding, nd, data_format, reducer, init, ceil_mode=
     return apply("pool", _p, x)
 
 
+def _max_pool_with_mask(x, kernel_size, stride, padding, nd, data_format, ceil_mode=False):
+    """Max pool returning (out, mask): mask holds each max's flat index
+    within its (N, C) spatial map — the layout max_unpool consumes
+    (reference: paddle/phi/kernels/funcs/pooling.h MaxPool2dWithIndex)."""
+    x = ensure_tensor(x)
+    ks = _tuple(kernel_size, nd)
+    st = _tuple(stride if stride is not None else kernel_size, nd)
+    pd = _pads(padding, nd)
+    if isinstance(pd, str):
+        raise ValueError("return_mask does not support string padding")
+    if data_format[-1] == "C":
+        raise ValueError("return_mask supports channel-first layouts only")
+    if ceil_mode:
+        pd = [
+            (lo, hi + _ceil_extra(int(n), k, s, lo))
+            for (lo, hi), n, k, s in zip(pd, x.shape[2 : 2 + nd], ks, st)
+        ]
+
+    def _fn(v):
+        N, C = v.shape[0], v.shape[1]
+        spatial = v.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(1, 1, *spatial)
+        flat_idx = jnp.broadcast_to(flat_idx, v.shape)
+        # pad values with -inf (never wins argmax) and indices with 0 BEFORE
+        # patch extraction — conv patches would otherwise zero-pad values
+        pad_cfg = [(0, 0), (0, 0)] + [(p[0], p[1]) for p in pd]
+        vpad = jnp.pad(v, pad_cfg, constant_values=-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min)
+        ipad = jnp.pad(flat_idx, pad_cfg, constant_values=0)
+        # patches: [N, C*prod(ks), *out_spatial]
+        patches = jax.lax.conv_general_dilated_patches(
+            vpad, filter_shape=ks, window_strides=st, padding="VALID"
+        )
+        ipatches = jax.lax.conv_general_dilated_patches(
+            ipad.astype(jnp.float32), filter_shape=ks, window_strides=st, padding="VALID"
+        )
+        out_sp = patches.shape[2:]
+        K = int(np.prod(ks))
+        pv = patches.reshape(N, C, K, *out_sp)
+        piv = ipatches.reshape(N, C, K, *out_sp)
+        arg = jnp.argmax(pv, axis=2)
+        out = jnp.max(pv, axis=2)
+        mask = jnp.take_along_axis(piv, arg[:, :, None], axis=2)[:, :, 0].astype(jnp.int32)
+        return out, mask
+
+    return apply("max_pool_with_mask", _fn, x, n_outputs=2)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
-    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.max, -jnp.inf)
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1, data_format, ceil_mode)
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max, -jnp.inf)
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2, data_format, ceil_mode)
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max, -jnp.inf)
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3, data_format, ceil_mode)
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max, -jnp.inf, ceil_mode=ceil_mode)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride=None, padding=0, output_size=None, data_format="NCHW"):
+    """Scatter pooled values back to their argmax positions (reference:
+    paddle/phi/kernels/cpu/unpool_kernel.cc)."""
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    ks = _tuple(kernel_size, nd)
+    st = _tuple(stride if stride is not None else kernel_size, nd)
+    pd = _pads(padding, nd)
+    in_sp = x.shape[2:]
+    if output_size is None:
+        out_sp = tuple(
+            (in_sp[i] - 1) * st[i] - 2 * pd[i][0] + ks[i] for i in range(nd)
+        )
+    else:
+        out_sp = tuple(int(s) for s in (output_size[-nd:] if len(output_size) > nd else output_size))
+
+    def _fn(v, idx):
+        N, C = v.shape[0], v.shape[1]
+        L = int(np.prod(v.shape[2:]))
+        M = int(np.prod(out_sp))
+        vf = v.reshape(N * C, L)
+        if_ = idx.reshape(N * C, L).astype(jnp.int32)
+        out = jnp.zeros((N * C, M), v.dtype)
+        out = out.at[jnp.arange(N * C, dtype=jnp.int32)[:, None], if_].set(vf)
+        return out.reshape(N, C, *out_sp)
+
+    return apply("max_unpool", _fn, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding, output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding, output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding, output_size, data_format)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
-    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add, 0.0, is_avg=True, count_include_pad=not exclusive)
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add, 0.0, ceil_mode=ceil_mode, is_avg=True, count_include_pad=not exclusive)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add, 0.0, is_avg=True, count_include_pad=not exclusive)
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add, 0.0, ceil_mode=ceil_mode, is_avg=True, count_include_pad=not exclusive)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add, 0.0, is_avg=True, count_include_pad=not exclusive)
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add, 0.0, ceil_mode=ceil_mode, is_avg=True, count_include_pad=not exclusive)
 
 
 def _adaptive_pool(x, output_size, nd, data_format, is_avg):

@@ -1,0 +1,116 @@
+"""Spatial-transformer functionals (reference:
+python/paddle/nn/functional/vision.py affine_grid/grid_sample;
+paddle/phi/kernels/gpu/affine_grid_kernel.cu, grid_sample_kernel.cu).
+
+Pure-jnp gather math: XLA lowers the bilinear gathers to vectorized
+dynamic-slices; there is no CUDA texture unit to replicate on TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def _lin(n, align_corners):
+    # normalized coords in [-1, 1] for n sample positions
+    if align_corners:
+        return jnp.linspace(-1.0, 1.0, n)
+    step = 2.0 / n
+    return jnp.linspace(-1.0 + step / 2.0, 1.0 - step / 2.0, n)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] + out_shape [N, C, H, W] -> grid [N, H, W, 2];
+    3-D variant: theta [N, 3, 4] -> grid [N, D, H, W, 3]."""
+    theta = ensure_tensor(theta)
+    sh = [int(s) for s in (out_shape.tolist() if hasattr(out_shape, "tolist") else out_shape)]
+    is_3d = len(sh) == 5
+
+    def _fn(th):
+        if is_3d:
+            _, _, D, H, W = sh
+            zs, ys, xs = _lin(D, align_corners), _lin(H, align_corners), _lin(W, align_corners)
+            z, y, x = jnp.meshgrid(zs, ys, xs, indexing="ij")
+            base = jnp.stack([x, y, z, jnp.ones_like(x)], axis=-1)  # [D,H,W,4]
+            g = jnp.einsum("dhwk,nik->ndhwi", base, th.astype(jnp.float32))
+        else:
+            _, _, H, W = sh
+            ys, xs = _lin(H, align_corners), _lin(W, align_corners)
+            y, x = jnp.meshgrid(ys, xs, indexing="ij")
+            base = jnp.stack([x, y, jnp.ones_like(x)], axis=-1)  # [H,W,3]
+            g = jnp.einsum("hwk,nik->nhwi", base, th.astype(jnp.float32))
+        return g.astype(th.dtype)
+
+    return apply("affine_grid", _fn, theta)
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(ix, low, high):
+    # reflect coordinates into [low, high] (inclusive), repeating as needed
+    span = high - low
+    if span <= 0:
+        return jnp.zeros_like(ix)
+    ix = jnp.abs(ix - low) % (2 * span)
+    return low + jnp.where(ix > span, 2 * span - ix, ix)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """x [N, C, H, W], grid [N, Hg, Wg, 2] (xy order, normalized) ->
+    [N, C, Hg, Wg].  Modes: bilinear | nearest; padding: zeros | border |
+    reflection."""
+    x, grid = ensure_tensor(x), ensure_tensor(grid)
+
+    def _fn(v, g):
+        N, C, H, W = v.shape
+        gf = g.astype(jnp.float32)
+        ix = _unnormalize(gf[..., 0], W, align_corners)
+        iy = _unnormalize(gf[..., 1], H, align_corners)
+
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, W - 1)
+            iy = jnp.clip(iy, 0, H - 1)
+        elif padding_mode == "reflection":
+            if align_corners:
+                ix = _reflect(ix, 0.0, float(W - 1))
+                iy = _reflect(iy, 0.0, float(H - 1))
+            else:
+                ix = jnp.clip(_reflect(ix, -0.5, W - 0.5), 0, W - 1)
+                iy = jnp.clip(_reflect(iy, -0.5, H - 0.5), 0, H - 1)
+
+        def gather(yy, xx):
+            # returns [N, C, Hg, Wg] of v[n, :, yy, xx] with zero padding OOB
+            inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            flat = v.reshape(N, C, H * W)
+            lin = (yc * W + xc).reshape(N, 1, -1)
+            out = jnp.take_along_axis(flat, jnp.broadcast_to(lin, (N, C, lin.shape[-1])), axis=2)
+            out = out.reshape(N, C, *yy.shape[1:])
+            return jnp.where(inb[:, None], out, jnp.zeros((), v.dtype))
+
+        if mode == "nearest":
+            return gather(jnp.round(iy), jnp.round(ix))
+
+        x0, y0 = jnp.floor(ix), jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = ((x1 - ix) * (y1 - iy))[:, None]
+        wb = ((x1 - ix) * (iy - y0))[:, None]
+        wc = ((ix - x0) * (y1 - iy))[:, None]
+        wd = ((ix - x0) * (iy - y0))[:, None]
+        va, vb = gather(y0, x0), gather(y1, x0)
+        vc, vd = gather(y0, x1), gather(y1, x1)
+        out = va * wa.astype(v.dtype) + vb * wb.astype(v.dtype) + vc * wc.astype(v.dtype) + vd * wd.astype(v.dtype)
+        return out
+
+    return apply("grid_sample", _fn, x, grid)

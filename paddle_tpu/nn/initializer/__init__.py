@@ -194,3 +194,44 @@ class Dirac(Initializer):
                 idx = (g * (out_c // self.groups) + i, i) + centers
                 v[idx] = 1.0
         return jnp.asarray(v, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed conv (reference:
+    python/paddle/nn/initializer/Bilinear): weight [out, in, kh, kw] filled
+    with the bilinear interpolation kernel of its spatial size."""
+
+    def _init_value(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv weight")
+        out_c, in_c, kh, kw = shape
+        def kern(k):
+            f = (k + 1) // 2
+            c = f - 1 if k % 2 == 1 else f - 0.5
+            return 1.0 - np.abs(np.arange(k) - c) / f
+        w2d = np.outer(kern(kh), kern(kw)).astype(np.float32)
+        v = np.zeros(shape, np.float32)
+        for o in range(out_c):
+            for i in range(in_c):
+                v[o, i] = w2d
+        return jnp.asarray(v, dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default param initializers used when a layer's ParamAttr
+    has none (reference: python/paddle/nn/initializer/set_global_initializer).
+    Pass None to reset."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+def _default_init(is_bias):
+    if is_bias:
+        return _global_bias_init
+    return _global_weight_init
+
+__all__ += ["Bilinear", "set_global_initializer", "calculate_gain"]

@@ -266,3 +266,17 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference:
+    python/paddle/nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects 3-D or 4-D input"
+        return F.softmax(x, axis=-3)
+
+__all__ += ['Softmax2D']

@@ -252,3 +252,27 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """paddle.nn.Unflatten parity (reference python/paddle/nn/layer/common.py)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from paddle_tpu.tensor.manipulation import unflatten
+
+        return unflatten(x, self.axis, self.shape)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+__all__ += ['Unflatten', 'PairwiseDistance']

@@ -156,7 +156,8 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer
+        # precedence: explicit ParamAttr > set_global_initializer > layer default
+        init = attr.initializer or I._default_init(is_bias) or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init._init_value(tuple(int(s) for s in shape), to_jax_dtype(dtype))

@@ -183,3 +183,54 @@ class SigmoidFocalLoss(Layer):
 
     def forward(self, logit, label, normalizer=None):
         return F.sigmoid_focal_loss(logit, label, normalizer, self.alpha, self.gamma, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.p, self.margin, self.weight, self.reduction = p, margin, weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin, self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False, reduction="mean", name=None):
+        super().__init__()
+        self.distance_function, self.margin, self.swap, self.reduction = distance_function, margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin, self.swap, self.reduction
+        )
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid layer (reference: python/paddle/nn/layer/loss.py
+    HSigmoidLoss): owns the inner-node weight [num_classes-1, feature] and
+    optional bias."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter([n_nodes, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([n_nodes, 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, self.bias, path_table, path_code
+        )
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.fastemit_lambda, self.reduction = blank, fastemit_lambda, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(
+            input, label, input_lengths, label_lengths, self.blank, self.fastemit_lambda, self.reduction
+        )
+
+__all__ += ['MultiMarginLoss', 'TripletMarginWithDistanceLoss', 'HSigmoidLoss', 'RNNTLoss']

@@ -113,3 +113,35 @@ class LPPool2D(Layer):
 
     def forward(self, x):
         return F.lp_pool2d(x, *self.args)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os = self.args
+        return F.max_unpool1d(x, indices, k, s, p, df, os)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os = self.args
+        return F.max_unpool2d(x, indices, k, s, p, df, os)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os = self.args
+        return F.max_unpool3d(x, indices, k, s, p, df, os)
+
+__all__ += ['MaxUnPool1D', 'MaxUnPool2D', 'MaxUnPool3D']

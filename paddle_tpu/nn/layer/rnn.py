@@ -17,7 +17,7 @@ from paddle_tpu.nn import initializer as I
 from paddle_tpu.tensor._ops_common import apply, ensure_tensor
 from .layers import Layer
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN"]
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN", "RNNCellBase", "Decoder", "BeamSearchDecoder", "dynamic_decode"]
 
 
 class RNNCellBase(Layer):
@@ -324,3 +324,148 @@ class BiRNN(Layer):
         out_fw, st_fw = self.fw(inputs, states_fw)
         out_bw, st_bw = self.bw(inputs, states_bw)
         return paddle.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+# --------------------------------------------------------------- decoding
+class Decoder:
+    """Abstract decode-step interface (reference: python/paddle/nn/decode.py
+    Decoder): initialize() / step() / finalize()."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoder over an RNN cell (reference:
+    python/paddle/nn/decode.py:BeamSearchDecoder).
+
+    Host-driven eager loop (the schedule is data-dependent); each step's
+    tensor math is jnp and the per-step cell call hits the jit cache, the
+    same execution shape as the reference's per-step kernel launches.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token, self.end_token = int(start_token), int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] by repeating each row."""
+        x = ensure_tensor(x)
+        v = x._value
+        v = jnp.repeat(v[:, None], beam_size, axis=1).reshape(-1, *v.shape[1:])
+        return Tensor(v)
+
+    def _merge(self, v):
+        return v.reshape(-1, *v.shape[2:])  # [B, K, ...] -> [B*K, ...]
+
+    def _split(self, v):
+        return v.reshape(self.batch_size, self.beam_size, *v.shape[1:])
+
+    @staticmethod
+    def _tree_map_tensors(fn, tree):
+        # Tensor is itself a registered pytree; map over whole Tensors, not
+        # their leaves, or the reconstruction nests Tensor inside Tensor
+        return jax.tree_util.tree_map(fn, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def initialize(self, inits):
+        sample = jax.tree_util.tree_leaves(inits)[0]
+        self.batch_size = int(sample.shape[0])
+        B, K = self.batch_size, self.beam_size
+        states = self._tree_map_tensors(
+            lambda t: Tensor(self._merge(jnp.repeat((t._value if isinstance(t, Tensor) else jnp.asarray(t))[:, None], K, axis=1))),
+            inits,
+        )
+        ids = jnp.full((B, K), self.start_token, jnp.int32)
+        # first beam active, others -inf so step 1 expands only beam 0
+        log_probs = jnp.tile(jnp.array([0.0] + [-1e9] * (K - 1), jnp.float32), (B, 1))
+        finished = jnp.zeros((B, K), bool)
+        init_inputs = self._embed(ids)
+        return init_inputs, (states, log_probs, finished), Tensor(finished)
+
+    def _embed(self, ids):
+        t = Tensor(self._merge(ids) if ids.ndim == 2 else ids)
+        if self.embedding_fn is not None:
+            return self.embedding_fn(t)
+        return t
+
+    def step(self, time, inputs, states_tuple, **kwargs):
+        cell_states, log_probs, finished = states_tuple
+        B, K = self.batch_size, self.beam_size
+        out, next_states = self.cell(inputs, cell_states, **kwargs)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        lv = logits._value.astype(jnp.float32)
+        V = lv.shape[-1]
+        step_lp = jax.nn.log_softmax(lv, axis=-1).reshape(B, K, V)
+        # finished beams only extend with end_token at prob 0
+        fin_mask = jnp.full((V,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], fin_mask[None, None, :], step_lp)
+        total = log_probs[..., None] + step_lp  # [B, K, V]
+        top_lp, top_idx = jax.lax.top_k(total.reshape(B, K * V), K)
+        parent = (top_idx // V).astype(jnp.int32)  # [B, K]
+        token = (top_idx % V).astype(jnp.int32)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) | (token == self.end_token)
+        # reorder cell states by parent beam
+        flat_parent = (jnp.arange(B, dtype=jnp.int32)[:, None] * K + parent).reshape(-1)
+        next_states = self._tree_map_tensors(
+            lambda t: Tensor(jnp.take((t._value if isinstance(t, Tensor) else jnp.asarray(t)), flat_parent, axis=0)),
+            next_states,
+        )
+        outputs = {
+            "scores": Tensor(top_lp),
+            "predicted_ids": Tensor(token),
+            "parent_ids": Tensor(parent),
+        }
+        next_inputs = self._embed(token)
+        return outputs, (next_states, top_lp, new_finished), next_inputs, Tensor(new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        import paddle_tpu.nn.functional as F
+
+        ids = paddle.stack(outputs["predicted_ids"], axis=0)  # [T, B, K]
+        parents = paddle.stack(outputs["parent_ids"], axis=0)
+        return F.gather_tree(ids, parents), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False, impute_finished=False, is_test=False, return_length=False, **kwargs):
+    """Run a Decoder until all sequences finish or max_step_num (reference:
+    python/paddle/nn/decode.py dynamic_decode)."""
+    import numpy as np
+
+    inputs, states, finished = decoder.initialize(inits)
+    collected = {"scores": [], "predicted_ids": [], "parent_ids": []}
+    lengths = None
+    step = 0
+    limit = int(max_step_num) if max_step_num is not None else 256
+    while step < limit:
+        outputs, states, inputs, finished = decoder.step(step, inputs, states, **kwargs)
+        for k in collected:
+            collected[k].append(outputs[k])
+        fin = np.asarray(finished._value)
+        if lengths is None:
+            lengths = np.full(fin.shape, limit, np.int64)
+        newly = (fin) & (lengths == limit)
+        lengths[newly] = step + 1
+        step += 1
+        if fin.all():
+            break
+    seqs, final_states = decoder.finalize(collected, states, lengths)
+    if not output_time_major:
+        # reference _transpose_batch_time: [T, B, K] -> [B, T, K]
+        seqs = paddle.transpose(seqs, [1, 0, 2]) if seqs.ndim == 3 else seqs
+    if return_length:
+        return seqs, final_states, Tensor(jnp.asarray(np.minimum(lengths, step)))
+    return seqs, final_states

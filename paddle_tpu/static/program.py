@@ -184,6 +184,24 @@ class Program:
             self.param_inits[v._vid] = t._value
         return self.state_vars[key]
 
+    def state_tensors(self):
+        """Name -> persistable payload (params + optimizer state) of this
+        program — the save/load unit of static.io serialize_persistables."""
+        out = {}
+        for vid, val in self.param_inits.items():
+            var = self._var_by_vid.get(vid)
+            if var is not None:
+                out[var.name] = Tensor(val)
+        return out
+
+    def set_state_tensor(self, name, value):
+        for vid in list(self.param_inits):
+            var = self._var_by_vid.get(vid)
+            if var is not None and var.name == name:
+                self.param_inits[vid] = value
+                return True
+        return False
+
     def record(self, type_, fn, args, kwargs):
         """Append an Operator; returns output Variable(s).  Called by
         _core.autograd.apply when this program is being captured."""

@@ -113,3 +113,11 @@ from .array import array_length, array_read, array_write, create_array  # noqa: 
 # generated in-place op tier (framework/op_registry codegen)
 from paddle_tpu.framework.op_registry import generate_inplace_variants as _gen_inplace  # noqa: E402
 _gen_inplace()
+
+# surface the generated `op_` names (and any hand-written ones the star
+# imports above predate) on the package so `paddle.cos_` etc. resolve
+for _mod in _METHOD_SOURCES:
+    for _n in dir(_mod):
+        if _n.endswith("_") and not _n.startswith("_") and _n not in globals():
+            globals()[_n] = getattr(_mod, _n)
+del _mod, _n

@@ -801,3 +801,87 @@ def rank(x):
 
 def shape(x):
     return Tensor(jnp.asarray(ensure_tensor(x).shape, jnp.int32))
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand one axis into the given shape (reference:
+    python/paddle/tensor/manipulation.py unflatten); at most one -1 entry."""
+    x = ensure_tensor(x)
+    ax = int(axis) % max(x.ndim, 1)
+    sh = _shape_list(shape)
+    neg = [i for i, s in enumerate(sh) if s == -1]
+    if len(neg) > 1:
+        raise ValueError("unflatten: at most one -1 in shape")
+    if neg:
+        known = int(np.prod([s for s in sh if s != -1])) or 1
+        sh[neg[0]] = x.shape[ax] // known
+    new_shape = list(x.shape[:ax]) + sh + list(x.shape[ax + 1 :])
+    return apply("unflatten", lambda v: jnp.reshape(v, new_shape), x)
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference: paddle.reverse)."""
+    return flip(x, axis)
+
+
+def index_fill(x, index, axis, value, name=None):
+    """Fill slices of x at `index` positions along `axis` with scalar value."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ax = int(axis) % x.ndim
+    if isinstance(value, Tensor):
+        value = value._value
+
+    def _fn(v, idx):
+        hit = jnp.zeros((v.shape[ax],), jnp.bool_).at[idx].set(True)
+        bshape = [1] * v.ndim
+        bshape[ax] = v.shape[ax]
+        return jnp.where(hit.reshape(bshape), jnp.asarray(value, v.dtype), v)
+
+    return apply("index_fill", _fn, x, index)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, index_fill, index, axis, value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the (offset) diagonal of the (axis1, axis2) planes."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    a1, a2 = int(axis1) % x.ndim, int(axis2) % x.ndim
+    off = int(offset)
+
+    def _fn(v, w):
+        v2 = jnp.moveaxis(v, (a1, a2), (-2, -1))
+        n, m = v2.shape[-2], v2.shape[-1]
+        i = jax.lax.broadcasted_iota(jnp.int32, (n, m), 0)
+        j = jax.lax.broadcasted_iota(jnp.int32, (n, m), 1)
+        mask = (j - i) == off
+        # position along the diagonal for each (i, j) on it
+        pos = jnp.where(off >= 0, i, j)
+        L = w.shape[-1]
+        wfull = jnp.take(w.astype(v.dtype), jnp.clip(pos, 0, L - 1), axis=-1)
+        out = jnp.where(mask, wfull, v2)
+        return jnp.moveaxis(out, (-2, -1), (a1, a2))
+
+    return apply("diagonal_scatter", _fn, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write `values` into x at position `index` along `axis`."""
+    x, values = ensure_tensor(x), ensure_tensor(values)
+    ax = int(axis) % x.ndim
+    idx = int(index)
+
+    def _fn(v, w):
+        upd = jnp.expand_dims(w.astype(v.dtype), ax)
+        return jax.lax.dynamic_update_slice_in_dim(v, upd, idx, ax)
+
+    return apply("select_scatter", _fn, x, values)
+
+
+def t_(x, name=None):
+    from ._ops_common import inplace_from
+
+    return inplace_from(x, t)

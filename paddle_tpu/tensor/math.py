@@ -7,6 +7,7 @@ fusion story the reference builds CINN for.
 
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -516,3 +517,44 @@ def combinations(x, r=2, with_replacement=False, name=None):
     if idx.size == 0:
         return Tensor(jnp.zeros((0, r), x._value.dtype))
     return apply("combinations", lambda v: jnp.take(v, jnp.asarray(idx), axis=0), x)
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference: paddle.add_n)."""
+    if isinstance(inputs, (list, tuple)):
+        ts = [ensure_tensor(v) for v in inputs]
+    else:
+        ts = [ensure_tensor(inputs)]
+
+    def _fn(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+
+    return apply("add_n", _fn, *ts)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise p-norm distances of an (N, M) matrix: the upper
+    triangle (i < j) flattened to shape (N*(N-1)/2,)."""
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    iu, ju = np.triu_indices(n, k=1)
+    pf = float(p)
+
+    def _fn(v):
+        a = v[iu.astype(np.int32)]
+        b = v[ju.astype(np.int32)]
+        diff = jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))
+        if pf == float("inf"):
+            d = jnp.max(diff, axis=-1)
+        elif pf == 0.0:
+            d = jnp.sum((diff != 0).astype(jnp.float32), axis=-1)
+        elif pf == 2.0:
+            d = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        else:
+            d = jnp.sum(diff**pf, axis=-1) ** (1.0 / pf)
+        return d.astype(v.dtype)
+
+    return apply("pdist", _fn, x)

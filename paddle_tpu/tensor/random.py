@@ -163,3 +163,26 @@ def rayleigh(scale=1.0, shape=None, name=None):
     sh = _shape_list(shape) if shape is not None else []
     u = jax.random.uniform(rng.next_key(), sh, minval=1e-9, maxval=1.0)
     return Tensor(scale * jnp.sqrt(-2.0 * jnp.log(u)))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill x in place with Cauchy(loc, scale) samples (reference:
+    Tensor.cauchy_); inverse-CDF sampling on the VPU."""
+    x = ensure_tensor(x)
+    u = jax.random.uniform(rng.next_key(), x._value.shape, jnp.float32)
+    val = jnp.float32(loc) + jnp.float32(scale) * jnp.tan(jnp.pi * (u - 0.5))
+    x._bind(val.astype(x._value.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill x in place with Geometric(probs) samples on {1, 2, ...}
+    (reference: Tensor.geometric_)."""
+    x = ensure_tensor(x)
+    if isinstance(probs, Tensor):
+        probs = probs._value
+    u = jax.random.uniform(rng.next_key(), x._value.shape, jnp.float32,
+                           minval=jnp.float32(1e-7), maxval=1.0)
+    val = jnp.floor(jnp.log(u) / jnp.log1p(-jnp.float32(probs))) + 1.0
+    x._bind(val.astype(x._value.dtype))
+    return x

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.tensor._ops_common import Tensor, apply, ensure_tensor
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool", "yolo_box"]
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals", "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool", "yolo_box", "prior_box", "matrix_nms", "psroi_pool", "yolo_loss", "read_file", "decode_jpeg"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
@@ -366,3 +366,294 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
         return boxes, scores
 
     return apply("yolo_box", _decode, x, img_size)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0], variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False, steps=[0.0, 0.0], offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) box generation (reference:
+    python/paddle/vision/ops.py prior_box,
+    paddle/phi/kernels/impl/prior_box_kernel_impl.h).  Pure host/np-style
+    jnp math over the static feature-map grid."""
+    input, image = ensure_tensor(input), ensure_tensor(image)
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                ms = float(ms)
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        big = (ms * float(max_sizes[k])) ** 0.5
+                        cell.append((cx, cy, big, big))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * ar**0.5, ms / ar**0.5))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * ar**0.5, ms / ar**0.5))
+                    if max_sizes:
+                        big = (ms * float(max_sizes[k])) ** 0.5
+                        cell.append((cx, cy, big, big))
+            boxes.extend(cell)
+    import numpy as np
+
+    b = np.asarray(boxes, np.float32).reshape(H, W, -1, 4)
+    out = np.empty_like(b)
+    out[..., 0] = (b[..., 0] - b[..., 2] / 2) / img_w
+    out[..., 1] = (b[..., 1] - b[..., 3] / 2) / img_h
+    out[..., 2] = (b[..., 0] + b[..., 2] / 2) / img_w
+    out[..., 3] = (b[..., 1] + b[..., 3] / 2) / img_h
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0, background_label=0, normalized=True, return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference: python/paddle/vision/ops.py matrix_nms,
+    SOLOv2 paper): soft decay of scores by pairwise IoU — O(k^2) matrix math,
+    no sequential suppression loop, which is exactly the TPU-friendly NMS."""
+    import numpy as np
+
+    bboxes, scores = ensure_tensor(bboxes), ensure_tensor(scores)
+    bv = np.asarray(bboxes._value)  # [N, M, 4]
+    sv = np.asarray(scores._value)  # [N, C, M]
+    N, C, M = sv.shape
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(N):
+        dets, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sv[n, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][: int(nms_top_k) if nms_top_k > 0 else None]
+            b = bv[n, order]
+            sc = s[order]
+            # pairwise IoU (upper triangle)
+            x1 = np.maximum(b[:, None, 0], b[None, :, 0])
+            y1 = np.maximum(b[:, None, 1], b[None, :, 1])
+            x2 = np.minimum(b[:, None, 2], b[None, :, 2])
+            y2 = np.minimum(b[:, None, 3], b[None, :, 3])
+            ext = 0.0 if normalized else 1.0
+            inter = np.clip(x2 - x1 + ext, 0, None) * np.clip(y2 - y1 + ext, 0, None)
+            area = np.clip(b[:, 2] - b[:, 0] + ext, 0, None) * np.clip(b[:, 3] - b[:, 1] + ext, 0, None)
+            union = area[:, None] + area[None, :] - inter
+            iou = np.where(union > 0, inter / union, 0.0)
+            iou = np.triu(iou, k=1)  # iou[i, j]: i higher-scored than j
+            # SOLOv2 matrix NMS: decay_j = min_i f(iou_ij) / f(compensate_i),
+            # compensate_i = that suppressor's own max IoU with anything above it
+            comp = iou.max(axis=0)  # compensate per box (as a suppressor)
+            if use_gaussian:
+                dm = np.exp(-(iou**2 - comp[:, None] ** 2) / gaussian_sigma)
+            else:
+                dm = (1.0 - iou) / np.clip(1.0 - comp[:, None], 1e-10, None)
+            dm = np.where(np.triu(np.ones_like(iou), k=1) > 0, dm, np.inf)
+            decay = np.minimum(dm.min(axis=0), 1.0)
+            dec_s = sc * decay
+            sel = dec_s >= post_threshold
+            for i in np.where(sel)[0]:
+                dets.append([c, dec_s[i], *b[i]])
+                idxs.append(n * M + order[i])
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            o = np.argsort(-dets[:, 1])
+            if keep_top_k > 0:
+                o = o[: int(keep_top_k)]
+            dets = dets[o]
+            idxs = np.asarray(idxs, np.int64)[o]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, axis=0)))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(np.concatenate(all_idx))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference: python/paddle/vision/ops.py
+    psroi_pool, R-FCN): channel k of output bin (i, j) averages input channel
+    (k*P*P + i*P + j) over that bin's region."""
+    import numpy as np
+
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    P = int(output_size) if not isinstance(output_size, (tuple, list)) else int(output_size[0])
+    xv = np.asarray(x._value)
+    bv = np.asarray(boxes._value)
+    nv = np.asarray(ensure_tensor(boxes_num)._value)
+    N, C, H, W = xv.shape
+    out_c = C // (P * P)
+    outs = []
+    bi = 0
+    for n in range(N):
+        for _ in range(int(nv[n])):
+            x1, y1, x2, y2 = bv[bi] * spatial_scale
+            bi += 1
+            rw = max((x2 - x1), 0.1) / P
+            rh = max((y2 - y1), 0.1) / P
+            o = np.zeros((out_c, P, P), np.float32)
+            for i in range(P):
+                for j in range(P):
+                    hs, he = int(np.floor(y1 + i * rh)), int(np.ceil(y1 + (i + 1) * rh))
+                    ws, we = int(np.floor(x1 + j * rw)), int(np.ceil(x1 + (j + 1) * rw))
+                    hs, he = np.clip([hs, he], 0, H)
+                    ws, we = np.clip([ws, we], 0, W)
+                    if he > hs and we > ws:
+                        for k in range(out_c):
+                            ch = k * P * P + i * P + j
+                            o[k, i, j] = xv[n, ch, hs:he, ws:we].mean()
+            outs.append(o)
+    return Tensor(jnp.asarray(np.stack(outs) if outs else np.zeros((0, out_c, P, P), np.float32)))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thresh, downsample_ratio, gt_score=None, use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (reference: python/paddle/vision/ops.py yolo_loss,
+    paddle/phi/kernels/cpu/yolo_loss_kernel.cc): objectness + box + class
+    terms against assigned anchors, jnp throughout (autodiffable)."""
+    x, gt_box, gt_label = ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)
+    extras = [ensure_tensor(gt_score)] if gt_score is not None else []
+    an = [float(a) for a in anchors]
+    mask = [int(m) for m in anchor_mask]
+    S = len(mask)
+    C = int(class_num)
+
+    def _fn(xv, gb, gl, *gs):
+        N, _, H, W = xv.shape
+        xv = xv.reshape(N, S, 5 + C, H, W).astype(jnp.float32)
+        px, py = jax.nn.sigmoid(xv[:, :, 0]), jax.nn.sigmoid(xv[:, :, 1])
+        pw, ph = xv[:, :, 2], xv[:, :, 3]
+        pobj = xv[:, :, 4]
+        pcls = xv[:, :, 5:]
+        # grid-relative predicted boxes (normalized)
+        gx = (jnp.arange(W, dtype=jnp.float32)[None, None, None, :] + px) / W
+        gy = (jnp.arange(H, dtype=jnp.float32)[None, None, :, None] + py) / H
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+        aw = jnp.asarray([an[2 * m] for m in mask], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray([an[2 * m + 1] for m in mask], jnp.float32)[None, :, None, None]
+        gw = jnp.exp(pw) * aw / in_w
+        gh = jnp.exp(ph) * ah / in_h
+        # IoU of every predicted box with every gt box -> ignore mask
+        B = gb.shape[1]
+        pb = jnp.stack([gx, gy, gw, gh], axis=-1).reshape(N, -1, 4)  # [N, S*H*W, 4]
+        def iou(a, b):
+            ax1, ay1 = a[..., 0] - a[..., 2] / 2, a[..., 1] - a[..., 3] / 2
+            ax2, ay2 = a[..., 0] + a[..., 2] / 2, a[..., 1] + a[..., 3] / 2
+            bx1, by1 = b[..., 0] - b[..., 2] / 2, b[..., 1] - b[..., 3] / 2
+            bx2, by2 = b[..., 0] + b[..., 2] / 2, b[..., 1] + b[..., 3] / 2
+            ix = jnp.clip(jnp.minimum(ax2[:, :, None], bx2[:, None, :]) - jnp.maximum(ax1[:, :, None], bx1[:, None, :]), 0)
+            iy = jnp.clip(jnp.minimum(ay2[:, :, None], by2[:, None, :]) - jnp.maximum(ay1[:, :, None], by1[:, None, :]), 0)
+            inter = ix * iy
+            ua = (ax2 - ax1) * (ay2 - ay1)
+            ub = (bx2 - bx1) * (by2 - by1)
+            return inter / jnp.clip(ua[:, :, None] + ub[:, None, :] - inter, 1e-10)
+        ious = iou(pb, gb.astype(jnp.float32))  # [N, SHW, B]
+        best_iou = jnp.max(ious, axis=-1).reshape(N, S, H, W)
+        ignore = best_iou > ignore_thresh
+        # gt assignment: each gt lands in cell (floor(gx*W), floor(gy*H)) with
+        # responsible anchor = best-IoU anchor in this mask group (by shape)
+        gtx, gty, gtw, gth = gb[..., 0], gb[..., 1], gb[..., 2], gb[..., 3]
+        valid = gtw > 1e-8  # [N, B]
+        ci = jnp.clip((gtx * W).astype(jnp.int32), 0, W - 1)
+        ri = jnp.clip((gty * H).astype(jnp.int32), 0, H - 1)
+        # shape-IoU with each anchor of this group
+        wa = gtw[..., None] * in_w
+        ha = gth[..., None] * in_h
+        inter = jnp.minimum(wa, aw.reshape(1, 1, S)) * jnp.minimum(ha, ah.reshape(1, 1, S))
+        s_iou = inter / jnp.clip(wa * ha + aw.reshape(1, 1, S) * ah.reshape(1, 1, S) - inter, 1e-10)
+        best_a = jnp.argmax(s_iou, axis=-1)  # [N, B]
+        # scatter targets
+        tobj = jnp.zeros((N, S, H, W))
+        bidx = jnp.arange(N)[:, None].repeat(gb.shape[1], 1)
+        w_obj = gs[0].astype(jnp.float32) if gs else jnp.ones_like(gtx)
+        w_obj = jnp.where(valid, w_obj, 0.0)
+        tobj = tobj.at[bidx, best_a, ri, ci].max(w_obj)
+        tx = gtx * W - ci
+        ty = gty * H - ri
+        tw = jnp.log(jnp.clip(gtw * in_w / jnp.take(aw.reshape(-1), best_a), 1e-9))
+        th = jnp.log(jnp.clip(gth * in_h / jnp.take(ah.reshape(-1), best_a), 1e-9))
+        box_scale = 2.0 - gtw * gth
+        def at_cells(pred):
+            return pred[bidx, best_a, ri, ci]
+        bce = lambda lo, t: jnp.maximum(lo, 0) - lo * t + jnp.log1p(jnp.exp(-jnp.abs(lo)))
+        vm = w_obj
+        loss_xy = jnp.sum((bce(at_cells(xv[:, :, 0]), tx) + bce(at_cells(xv[:, :, 1]), ty)) * box_scale * vm, axis=1)
+        loss_wh = jnp.sum((jnp.abs(at_cells(pw) - tw) + jnp.abs(at_cells(ph) - th)) * box_scale * vm, axis=1)
+        obj_mask = tobj > 0
+        loss_obj = jnp.sum(bce(pobj, tobj) * jnp.where(~obj_mask & ignore, 0.0, 1.0), axis=(1, 2, 3))
+        smooth = 1.0 / C if use_label_smooth else 0.0
+        tcls = jax.nn.one_hot(gl.astype(jnp.int32), C) * (1.0 - smooth) + smooth / 2.0
+        pcls_cells = jnp.transpose(pcls, (0, 1, 3, 4, 2))[bidx, best_a, ri, ci]
+        loss_cls = jnp.sum(jnp.sum(bce(pcls_cells, tcls), axis=-1) * vm, axis=1)
+        return (loss_xy + loss_wh + loss_obj + loss_cls).astype(jnp.float32)
+
+    return apply("yolo_loss", _fn, x, gt_box, gt_label, *extras)
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (reference: paddle.vision.ops.read_file)."""
+    import numpy as np
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference:
+    paddle.vision.ops.decode_jpeg over nvjpeg).  Host-side decode via PIL —
+    image IO is a host job on TPU; the device path starts at the batch."""
+    import io
+
+    import numpy as np
+
+    x = ensure_tensor(x)
+    data = bytes(np.asarray(x._value).astype(np.uint8))
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs Pillow on the host") from e
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+class PSRoIPool:
+    """Layer wrapper over psroi_pool (reference: paddle.vision.ops.PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)

@@ -1,0 +1,164 @@
+"""Top-level API surface parity + numerics of the round-2 closure ops.
+
+The reference exports 387 names from python/paddle/__init__.py; every one
+must resolve on paddle_tpu.  Plus NumPy-reference checks for the ops added
+to close the gap (unflatten, index_fill, diagonal_scatter, select_scatter,
+pdist, add_n, reverse) and the framework defaults surface.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_reference_top_level_surface_complete():
+    src = open("/root/reference/python/paddle/__init__.py").read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    ref_all = set(re.findall(r"'([^']+)'", m.group(1)))
+    missing = sorted(n for n in ref_all if not hasattr(paddle, n))
+    assert not missing, f"{len(missing)} missing top-level names: {missing[:20]}"
+
+
+def test_unflatten():
+    x = paddle.arange(24).reshape([2, 12])
+    out = paddle.unflatten(x, 1, [3, 4])
+    assert out.shape == [2, 3, 4]
+    out2 = paddle.unflatten(x, 1, [3, -1])
+    np.testing.assert_array_equal(np.asarray(out._value), np.asarray(out2._value))
+
+
+def test_index_fill_and_inplace():
+    x = paddle.zeros([4, 3])
+    idx = paddle.to_tensor(np.array([0, 2], np.int32))
+    out = paddle.index_fill(x, idx, 0, 7.0)
+    ref = np.zeros((4, 3), np.float32)
+    ref[[0, 2]] = 7.0
+    np.testing.assert_array_equal(np.asarray(out._value), ref)
+    x.index_fill_(idx, 0, 7.0)
+    np.testing.assert_array_equal(np.asarray(x._value), ref)
+
+
+@pytest.mark.parametrize("offset", [0, 1, -1])
+def test_diagonal_scatter(offset):
+    x = np.zeros((4, 5), np.float32)
+    L = np.diagonal(x, offset=offset).shape[0]
+    y = np.arange(1, L + 1, dtype=np.float32)
+    out = paddle.diagonal_scatter(paddle.to_tensor(x), paddle.to_tensor(y), offset=offset)
+    ref = x.copy()
+    i = np.arange(L)
+    if offset >= 0:
+        ref[i, i + offset] = y
+    else:
+        ref[i - offset, i] = y
+    np.testing.assert_array_equal(np.asarray(out._value), ref)
+
+
+def test_select_scatter():
+    x = paddle.zeros([3, 4])
+    v = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    out = paddle.select_scatter(x, v, 0, 1)
+    ref = np.zeros((3, 4), np.float32)
+    ref[1] = [1, 2, 3, 4]
+    np.testing.assert_array_equal(np.asarray(out._value), ref)
+
+
+def test_pdist():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    out = np.asarray(paddle.pdist(paddle.to_tensor(x))._value)
+    iu, ju = np.triu_indices(5, k=1)
+    ref = np.linalg.norm(x[iu] - x[ju], axis=-1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_add_n_and_reverse():
+    a, b = paddle.ones([2, 2]), paddle.full([2, 2], 2.0)
+    np.testing.assert_array_equal(np.asarray(paddle.add_n([a, b])._value), np.full((2, 2), 3.0, np.float32))
+    x = paddle.arange(4)
+    np.testing.assert_array_equal(np.asarray(paddle.reverse(x, 0)._value), [3, 2, 1, 0])
+
+
+def test_generated_inplace_tier():
+    x = paddle.to_tensor(np.array([0.5, 1.0], np.float32))
+    y = paddle.cos(x)
+    x.cos_()
+    np.testing.assert_allclose(np.asarray(x._value), np.asarray(y._value))
+    z = paddle.to_tensor(np.ones((3, 3), np.float32))
+    z.tril_()
+    np.testing.assert_array_equal(np.asarray(z._value), np.tril(np.ones((3, 3), np.float32)))
+    # module-level generated names are exported
+    assert callable(paddle.log10_) and callable(paddle.bitwise_not_)
+
+
+def test_random_inplace_fills():
+    paddle.seed(7)
+    x = paddle.zeros([2000])
+    x.cauchy_(loc=1.0, scale=2.0)
+    med = float(np.median(np.asarray(x._value)))
+    assert abs(med - 1.0) < 0.3  # Cauchy median = loc
+    g = paddle.zeros([2000])
+    g.geometric_(0.5)
+    vals = np.asarray(g._value)
+    assert vals.min() >= 1.0 and abs(vals.mean() - 2.0) < 0.2  # E[X] = 1/p
+
+
+def test_finfo_iinfo_default_dtype():
+    assert paddle.finfo(paddle.bfloat16).bits == 16
+    assert paddle.finfo("float32").eps == np.finfo(np.float32).eps
+    assert paddle.iinfo(paddle.int8).max == 127
+    assert paddle.get_default_dtype() == "float32"
+    paddle.set_default_dtype("bfloat16")
+    try:
+        assert paddle.get_default_dtype() == "bfloat16"
+        # float64 narrows to float32 (framework-wide no-64-bit policy)
+        paddle.set_default_dtype("float64")
+        assert paddle.get_default_dtype() == "float32"
+    finally:
+        paddle.set_default_dtype("float32")
+    with pytest.raises(TypeError):
+        paddle.set_default_dtype("int32")
+
+
+def test_batch_reader():
+    reader = paddle.batch(lambda: iter(range(10)), batch_size=4)
+    batches = list(reader())
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    reader = paddle.batch(lambda: iter(range(10)), batch_size=4, drop_last=True)
+    assert list(reader()) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_create_parameter_and_param_attr():
+    p = paddle.create_parameter([4, 4], "float32", attr=paddle.ParamAttr(learning_rate=0.5))
+    assert p.shape == [4, 4] and p.optimize_attr["learning_rate"] == 0.5
+    b = paddle.create_parameter([4], "float32", is_bias=True)
+    np.testing.assert_array_equal(np.asarray(b._value), np.zeros(4, np.float32))
+
+
+def test_lazy_guard_host_then_initialize():
+    import jax
+
+    with paddle.LazyGuard():
+        lin = paddle.nn.Linear(8, 8)
+    w = lin.weight
+    assert "cpu" in str(next(iter(w._value.devices()))).lower()
+    w.initialize()
+    y = lin(paddle.ones([2, 8]))
+    assert np.isfinite(np.asarray(y._value)).all()
+
+
+def test_cuda_compat_place_and_rng():
+    place = paddle.CUDAPlace(0)
+    assert place.jax_device() is not None
+    assert isinstance(paddle.CUDAPinnedPlace(), paddle.CPUPlace)
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+
+
+def test_tolist_and_t_():
+    assert paddle.tolist(paddle.arange(3)) == [0, 1, 2]
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.t_()
+    assert x.shape == [3, 2]
