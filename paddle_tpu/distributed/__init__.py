@@ -61,3 +61,31 @@ from .env import (  # noqa: F401
     init_parallel_env,
     is_initialized,
 )
+
+# compat tier: enums, split, shard_optimizer, DistModel bridge, spawn,
+# gloo trio, PS entry configs (reference __all__ closure)
+from .compat import (  # noqa: F401,E402
+    CountFilterEntry,
+    DistAttr,
+    ParallelMode,
+    ProbabilityEntry,
+    ReduceType,
+    ShowClickEntry,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    shard_optimizer,
+    spawn,
+    split,
+    to_static,
+)
+from .communication.ops import (  # noqa: F401,E402
+    broadcast_object_list,
+    gather,
+    get_backend,
+    scatter_object_list,
+)
+from . import io  # noqa: F401,E402
+from .auto_parallel.engine import Strategy  # noqa: F401,E402
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+from paddle_tpu.io import InMemoryDataset, QueueDataset  # noqa: F401,E402

@@ -453,3 +453,80 @@ def wait(tensor, group=None, use_calc_stream=True):
         with comm_watch("wait", group=group):
             tensor._value.block_until_ready()
     return _Task(tensor)
+
+
+_obj_seq = [0]
+
+
+def _next_obj_seq():
+    _obj_seq[0] += 1
+    return _obj_seq[0]
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (reference: communication/gather.py).  In SPMD axis
+    mode XLA collectives are rank-symmetric, so this lowers to all_gather
+    (every rank materializes the list; dst semantics are free).  World-1:
+    the local value."""
+    if _world(group) == 1:
+        if gather_list is not None:
+            gather_list.append(tensor)
+        return _Task(tensor)
+    out = all_gather(gather_list if gather_list is not None else [], tensor, group)
+    return out
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list — pickled
+    host objects ride the rendezvous store (control plane), not ICI."""
+    if _world(group) == 1:
+        return _Task(object_list)
+    import pickle
+
+    from paddle_tpu.distributed import env as _env
+    from .watchdog import get_rendezvous_store
+
+    store = get_rendezvous_store()
+    if store is None:
+        raise RuntimeError("broadcast_object_list needs a rendezvous store (set_rendezvous_store/launch) outside world-1")
+    rank = _env.get_rank()
+    key = f"bcast_obj/{_next_obj_seq()}"
+    if rank == src:
+        store.set(key, pickle.dumps(list(object_list)))
+    else:
+        payload = pickle.loads(store.get(key))
+        object_list[:] = payload
+    return _Task(object_list)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """reference: communication/scatter.py scatter_object_list."""
+    if _world(group) == 1:
+        out_object_list[:] = [in_object_list[0] if in_object_list else None]
+        return _Task(out_object_list)
+    import pickle
+
+    from paddle_tpu.distributed import env as _env
+    from .watchdog import get_rendezvous_store
+
+    store = get_rendezvous_store()
+    if store is None:
+        raise RuntimeError("scatter_object_list needs a rendezvous store (set_rendezvous_store/launch) outside world-1")
+    rank, world = _env.get_rank(), _env.get_world_size()
+    key = f"scatter_obj/{_next_obj_seq()}"
+    if rank == src:
+        store.set(key, pickle.dumps(list(in_object_list)))
+        out_object_list[:] = [in_object_list[rank]]
+    else:
+        payload = pickle.loads(store.get(key))
+        out_object_list[:] = [payload[rank]]
+    return _Task(out_object_list)
+
+
+def get_backend(group=None):
+    """reference: communication/group.py get_backend — the comm transport.
+    XLA collectives ride ICI/DCN via the jax backend; report it."""
+    return "xla:" + jax.default_backend()
+
+
+__all__ += ["gather", "broadcast_object_list", "scatter_object_list", "get_backend"]

@@ -1,0 +1,291 @@
+"""Distributed surface compat tier (reference:
+python/paddle/distributed/__init__.py __all__).
+
+The substantial machinery lives elsewhere (auto_parallel/, fleet/,
+communication/, ps/, checkpoint/); this module supplies the remaining
+reference exports: mode/type enums, the Megatron `split` op, shard_optimizer
+and the dygraph->static DistModel bridge, spawn, the gloo_* CPU-barrier trio
+(over the native TCPStore), and the PS sparse-table entry configs.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ParallelMode",
+    "ReduceType",
+    "DistAttr",
+    "split",
+    "shard_optimizer",
+    "to_static",
+    "spawn",
+    "gloo_init_parallel_env",
+    "gloo_barrier",
+    "gloo_release",
+    "CountFilterEntry",
+    "ProbabilityEntry",
+    "ShowClickEntry",
+]
+
+
+class ParallelMode:
+    """reference: python/paddle/distributed/parallel.py ParallelMode."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """reference: auto_parallel placement reduce types (dist_attr.h)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Tensor distribution attribute (reference:
+    paddle/phi/core/distributed/auto_parallel/dist_attr.h TensorDistAttr):
+    carries (process_mesh, placements); sharding_of() maps it onto a
+    NamedSharding for GSPMD."""
+
+    def __init__(self, mesh=None, sharding_specs=None, placements=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+        self.placements = placements
+
+    def sharding(self):
+        from .auto_parallel.api import sharding_of
+
+        return sharding_of(self.process_mesh, self.placements)
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Megatron column/row split op (reference:
+    python/paddle/distributed/collective.py split): one-call model-parallel
+    linear/embedding over the mp group.  Builds the matching mpu layer and
+    applies it; with no hybrid mp group (single process), falls back to the
+    plain layer — the reference's nranks==1 path.
+    """
+    import paddle_tpu as paddle
+    from .fleet.fleet import get_hybrid_communicate_group
+
+    try:
+        hcg = get_hybrid_communicate_group()
+        mp = hcg.get_model_parallel_world_size()
+    except Exception:
+        mp = 1
+    if operation == "linear":
+        in_f, out_f = int(size[0]), int(size[1])
+        if mp > 1:
+            from .fleet.layers.mpu.mp_layers import ColumnParallelLinear, RowParallelLinear
+
+            if axis == 1:
+                layer = ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr, has_bias=bias_attr is not False, gather_output=gather_out)
+            else:
+                layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr, has_bias=bias_attr is not False, input_is_parallel=False)
+        else:
+            layer = paddle.nn.Linear(in_f, out_f, weight_attr=weight_attr, bias_attr=bias_attr)
+        return layer(x)
+    if operation == "embedding":
+        num_emb, emb_dim = int(size[0]), int(size[1])
+        if mp > 1:
+            from .fleet.layers.mpu.mp_layers import VocabParallelEmbedding
+
+            layer = VocabParallelEmbedding(num_emb, emb_dim, weight_attr=weight_attr)
+        else:
+            layer = paddle.nn.Embedding(num_emb, emb_dim, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """reference: python/paddle/distributed/auto_parallel/api.py
+    shard_optimizer — mark optimizer states for sharded placement.
+
+    GSPMD path: ShardedTrainStep already lays optimizer accumulators out
+    with their parameters' shardings (distributed/sharded_step.py).  This
+    records an optional per-state shard_fn consulted when states are
+    created: shard_fn(accumulator_name, param, accumulator) -> placements.
+    """
+    optimizer._shard_fn = shard_fn
+    if shard_fn is not None:
+        from .auto_parallel.api import shard_tensor  # noqa: F401 (applied lazily)
+
+        orig_acc = optimizer._acc
+
+        def sharded_acc(name, p, init=None, dtype=None):
+            t = orig_acc(name, p, init, dtype)
+            try:
+                placements = shard_fn(name, p, t)
+            except TypeError:
+                placements = None
+            if placements is not None and getattr(p, "_dist_mesh", None) is not None:
+                from .auto_parallel.api import _mark_dist
+
+                _mark_dist(t, p._dist_mesh, placements)
+            return t
+
+        optimizer._acc = sharded_acc
+    return optimizer
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Dygraph semi-auto -> static engine bridge (reference:
+    python/paddle/distributed/auto_parallel/api.py to_static -> DistModel):
+    wraps the layer in an Engine-backed DistModel running the whole step as
+    one GSPMD executable."""
+    from .auto_parallel.engine import Engine
+
+    eng = Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)
+
+    class DistModel:
+        def __init__(self):
+            self._engine = eng
+            self._mode = "train"
+            self._loader = loader
+            self._model = layer
+
+        def train(self):
+            self._mode = "train"
+
+        def eval(self):
+            self._mode = "eval"
+
+        def predict(self):
+            self._mode = "predict"
+
+        def __call__(self, *inputs):
+            if self._mode == "train":
+                if len(inputs) < 2:
+                    raise ValueError("DistModel train step expects (*inputs, labels)")
+                mesh = self._engine._infer_mesh()
+                self._engine._ensure_train_step(mesh)
+                return self._engine._train_step(*inputs)
+            out = self._engine._compiled_forward()(*inputs)
+            if self._mode == "eval" and loss is not None:
+                return loss(out, inputs[-1]) if len(inputs) >= 2 else out
+            return out
+
+        def state_dict(self):
+            return self._model.state_dict()
+
+        def dist_main_program(self, mode=None):
+            return self._engine.main_program
+
+    return DistModel()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: python/paddle/distributed/spawn.py — start nprocs worker
+    processes with fabricated cluster env and run func(rank) in each.  On
+    TPU the per-process world is CPU/virtual-device based (tests' fake
+    cluster strategy, SURVEY §4); multi-chip SPMD does not need spawn."""
+    import multiprocessing as mp
+    import os
+
+    import socket
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) or 1
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "RANK": str(rank),
+            "WORLD_SIZE": str(nprocs),
+        }
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawn: worker exited with {bad}")
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    import os
+
+    os.environ.update(env)
+    func(*args)
+
+
+# ------------------------------------------------------------------- gloo
+_gloo = {"store": None, "server": None, "rank": 0, "world": 1}
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: paddle.distributed.gloo_init_parallel_env — CPU-only
+    barrier group.  The native TCPStore plays gloo's role."""
+    from .bootstrap import host_or_connect
+
+    client = host_or_connect(server_endpoint, is_host=(int(rank_id) == 0))
+    _gloo.update(store=client, rank=int(rank_id), world=int(rank_num))
+
+
+def gloo_barrier():
+    from .bootstrap import store_barrier
+
+    if _gloo["store"] is None:
+        raise RuntimeError("gloo_barrier before gloo_init_parallel_env")
+    _gloo["seq"] = _gloo.get("seq", 0) + 1
+    store_barrier(_gloo["store"], f"gloo_barrier/{_gloo['seq']}", _gloo["world"])
+
+
+def gloo_release():
+    _gloo.update(store=None, server=None)
+
+
+# ------------------------------------------------- PS sparse-table entries
+class CountFilterEntry:
+    """reference: python/paddle/distributed/entry_attr.py CountFilterEntry —
+    admit a sparse feature into the table after `count_filter` shows."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ProbabilityEntry:
+    """reference: entry_attr.py ProbabilityEntry — admit with probability."""
+
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class ShowClickEntry:
+    """reference: entry_attr.py ShowClickEntry — show/click-weighted entry."""
+
+    def __init__(self, show_name, click_name):
+        self._show = str(show_name)
+        self._click = str(click_name)
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"

@@ -1,0 +1,31 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py):
+persistable save/load helpers for distributed programs."""
+
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a static Program's persistables (reference io.py
+    save_persistables) through static.io's serializer."""
+    import os
+
+    from paddle_tpu.static.compat import serialize_persistables, save_to_file
+
+    os.makedirs(dirname, exist_ok=True)
+    blob = serialize_persistables(None, None, executor, main_program)
+    save_to_file(os.path.join(dirname, filename or "__params__"), blob)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from paddle_tpu.static.compat import deserialize_persistables, load_from_file
+
+    blob = load_from_file(os.path.join(dirname, filename or "__params__"))
+    return deserialize_persistables(main_program, blob, executor)

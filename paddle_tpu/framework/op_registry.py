@@ -145,6 +145,7 @@ _INPLACE_BASES = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "floor_divide", "floor_mod", "mod", "tril", "triu",
     "pow", "lerp", "fill_diagonal", "put_along_axis", "index_add",
+    "erfinv", "flatten", "index_put", "sigmoid",
 ]
 
 

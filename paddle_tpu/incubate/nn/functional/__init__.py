@@ -441,6 +441,14 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases, li
     applies L fused transformer layers in sequence."""
     out = ensure_tensor(x)
     L_layers = len(qkv_weights)
+    if cache_kvs is not None or time_step is not None or pre_caches is not None or rotary_embs is not None or seq_lens is not None:
+        # incremental decoding lives in the paged/serving tier
+        raise NotImplementedError(
+            "fused_multi_transformer: cache_kvs/time_step/pre_caches/"
+            "rotary_embs/seq_lens (incremental decode) are served by "
+            "paddle_tpu.incubate.nn.functional.block_multihead_attention / "
+            "masked_multihead_attention and LlamaForCausalLM.generate"
+        )
     if not trans_qkvw:
         # [E, 3*E]-layout weights carry no head count; the [3, nh, hd, E]
         # layout (trans_qkvw=True, the reference default) is required here

@@ -592,3 +592,12 @@ class InMemoryDataset(Dataset):
 
     def __getitem__(self, idx):
         return self._samples[idx]
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference: python/paddle/distributed/fleet/dataset
+    QueueDataset): samples are consumed epoch-by-epoch from files without a
+    global shuffle (single-pass queue semantics)."""
+
+    def global_shuffle(self, seed=0):
+        raise RuntimeError("QueueDataset is single-pass; use InMemoryDataset for global_shuffle")

@@ -581,6 +581,11 @@ def ast_transform(fn):
             fn_locals.add(fdef.args.kwarg.arg)
         new_tree = _ControlFlowTransformer(fn_locals).visit(tree)
         ast.fix_missing_locations(new_tree)
+        from paddle_tpu import jit as _jit_mod
+
+        if getattr(_jit_mod, "_dy2static_log_level", 0) > 0:
+            # paddle.jit.set_code_level: print the transformed source
+            print(f"[dy2static] transformed code of {func.__name__}:\n{ast.unparse(new_tree)}")
         code = compile(new_tree, filename=f"<dy2static {func.__name__}>", mode="exec")
         from paddle_tpu.jit import dy2static as _rt
 

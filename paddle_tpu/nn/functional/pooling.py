@@ -29,13 +29,15 @@ def _pads(padding, n):
     raise ValueError(f"bad padding {padding}")
 
 
-def _ceil_extra(n, k, s, p):
+def _ceil_extra(n, k, s, lo, hi):
     """Extra right-padding making reduce_window emit the ceil-mode output
-    size: out = ceil((n + 2p - k)/s) + 1 (reference pooling ceil semantics)."""
+    size: out = ceil((n + lo + hi - k)/s) + 1 (reference pooling ceil
+    semantics; lo/hi may differ under 2n-form padding)."""
     import math
 
-    out = math.ceil(max(n + 2 * p - k, 0) / s) + 1
-    return max((out - 1) * s + k - (n + 2 * p), 0)
+    total = n + lo + hi
+    out = math.ceil(max(total - k, 0) / s) + 1
+    return max((out - 1) * s + k - total, 0)
 
 
 def _pool(x, kernel, stride, padding, nd, data_format, reducer, init, ceil_mode=False, count_include_pad=True, is_avg=False):
@@ -56,7 +58,7 @@ def _pool(x, kernel, stride, padding, nd, data_format, reducer, init, ceil_mode=
         if ceil_mode:
             sp_shape = x.shape[1 : 1 + nd] if channel_last else x.shape[2 : 2 + nd]
             pd = [
-                (lo, hi + _ceil_extra(int(n), k, s, lo))
+                (lo, hi + _ceil_extra(int(n), k, s, lo, hi))
                 for (lo, hi), n, k, s in zip(pd, sp_shape, ks, st)
             ]
         pad_full = ([(0, 0)] + list(pd) + [(0, 0)]) if channel_last else ([(0, 0), (0, 0)] + list(pd))
@@ -89,13 +91,20 @@ def _max_pool_with_mask(x, kernel_size, stride, padding, nd, data_format, ceil_m
         raise ValueError("return_mask supports channel-first layouts only")
     if ceil_mode:
         pd = [
-            (lo, hi + _ceil_extra(int(n), k, s, lo))
+            (lo, hi + _ceil_extra(int(n), k, s, lo, hi))
             for (lo, hi), n, k, s in zip(pd, x.shape[2 : 2 + nd], ks, st)
         ]
 
     def _fn(v):
         N, C = v.shape[0], v.shape[1]
         spatial = v.shape[2:]
+        if int(np.prod(spatial)) > (1 << 24):
+            # indices ride a float32 patch extraction; above 2^24 they lose
+            # exactness and unpool would scatter to wrong positions
+            raise ValueError(
+                "return_mask supports spatial maps up to 2^24 elements per "
+                f"channel; got {int(np.prod(spatial))}"
+            )
         flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(1, 1, *spatial)
         flat_idx = jnp.broadcast_to(flat_idx, v.shape)
         # pad values with -inf (never wins argmax) and indices with 0 BEFORE

@@ -110,6 +110,26 @@ _patch_operators()
 
 from .array import array_length, array_read, array_write, create_array  # noqa: F401,E402
 
+# signal-domain tensor methods (reference tensor_method_func includes stft/istft)
+from paddle_tpu import signal as _signal  # noqa: E402
+
+Tensor.stft = _signal.stft
+Tensor.istft = _signal.istft
+stft = _signal.stft
+istft = _signal.istft
+
+# reference tensor_method_func attaches even multi-tensor/creation entry
+# points as methods (self = first argument); match that surface exactly
+from .manipulation import broadcast_tensors as _bt  # noqa: E402
+from .linalg import multi_dot as _md  # noqa: E402
+
+Tensor.broadcast_shape = lambda self, y: math.broadcast_shape(self.shape, y.shape if isinstance(y, Tensor) else y)
+Tensor.broadcast_tensors = lambda self, *o: _bt([self, *o])
+Tensor.multi_dot = lambda self, *o: _md([self, *(o[0] if len(o) == 1 and isinstance(o[0], (list, tuple)) else o)])
+Tensor.multiplex = lambda self, index: math.multiplex(self, index)
+Tensor.scatter_nd = lambda self, updates, shape: manipulation.scatter_nd(self, updates, shape)
+Tensor.create_parameter = staticmethod(lambda *a, **k: __import__("paddle_tpu.framework.defaults", fromlist=["create_parameter"]).create_parameter(*a, **k))
+
 # generated in-place op tier (framework/op_registry codegen)
 from paddle_tpu.framework.op_registry import generate_inplace_variants as _gen_inplace  # noqa: E402
 _gen_inplace()

@@ -238,3 +238,12 @@ def one_hot(x, num_classes, name=None):
         x,
     )
 
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Empty 0-d tensor holder of the given dtype (reference:
+    python/paddle/tensor/creation.py:233)."""
+    t = Tensor(jnp.zeros((), to_jax_dtype(dtype)))
+    t.name = name or ""
+    t.persistable = persistable
+    return t

@@ -348,3 +348,39 @@ def orthogonalize(x, name=None):
 def multi_dot(x, name=None):
     tensors = [ensure_tensor(t) for t in x]
     return apply("multi_dot", lambda *vs: jnp.linalg.multi_dot(list(vs)), *tensors)
+
+
+def inverse(x, name=None):
+    """Alias of linalg.inv (reference: paddle.inverse)."""
+    return inv(x)
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (reference: paddle.linalg.cond): p in
+    {None/'fro', 'nuc', 1, -1, 2, -2, inf, -inf}."""
+    x = ensure_tensor(x)
+
+    def _fn(v):
+        vf = v.astype(jnp.float32)
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(vf, compute_uv=False)
+            if p == -2:
+                return (s[..., -1] / s[..., 0]).astype(v.dtype)
+            return (s[..., 0] / s[..., -1]).astype(v.dtype)
+        if p == "fro":
+            n = jnp.sqrt(jnp.sum(vf * vf, axis=(-2, -1)))
+            ninv = jnp.sqrt(jnp.sum(jnp.linalg.inv(vf) ** 2, axis=(-2, -1)))
+            return (n * ninv).astype(v.dtype)
+        if p == "nuc":
+            s = jnp.linalg.svd(vf, compute_uv=False)
+            si = jnp.linalg.svd(jnp.linalg.inv(vf), compute_uv=False)
+            return (jnp.sum(s, -1) * jnp.sum(si, -1)).astype(v.dtype)
+        # 1-norm: max over columns of column sums (sum rows, axis=-2);
+        # inf-norm: max over rows of row sums (sum cols, axis=-1)
+        axis = -2 if p in (1, -1) else -1
+        red = jnp.max if p in (1, float("inf")) else jnp.min
+        n = red(jnp.sum(jnp.abs(vf), axis=axis), axis=-1)
+        ninv = red(jnp.sum(jnp.abs(jnp.linalg.inv(vf)), axis=axis), axis=-1)
+        return (n * ninv).astype(v.dtype)
+
+    return apply("cond", _fn, x)

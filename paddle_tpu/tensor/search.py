@@ -184,3 +184,32 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     return apply(
         "bucketize", lambda v, seq: jnp.searchsorted(seq, v, side=side).astype(dt), x, sorted_sequence
     )
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus (top-p) sampling over the last axis (reference:
+    paddle.tensor.top_p_sampling, paddle/phi/kernels/gpu/top_p_sampling
+    kernel — the serving sampler).  x: [B, V] probabilities, ps: [B] or
+    [B, 1] cumulative-probability cutoffs.  Returns (scores, ids)."""
+    from paddle_tpu._core import random as rng
+
+    x, ps = ensure_tensor(x), ensure_tensor(ps)
+    key = jax.random.key(seed) if seed not in (None, -1) else rng.next_key()
+
+    def _fn(v, p):
+        probs = v.astype(jnp.float32)
+        p = p.reshape(-1, 1).astype(jnp.float32)
+        sort_p = jnp.sort(probs, axis=-1)[:, ::-1]
+        sort_i = jnp.argsort(-probs, axis=-1)
+        cum = jnp.cumsum(sort_p, axis=-1)
+        # keep the smallest prefix with cumsum >= p (always keep top-1)
+        keep = (cum - sort_p) < p
+        keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, sort_p, 0.0)
+        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(jnp.clip(masked, 1e-38)), axis=-1)
+        ids = jnp.take_along_axis(sort_i, choice[:, None], axis=-1)
+        scores = jnp.take_along_axis(probs, ids, axis=-1).astype(v.dtype)
+        return scores, ids.astype(jnp.int32)
+
+    return apply("top_p_sampling", _fn, x, ps, n_outputs=2)

@@ -386,33 +386,32 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0], vari
             if flip:
                 ars.append(1.0 / float(ar))
 
-    boxes = []
-    for h in range(H):
-        for w in range(W):
-            cx = (w + offset) * step_w
-            cy = (h + offset) * step_h
-            cell = []
-            for k, ms in enumerate(min_sizes):
-                ms = float(ms)
-                if min_max_aspect_ratios_order:
-                    cell.append((cx, cy, ms, ms))
-                    if max_sizes:
-                        big = (ms * float(max_sizes[k])) ** 0.5
-                        cell.append((cx, cy, big, big))
-                    for ar in ars:
-                        if abs(ar - 1.0) < 1e-6:
-                            continue
-                        cell.append((cx, cy, ms * ar**0.5, ms / ar**0.5))
-                else:
-                    for ar in ars:
-                        cell.append((cx, cy, ms * ar**0.5, ms / ar**0.5))
-                    if max_sizes:
-                        big = (ms * float(max_sizes[k])) ** 0.5
-                        cell.append((cx, cy, big, big))
-            boxes.extend(cell)
     import numpy as np
 
-    b = np.asarray(boxes, np.float32).reshape(H, W, -1, 4)
+    # per-anchor (w, h) set is cell-independent: build it once, broadcast
+    # against the center grid (the reference kernel's loop order, vectorized)
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = (ms * float(max_sizes[k])) ** 0.5
+                whs.append((big, big))
+            whs.extend((ms * ar**0.5, ms / ar**0.5) for ar in ars if abs(ar - 1.0) >= 1e-6)
+        else:
+            whs.extend((ms * ar**0.5, ms / ar**0.5) for ar in ars)
+            if max_sizes:
+                big = (ms * float(max_sizes[k])) ** 0.5
+                whs.append((big, big))
+    wh = np.asarray(whs, np.float32)  # [A, 2]
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    b = np.empty((H, W, len(whs), 4), np.float32)
+    b[..., 0] = cx[None, :, None]
+    b[..., 1] = cy[:, None, None]
+    b[..., 2] = wh[None, None, :, 0]
+    b[..., 3] = wh[None, None, :, 1]
     out = np.empty_like(b)
     out[..., 0] = (b[..., 0] - b[..., 2] / 2) / img_w
     out[..., 1] = (b[..., 1] - b[..., 3] / 2) / img_h
