@@ -2,7 +2,7 @@
 functional, features, backends (wave IO), datasets (ESC50, TESS))."""
 
 from . import features, functional  # noqa: F401
-from .backends import load, save  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from . import backends, datasets  # noqa: F401
 
-__all__ = ["functional", "features", "backends", "datasets", "load", "save"]
+__all__ = ["functional", "features", "backends", "datasets", "load", "save", "info"]

@@ -186,3 +186,86 @@ from .plugin import (  # noqa: F401,E402
     registered_custom_devices,
     scan_custom_device_plugins,
 )
+
+
+# ----------------------------------------------------- compile-flag predicates
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    """Distributed support is built in (jax.distributed + GSPMD)."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type):
+    """True when a PJRT plugin backend of this name is registered
+    (reference: custom-device runtime query)."""
+    import jax
+
+    try:
+        return any(d.platform == device_type for d in jax.devices(device_type))
+    except RuntimeError:
+        return False
+
+
+def get_available_custom_device():
+    """All devices of non-default PJRT backends (reference:
+    paddle.device.get_available_custom_device)."""
+    import jax
+
+    out = []
+    default = jax.default_backend()
+    for plat in ("cpu", "tpu"):
+        if plat == default:
+            continue
+        try:
+            out.append([f"{d.platform}:{d.id}" for d in jax.devices(plat)])
+        except RuntimeError:
+            pass
+    return [d for sub in out for d in sub]
+
+
+def get_cudnn_version():
+    """No cuDNN on this backend (reference returns None when not compiled
+    with CUDA)."""
+    return None
+
+
+def set_stream(stream=None):
+    """Streams are XLA-managed on TPU; accepted for API compat, returns the
+    previous (None) stream like the reference's setter contract."""
+    return None
+
+
+class XPUPlace:
+    def __init__(self, *a, **k):
+        raise RuntimeError("XPU backend is not available in paddle_tpu (TPU-native build)")
+
+
+class IPUPlace:
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU backend is not available in paddle_tpu (TPU-native build)")
+
+__all__ += [
+    "is_compiled_with_cuda", "is_compiled_with_rocm", "is_compiled_with_xpu",
+    "is_compiled_with_ipu", "is_compiled_with_cinn", "is_compiled_with_distribute",
+    "is_compiled_with_custom_device", "get_available_custom_device",
+    "get_cudnn_version", "set_stream", "XPUPlace", "IPUPlace",
+]

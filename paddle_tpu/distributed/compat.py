@@ -167,10 +167,11 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
                 mesh = self._engine._infer_mesh()
                 self._engine._ensure_train_step(mesh)
                 return self._engine._train_step(*inputs)
-            out = self._engine._compiled_forward()(*inputs)
-            if self._mode == "eval" and loss is not None:
-                return loss(out, inputs[-1]) if len(inputs) >= 2 else out
-            return out
+            if self._mode == "eval" and loss is not None and len(inputs) >= 2:
+                *feats, labels = inputs
+                out = self._engine._compiled_forward()(*feats)
+                return loss(out, labels)
+            return self._engine._compiled_forward()(*inputs)
 
         def state_dict(self):
             return self._model.state_dict()
@@ -237,8 +238,8 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     barrier group.  The native TCPStore plays gloo's role."""
     from .bootstrap import host_or_connect
 
-    client = host_or_connect(server_endpoint, is_host=(int(rank_id) == 0))
-    _gloo.update(store=client, rank=int(rank_id), world=int(rank_num))
+    server, client = host_or_connect(server_endpoint, is_host=(int(rank_id) == 0))
+    _gloo.update(store=client, server=server, rank=int(rank_id), world=int(rank_num))
 
 
 def gloo_barrier():

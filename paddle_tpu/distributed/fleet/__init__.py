@@ -4,6 +4,12 @@ from . import base, layers, meta_parallel, utils  # noqa: F401
 from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from .fleet import (  # noqa: F401
     DistributedStrategy,
+    Fleet,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    Role,
+    UtilBase,
+    util,
     HybridParallelOptimizer,
     PaddleCloudRoleMaker,
     UserDefinedRoleMaker,

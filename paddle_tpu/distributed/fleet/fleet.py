@@ -290,3 +290,149 @@ def stop_worker():
         rpc.shutdown()
     except Exception:
         pass
+
+
+class Role:
+    """reference: python/paddle/distributed/fleet/base/role_maker.py Role."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UtilBase:
+    """Cross-rank small-data helpers (reference:
+    python/paddle/distributed/fleet/base/util_factory.py UtilBase): host
+    object collectives over the rendezvous/communication layer."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from paddle_tpu.distributed import ReduceOp, all_reduce as _ar
+        from paddle_tpu._core.tensor import Tensor
+
+        ops = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX, "min": ReduceOp.MIN}
+        if mode not in ops:
+            raise ValueError(f"all_reduce mode must be sum/max/min, got {mode!r}")
+        t = Tensor(np.asarray(input))
+        out = _ar(t, op=ops[mode])
+        return np.asarray(out._value if isinstance(out, Tensor) else t._value)
+
+    def barrier(self, comm_world="worker"):
+        from paddle_tpu.distributed import barrier as _b
+
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        """Gather each rank's host object: world-1 returns [input]; multi-
+        process exchanges pickles through the rendezvous store."""
+        import pickle
+
+        from paddle_tpu.distributed import get_rank, get_world_size
+        from paddle_tpu.distributed.communication.watchdog import get_rendezvous_store
+
+        world = get_world_size()
+        if world == 1:
+            return [input]
+        store = get_rendezvous_store()
+        if store is None:
+            raise RuntimeError("util.all_gather needs a rendezvous store outside world-1")
+        rank = get_rank()
+        self._ag_seq = getattr(self, "_ag_seq", 0) + 1
+        store.set(f"util_ag/{self._ag_seq}/{rank}", pickle.dumps(input))
+        return [pickle.loads(store.get(f"util_ag/{self._ag_seq}/{r}")) for r in range(world)]
+
+    def get_file_shard(self, files):
+        """Split a file list evenly across workers (reference util)."""
+        from paddle_tpu.distributed import get_rank, get_world_size
+
+        w, r = get_world_size(), get_rank()
+        per = (len(files) + w - 1) // w
+        return files[r * per : (r + 1) * per]
+
+    def print_on_rank(self, message, rank_id=0):
+        from paddle_tpu.distributed import get_rank
+
+        if get_rank() == int(rank_id):
+            print(message)
+
+
+util = UtilBase()
+
+
+class MultiSlotDataGenerator:
+    """PS-mode data generator (reference:
+    python/paddle/distributed/fleet/data_generator/data_generator.py):
+    subclass generate_sample(line) yielding [(slot_name, [ids...]), ...];
+    run_from_stdin/run_from_files feed the PS dataset pipeline."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError("subclass must implement generate_sample")
+
+    def set_batch(self, batch_size):
+        self._batch = int(batch_size)
+
+    def _format(self, sample):
+        # MultiSlot text protocol: "slots_num slot_len v0 v1 ... " per slot
+        parts = []
+        for _, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_files(self, files, output_fn=print):
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    gen = self.generate_sample(line.rstrip("\n"))
+                    for sample in (gen() if callable(gen) else gen):
+                        output_fn(self._format(sample))
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            gen = self.generate_sample(line.rstrip("\n"))
+            for sample in (gen() if callable(gen) else gen):
+                print(self._format(sample))
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (values emitted verbatim)."""
+
+
+__all__ += ["Role", "UtilBase", "util", "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+
+
+class Fleet:
+    """The Fleet singleton class (reference: fleet.py:167 class Fleet).
+    This build implements fleet as module-level functions over _FleetEnv;
+    the class view binds the same operations for scripts that instantiate
+    or type-check paddle.distributed.fleet.Fleet."""
+
+    def init(self, role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+        return init(role_maker, is_collective, strategy)
+
+    is_initialized = staticmethod(is_initialized)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_server = staticmethod(is_server)
+    is_worker = staticmethod(is_worker)
+    init_server = staticmethod(init_server)
+    init_worker = staticmethod(init_worker)
+    run_server = staticmethod(run_server)
+    stop_worker = staticmethod(stop_worker)
+
+    @property
+    def util(self):
+        return util
+
+
+__all__ += ["Fleet"]

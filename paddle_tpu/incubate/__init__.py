@@ -4,3 +4,102 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+
+# reference paddle.incubate top-level __all__ closure
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from paddle_tpu.geometric import (  # noqa: F401
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    send_u_recv as graph_send_recv,
+)
+from . import autograd  # noqa: F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference:
+    python/paddle/incubate/operators/graph_khop_sampler.py): repeated
+    sample_neighbors over CSC (row, colptr) for each hop.  Host-side
+    sampling op (data-dependent sizes), like the reference's usage in the
+    data pipeline."""
+    import numpy as np
+
+    from paddle_tpu._core.tensor import Tensor
+    from paddle_tpu.geometric import sample_neighbors
+
+    nodes = input_nodes
+    edge_src, edge_dst = [], []
+    for k in sample_sizes:
+        srcs, counts = sample_neighbors(row, colptr, nodes, sample_size=int(k))
+        sv = np.asarray(srcs._value)
+        cv = np.asarray(counts._value)
+        dst = np.repeat(np.asarray(nodes._value if isinstance(nodes, Tensor) else nodes), cv)
+        edge_src.append(sv)
+        edge_dst.append(dst)
+        nodes = Tensor(srcs._value)
+    es = np.concatenate(edge_src) if edge_src else np.zeros(0, np.int64)
+    ed = np.concatenate(edge_dst) if edge_dst else np.zeros(0, np.int64)
+    seeds = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor) else input_nodes)
+    uniq = np.unique(np.concatenate([seeds, es]))
+    import jax.numpy as jnp
+
+    return (
+        Tensor(jnp.asarray(es)),
+        Tensor(jnp.asarray(ed)),
+        Tensor(jnp.asarray(uniq)),
+        Tensor(jnp.asarray(np.searchsorted(uniq, es))),
+    )
+
+
+def identity_loss(x, reduction="none"):
+    """reference: python/paddle/incubate/nn/functional/identity_loss — marks
+    x as the loss (IPU lineage); reduces per `reduction`."""
+    from paddle_tpu.tensor._ops_common import ensure_tensor
+
+    x = ensure_tensor(x)
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused attention-mask + softmax (reference:
+    python/paddle/incubate/operators/softmax_mask_fuse.py): softmax(x + mask)
+    in fp32 — XLA fuses this into one kernel, which is the entire point of
+    the reference's handwritten CUDA op."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+
+    def _fn(v, m):
+        return jax.nn.softmax(v.astype(jnp.float32) + m.astype(jnp.float32), axis=-1).astype(v.dtype)
+
+    return apply("softmax_mask_fuse", _fn, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """reference: softmax_mask_fuse_upper_triangle — causal-masked softmax
+    (upper triangle masked out) without materializing the mask."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+    x = ensure_tensor(x)
+
+    def _fn(v):
+        S, T = v.shape[-2], v.shape[-1]
+        i = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+        j = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+        vf = jnp.where(j <= i, v.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(vf, axis=-1).astype(v.dtype)
+
+    return apply("softmax_mask_fuse_upper_triangle", _fn, x)

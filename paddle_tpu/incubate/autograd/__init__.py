@@ -1,0 +1,92 @@
+"""paddle.incubate.autograd — functional/forward-mode autodiff surface.
+
+Reference: python/paddle/incubate/autograd/__init__.py (jvp/vjp/Jacobian/
+Hessian from functional.py, prim-mode toggles from primx.py).
+
+TPU-native: jax's jvp/vjp ARE the primitive-level autodiff the reference
+builds its prim flag machinery for — enable_prim/disable_prim exist for
+script compat and report prim always-on (every grad here is computed on the
+primitive jaxpr).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.autograd.functional import hessian as Hessian  # noqa: N812
+from paddle_tpu.autograd.functional import jacobian as Jacobian  # noqa: N812
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim", "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+
+def _unwrap(ts):
+    if isinstance(ts, (list, tuple)):
+        return [t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in ts]
+    return [ts._value if isinstance(ts, Tensor) else jnp.asarray(ts)]
+
+
+def _wrap_like(vals, like):
+    out = [Tensor(v) for v in vals]
+    if isinstance(like, (list, tuple)):
+        return out
+    return out[0]
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode JVP (reference: incubate/autograd/functional.py jvp):
+    returns (func(xs), J @ v)."""
+    xv = _unwrap(xs)
+    tv = _unwrap(v) if v is not None else [jnp.ones_like(x) for x in xv]
+
+    def f(*args):
+        out = func(*[Tensor(a) for a in args])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o._value if isinstance(o, Tensor) else jnp.asarray(o) for o in outs]
+
+    primals, tangents = jax.jvp(f, tuple(xv), tuple(tv))
+    return [Tensor(p) for p in primals], [Tensor(t) for t in tangents]
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode VJP (reference functional.py vjp): (func(xs), v @ J)."""
+    xv = _unwrap(xs)
+
+    def f(*args):
+        out = func(*[Tensor(a) for a in args])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o._value if isinstance(o, Tensor) else jnp.asarray(o) for o in outs]
+
+    primals, pullback = jax.vjp(f, *xv)
+    cots = _unwrap(v) if v is not None else [jnp.ones_like(p) for p in primals]
+    grads = pullback(list(cots))
+    return [Tensor(p) for p in primals], [Tensor(g) for g in grads]
+
+
+def forward_grad(func, xs, v=None):
+    """Alias of jvp's tangent output (reference primx forward_grad)."""
+    _, tangents = jvp(func, xs, v)
+    return tangents
+
+
+def grad(func, xs, v=None):
+    """Primitive-mode grad (reference incubate.autograd.grad)."""
+    _, grads = vjp(func, xs, v)
+    return grads
+
+
+_prim = {"enabled": True}
+
+
+def enable_prim():
+    _prim["enabled"] = True
+
+
+def disable_prim():
+    # autodiff on jaxprs cannot be turned off; record intent for compat
+    _prim["enabled"] = False
+
+
+def prim_enabled():
+    return _prim["enabled"]

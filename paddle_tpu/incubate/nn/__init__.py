@@ -2,8 +2,12 @@
 
 from . import functional  # noqa: F401
 from .layers import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
     FusedFeedForward,
     FusedLayerNorm,
+    FusedLinear,
     FusedMultiHeadAttention,
     FusedMultiTransformer,
     FusedRMSNorm,

@@ -130,3 +130,66 @@ class FusedMultiTransformer(nn.Layer):
         for layer in self.layers:
             x = layer(x, src_mask=attn_mask)
         return x
+
+
+class FusedLinear(nn.Layer):
+    """reference: python/paddle/incubate/nn/layer/fused_linear.py — Linear
+    over the fused matmul+bias functional."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = [out_features, in_features] if transpose_weight else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr, is_bias=True) if bias_attr is not False else None
+        self._transpose = transpose_weight
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias, transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(nn.Layer):
+    """reference: incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p, training=self.training, mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """reference: incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm — owns the LN affine params."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None, bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.ln_scale = self.create_parameter([embed_dim], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], attr=bias_attr, is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon, training=self.training,
+        )
+
+
+class FusedEcMoe(nn.Layer):
+    """reference: incubate/nn/layer/fused_ec_moe.py — expert-choice MoE
+    block over the fused_ec_moe functional."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.bmm_weight0 = self.create_parameter([num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter([num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter([num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter([num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
+        self.act_type = act_type
+
+    def forward(self, x, gate_logits):
+        return F.fused_ec_moe(
+            x, gate_logits, self.bmm_weight0, self.bmm_bias0,
+            self.bmm_weight1, self.bmm_bias1, act_type=self.act_type,
+        )

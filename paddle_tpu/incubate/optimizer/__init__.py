@@ -7,7 +7,9 @@ import paddle_tpu as paddle
 from paddle_tpu._core.tensor import Tensor
 from paddle_tpu.optimizer.optimizer import Optimizer
 
-__all__ = ["LookAhead", "ModelAverage", "LARS", "GradientMergeOptimizer", "DistributedFusedLamb"]
+from paddle_tpu.optimizer.lbfgs import LBFGS  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "LARS", "GradientMergeOptimizer", "DistributedFusedLamb", "LBFGS"]
 
 
 class LookAhead(Optimizer):

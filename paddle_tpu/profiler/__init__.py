@@ -36,6 +36,7 @@ __all__ = [
 ]
 
 _active_profiler = None  # checked by the op funnel (cheap global)
+_last_profiler = None  # most recent stopped Profiler (export_protobuf)
 
 
 class ProfilerTarget(Enum):
@@ -175,6 +176,8 @@ class Profiler:
             self._xplane_dir = None
         self._recording = False
         _active_profiler = None
+        global _last_profiler
+        _last_profiler = self
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
 
@@ -249,3 +252,45 @@ def export_chrome_tracing(profiler: Profiler, path: str):
 def load_profiler_result(path):
     with open(path) as f:
         return json.load(f)
+
+
+class SortedKeys:
+    """Summary-table sort keys (reference:
+    python/paddle/profiler/profiler_statistic.py SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary view selector (reference: profiler.py SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(path=None):
+    """reference: profiler export to protobuf dump.  The host-span tree
+    exports via the chrome-trace JSON (load_profiler_result-compatible);
+    protobuf adds no information on this runtime, so this writes the same
+    payload with the requested extension."""
+    prof = _active_profiler or _last_profiler
+    if prof is None:
+        raise RuntimeError("export_protobuf: no active/finished Profiler")
+    prof.export(path or "profiler.pb")
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

@@ -3,7 +3,7 @@ cpp_extension (custom C++ op build/load) plus small helpers."""
 
 from . import cpp_extension  # noqa: F401
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "try_import", "require_version", "deprecated"]
 
 
 def run_check():
@@ -15,3 +15,55 @@ def run_check():
     out = jnp.ones((8, 8)) @ jnp.ones((8, 8))
     assert float(out[0, 0]) == 8.0
     print(f"paddle_tpu is installed successfully! {n} device(s) available.")
+
+
+def try_import(module_name, err_msg=None):
+    """reference: python/paddle/utils/lazy_import.py try_import."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"{module_name} is required: pip install {module_name}") from e
+
+
+def require_version(min_version, max_version=None):
+    """reference: python/paddle/utils/__init__.py require_version — check the
+    installed framework version against [min, max]."""
+    from paddle_tpu import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(f"paddle_tpu>={min_version} required, found {__version__}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(f"paddle_tpu<={max_version} required, found {__version__}")
+    return True
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: python/paddle/utils/deprecated.py — decorator emitting a
+    DeprecationWarning on call."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap

@@ -4,3 +4,47 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+
+# image IO backend selector (reference: python/paddle/vision/image.py)
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """reference: paddle.vision.set_image_backend — 'pil' | 'cv2' |
+    'tensor'.  cv2 is accepted only if importable."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError as e:
+            raise ImportError("cv2 backend requested but opencv is not installed") from e
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """reference: paddle.vision.image_load — read an image file with the
+    selected backend; 'tensor' returns a CHW uint8 Tensor."""
+    b = backend or _image_backend
+    if b == "cv2":
+        import cv2
+
+        return cv2.imread(path)
+    from PIL import Image
+
+    img = Image.open(path)
+    if b == "pil":
+        return img
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu._core.tensor import Tensor
+
+    arr = np.asarray(img.convert("RGB")).transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

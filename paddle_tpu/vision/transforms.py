@@ -266,3 +266,308 @@ class Grayscale:
         arr = _to_np(img).astype(np.float32)
         gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
         return np.stack([gray] * self.n, axis=-1)
+
+
+# ---------------------------------------------------------------- functional
+# (reference: python/paddle/vision/transforms/functional.py; HWC numpy arrays)
+
+def _value_range(img):
+    """255 for integer images, else the 0-1 float convention (a dark uint8
+    image must not be misread as float by a max-value heuristic)."""
+    raw = np.asarray(img._value) if isinstance(img, Tensor) else np.asarray(img)
+    if np.issubdtype(raw.dtype, np.integer):
+        return 255.0
+    return 255.0 if raw.max() > 1.5 else 1.0
+
+
+def adjust_brightness(img, brightness_factor):
+    hi = _value_range(img)
+    arr = _to_np(img).astype(np.float32)
+    return np.clip(arr * float(brightness_factor), 0, hi)
+
+
+def adjust_contrast(img, contrast_factor):
+    hi = _value_range(img)
+    arr = _to_np(img).astype(np.float32)
+    gray_mean = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114).mean() if arr.ndim == 3 and arr.shape[-1] == 3 else arr.mean()
+    return np.clip((arr - gray_mean) * float(contrast_factor) + gray_mean, 0, hi)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) through HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    hi = _value_range(img)
+    arr = _to_np(img).astype(np.float32)
+    x = arr / hi
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = np.max(x, axis=-1)
+    minc = np.min(x, axis=-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.clip(maxc, 1e-8, None), 0.0)
+    dz = np.clip(delta, 1e-8, None)
+    h = np.where(
+        maxc == r, (g - b) / dz % 6.0,
+        np.where(maxc == g, (b - r) / dz + 2.0, (r - g) / dz + 4.0),
+    ) / 6.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    rgb = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q]),
+    ], axis=-1)
+    return np.clip(rgb * hi, 0, hi)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase region [i:i+h, j:j+w] with value v (reference functional.erase).
+    Accepts HWC numpy/PIL or CHW Tensor."""
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        val = jnp.asarray(v, img._value.dtype)
+        patch = jnp.broadcast_to(val, (img._value.shape[0], h, w))
+        new = img._value.at[:, i : i + h, j : j + w].set(patch)
+        return Tensor(new)
+    arr = _to_np(img).copy()
+    arr[i : i + h, j : j + w] = v
+    return arr
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # RSS (rotate-shear-scale) as in torchvision/paddle functional
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]], np.float64) * scale
+    # T(center+translate) @ RSS @ T(-center)
+    m[0, 2] = cx + tx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + ty - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+def _warp_affine(arr, m_inv, out_hw, fill=0.0):
+    H, W = out_hw
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    src_x = m_inv[0, 0] * xs + m_inv[0, 1] * ys + m_inv[0, 2]
+    src_y = m_inv[1, 0] * xs + m_inv[1, 1] * ys + m_inv[1, 2]
+    x0 = np.round(src_x).astype(np.int64)
+    y0 = np.round(src_y).astype(np.int64)
+    inb = (x0 >= 0) & (x0 < arr.shape[1]) & (y0 >= 0) & (y0 < arr.shape[0])
+    out = np.full((H, W) + arr.shape[2:], fill, arr.dtype)
+    out[inb] = arr[y0[inb], x0[inb]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest", fill=0, center=None):
+    """Affine-transform an HWC image (reference functional.affine)."""
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) / 2.0, (H - 1) / 2.0)
+    shear = shear if isinstance(shear, (list, tuple)) else (shear, 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    m3 = np.vstack([m, [0, 0, 1]])
+    m_inv = np.linalg.inv(m3)[:2]
+    return _warp_affine(arr, m_inv, (H, W), fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    """Rotate an HWC image counter-clockwise (reference functional.rotate)."""
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    if expand:
+        rad = np.deg2rad(angle)
+        nW = int(np.ceil(abs(W * np.cos(rad)) + abs(H * np.sin(rad))))
+        nH = int(np.ceil(abs(W * np.sin(rad)) + abs(H * np.cos(rad))))
+    else:
+        nW, nH = W, H
+    if center is None:
+        center = ((W - 1) / 2.0, (H - 1) / 2.0)
+    m = _affine_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
+    m[0, 2] += (nW - W) / 2.0
+    m[1, 2] += (nH - H) / 2.0
+    m3 = np.vstack([m, [0, 0, 1]])
+    m_inv = np.linalg.inv(m3)[:2]
+    return _warp_affine(arr, m_inv, (nH, nW), fill)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    # solve the 8-dof homography mapping endpoints -> startpoints
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coeffs = np.linalg.solve(np.asarray(a, np.float64), np.asarray(b, np.float64))
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective-warp an HWC image (reference functional.perspective)."""
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    c = _perspective_coeffs(startpoints, endpoints)
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    denom = c[6] * xs + c[7] * ys + 1.0
+    src_x = (c[0] * xs + c[1] * ys + c[2]) / denom
+    src_y = (c[3] * xs + c[4] * ys + c[5]) / denom
+    x0 = np.round(src_x).astype(np.int64)
+    y0 = np.round(src_y).astype(np.int64)
+    inb = (x0 >= 0) & (x0 < W) & (y0 >= 0) & (y0 < H)
+    out = np.full_like(arr, fill)
+    out[inb] = arr[y0[inb], x0[inb]]
+    return out
+
+
+# ------------------------------------------------------------------ classes
+class BaseTransform:
+    """Transform base with keys plumbing (reference:
+    python/paddle/vision/transforms/transforms.py BaseTransform)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            # entries beyond len(keys) pass through untouched (reference
+            # BaseTransform contract — labels must survive the pipeline)
+            out = [
+                self._apply_image(v) if k == "image" else v
+                for k, v in zip(self.keys, inputs)
+            ]
+            out.extend(inputs[len(self.keys):])
+            return tuple(out)
+        return self._apply_image(inputs)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, pyrandom.uniform(-self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        hi = _value_range(img)
+        arr = _to_np(img).astype(np.float32)
+        factor = 1 + pyrandom.uniform(-self.value, self.value)
+        gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+        return np.clip((arr - gray[..., None]) * factor + gray[..., None], 0, hi)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        self.expand, self.center, self.fill = expand, center, fill
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        angle = pyrandom.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand, self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None, interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        self.translate, self.scale_rng, self.shear_rng = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        H, W = arr.shape[:2]
+        angle = pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = pyrandom.uniform(-self.translate[0], self.translate[0]) * W
+            ty = pyrandom.uniform(-self.translate[1], self.translate[1]) * H
+        sc = pyrandom.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (pyrandom.uniform(-self.shear_rng[0], self.shear_rng[0]) if self.shear_rng else 0.0, 0.0)
+        return affine(arr, angle, (tx, ty), sc, sh, self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.d = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if pyrandom.random() >= self.prob:
+            return _to_np(img)
+        arr = _to_np(img)
+        H, W = arr.shape[:2]
+        dx, dy = int(self.d * W / 2), int(self.d * H / 2)
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [
+            (pyrandom.randint(0, dx), pyrandom.randint(0, dy)),
+            (W - 1 - pyrandom.randint(0, dx), pyrandom.randint(0, dy)),
+            (W - 1 - pyrandom.randint(0, dx), H - 1 - pyrandom.randint(0, dy)),
+            (pyrandom.randint(0, dx), H - 1 - pyrandom.randint(0, dy)),
+        ]
+        return perspective(arr, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference transforms.RandomErasing (Zhong et al. 2020)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if pyrandom.random() >= self.prob:
+            return arr
+        chw = isinstance(img, Tensor)
+        H, W = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = H * W
+        for _ in range(10):
+            target = pyrandom.uniform(*self.scale) * area
+            ar = pyrandom.uniform(*self.ratio)
+            h = int(round((target * ar) ** 0.5))
+            w = int(round((target / ar) ** 0.5))
+            if h < H and w < W:
+                i = pyrandom.randint(0, H - h)
+                j = pyrandom.randint(0, W - w)
+                return erase(img, i, j, h, w, self.value)
+        return arr
+
+
+__all__ += [
+    "BaseTransform", "HueTransform", "SaturationTransform", "RandomAffine",
+    "RandomErasing", "RandomPerspective", "RandomRotation",
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "affine", "erase",
+    "perspective", "rotate", "to_grayscale",
+]

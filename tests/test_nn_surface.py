@@ -307,3 +307,145 @@ def test_temporal_shift_and_unflatten_layer():
     assert out.shape == [2, 4, 2, 2]
     u = paddle.nn.Unflatten(1, [2, 2])
     assert u(x).shape == [2, 2, 2, 2, 2]
+
+
+def test_remaining_submodule_surfaces_complete():
+    """Every remaining reference submodule __all__ resolves (incubate tier,
+    utils, audio, vision incl. transforms, profiler, device, fleet)."""
+    import importlib
+    import os
+    import re
+
+    pairs = [
+        ("incubate", "incubate/__init__.py"),
+        ("incubate.nn", "incubate/nn/__init__.py"),
+        ("incubate.optimizer", "incubate/optimizer/__init__.py"),
+        ("incubate.autograd", "incubate/autograd/__init__.py"),
+        ("utils", "utils/__init__.py"),
+        ("audio", "audio/__init__.py"),
+        ("vision", "vision/__init__.py"),
+        ("vision.transforms", "vision/transforms/__init__.py"),
+        ("profiler", "profiler/__init__.py"),
+        ("device", "device/__init__.py"),
+        ("distributed.fleet", "distributed/fleet/__init__.py"),
+    ]
+    for name, path in pairs:
+        fp = f"/root/reference/python/paddle/{path}"
+        if not os.path.exists(fp):
+            continue
+        m = re.search(r"__all__ = \[(.*?)\]", open(fp).read(), re.S)
+        if not m:
+            continue
+        ref = set(re.findall(r'"([^"]+)"', m.group(1))) | set(re.findall(r"'([^']+)'", m.group(1)))
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        missing = sorted(n for n in ref if not hasattr(mod, n))
+        assert not missing, f"paddle.{name} missing {missing}"
+
+
+def test_vision_transform_numerics():
+    from paddle_tpu.vision import transforms as T
+
+    img = (np.random.default_rng(0).random((16, 16, 3)) * 255).astype(np.uint8)
+    np.testing.assert_allclose(T.rotate(img, 90), np.rot90(img, 1, axes=(0, 1)))
+    assert np.abs(T.adjust_hue(img, 0.0).astype(np.float32) - img).max() < 1e-2
+    # hue shift by 1/3 permutes pure channels: red -> green
+    red = np.zeros((2, 2, 3), np.float32)
+    red[..., 0] = 1.0
+    shifted = T.adjust_hue(red, 1.0 / 3.0)
+    np.testing.assert_allclose(shifted[..., 1], 1.0, atol=1e-5)
+    a = T.affine(img, 0, (2, 0), 1.0, (0, 0))
+    assert np.array_equal(a[:, 2:], img[:, :-2])
+    e = T.erase(img, 2, 3, 4, 5, 0)
+    assert (e[2:6, 3:8] == 0).all() and np.array_equal(e[10:], img[10:])
+    b = T.adjust_brightness(img, 2.0)
+    assert b.max() <= 255.0 and b.mean() >= img.mean()
+    out = T.RandomErasing(prob=1.0)(img)
+    assert out.shape == img.shape
+    rp = T.perspective(img, [(0, 0), (15, 0), (15, 15), (0, 15)], [(0, 0), (15, 0), (15, 15), (0, 15)])
+    np.testing.assert_allclose(rp, img)  # identity homography
+
+
+def test_incubate_autograd_jvp_vjp():
+    import paddle_tpu.incubate.autograd as ag
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+
+    def f(a):
+        return a * a
+
+    primal, tangent = ag.jvp(f, [x], [paddle.to_tensor(np.array([1.0], np.float32))])
+    np.testing.assert_allclose(np.asarray(primal[0]._value), [4.0])
+    np.testing.assert_allclose(np.asarray(tangent[0]._value), [4.0])  # 2x
+    primal, grads = ag.vjp(f, [x])
+    np.testing.assert_allclose(np.asarray(grads[0]._value), [4.0])
+    assert ag.prim_enabled()
+
+
+def test_incubate_top_level_ops():
+    import paddle_tpu.incubate as inc
+
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 4, 4)).astype(np.float32))
+    mask = paddle.zeros([2, 4, 4])
+    out = inc.softmax_mask_fuse(x, mask)
+    np.testing.assert_allclose(np.asarray(out._value).sum(-1), 1.0, rtol=1e-5)
+    tri = inc.softmax_mask_fuse_upper_triangle(x)
+    tv = np.asarray(tri._value)
+    assert tv[0, 0, 1] == 0.0 and abs(tv[0, 0, 0] - 1.0) < 1e-6  # causal row 0
+    data = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(np.asarray(inc.segment_sum(data, seg)._value), [[3.0], [3.0]])
+    assert float(inc.identity_loss(x, "sum")._value) == pytest.approx(float(np.asarray(x._value).sum()), rel=1e-5)
+
+
+def test_fused_layer_classes():
+    import paddle_tpu.incubate.nn as inn
+
+    paddle.seed(0)
+    lin = inn.FusedLinear(8, 16)
+    y = lin(paddle.ones([2, 8]))
+    assert y.shape == [2, 16]
+    da = inn.FusedDropoutAdd(p=0.0)
+    z = da(paddle.ones([2, 4]), paddle.ones([2, 4]))
+    np.testing.assert_allclose(np.asarray(z._value), 2.0)
+    bd = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    o = bd(paddle.ones([2, 3, 8]), paddle.ones([2, 3, 8]))
+    assert np.abs(np.asarray(o._value).mean()) < 1e-5  # LN zero-means
+
+
+def test_device_predicates_and_fleet_util():
+    import paddle_tpu.device as dev
+
+    assert dev.is_compiled_with_cuda() is False
+    assert dev.is_compiled_with_distribute() is True
+    assert dev.get_cudnn_version() is None
+    with pytest.raises(RuntimeError):
+        dev.XPUPlace(0)
+    import paddle_tpu.distributed.fleet as fleet
+
+    assert fleet.util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]  # world 1
+    f = fleet.Fleet()
+    assert callable(f.init)
+
+
+def test_utils_trio():
+    import paddle_tpu.utils as U
+
+    assert U.try_import("math") is not None
+    with pytest.raises(ImportError):
+        U.try_import("definitely_not_a_module_xyz")
+    assert U.require_version("0.1.0")
+    with pytest.raises(Exception):
+        U.require_version("99.0.0")
+    calls = []
+
+    @U.deprecated(update_to="new_fn", since="0.2")
+    def old_fn():
+        calls.append(1)
+        return 7
+
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 7
+        assert any("deprecated" in str(x.message) for x in w)
