@@ -226,20 +226,20 @@ def is_compiled_with_custom_device(device_type):
 
 
 def get_available_custom_device():
-    """All devices of non-default PJRT backends (reference:
-    paddle.device.get_available_custom_device)."""
+    """Devices of registered PJRT PLUGIN backends (reference:
+    paddle.device.get_available_custom_device) — builtin cpu/tpu are not
+    custom devices."""
     import jax
 
+    from .plugin import registered_custom_devices
+
     out = []
-    default = jax.default_backend()
-    for plat in ("cpu", "tpu"):
-        if plat == default:
-            continue
+    for plat in registered_custom_devices():
         try:
-            out.append([f"{d.platform}:{d.id}" for d in jax.devices(plat)])
+            out.extend(f"{d.platform}:{d.id}" for d in jax.devices(plat))
         except RuntimeError:
             pass
-    return [d for sub in out for d in sub]
+    return out
 
 
 def get_cudnn_version():

@@ -345,12 +345,15 @@ class UtilBase:
         return [pickle.loads(store.get(f"util_ag/{self._ag_seq}/{r}")) for r in range(world)]
 
     def get_file_shard(self, files):
-        """Split a file list evenly across workers (reference util)."""
+        """Split a file list across workers, remainder to the first trainers
+        (reference util_factory.get_file_shard: every worker gets floor or
+        floor+1 files, none idle)."""
         from paddle_tpu.distributed import get_rank, get_world_size
 
         w, r = get_world_size(), get_rank()
-        per = (len(files) + w - 1) // w
-        return files[r * per : (r + 1) * per]
+        base, rem = divmod(len(files), w)
+        start = r * base + min(r, rem)
+        return files[start : start + base + (1 if r < rem else 0)]
 
     def print_on_rank(self, message, rank_id=0):
         from paddle_tpu.distributed import get_rank

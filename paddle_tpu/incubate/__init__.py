@@ -30,6 +30,11 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
     from paddle_tpu._core.tensor import Tensor
     from paddle_tpu.geometric import sample_neighbors
 
+    if return_eids or sorted_eids is not None:
+        raise NotImplementedError(
+            "graph_khop_sampler: return_eids/sorted_eids not supported; use "
+            "paddle.geometric.sample_neighbors(..., eids=, return_eids=True) per hop"
+        )
     nodes = input_nodes
     edge_src, edge_dst = [], []
     for k in sample_sizes:

@@ -27,13 +27,6 @@ def _unwrap(ts):
     return [ts._value if isinstance(ts, Tensor) else jnp.asarray(ts)]
 
 
-def _wrap_like(vals, like):
-    out = [Tensor(v) for v in vals]
-    if isinstance(like, (list, tuple)):
-        return out
-    return out[0]
-
-
 def jvp(func, xs, v=None):
     """Forward-mode JVP (reference: incubate/autograd/functional.py jvp):
     returns (func(xs), J @ v)."""

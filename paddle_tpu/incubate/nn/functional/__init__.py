@@ -325,8 +325,17 @@ def fused_ec_moe(x, gate_weight, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
             ensure_tensor(bmm1_weight), ensure_tensor(bmm1_bias)]
 
     def _fn(xv, gw, w0, b0, w1, b1):
-        # xv: [B, S, D]; gw: [D, E]; w0: [E, D, Dff]; w1: [E, Dff, D]
-        probs = jax.nn.softmax(xv.astype(jnp.float32) @ gw.astype(jnp.float32), axis=-1)
+        # xv: [B, S, D]; gate per the reference contract is per-token LOGITS
+        # [B, S, E]; a [D, E] projection weight is also accepted (then the
+        # logits are x @ gw).  Biases may be [E, F] or the reference's
+        # [E, 1, F].
+        if gw.ndim == 3:
+            logits = gw.astype(jnp.float32)
+        else:
+            logits = xv.astype(jnp.float32) @ gw.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        b0 = b0.reshape(b0.shape[0], -1)
+        b1 = b1.reshape(b1.shape[0], -1)
         h = jnp.einsum("bsd,edf->bsef", xv, w0) + b0[None, None]
         if act_type == "gelu":
             h = jax.nn.gelu(h)

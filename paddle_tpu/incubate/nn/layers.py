@@ -182,14 +182,20 @@ class FusedEcMoe(nn.Layer):
 
     def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu", weight_attr=None, bias_attr=None):
         super().__init__()
+        # reference shapes (fused_ec_moe.py docstring): weights [E, D, F] /
+        # [E, F, D], biases [E, 1, F] / [E, 1, D]
         self.bmm_weight0 = self.create_parameter([num_experts, hidden_size, inter_size], attr=weight_attr)
         self.bmm_bias0 = self.create_parameter([num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
         self.bmm_weight1 = self.create_parameter([num_experts, inter_size, hidden_size], attr=weight_attr)
         self.bmm_bias1 = self.create_parameter([num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
         self.act_type = act_type
+        if self.act_type not in ("gelu", "relu"):
+            raise NotImplementedError("FusedEcMoe supports gelu/relu")
 
-    def forward(self, x, gate_logits):
+    def forward(self, x, gate):
+        """x: [B, S, D]; gate: per-token logits [B, S, E] (reference
+        forward contract)."""
         return F.fused_ec_moe(
-            x, gate_logits, self.bmm_weight0, self.bmm_bias0,
+            x, gate, self.bmm_weight0, self.bmm_bias0,
             self.bmm_weight1, self.bmm_bias1, act_type=self.act_type,
         )

@@ -33,7 +33,8 @@ def require_version(min_version, max_version=None):
     from paddle_tpu import __version__
 
     def parse(v):
-        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+        t = tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+        return t + (0,) * (3 - len(t))  # '0.1' == '0.1.0'
 
     cur = parse(__version__)
     if parse(min_version) > cur:

@@ -501,6 +501,8 @@ class RandomAffine(BaseTransform):
     def __init__(self, degrees, translate=None, scale=None, shear=None, interpolation="nearest", fill=0, center=None, keys=None):
         super().__init__(keys)
         self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        if shear is not None and np.isscalar(shear):
+            shear = (shear,)
         self.translate, self.scale_rng, self.shear_rng = translate, scale, shear
         self.interpolation, self.fill, self.center = interpolation, fill, center
 

@@ -175,3 +175,53 @@ def test_global_norm_clip_across_mesh_axes():
         np.testing.assert_allclose(
             np.asarray(p_ref._value), np.asarray(p_sh._value), rtol=2e-4, atol=2e-5
         )
+
+
+def test_cross_mesh_reshard():
+    """Reshard across DIFFERENT meshes and placements: values must be
+    preserved exactly and the new sharding must land on the target mesh
+    (reference: reshard/*.cc pairwise converters incl. cross-mesh
+    same_status; here one XLA resharding device_put)."""
+    from paddle_tpu.distributed.auto_parallel.api import reshard, shard_tensor
+
+    data = np.arange(64, dtype=np.float32).reshape(8, 8)
+    mesh_a = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["x", "y"])
+    mesh_b = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["p", "q"])
+
+    t = shard_tensor(data, mesh_a, [Shard(0), Shard(1)])
+    # r_to_s, s_to_r, s_to_s and the cross-mesh move, value-checked each hop
+    hops = [
+        (mesh_a, [Replicate(), Replicate()]),
+        (mesh_a, [Shard(1), Replicate()]),
+        (mesh_b, [Shard(0), Shard(1)]),
+        (mesh_b, [Replicate(), Shard(0)]),
+        (mesh_a, [Shard(0), Shard(1)]),
+    ]
+    cur = t
+    for mesh, placements in hops:
+        cur = reshard(cur, mesh, placements)
+        np.testing.assert_array_equal(np.asarray(cur._value), data)
+        shard_mesh = cur._value.sharding.mesh
+        assert tuple(shard_mesh.axis_names) == tuple(mesh._jax_mesh.axis_names)
+
+
+def test_cross_mesh_reshard_inside_jit():
+    """Resharding constraints compile into a jitted program (the GSPMD
+    path the static Engine rides)."""
+    from paddle_tpu.distributed.auto_parallel.api import reshard, shard_tensor
+
+    data = np.arange(32, dtype=np.float32).reshape(4, 8)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    t = shard_tensor(data, mesh, [Shard(0), Replicate()])
+
+    import jax
+
+    from paddle_tpu.distributed.auto_parallel.api import sharding_of
+
+    @jax.jit
+    def f(v):
+        v2 = jax.lax.with_sharding_constraint(v * 2.0, sharding_of(mesh, [Replicate(), Shard(1)]))
+        return v2 + 1.0
+
+    out = f(t._value)
+    np.testing.assert_array_equal(np.asarray(out), data * 2 + 1)

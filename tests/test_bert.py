@@ -58,6 +58,7 @@ def test_ernie_alias():
     assert ErnieModel is not None and ErnieForSequenceClassification is not None
 
 
+@pytest.mark.slow
 def test_gpt_trains_and_shards():
     """GPT family: compiled pretrain step decreases loss; Megatron-sharded
     tp x dp step matches single-device numerics."""

@@ -410,6 +410,12 @@ def test_fused_layer_classes():
     bd = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
     o = bd(paddle.ones([2, 3, 8]), paddle.ones([2, 3, 8]))
     assert np.abs(np.asarray(o._value).mean()) < 1e-5  # LN zero-means
+    # FusedEcMoe: reference forward contract is per-token gate logits
+    moe = inn.FusedEcMoe(8, 16, 4)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 3, 8)).astype(np.float32))
+    gate = paddle.to_tensor(np.random.default_rng(1).standard_normal((2, 3, 4)).astype(np.float32))
+    out = moe(x, gate)
+    assert out.shape == [2, 3, 8] and np.isfinite(np.asarray(out._value)).all()
 
 
 def test_device_predicates_and_fleet_util():

@@ -31,6 +31,7 @@ def _blocks(n, h, seed=0):
     return [Block(h) for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_pipeline_stack_forward_matches_sequential():
     mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
     blocks = _blocks(8, 16)

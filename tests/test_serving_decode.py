@@ -21,7 +21,7 @@ def _model_and_prompt(gqa=False):
     return m, ids
 
 
-@pytest.mark.parametrize("gqa", [False, True])
+@pytest.mark.parametrize("gqa", [pytest.param(False, marks=pytest.mark.slow), True])
 def test_paged_matches_naive_decode(gqa):
     m, ids = _model_and_prompt(gqa)
     naive = np.asarray(m.generate(ids, max_new_tokens=8, cache="naive")._value)

@@ -78,6 +78,7 @@ class TestModelZoo:
     def test_inception_v3(self):
         _check(models.inception_v3(num_classes=10), size=160)
 
+    @pytest.mark.slow
     def test_resnext(self):
         _check(models.resnext50_32x4d(num_classes=10), size=64)
 
