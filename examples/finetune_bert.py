@@ -1,7 +1,12 @@
 """BERT sequence-classification finetune with the WordPiece tokenizer,
 AMP, and async checkpointing."""
 
+import os
+import sys
+
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import paddle_tpu as paddle
 from paddle_tpu.jit import TrainStep

@@ -6,6 +6,10 @@ Real pod: run one process per host under `python -m paddle_tpu.distributed.launc
 """
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

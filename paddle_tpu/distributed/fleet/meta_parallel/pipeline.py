@@ -55,6 +55,13 @@ from paddle_tpu._core.autograd import apply, no_grad
 from paddle_tpu._core.tensor import Parameter, Tensor
 from paddle_tpu.nn import Layer
 
+
+def _pvary(x, axes):
+    # jax>=0.9 renames pvary -> pcast(..., to='varying'); support both
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
+
 __all__ = ["PipelineStack"]
 
 _SCHEDULES = ("1F1B", "FThenB", "VPP")
@@ -248,11 +255,11 @@ class PipelineStack(Layer):
                 return (h_next, m_next, c_next, next_m2, out), None
 
             carry0 = (
-                lax.pvary(jnp.zeros_like(x[0]), (pp,)),
-                lax.pvary(jnp.asarray(-1, jnp.int32), (pp,)),
-                lax.pvary(jnp.asarray(V, jnp.int32), (pp,)),  # dead: inject
-                lax.pvary(jnp.asarray(0, jnp.int32), (pp,)),
-                lax.pvary(jnp.zeros_like(x), (pp,)),
+                _pvary(jnp.zeros_like(x[0]), (pp,)),
+                _pvary(jnp.asarray(-1, jnp.int32), (pp,)),
+                _pvary(jnp.asarray(V, jnp.int32), (pp,)),  # dead: inject
+                _pvary(jnp.asarray(0, jnp.int32), (pp,)),
+                _pvary(jnp.zeros_like(x), (pp,)),
             )
             (_, _, _, _, out), _ = lax.scan(tick, carry0, jnp.arange(T, dtype=jnp.int32))
             return lax.psum(out, pp)
@@ -316,8 +323,8 @@ class PipelineStack(Layer):
 
             # carries become pp-varying inside the loop; type them so upfront
             carry0 = (
-                lax.pvary(jnp.zeros_like(x[0]), (pp,)),
-                lax.pvary(jnp.zeros_like(x), (pp,)),
+                _pvary(jnp.zeros_like(x[0]), (pp,)),
+                _pvary(jnp.zeros_like(x), (pp,)),
             )
             (_, out), _ = lax.scan(tick, carry0, jnp.arange(T, dtype=jnp.int32))
             # outputs live on the last stage; psum replicates them over pp
