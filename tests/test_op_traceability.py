@@ -106,6 +106,26 @@ TRACEABLE = [
     ("slogdet", lambda x: paddle.linalg.slogdet(x)[0], (F32(3, 3),)),
     ("solve", lambda x, y: paddle.linalg.solve(x + 3 * paddle.eye(3), y), (F32(3, 3), F32(3))),
     ("svd", lambda x: paddle.linalg.svd(x)[1], (F32(3, 4),)),
+    # round-2 surface-closure ops
+    ("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]), (F32(3, 4),)),
+    ("index_fill", lambda x, i: paddle.index_fill(x, i, 0, 5.0), (F32(4, 2), I32(2))),
+    ("diagonal_scatter", lambda x, y: paddle.diagonal_scatter(x, y), (F32(4, 4), F32(4))),
+    ("select_scatter", lambda x, y: paddle.select_scatter(x, y, 0, 1), (F32(3, 4), F32(4))),
+    ("pdist", lambda x: paddle.pdist(x), (F32(5, 3),)),
+    ("add_n", lambda x, y: paddle.add_n([x, y]), (F32(3), F32(3))),
+    ("reverse", lambda x: paddle.reverse(x, 0), (F32(4),)),
+    ("inverse", lambda x: paddle.inverse(x), (F32(3, 3) + 3 * np.eye(3, dtype=np.float32),)),
+    ("linalg_cond", lambda x: paddle.linalg.cond(x), (F32(3, 3) + 3 * np.eye(3, dtype=np.float32),)),
+    ("multiplex", lambda x, y, i: paddle.multiplex([x, y], i), (F32(3, 2), F32(3, 2), np.array([[0], [1], [0]], np.int32))),
+    ("seq_mask", lambda x: paddle.nn.functional.sequence_mask(x, maxlen=5), (I32(3),)),
+    ("pairwise_distance", lambda x, y: paddle.nn.functional.pairwise_distance(x, y), (F32(3, 4), F32(3, 4))),
+    ("grid_sample", lambda x, g: paddle.nn.functional.grid_sample(x, g), (F32(1, 1, 4, 4), F32(1, 4, 4, 2))),
+    ("temporal_shift", lambda x: paddle.nn.functional.temporal_shift(x, 2), (F32(4, 4, 2, 2),)),
+    ("maxpool_mask", lambda x: paddle.nn.functional.max_pool2d(x, 2, return_mask=True)[1], (F32(1, 1, 4, 4),)),
+    ("max_unpool2d", lambda x, i: paddle.nn.functional.max_unpool2d(x, i, 2), (F32(1, 1, 2, 2), np.array([[[[0, 3], [9, 14]]]], np.int32))),
+    ("multi_margin", lambda x, y: paddle.nn.functional.multi_margin_loss(x, y), (F32(3, 4), I32(3))),
+    ("hsigmoid", lambda x, y, w: paddle.nn.functional.hsigmoid_loss(x, y, 4, w), (F32(3, 5), I32(3), F32(3, 5))),
+    ("top_p", lambda x, p: paddle.tensor.top_p_sampling(x, p, seed=7)[1], (POS(2, 6), POS(2))),
 ]
 
 # ops whose OUTPUT SHAPE depends on data: must raise the documented error
