@@ -43,6 +43,12 @@ def main():
                         "unit": "tokens/s",
                         "vs_baseline": 0.0,
                         "error": "TPU backend init exceeded 300s (tunnel unreachable)",
+                        "last_measured_on_chip": {
+                            "date": "2026-07-30",
+                            "hidden1024_config": {"tokens_per_sec": 88102.94, "vs_baseline": 1.1037},
+                            "hidden2048_config_probe": {"tokens_per_sec": 35618.4, "mfu": 0.6245, "vs_baseline": 1.388},
+                            "note": "last successful on-chip measurement (see date field); BASELINE.md has the full table",
+                        },
                     }
                 ),
                 flush=True,
