@@ -10,6 +10,14 @@ from paddle_tpu.utils.op_test import check_grad, check_output
 R = np.random.default_rng(0)
 
 
+# constants for the sparse-attention case (a lambda must NOT redraw random
+# tensors per call — numeric differencing would compare different functions)
+_SA_K = paddle.to_tensor(np.random.default_rng(10).standard_normal((1, 1, 4, 8)).astype(np.float32))
+_SA_V = paddle.to_tensor(np.random.default_rng(11).standard_normal((1, 1, 4, 8)).astype(np.float32))
+# lower-triangular CSR: row i attends to columns 0..i
+_SA_OFF = paddle.to_tensor(np.array([[[0, 1, 3, 6, 10]]], np.int32))
+_SA_COL = paddle.to_tensor(np.array([[[0, 0, 1, 0, 1, 2, 0, 1, 2, 3]]], np.int32))
+
 GRAD_CASES = [
     ("matmul", lambda a, b: paddle.matmul(a, b), (R.standard_normal((3, 4)), R.standard_normal((4, 2)))),
     ("add_bcast", lambda a, b: a + b, (R.standard_normal((3, 4)), R.standard_normal((4,)))),
@@ -31,6 +39,22 @@ GRAD_CASES = [
     ("maximum", lambda a, b: paddle.maximum(a, b), (R.standard_normal((4,)), R.standard_normal((4,)) + 2.0)),
     ("pow", lambda a: paddle.pow(a, 3.0), (np.abs(R.standard_normal((4,))) + 0.5,)),
     ("cross_entropy", lambda a: paddle.nn.functional.cross_entropy(a, paddle.to_tensor(np.array([1, 0], np.int32))), (R.standard_normal((2, 4)),)),
+    # round-2 surface-closure differentiable ops
+    ("pdist", lambda a: paddle.pdist(a), (R.standard_normal((4, 3)),)),
+    ("diagonal_scatter", lambda a, v: paddle.diagonal_scatter(a, v), (R.standard_normal((3, 3)), R.standard_normal((3,)))),
+    ("select_scatter", lambda a, v: paddle.select_scatter(a, v, 0, 1), (R.standard_normal((3, 4)), R.standard_normal((4,)))),
+    ("index_fill", lambda a: paddle.index_fill(a, paddle.to_tensor(np.array([0], np.int32)), 0, 2.0), (R.standard_normal((3, 2)),)),
+    ("unflatten", lambda a: paddle.unflatten(a, 0, [2, 3]), (R.standard_normal((6,)),)),
+    ("grid_sample", lambda a, g: paddle.nn.functional.grid_sample(a, g), (R.standard_normal((1, 1, 4, 4)), 0.5 * R.standard_normal((1, 3, 3, 2)))),
+    ("pairwise_distance", lambda a, b: paddle.nn.functional.pairwise_distance(a, b), (R.standard_normal((3, 4)), R.standard_normal((3, 4)) + 1.0)),
+    ("multi_margin", lambda a: paddle.nn.functional.multi_margin_loss(a, paddle.to_tensor(np.array([1, 0], np.int32))), (R.standard_normal((2, 4)),)),
+    ("sparse_attention_grad", lambda q: paddle.nn.functional.sparse_attention(
+        q, _SA_K, _SA_V, _SA_OFF, _SA_COL
+    ), (R.standard_normal((1, 1, 4, 8)),)),
+    ("margin_ce", lambda a: paddle.nn.functional.margin_cross_entropy(
+        paddle.tanh(a) * 0.9, paddle.to_tensor(np.array([1, 0], np.int32)),
+        margin1=1.0, margin2=0.1, margin3=0.0, scale=4.0), (0.3 * R.standard_normal((2, 4)),)),
+    ("softmax_mask_fuse_tri", lambda a: paddle.incubate.softmax_mask_fuse_upper_triangle(a), (R.standard_normal((1, 3, 3)),)),
 ]
 
 
