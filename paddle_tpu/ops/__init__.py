@@ -29,6 +29,8 @@ import jax
 from paddle_tpu._core import flags as _flags
 
 _flags.define_flag("FLAGS_use_pallas", "auto", "auto|true|false — Pallas kernel dispatch")
+_flags.define_flag("FLAGS_flash_block_q", 128, "flash attention q-block rows (MXU tile multiple)")
+_flags.define_flag("FLAGS_flash_block_k", 128, "flash attention k-block rows (MXU tile multiple)")
 
 
 def use_pallas() -> bool:

@@ -36,9 +36,22 @@ def _mask_val():
 
 
 def _block_sizes(seq_q, seq_k):
-    bq = min(128, seq_q)
-    bk = min(128, seq_k)
-    return bq, bk
+    """Default 128x128 (the proven v5e config); FLAGS_flash_block_q/_k let a
+    tuning run try other tiles without code edits.  A flag value applies
+    only when it is a positive multiple of 8 (sublane tile) AND divides the
+    sequence; otherwise the 128 default stands — and when even that does
+    not divide, the caller's ragged-length reference fallback triggers."""
+    from paddle_tpu._core import flags as _flags
+
+    def pick(flag, seq):
+        want = int(_flags.flag(flag))
+        if want >= 8 and want % 8 == 0:
+            cand = min(want, seq)
+            if seq % cand == 0:
+                return cand
+        return min(128, seq)
+
+    return pick("FLAGS_flash_block_q", seq_q), pick("FLAGS_flash_block_k", seq_k)
 
 
 # ---------------------------------------------------------------------------

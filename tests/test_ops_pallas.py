@@ -248,3 +248,41 @@ def test_kernel_jaxpr_no_64bit(name, fn, args):
 @pytest.mark.parametrize("name,fn,args", _kernel_calls(), ids=lambda v: v if isinstance(v, str) else "")
 def test_kernel_compiles_on_tpu(name, fn, args):
     jax.jit(fn).lower(*args).compile()
+
+
+def test_flash_block_size_flags():
+    """FLAGS_flash_block_q/_k apply only when a positive multiple of 8 that
+    divides the sequence; anything else keeps the 128 default, and ragged
+    lengths still reach the caller's reference fallback."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.flash_attention import _block_sizes
+
+    try:
+        assert _block_sizes(1024, 1024) == (128, 128)
+        assert _block_sizes(130, 130) == (128, 128)  # 130 % 128 != 0 -> caller falls back
+        paddle.set_flags({"FLAGS_flash_block_q": 256, "FLAGS_flash_block_k": 64})
+        assert _block_sizes(1024, 1024) == (256, 64)
+        paddle.set_flags({"FLAGS_flash_block_q": 0, "FLAGS_flash_block_k": -64})
+        assert _block_sizes(1024, 1024) == (128, 128)
+        paddle.set_flags({"FLAGS_flash_block_q": 100, "FLAGS_flash_block_k": 128})
+        assert _block_sizes(400, 400) == (128, 128)  # 100 not a sublane multiple
+    finally:
+        paddle.set_flags({"FLAGS_flash_block_q": 128, "FLAGS_flash_block_k": 128})
+
+
+def test_flash_nondefault_blocks_match_reference():
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import flash_attention as fa_fn
+    from paddle_tpu.ops.flash_attention import flash_attention_reference
+
+    q = jnp.asarray(np.random.default_rng(0).standard_normal((1, 256, 2, 128)).astype(np.float32))
+    try:
+        paddle.set_flags({"FLAGS_use_pallas": "true", "FLAGS_flash_block_q": 256, "FLAGS_flash_block_k": 64})
+        out = fa_fn(q, q, q, causal=True)
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas": "auto", "FLAGS_flash_block_q": 128, "FLAGS_flash_block_k": 128})
+    ref = flash_attention_reference(q, q, q, causal=True)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
