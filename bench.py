@@ -8,58 +8,136 @@ Paddle/PaddleNLP reaches for LLaMA pretraining on A100 (the north-star is
 "match Paddle-on-A100 tokens/sec/chip", which at equal MFU is the same
 comparison up to the peak-FLOPs ratio). vs_baseline >= 1.0 means we use our
 chip at least as efficiently as the reference uses its GPU.
+
+Tunnel-flap hardening: the remote-TPU (axon) backend init can wedge forever.
+The parent process first runs cheap device probes in subprocesses with a
+bounded timeout and exponential backoff; only after a probe succeeds does it
+launch the measurement child (whose XLA compiles hit the persistent cache, so
+a retry does not pay the full compile again).  All failures emit a clean
+zero-value JSON line — no stale historical numbers in the payload
+(see BASELINE.md for history).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+METRIC = "llama_pretrain_tokens_per_sec_per_chip"
+CACHE_DIR = "/tmp/jax_cache"
+
+PROBE_TIMEOUT = 90  # seconds per probe attempt (first TPU init ~20-40s)
+PROBE_BACKOFFS = (10, 20, 40)  # sleep between probe attempts
+BENCH_TIMEOUT = 900  # full measurement incl. cold compile
+BENCH_ATTEMPTS = 2
 
 
-def main():
-    import threading
+def _fail(error: str, code: int = 3) -> int:
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": error,
+            }
+        ),
+        flush=True,
+    )
+    return code
 
+
+def _probe() -> bool:
+    """Initialize the jax backend in a throwaway subprocess, bounded."""
+    code = (
+        "import jax, os; "
+        "os.environ.get('PADDLE_TPU_BENCH_CPU') and jax.config.update('jax_platforms', 'cpu'); "
+        "jax.config.update('jax_compilation_cache_dir', %r); "
+        "d = jax.devices(); print('PROBE_OK', d[0].platform, flush=True)" % CACHE_DIR
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=PROBE_TIMEOUT,
+            capture_output=True,
+            text=True,
+        )
+        return out.returncode == 0 and "PROBE_OK" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def parent() -> int:
+    ok = _probe()
+    for backoff in PROBE_BACKOFFS:
+        if ok:
+            break
+        time.sleep(backoff)
+        ok = _probe()
+    if not ok:
+        return _fail(
+            "TPU backend init failed %d probe attempts (tunnel unreachable); "
+            "see BASELINE.md for the last recorded on-chip measurement"
+            % (1 + len(PROBE_BACKOFFS))
+        )
+
+    env = dict(os.environ, PADDLE_TPU_BENCH_CHILD="1")
+    last_err = "unknown"
+    for attempt in range(BENCH_ATTEMPTS):
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                timeout=BENCH_TIMEOUT,
+                capture_output=True,
+                text=True,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = "measurement child exceeded %ds" % BENCH_TIMEOUT
+            continue
+        line = next(
+            (
+                ln
+                for ln in reversed(out.stdout.splitlines())
+                if ln.startswith("{") and '"metric"' in ln
+            ),
+            None,
+        )
+        if out.returncode == 0 and line:
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                last_err = "child emitted unparseable JSON"
+                continue
+            if parsed.get("value", 0) > 0:
+                print(line, flush=True)
+                return 0
+            last_err = parsed.get("error", "child reported zero value")
+        else:
+            last_err = "child rc=%d: %s" % (
+                out.returncode,
+                (out.stderr or out.stdout).strip().splitlines()[-1:]
+                or ["no output"],
+            )
+        if attempt + 1 < BENCH_ATTEMPTS and not _probe():
+            time.sleep(30)
+    return _fail("measurement failed after %d attempts: %s" % (BENCH_ATTEMPTS, last_err))
+
+
+def child() -> int:
+    import numpy as np
     import jax
 
-    # persistent XLA compile cache: repeated bench runs (driver re-runs,
-    # round restarts on one box) skip the multi-minute first compile
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):  # dev smoke without the tunnel
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    # The remote-TPU (axon) tunnel can wedge, making backend init hang
-    # forever; emit an explicit zero result instead of timing out silently.
-    init_done = threading.Event()
-
-    def _init_watchdog():
-        if not init_done.wait(300):
-            print(
-                json.dumps(
-                    {
-                        "metric": "llama_pretrain_tokens_per_sec_per_chip",
-                        "value": 0.0,
-                        "unit": "tokens/s",
-                        "vs_baseline": 0.0,
-                        "error": "TPU backend init exceeded 300s (tunnel unreachable)",
-                        "last_measured_on_chip": {
-                            "date": "2026-07-30",
-                            "hidden1024_config": {"tokens_per_sec": 88102.94, "vs_baseline": 1.1037},
-                            "hidden2048_config_probe": {"tokens_per_sec": 35618.4, "mfu": 0.6245, "vs_baseline": 1.388},
-                            "note": "last successful on-chip measurement (see date field); BASELINE.md has the full table",
-                        },
-                    }
-                ),
-                flush=True,
-            )
-            import os
-
-            os._exit(3)
-
-    threading.Thread(target=_init_watchdog, daemon=True).start()
     platform = jax.devices()[0].platform
-    init_done.set()
     on_accel = platform not in ("cpu",)
 
     import paddle_tpu as paddle
@@ -67,8 +145,8 @@ def main():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     if on_accel:
-        # hidden 2048 doubles the MXU tile occupancy vs 1024: measured 0.62
-        # vs 0.50 MFU on the v5e (ablation in BASELINE.md round-2 notes)
+        # Flagship config: hidden 2048 doubles the MXU tile occupancy vs
+        # 1024 — measured 0.62 vs 0.50 MFU on the v5e (BASELINE.md round-2).
         cfg = LlamaConfig(
             vocab_size=32000,
             hidden_size=2048,
@@ -128,32 +206,31 @@ def main():
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * S
     achieved_tflops = tokens_per_sec * flops_per_token / 1e12
 
+    from paddle_tpu.device.peaks import device_peak_tflops
+
     kind = jax.devices()[0].device_kind.lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        peak = 197.0
-    elif "v5p" in kind or "v5" in kind:
-        peak = 459.0
-    elif platform != "cpu":
-        peak = 275.0  # v4 default
-    else:
-        peak = 0.0
-    if peak:
-        mfu = achieved_tflops / peak
-        vs_baseline = mfu / 0.45
-    else:
-        vs_baseline = 0.0
+    peak = device_peak_tflops(kind, platform)
+    mfu = achieved_tflops / peak if peak else 0.0
+    vs_baseline = mfu / 0.45 if peak else 0.0
 
     print(
         json.dumps(
             {
-                "metric": "llama_pretrain_tokens_per_sec_per_chip",
+                "metric": METRIC,
                 "value": round(tokens_per_sec, 2),
                 "unit": "tokens/s",
                 "vs_baseline": round(vs_baseline, 4),
+                "mfu": round(mfu, 4),
+                "device_kind": kind,
+                "config": "hidden2048_L8_bf16" if on_accel else "cpu_smoke",
             }
-        )
+        ),
+        flush=True,
     )
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("PADDLE_TPU_BENCH_CHILD"):
+        sys.exit(child())
+    sys.exit(parent())

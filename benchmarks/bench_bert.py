@@ -53,11 +53,21 @@ def main():
         loss = step(ids, y)
     loss._value.block_until_ready()
     dt = time.perf_counter() - t0
+    tokens_per_sec = B * S * iters / dt
+
+    # vs_baseline: peak-normalized chip-efficiency parity against the
+    # written-down A100 reference point (BASELINE.md "A100 reference
+    # points"): BERT-base AMP S=128 1xA100 = 139,264 tok/s (1,088 seq/s).
+    from paddle_tpu.device.peaks import A100_PEAK_TFLOPS, device_peak_tflops
+
+    d = jax.devices()[0]
+    peak = device_peak_tflops(d.device_kind, d.platform)
+    vs_baseline = (tokens_per_sec / peak) / (139264.0 / A100_PEAK_TFLOPS) if peak else 0.0
     print(json.dumps({
         "metric": "bert_finetune_tokens_per_sec",
-        "value": round(B * S * iters / dt, 2),
+        "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
-        "vs_baseline": 0.0,
+        "vs_baseline": round(vs_baseline, 4),
     }))
 
 

@@ -1,0 +1,21 @@
+"""Per-chip peak bf16 TFLOPs (single source for the benchmark suite's
+MFU / vs_baseline math — bench.py, benchmarks/bench_resnet.py,
+benchmarks/bench_bert.py)."""
+
+from __future__ import annotations
+
+A100_PEAK_TFLOPS = 312.0  # bf16, the reference baselines' GPU
+
+
+def device_peak_tflops(device_kind: str, platform: str) -> float:
+    """Peak bf16 TFLOPs for a jax device kind; 0.0 for CPU (no MFU)."""
+    kind = device_kind.lower()
+    if "v6e" in kind or "trillium" in kind:
+        return 918.0
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197.0
+    if "v5p" in kind or "v5" in kind:
+        return 459.0
+    if platform != "cpu":
+        return 275.0  # v4 default
+    return 0.0
