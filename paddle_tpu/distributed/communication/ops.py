@@ -396,7 +396,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     )
 
 
-def _p2p_impl(tensor, group):
+def _p2p_impl(tensor, group, peer, is_send):
     ax = _axis_for(group)
     if ax is not None:
         raise NotImplementedError(
@@ -405,21 +405,18 @@ def _p2p_impl(tensor, group):
         )
     if _world(group) == 1:
         return _Task(tensor)
-    raise NotImplementedError(
-        "this collective has no eager multi-controller path yet; run it "
-        "inside the distributed step (axis mode) or use "
-        "paddle_tpu.distributed.collective.ProcessGroup directly"
-    )
+    pg = _process_group_for(group)
+    return pg.send(tensor, peer) if is_send else pg.recv(tensor, peer)
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     _static_check("p2p", tensor, group, peers_hint=sorted([_my_rank(), dst]))
-    return _p2p_impl(tensor, group)
+    return _p2p_impl(tensor, group, dst, is_send=True)
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
     _static_check("p2p", tensor, group, peers_hint=sorted([src, _my_rank()]))
-    return _p2p_impl(tensor, group)
+    return _p2p_impl(tensor, group, src, is_send=False)
 
 
 def isend(tensor, dst=0, group=None):
@@ -455,12 +452,18 @@ def wait(tensor, group=None, use_calc_stream=True):
     return _Task(tensor)
 
 
-_obj_seq = [0]
+def _next_obj_seq(store, kind, src, rank):
+    """Store-allocated per-(kind, src, reader) sequence number.
 
-
-def _next_obj_seq():
-    _obj_seq[0] += 1
-    return _obj_seq[0]
+    Living in the rendezvous store (not process memory), the counters
+    survive elastic restarts, so a restarted rank resumes at the next
+    unconsumed payload instead of silently re-reading generation-old
+    pickles (the reference keys these exchanges off the store too:
+    python/paddle/distributed/communication/serialization_utils.py).
+    A reader that runs ahead of the writer blocks on get() and times
+    out loudly rather than deserializing a stale value."""
+    role = "src" if rank == src else f"r{rank}"
+    return store.add(f"objseq/{kind}/{src}/{role}", 1)
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
@@ -490,7 +493,7 @@ def broadcast_object_list(object_list, src=0, group=None):
     if store is None:
         raise RuntimeError("broadcast_object_list needs a rendezvous store (set_rendezvous_store/launch) outside world-1")
     rank = _env.get_rank()
-    key = f"bcast_obj/{_next_obj_seq()}"
+    key = f"bcast_obj/{src}/{_next_obj_seq(store, 'bcast', src, rank)}"
     if rank == src:
         store.set(key, pickle.dumps(list(object_list)))
     else:
@@ -513,7 +516,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
     if store is None:
         raise RuntimeError("scatter_object_list needs a rendezvous store (set_rendezvous_store/launch) outside world-1")
     rank, world = _env.get_rank(), _env.get_world_size()
-    key = f"scatter_obj/{_next_obj_seq()}"
+    key = f"scatter_obj/{src}/{_next_obj_seq(store, 'scatter', src, rank)}"
     if rank == src:
         store.set(key, pickle.dumps(list(in_object_list)))
         out_object_list[:] = [in_object_list[rank]]

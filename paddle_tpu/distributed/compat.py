@@ -193,7 +193,26 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     import socket
 
     if nprocs <= 0:
-        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) or 1
+        env_n = os.environ.get("PADDLE_TRAINERS_NUM")
+        if env_n:
+            nprocs = int(env_n)
+        else:
+            # reference spawn defaults to the visible device count
+            # (python/paddle/distributed/spawn.py _get_default_nprocs).
+            # Query it in a THROWAWAY subprocess: jax.device_count() in this
+            # parent would initialize the TPU runtime here and lock the
+            # chips away from the spawned trainers.
+            import subprocess
+            import sys
+
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-c", "import jax; print(jax.device_count())"],
+                    capture_output=True, text=True, timeout=120,
+                )
+                nprocs = max(1, int(out.stdout.strip().splitlines()[-1]))
+            except Exception:
+                nprocs = 1
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]

@@ -313,6 +313,51 @@ def default_collate_fn(batch):
     return batch
 
 
+def _shm_worker(ring_name, counter_path, ds_blob, batches, wid, nw, window):
+    """Spawned DataLoader worker: fetch raw samples for a strided subset of
+    batches and push pickled (batch_index, samples) items onto the shm ring.
+
+    Runs in a fresh interpreter (spawn, not fork — forking a JAX-initialized
+    multithreaded parent deadlocks), so the dataset arrives cloudpickled and
+    nothing here may touch the JAX runtime."""
+    import mmap
+    import pickle
+    import struct
+    import time
+    import traceback
+
+    import cloudpickle
+
+    from paddle_tpu import _native
+
+    wring = None
+    try:
+        dataset = cloudpickle.loads(ds_blob)
+        wring = _native.ShmRing(ring_name, create=False)
+        fd = os.open(counter_path, os.O_RDONLY)
+        try:
+            consumed = mmap.mmap(fd, 8, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        n = len(batches)
+        for k in range(wid, n, nw):
+            # pace: never run more than `window` batches ahead of the parent
+            while k - struct.unpack("Q", consumed[0:8])[0] >= window:
+                time.sleep(0.002)
+            samples = [dataset[i] for i in batches[k]]
+            payload = pickle.dumps((k, samples), protocol=pickle.HIGHEST_PROTOCOL)
+            wring.push(payload, timeout_ms=60_000)
+    except BaseException:
+        try:
+            err = pickle.dumps((-1, (wid, traceback.format_exc())))
+            if wring is not None:
+                wring.push(err, timeout_ms=1000)
+        except BaseException:
+            pass
+        os._exit(1)
+    os._exit(0)
+
+
 class DataLoader:
     def __init__(
         self,
@@ -337,11 +382,11 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
-        # use_shared_memory=True explicitly OPTS IN to fork()-based workers
-        # over the native shm ring (reference default is shared memory; here
-        # the default None/False keeps the fork-free thread path because
-        # forking a JAX-initialized multithreaded parent is only safe when
-        # dataset code stays out of the runtime — caller's judgement)
+        # use_shared_memory=True OPTS IN to spawned worker processes over the
+        # native shm ring (reference default is shared memory; the default
+        # None/False keeps the in-process thread path, which avoids the
+        # per-epoch interpreter spawn cost when Python-level decode isn't
+        # the bottleneck)
         self._use_shared_memory = bool(use_shared_memory)
         self.prefetch_factor = prefetch_factor
         # TPU-first input pipeline: stage the next N batches onto the device
@@ -379,7 +424,7 @@ class DataLoader:
                 yield self.collate_fn(batch)
             return
         if self.num_workers > 0:
-            if self._use_shared_memory and hasattr(os, "fork"):
+            if self._use_shared_memory:
                 from paddle_tpu import _native  # lazy: builds the .so on first use
 
                 if _native.AVAILABLE:
@@ -408,24 +453,27 @@ class DataLoader:
 
     def _iter_mp_shm(self):
         """True multi-process workers over the native shared-memory ring
-        (reference: python/paddle/io/dataloader/dataloader_iter.py fork
-        workers + shared-memory queues; ring in paddle_tpu/_native/shm_ring.cc).
+        (reference: python/paddle/io/dataloader/dataloader_iter.py worker
+        processes + shared-memory queues; ring in
+        paddle_tpu/_native/src/shm_ring.cc).
 
-        Workers fork and fetch raw samples for their strided subset of
-        batches, pushing pickled (batch_index, samples) items; the parent
-        pops, reorders, runs collate_fn, and yields in sampler order.
-        collate_fn runs in the PARENT so forked children never touch the
-        JAX/XLA runtime (fork after XLA thread init is not safe); dataset
-        __getitem__ must likewise be fork-safe (numpy/PIL/IO — same caveat
-        as the reference's fork-mode workers).  A shared consumed-counter
-        paces workers to a bounded read-ahead window so the parent's reorder
-        buffer cannot grow past ~nw * (prefetch_factor + 1) batches."""
+        Workers are SPAWNED (never forked: the parent is a JAX-initialized
+        multithreaded process, and fork there deadlocks) with the dataset
+        shipped via cloudpickle; each fetches raw samples for its strided
+        subset of batches and pushes pickled (batch_index, samples) items.
+        The parent pops, reorders, runs collate_fn, and yields in sampler
+        order — collate_fn runs in the PARENT so workers never touch the
+        JAX/XLA runtime.  A file-backed consumed-counter paces workers to a
+        bounded read-ahead window so the parent's reorder buffer cannot grow
+        past ~nw * (prefetch_factor + 1) batches."""
         import mmap
+        import multiprocessing as mp
         import pickle
         import struct
-        import time
-        import traceback
+        import tempfile
         import uuid
+
+        import cloudpickle
 
         from paddle_tpu import _native
 
@@ -435,36 +483,34 @@ class DataLoader:
             return
         nw = min(self.num_workers, n)
         window = nw * (self.prefetch_factor + 1)
-        ring_name = f"/pt_dl_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        uid = f"pt_dl_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        ring_name = "/" + uid
         ring = _native.ShmRing(ring_name, 128 << 20)
-        # anonymous shared page: [0:8] = number of batches consumed by parent
-        consumed = mmap.mmap(-1, 8)
+        # file-backed shared page: [0:8] = number of batches consumed by the
+        # parent (a plain file under /dev/shm; mmap-shared with spawned
+        # children by path, no resource-tracker involvement)
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+        counter_path = os.path.join(shm_dir, uid + ".ctr")
+        with open(counter_path, "wb") as f:
+            f.write(struct.pack("Q", 0))
+        fd = os.open(counter_path, os.O_RDWR)
+        consumed = mmap.mmap(fd, 8)
+        os.close(fd)
         consumed[0:8] = struct.pack("Q", 0)
-        pids = []
+        ds_blob = cloudpickle.dumps(self.dataset)
+        ctx = mp.get_context("spawn")
+        procs = []
         try:
             for wid in range(nw):
-                pid = os.fork()
-                if pid == 0:  # worker
-                    try:
-                        wring = _native.ShmRing(ring_name, create=False)
-                        for k in range(wid, n, nw):
-                            # pace: never run more than `window` batches ahead
-                            while k - struct.unpack("Q", consumed[0:8])[0] >= window:
-                                time.sleep(0.002)
-                            samples = [self.dataset[i] for i in batches[k]]
-                            payload = pickle.dumps((k, samples), protocol=pickle.HIGHEST_PROTOCOL)
-                            wring.push(payload, timeout_ms=60_000)
-                    except BaseException:
-                        try:
-                            err = pickle.dumps((-1, (wid, traceback.format_exc())))
-                            wring.push(err, timeout_ms=1000)
-                        except BaseException:
-                            pass
-                        os._exit(1)
-                    os._exit(0)
-                pids.append(pid)
+                p = ctx.Process(
+                    target=_shm_worker,
+                    args=(ring_name, counter_path, ds_blob, batches, wid, nw, window),
+                    daemon=True,
+                )
+                p.start()
+                procs.append(p)
 
-            live = set(pids)
+            live = set(procs)
             holdback = {}
             next_k = 0
             while next_k < n:
@@ -476,12 +522,11 @@ class DataLoader:
                 try:
                     payload = ring.pop(timeout_ms=1000)
                 except TimeoutError:
-                    # reap exited workers (each at most once) to detect failures
-                    for pid in list(live):
-                        done, status = os.waitpid(pid, os.WNOHANG)
-                        if done:
-                            live.discard(pid)
-                            if os.waitstatus_to_exitcode(status) != 0:
+                    # notice dead workers to turn hangs into failures
+                    for p in list(live):
+                        if not p.is_alive():
+                            live.discard(p)
+                            if p.exitcode != 0:
                                 raise RuntimeError(
                                     "DataLoader worker died without reporting "
                                     "an exception"
@@ -512,13 +557,17 @@ class DataLoader:
             # closed ring
             consumed[0:8] = struct.pack("Q", n + window)
             ring.close()
-            for pid in pids:
-                try:
-                    os.waitpid(pid, 0)
-                except ChildProcessError:
-                    pass
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=5)
             ring.destroy()
             consumed.close()
+            try:
+                os.unlink(counter_path)
+            except OSError:
+                pass
 
     def __iter__(self):
         if self.prefetch_to_device > 0:

@@ -449,7 +449,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
     collected = {"scores": [], "predicted_ids": [], "parent_ids": []}
     lengths = None
     step = 0
-    limit = int(max_step_num) if max_step_num is not None else 256
+    # reference loops until all beams finish when max_step_num is None; keep
+    # a high safety cap against non-terminating decoders and warn if hit.
+    limit = int(max_step_num) if max_step_num is not None else 10_000
     while step < limit:
         outputs, states, inputs, finished = decoder.step(step, inputs, states, **kwargs)
         for k in collected:
@@ -462,6 +464,16 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
         step += 1
         if fin.all():
             break
+    else:
+        if max_step_num is None:
+            import warnings
+
+            warnings.warn(
+                f"dynamic_decode stopped at the {limit}-step safety cap with "
+                "unfinished sequences; pass max_step_num to bound decoding "
+                "explicitly",
+                RuntimeWarning,
+            )
     seqs, final_states = decoder.finalize(collected, states, lengths)
     if not output_time_major:
         # reference _transpose_batch_time: [T, B, K] -> [B, T, K]

@@ -142,7 +142,11 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """Host-callback op (reference: python/paddle/static/nn/common.py py_func
     over the C++ py_func op): runs a numpy function inside the graph via
-    jax.pure_callback, with an optional custom backward."""
+    jax.pure_callback.  When backward_func is given the callback is wrapped
+    in jax.custom_vjp and the cotangents route through a second
+    pure_callback, mirroring the reference's paired forward/backward py_func
+    ops (backward input = x + out + out_grads, minus
+    skip_vars_in_backward_input; backward output = one grad per x)."""
     import jax
     import jax.numpy as jnp
 
@@ -153,14 +157,54 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     outs = out if isinstance(out, (list, tuple)) else [out]
     shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in outs]
 
-    def _fn(*vals):
+    def _call(*vals):
         def host(*hv):
             res = func(*[np.asarray(h) for h in hv])
             res = res if isinstance(res, (list, tuple)) else [res]
-            return [np.asarray(r) for r in res]
+            return [np.asarray(r, sh.dtype) for r, sh in zip(res, shapes)]
 
-        res = jax.pure_callback(host, shapes, *vals)
-        return tuple(res) if len(res) > 1 else res[0]
+        return tuple(jax.pure_callback(host, shapes, *vals))
+
+    if backward_func is None:
+        def _fn(*vals):
+            res = _call(*vals)
+            return res if len(res) > 1 else res[0]
+    else:
+        skip = skip_vars_in_backward_input or []
+        skip = skip if isinstance(skip, (list, tuple)) else [skip]
+        skip_ids = {id(t) for t in skip}
+        keep_x = [i for i, t in enumerate(xs) if id(t) not in skip_ids]
+        keep_o = [i for i, t in enumerate(outs) if id(t) not in skip_ids]
+        x_shapes = [jax.ShapeDtypeStruct(tuple(v.shape), v._value.dtype) for v in xs]
+
+        @jax.custom_vjp
+        def _cb(*vals):
+            res = _call(*vals)
+            return res if len(res) > 1 else res[0]
+
+        def _cb_fwd(*vals):
+            res = _call(*vals)
+            return (res if len(res) > 1 else res[0]), (vals, res)
+
+        def _cb_bwd(saved, cot):
+            vals, res = saved
+            cots = cot if isinstance(cot, tuple) else (cot,)
+            b_in = [vals[i] for i in keep_x] + [res[i] for i in keep_o] + list(cots)
+
+            def host_bwd(*hv):
+                g = backward_func(*[np.asarray(h) for h in hv])
+                g = g if isinstance(g, (list, tuple)) else [g]
+                if len(g) != len(x_shapes):
+                    raise ValueError(
+                        f"py_func backward_func returned {len(g)} grads for "
+                        f"{len(x_shapes)} inputs"
+                    )
+                return [np.asarray(gv, sh.dtype).reshape(sh.shape) for gv, sh in zip(g, x_shapes)]
+
+            return tuple(jax.pure_callback(host_bwd, x_shapes, *b_in))
+
+        _cb.defvjp(_cb_fwd, _cb_bwd)
+        _fn = _cb
 
     return apply("py_func", _fn, *xs, n_outputs=len(shapes) if len(shapes) > 1 else None)
 

@@ -289,6 +289,21 @@ def test_py_func_and_print():
     np.testing.assert_allclose(np.asarray(y._value), np.asarray(x._value))
 
 
+def test_py_func_custom_backward():
+    # backward_func receives (x, out, out_grad) and returns dx; the custom
+    # rule deliberately disagrees with the analytic grad (returns 10*g)
+    # so the test proves backward_func is actually used.
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    out = paddle.static.py_func(
+        lambda a: a * 3.0,
+        x,
+        paddle.zeros([2]),
+        backward_func=lambda a, o, g: 10.0 * g,
+    )
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), [10.0, 10.0])
+
+
 def test_bilinear_and_global_initializer():
     init = paddle.nn.initializer.Bilinear()
     w = init._init_value((1, 1, 4, 4), np.float32)
