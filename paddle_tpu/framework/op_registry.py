@@ -61,16 +61,26 @@ _DYNAMIC_SHAPE_OPS = {
 
 _registry: dict[str, OpInfo] | None = None
 
+# op-factory plumbing that lives in the op modules but is not itself a
+# public op (would pollute the registry's op counts and docs)
+_NOT_OPS = {"apply", "binary", "unary", "ensure_tensor", "to_jax_dtype"}
+
 
 def _op_modules():
+    import importlib
+
     from paddle_tpu.tensor import (
-        creation, einsum, linalg, logic, manipulation, math, random, search, stat,
+        creation, linalg, logic, manipulation, math, random, search, stat,
     )
 
+    # NOTE: `from paddle_tpu.tensor import einsum` would bind the einsum
+    # FUNCTION (re-exported by the package __init__), not the module —
+    # import it by path so its ops register.
+    einsum_mod = importlib.import_module("paddle_tpu.tensor.einsum")
     return {
         "math": math, "manipulation": manipulation, "linalg": linalg,
         "logic": logic, "search": search, "stat": stat, "creation": creation,
-        "random": random, "einsum": einsum,
+        "random": random, "einsum": einsum_mod,
     }
 
 
@@ -85,7 +95,7 @@ def build_registry() -> dict[str, OpInfo]:
     reg: dict[str, OpInfo] = {}
     for cat, mod in _op_modules().items():
         for name in dir(mod):
-            if name.startswith("_"):
+            if name.startswith("_") or name in _NOT_OPS:
                 continue
             fn = getattr(mod, name)
             if not callable(fn) or isinstance(fn, type):

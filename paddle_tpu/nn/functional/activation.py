@@ -121,12 +121,14 @@ def softmax(x, axis=-1, dtype=None, name=None):
 
     dt = to_jax_dtype(dtype)
 
-    def _sm(v):
+    def _sm(v, axis=int(axis)):
         if dt is not None:
             v = v.astype(dt)
-        return jax.nn.softmax(v, axis=int(axis))
+        return jax.nn.softmax(v, axis=axis)
 
-    return apply("softmax", _sm, x)
+    # axis rides as a static kwarg so captured Operators expose it to
+    # pattern matchers (static/rewrite.py checks it before fusing)
+    return apply("softmax", _sm, x, axis=int(axis))
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

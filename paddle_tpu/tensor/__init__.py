@@ -60,7 +60,7 @@ def _patch_methods():
 
 
 def _patch_operators():
-    from .math import add, divide, floor_divide, matmul, maximum, minimum, mod, multiply, pow_, subtract
+    from .math import _pow_impl, add, divide, floor_divide, matmul, maximum, minimum, mod, multiply, subtract
     from .logic import (
         equal,
         greater_equal,
@@ -86,8 +86,8 @@ def _patch_operators():
     Tensor.__rfloordiv__ = lambda s, o: floor_divide(o, s)
     Tensor.__mod__ = lambda s, o: mod(s, o)
     Tensor.__rmod__ = lambda s, o: mod(o, s)
-    Tensor.__pow__ = lambda s, o: pow_(s, o)
-    Tensor.__rpow__ = lambda s, o: pow_(o, s)
+    Tensor.__pow__ = lambda s, o: _pow_impl(s, o)
+    Tensor.__rpow__ = lambda s, o: _pow_impl(o, s)
     Tensor.__matmul__ = lambda s, o: matmul(s, o)
     Tensor.__rmatmul__ = lambda s, o: matmul(o, s)
     Tensor.__neg__ = lambda s: multiply(s, -1)

@@ -45,12 +45,11 @@ def wrap(name, jfn):
 
 
 def unary(name, jfn, doc=""):
+    op_name = name  # the paddle-API `name=` kwarg must NOT shadow the op id
+
     def op(x, name_arg=None, name=None):
         x = ensure_tensor(x)
-        return apply(name_or(op), jfn, x)
-
-    def name_or(_):
-        return name
+        return apply(op_name, jfn, x)
 
     op.__name__ = name
     op.__doc__ = doc or f"Elementwise {name} (TPU-native equivalent of paddle.{name})."
@@ -58,12 +57,15 @@ def unary(name, jfn, doc=""):
 
 
 def binary(name, jfn, doc=""):
+    op_name = name  # NOT the call-time `name=` kwarg (AMP lists + static
+    # capture + profiler all key off the op id; shadowing recorded None)
+
     def op(x, y, name=None):
         if not isinstance(x, Tensor) and isinstance(y, Tensor):
             x = ensure_tensor(x, ref=y)
         x = ensure_tensor(x)
         y = ensure_tensor(y, ref=x)
-        return apply(name, jfn, x, y)
+        return apply(op_name, jfn, x, y)
 
     op.__name__ = name
     op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting (paddle.{name})."

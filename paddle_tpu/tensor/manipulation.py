@@ -48,7 +48,12 @@ def swapaxes(x, axis0, axis1, name=None):
     return apply("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), x)
 
 
-transpose_ = transpose  # placeholder for inplace variant
+def transpose_(x, perm, name=None):
+    """In-place transpose (reference transpose_): rebinds x to the permuted
+    buffer via the shared in-place helper."""
+    from paddle_tpu.tensor._ops_common import inplace_from
+
+    return inplace_from(x, transpose, perm)
 t = lambda x, name=None: transpose(ensure_tensor(x), list(range(ensure_tensor(x).ndim))[::-1])  # noqa: E731
 
 

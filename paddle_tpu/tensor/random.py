@@ -160,6 +160,13 @@ def log_normal(mean=1.0, std=2.0, shape=None, name=None):
 
 
 def rayleigh(scale=1.0, shape=None, name=None):
+    if isinstance(scale, Tensor):
+        # tensor scale: one sample per element (broadcasting a single
+        # scalar draw over the tensor would correlate every entry)
+        sv = scale._value
+        sh = _shape_list(shape) if shape is not None else list(sv.shape)
+        u = jax.random.uniform(rng.next_key(), sh, minval=1e-9, maxval=1.0)
+        return Tensor(sv * jnp.sqrt(-2.0 * jnp.log(u)))
     sh = _shape_list(shape) if shape is not None else []
     u = jax.random.uniform(rng.next_key(), sh, minval=1e-9, maxval=1.0)
     return Tensor(scale * jnp.sqrt(-2.0 * jnp.log(u)))
