@@ -1,2 +1,2 @@
-from .pipeline import PipelineStack  # noqa: F401
+from .pipeline import PipelineStack, segment_layers  # noqa: F401
 from .segment_parallel import SegmentParallel, sep_attention, split_inputs_sequence_dim  # noqa: F401

@@ -36,11 +36,25 @@ SPMD program:
 - Activation recompute per layer (use_recompute=True, jax.checkpoint inside
   the stage) replaces the reference's RecomputeFunction inside stages.
 
-Constraints (same as the reference's uniform SegmentLayers path): all blocks
-structurally identical, block output shape == input shape, and
+Constraints (same as the reference's uniform SegmentLayers path): all TRUNK
+blocks structurally identical, block output shape == input shape, and
 len(blocks) % pp_degree == 0.  num_microbatches may exceed the stage count
 (steady-state 1F1B, reference pipeline_parallel.py:431) — it must divide the
 batch.
+
+Non-uniform stages (reference SegmentLayers:92 puts embedding on the first
+stage and the head on the last): `first_stage` / `last_stage` layers ride
+the same SPMD program guarded by `lax.cond(stage == 0 / S-1, ...)`, so the
+embedding runs only where stage 0's devices execute and the head only on the
+last stage — the cond keeps the FLOPs off the other stages at runtime.  The
+ring still carries the uniform trunk activation; the input buffer holds the
+raw model input (e.g. token ids) and the output buffer the head's output
+(e.g. logits), whose shapes may both differ from the trunk activation.
+Cost-weighted trunk segmentation (SegmentLayers seg_method="uniform"/
+param-weighted) degenerates to uniform here because trunk blocks are
+structurally identical — the heterogeneity LLMs actually have (embedding/
+head) is exactly what first_stage/last_stage carry; `segment_layers` below
+keeps the reference's cut algorithm available for planner parity.
 """
 
 from __future__ import annotations
@@ -62,9 +76,43 @@ def _pvary(x, axes):
         return lax.pcast(x, axes, to="varying")
     return lax.pvary(x, axes)
 
-__all__ = ["PipelineStack"]
+__all__ = ["PipelineStack", "segment_layers"]
 
 _SCHEDULES = ("1F1B", "FThenB", "VPP")
+
+
+def segment_layers(weights, num_stages, method: str = "uniform"):
+    """Cut a heterogeneous layer list into pipeline stages (reference
+    SegmentLayers, fleet pp_layers.py:92): returns num_stages+1 cut points.
+
+    method="uniform": equal layer counts (remainder spread to the front);
+    method="param" (reference seg_method="layer:..."/parameter-weighted):
+    balance the per-stage sum of `weights` (e.g. parameter counts) greedily
+    along the prefix-sum, the reference's segment_parts strategy."""
+    n = len(weights)
+    if num_stages < 1 or n < num_stages:
+        raise ValueError(f"cannot cut {n} layers into {num_stages} stages")
+    if method == "uniform":
+        base, rem = divmod(n, num_stages)
+        cuts = [0]
+        for s in range(num_stages):
+            cuts.append(cuts[-1] + base + (1 if s < rem else 0))
+        return cuts
+    if method == "param":
+        total = float(sum(weights))
+        prefix = [0.0]
+        for w in weights:
+            prefix.append(prefix[-1] + float(w))
+        cuts = [0]
+        for s in range(1, num_stages):
+            target = total * s / num_stages
+            # closest prefix point that keeps at least one layer per stage
+            lo, hi = cuts[-1] + 1, n - (num_stages - s)
+            best = min(range(lo, hi + 1), key=lambda i: abs(prefix[i] - target))
+            cuts.append(best)
+        cuts.append(n)
+        return cuts
+    raise ValueError(f"unknown segment method {method!r}")
 
 
 class PipelineStack(Layer):
@@ -82,7 +130,7 @@ class PipelineStack(Layer):
 
     def __init__(self, blocks, mesh, pp_axis: str = "pp", num_microbatches=None,
                  use_recompute: bool = False, schedule: str = "1F1B",
-                 num_virtual_stages: int = 1):
+                 num_virtual_stages: int = 1, first_stage=None, last_stage=None):
         super().__init__()
         from paddle_tpu.distributed.auto_parallel import ProcessMesh
         from paddle_tpu.distributed.auto_parallel.api import placements_to_spec
@@ -113,6 +161,15 @@ class PipelineStack(Layer):
         self._num_microbatches = num_microbatches
         self._use_recompute = use_recompute
         self._schedule = schedule
+
+        # first/last stage extras (embedding / head): NOT registered as
+        # sublayers — their params stay registered wherever the caller keeps
+        # them (so optimizers see each exactly once); forward() threads the
+        # same Tensor objects through the tape, which routes their grads.
+        object.__setattr__(self, "_first", first_stage)
+        object.__setattr__(self, "_last", last_stage)
+        self._first_tensors = list(first_stage.state_dict().values()) if first_stage else []
+        self._last_tensors = list(last_stage.state_dict().values()) if last_stage else []
 
         # Template block: bypass Layer registration so its params stay out of
         # this layer's state_dict (they become dead storage bound over by the
@@ -165,6 +222,22 @@ class PipelineStack(Layer):
         m = num_microbatches or self._num_microbatches or self._n_stages
         return (self._n_stages - 1) / (m * self._n_virtual + self._n_stages - 1)
 
+    def _edge_call(self, layer, tensors):
+        """Traced call of a first/last stage layer: bind the incoming traced
+        param values over the layer's tensors, run it, restore."""
+        def call(h_val, vals):
+            originals = [t._value for t in tensors]
+            try:
+                for t, v in zip(tensors, vals):
+                    t._bind(v)
+                with no_grad():
+                    out = layer(Tensor(h_val))
+                return out._value if isinstance(out, Tensor) else out
+            finally:
+                for t, v in zip(tensors, originals):
+                    t._bind(v)
+        return call
+
     # ------------------------------------------------------------------ fwd
     def forward(self, h, *bcast):
         S = self._n_stages
@@ -175,15 +248,34 @@ class PipelineStack(Layer):
         bcast_t = [b for b in bcast if isinstance(b, Tensor)]
         self._bcast_template = [b if isinstance(b, Tensor) else None for b in bcast]
 
+        # trunk-activation and output shapes per microbatch: the first/last
+        # stage layers may change both (ids -> hidden, hidden -> logits)
+        mb_struct = jax.ShapeDtypeStruct((B // M,) + tuple(int(s) for s in h.shape[1:]), h._value.dtype)
+        if self._first is not None:
+            call = self._edge_call(self._first, self._first_tensors)
+            vals = [t._value for t in self._first_tensors]
+            h_struct = jax.eval_shape(lambda hv: call(hv, vals), mb_struct)
+        else:
+            h_struct = mb_struct
+        if self._last is not None:
+            call = self._edge_call(self._last, self._last_tensors)
+            vals = [t._value for t in self._last_tensors]
+            out_struct = jax.eval_shape(lambda hv: call(hv, vals), h_struct)
+        else:
+            out_struct = h_struct
+        self._h_struct, self._out_struct = h_struct, out_struct
+
         x = h.reshape([M, B // M] + list(h.shape[1:]))
         out = apply(
             "pipeline_stack",
             self._make_fn(M),
             *self.stacked_parameters(),
+            *self._first_tensors,
+            *self._last_tensors,
             x,
             *bcast_t,
         )
-        return out.reshape([B] + list(h.shape[1:]))
+        return out.reshape([B] + list(out_struct.shape[1:]))
 
     def _make_fn(self, M):
         S = self._n_stages
@@ -198,8 +290,19 @@ class PipelineStack(Layer):
         per_tick_remat = self._schedule in ("1F1B", "VPP")
         n_virtual = self._n_virtual
         lpc = Lps // n_virtual
+        nf, nl = len(self._first_tensors), len(self._last_tensors)
+        # set by forward(); None when _make_fn is driven directly (tests,
+        # structure inspection) — then trunk-in == trunk-out == x's shape
+        h_struct = getattr(self, "_h_struct", None)
+        out_struct = getattr(self, "_out_struct", None)
+        first_call = (
+            self._edge_call(self._first, self._first_tensors) if self._first else None
+        )
+        last_call = (
+            self._edge_call(self._last, self._last_tensors) if self._last else None
+        )
 
-        def pipe_vpp(stacked, x, bcast_vals, stage):
+        def pipe_vpp(stacked, x, bcast_vals, stage, first_vals=(), last_vals=()):
             """Circular token ring (see class docstring): each device carries
             one (microbatch m, chunk c) token; device 0 injects when a
             completed (c == V) token returns.  T = M*v + S - 1 ticks."""
@@ -232,11 +335,18 @@ class PipelineStack(Layer):
                 inject = jnp.logical_and(jnp.logical_and(stage == 0, dead), next_m < M)
                 m_new = jnp.where(inject, next_m, m_idx)
                 c_new = jnp.where(inject, 0, c_idx)
-                h_in = jnp.where(
-                    inject,
-                    lax.dynamic_index_in_dim(x, jnp.clip(next_m, 0, M - 1), 0, keepdims=False),
-                    h,
-                )
+                raw = lax.dynamic_index_in_dim(x, jnp.clip(next_m, 0, M - 1), 0, keepdims=False)
+                if first_call is not None:
+                    # pre-cast cond inputs to pp-varying (see non-VPP note)
+                    fed = lax.cond(
+                        inject,
+                        lambda r: first_call(r, first_vals),
+                        lambda r: _pvary(jnp.zeros(h_struct.shape, h_struct.dtype), (pp,)),
+                        _pvary(raw, (pp,)),
+                    )
+                else:
+                    fed = raw
+                h_in = jnp.where(inject, fed, h)
                 next_m2 = jnp.where(inject, next_m + 1, next_m)
                 active = c_new < V
                 chunk_local = jnp.clip(c_new // S, 0, n_virtual - 1)
@@ -246,20 +356,33 @@ class PipelineStack(Layer):
                 done_now = jnp.logical_and(active, c_after == V)
                 m_out = jnp.clip(m_new, 0, M - 1)
                 cur = lax.dynamic_index_in_dim(out, m_out, 0, keepdims=False)
+                if last_call is not None:
+                    val = lax.cond(
+                        done_now,
+                        lambda yy: last_call(yy, last_vals),
+                        lambda yy: _pvary(jnp.zeros(out_struct.shape, out_struct.dtype), (pp,)),
+                        y,
+                    )
+                else:
+                    val = y
                 out = lax.dynamic_update_index_in_dim(
-                    out, jnp.where(done_now, y, cur), m_out, 0
+                    out, jnp.where(done_now, val, cur), m_out, 0
                 )
                 h_next = lax.ppermute(y, pp, ring)
                 m_next = lax.ppermute(m_new, pp, ring)
                 c_next = lax.ppermute(c_after, pp, ring)
                 return (h_next, m_next, c_next, next_m2, out), None
 
+            zeros_h = (jnp.zeros(h_struct.shape, h_struct.dtype)
+                       if h_struct is not None else jnp.zeros_like(x[0]))
+            zeros_out = (jnp.zeros((M,) + tuple(out_struct.shape), out_struct.dtype)
+                         if out_struct is not None else jnp.zeros_like(x))
             carry0 = (
-                _pvary(jnp.zeros_like(x[0]), (pp,)),
+                _pvary(zeros_h, (pp,)),
                 _pvary(jnp.asarray(-1, jnp.int32), (pp,)),
                 _pvary(jnp.asarray(V, jnp.int32), (pp,)),  # dead: inject
                 _pvary(jnp.asarray(0, jnp.int32), (pp,)),
-                _pvary(jnp.zeros_like(x), (pp,)),
+                _pvary(zeros_out, (pp,)),
             )
             (_, _, _, _, out), _ = lax.scan(tick, carry0, jnp.arange(T, dtype=jnp.int32))
             return lax.psum(out, pp)
@@ -280,8 +403,12 @@ class PipelineStack(Layer):
 
         def pipe(*vals):
             stacked = vals[:n_keys]           # each [1, Lps, ...] local
-            x = vals[n_keys]                  # [M, mb, ...] (replicated over pp)
-            bcast_vals = vals[n_keys + 1:]
+            # pp-varying casts up front: their transpose-psums then run
+            # uniformly on every device, outside any stage-predicated cond
+            first_vals = [_pvary(v, (pp,)) for v in vals[n_keys:n_keys + nf]]
+            last_vals = [_pvary(v, (pp,)) for v in vals[n_keys + nf:n_keys + nf + nl]]
+            x = vals[n_keys + nf + nl]        # [M, mb, ...] (replicated over pp)
+            bcast_vals = vals[n_keys + nf + nl + 1:]
             stage = lax.axis_index(pp)
             wlocal = [w[0] for w in stacked]  # [Lps, ...]
 
@@ -298,7 +425,7 @@ class PipelineStack(Layer):
                 stage_fn = jax.checkpoint(stage_fn)
 
             if n_virtual > 1:
-                return pipe_vpp(stacked, x, bcast_vals, stage)
+                return pipe_vpp(stacked, x, bcast_vals, stage, first_vals, last_vals)
 
             T = M + S - 1
             ring = [(i, (i + 1) % S) for i in range(S)]
@@ -309,22 +436,53 @@ class PipelineStack(Layer):
                 # drain ticks — the classic warmup/drain bubble); others eat
                 # the boundary activation that just hopped in on the ring.
                 m_in = jnp.clip(t, 0, M - 1)
-                inp = jnp.where(stage == 0, lax.dynamic_index_in_dim(x, m_in, 0, keepdims=False), buf)
+                raw = lax.dynamic_index_in_dim(x, m_in, 0, keepdims=False)
+                if first_call is not None:
+                    # cond keeps the embedding off stages != 0 at runtime.
+                    # EVERYTHING entering the cond is pre-cast to pp-varying
+                    # (params at the top of pipe, raw here): an unvarying
+                    # value used inside a stage-predicated branch would get
+                    # its transpose-psum(pp) placed inside the branch, which
+                    # only one stage executes -> collective deadlock.
+                    fed = lax.cond(
+                        stage == 0,
+                        lambda r: first_call(r, first_vals),
+                        lambda r: _pvary(jnp.zeros(h_struct.shape, h_struct.dtype), (pp,)),
+                        _pvary(raw, (pp,)),
+                    )
+                else:
+                    fed = raw
+                inp = jnp.where(stage == 0, fed, buf)
                 y = stage_fn(inp)
                 # last stage owns microbatch t-(S-1)'s output
                 m_out = jnp.clip(t - (S - 1), 0, M - 1)
                 cur = lax.dynamic_index_in_dim(out, m_out, 0, keepdims=False)
                 write = jnp.logical_and(stage == S - 1, t >= S - 1)
+                if last_call is not None:
+                    # head (e.g. lm-head matmul) only runs on write ticks of
+                    # the last stage
+                    val = lax.cond(
+                        write,
+                        lambda yy: last_call(yy, last_vals),
+                        lambda yy: _pvary(jnp.zeros(out_struct.shape, out_struct.dtype), (pp,)),
+                        y,
+                    )
+                else:
+                    val = y
                 out = lax.dynamic_update_index_in_dim(
-                    out, jnp.where(write, y, cur), m_out, 0
+                    out, jnp.where(write, val, cur), m_out, 0
                 )
                 buf = lax.ppermute(y, pp, ring)
                 return (buf, out), None
 
             # carries become pp-varying inside the loop; type them so upfront
+            zeros_h = (jnp.zeros(h_struct.shape, h_struct.dtype)
+                       if h_struct is not None else jnp.zeros_like(x[0]))
+            zeros_out = (jnp.zeros((M,) + tuple(out_struct.shape), out_struct.dtype)
+                         if out_struct is not None else jnp.zeros_like(x))
             carry0 = (
-                _pvary(jnp.zeros_like(x[0]), (pp,)),
-                _pvary(jnp.zeros_like(x), (pp,)),
+                _pvary(zeros_h, (pp,)),
+                _pvary(zeros_out, (pp,)),
             )
             (_, out), _ = lax.scan(tick, carry0, jnp.arange(T, dtype=jnp.int32))
             # outputs live on the last stage; psum replicates them over pp

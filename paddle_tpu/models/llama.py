@@ -201,9 +201,14 @@ class LlamaModel(nn.Layer):
             self.rope_sin._bind(sin)
 
     def forward(self, input_ids, attn_mask=None):
-        h = self.embed_tokens(input_ids)
         from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
 
+        if getattr(self, "_pp_full", False):
+            # full-model pipeline: embedding rides the first stage and
+            # norm+head the last (reference SegmentLayers pp_layers.py:92);
+            # the stack consumes token ids and emits logits
+            return self.layers(input_ids, self.rope_cos, self.rope_sin, attn_mask)
+        h = self.embed_tokens(input_ids)
         if isinstance(self.layers, PipelineStack):
             h = self.layers(h, self.rope_cos, self.rope_sin, attn_mask)
         else:
@@ -271,11 +276,11 @@ class LlamaForCausalLM(nn.Layer):
                 self.lm_head.to(dtype="bfloat16")
 
     def forward(self, input_ids, labels=None, attn_mask=None):
-        h = self.model(input_ids, attn_mask)
-        if self.lm_head is not None:
-            logits = self.lm_head(h)
+        if getattr(self.model, "_pp_full", False):
+            logits = self.model(input_ids, attn_mask)  # stack already applied norm+head
         else:
-            logits = paddle.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+            h = self.model(input_ids, attn_mask)
+            logits = self._logits(h)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.astype("float32").reshape([-1, self.config.vocab_size]),
@@ -439,23 +444,67 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):
     return model
 
 
+class _LlamaHead(nn.Layer):
+    """Last pipeline stage: final RMSNorm + lm head — the layers the
+    reference's SegmentLayers places on the last stage (fleet
+    pp_layers.py:92)."""
+
+    def __init__(self, norm, lm_head):
+        super().__init__()
+        self.norm = norm
+        self.lm_head = lm_head
+
+    def forward(self, h):
+        return self.lm_head(self.norm(h))
+
+
 def pipeline_llama(model: "LlamaForCausalLM", mesh, pp_axis: str = "pp",
-                   num_microbatches=None, use_recompute: bool = False):
+                   num_microbatches=None, use_recompute: bool = False,
+                   include_edges: bool = True, schedule: str = "1F1B",
+                   num_virtual_stages: int = 1):
     """Convert the decoder stack to a pipelined stack over the 'pp' mesh axis
     (reference: PipelineLayer partition, fleet pp_layers.py:237).  Apply AFTER
     shard_llama (TP placements transfer to the stacked weights) and BEFORE
-    creating the optimizer (parameters are replaced by stacked ones)."""
+    creating the optimizer (parameters are replaced by stacked ones).
+
+    include_edges=True pipelines the FULL model: the embedding becomes the
+    first stage's extra layer and norm+lm_head the last stage's (reference
+    SegmentLayers non-uniform cut, pp_layers.py:92), so token ids enter the
+    pipeline and logits leave it."""
     from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
 
     if pp_axis not in mesh.dim_names:
         return model
+    first = last = None
+    if include_edges and model.lm_head is None:
+        # tied embeddings would need the embedding weight on both edge
+        # stages; keep the (previous, still-correct) trunk-only pipeline
+        import warnings
+
+        warnings.warn(
+            "pipeline_llama: tie_word_embeddings=True cannot place the "
+            "embedding on both edge stages; falling back to the trunk-only "
+            "pipeline (embedding/head replicated outside the pp region)",
+            stacklevel=2,
+        )
+        include_edges = False
+    if include_edges:
+        first = model.model.embed_tokens
+        last = _LlamaHead(model.model.norm, model.lm_head)
     model.model.layers = PipelineStack(
         list(model.model.layers),
         mesh,
         pp_axis=pp_axis,
         num_microbatches=num_microbatches,
         use_recompute=use_recompute,
+        schedule=schedule,
+        num_virtual_stages=num_virtual_stages,
+        first_stage=first,
+        last_stage=last,
     )
+    if include_edges:
+        self_model = model.model
+        self_model._pp_full = True
     return model
 
 

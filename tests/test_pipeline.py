@@ -279,3 +279,64 @@ def test_1f1b_memory_profile_below_fthenb():
         g = jax.jit(jax.grad(loss))
         temps[sched] = g.lower(params, x).compile().memory_analysis().temp_size_in_bytes
     assert temps["1F1B"] < 0.75 * temps["FThenB"], temps
+
+
+def test_full_model_pipeline_matches_single_device():
+    """Embedding + trunk + norm/head all inside the pipelined region
+    (reference SegmentLayers non-uniform cut, pp_layers.py:92): forward
+    logits, loss, and the edge-layer gradients match single-device."""
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny, pipeline_llama
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 96, size=(4, 12)).astype(np.int32)
+    labels = rng.integers(0, 96, size=(4, 12)).astype(np.int64)
+
+    def make_model():
+        paddle.seed(11)
+        cfg = llama_tiny(vocab_size=96, hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=4, num_attention_heads=4,
+                         num_key_value_heads=4, max_position_embeddings=32,
+                         dtype="float32")
+        return LlamaForCausalLM(cfg)
+
+    ref = make_model()
+    ref_logits = ref(paddle.to_tensor(ids))
+    ref_loss, _ = ref(paddle.to_tensor(ids), labels=paddle.to_tensor(labels))
+    ref_loss.backward()
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    pm = make_model()
+    pipeline_llama(pm, mesh, pp_axis="pp", num_microbatches=2)
+    assert getattr(pm.model, "_pp_full", False)
+    got_logits = pm(paddle.to_tensor(ids))
+    np.testing.assert_allclose(
+        np.asarray(got_logits._value), np.asarray(ref_logits._value), rtol=2e-4, atol=2e-4
+    )
+    loss, _ = pm(paddle.to_tensor(ids), labels=paddle.to_tensor(labels))
+    np.testing.assert_allclose(float(loss._value), float(ref_loss._value), rtol=1e-4)
+    loss.backward()
+    np.testing.assert_allclose(
+        np.asarray(pm.model.embed_tokens.weight.grad._value),
+        np.asarray(ref.model.embed_tokens.weight.grad._value), rtol=2e-3, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(pm.lm_head.weight.grad._value),
+        np.asarray(ref.lm_head.weight.grad._value), rtol=2e-3, atol=1e-5
+    )
+
+
+def test_segment_layers_cuts():
+    """Reference SegmentLayers (pp_layers.py:92): uniform and
+    parameter-weighted cut points."""
+    from paddle_tpu.distributed.fleet.meta_parallel import segment_layers
+
+    assert segment_layers([1] * 8, 4) == [0, 2, 4, 6, 8]
+    assert segment_layers([1] * 7, 3) == [0, 3, 5, 7]  # remainder to the front
+    # heavy tail: param-weighted shifts cuts right
+    w = [1, 1, 1, 1, 10, 10]
+    cuts = segment_layers(w, 2, method="param")
+    assert cuts[0] == 0 and cuts[-1] == 6
+    sums = [sum(w[cuts[i]:cuts[i + 1]]) for i in range(2)]
+    assert abs(sums[0] - sums[1]) <= 10  # balanced within one heavy layer
+    with pytest.raises(ValueError):
+        segment_layers([1, 2], 3)
