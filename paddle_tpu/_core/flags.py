@@ -68,3 +68,9 @@ define_flag("FLAGS_check_nan_inf", False, "Scan op outputs for NaN/Inf in eager 
 define_flag("FLAGS_default_dtype", "float32", "Default floating dtype for creation ops")
 define_flag("FLAGS_tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
 define_flag("FLAGS_eager_op_jit", True, "Route eager composite ops through cached jax.jit")
+define_flag(
+    "FLAGS_use_pallas_fusion",
+    True,
+    "Substitute attention/rms-norm/swiglu subgraphs in captured Programs "
+    "with Pallas kernels before lowering (static.rewrite.PallasFusionPass)",
+)

@@ -101,6 +101,26 @@ class Executor:
                 raise KeyError(f"missing feed '{v.name}'")
             feed_vals.append(jax.numpy.asarray(feed[v.name], v._value.dtype))
 
+        from paddle_tpu._core import flags
+
+        if flags.flag("FLAGS_use_pallas_fusion"):
+            # default pass pipeline: substitute Pallas kernels for the
+            # attention/rms-norm/swiglu subgraphs XLA cannot re-derive
+            # (SURVEY §7's CINN role).  Idempotent — fused ops don't match
+            # again; a change bumps program.version → fresh cache entry.
+            # Memoized per (version, fetch set) — a SET, so alternating
+            # fetch lists don't ping-pong the stamp and re-pay the scan on
+            # the per-step hot path.
+            seen = getattr(program, "_pallas_fused_at", None)
+            if seen is None:
+                seen = program._pallas_fused_at = set()
+            stamp = (program.version, fetch_vids)
+            if stamp not in seen:
+                from .rewrite import PallasFusionPass
+
+                PallasFusionPass(fetch_vids).apply(program)
+                seen.add((program.version, fetch_vids))
+
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
         key = (id(program), program.version, sig, fetch_vids)
         if key not in self._cache:

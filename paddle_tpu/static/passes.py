@@ -78,7 +78,16 @@ class ProgramPassManager:
         return total
 
 
-_REGISTRY = {"dead_code_elimination": DeadCodeEliminationPass}
+def _pallas_fusion_factory(**kwargs):
+    from .rewrite import PallasFusionPass
+
+    return PallasFusionPass(**kwargs)
+
+
+_REGISTRY = {
+    "dead_code_elimination": DeadCodeEliminationPass,
+    "pallas_fusion": _pallas_fusion_factory,
+}
 
 
 def apply_pass(program, name, **kwargs):

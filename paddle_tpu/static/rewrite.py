@@ -1,0 +1,368 @@
+"""Pattern rewriting over captured Programs — the DRR/pattern_match role.
+
+Reference: paddle/pir/pattern_rewrite/pattern_match.h (RewritePattern /
+PatternRewriter / greedy driver) + paddle/fluid/pir/drr/ (declarative
+source->result patterns), and the fusion-extraction role of
+paddle/fluid/pir/transforms/build_cinn_pass.cc + sub_graph_detector.cc.
+
+TPU-native role: XLA already fuses elementwise chains, so the profitable
+Program-level rewrites are the ones XLA can NOT do — substituting an
+algebraic subgraph with a hand-written Pallas kernel that changes the
+algorithm (flash attention's online softmax, fused-norm's single pass).
+The pass family here (`PallasFusionPass`) is the SURVEY §7 "Pallas codegen
+pass for flagged subgraphs": a captured vanilla-jnp attention block gets
+flash-attention substituted before lowering; rms-norm and swiglu chains get
+their fused kernels.  Replaced final ops keep their output vids, so
+downstream consumers / fetches are untouched and orphaned intermediates die
+in the executor's dead-code-elimination pass.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+__all__ = [
+    "ProgramGraph",
+    "RewritePattern",
+    "PatternRewritePass",
+    "PallasFusionPass",
+    "FlashAttentionPattern",
+    "RMSNormPattern",
+    "SwiGLUPattern",
+]
+
+
+def _const_scalar(spec):
+    """('const', v) -> python float if v is a scalar, else None."""
+    if spec[0] != "const":
+        return None
+    v = spec[1]
+    try:
+        arr = np.asarray(v)
+    except Exception:
+        return None
+    if arr.ndim == 0 or arr.size == 1:
+        try:
+            return float(arr.reshape(()))
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+class ProgramGraph:
+    """Def-use view of a Program's global block (the pattern matcher's
+    working set; reference pattern_match.h works over Operation/Value
+    use-def chains the same way)."""
+
+    def __init__(self, program, fetch_vids=()):
+        self.program = program
+        self.block = program.global_block()
+        self.producer = {}
+        self.consumers = defaultdict(list)
+        for op in self.block.ops:
+            for vid in op.out_vids:
+                self.producer[vid] = op
+            for vid in op.input_vids():
+                self.consumers[vid].append(op)
+        # vids visible outside the op list: fetches and state writes
+        self.external = set(fetch_vids)
+        self.external.update(program.writes.keys())
+        self.external.update(program.writes.values())
+
+    def single_use(self, vid) -> bool:
+        return len(self.consumers[vid]) == 1 and vid not in self.external
+
+    def shape(self, vid):
+        var = self.program._var_by_vid.get(vid)
+        return tuple(var._value.shape) if var is not None else None
+
+    def def_op(self, vid, type_=None):
+        op = self.producer.get(vid)
+        if op is None:
+            return None
+        if type_ is not None and op.type != type_:
+            return None
+        return op
+
+    def replace_op(self, old_op, new_op):
+        """Swap old_op for new_op at the same position (same out vids →
+        consumers unchanged; orphaned producers go to DCE)."""
+        idx = self.block.ops.index(old_op)
+        self.block.ops[idx] = new_op
+        self.program.version += 1
+
+
+class RewritePattern:
+    """One source->result rule; anchored at a root op type (reference
+    RewritePattern::match_and_rewrite)."""
+
+    name = "base"
+    root_type = None  # op.type this pattern anchors at
+
+    def match_and_rewrite(self, op, graph: ProgramGraph) -> bool:
+        raise NotImplementedError
+
+
+class PatternRewritePass:
+    """Greedy driver: apply patterns to fixpoint (bounded), reference
+    ApplyPatternsGreedily."""
+
+    name = "pattern_rewrite"
+
+    def __init__(self, patterns, fetch_vids=(), max_iterations=8):
+        self._patterns = list(patterns)
+        self._fetch_vids = tuple(fetch_vids)
+        self._max_iterations = max_iterations
+
+    def apply(self, program) -> int:
+        total = 0
+        for _ in range(self._max_iterations):
+            graph = ProgramGraph(program, self._fetch_vids)
+            changed = 0
+            for op in list(graph.block.ops):
+                for pat in self._patterns:
+                    if pat.root_type is not None and op.type != pat.root_type:
+                        continue
+                    if op not in graph.block.ops:
+                        break  # already replaced this round
+                    if pat.match_and_rewrite(op, graph):
+                        changed += 1
+                        graph = ProgramGraph(program, self._fetch_vids)
+                        break
+            total += changed
+            if not changed:
+                break
+        return total
+
+
+def _make_op(type_, fn, var_vids, template_op):
+    """New Operator producing template_op's outputs from var inputs."""
+    from paddle_tpu.static.program import Operator
+
+    return Operator(
+        type=type_,
+        fn=fn,
+        arg_spec=[("var", vid) for vid in var_vids],
+        kwargs={},
+        out_vids=list(template_op.out_vids),
+        out_tree=template_op.out_tree,
+    )
+
+
+class FlashAttentionPattern(RewritePattern):
+    """matmul(q,kᵀ) [→ scale] → softmax → matmul(·,v)  ⇒  Pallas flash
+    attention (ops/flash_attention.py — online softmax, O(S) memory).
+
+    Anchored at the second matmul.  Conservative: 4-D [B, N, S, D] layouts
+    only, no additive mask (an arbitrary mask has no kernel parameter;
+    causal masks arrive via the kernel's own flag in model code), unique
+    consumers for every interior value, and S != D so the kᵀ layout is
+    unambiguous."""
+
+    name = "flash_attention_fuse"
+    root_type = "matmul"
+
+    def match_and_rewrite(self, op, graph):
+        import jax.numpy as jnp
+
+        # root: out = matmul(probs, v)
+        if len(op.arg_spec) != 2 or any(s[0] != "var" for s in op.arg_spec):
+            return False
+        probs_vid, v_vid = op.arg_spec[0][1], op.arg_spec[1][1]
+        out_shape = graph.shape(op.out_vids[0]) if op.out_vids else None
+        v_shape = graph.shape(v_vid)
+        p_shape = graph.shape(probs_vid)
+        if not (out_shape and v_shape and p_shape):
+            return False
+        if len(out_shape) != 4 or len(v_shape) != 4 or len(p_shape) != 4:
+            return False
+        B, N, S, D = out_shape
+        if p_shape != (B, N, S, S) or v_shape != (B, N, S, D) or S == D:
+            return False
+
+        sm = graph.def_op(probs_vid, "softmax")
+        if sm is None or not graph.single_use(probs_vid):
+            return False
+        if len(sm.arg_spec) != 1 or sm.arg_spec[0][0] != "var":
+            return False
+        # flash attention's online softmax is last-axis only
+        sm_axis = sm.kwargs.get("axis", -1)
+        if sm_axis not in (-1, 3):
+            return False
+
+        # optional scale chain between qk-matmul and softmax
+        scale = None
+        cur_vid = sm.arg_spec[0][1]
+        if not graph.single_use(cur_vid):
+            return False
+        cur = graph.def_op(cur_vid)
+        if cur is not None and cur.type in ("divide", "multiply", "scale"):
+            var_ins = [s for s in cur.arg_spec if s[0] == "var"]
+            consts = [s for s in cur.arg_spec if s[0] == "const"]
+            c = _const_scalar(consts[0]) if len(consts) == 1 else None
+            if len(var_ins) == 1 and c is not None:
+                scale = (1.0 / c) if cur.type == "divide" else c
+                cur_vid = var_ins[0][1]
+                if not graph.single_use(cur_vid):
+                    return False
+                cur = graph.def_op(cur_vid)
+            elif cur.type == "scale":
+                return False
+        qk = cur
+        if qk is None or qk.type != "matmul":
+            return False
+        if len(qk.arg_spec) != 2 or any(s[0] != "var" for s in qk.arg_spec):
+            return False
+        q_vid, k_vid = qk.arg_spec[0][1], qk.arg_spec[1][1]
+        q_shape, k_shape = graph.shape(q_vid), graph.shape(k_vid)
+        if q_shape != (B, N, S, D):
+            return False
+        if k_shape == (B, N, S, D):
+            k_transposed = True  # user wrote matmul(q, k, transpose_y=True)
+        elif k_shape == (B, N, D, S):
+            k_transposed = False
+        else:
+            return False
+
+        if scale is None:
+            scale = 1.0  # plain matmul softmax: no 1/sqrt(d) in source
+
+        def fused(q, k, v):
+            from paddle_tpu.ops import flash_attention
+
+            if not k_transposed:
+                k = jnp.swapaxes(k, -1, -2)
+            qt = jnp.swapaxes(q, 1, 2)  # [B,N,S,D] -> kernel's [B,S,N,D]
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            o = flash_attention(qt, kt, vt, scale=scale)
+            return jnp.swapaxes(o, 1, 2)
+
+        graph.replace_op(op, _make_op("flash_attention", fused, [q_vid, k_vid, v_vid], op))
+        return True
+
+
+class RMSNormPattern(RewritePattern):
+    """x·rsqrt(mean(x²)+ε)·w  ⇒  Pallas fused_rms_norm (ops/fused_norm.py).
+
+    Anchored at the final weight multiply; accepts square(x) or
+    multiply(x, x) for the square."""
+
+    name = "rms_norm_fuse"
+    root_type = "multiply"
+
+    def _match_square_mean(self, vid, graph, x_vid):
+        mean = graph.def_op(vid, "mean")
+        if mean is None or not graph.single_use(vid):
+            return False
+        if len(mean.arg_spec) != 1 or mean.arg_spec[0][0] != "var":
+            return False
+        sq_vid = mean.arg_spec[0][1]
+        if not graph.single_use(sq_vid):
+            return False
+        sq = graph.def_op(sq_vid)
+        if sq is None:
+            return False
+        if sq.type == "square":
+            return sq.arg_spec[0] == ("var", x_vid)
+        if sq.type in ("multiply", "pow"):
+            vids = [s[1] for s in sq.arg_spec if s[0] == "var"]
+            if sq.type == "multiply":
+                return vids == [x_vid, x_vid]
+            c = next((_const_scalar(s) for s in sq.arg_spec if s[0] == "const"), None)
+            return vids == [x_vid] and c == 2.0
+        return False
+
+    def match_and_rewrite(self, op, graph):
+        # root: out = multiply(normed, w)   (w: 1-D over last axis)
+        if len(op.arg_spec) != 2 or any(s[0] != "var" for s in op.arg_spec):
+            return False
+        normed_vid, w_vid = op.arg_spec[0][1], op.arg_spec[1][1]
+        w_shape = graph.shape(w_vid)
+        out_shape = graph.shape(op.out_vids[0]) if op.out_vids else None
+        if not w_shape or not out_shape or len(w_shape) != 1 or w_shape[0] != out_shape[-1]:
+            return False
+        if not graph.single_use(normed_vid):
+            return False
+        # normed = multiply(x, rsqrt(mean(x*x) + eps))
+        mul = graph.def_op(normed_vid, "multiply")
+        if mul is None or len(mul.arg_spec) != 2 or any(s[0] != "var" for s in mul.arg_spec):
+            return False
+        x_vid, r_vid = mul.arg_spec[0][1], mul.arg_spec[1][1]
+        if graph.shape(x_vid) != out_shape:
+            x_vid, r_vid = r_vid, x_vid
+        if graph.shape(x_vid) != out_shape:
+            return False
+        if not graph.single_use(r_vid):
+            return False
+        rs = graph.def_op(r_vid, "rsqrt")
+        if rs is None or len(rs.arg_spec) != 1 or rs.arg_spec[0][0] != "var":
+            return False
+        add_vid = rs.arg_spec[0][1]
+        if not graph.single_use(add_vid):
+            return False
+        add = graph.def_op(add_vid, "add")
+        if add is None:
+            return False
+        eps = next((_const_scalar(s) for s in add.arg_spec if s[0] == "const"), None)
+        var_ins = [s[1] for s in add.arg_spec if s[0] == "var"]
+        if eps is None or len(var_ins) != 1:
+            return False
+        if not self._match_square_mean(var_ins[0], graph, x_vid):
+            return False
+        # mean must reduce the last axis with keepdim
+        mean_shape = graph.shape(var_ins[0])
+        if mean_shape is None or mean_shape != out_shape[:-1] + (1,):
+            return False
+
+        def fused(x, w):
+            from paddle_tpu.ops import fused_rms_norm
+
+            return fused_rms_norm(x, w, epsilon=eps)
+
+        graph.replace_op(op, _make_op("fused_rms_norm", fused, [x_vid, w_vid], op))
+        return True
+
+
+class SwiGLUPattern(RewritePattern):
+    """silu(g)·u  ⇒  Pallas swiglu (ops/swiglu.py)."""
+
+    name = "swiglu_fuse"
+    root_type = "multiply"
+
+    def match_and_rewrite(self, op, graph):
+        if len(op.arg_spec) != 2 or any(s[0] != "var" for s in op.arg_spec):
+            return False
+        a_vid, b_vid = op.arg_spec[0][1], op.arg_spec[1][1]
+        for gate_vid, up_vid in ((a_vid, b_vid), (b_vid, a_vid)):
+            silu = graph.def_op(gate_vid, "silu")
+            if silu is None or not graph.single_use(gate_vid):
+                continue
+            if len(silu.arg_spec) != 1 or silu.arg_spec[0][0] != "var":
+                continue
+            g_vid = silu.arg_spec[0][1]
+            if graph.shape(g_vid) != graph.shape(up_vid):
+                continue
+
+            def fused(g, u):
+                from paddle_tpu.ops import swiglu
+
+                return swiglu(g, u)
+
+            graph.replace_op(op, _make_op("swiglu", fused, [g_vid, up_vid], op))
+            return True
+        return False
+
+
+class PallasFusionPass(PatternRewritePass):
+    """The default Pallas-substitution pipeline (SURVEY §7's CINN analog)."""
+
+    name = "pallas_fusion"
+
+    def __init__(self, fetch_vids=()):
+        super().__init__(
+            [FlashAttentionPattern(), RMSNormPattern(), SwiGLUPattern()],
+            fetch_vids=fetch_vids,
+        )

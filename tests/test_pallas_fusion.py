@@ -1,0 +1,193 @@
+"""Pattern-rewrite infra + Pallas fusion pass (VERDICT r2 items 4+5).
+
+Reference: paddle/pir/pattern_rewrite/pattern_match.h (greedy rewrite
+driver) + paddle/fluid/pir/transforms/build_cinn_pass.cc (fusible-subgraph
+substitution).  Here: a captured vanilla-jnp attention / rms-norm / swiglu
+subgraph gets the Pallas kernel substituted, numerics preserved, via the
+Executor's default pipeline.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+from paddle_tpu.static.program import Program, program_guard
+from paddle_tpu.static.rewrite import PallasFusionPass
+
+
+def _feed(prog, name, shape, dtype=np.float32):
+    return prog.add_feed(prog.new_var(jax.ShapeDtypeStruct(shape, dtype), name))
+
+
+def _capture_vanilla(B=2, N=4, S=128, D=16, H=32, F_=64):
+    """One program holding vanilla attention + rms-norm + swiglu."""
+    prog = Program()
+    with program_guard(prog):
+        q = _feed(prog, "q", (B, N, S, D))
+        k = _feed(prog, "k", (B, N, S, D))
+        v = _feed(prog, "v", (B, N, S, D))
+        x = _feed(prog, "x", (B, S, H))
+        w = _feed(prog, "w", (H,))
+        g = _feed(prog, "g", (B, S, F_))
+        u = _feed(prog, "u", (B, S, F_))
+        scores = paddle.matmul(q, k, transpose_y=True) / (D ** 0.5)
+        probs = F.softmax(scores, axis=-1)
+        attn = paddle.matmul(probs, v)
+        var = (x * x).mean(axis=-1, keepdim=True)
+        normed = x * paddle.rsqrt(var + 1e-6) * w
+        sw = F.silu(g) * u
+    return prog, (attn, normed, sw)
+
+
+def _optypes(prog):
+    return [op.type for op in prog.global_block().ops]
+
+
+def test_fusion_pass_substitutes_all_three_patterns():
+    prog, (attn, normed, sw) = _capture_vanilla()
+    n = PallasFusionPass([attn._vid, normed._vid, sw._vid]).apply(prog)
+    assert n == 3
+    types = _optypes(prog)
+    assert "flash_attention" in types
+    assert "fused_rms_norm" in types
+    assert "swiglu" in types
+    assert "softmax" not in [
+        op.type
+        for op in prog.global_block().ops
+        if any(vid in (attn._vid,) for vid in op.out_vids)
+    ]
+
+
+def test_fusion_preserves_numerics_via_executor():
+    rng = np.random.default_rng(0)
+    B, N, S, D, H, F_ = 2, 4, 128, 16, 32, 64
+    feed = {
+        "q": rng.normal(size=(B, N, S, D)).astype(np.float32),
+        "k": rng.normal(size=(B, N, S, D)).astype(np.float32),
+        "v": rng.normal(size=(B, N, S, D)).astype(np.float32),
+        "x": rng.normal(size=(B, S, H)).astype(np.float32),
+        "w": rng.normal(size=(H,)).astype(np.float32),
+        "g": rng.normal(size=(B, S, F_)).astype(np.float32),
+        "u": rng.normal(size=(B, S, F_)).astype(np.float32),
+    }
+
+    paddle.set_flags({"FLAGS_use_pallas_fusion": False})
+    try:
+        prog, fetches = _capture_vanilla()
+        exe = static.Executor()
+        ref = exe.run(prog, feed=feed, fetch_list=list(fetches))
+        assert "flash_attention" not in _optypes(prog)
+
+        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
+        prog2, fetches2 = _capture_vanilla()
+        exe2 = static.Executor()
+        got = exe2.run(prog2, feed=feed, fetch_list=list(fetches2))
+        assert "flash_attention" in _optypes(prog2)  # pass ran inside run()
+        assert "fused_rms_norm" in _optypes(prog2)
+        assert "swiglu" in _optypes(prog2)
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
+
+    for r, g_ in zip(ref, got):
+        np.testing.assert_allclose(r, g_, rtol=2e-3, atol=2e-3)
+
+
+def test_fusion_bails_when_intermediate_is_fetched():
+    """Fetching attention probs keeps the pattern unfused (externally
+    visible intermediates make substitution unsound)."""
+    prog = Program()
+    with program_guard(prog):
+        q = _feed(prog, "q", (2, 4, 128, 16))
+        k = _feed(prog, "k", (2, 4, 128, 16))
+        v = _feed(prog, "v", (2, 4, 128, 16))
+        scores = paddle.matmul(q, k, transpose_y=True) / 4.0
+        probs = F.softmax(scores, axis=-1)
+        out = paddle.matmul(probs, v)
+    n = PallasFusionPass([out._vid, probs._vid]).apply(prog)
+    assert n == 0
+    assert "flash_attention" not in _optypes(prog)
+
+
+def test_fusion_handles_untransposed_k_layout():
+    prog = Program()
+    with program_guard(prog):
+        q = _feed(prog, "q", (2, 2, 128, 16))
+        kT = _feed(prog, "kT", (2, 2, 16, 128))  # [B,N,D,S]: plain matmul
+        v = _feed(prog, "v", (2, 2, 128, 16))
+        probs = F.softmax(paddle.matmul(q, kT) * (1 / 4.0), axis=-1)
+        out = paddle.matmul(probs, v)
+    n = PallasFusionPass([out._vid]).apply(prog)
+    assert n == 1
+
+    rng = np.random.default_rng(1)
+    qv = rng.normal(size=(2, 2, 128, 16)).astype(np.float32)
+    kv = rng.normal(size=(2, 2, 16, 128)).astype(np.float32)
+    vv = rng.normal(size=(2, 2, 128, 16)).astype(np.float32)
+    exe = static.Executor()
+    got = exe.run(prog, feed={"q": qv, "kT": kv, "v": vv}, fetch_list=[out])[0]
+    s = qv @ kv / 4.0
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, p @ vv, rtol=2e-3, atol=2e-3)
+
+
+class VanillaLlamaBlock(paddle.nn.Layer):
+    """A LLaMA decoder block written in VANILLA paddle ops only — no calls
+    into paddle_tpu.ops — so fusion must come from the rewrite pass."""
+
+    def __init__(self, hidden, heads, inter):
+        super().__init__()
+        self.h, self.n = hidden, heads
+        self.d = hidden // heads
+        self.wq = paddle.nn.Linear(hidden, hidden, bias_attr=False)
+        self.wk = paddle.nn.Linear(hidden, hidden, bias_attr=False)
+        self.wv = paddle.nn.Linear(hidden, hidden, bias_attr=False)
+        self.wo = paddle.nn.Linear(hidden, hidden, bias_attr=False)
+        self.gate = paddle.nn.Linear(hidden, inter, bias_attr=False)
+        self.up = paddle.nn.Linear(hidden, inter, bias_attr=False)
+        self.down = paddle.nn.Linear(inter, hidden, bias_attr=False)
+        self.norm_w1 = paddle.create_parameter([hidden], "float32")
+        self.norm_w2 = paddle.create_parameter([hidden], "float32")
+
+    def _rms(self, x, w):
+        var = (x * x).mean(axis=-1, keepdim=True)
+        return x * paddle.rsqrt(var + 1e-6) * w
+
+    def forward(self, x):
+        B, S, _ = x.shape
+        h = self._rms(x, self.norm_w1)
+        q = self.wq(h).reshape([B, S, self.n, self.d]).transpose([0, 2, 1, 3])
+        k = self.wk(h).reshape([B, S, self.n, self.d]).transpose([0, 2, 1, 3])
+        v = self.wv(h).reshape([B, S, self.n, self.d]).transpose([0, 2, 1, 3])
+        scores = paddle.matmul(q, k, transpose_y=True) / (self.d ** 0.5)
+        probs = F.softmax(scores, axis=-1)
+        o = paddle.matmul(probs, v).transpose([0, 2, 1, 3]).reshape([B, S, self.h])
+        x = x + self.wo(o)
+        h2 = self._rms(x, self.norm_w2)
+        return x + self.down(F.silu(self.gate(h2)) * self.up(h2))
+
+
+def test_vanilla_llama_block_gets_flash_substituted():
+    """The VERDICT's done-criterion: a vanilla-jnp LLaMA block captured as
+    a Program shows flash-attention substitution and matches numerics."""
+    paddle.seed(5)
+    blk = VanillaLlamaBlock(hidden=64, heads=4, inter=128)
+    x_np = np.random.default_rng(2).normal(size=(2, 128, 64)).astype(np.float32)
+
+    with paddle.no_grad():
+        ref = np.asarray(blk(paddle.to_tensor(x_np))._value)
+
+    prog = Program()
+    with program_guard(prog):
+        xv = _feed(prog, "x", (2, 128, 64))
+        out = blk(xv)
+    exe = static.Executor()
+    got = exe.run(prog, feed={"x": x_np}, fetch_list=[out])[0]
+    types = _optypes(prog)
+    assert "flash_attention" in types
+    assert types.count("fused_rms_norm") == 2
+    assert "swiglu" in types
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
