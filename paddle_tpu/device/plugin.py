@@ -18,7 +18,12 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["load_custom_device_plugin", "scan_custom_device_plugins", "registered_custom_devices"]
+__all__ = [
+    "load_custom_device_plugin",
+    "scan_custom_device_plugins",
+    "register_custom_backend",
+    "registered_custom_devices",
+]
 
 _registered: dict[str, str] = {}
 
@@ -52,6 +57,20 @@ def scan_custom_device_plugins(root=None):
 
                 warnings.warn(f"custom device plugin {fn}: registration failed: {e}")
     return found
+
+
+def register_custom_backend(name: str, factory, priority: int = 0):
+    """In-process custom backend: register a client factory under `name`
+    (the PJRT-plugin flow without a .so — the analog of the reference's
+    fake_cpu_device.h test device, test/custom_runtime/
+    test_custom_cpu_plugin.py:24).  The backend must also appear in
+    jax_platforms (e.g. "cpu,<name>") BEFORE first backend init; then
+    `jax.devices(name)` / paddle.set_device(name) target it."""
+    from jax._src import xla_bridge
+
+    xla_bridge.register_backend_factory(name, factory, priority=priority, fail_quietly=False)
+    _registered[name] = "<in-process factory>"
+    return name
 
 
 def registered_custom_devices():

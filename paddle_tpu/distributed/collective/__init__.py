@@ -130,7 +130,14 @@ class ProcessGroup:
 
         fn = self._compiled(op_name, builder, value)
         garr = self._global(value)
-        out = fn(garr)
+        # the execute blocks on peers joining: watchdog-guard it so a dead
+        # rank produces a loud timeout (+ creation stack) instead of a
+        # silent hang (reference CommTask / comm_task_manager.h:37)
+        from paddle_tpu.distributed.communication.watchdog import comm_watch
+
+        with comm_watch(op_name, group=self):
+            out = fn(garr)
+            jax.block_until_ready(out)
         return out, out
 
     # ----------------------------------------------------------- collectives
@@ -209,6 +216,119 @@ class ProcessGroup:
         t.wait()
         return t
 
+    # ------------------------------------------------------------------ p2p
+    def _pair_group(self, a, b):
+        """2-endpoint subgroup for pairwise transfers: ONLY the two endpoint
+        processes execute the pair's executable, so p2p in a world > 2 does
+        not require bystander ranks to join a whole-ring collective (which
+        would deadlock them)."""
+        if self.nranks == 2:
+            return self
+        key = tuple(sorted((a, b)))
+        cache = getattr(self, "_pair_groups", None)
+        if cache is None:
+            cache = self._pair_groups = {}
+        pg = cache.get(key)
+        if pg is None:
+            pg = ProcessGroup(ranks=list(key), ring_id=self.ring_id,
+                              name=f"{self._name}_pair_{key[0]}_{key[1]}")
+            cache[key] = pg
+        return pg
+
+    def _p2p(self, value, src, dst):
+        """One ppermute hop src->dst over the {src, dst} pair subgroup (the
+        NCCL send/recv pair of p2p_communication.py, compiled once per
+        (shape, dtype, src, dst))."""
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        pg = self._pair_group(src, dst)
+        si, di = pg.ranks.index(src), pg.ranks.index(dst)
+
+        def body(x):
+            return lax.ppermute(x, "ring", [(si, di)])
+
+        out, _ = pg._run(f"p2p_{si}_{di}", value, body, PartitionSpec("ring"))
+        return out.addressable_shards[0].data[0]
+
+    def send(self, tensor, dst):
+        from paddle_tpu._core.tensor import Tensor
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "send")
+        me = self.ranks[self.rank()]
+        self._p2p(v, src=me, dst=dst)
+        return Task(v, self, "send")
+
+    def recv(self, tensor, src):
+        """tensor supplies the receive buffer's shape/dtype; the received
+        payload is bound back into it (reference recv semantics)."""
+        from paddle_tpu._core.tensor import Tensor
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "recv")
+        me = self.ranks[self.rank()]
+        got = self._p2p(v, src=src, dst=me)
+        if isinstance(tensor, Tensor):
+            tensor._bind(got)
+        return Task(got, self, "recv")
+
+    # --------------------------------------------------- scatter / alltoall
+    def scatter(self, tensor, src=0):
+        """Input on every rank: [nranks*chunk, ...]; each rank keeps src's
+        chunk for its own index."""
+        from paddle_tpu._core.tensor import Tensor
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "scatter")
+        n = self.nranks
+        if v.shape[0] % n:
+            raise ValueError(
+                f"scatter: leading dim {v.shape[0]} not divisible by "
+                f"nranks {n}"
+            )
+        chunk = v.shape[0] // n
+        src_idx = self.ranks.index(src)
+
+        def body(x):
+            g = lax.all_gather(x[0], "ring")[src_idx]
+            me = lax.axis_index("ring")
+            return lax.dynamic_slice_in_dim(g, me * chunk, chunk, 0)[None]
+
+        out, _ = self._run(f"scatter_{src_idx}", v, body, PartitionSpec("ring"))
+        return Task(out.addressable_shards[0].data[0], self, "scatter")
+
+    def alltoall(self, tensor):
+        """[nranks*chunk, ...] per rank; chunk i goes to rank i."""
+        from paddle_tpu._core.tensor import Tensor
+        from jax import lax
+        from jax.sharding import PartitionSpec
+
+        v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if self.nranks == 1:
+            return Task(v, self, "alltoall")
+        if v.shape[0] % self.nranks:
+            raise ValueError(
+                f"alltoall: leading dim {v.shape[0]} not divisible by "
+                f"nranks {self.nranks}"
+            )
+
+        def body(x):
+            return lax.all_to_all(x, "ring", split_axis=1, concat_axis=1, tiled=True)
+
+        out, _ = self._run("alltoall", v, body, PartitionSpec("ring"))
+        return Task(out.addressable_shards[0].data[0], self, "alltoall")
+
+    def reduce(self, tensor, dst=0, op="sum"):
+        """Reference reduce: result is only meaningful on dst (here every
+        rank computes it — XLA collectives are rank-symmetric)."""
+        return self.allreduce(tensor, op=op)
+
 
 class P2POp:
     """Batched p2p descriptor (reference batch_isend_irecv)."""
@@ -221,17 +341,46 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    """Reference communication/batch_isend_irecv.py.  On the SPMD path p2p is
-    ppermute inside programs; eagerly, world-1 is a no-op and multi-host p2p
-    maps to a ring ppermute executable per batch (future work beyond the
-    single-host image).  Returns Tasks."""
-    tasks = []
+    """Reference communication/batch_isend_irecv.py.  On the SPMD path p2p
+    is ppermute inside programs; eagerly, multi-controller batches execute
+    as a sequence of pairwise ppermute executables in a canonical
+    (sorted-pair) order so both endpoints of each transfer issue them in
+    the same sequence — matched send/recv pairs are required, the same
+    contract the reference's NCCL group launch has.  Returns Tasks."""
+    me = jax.process_index()
+
+    def _is_send(op):
+        # accept the reference's callable form (P2POp(dist.isend, ...)) and
+        # the string form
+        name = op if isinstance(op, str) else getattr(op, "__name__", "")
+        if name not in ("isend", "irecv", "send", "recv"):
+            raise ValueError(f"P2POp.op must be isend/irecv, got {op!r}")
+        return name in ("isend", "send")
+
+    annotated = []
     for p in p2p_op_list:
         world = p.group.nranks if p.group is not None else jax.process_count()
-        if world != 1:
-            raise NotImplementedError(
-                "eager multi-host batch_isend_irecv: use the SPMD pipeline "
-                "engine (ppermute) or ProcessGroup collectives"
-            )
-        tasks.append(Task(p.tensor._value if hasattr(p.tensor, "_value") else p.tensor))
+        if world == 1:
+            annotated.append((None, False, p))
+            continue
+        is_send = _is_send(p.op)
+        pair = (me, p.peer) if is_send else (p.peer, me)
+        annotated.append((tuple(sorted(pair)) + (pair[0],), is_send, p))
+    tasks = []
+    for key, is_send, p in sorted(
+        annotated, key=lambda kp: (kp[0] is not None, kp[0] or ())
+    ):
+        if key is None:
+            tasks.append(Task(p.tensor._value if hasattr(p.tensor, "_value") else p.tensor))
+            continue
+        if p.group is not None:
+            pg = p.group
+        else:
+            from paddle_tpu.distributed.communication.ops import _process_group_for
+
+            pg = _process_group_for(None)  # cached world ring
+        if is_send:
+            tasks.append(pg.send(p.tensor, p.peer))
+        else:
+            tasks.append(pg.recv(p.tensor, p.peer))
     return tasks

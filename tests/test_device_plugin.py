@@ -1,0 +1,133 @@
+"""Pluggable-device proof (VERDICT r2 item 7).
+
+Reference: test/custom_runtime/test_custom_cpu_plugin.py:24-50 registers an
+out-of-tree fake CPU device (fake_cpu_device.h:225) and runs ops on it.
+Here the pluggable ABI is PJRT (device/plugin.py): the .so discovery path
+is exercised with a stub library (broken plugins must fail loudly, not
+crash startup), and a factory-registered custom backend runs a real op and
+a collective end-to-end.  Runs in a subprocess: registration must precede
+first backend init, which the test session has long passed.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, "__REPO__")
+    import jax
+    jax.config.update("jax_platforms", "cpu,fake_cpu")
+
+    # ---- in-process factory backend: op + collective on the custom device
+    from paddle_tpu.device.plugin import (
+        load_custom_device_plugin,
+        register_custom_backend,
+        registered_custom_devices,
+        scan_custom_device_plugins,
+    )
+
+    def fake_factory():
+        import jaxlib._jax as _x
+        return _x.get_tfrt_cpu_client(asynchronous=True)
+
+    register_custom_backend("fake_cpu", fake_factory)
+    assert "fake_cpu" in registered_custom_devices()
+
+    import jax.numpy as jnp
+    devs = jax.devices("fake_cpu")
+    assert devs, "no devices from the registered custom backend"
+    x = jax.device_put(jnp.ones((4, 4), jnp.float32), devs[0])
+    assert float((x @ x).sum()) == 64.0
+
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+    from jax import lax, shard_map
+    mesh = Mesh(np.array(devs[:1]), ("x",))
+    f = jax.jit(shard_map(lambda v: lax.psum(v, "x"), mesh=mesh,
+                          in_specs=PartitionSpec(), out_specs=PartitionSpec()))
+    assert np.allclose(np.asarray(f(jnp.ones(3))), 1.0)
+
+    # paddle surface: tensors created while the custom device is default
+    import paddle_tpu as paddle
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    assert float((t @ t).sum()._value) == 8.0
+
+    # ---- .so discovery path (reference CUSTOM_DEVICE_ROOT scan):
+    # (a) a corrupt plugin is skipped with a warning — startup survives
+    import tempfile, warnings
+    root = tempfile.mkdtemp()
+    with open(os.path.join(root, "libpjrt_corrupt.so"), "wb") as f:
+        f.write(b"not a real shared object")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        found = scan_custom_device_plugins(root)
+    assert found == [], found
+    assert any("corrupt" in str(x.message) for x in w), [str(x.message) for x in w]
+
+    # (b) a REAL (if useless) shared object registers through the scan; a
+    # stub GetPjrtApi means first USE fails cleanly, never a crash
+    import subprocess as sp
+    src = os.path.join(root, "stub.cc")
+    with open(src, "w") as f:
+        f.write(
+            "#include <cstddef>\\n"
+            "#include <cstring>\\n"
+            "// minimal PJRT_Api-shaped blob: struct_size + extension + version\\n"
+            "struct StubApi { size_t struct_size; void* ext;\\n"
+            "  struct { size_t struct_size; void* ext; int major_v; int minor_v; } v;\\n"
+            "  void* fns[256]; };\\n"
+            "static StubApi api;\\n"
+            "extern \\"C\\" const void* GetPjrtApi() {\\n"
+            "  std::memset(&api, 0, sizeof api);\\n"
+            "  api.struct_size = sizeof api;\\n"
+            "  api.v.struct_size = sizeof api.v;\\n"
+            "  api.v.major_v = 0; api.v.minor_v = 1;\\n"
+            "  return &api; }\\n"
+        )
+    sp.run(["g++", "-shared", "-fPIC", "-o",
+            os.path.join(root, "libpjrt_stubdev.so"), src], check=True)
+    try:
+        load_custom_device_plugin("stubdev", os.path.join(root, "libpjrt_stubdev.so"))
+        registered = True
+    except BaseException as e:  # clean python-level rejection is the point
+        registered = False
+        print("stub registration rejected:", type(e).__name__, str(e)[:120], flush=True)
+    if registered:
+        assert "stubdev" in registered_custom_devices()
+        try:
+            jax.config.update("jax_platforms", "cpu,fake_cpu,stubdev")
+            jax.extend.backend.get_backend("stubdev")
+            raise SystemExit("stub plugin unexpectedly initialized")
+        except RuntimeError:
+            pass
+
+    # missing path errors immediately
+    try:
+        load_custom_device_plugin("ghost", "/nonexistent/libpjrt_ghost.so")
+        raise SystemExit("missing plugin path did not raise")
+    except FileNotFoundError:
+        pass
+    print("PLUGIN OK", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_custom_device_plugin_end_to_end(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "plugin_worker.py"
+    script.write_text(_SCRIPT.replace("__REPO__", repo))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "PLUGIN OK" in out.stdout

@@ -72,6 +72,41 @@ _WORKER = textwrap.dedent(
     dist.all_reduce(t2, op=dist.ReduceOp.MAX)
     assert abs(float(t2._value[0]) - wsum) < 1e-6, "weights diverged across ranks"
     assert losses[-1] < losses[0]
+
+    # ---- point-to-point: ProcessGroup send/recv (ppermute pair) ----
+    if rank == 0:
+        pg.send(jnp.arange(4, dtype=jnp.float32), dst=1)
+    else:
+        got = pg.recv(jnp.zeros((4,), jnp.float32), src=0)
+        assert np.allclose(np.asarray(got.result()), np.arange(4.0)), np.asarray(got.result())
+
+    # public isend/irecv API
+    if rank == 0:
+        dist.isend(paddle.to_tensor(np.full(3, 7.0, np.float32)), dst=1).wait()
+    else:
+        t3 = paddle.to_tensor(np.zeros(3, np.float32))
+        dist.irecv(t3, src=0).wait()
+        assert np.allclose(np.asarray(t3._value), 7.0)
+
+    # batch_isend_irecv ring exchange (both ranks send AND receive)
+    from paddle_tpu.distributed.collective import P2POp, batch_isend_irecv
+
+    peer = 1 - rank
+    send_t = paddle.to_tensor(np.full(2, float(rank), np.float32))
+    recv_t = paddle.to_tensor(np.zeros(2, np.float32))
+    for task in batch_isend_irecv([
+        P2POp("isend", send_t, peer), P2POp("irecv", recv_t, peer)
+    ]):
+        task.wait()
+    assert np.allclose(np.asarray(recv_t._value), float(peer)), np.asarray(recv_t._value)
+
+    # scatter: each rank keeps src 0's chunk for its index
+    sc = pg.scatter(jnp.arange(4, dtype=jnp.float32), src=0)
+    assert np.allclose(np.asarray(sc.result()), [2.0 * rank, 2.0 * rank + 1])
+
+    # alltoall: chunk i of my input goes to rank i
+    at = pg.alltoall(jnp.asarray([rank * 10.0, rank * 10.0 + 1], jnp.float32))
+    assert np.allclose(np.asarray(at.result()), [float(rank), 10.0 + rank]), np.asarray(at.result())
     print("rank " + str(rank) + " OK", flush=True)
     """
 )
@@ -168,7 +203,7 @@ def test_two_process_global_mesh_spmd_training(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=400)
+            out, _ = p.communicate(timeout=700)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
@@ -179,3 +214,70 @@ def test_two_process_global_mesh_spmd_training(tmp_path):
     assert len(lines) == 2
     # identical loss trajectories on both ranks
     assert lines[0].split("SPMD")[1] == lines[1].split("SPMD")[1], lines
+
+
+_HANG_WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    rank, world, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port, num_processes=world, process_id=rank
+    )
+    sys.path.insert(0, "__REPO__")
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.collective import ProcessGroup
+
+    jax.devices()  # gloo client creation itself rendezvouses: init BOTH ranks
+    if rank == 1:
+        # backend up, but never joins the collective: a stuck/dead peer
+        time.sleep(30)
+        sys.exit(0)
+    pg = ProcessGroup()
+    pg.allreduce(jnp.ones((4,), jnp.float32)).wait()  # hangs forever
+    print("UNREACHABLE", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_watchdog_aborts_hung_collective(tmp_path):
+    """Reference comm_task_manager.h:37 + FLAGS_enable_async_trace: a rank
+    stuck in a collective whose peer never arrives gets a loud watchdog
+    report (op name, group, elapsed, creation stack) and an abort instead
+    of an indefinite hang."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "hang_worker.py"
+    script.write_text(_HANG_WORKER.replace("__REPO__", repo))
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["FLAGS_comm_timeout_s"] = "6"
+    env["FLAGS_comm_timeout_abort"] = "1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), "2", str(free_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append((p.returncode, out))
+    rc0, out0 = outs[0]
+    assert rc0 == 124, (rc0, out0[-2000:])
+    assert "comm watchdog" in out0
+    assert "allreduce" in out0
+    assert "Task created at" in out0
+    assert "UNREACHABLE" not in out0
