@@ -117,12 +117,24 @@ class _StaticFunction:
         return jax.tree_util.tree_map(_wrap, out_vals, is_leaf=lambda x: isinstance(x, jax.Array))
 
 
-def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """Decorator/wrapper: compile a function or Layer (reference jit/api.py:240)."""
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              mode="ast", **kwargs):
+    """Decorator/wrapper: compile a function or Layer (reference jit/api.py:240).
+
+    mode="ast" (default): whole-function trace+jit (the AST dy2static tier).
+    mode="sot": bytecode-level capture with guards and graph-break fallback
+    (jit/sot.py — the reference's symbolic-opcode-translation tier)."""
 
     def decorate(obj):
         from paddle_tpu.nn import Layer
 
+        if mode == "sot":
+            from .sot import symbolic_translate
+
+            if isinstance(obj, Layer):
+                obj.forward = symbolic_translate(obj.forward)
+                return obj
+            return symbolic_translate(obj)
         if isinstance(obj, Layer):
             sf = _StaticFunction(obj.forward, layer=obj)
             obj.forward = sf

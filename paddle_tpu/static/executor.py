@@ -80,8 +80,10 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list or []
 
-        if not program.global_block().ops and not program.param_inits:
+        if not program.global_block().ops and not program.param_inits and not fetch_list:
             return []  # startup program: state materializes lazily below
+            # (op-less programs WITH fetches still run: feeds flow straight
+            # to fetches — pass-through segments from jit/sot.py need this)
 
         self._ensure_state(program, scope)
 
