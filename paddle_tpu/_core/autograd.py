@@ -446,6 +446,10 @@ def _backward_impl(roots, grad_vals, retain_graph, leaf_targets):
 def _acc_tensor_grad(t: Tensor, g):
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True)
+    elif not hasattr(t.grad, "_value"):
+        # a SelectedRows sparse grad already accumulated here (sparse
+        # Embedding hook) now meets a dense contribution: densify
+        t.grad = Tensor(t.grad.accumulate(g), stop_gradient=True)
     else:
         t.grad = Tensor(t.grad._value + g, stop_gradient=True)
 

@@ -195,9 +195,10 @@ class Tensor:
         self.grad = None
 
     def clear_gradient(self, set_to_zero: bool = False):
-        if set_to_zero and self.grad is not None:
+        if set_to_zero and self.grad is not None and hasattr(self.grad, "_value"):
             self.grad = Tensor(jnp.zeros_like(self.grad._value))
         else:
+            # None, or a SelectedRows sparse grad (no dense buffer to zero)
             self.grad = None
 
     @property

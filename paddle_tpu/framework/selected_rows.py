@@ -1,0 +1,82 @@
+"""SelectedRows — row-sparse gradients for embedding-class parameters.
+
+Reference: paddle/phi/core/selected_rows.h (rows + value tensor + height)
+and the sparse-gradient path of embedding / lookup_table
+(paddle/phi/kernels/cpu/embedding_grad_kernel.cc sparse branch, the Adam
+lazy_mode row updates in paddle/phi/kernels/funcs/adam_functors.h).
+
+TPU-native role: a large-vocab embedding backward that materializes a dense
+[V, H] gradient wastes HBM bandwidth on rows that are all zero.  With
+Embedding(sparse=True) the backward instead produces a SelectedRows —
+(rows[k], values[k, H], height=V) — and the optimizer applies a
+segment-sum/scatter row update touching only the k looked-up rows, the
+reference's lazy_mode semantics."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows[i] indexes the parameter's dim-0; values[i] is that row's grad
+    contribution.  Duplicate rows are allowed (coalesce() merges them)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"SelectedRows: {self.rows.shape[0]} rows vs "
+                f"{self.values.shape[0]} value rows"
+            )
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def coalesce(self) -> "SelectedRows":
+        """Merge duplicate rows (segment-sum over the unique row set) —
+        reference MergeAdd on SelectedRows.  Eager-only (unique output size
+        is data-dependent)."""
+        rows = np.asarray(self.rows)
+        urows, inv = np.unique(rows, return_inverse=True)
+        import jax.ops
+
+        merged = jax.ops.segment_sum(
+            self.values, jnp.asarray(inv), num_segments=int(urows.shape[0])
+        )
+        return SelectedRows(jnp.asarray(urows), merged, self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def accumulate(self, other):
+        """Gradient accumulation across backward calls: concatenation (the
+        optimizer coalesces once at update time)."""
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch in accumulate")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.height,
+            )
+        # dense + sparse -> dense
+        return other + self.to_dense()
+
+    def __repr__(self):
+        return (
+            f"SelectedRows(height={self.height}, nnz_rows={self.rows.shape[0]}, "
+            f"row_width={self.values.shape[1:]}, dtype={self.values.dtype})"
+        )

@@ -68,6 +68,7 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        self._sparse = bool(sparse)
         self._padding_idx = (
             None if padding_idx is None else (padding_idx if padding_idx >= 0 else num_embeddings + padding_idx)
         )
@@ -80,7 +81,55 @@ class Embedding(Layer):
             self.weight._bind(self.weight._value.at[self._padding_idx].set(jnp.zeros((embedding_dim,), self.weight._value.dtype)))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        if not self._sparse:
+            return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return self._sparse_forward(x)
+
+    def _sparse_forward(self, x):
+        """sparse=True (reference lookup_table sparse-grad branch): the
+        lookup runs on a DETACHED weight, and an output hook turns the
+        incoming cotangent into a SelectedRows gradient — the dense [V, H]
+        gradient is never materialized; the optimizer applies the lazy
+        row update (framework/selected_rows.py)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu._core.autograd import is_grad_enabled
+        from paddle_tpu._core.tensor import Tensor
+        from paddle_tpu.framework.selected_rows import SelectedRows
+
+        w = self.weight
+        detached = Tensor(w._value, stop_gradient=True)
+        out = F.embedding(x, detached, padding_idx=self._padding_idx)
+        if w.stop_gradient or not is_grad_enabled():
+            return out
+
+        # the lookup ran on a detached weight, so `out` is off the tape;
+        # a zero-valued scalar anchor re-attaches it (its own grad is a
+        # throwaway scalar) so the output hook below receives the cotangent
+        from paddle_tpu._core.autograd import apply
+
+        anchor = Tensor(jnp.zeros((), out._value.dtype), stop_gradient=False)
+        out = apply("sparse_embedding", lambda o, a: o + a, out, anchor)
+
+        ids = (x._value if isinstance(x, Tensor) else jnp.asarray(x)).reshape(-1)
+        H = self._embedding_dim
+        pad = self._padding_idx
+
+        def hook(g):
+            vals = g._value.reshape(-1, H)
+            if pad is not None:
+                vals = jnp.where((ids == pad)[:, None], jnp.zeros((), vals.dtype), vals)
+            sr = SelectedRows(ids, vals, self._num_embeddings)
+            if w.grad is None:
+                w.grad = sr
+            elif isinstance(w.grad, SelectedRows):
+                w.grad = w.grad.accumulate(sr)
+            else:
+                w.grad = Tensor(w.grad._value + sr.to_dense())
+            return g
+
+        out.register_hook(hook)
+        return out
 
 
 class Dropout(Layer):

@@ -93,14 +93,28 @@ class Optimizer:
         if not _is_tracer(self._lr_t._value):
             self._sync_lr()
         lr = self._lr_t._value
+        from paddle_tpu.framework.selected_rows import SelectedRows
+
         params_grads = [
             (p, p.grad) for p in self._parameter_list if not p.stop_gradient and p.grad is not None
         ]
         if self._grad_clip is not None and isinstance(self._grad_clip, ClipGradBase):
+            # grad clip computes dense norms: densify any SelectedRows first
+            params_grads = [
+                (p, Tensor(g.to_dense()) if isinstance(g, SelectedRows) else g)
+                for p, g in params_grads
+            ]
             params_grads = self._grad_clip(params_grads)
         with no_grad():
             for p, g in params_grads:
                 if g is None:
+                    continue
+                if isinstance(g, SelectedRows):
+                    # lazy row update (reference adam_functors.h lazy_mode):
+                    # only the looked-up rows are touched; master-weight and
+                    # L2 interplay stay dense-path-only by design
+                    new_val = self._sparse_update(p, g.coalesce(), lr)
+                    p._bind(new_val.astype(p._value.dtype))
                     continue
                 gv = g._value.astype(jnp.float32) if g._value.dtype == jnp.float16 else g._value
                 use_l2 = self._weight_decay and self._wd_is_l2 and not self._decoupled_wd()
@@ -135,6 +149,12 @@ class Optimizer:
 
     def _decoupled_wd(self) -> bool:
         return False
+
+    def _sparse_update(self, p, sr, lr):
+        """Row-sparse update for a coalesced SelectedRows grad.  Base class:
+        densify (correct for every optimizer); SGD/Momentum/Adam override
+        with true touched-rows-only updates."""
+        return self._single_update(p, sr.to_dense(), lr)
 
     def clear_grad(self, set_to_zero: bool = False):
         for p in self._parameter_list:

@@ -19,6 +19,10 @@ class SGD(Optimizer):
     def _single_update(self, p, g, lr):
         return p._value - lr.astype(g.dtype) * g
 
+    def _sparse_update(self, p, sr, lr):
+        # scatter-add touches only the looked-up rows
+        return p._value.at[sr.rows].add(-lr.astype(sr.values.dtype) * sr.values)
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
@@ -33,6 +37,14 @@ class Momentum(Optimizer):
         if self._nesterov:
             return p._value - lr.astype(g.dtype) * (g + self._momentum * new_v)
         return p._value - lr.astype(g.dtype) * new_v
+
+    def _sparse_update(self, p, sr, lr):
+        rows, g = sr.rows, sr.values
+        vel = self._acc("velocity", p, dtype=g.dtype)
+        v_rows = self._momentum * vel._value[rows] + g
+        vel._bind(vel._value.at[rows].set(v_rows))
+        step = (g + self._momentum * v_rows) if self._nesterov else v_rows
+        return p._value.at[rows].add(-lr.astype(g.dtype) * step)
 
 
 class Adam(Optimizer):
@@ -65,6 +77,33 @@ class Adam(Optimizer):
         new32 = master - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
         return new32
 
+    def _update_moments_rows(self, p, rows, g32):
+        """Lazy (touched-rows-only) moment update — the reference's Adam
+        lazy_mode (adam_functors.h SparseAdamFunctor): untouched rows keep
+        stale moments, exactly paddle's sparse semantics."""
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=lambda: jnp.asarray(1.0, jnp.float32))
+        m_rows = self._beta1 * m._value[rows] + (1 - self._beta1) * g32
+        v_rows = self._beta2 * v._value[rows] + (1 - self._beta2) * jnp.square(g32)
+        new_b1p = b1p._value * self._beta1
+        new_b2p = b2p._value * self._beta2
+        m._bind(m._value.at[rows].set(m_rows))
+        v._bind(v._value.at[rows].set(v_rows))
+        b1p._bind(new_b1p)
+        b2p._bind(new_b2p)
+        m_hat = m_rows / (1 - new_b1p)
+        v_hat = v_rows / (1 - new_b2p)
+        return m_hat, v_hat
+
+    def _sparse_update(self, p, sr, lr):
+        rows = sr.rows
+        g32 = sr.values.astype(jnp.float32)
+        m_hat, v_hat = self._update_moments_rows(p, rows, g32)
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return p._value.astype(jnp.float32).at[rows].add(-upd)
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
@@ -87,6 +126,20 @@ class AdamW(Adam):
         lr_eff = lr * (self._lr_ratio(p) if self._lr_ratio is not None else 1.0)
         master = master * (1.0 - lr_eff * decay)
         return master - lr_eff * m_hat / (jnp.sqrt(v_hat) + self._eps)
+
+    def _sparse_update(self, p, sr, lr):
+        rows = sr.rows
+        g32 = sr.values.astype(jnp.float32)
+        m_hat, v_hat = self._update_moments_rows(p, rows, g32)
+        decay = self._wd_coeff
+        if self._apply_decay_fn is not None and not self._apply_decay_fn(p.name):
+            decay = 0.0
+        lr_eff = lr * (self._lr_ratio(p) if self._lr_ratio is not None else 1.0)
+        master = p._value.astype(jnp.float32)
+        # decoupled decay on touched rows only (lazy semantics)
+        row_vals = master[rows] * (1.0 - lr_eff * decay)
+        row_vals = row_vals - lr_eff * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return master.at[rows].set(row_vals)
 
 
 class Adagrad(Optimizer):
