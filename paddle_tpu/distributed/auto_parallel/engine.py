@@ -55,6 +55,9 @@ class Strategy:
         self.amp = config.get("amp", {})
         self.gradient_merge = config.get("gradient_merge", {})
         self.pipeline = config.get("pipeline", {})
+        # overrides merged into the auto-mode tuner_cfg (hbm_gb, candidate
+        # lists, ...) — the reference reads these from the tuner json
+        self.tuner = config.get("tuner", {})
 
 
 class Engine:
@@ -74,9 +77,16 @@ class Engine:
     # ------------------------------------------------------------------ mesh
     def _infer_mesh(self):
         """Mesh = the one used by param annotations, else the default world
-        mesh from fleet.auto context (reference get_default_process_mesh)."""
+        mesh from fleet.auto context (reference get_default_process_mesh).
+        auto_mode="auto": the tuner picks dp/mp/pp and applies it first."""
         if self._mesh is not None:
             return self._mesh
+        if self._strategy.auto_mode == "auto":
+            import jax
+
+            n = jax.device_count()
+            plan = self._auto_plan(n)
+            return self._apply_plan(plan, n)
         for p in self._model.parameters():
             if getattr(p, "process_mesh", None) is not None:
                 self._mesh = p.process_mesh
@@ -107,6 +117,112 @@ class Engine:
         if mode == "train":
             self._ensure_train_step(mesh)
         return self
+
+    # ------------------------------------------------------------- auto mode
+    def _model_cfg_estimate(self):
+        """Derive the tuner's model_cfg from the live model (reference reads
+        it from the tuner json; here introspection keeps them in sync)."""
+        cfg = getattr(self._model, "config", None)
+        out = {}
+        for src, dst in (
+            ("hidden_size", "hidden_size"),
+            ("num_hidden_layers", "num_layers"),
+            ("num_layers", "num_layers"),
+            ("num_attention_heads", "num_attention_heads"),
+            ("vocab_size", "vocab_size"),
+            ("intermediate_size", "intermediate_size"),
+            ("max_position_embeddings", "seq_length"),
+        ):
+            v = getattr(cfg, src, None)
+            if v is not None:
+                out.setdefault(dst, int(v))
+        out["num_params"] = sum(
+            int(np.prod(p.shape)) for p in self._model.parameters()
+        )
+        return out
+
+    def _model_parallel_fns(self):
+        """Known model families' mp/pp appliers (the reference's planner
+        rewrites programs; here placements are applied by family)."""
+        name = type(self._model).__name__
+        if name == "LlamaForCausalLM":
+            from paddle_tpu.models.llama import pipeline_llama, shard_llama
+
+            return shard_llama, pipeline_llama
+        if name == "GPTForCausalLM":
+            from paddle_tpu.models.gpt import shard_gpt
+
+            return (lambda m, mesh, mp_axis="mp": shard_gpt(m, mesh)), None
+        return None, None
+
+    def _auto_plan(self, n_devices):
+        """Full-auto mode (reference engine.py:59 `auto` + tuner.py:19):
+        grid-search dp/mp/pp with the pruner + analytic HBM model, pick the
+        surviving plan with the most data parallelism (fewest cross-device
+        activations), pp as last resort."""
+        from paddle_tpu.distributed.auto_tuner.tuner import AutoTuner
+
+        shard_fn, pipeline_fn = self._model_parallel_fns()
+        model_cfg = self._model_cfg_estimate()
+        tuner_cfg = {
+            "num_devices": n_devices,
+            "num_gpus": n_devices,
+            "model_cfg": model_cfg,
+            "sharding_degree": [1],
+            "sharding_stage": [self._strategy.sharding_stage or 1],
+            "use_recompute": [False],
+            "micro_batch_size": [1],
+            "task_limit": 10_000,
+        }
+        if shard_fn is None:
+            tuner_cfg["mp_degree"] = [1]
+        if pipeline_fn is None:
+            tuner_cfg["pp_degree"] = [1]
+        tuner_cfg.update(self._strategy.tuner)
+        tuner = AutoTuner(tuner_cfg)
+        best = best_key = None
+        while True:
+            cand = tuner.search_once()
+            if cand is None:
+                break
+            tuner.add_cfg(cand)
+            key = (cand["dp_degree"], -cand["pp_degree"], -cand["mp_degree"])
+            if best is None or key > best_key:
+                best, best_key = cand, key
+        if best is None:
+            best = {"dp_degree": n_devices, "mp_degree": 1, "pp_degree": 1}
+        return best
+
+    def _apply_plan(self, plan, n_devices):
+        from . import ProcessMesh
+
+        axes, shape = [], []
+        for name, deg in (
+            ("dp", plan.get("dp_degree", 1)),
+            ("pp", plan.get("pp_degree", 1)),
+            ("mp", plan.get("mp_degree", 1)),
+        ):
+            if deg > 1:
+                axes.append(name)
+                shape.append(int(deg))
+        if not axes:
+            axes, shape = ["dp"], [1]
+        used = int(np.prod(shape))
+        mesh = ProcessMesh(np.arange(used).reshape(shape), axes)
+        shard_fn, pipeline_fn = self._model_parallel_fns()
+        if "mp" in axes and shard_fn is not None:
+            shard_fn(self._model, mesh, mp_axis="mp")
+        if "pp" in axes and pipeline_fn is not None:
+            pipeline_fn(self._model, mesh, pp_axis="pp",
+                        num_microbatches=plan.get("pp_degree"))
+            # the pipeline stack replaces block parameters with stacked
+            # ones: point the optimizer at the new parameter set (lazy
+            # accumulators key per-param, so state starts fresh)
+            if self._optimizer is not None:
+                self._optimizer._parameter_list = list(self._model.parameters())
+        self._mesh = mesh
+        self._plan = dict(plan)
+        return mesh
 
     def _ensure_train_step(self, mesh):
         if self._train_step is not None:
