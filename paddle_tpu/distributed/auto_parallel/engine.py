@@ -174,11 +174,16 @@ class Engine:
             "micro_batch_size": [1],
             "task_limit": 10_000,
         }
+        tuner_cfg.update(self._strategy.tuner)
+        # capability guards come AFTER user overrides: a plan the engine
+        # cannot APPLY (no mp shard fn / no pipeline fn for this model
+        # family; sharding axis not mesh-materialized here) must never be
+        # reported as selected
         if shard_fn is None:
             tuner_cfg["mp_degree"] = [1]
         if pipeline_fn is None:
             tuner_cfg["pp_degree"] = [1]
-        tuner_cfg.update(self._strategy.tuner)
+        tuner_cfg["sharding_degree"] = [1]
         tuner = AutoTuner(tuner_cfg)
         best = best_key = None
         while True:

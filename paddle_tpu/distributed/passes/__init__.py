@@ -88,15 +88,86 @@ class AmpPass(PassBase):
         return ctx
 
 
+class Fp16ProgramRewrite:
+    """TRUE program transform (reference auto_parallel_fp16.py rewrites the
+    ProgramDesc op-by-op inserting casts): every white-listed Operator in a
+    captured Program is replaced by an `fp16::`-prefixed clone whose body
+    casts float32 variable inputs to the low dtype, computes there, and
+    casts the result back — the Variable avals (and so every consumer) are
+    untouched, XLA fuses the cast pairs into the surrounding ops."""
+
+    WHITE = {"matmul", "mm", "bmm", "mv", "addmm", "einsum", "conv2d",
+             "conv1d", "conv3d", "flash_attention"}
+
+    def __init__(self, dtype="bfloat16"):
+        self.dtype = dtype
+
+    def apply(self, program) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu._core.dtype import to_jax_dtype
+        from paddle_tpu.static.program import Operator
+
+        low = to_jax_dtype(self.dtype)
+        block = program.global_block()
+        n = 0
+        for i, op in enumerate(list(block.ops)):
+            if op.type not in self.WHITE:
+                continue
+
+            def make(fn, low=low):
+                def wrapped(*vals):
+                    downcast = False
+                    cast = []
+                    for v in vals:
+                        if hasattr(v, "dtype") and v.dtype == jnp.float32:
+                            cast.append(v.astype(low))
+                            downcast = True
+                        else:
+                            cast.append(v)
+                    out = fn(*cast)
+                    if not downcast:
+                        # natively-low-precision program: outputs keep their
+                        # recorded avals — no silent fp32 upcast
+                        return out
+                    return jax.tree_util.tree_map(
+                        lambda o: o.astype(jnp.float32)
+                        if hasattr(o, "dtype") and o.dtype == low
+                        else o,
+                        out,
+                    )
+
+                return wrapped
+
+            block.ops[i] = Operator(
+                "fp16::" + op.type, make(op.fn), op.arg_spec, op.kwargs,
+                op.out_vids, op.out_tree,
+            )
+            n += 1
+        if n:
+            program.version += 1
+        return n
+
+
 @register_pass("auto_parallel_fp16")
 class Fp16Pass(PassBase):
-    """O2: decorate params to the low dtype; the optimizer base keeps fp32
-    masters (reference auto_parallel_fp16.py + mix_precision_utils)."""
+    """Dual-mode like the reference pass family: given a captured Program
+    (attrs main_program) it REWRITES it (Fp16ProgramRewrite cast
+    insertion); given the (model, optimizer) triple it decorates params to
+    the low dtype with the optimizer keeping fp32 masters
+    (auto_parallel_fp16.py + mix_precision_utils)."""
 
     def apply(self, ctx):
+        dtype = self.attrs.get("dtype", "bfloat16")
+        prog = ctx.attrs.get("main_program")
+        if prog is not None:
+            ctx.attrs["fp16_rewritten_ops"] = Fp16ProgramRewrite(dtype).apply(prog)
+            ctx.attrs["amp_level"] = "O1"
+            ctx.attrs["amp_dtype"] = dtype
+            return ctx
         from paddle_tpu import amp
 
-        dtype = self.attrs.get("dtype", "bfloat16")
         amp.decorate(ctx.model, level="O2", dtype=dtype)
         ctx.attrs["amp_level"] = "O2"
         ctx.attrs["amp_dtype"] = dtype

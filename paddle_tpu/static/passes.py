@@ -84,9 +84,16 @@ def _pallas_fusion_factory(**kwargs):
     return PallasFusionPass(**kwargs)
 
 
+def _fp16_rewrite_factory(**kwargs):
+    from paddle_tpu.distributed.passes import Fp16ProgramRewrite
+
+    return Fp16ProgramRewrite(**kwargs)
+
+
 _REGISTRY = {
     "dead_code_elimination": DeadCodeEliminationPass,
     "pallas_fusion": _pallas_fusion_factory,
+    "auto_parallel_fp16": _fp16_rewrite_factory,
 }
 
 

@@ -51,6 +51,30 @@ def _const_scalar(spec):
     return None
 
 
+def _is_causal_mask_const(spec, S):
+    """('const', v) holding an additive causal mask over an [.., S, S]
+    score matrix: 0 on/below the diagonal, <= -1e9 (or -inf) strictly
+    above.  Leading broadcast dims of size 1 are allowed."""
+    if spec[0] != "const":
+        return False
+    try:
+        arr = np.asarray(spec[1], np.float32)
+    except Exception:
+        return False
+    if arr.ndim < 2 or arr.shape[-1] != S or arr.shape[-2] != S:
+        return False
+    if any(d != 1 for d in arr.shape[:-2]):
+        return False
+    m = arr.reshape(S, S)
+    lower = np.tril(np.ones((S, S), bool))
+    if not np.all(m[lower] == 0):
+        return False
+    upper_vals = m[~lower]
+    if upper_vals.size == 0:
+        return True
+    return bool(np.all(np.isneginf(upper_vals) | (upper_vals <= -1e9)))
+
+
 class ProgramGraph:
     """Def-use view of a Program's global block (the pattern matcher's
     working set; reference pattern_match.h works over Operation/Value
@@ -152,14 +176,15 @@ def _make_op(type_, fn, var_vids, template_op):
 
 
 class FlashAttentionPattern(RewritePattern):
-    """matmul(q,kᵀ) [→ scale] → softmax → matmul(·,v)  ⇒  Pallas flash
-    attention (ops/flash_attention.py — online softmax, O(S) memory).
+    """matmul(q,kᵀ) [→ scale] [→ +causal mask] → softmax → matmul(·,v)
+    ⇒ Pallas flash attention (ops/flash_attention.py — online softmax,
+    O(S) memory).
 
     Anchored at the second matmul.  Conservative: 4-D [B, N, S, D] layouts
-    only, no additive mask (an arbitrary mask has no kernel parameter;
-    causal masks arrive via the kernel's own flag in model code), unique
-    consumers for every interior value, and S != D so the kᵀ layout is
-    unambiguous."""
+    only; an additive CONST mask fuses only when it is recognizably the
+    causal triangle (maps to the kernel's causal flag) — arbitrary masks
+    have no kernel parameter and block fusion; unique consumers for every
+    interior value; and S != D so the kᵀ layout is unambiguous."""
 
     name = "flash_attention_fuse"
     root_type = "matmul"
@@ -192,24 +217,42 @@ class FlashAttentionPattern(RewritePattern):
         if sm_axis not in (-1, 3):
             return False
 
-        # optional scale chain between qk-matmul and softmax
+        # optional scale / causal-mask-add chain between qk-matmul and
+        # softmax (vanilla LLaMA writes scores/sqrt(d) + causal_mask)
         scale = None
+        causal = False
         cur_vid = sm.arg_spec[0][1]
         if not graph.single_use(cur_vid):
             return False
         cur = graph.def_op(cur_vid)
-        if cur is not None and cur.type in ("divide", "multiply", "scale"):
+        for _ in range(2):  # at most one scale + one mask-add, any order
+            if cur is None:
+                return False
             var_ins = [s for s in cur.arg_spec if s[0] == "var"]
             consts = [s for s in cur.arg_spec if s[0] == "const"]
-            c = _const_scalar(consts[0]) if len(consts) == 1 else None
-            if len(var_ins) == 1 and c is not None:
+            if (
+                cur.type in ("divide", "multiply")
+                and len(var_ins) == 1
+                and len(consts) == 1
+                and _const_scalar(consts[0]) is not None
+                and scale is None
+            ):
+                c = _const_scalar(consts[0])
                 scale = (1.0 / c) if cur.type == "divide" else c
-                cur_vid = var_ins[0][1]
-                if not graph.single_use(cur_vid):
-                    return False
-                cur = graph.def_op(cur_vid)
-            elif cur.type == "scale":
+            elif (
+                cur.type == "add"
+                and len(var_ins) == 1
+                and len(consts) == 1
+                and not causal
+                and _is_causal_mask_const(consts[0], S)
+            ):
+                causal = True
+            else:
+                break
+            cur_vid = var_ins[0][1]
+            if not graph.single_use(cur_vid):
                 return False
+            cur = graph.def_op(cur_vid)
         qk = cur
         if qk is None or qk.type != "matmul":
             return False
@@ -237,7 +280,7 @@ class FlashAttentionPattern(RewritePattern):
             qt = jnp.swapaxes(q, 1, 2)  # [B,N,S,D] -> kernel's [B,S,N,D]
             kt = jnp.swapaxes(k, 1, 2)
             vt = jnp.swapaxes(v, 1, 2)
-            o = flash_attention(qt, kt, vt, scale=scale)
+            o = flash_attention(qt, kt, vt, scale=scale, causal=causal)
             return jnp.swapaxes(o, 1, 2)
 
         graph.replace_op(op, _make_op("flash_attention", fused, [q_vid, k_vid, v_vid], op))

@@ -83,3 +83,47 @@ def test_pipeline_scheduler_pass():
     PassManager([new_pass("pipeline_scheduler", {"schedule": "FThenB", "num_microbatches": 8})]).apply(ctx)
     assert stack._schedule == "FThenB" and stack._num_microbatches == 8
     assert ctx.attrs["pipeline_stacks"] == 1
+
+
+def test_fp16_program_rewrite_pass():
+    """Program-REWRITING distributed pass (reference auto_parallel_fp16.py
+    transforms the ProgramDesc): white-listed ops in a captured Program are
+    replaced by bf16-compute clones; numerics shift by at most bf16
+    rounding, consumers/avals untouched."""
+    import jax
+    import numpy as np
+    import paddle_tpu.static as static
+    from paddle_tpu.static.program import Program, program_guard
+    from paddle_tpu.distributed.passes import PassContext, new_pass
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(8, 16)).astype(np.float32)
+    b = rng.normal(size=(16, 4)).astype(np.float32)
+
+    def capture():
+        prog = Program()
+        with program_guard(prog):
+            av = prog.add_feed(prog.new_var(jax.ShapeDtypeStruct((8, 16), np.float32), "a"))
+            bv = prog.add_feed(prog.new_var(jax.ShapeDtypeStruct((16, 4), np.float32), "b"))
+            out = paddle.tanh(paddle.matmul(av, bv)).sum()
+        return prog, out
+
+    prog_ref, out_ref = capture()
+    exe = static.Executor()
+    ref = exe.run(prog_ref, feed={"a": a, "b": b}, fetch_list=[out_ref])[0]
+
+    prog, out = capture()
+    ctx = new_pass("auto_parallel_fp16").apply(PassContext(main_program=prog))
+    assert ctx.attrs["fp16_rewritten_ops"] == 1
+    types = [op.type for op in prog.global_block().ops]
+    assert "fp16::matmul" in types and "matmul" not in types
+    got = exe.run(prog, feed={"a": a, "b": b}, fetch_list=[out])[0]
+    # bf16 compute inside the op; output cast back to fp32
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+    # also reachable through the static pass registry
+    from paddle_tpu.static.passes import apply_pass
+
+    prog2, out2 = capture()
+    n = apply_pass(prog2, "auto_parallel_fp16")
+    assert n == 1

@@ -191,3 +191,55 @@ def test_vanilla_llama_block_gets_flash_substituted():
     assert types.count("fused_rms_norm") == 2
     assert "swiglu" in types
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_causal_mask_attention_fuses_with_causal_flag():
+    """Vanilla causal attention — scores/sqrt(d) + triangular -inf mask —
+    fuses to flash_attention(causal=True) and matches the unfused numerics."""
+    B, N, S, D = 2, 2, 128, 16
+    mask = np.triu(np.full((S, S), -1e9, np.float32), k=1)[None, None]
+
+    prog = Program()
+    with program_guard(prog):
+        q = _feed(prog, "q", (B, N, S, D))
+        k = _feed(prog, "k", (B, N, S, D))
+        v = _feed(prog, "v", (B, N, S, D))
+        scores = paddle.matmul(q, k, transpose_y=True) / (D ** 0.5)
+        scores = scores + paddle.to_tensor(mask)
+        probs = F.softmax(scores, axis=-1)
+        out = paddle.matmul(probs, v)
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    n = PallasFusionPass([out._vid]).apply(prog)
+    assert n == 1
+    assert "flash_attention" in _optypes(prog)
+
+    rng = np.random.default_rng(4)
+    qv = rng.normal(size=(B, N, S, D)).astype(np.float32)
+    kv = rng.normal(size=(B, N, S, D)).astype(np.float32)
+    vv = rng.normal(size=(B, N, S, D)).astype(np.float32)
+    exe = static.Executor()
+    got = exe.run(prog, feed={"q": qv, "k": kv, "v": vv}, fetch_list=[out])[0]
+    s = qv @ np.swapaxes(kv, -1, -2) / np.sqrt(D) + mask
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, p @ vv, rtol=2e-3, atol=2e-3)
+
+
+def test_non_causal_arbitrary_mask_blocks_fusion():
+    """An arbitrary additive mask has no kernel parameter: must NOT fuse."""
+    B, N, S, D = 1, 2, 128, 16
+    mask = np.random.default_rng(0).normal(size=(1, 1, S, S)).astype(np.float32)
+
+    prog = Program()
+    with program_guard(prog):
+        q = _feed(prog, "q", (B, N, S, D))
+        k = _feed(prog, "k", (B, N, S, D))
+        v = _feed(prog, "v", (B, N, S, D))
+        scores = paddle.matmul(q, k, transpose_y=True) + paddle.to_tensor(mask)
+        out = paddle.matmul(F.softmax(scores, axis=-1), v)
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    n = PallasFusionPass([out._vid]).apply(prog)
+    assert n == 0
+    assert "flash_attention" not in _optypes(prog)
