@@ -71,10 +71,14 @@ from paddle_tpu.nn import Layer
 
 
 def _pvary(x, axes):
-    # jax>=0.9 renames pvary -> pcast(..., to='varying'); support both
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    # jax>=0.9 renames pvary -> pcast(..., to='varying'); support both.
+    # Idempotent: values already varying over the axes pass through.
+    try:
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, axes, to="varying")
+        return lax.pvary(x, axes)
+    except ValueError:
+        return x
 
 __all__ = ["PipelineStack", "segment_layers"]
 
