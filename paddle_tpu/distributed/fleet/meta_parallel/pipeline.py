@@ -72,13 +72,16 @@ from paddle_tpu.nn import Layer
 
 def _pvary(x, axes):
     # jax>=0.9 renames pvary -> pcast(..., to='varying'); support both.
-    # Idempotent: values already varying over the axes pass through.
+    # Idempotent: values already varying over the axes pass through — but
+    # only that case; any other ValueError (bad axis name, bad to=) raises.
     try:
         if hasattr(lax, "pcast"):
             return lax.pcast(x, axes, to="varying")
         return lax.pvary(x, axes)
-    except ValueError:
-        return x
+    except ValueError as e:
+        if "from=varying" in str(e) or "already" in str(e):
+            return x
+        raise
 
 __all__ = ["PipelineStack", "segment_layers"]
 

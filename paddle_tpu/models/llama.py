@@ -30,6 +30,7 @@ __all__ = [
     "LlamaDecoderLayer",
     "shard_llama",
     "pipeline_llama",
+    "context_parallel_llama",
     "llama_tiny",
     "llama_7b",
 ]
@@ -61,25 +62,48 @@ def _rope_tables(head_dim: int, max_len: int, theta: float):
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
+def _rope_rotate(qv, kv, c_t, s_t):
+    """Rotate-half on [B, S, N, H] given pre-sliced cos/sin [S, H/2]."""
+    c_t = c_t[None, :, None, :]
+    s_t = s_t[None, :, None, :]
+
+    def rot(x):
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        xr1 = x1 * c_t - x2 * s_t
+        xr2 = x2 * c_t + x1 * s_t
+        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+
+    return rot(qv).astype(qv.dtype), rot(kv).astype(kv.dtype)
+
+
 def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
     """Rotate half formulation on [B, S, N, H] tensors (reference fused_rope
     kernel paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu — here one
-    fused XLA elementwise chain; a Pallas variant lives in paddle_tpu.ops)."""
+    fused XLA elementwise chain; a Pallas variant lives in paddle_tpu.ops).
+
+    position_offset may be a Tensor (traced — e.g. a sequence-parallel
+    rank's shard offset); the table slice then lowers to dynamic_slice."""
+    from paddle_tpu._core.tensor import Tensor as _T
+
+    if isinstance(position_offset, _T):
+        def _rope_dyn(qv, kv, c, s, off):
+            import jax.lax as _lax
+
+            S = qv.shape[1]
+            c_t = _lax.dynamic_slice_in_dim(c, off, S, 0)
+            s_t = _lax.dynamic_slice_in_dim(s, off, S, 0)
+            return _rope_rotate(qv, kv, c_t, s_t)
+
+        return apply("rotary_pos_emb", _rope_dyn, q, k, cos, sin, position_offset)
 
     def _rope(qv, kv, c, s):
         S = qv.shape[1]
-        c_t = c[position_offset : position_offset + S][None, :, None, :]
-        s_t = s[position_offset : position_offset + S][None, :, None, :]
-
-        def rot(x):
-            x1 = x[..., 0::2]
-            x2 = x[..., 1::2]
-            xr1 = x1 * c_t - x2 * s_t
-            xr2 = x2 * c_t + x1 * s_t
-            out = jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
-            return out
-
-        return rot(qv).astype(qv.dtype), rot(kv).astype(kv.dtype)
+        return _rope_rotate(
+            qv, kv,
+            c[position_offset : position_offset + S],
+            s[position_offset : position_offset + S],
+        )
 
     return apply("rotary_pos_emb", _rope, q, k, cos, sin)
 
@@ -102,6 +126,37 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(hidden_states).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
+        sep_ax = None
+        if getattr(self, "_sep_mode", None) and kv_cache is None and attn_mask is None:
+            # one gate for BOTH the rope offset and the attention branch:
+            # rope offsets and ring exchange must engage together
+            from paddle_tpu.distributed.communication import current_axis_scope
+
+            sep_ax = current_axis_scope().get("sep")
+        if sep_ax is not None:
+            # sequence sharded over 'sep': this shard's tokens sit at global
+            # positions rank*s .. rank*s + s, so the rope tables must be
+            # sliced at the rank offset (dynamic under tracing)
+            import jax.lax as _lax
+
+            rope_len = int(rope_cos.shape[0])
+
+            def _sep_off(z, ax=sep_ax, s=s, rope_len=rope_len):
+                w = _lax.axis_size(ax)
+                if s * w > rope_len:
+                    raise ValueError(
+                        f"context parallelism: global sequence {s * w} "
+                        f"exceeds the rope table ({rope_len} positions); "
+                        "raise max_position_embeddings"
+                    )
+                return (z + _lax.axis_index(ax) * s).astype(jnp.int32)
+
+            base = (
+                position_offset
+                if isinstance(position_offset, Tensor)
+                else paddle.full([], int(position_offset), "int32")
+            )
+            position_offset = apply("sep_pos_offset", _sep_off, base)
         q, k = apply_rotary_pos_emb(q, k, rope_cos, rope_sin, position_offset)
         if kv_cache is not None:
             k = paddle.concat([kv_cache[0], k], axis=1)
@@ -121,11 +176,21 @@ class LlamaAttention(nn.Layer):
                 "chunked prefill (multi-token input on a non-empty cache) is "
                 "not supported; decode one token at a time"
             )
-        # empty-cache prefill is causal; a cached single-token decode
-        # attends to everything it has
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=(kv_cache is None) or s > 1
-        )
+        if sep_ax is not None:
+            # context parallelism (context_parallel_llama): the sequence is
+            # sharded over the 'sep' axis — ring/Ulysses attention exchange
+            # K/V shards over ICI instead of materializing the full sequence
+            from paddle_tpu.distributed.fleet.meta_parallel.segment_parallel import (
+                sep_attention,
+            )
+
+            out = sep_attention(q, k, v, causal=True, mode=self._sep_mode)
+        else:
+            # empty-cache prefill is causal; a cached single-token decode
+            # attends to everything it has
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=(kv_cache is None) or s > 1
+            )
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if new_cache is not None:
@@ -505,6 +570,21 @@ def pipeline_llama(model: "LlamaForCausalLM", mesh, pp_axis: str = "pp",
     if include_edges:
         self_model = model.model
         self_model._pp_full = True
+    return model
+
+
+def context_parallel_llama(model: "LlamaForCausalLM", mode: str = "ring"):
+    """Switch every attention layer to sequence-parallel attention
+    (ring or Ulysses over the 'sep' mesh axis — reference SEP hybrid axis +
+    the ring/all-to-all context-parallel recipes).  Inside an SPMD region
+    with 'sep' in scope each rank holds a contiguous sequence shard: rope
+    offsets become rank-relative and K/V shards rotate over ICI
+    (ops/ring_attention.py).  Outside any sep scope the layers fall back to
+    ordinary causal attention, so the same model object serves both."""
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"mode must be ring|ulysses, got {mode!r}")
+    for blk in model.model.layers:
+        blk.self_attn._sep_mode = mode
     return model
 
 
