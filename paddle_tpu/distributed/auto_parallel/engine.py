@@ -150,9 +150,9 @@ class Engine:
 
             return shard_llama, pipeline_llama
         if name == "GPTForCausalLM":
-            from paddle_tpu.models.gpt import shard_gpt
+            from paddle_tpu.models.gpt import pipeline_gpt, shard_gpt
 
-            return (lambda m, mesh, mp_axis="mp": shard_gpt(m, mesh)), None
+            return shard_gpt, pipeline_gpt
         return None, None
 
     def _auto_plan(self, n_devices):

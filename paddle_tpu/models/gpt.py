@@ -15,7 +15,7 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "shard_gpt"]
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "shard_gpt", "pipeline_gpt"]
 
 
 @dataclass
@@ -67,8 +67,13 @@ class GPTModel(nn.Layer):
             )
         pos = paddle.arange(s, dtype="int32").unsqueeze(0).expand([b, s])
         h = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for blk in self.h:
-            h = blk(h)
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
+
+        if isinstance(self.h, PipelineStack):
+            h = self.h(h)
+        else:
+            for blk in self.h:
+                h = blk(h)
         return self.ln_f(h)
 
 
@@ -136,3 +141,23 @@ def gpt_tiny(**kw) -> GPTConfig:
     )
     cfg.update(kw)
     return GPTConfig(**cfg)
+
+
+def pipeline_gpt(model: "GPTForCausalLM", mesh, pp_axis: str = "pp",
+                 num_microbatches=None, use_recompute: bool = False,
+                 schedule: str = "1F1B", num_virtual_stages: int = 1):
+    """Pipeline the GPT decoder trunk over the 'pp' mesh axis (reference
+    PipelineLayer partition, fleet pp_layers.py:237).  GPT-2's head is
+    weight-tied to wte, so the embeddings / final norm / head stay outside
+    the pipelined region (the same trunk-only fallback tied-embedding LLaMA
+    takes); the uniform block stack rides the scan-based SPMD engine."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
+
+    if pp_axis not in mesh.dim_names:
+        return model
+    model.gpt.h = PipelineStack(
+        list(model.gpt.h), mesh, pp_axis=pp_axis,
+        num_microbatches=num_microbatches, use_recompute=use_recompute,
+        schedule=schedule, num_virtual_stages=num_virtual_stages,
+    )
+    return model

@@ -294,3 +294,64 @@ def export_protobuf(path=None):
 
 
 __all__ += ["SortedKeys", "SummaryView", "export_protobuf"]
+
+
+def _compile_and_analyze(fn, example_args):
+    """jit-compile fn on the current backend and normalize its cost
+    analysis (list vs dict across jax versions)."""
+    import jax
+
+    from paddle_tpu._core.tensor import Tensor
+
+    vals = [a._value if isinstance(a, Tensor) else a for a in example_args]
+    compiled = jax.jit(fn).lower(*vals).compile()
+    analyses = compiled.cost_analysis()
+    if isinstance(analyses, (list, tuple)):
+        analyses = analyses[0] if analyses else {}
+    return compiled, vals, dict(analyses or {})
+
+
+def cost_analysis(fn, *example_args):
+    """Compile `fn` for the current backend and return XLA's cost analysis
+    (flops, bytes accessed, ...) — the per-op cost table the reference
+    builds by profiling (python/paddle/cost_model/static_op_benchmark.json),
+    here read straight from the compiler."""
+    return _compile_and_analyze(fn, example_args)[2]
+
+
+def estimate_mfu(fn, *example_args, runtime_s=None, peak_tflops=None):
+    """Model-FLOPs-utilization report for a compiled step.
+
+    flops come from XLA's cost analysis of the compiled executable;
+    runtime_s (measured seconds per call; measured here with one timed call
+    after warmup when omitted); peak from the device kind
+    (device/peaks.py).  Returns {"flops", "runtime_s", "achieved_tflops",
+    "peak_tflops", "mfu"} — mfu is 0.0 on CPU (no meaningful peak)."""
+    import time
+
+    import jax
+
+    from paddle_tpu.device.peaks import device_peak_tflops
+
+    compiled, vals, analyses = _compile_and_analyze(fn, example_args)
+    flops = float(analyses.get("flops", 0.0))
+    if runtime_s is None:
+        jax.block_until_ready(compiled(*vals))  # warmup
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*vals))
+        runtime_s = time.perf_counter() - t0
+    d = jax.devices()[0]
+    if peak_tflops is None:
+        peak_tflops = device_peak_tflops(d.device_kind, d.platform)
+    achieved = flops / runtime_s / 1e12 if runtime_s > 0 else 0.0
+    mfu = achieved / peak_tflops if peak_tflops else 0.0
+    return {
+        "flops": flops,
+        "runtime_s": runtime_s,
+        "achieved_tflops": achieved,
+        "peak_tflops": peak_tflops,
+        "mfu": mfu,
+    }
+
+
+__all__ += ["cost_analysis", "estimate_mfu"]

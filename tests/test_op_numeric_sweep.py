@@ -972,3 +972,61 @@ def test_sweep_coverage_target():
     uncovered = sorted(set(reg) - covered)
     assert len(covered) >= 300, (
         f"only {len(covered)} ops covered; uncovered: {uncovered}")
+
+
+# ---------------------------------------------- odd-signature in-place tier
+
+def _t(a):
+    return Tensor(np.asarray(a))
+
+
+_ip_x = S(2, 3)
+_ip_y = S(2, 3)
+_ip_c = S(2, 3)
+_ip_a24, _ip_b43 = S(2, 4), S(4, 3)
+_ip_rows = S(5, 3)
+
+
+def _np_lerp(a, b, w):
+    return a + w * (b - a)
+
+
+def _np_indexfill(a, idx, v):
+    r = a.copy()
+    r[idx] = v
+    return r
+
+
+@pytest.mark.parametrize("name,call,expected", [
+    ("clip_", lambda: paddle.clip_(_t(_ip_x.copy()), min=-0.2, max=0.2),
+     np.clip(_ip_x, -0.2, 0.2)),
+    ("scale_", lambda: paddle.scale_(_t(_ip_x.copy()), scale=3.0, bias=1.0),
+     _ip_x * 3.0 + 1.0),
+    ("lerp_", lambda: _t(_ip_x.copy()).lerp_(_t(_ip_y), 0.25),
+     _np_lerp(_ip_x, _ip_y, 0.25)),
+    ("addmm_", lambda: _t(_ip_c.copy()).addmm_(_t(_ip_a24), _t(_ip_b43)),
+     _ip_c + _ip_a24 @ _ip_b43),
+    ("index_fill_", lambda: _t(_ip_rows.copy()).index_fill_(_t(np.int64([0, 2])), 0, 9.0),
+     _np_indexfill(_ip_rows, [0, 2], 9.0)),
+    ("zero_", lambda: _t(_ip_x.copy()).zero_(), np.zeros_like(_ip_x)),
+    ("fill_", lambda: _t(_ip_x.copy()).fill_(2.5), np.full_like(_ip_x, 2.5)),
+])
+def test_odd_signature_inplace_ops(name, call, expected):
+    """In-place variants whose signatures don't fit the generic pair test:
+    each result is value-compared against the NumPy expectation computed
+    from the SAME input."""
+    out = call()
+    np.testing.assert_allclose(
+        np.asarray(out._value), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_inplace_semantics_rebind():
+    """x.op_() must rebind x itself for the odd-signature tier too."""
+    x = _t(u23.copy())
+    out = x.clip_(min=0.6, max=1.2)
+    assert out is x
+    np.testing.assert_allclose(np.asarray(x._value), np.clip(u23, 0.6, 1.2), rtol=1e-6)
+    x2 = _t(u23.copy())
+    out2 = x2.scale_(scale=2.0)
+    assert out2 is x2
+    np.testing.assert_allclose(np.asarray(x2._value), u23 * 2.0, rtol=1e-6)

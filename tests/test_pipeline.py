@@ -373,3 +373,28 @@ def test_full_model_vpp_matches_single_device():
     np.testing.assert_allclose(
         np.asarray(pm.lm_head.weight.grad._value),
         np.asarray(ref.lm_head.weight.grad._value), rtol=2e-3, atol=1e-5)
+
+
+def test_pipeline_gpt_trunk_matches_single_device():
+    """GPT trunk pipelining (tied head stays outside): loss matches the
+    unpipelined model."""
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.models.gpt import pipeline_gpt
+
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 256, (4, 16)).astype(np.int32)
+
+    def make():
+        paddle.seed(23)
+        return GPTForCausalLM(gpt_tiny(num_hidden_layers=4, vocab_size=256))
+
+    ref = make()
+    ref.eval()
+    ref_loss, _ = ref(paddle.to_tensor(ids), labels=paddle.to_tensor(ids))
+
+    mesh = ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "pp"])
+    pm = make()
+    pm.eval()
+    pipeline_gpt(pm, mesh, pp_axis="pp", num_microbatches=2)
+    loss, _ = pm(paddle.to_tensor(ids), labels=paddle.to_tensor(ids))
+    np.testing.assert_allclose(float(loss._value), float(ref_loss._value), rtol=1e-4)

@@ -76,3 +76,25 @@ def test_op_cost_model_profile_and_roofline(tmp_path):
     m2 = OpCostModel.load(str(p))
     assert m2.query("matmul_128") == dt
     assert m2.query("missing", default=1.0) == 1.0
+
+
+def test_cost_analysis_and_mfu_report():
+    """XLA-compiler-sourced cost table + MFU report (the reference profiles
+    per-op costs into static_op_benchmark.json; here the compiler reports
+    them directly)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.profiler as prof
+
+    def f(a, b):
+        return (a @ b).sum()
+
+    a = np.random.default_rng(0).normal(size=(256, 256)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(256, 256)).astype(np.float32)
+    ca = prof.cost_analysis(f, jnp.asarray(a), jnp.asarray(b))
+    assert ca.get("flops", 0) >= 2 * 256**3 * 0.9  # matmul dominates
+
+    rep = prof.estimate_mfu(f, jnp.asarray(a), jnp.asarray(b))
+    assert rep["flops"] >= 2 * 256**3 * 0.9
+    assert rep["runtime_s"] > 0
+    assert rep["mfu"] == 0.0  # CPU: no peak
