@@ -104,6 +104,17 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
     set_args(tuple(new_vals))
 
 
+def convert_ifexp(pred, true_fn, false_fn):
+    """`a if t else b` / folded tail returns: lazy branches; tensor
+    predicates lower to lax.cond via static.control_flow.cond."""
+    pv = _unwrap(pred)
+    if not _is_tracer(pv):
+        return true_fn() if bool(pv) else false_fn()
+    from paddle_tpu.static.control_flow import cond as _cond
+
+    return _cond(pred, true_fn, false_fn)
+
+
 def convert_while(test_fn, body_fn, get_args, set_args, names):
     # concrete path: exact python semantics
     first = _unwrap(test_fn())
@@ -383,7 +394,10 @@ def _merge_returns(stmts):
                 setattr(st, attr, _merge_returns(getattr(st, attr)))
         if isinstance(st, ast.If) and _all_paths_return(st.body):
             trailing = stmts[i + 1 :]
-            orelse = st.orelse if st.orelse else trailing
+            # the implicit-else trailing block may itself hold if-return
+            # chains (e.g. a python-bool early return followed by a
+            # tensor-predicate return): merge it too
+            orelse = st.orelse if st.orelse else _merge_returns(trailing)
             if _all_paths_return(orelse):
                 _RET_UID[0] += 1
                 uid = _RET_UID[0]
@@ -459,13 +473,34 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             )
         return node
 
+    # ---- conditional expressions
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+
+        def lam(body):
+            return ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=body,
+            )
+
+        return ast.copy_location(
+            ast.Call(
+                func=ast.Attribute(value=ast.Name(id="_pt_rt", ctx=ast.Load()),
+                                   attr="convert_ifexp", ctx=ast.Load()),
+                args=[node.test, lam(node.body), lam(node.orelse)],
+                keywords=[],
+            ),
+            node,
+        )
+
     # ---- if statements
     def visit_If(self, node):
         self.generic_visit(node)
         if _has_escape(node.body) or _has_escape(node.orelse):
             return node  # python `if` kept; traced use raises tracer-bool
         uid = self._next()
-        names = _assigned_names(node.body + node.orelse)
+        names = [n for n in _assigned_names(node.body + node.orelse) if not n.startswith("_pt_")]
         get_src, set_src = _make_getset(names, uid)
         true_def = ast.parse(f"def _pt_true_{uid}():\n    pass").body[0]
         false_def = ast.parse(f"def _pt_false_{uid}():\n    pass").body[0]

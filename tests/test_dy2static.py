@@ -214,3 +214,170 @@ def _python_range_loop(x):
 def test_for_over_python_range_preserved():
     r = _python_range_loop(paddle.to_tensor(np.zeros(1, np.float32)))
     assert float(r._value[0]) == 3.0
+
+
+# ------------------------------------------------------- breadth battery
+# Mirrors test/dygraph_to_static's wide case matrix at small scale: every
+# entry is (fn, args) checked for numeric equality between eager and
+# to_static execution (reference test strategy, SURVEY §4).
+
+def _eq(fn, *args, **kw):
+    ref = fn(*args)
+    got = to_static(fn)(*args)
+    np.testing.assert_allclose(
+        np.asarray(got._value), np.asarray(ref._value), rtol=1e-5, atol=1e-6, **kw)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_breadth_nested_tensor_if():
+    def fn(x):
+        if x.sum() > 0:
+            if x.max() > 2:
+                y = x * 3
+            else:
+                y = x * 2
+        else:
+            y = -x
+        return y.mean()
+
+    _eq(fn, _t([1.0, 2.5]))
+    _eq(fn, _t([1.0, 0.5]))
+    _eq(fn, _t([-1.0, -2.0]))
+
+
+def test_breadth_if_with_multiple_live_vars():
+    def fn(x):
+        a = x + 1
+        b = x * 2
+        if (a * b).sum() > 0:
+            a, b = b, a + b
+        else:
+            a = a - b
+        return (a + b).sum()
+
+    _eq(fn, _t([0.5, 1.5]))
+    _eq(fn, _t([-3.0, -4.0]))
+
+
+def test_breadth_while_accumulator():
+    def fn(x):
+        total = paddle.zeros([])
+        i = paddle.zeros([])
+        while i < 5:
+            total = total + (x * i).sum()
+            i = i + 1
+        return total
+
+    _eq(fn, _t([1.0, 2.0]))
+
+
+def test_breadth_while_with_tensor_condition_on_value():
+    def fn(x):
+        while x.sum() < 10:
+            x = x * 1.5
+        return x.sum()
+
+    _eq(fn, _t([1.0, 1.0]))
+
+
+def test_breadth_ternary_and_compare_chain():
+    def fn(x):
+        y = x * 2 if x.mean() > 0 else x * -1
+        return y.sum()
+
+    _eq(fn, _t([1.0, 3.0]))
+    _eq(fn, _t([-1.0, -3.0]))
+
+
+def test_breadth_logical_combinations():
+    def fn(x):
+        if (x.sum() > 0) and (x.max() < 10) or (x.min() < -5):
+            return x.sum() * 2
+        return x.sum()
+
+    _eq(fn, _t([1.0, 2.0]))
+    _eq(fn, _t([-6.0, 1.0]))
+    _eq(fn, _t([20.0, 1.0]))
+
+
+def test_breadth_for_range_over_tensor_len_steps():
+    def fn(x, n):
+        acc = x
+        for i in range(n):
+            acc = acc + x * float(i)
+        return acc.sum()
+
+    ref = fn(_t([1.0, 2.0]), 4)
+    got = to_static(fn)(_t([1.0, 2.0]), 4)
+    np.testing.assert_allclose(float(got._value), float(ref._value), rtol=1e-5)
+
+
+def test_breadth_grad_through_tensor_if():
+    def fn(x):
+        if x.sum() > 0:
+            return (x ** 2).sum()
+        return (x ** 3).sum()
+
+    x1 = _t([1.0, 2.0]); x1.stop_gradient = False
+    out = to_static(fn)(x1)
+    out.backward()
+    np.testing.assert_allclose(np.asarray(x1.grad._value), [2.0, 4.0], rtol=1e-5)
+
+    x2 = _t([-1.0, -2.0]); x2.stop_gradient = False
+    out2 = to_static(fn)(x2)
+    out2.backward()
+    np.testing.assert_allclose(np.asarray(x2.grad._value), [3.0, 12.0], rtol=1e-5)
+
+
+def test_breadth_layer_with_state_and_branch():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(3, 3)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                return h * 2
+            return h * -1
+
+    paddle.seed(4)
+    m = Gate()
+    x = _t([[0.5, 1.0, -0.2]])
+    ref = m(x)
+    paddle.seed(4)
+    sm = to_static(Gate())
+    got = sm(x)
+    np.testing.assert_allclose(np.asarray(got._value), np.asarray(ref._value), rtol=1e-5)
+
+
+def test_breadth_while_loop_carrying_two_tensors():
+    def fn(x):
+        a = x
+        b = paddle.zeros_like(x)
+        i = paddle.zeros([])
+        while i < 3:
+            a, b = a * 2, b + a
+            i = i + 1
+        return (a + b).sum()
+
+    _eq(fn, _t([1.0, -1.0]))
+
+
+def test_breadth_early_return_before_branch():
+    def fn(x, flag):
+        if flag:  # python bool: resolved at trace time
+            return x.sum()
+        if x.sum() > 0:
+            return x.mean()
+        return x.max()
+
+    ref = fn(_t([1.0, 2.0]), True)
+    got = to_static(fn)(_t([1.0, 2.0]), True)
+    np.testing.assert_allclose(float(got._value), float(ref._value))
+    ref2 = fn(_t([1.0, 2.0]), False)
+    got2 = to_static(fn)(_t([1.0, 2.0]), False)
+    np.testing.assert_allclose(float(got2._value), float(ref2._value))
