@@ -94,6 +94,12 @@ class _StaticFunction:
 
         layer = self._layer
         state = self._state_tensors()
+        # array-valued kwargs are dynamic traced inputs just like positional
+        # arrays; only python scalars & co. stay static
+        kwargs = {
+            k: (Tensor(jnp.asarray(v)) if isinstance(v, (np.ndarray, jax.Array)) else v)
+            for k, v in kwargs.items()
+        }
         static_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, Tensor)}
         tensor_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Tensor)}
 
@@ -115,22 +121,28 @@ class _StaticFunction:
         key_parts = [
             layer.training if layer else None, tree, t_idx, kw_names,
         ]
+        cacheable = True
         try:
-            hash(static_leaves)
-            key_parts.append(static_leaves)
+            # type names disambiguate 1 / 1.0 / True (equal+same hash in
+            # python, but different trace-time constants)
+            typed = tuple((i, type(v).__name__, v) for i, v in static_leaves)
+            hash(typed)
+            key_parts.append(typed)
         except TypeError:
-            # unhashable static python leaf: never share a cache entry
-            # (baking it into a shared closure could silently serve stale
-            # constants to a different value with an equal-looking repr)
-            key_parts.append(object())
+            cacheable = False  # unhashable python leaf: compile-per-call
+            key_parts.append(None)
         try:
-            key_parts.append(tuple(sorted((k, v) for k, v in static_kwargs.items())))
-            hash(key_parts[-1])
+            kw_typed = tuple(
+                sorted((k, type(v).__name__, v) for k, v in static_kwargs.items())
+            )
+            hash(kw_typed)
+            key_parts.append(kw_typed)
         except TypeError:
-            key_parts[-1] = object()
+            cacheable = False
+            key_parts.append(None)
         cache_key = tuple(key_parts)
 
-        entry = self._cache.get(cache_key)
+        entry = self._cache.get(cache_key) if cacheable else None
         if entry is None:
             fn = self._fn
             n_s, n_t, n_k = len(state), len(t_idx), len(kw_names)
@@ -173,23 +185,10 @@ class _StaticFunction:
                 holder["tree"] = out_tree
                 return tuple(flat_out) if len(flat_out) != 1 else flat_out[0]
 
-            # one abstract evaluation pins the output structure (and the
-            # funnel's n_outputs) before the first real call
-            # fixed dummy key: the probe is abstract-only, and burning a
-            # real key here would shift the rng stream between cold- and
-            # warm-cache calls (seed reproducibility)
-            probe_key = jax.random.key(0)
-            avals = [jax.ShapeDtypeStruct(t._value.shape, t._value.dtype) for t in state]
-            avals += [jax.ShapeDtypeStruct(flat[i]._value.shape, flat[i]._value.dtype) for i in t_idx]
-            avals += [
-                jax.ShapeDtypeStruct(tensor_kwargs[k]._value.shape, tensor_kwargs[k]._value.dtype)
-                for k in kw_names
-            ]
-            out_shape = jax.eval_shape(functools.partial(op_fn, _key=probe_key), *avals)
-            n_out = len(jax.tree_util.tree_leaves(out_shape))
-            entry = (op_fn, holder["tree"], n_out)
-            self._cache[cache_key] = entry
-        op_fn, out_tree, n_out = entry
+            entry = (op_fn, holder)
+            if cacheable:
+                self._cache[cache_key] = entry
+        op_fn, holder = entry
 
         inputs = list(state) + [flat[i] for i in t_idx] + [tensor_kwargs[k] for k in kw_names]
         key = rng_mod.next_key()
@@ -201,12 +200,10 @@ class _StaticFunction:
                 else _wrap(res)
             )
         else:
-            res = apply(
-                "dy2static_run",
-                functools.partial(op_fn, _key=key),
-                *inputs,
-                n_outputs=n_out if n_out > 1 else None,
-            )
+            res = apply("dy2static_run", functools.partial(op_fn, _key=key), *inputs)
+        # out structure comes from THIS call's trace (op_fn ran just now),
+        # so shape-dependent output trees stay correct across shapes
+        out_tree = holder["tree"]
         leaves = list(res) if isinstance(res, (tuple, list)) else [res]
         return jax.tree_util.tree_unflatten(out_tree, leaves)
 

@@ -1,0 +1,246 @@
+"""Continuous-batching generation engine over the paged-KV tier.
+
+Reference lineage: the block-attention serving stack —
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and the
+FastDeploy/PaddleNLP continuous-batching servers built on it (requests
+share one block pool through per-request block tables, joining and leaving
+the decode batch between steps).
+
+TPU-native design: the decode batch has a FIXED number of slots, so every
+step — any mix of live requests — reuses ONE compiled XLA program (static
+shapes are the whole game on TPU; the reference's GPU kernel re-launches
+per ragged batch instead).  A host-side block allocator hands pool pages
+to requests and recycles them at completion; inactive slots park on a
+dedicated scratch page each so the shared pool is never corrupted by
+masked lanes.  Prefill runs per admitted request and pours its K/V into
+pool pages; decode then advances all live slots together.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GenerationEngine"]
+
+
+@dataclass
+class _Slot:
+    rid: object = None
+    active: bool = False
+    seq_len: int = 0          # tokens stored in the pool (incl. prompt)
+    max_len: int = 0          # seq_len limit for this request
+    blocks: list = field(default_factory=list)
+    last_token: int = 0
+    generated: list = field(default_factory=list)
+
+
+class GenerationEngine:
+    """Greedy continuous-batching decode over a shared paged-KV pool.
+
+    Usage:
+        eng = GenerationEngine(model, max_batch=4, block_size=16, num_blocks=64)
+        eng.add_request("a", prompt_ids_a, max_new_tokens=8)
+        while eng.has_work():
+            for rid, tok in eng.step().items(): ...
+        eng.result("a")  # -> list of generated token ids
+    """
+
+    def __init__(self, model, max_batch=4, block_size=16, num_blocks=128,
+                 eos_token_id=None):
+        cfg = model.config
+        self.model = model
+        self.block_size = int(block_size)
+        self.max_batch = int(max_batch)
+        self.eos_token_id = eos_token_id
+        self._n_layers = cfg.num_hidden_layers
+        self._nkv = cfg.num_key_value_heads
+        self._head_dim = cfg.hidden_size // cfg.num_attention_heads
+        # pool pages [num_blocks, Nkv, bs, H] per layer, plus one dedicated
+        # scratch page per slot (masked lanes write there, never the pool)
+        self._num_blocks = int(num_blocks)
+        total = self._num_blocks + self.max_batch
+        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self._kpools = [
+            jnp.zeros((total, self._nkv, self.block_size, self._head_dim), dt)
+            for _ in range(self._n_layers)
+        ]
+        self._vpools = [jnp.zeros_like(k) for k in self._kpools]
+        self._free = list(range(self._num_blocks))
+        self._scratch = [self._num_blocks + i for i in range(self.max_batch)]
+        self._slots = [_Slot() for _ in range(self.max_batch)]
+        self._results: dict = {}
+        self._max_blocks_per_seq = max(2, self._num_blocks // max(1, self.max_batch))
+        self._step_fn = None
+        self._state = list(model.state_dict().values())
+
+    # ------------------------------------------------------------ requests
+    def has_work(self):
+        return any(s.active for s in self._slots)
+
+    def result(self, rid):
+        return self._results.get(rid)
+
+    def _alloc(self, n):
+        if len(self._free) < n:
+            raise RuntimeError(
+                f"paged pool exhausted: need {n} blocks, {len(self._free)} free"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def _release(self, slot):
+        self._free.extend(slot.blocks)
+        slot.blocks = []
+        slot.active = False
+        slot.rid = None
+
+    def add_request(self, rid, prompt_ids, max_new_tokens=16):
+        """Prefill the prompt, pour K/V into pool pages, occupy a slot."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import _model_forward_cached
+
+        slot = next((s for s in self._slots if not s.active), None)
+        if slot is None:
+            raise RuntimeError("no free decode slot; call step() until one drains")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(1, -1)
+        s0 = prompt.shape[1]
+        max_len = s0 + int(max_new_tokens)
+        n_blocks = -(-max_len // self.block_size)
+        if n_blocks > self._max_blocks_per_seq:
+            raise RuntimeError(
+                f"request needs {n_blocks} blocks > per-seq table width "
+                f"{self._max_blocks_per_seq}"
+            )
+        blocks = self._alloc(n_blocks)
+
+        model = self.model
+        empty = [
+            (
+                paddle.zeros([1, 0, self._nkv, self._head_dim], dtype=model.config.dtype),
+                paddle.zeros([1, 0, self._nkv, self._head_dim], dtype=model.config.dtype),
+            )
+            for _ in range(self._n_layers)
+        ]
+        with paddle.no_grad():
+            h, caches = _model_forward_cached(model.model, paddle.to_tensor(prompt), empty, 0)
+            first = int(np.asarray(
+                paddle.argmax(model._logits(h[:, -1:, :]), axis=-1)._value
+            ).reshape(-1)[0])
+
+        # pour prefill K/V into this request's pages
+        bs = self.block_size
+        pad = n_blocks * bs - s0
+        for li, (k, v) in enumerate(caches):
+            kv = jnp.moveaxis(k._value, 1, 2)  # [1, Nkv, S, H]
+            vv = jnp.moveaxis(v._value, 1, 2)
+            if pad:
+                kv = jnp.pad(kv, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                vv = jnp.pad(vv, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            # [1, Nkv, n_blocks*bs, H] -> n_blocks x [Nkv, bs, H]
+            kv = kv.reshape(self._nkv, n_blocks, bs, self._head_dim).swapaxes(0, 1)
+            vv = vv.reshape(self._nkv, n_blocks, bs, self._head_dim).swapaxes(0, 1)
+            idx = jnp.asarray(blocks, jnp.int32)
+            self._kpools[li] = self._kpools[li].at[idx].set(kv.astype(self._kpools[li].dtype))
+            self._vpools[li] = self._vpools[li].at[idx].set(vv.astype(self._vpools[li].dtype))
+
+        slot.rid = rid
+        slot.active = True
+        slot.seq_len = s0
+        slot.max_len = max_len
+        slot.blocks = blocks
+        slot.last_token = first
+        slot.generated = [first]
+        self._results[rid] = slot.generated
+        if self.eos_token_id is not None and first == self.eos_token_id:
+            self._finish(slot)
+        elif slot.seq_len + 1 >= slot.max_len:
+            self._finish(slot)
+        return first
+
+    def _finish(self, slot):
+        self._results[slot.rid] = list(slot.generated)
+        self._release(slot)
+
+    # -------------------------------------------------------------- decode
+    def _build_step(self):
+        from paddle_tpu._core.autograd import no_grad
+        from paddle_tpu._core.tensor import Tensor
+        from paddle_tpu.models.llama import _decode_layer_paged
+
+        model = self.model
+        state = self._state
+
+        def step(state_vals, kpools, vpools, tokens, tables, lens):
+            originals = [t._value for t in state]
+            try:
+                for t, v in zip(state, state_vals):
+                    t._bind(v)
+                with no_grad():
+                    h = model.model.embed_tokens(Tensor(tokens))
+                    cos = model.model.rope_cos._value
+                    sin = model.model.rope_sin._value
+                    new_k, new_v = [], []
+                    for li, layer in enumerate(model.model.layers):
+                        h, kc, vc = _decode_layer_paged(
+                            layer, h, cos, sin, kpools[li], vpools[li], tables, lens
+                        )
+                        new_k.append(kc)
+                        new_v.append(vc)
+                    h = model.model.norm(h)
+                    logits = model._logits(h)
+                    nxt = jnp.argmax(logits._value[:, -1, :], axis=-1).astype(jnp.int32)
+                return nxt, new_k, new_v
+            finally:
+                for t, v in zip(state, originals):
+                    t._bind(v)
+
+        return jax.jit(step)
+
+    def step(self):
+        """One decode tick for every live request; returns {rid: token}."""
+        if not self.has_work():
+            return {}
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+
+        B, W = self.max_batch, self._max_blocks_per_seq
+        tokens = np.zeros((B, 1), np.int32)
+        tables = np.zeros((B, W), np.int32)
+        lens = np.ones((B,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s.active:
+                tokens[i, 0] = s.last_token
+                row = list(s.blocks) + [s.blocks[-1]] * (W - len(s.blocks))
+                tables[i] = row
+                lens[i] = s.seq_len + 1  # includes the token being decoded
+            else:
+                tables[i] = self._scratch[i]  # park masked lanes off-pool
+                lens[i] = 1
+
+        nxt, new_k, new_v = self._step_fn(
+            [t._value for t in self._state],
+            list(self._kpools), list(self._vpools),
+            jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(lens),
+        )
+        self._kpools = list(new_k)
+        self._vpools = list(new_v)
+        nxt = np.asarray(nxt)
+
+        out = {}
+        for i, s in enumerate(self._slots):
+            if not s.active:
+                continue
+            tok = int(nxt[i])
+            s.seq_len += 1
+            s.last_token = tok
+            s.generated.append(tok)
+            out[s.rid] = tok
+            if (self.eos_token_id is not None and tok == self.eos_token_id) or (
+                s.seq_len + 1 >= s.max_len
+            ):
+                self._finish(s)
+        return out

@@ -381,3 +381,50 @@ def test_breadth_early_return_before_branch():
     ref2 = fn(_t([1.0, 2.0]), False)
     got2 = to_static(fn)(_t([1.0, 2.0]), False)
     np.testing.assert_allclose(float(got2._value), float(ref2._value))
+
+
+def test_static_arg_type_disambiguation():
+    """1 / 1.0 / True are distinct trace-time constants (cache must not
+    collide them on python equality)."""
+    f = to_static(lambda x, n: x * n)
+    xi = paddle.to_tensor(np.int32([2, 3]))
+    out_int = f(xi, 1)
+    out_float = f(xi, 1.0)
+    assert str(out_int.dtype) != str(out_float.dtype), (out_int.dtype, out_float.dtype)
+
+
+def test_ndarray_args_are_dynamic_not_baked():
+    """Positional AND keyword ndarrays trace as dynamic inputs: new values
+    give new results (no stale baked constants), without recompiles."""
+    f = to_static(lambda x, w=None: (x * paddle.to_tensor(w)).sum())
+    x = paddle.to_tensor(np.float32([1.0, 1.0]))
+    a = np.float32([2.0, 2.0])
+    b = np.float32([5.0, 5.0])
+    assert float(f(x, w=a)._value) == 4.0
+    assert float(f(x, w=b)._value) == 10.0
+    assert len(f._cache) == 1  # same structure -> one compiled entry
+
+
+def test_shape_dependent_output_structure():
+    def fn(x):
+        return [x[i] for i in range(x.shape[0])]
+
+    f = to_static(fn)
+    out2 = f(paddle.to_tensor(np.float32([1, 2])))
+    assert len(out2) == 2
+    out3 = f(paddle.to_tensor(np.float32([1, 2, 3])))
+    assert len(out3) == 3
+
+
+def test_multi_output_tuple_and_grad():
+    def fn(x):
+        return (x * 2).sum(), (x ** 2).sum()
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.float32([1.0, 3.0]))
+    x.stop_gradient = False
+    a, b = f(x)
+    np.testing.assert_allclose(float(a._value), 8.0)
+    np.testing.assert_allclose(float(b._value), 10.0)
+    (a + b).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), [4.0, 8.0])
