@@ -140,10 +140,15 @@ class Fp16ProgramRewrite:
 
                 return wrapped
 
-            block.ops[i] = Operator(
+            clone = Operator(
                 "fp16::" + op.type, make(op.fn), op.arg_spec, op.kwargs,
                 op.out_vids, op.out_tree,
             )
+            # later fusion patterns read this to keep their replacement
+            # kernels in the low dtype (the type prefix alone doesn't say
+            # WHICH low dtype was chosen)
+            clone.fp16_low = low
+            block.ops[i] = clone
             n += 1
         if n:
             program.version += 1

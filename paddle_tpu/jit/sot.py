@@ -38,6 +38,7 @@ happen in the outermost frame; `while` over symbolic predicates falls back
 from __future__ import annotations
 
 import dis
+import sys
 import types
 
 import jax
@@ -391,7 +392,10 @@ class _Interpreter:
             return idx + 1
         if op == "LOAD_ATTR":
             obj = st.pop()
-            if getattr(inst, "arg", 0) & 1:  # 3.12 method-load bit
+            # the low method-load bit exists only in 3.12's LOAD_ATTR
+            # encoding; on 3.11 the arg is a raw name index and testing it
+            # would corrupt the stack on odd indices
+            if sys.version_info >= (3, 12) and (getattr(inst, "arg", 0) & 1):
                 attr = self._call(getattr, (obj, inst.argval))
                 st.append(attr)
                 st.append(None)  # self_or_null slot consumed by CALL
@@ -598,7 +602,10 @@ class SOTFunction:
         try:
             interp = _Interpreter(self._fn, args, kwargs)
             result, capture = interp.run()
-        except Unsupported:
+        except Exception:
+            # never-crash contract: modeled Unsupported constructs AND any
+            # interpreter defect fall back to eager; a genuine user error
+            # reproduces in the eager run with its real traceback
             self._eager_only.add(sig)
             _STATS["fallbacks"] += 1
             return self._fn(*args, **kwargs)

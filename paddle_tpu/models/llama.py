@@ -127,12 +127,21 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
         sep_ax = None
-        if getattr(self, "_sep_mode", None) and kv_cache is None and attn_mask is None:
+        if getattr(self, "_sep_mode", None):
             # one gate for BOTH the rope offset and the attention branch:
             # rope offsets and ring exchange must engage together
             from paddle_tpu.distributed.communication import current_axis_scope
 
-            sep_ax = current_axis_scope().get("sep")
+            ax = current_axis_scope().get("sep")
+            if ax is not None and (attn_mask is not None or kv_cache is not None):
+                # silently skipping the sep path would make each rank compute
+                # plain local attention with offset-0 rope -> wrong logits
+                raise ValueError(
+                    "context-parallel ('sep') attention supports neither "
+                    "attn_mask nor kv_cache: drop them inside the sep axis "
+                    "scope, or run this layer without context parallelism"
+                )
+            sep_ax = ax
         if sep_ax is not None:
             # sequence sharded over 'sep': this shard's tokens sit at global
             # positions rank*s .. rank*s + s, so the rope tables must be

@@ -112,7 +112,20 @@ class Optimizer:
                 if isinstance(g, SelectedRows):
                     # lazy row update (reference adam_functors.h lazy_mode):
                     # only the looked-up rows are touched; master-weight and
-                    # L2 interplay stay dense-path-only by design
+                    # L2 interplay stay dense-path-only by design — surface
+                    # that divergence once instead of silently skipping it
+                    if (self._weight_decay or p._value.dtype in (jnp.bfloat16, jnp.float16)) \
+                            and not getattr(self, "_warned_sparse_path", False):
+                        import warnings
+
+                        self._warned_sparse_path = True
+                        warnings.warn(
+                            f"SelectedRows sparse update for {p.name!r}: "
+                            "weight_decay and fp32 master weights apply only "
+                            "on the dense path; the sparse rows are updated "
+                            "without regularization/master-weight handling",
+                            stacklevel=2,
+                        )
                     new_val = self._sparse_update(p, g.coalesce(), lr)
                     p._bind(new_val.astype(p._value.dtype))
                     continue

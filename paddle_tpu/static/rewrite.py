@@ -34,6 +34,14 @@ __all__ = [
 ]
 
 
+def _base_type(t):
+    """Strip pass-inserted namespaces ('fp16::matmul' -> 'matmul') so
+    patterns still anchor after the fp16 program rewrite has run — the
+    rewrite order (user-applied fp16 pass, then the Executor's default
+    fusion pass) would otherwise silently defeat every substitution."""
+    return t.rsplit("::", 1)[-1]
+
+
 def _const_scalar(spec):
     """('const', v) -> python float if v is a scalar, else None."""
     if spec[0] != "const":
@@ -106,7 +114,7 @@ class ProgramGraph:
         op = self.producer.get(vid)
         if op is None:
             return None
-        if type_ is not None and op.type != type_:
+        if type_ is not None and _base_type(op.type) != type_:
             return None
         return op
 
@@ -147,7 +155,7 @@ class PatternRewritePass:
             changed = 0
             for op in list(graph.block.ops):
                 for pat in self._patterns:
-                    if pat.root_type is not None and op.type != pat.root_type:
+                    if pat.root_type is not None and _base_type(op.type) != pat.root_type:
                         continue
                     if op not in graph.block.ops:
                         break  # already replaced this round
@@ -231,16 +239,16 @@ class FlashAttentionPattern(RewritePattern):
             var_ins = [s for s in cur.arg_spec if s[0] == "var"]
             consts = [s for s in cur.arg_spec if s[0] == "const"]
             if (
-                cur.type in ("divide", "multiply")
+                _base_type(cur.type) in ("divide", "multiply")
                 and len(var_ins) == 1
                 and len(consts) == 1
                 and _const_scalar(consts[0]) is not None
                 and scale is None
             ):
                 c = _const_scalar(consts[0])
-                scale = (1.0 / c) if cur.type == "divide" else c
+                scale = (1.0 / c) if _base_type(cur.type) == "divide" else c
             elif (
-                cur.type == "add"
+                _base_type(cur.type) == "add"
                 and len(var_ins) == 1
                 and len(consts) == 1
                 and not causal
@@ -254,7 +262,7 @@ class FlashAttentionPattern(RewritePattern):
                 return False
             cur = graph.def_op(cur_vid)
         qk = cur
-        if qk is None or qk.type != "matmul":
+        if qk is None or _base_type(qk.type) != "matmul":
             return False
         if len(qk.arg_spec) != 2 or any(s[0] != "var" for s in qk.arg_spec):
             return False
@@ -272,18 +280,37 @@ class FlashAttentionPattern(RewritePattern):
         if scale is None:
             scale = 1.0  # plain matmul softmax: no 1/sqrt(d) in source
 
+        # matched through fp16::-wrapped matmuls (fp16 pass ran first):
+        # keep the low-dtype compute the user asked for — downcast fp32
+        # inputs, run the kernel there, upcast the result back, exactly
+        # Fp16ProgramRewrite's contract
+        low = getattr(op, "fp16_low", None) or getattr(qk, "fp16_low", None)
+
         def fused(q, k, v):
             from paddle_tpu.ops import flash_attention
 
+            downcast = False
+            if low is not None:
+                ins = []
+                for t in (q, k, v):
+                    if t.dtype == jnp.float32:
+                        ins.append(t.astype(low))
+                        downcast = True
+                    else:
+                        ins.append(t)
+                q, k, v = ins
             if not k_transposed:
                 k = jnp.swapaxes(k, -1, -2)
             qt = jnp.swapaxes(q, 1, 2)  # [B,N,S,D] -> kernel's [B,S,N,D]
             kt = jnp.swapaxes(k, 1, 2)
             vt = jnp.swapaxes(v, 1, 2)
             o = flash_attention(qt, kt, vt, scale=scale, causal=causal)
+            if downcast and o.dtype == low:
+                o = o.astype(jnp.float32)
             return jnp.swapaxes(o, 1, 2)
 
-        graph.replace_op(op, _make_op("flash_attention", fused, [q_vid, k_vid, v_vid], op))
+        new_type = "flash_attention" if low is None else "fp16::flash_attention"
+        graph.replace_op(op, _make_op(new_type, fused, [q_vid, k_vid, v_vid], op))
         return True
 
 
@@ -308,11 +335,11 @@ class RMSNormPattern(RewritePattern):
         sq = graph.def_op(sq_vid)
         if sq is None:
             return False
-        if sq.type == "square":
+        if _base_type(sq.type) == "square":
             return sq.arg_spec[0] == ("var", x_vid)
-        if sq.type in ("multiply", "pow"):
+        if _base_type(sq.type) in ("multiply", "pow"):
             vids = [s[1] for s in sq.arg_spec if s[0] == "var"]
-            if sq.type == "multiply":
+            if _base_type(sq.type) == "multiply":
                 return vids == [x_vid, x_vid]
             c = next((_const_scalar(s) for s in sq.arg_spec if s[0] == "const"), None)
             return vids == [x_vid] and c == 2.0

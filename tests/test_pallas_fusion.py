@@ -243,3 +243,42 @@ def test_non_causal_arbitrary_mask_blocks_fusion():
     n = PallasFusionPass([out._vid]).apply(prog)
     assert n == 0
     assert "flash_attention" not in _optypes(prog)
+
+
+def test_fp16_rewrite_then_fusion_still_substitutes_in_low_dtype():
+    """ADVICE r3: the fp16 program rewrite renames matmul -> fp16::matmul;
+    the fusion pass must still anchor, and the substituted flash kernel must
+    keep the low-dtype compute the user asked for (fp16::flash_attention)."""
+    from paddle_tpu.static.passes import apply_pass
+
+    rng = np.random.default_rng(1)
+    B, N, S, D, H, F_ = 2, 4, 128, 16, 32, 64
+    feed = {
+        "q": rng.normal(size=(B, N, S, D)).astype(np.float32),
+        "k": rng.normal(size=(B, N, S, D)).astype(np.float32),
+        "v": rng.normal(size=(B, N, S, D)).astype(np.float32),
+        "x": rng.normal(size=(B, S, H)).astype(np.float32),
+        "w": rng.normal(size=(H,)).astype(np.float32),
+        "g": rng.normal(size=(B, S, F_)).astype(np.float32),
+        "u": rng.normal(size=(B, S, F_)).astype(np.float32),
+    }
+    prog, fetches = _capture_vanilla()
+    ref_exe = static.Executor()
+    paddle.set_flags({"FLAGS_use_pallas_fusion": False})
+    try:
+        ref = ref_exe.run(prog, feed=feed, fetch_list=list(fetches))
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
+
+    prog2, fetches2 = _capture_vanilla()
+    n16 = apply_pass(prog2, "auto_parallel_fp16", dtype="bfloat16")
+    assert n16 >= 2  # both attention matmuls rewritten
+    assert "fp16::matmul" in _optypes(prog2)
+    n = PallasFusionPass([f._vid for f in fetches2]).apply(prog2)
+    assert n == 3, f"fusion defeated after fp16 rewrite: {_optypes(prog2)}"
+    assert "fp16::flash_attention" in _optypes(prog2)  # low dtype preserved
+    exe = static.Executor()
+    got = exe.run(prog2, feed=feed, fetch_list=list(fetches2))
+    # bf16-tolerance match against the fp32 unfused program
+    for r, g_ in zip(ref, got):
+        np.testing.assert_allclose(r, g_, rtol=3e-2, atol=3e-2)
