@@ -20,19 +20,31 @@ SOT-specific machinery here is exactly what plain tracing cannot do:
 - **graph breaks**: a jump conditioned on a symbolic tensor ends the
   current segment — the segment executes for real, the predicate becomes a
   concrete bool, and capture resumes in a fresh segment (the reference's
-  BreakGraph + resume-function mechanism, trace-tree-ified);
+  BreakGraph + resume-function mechanism, trace-tree-ified); `while` over a
+  symbolic predicate breaks per check, exactly like the reference's
+  per-iteration break;
+- **callee inlining**: plain-Python user functions, methods and hook-free
+  nn.Layer forwards are interpreted in their own frame on an explicit
+  frame stack (the reference's OpcodeInlineExecutor,
+  python/paddle/jit/sot/opcode_translator/executor/opcode_inline_executor.py:1),
+  so guards compose and graph breaks propagate at ANY call depth; a callee
+  whose bytecode pre-scan shows unsupported constructs simply executes
+  natively instead (safe: the decision is made before any side effect);
 - **guards**: captures are cached per input signature (tensor
   shapes/dtypes + hashable python args) and per branch-decision path; a
   guard miss re-traces instead of mis-replaying;
 - **fallback**: an unsupported opcode or a construct the interpreter
-  cannot model (e.g. a callee branching on a symbolic tensor internally)
-  marks the signature eager-only and runs the original function — never a
-  crash (`opcode_executor.py`'s fallback-to-dygraph contract).
+  cannot model marks the signature eager-only and runs the original
+  function — never a crash (`opcode_executor.py`'s fallback-to-dygraph
+  contract).
 
 Scope notes vs the reference's 32k-LoC tier (documented limits, not bugs):
-calls are executed natively rather than inlined, so a graph break can only
-happen in the outermost frame; `while` over symbolic predicates falls back
-(the reference breaks per-iteration); cell/global STORE falls back.
+framework internals (paddle_tpu.*, jax, numpy) always execute natively —
+they are designed to run on symbolic Variables through the apply() funnel,
+so inlining them would only add interpreter surface; cell/global STORE
+falls back; inlined-callee globals/closures are not guarded (rebinding a
+helper between calls without changing the input signature replays the old
+capture — same exposure as the natively-called design).
 """
 
 from __future__ import annotations
@@ -55,7 +67,8 @@ class Unsupported(Exception):
     """Internal: opcode/construct outside the supported subset."""
 
 
-_STATS = {"captures": 0, "graph_breaks": 0, "fallbacks": 0, "replays": 0}
+_STATS = {"captures": 0, "graph_breaks": 0, "fallbacks": 0, "replays": 0,
+          "inlines": 0}
 
 
 def sot_stats():
@@ -140,40 +153,177 @@ def _is_symbolic(v):
     return isinstance(v, Variable)
 
 
-class _Interpreter:
-    """Symbolically executes one function call, recording tensor work into
-    Programs and breaking the graph at tensor-valued branches."""
+# opnames _step models; a callee is inline-eligible only when every
+# instruction of its code object is in this set (pre-scan, decided BEFORE
+# execution so a "no" costs nothing and has no side effects)
+_SUPPORTED_OPS = frozenset({
+    "RESUME", "NOP", "PRECALL", "CACHE", "MAKE_CELL", "COPY_FREE_VARS",
+    "PUSH_EXC_INFO", "END_FOR", "POP_TOP", "COPY", "SWAP", "PUSH_NULL",
+    "LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_AND_CLEAR", "STORE_FAST",
+    "DELETE_FAST", "LOAD_CONST", "RETURN_CONST", "RETURN_VALUE",
+    "LOAD_GLOBAL", "LOAD_DEREF", "LOAD_ATTR", "LOAD_METHOD", "KW_NAMES",
+    "CALL", "BINARY_OP", "UNARY_NEGATIVE", "UNARY_NOT", "UNARY_INVERT",
+    "UNARY_POSITIVE", "COMPARE_OP", "IS_OP", "CONTAINS_OP",
+    "BINARY_SUBSCR", "BINARY_SLICE", "BUILD_SLICE", "BUILD_TUPLE", "BUILD_LIST",
+    "BUILD_MAP", "BUILD_SET", "BUILD_CONST_KEY_MAP", "LIST_EXTEND", "LIST_APPEND",
+    "SET_ADD", "MAP_ADD", "UNPACK_SEQUENCE", "POP_JUMP_IF_FALSE",
+    "POP_JUMP_IF_TRUE", "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE",
+    "JUMP_FORWARD", "JUMP_BACKWARD", "JUMP_BACKWARD_NO_INTERRUPT",
+    "GET_ITER", "FOR_ITER",
+})
 
-    def __init__(self, fn, args, kwargs):
+_INLINE_MAX_DEPTH = 12
+
+# CO_GENERATOR | CO_COROUTINE | CO_ASYNC_GENERATOR | CO_ITERABLE_COROUTINE
+_NON_PLAIN_FLAGS = 0x20 | 0x80 | 0x200 | 0x100
+
+_UNBOUND = object()  # LOAD_FAST_AND_CLEAR's NULL stand-in
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=4096)
+def _code_info(code):
+    """(instructions, offset->index) for a code object, computed once —
+    the same Block.forward is inlined per layer per trace."""
+    instructions = tuple(dis.get_instructions(code))
+    by_offset = {i.offset: idx for idx, i in enumerate(instructions)}
+    return instructions, by_offset
+
+
+@_functools.lru_cache(maxsize=4096)
+def _prescan_code(code):
+    if code.co_flags & _NON_PLAIN_FLAGS:
+        return False
+    return all(i.opname in _SUPPORTED_OPS for i in _code_info(code)[0])
+
+
+def _prescan_ok(fn):
+    return _prescan_code(fn.__code__)
+
+
+def _inline_target(func):
+    """Resolve a callee to (plain_function, prepended_args) when it is
+    inline-ELIGIBLE; None -> execute natively.  User code and hook-free
+    Layer forwards are inlined; framework internals (paddle_tpu.*, jax,
+    numpy, builtins) run natively — they are designed to execute on
+    symbolic Variables through the apply() funnel."""
+    prepend = []
+    if isinstance(func, types.MethodType):
+        prepend = [func.__self__]
+        func = func.__func__
+    if not isinstance(func, types.FunctionType):
+        # a hook-free nn.Layer instance: calling it == calling forward
+        # (layers.py __call__ is exactly pre-hooks -> forward -> post-hooks)
+        # — but ONLY when the subclass did not override __call__ or shadow
+        # forward on the instance; custom __call__ bodies must run natively
+        try:
+            from paddle_tpu.nn import Layer as _Layer
+        except ImportError:
+            return None
+        fwd = getattr(type(func), "forward", None)
+        if (
+            isinstance(func, _Layer)
+            and type(func).__call__ is _Layer.__call__
+            and "forward" not in vars(func)
+            and fwd is not None
+            and isinstance(fwd, types.FunctionType)
+            and not getattr(func, "_forward_pre_hooks", True)
+            and not getattr(func, "_forward_post_hooks", True)
+        ):
+            prepend = [func]
+            func = fwd
+        else:
+            return None
+    mod = getattr(func, "__module__", "") or ""
+    root = mod.split(".", 1)[0]
+    if root in ("paddle_tpu", "jax", "jaxlib", "numpy", "builtins") and not mod.startswith(
+        "paddle_tpu.models"
+    ):
+        # model-zoo forwards are user-shaped code and benefit from breaks
+        # at depth; everything else framework-side stays native
+        return None
+    return func, prepend
+
+
+def _bind_args(fn, args, kwargs):
+    """Full CPython binding (defaults, kw-only, *args/**kwargs) -> locals
+    dict keyed like co_varnames.  Unsupported on any mismatch."""
+    import inspect
+
+    try:
+        # follow_wrapped=False: we interpret THIS code object, so bind
+        # against its own signature, not a functools.wraps'd original
+        sig = inspect.Signature.from_callable(fn, follow_wrapped=False)
+        ba = sig.bind(*args, **kwargs)
+        ba.apply_defaults()
+    except (TypeError, ValueError) as e:
+        raise Unsupported(f"cannot bind arguments for {fn.__name__!r}: {e}") from e
+    return dict(ba.arguments)
+
+
+def _entry_tensor_list(fn, args, kwargs):
+    """Top-level Tensor arguments in PARAMETER-DECLARATION order — the
+    exact order the tracer's first segment uses for its feeds.  Replay must
+    bind identically or keyword calls pair the wrong tensors."""
+    from paddle_tpu._core.tensor import Tensor
+
+    if isinstance(fn, types.MethodType):
+        args = (fn.__self__,) + tuple(args)
+        fn = fn.__func__
+    loc = _bind_args(fn, args, kwargs)
+    return [v for v in loc.values() if isinstance(v, Tensor)]
+
+
+class _Frame:
+    """One interpreted call frame (reference OpcodeInlineExecutor keeps the
+    same per-frame state on its executor objects)."""
+
+    __slots__ = ("fn", "code", "instructions", "by_offset", "globals",
+                 "builtins", "closure", "locals", "stack", "kw_names", "idx")
+
+    def __init__(self, fn, local_vars):
         self.fn = fn
         self.code = fn.__code__
-        self.instructions = list(dis.get_instructions(self.code))
-        self.by_offset = {i.offset: idx for idx, i in enumerate(self.instructions)}
+        self.instructions, self.by_offset = _code_info(self.code)
         self.globals = fn.__globals__
-        self.builtins = fn.__globals__.get("__builtins__", __builtins__)
-        if isinstance(self.builtins, types.ModuleType):
-            self.builtins = self.builtins.__dict__
+        b = fn.__globals__.get("__builtins__", __builtins__)
+        if isinstance(b, types.ModuleType):
+            b = b.__dict__
+        self.builtins = b
         self.closure = {}
         if fn.__closure__:
             for name, cell in zip(self.code.co_freevars, fn.__closure__):
-                self.closure[name] = cell.cell_contents
+                try:
+                    self.closure[name] = cell.cell_contents
+                except ValueError:  # empty cell
+                    pass
+        self.locals = local_vars
+        self.stack: list = []
+        self.kw_names = ()
+        self.idx = 0
 
-        # bind arguments to locals
+
+class _Interpreter:
+    """Symbolically executes one function call, recording tensor work into
+    Programs and breaking the graph at tensor-valued branches.  Callees are
+    inlined as frames on an explicit stack when eligible, so breaks work at
+    any depth."""
+
+    def __init__(self, fn, args, kwargs):
         from paddle_tpu._core.tensor import Tensor
 
-        names = self.code.co_varnames
-        self.locals: dict[str, object] = {}
-        bound = list(args)
-        for i, v in enumerate(bound):
-            self.locals[names[i]] = v
-        for k, v in kwargs.items():
-            self.locals[k] = v
-
-        self.stack: list = []
+        if isinstance(fn, types.MethodType):  # e.g. model.forward
+            args = (fn.__self__,) + tuple(args)
+            fn = fn.__func__
+        self.fn = fn
+        root = _Frame(fn, _bind_args(fn, args, kwargs))
+        self.frames: list[_Frame] = [root]
         self.segments: list[_Segment] = []
         self.decisions: list[bool] = []
         self._tensor_inputs = [
-            (k, v) for k, v in self.locals.items() if isinstance(v, Tensor)
+            (k, v) for k, v in root.locals.items() if isinstance(v, Tensor)
         ]
 
     # ---------------------------------------------------------- segments
@@ -196,14 +346,73 @@ class _Interpreter:
         self._open_feed_vars = feed_vars
         return mapping
 
+    def _all_slots(self):
+        """Every value reachable from any frame's locals or stack."""
+        out = []
+        for fr in self.frames:
+            out.extend(fr.locals.values())
+            out.extend(fr.stack)
+        return out
+
+    @staticmethod
+    def _deep_leaves(v, out, seen):
+        """Collect leaves through list/tuple/dict containers (model code
+        holds tensors in lists across breaks: `outs.append(layer(x))`)."""
+        if isinstance(v, (list, tuple, set, frozenset)):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            for e in v:
+                _Interpreter._deep_leaves(e, out, seen)
+        elif isinstance(v, dict):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            for e in v.values():
+                _Interpreter._deep_leaves(e, out, seen)
+        else:
+            out.append(v)
+
+    @staticmethod
+    def _deep_replace(v, repl, seen):
+        """Apply `repl` to leaves through containers; lists/dicts mutate in
+        place (aliases stay consistent), tuples rebuild."""
+        if isinstance(v, list):
+            if id(v) not in seen:
+                seen.add(id(v))
+                for i, e in enumerate(v):
+                    v[i] = _Interpreter._deep_replace(e, repl, seen)
+            return v
+        if isinstance(v, tuple):
+            return tuple(_Interpreter._deep_replace(e, repl, seen) for e in v)
+        if isinstance(v, set):
+            if id(v) not in seen:
+                seen.add(id(v))
+                new = {_Interpreter._deep_replace(e, repl, set()) for e in v}
+                v.clear()
+                v.update(new)
+            return v
+        if isinstance(v, frozenset):
+            return frozenset(_Interpreter._deep_replace(e, repl, set()) for e in v)
+        if isinstance(v, dict):
+            if id(v) not in seen:
+                seen.add(id(v))
+                for k, e in list(v.items()):
+                    v[k] = _Interpreter._deep_replace(e, repl, seen)
+            return v
+        return repl(v)
+
     def _close_segment(self, extra_fetch=()):
-        """Fetch all live symbolic values (locals + stack + extras), execute
-        the recorded program, and substitute concrete Tensors back."""
+        """Fetch all live symbolic values (every frame's locals + stack +
+        extras), execute the recorded program, and substitute concrete
+        Tensors back across all frames."""
         from paddle_tpu.static.executor import Executor
 
+        leaves: list = []
+        self._deep_leaves(self._all_slots() + list(extra_fetch), leaves, set())
         live = []
         seen = set()
-        for v in list(self.locals.values()) + list(self.stack) + list(extra_fetch):
+        for v in leaves:
             if _is_symbolic(v) and id(v) not in seen:
                 seen.add(id(v))
                 live.append(v)
@@ -224,8 +433,10 @@ class _Interpreter:
         def replace(x):
             return subst[id(x)] if _is_symbolic(x) and id(x) in subst else x
 
-        self.locals = {k: replace(v) for k, v in self.locals.items()}
-        self.stack = [replace(v) for v in self.stack]
+        rseen: set = set()
+        for fr in self.frames:
+            fr.locals = {k: self._deep_replace(v, replace, rseen) for k, v in fr.locals.items()}
+            fr.stack = [self._deep_replace(v, replace, rseen) for v in fr.stack]
         return seg, [replace(v) for v in extra_fetch]
 
     # --------------------------------------------------------------- run
@@ -236,26 +447,28 @@ class _Interpreter:
         from paddle_tpu._core.tensor import Tensor
 
         # first segment: all tensor arguments become feeds
+        root = self.frames[0]
         mapping = self._begin_segment([t for _, t in self._tensor_inputs])
         for k, t in self._tensor_inputs:
-            self.locals[k] = mapping[id(t)]
+            root.locals[k] = mapping[id(t)]
 
         guard = contextlib.ExitStack()
         guard.enter_context(program_guard(self._prog))
         try:
-            idx = 0
             fuel = 200_000  # runaway-interpretation bound, shared across breaks
             while True:
                 fuel -= 1
                 if fuel <= 0:
                     raise Unsupported("interpretation exceeded the fuel bound")
-                inst = self.instructions[idx]
+                f = self.frames[-1]
+                inst = f.instructions[f.idx]
                 try:
-                    nxt = self._step(inst, idx)
+                    nxt = self._step(f, inst)
                 except GraphBreak:
                     # predicate on top of stack is symbolic: end segment,
-                    # concretize, take the branch on the real value
-                    pred = self.stack.pop()
+                    # concretize, take the branch on the real value — the
+                    # breaking frame may be ANY depth of inlined callee
+                    pred = f.stack.pop()
                     _STATS["graph_breaks"] += 1
                     guard.close()
                     seg, (pred_t,) = self._close_segment(extra_fetch=(pred,))
@@ -268,9 +481,12 @@ class _Interpreter:
                         jump = not taken
                     else:
                         raise Unsupported(f"symbolic predicate at {op}")
-                    # new segment seeded from the concrete live set
+                    # new segment seeded from the concrete live set of
+                    # every frame (containers included)
+                    leaves: list = []
+                    self._deep_leaves(self._all_slots(), leaves, set())
                     dedup, seen = [], set()
-                    for v in list(self.locals.values()) + list(self.stack):
+                    for v in leaves:
                         if isinstance(v, Tensor) and not _is_symbolic(v) and id(v) not in seen:
                             seen.add(id(v))
                             dedup.append(v)
@@ -279,17 +495,28 @@ class _Interpreter:
                     def replace(x):
                         return mapping.get(id(x), x) if isinstance(x, Tensor) else x
 
-                    self.locals = {k: replace(v) for k, v in self.locals.items()}
-                    self.stack = [replace(v) for v in self.stack]
+                    rseen: set = set()
+                    for fr in self.frames:
+                        fr.locals = {k: self._deep_replace(v, replace, rseen)
+                                     for k, v in fr.locals.items()}
+                        fr.stack = [self._deep_replace(v, replace, rseen)
+                                    for v in fr.stack]
                     guard = contextlib.ExitStack()
                     guard.enter_context(program_guard(self._prog))
-                    idx = self.by_offset[inst.argval] if jump else idx + 1
+                    f.idx = f.by_offset[inst.argval] if jump else f.idx + 1
                     continue
+                if nxt == "PUSHED":
+                    continue  # a callee frame was inlined; resume there
                 if nxt == "RETURN":
+                    ret = f.stack.pop()
+                    if len(self.frames) > 1:
+                        self.frames.pop()
+                        self.frames[-1].stack.append(ret)
+                        continue
                     guard.close()
                     guard = None
-                    return self._finish(self.stack.pop())
-                idx = nxt
+                    return self._finish(ret)
+                f.idx = nxt
         finally:
             if guard is not None:
                 guard.close()
@@ -336,9 +563,10 @@ class _Interpreter:
             raise Unsupported(f"call to {getattr(func, '__name__', func)!r} failed "
                               f"under symbolic execution: {e}") from e
 
-    def _step(self, inst, idx):
+    def _step(self, f, inst):
         op = inst.opname
-        st = self.stack
+        st = f.stack
+        idx = f.idx
 
         if op in ("RESUME", "NOP", "PRECALL", "CACHE", "MAKE_CELL", "COPY_FREE_VARS",
                   "PUSH_EXC_INFO", "END_FOR"):
@@ -356,15 +584,22 @@ class _Interpreter:
             st.append(None)
             return idx + 1
         if op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
-            if inst.argval not in self.locals:
+            if inst.argval not in f.locals:
                 raise Unsupported(f"unbound local {inst.argval}")
-            st.append(self.locals[inst.argval])
+            st.append(f.locals[inst.argval])
+            return idx + 1
+        if op == "LOAD_FAST_AND_CLEAR":  # 3.12 inlined comprehensions
+            st.append(f.locals.pop(inst.argval, _UNBOUND))
             return idx + 1
         if op == "STORE_FAST":
-            self.locals[inst.argval] = st.pop()
+            v = st.pop()
+            if v is _UNBOUND:  # restoring a cleared, previously-unbound slot
+                f.locals.pop(inst.argval, None)
+            else:
+                f.locals[inst.argval] = v
             return idx + 1
         if op == "DELETE_FAST":
-            self.locals.pop(inst.argval, None)
+            f.locals.pop(inst.argval, None)
             return idx + 1
         if op in ("LOAD_CONST",):
             st.append(inst.argval)
@@ -378,17 +613,21 @@ class _Interpreter:
             name = inst.argval
             if inst.arg & 1:  # 3.11+: low bit = push NULL before the global
                 st.append(None)
-            if name in self.globals:
-                st.append(self.globals[name])
-            elif name in self.builtins:
-                st.append(self.builtins[name])
+            if name in f.globals:
+                st.append(f.globals[name])
+            elif name in f.builtins:
+                st.append(f.builtins[name])
             else:
                 raise Unsupported(f"unresolvable global {name}")
             return idx + 1
         if op == "LOAD_DEREF":
-            if inst.argval not in self.closure:
+            if inst.argval in f.closure:
+                st.append(f.closure[inst.argval])
+            elif inst.argval in f.locals:
+                # MAKE_CELL'd local (a cellvar) reads through locals here
+                st.append(f.locals[inst.argval])
+            else:
                 raise Unsupported(f"unbound closure cell {inst.argval}")
-            st.append(self.closure[inst.argval])
             return idx + 1
         if op == "LOAD_ATTR":
             obj = st.pop()
@@ -411,12 +650,12 @@ class _Interpreter:
             st.append(self._call(getattr, (obj, inst.argval)))
             return idx + 1
         if op == "KW_NAMES":
-            self._kw_names = inst.argval
+            f.kw_names = inst.argval
             return idx + 1
         if op == "CALL":
             nargs = inst.arg
-            kw_names = getattr(self, "_kw_names", ())
-            self._kw_names = ()
+            kw_names = f.kw_names
+            f.kw_names = ()
             args = [st.pop() for _ in range(nargs)][::-1]
             kwargs = {}
             if kw_names:
@@ -432,6 +671,23 @@ class _Interpreter:
                 func = a
             else:
                 func, args = b, [a] + args  # (callable, self)
+
+            # inline-eligible callee: interpret it in its own frame so
+            # graph breaks inside it propagate instead of poisoning the
+            # whole signature (reference opcode_inline_executor.py)
+            if len(self.frames) < _INLINE_MAX_DEPTH:
+                target = _inline_target(func)
+                if target is not None and _prescan_ok(target[0]):
+                    tfn, prepend = target
+                    try:
+                        loc = _bind_args(tfn, prepend + args, kwargs)
+                    except Unsupported:
+                        loc = None  # odd binding: run it natively instead
+                    if loc is not None:
+                        f.idx = idx + 1  # resume here after the callee returns
+                        self.frames.append(_Frame(tfn, loc))
+                        _STATS["inlines"] += 1
+                        return "PUSHED"
             st.append(self._call(func, args, kwargs))
             return idx + 1
         if op == "BINARY_OP":
@@ -474,6 +730,10 @@ class _Interpreter:
             b, a = st.pop(), st.pop()
             st.append(self._call(lambda x, i: x[i], (a, b)))
             return idx + 1
+        if op == "BINARY_SLICE":  # 3.12: x[a:b] without BUILD_SLICE
+            stop, start, obj = st.pop(), st.pop(), st.pop()
+            st.append(self._call(lambda o, a, b: o[a:b], (obj, start, stop)))
+            return idx + 1
         if op == "BUILD_SLICE":
             if inst.arg == 3:
                 c, b, a = st.pop(), st.pop(), st.pop()
@@ -503,6 +763,23 @@ class _Interpreter:
             seq = st.pop()
             st[-inst.arg].extend(seq)
             return idx + 1
+        if op == "LIST_APPEND":  # 3.12 inlined comprehensions
+            v = st.pop()
+            st[-inst.arg].append(v)
+            return idx + 1
+        if op == "SET_ADD":
+            v = st.pop()
+            st[-inst.arg].add(v)
+            return idx + 1
+        if op == "MAP_ADD":
+            v = st.pop()
+            k = st.pop()
+            st[-inst.arg][k] = v
+            return idx + 1
+        if op == "BUILD_SET":
+            vals = [st.pop() for _ in range(inst.arg)][::-1]
+            st.append(set(vals))
+            return idx + 1
         if op == "UNPACK_SEQUENCE":
             seq = st.pop()
             if _is_symbolic(seq):
@@ -519,14 +796,14 @@ class _Interpreter:
                 raise GraphBreak()
             pred = st.pop()
             take = bool(pred) if op == "POP_JUMP_IF_TRUE" else not bool(pred)
-            return self.by_offset[inst.argval] if take else idx + 1
+            return f.by_offset[inst.argval] if take else idx + 1
         if op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
             pred = st.pop()
             is_none = pred is None
             take = is_none if op == "POP_JUMP_IF_NONE" else not is_none
-            return self.by_offset[inst.argval] if take else idx + 1
+            return f.by_offset[inst.argval] if take else idx + 1
         if op in ("JUMP_FORWARD", "JUMP_BACKWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
-            return self.by_offset[inst.argval]
+            return f.by_offset[inst.argval]
         if op == "GET_ITER":
             a = st.pop()
             if _is_symbolic(a):
@@ -541,7 +818,7 @@ class _Interpreter:
             except StopIteration:
                 # 3.12: jump target is END_FOR; leave iterator for END_FOR
                 st.append(None)
-                tgt = self.by_offset[inst.argval]
+                tgt = f.by_offset[inst.argval]
                 # emulate END_FOR's double pop here and skip past it
                 st.pop()
                 st.pop()
@@ -621,8 +898,10 @@ class SOTFunction:
         from paddle_tpu._core.tensor import Tensor
 
         exe = Executor()
-        tensors = [v for v in list(args) + [kwargs[k] for k in sorted(kwargs)]
-                   if isinstance(v, Tensor)]
+        try:
+            tensors = _entry_tensor_list(self._fn, args, kwargs)
+        except Unsupported:
+            return _MISS
         decisions: list[bool] = []
         carry = tensors
         seg_i = 0
