@@ -29,8 +29,17 @@ import jax
 from paddle_tpu._core import flags as _flags
 
 _flags.define_flag("FLAGS_use_pallas", "auto", "auto|true|false — Pallas kernel dispatch")
-_flags.define_flag("FLAGS_flash_block_q", 128, "flash attention q-block rows (MXU tile multiple)")
-_flags.define_flag("FLAGS_flash_block_k", 128, "flash attention k-block rows (MXU tile multiple)")
+_flags.define_flag("FLAGS_flash_block_q", 0,
+                   "flash attention q-block rows override; 0 = consult the "
+                   "autotune cache, then the 128 default")
+_flags.define_flag("FLAGS_flash_block_k", 0,
+                   "flash attention k-block rows override; 0 = consult the "
+                   "autotune cache, then the 128 default")
+_flags.define_flag("FLAGS_use_autotune_cache", True,
+                   "consult ops/tuned/<device_kind>.json for Pallas tile configs")
+_flags.define_flag("FLAGS_autotune_cache_dir", "",
+                   "where `python -m paddle_tpu.ops.autotune` saves tuned tiles "
+                   "(empty = the package's ops/tuned/ seed directory)")
 
 
 def use_pallas() -> bool:

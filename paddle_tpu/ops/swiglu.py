@@ -20,20 +20,33 @@ def _swiglu_kernel(x_ref, y_ref, o_ref):
     o_ref[:] = (x * jax.nn.sigmoid(x) * y).astype(o_ref.dtype)
 
 
-def _swiglu_apply(x2d, y2d):
+def _swiglu_apply(x2d, y2d, rows_block=None, cols_block=None):
     rows, cols = x2d.shape
-    br = min(256, rows)
+    br, bc = rows_block, cols_block
+    if br is None or bc is None:
+        # autotune cache first (per device kind; ops/autotune.py)
+        from paddle_tpu.ops import autotune as _at
+
+        tuned = _at.lookup("swiglu", {"rows": rows, "cols": cols,
+                                      "dtype": x2d.dtype.name})
+        if tuned:
+            tr, tc = int(tuned["rows_block"]), int(tuned["cols_block"])
+            if rows % tr == 0 and cols % tc == 0:
+                br, bc = br or tr, bc or tc
+    if br is None:
+        br = min(256, rows)
     if rows % br:
         br = rows
     # Tile the lane dim too: a (br, cols) block at large intermediate sizes
     # (e.g. 8192x5632) needs >16MB of double-buffered VMEM and fails to
     # allocate.  Elementwise kernel, so any 128-multiple tile is valid;
     # fall back to the full width only when cols has no such divisor.
-    bc = cols
-    for cand in (2048, 1024, 512, 256, 128):
-        if cols % cand == 0:
-            bc = cand
-            break
+    if bc is None or cols % bc:
+        bc = cols
+        for cand in (2048, 1024, 512, 256, 128):
+            if cols % cand == 0:
+                bc = cand
+                break
     return pl.pallas_call(
         _swiglu_kernel,
         grid=(rows // br, cols // bc),
