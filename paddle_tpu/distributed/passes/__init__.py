@@ -181,10 +181,22 @@ class Fp16Pass(PassBase):
 
 @register_pass("auto_parallel_recompute")
 class RecomputePass(PassBase):
-    """Wrap the named sublayers (or every direct child of `model.layers`)
-    in activation recompute (reference auto_parallel_recompute.py)."""
+    """Dual-mode (reference auto_parallel_recompute.py): given a captured
+    Program it REWRITES it — forward segments become jax.checkpoint
+    composites and the grad super-op is rebuilt over them
+    (program_rewrites.RecomputeProgramRewrite); given the (model, optimizer)
+    triple it wraps the named sublayers in eager recompute."""
 
     def apply(self, ctx):
+        prog = ctx.attrs.get("main_program")
+        if prog is not None:
+            from .program_rewrites import RecomputeProgramRewrite
+
+            ctx.attrs["recompute_segments"] = RecomputeProgramRewrite(
+                segments=int(self.attrs.get("segments", 2)),
+                fetch_vids=self.attrs.get("fetch_vids", ()),
+            ).apply(prog)
+            return ctx
         from paddle_tpu.distributed.fleet.recompute import recompute_wrap
 
         targets = self.attrs.get("layers")
@@ -203,11 +215,30 @@ class RecomputePass(PassBase):
 
 @register_pass("auto_parallel_sharding")
 class ShardingPass(PassBase):
-    """ZeRO stage on the optimizer state (reference auto_parallel_sharding.py
-    — here it sets the accumulator-sharding policy ShardedTrainStep reads)."""
+    """Dual-mode (reference auto_parallel_sharding.py): given a captured
+    Program (+ mesh attr) it REWRITES the update dataflow with ZeRO
+    sharding constraints (program_rewrites.ShardingProgramRewrite); given
+    the triple it sets the accumulator-sharding policy ShardedTrainStep
+    reads."""
 
     def apply(self, ctx):
         stage = int(self.attrs.get("stage", 1))
+        prog = ctx.attrs.get("main_program")
+        mesh = self.attrs.get("mesh") or ctx.attrs.get("mesh")
+        if prog is not None and mesh is None:
+            # never silently change modes: a program without a mesh is a
+            # misconfiguration, not a request for the eager-policy branch
+            raise ValueError(
+                "auto_parallel_sharding on a captured Program needs a "
+                "'mesh' attr (jax Mesh or ProcessMesh)")
+        if prog is not None:
+            from .program_rewrites import ShardingProgramRewrite
+
+            ctx.attrs["sharding_rewritten_ops"] = ShardingProgramRewrite(
+                mesh, stage=stage, axis=self.attrs.get("axis", "dp"),
+            ).apply(prog)
+            ctx.attrs["sharding_stage"] = stage
+            return ctx
         ctx.optimizer._zero_stage = stage
         ctx.attrs["sharding_stage"] = stage
         return ctx
@@ -215,13 +246,23 @@ class ShardingPass(PassBase):
 
 @register_pass("auto_parallel_gradient_merge")
 class GradientMergePass(PassBase):
-    """Swap the optimizer for the k-step merging wrapper (reference
-    auto_parallel_gradient_merge.py)."""
+    """Dual-mode (reference auto_parallel_gradient_merge.py): given a
+    captured Program it REWRITES it — accumulator/counter state vars, an
+    accumulate op after the grad super-op, and a lax.cond-gated optimizer
+    update (program_rewrites.GradientMergeProgramRewrite); given the triple
+    it swaps in the k-step merging optimizer wrapper."""
 
     def apply(self, ctx):
+        k = int(self.attrs.get("k_steps", 1))
+        prog = ctx.attrs.get("main_program")
+        if prog is not None:
+            from .program_rewrites import GradientMergeProgramRewrite
+
+            ctx.attrs["gradient_merge_rewritten_ops"] = GradientMergeProgramRewrite(
+                k_steps=k, avg=self.attrs.get("avg", True)).apply(prog)
+            return ctx
         from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
 
-        k = int(self.attrs.get("k_steps", 1))
         if k > 1:
             ctx.optimizer = GradientMergeOptimizer(ctx.optimizer, k_steps=k, avg=self.attrs.get("avg", True))
         return ctx

@@ -1,0 +1,384 @@
+"""Program-REWRITING distributed passes: recompute, gradient-merge, sharding.
+
+Reference: python/paddle/distributed/passes/auto_parallel_recompute.py
+(re-inserts forward subgraphs before their grad ops),
+auto_parallel_gradient_merge.py (accumulator vars + k-step conditional
+apply rewritten into the main program), auto_parallel_sharding.py
+(partitions param/grad/opt-state vars over the sharding group and inserts
+the matching collectives).
+
+TPU-native mechanics over the static Program IR (static/program.py): a
+captured training step has a recognizable spine —
+
+    forward ops ... -> [grad super-op] -> [optimizer_update super-op]
+                                            writes: param/acc <- outputs
+
+so each pass is a genuine transform of that op list:
+
+- **RecomputeProgramRewrite** splits the forward into segments, replaces
+  each with ONE composite op running the segment under `jax.checkpoint`,
+  and REBUILDS the grad super-op over the transformed prefix (its fn closes
+  over a snapshot of the op list, so rewriting the forward alone would not
+  change the backward) — jax.grad through the checkpointed composites then
+  rematerializes instead of storing segment interiors.
+- **GradientMergeProgramRewrite** adds counter/accumulator STATE variables
+  to the program, inserts an accumulate op after the grad op, and wraps
+  optimizer_update in a lax.cond that applies the (averaged) merged grads
+  only on every k-th step.
+- **ShardingProgramRewrite** wraps the grad/optimizer_update outputs in
+  `with_sharding_constraint` over the sharding axis (ZeRO: stage 1 = opt
+  state, stage 2 = + grads, stage 3 = + params) so GSPMD partitions the
+  update dataflow when the program runs under a mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "RecomputeProgramRewrite",
+    "GradientMergeProgramRewrite",
+    "ShardingProgramRewrite",
+]
+
+
+def _base_type(t):
+    """Strip pass-inserted namespaces ('zero::gradient_merge::optimizer_update'
+    -> 'optimizer_update') so the rewrites COMPOSE in any order."""
+    return t.rsplit("::", 1)[-1]
+
+
+def _find_superops(program):
+    """(forward_ops, grad_op, update_op) — grad/update may be None; matched
+    by base type so already-rewritten (namespaced) super-ops still anchor."""
+    ops = program.global_block().ops
+    grad_i = next((i for i, op in enumerate(ops) if _base_type(op.type) == "grad"), None)
+    upd_i = next((i for i, op in enumerate(ops)
+                  if _base_type(op.type) == "optimizer_update"), None)
+    fwd_end = grad_i if grad_i is not None else len(ops)
+    return (
+        list(ops[:fwd_end]),
+        ops[grad_i] if grad_i is not None else None,
+        ops[upd_i] if upd_i is not None else None,
+    )
+
+
+def _run_ops(ops, env):
+    for op in ops:
+        var_vals = [env[s[1]] for s in op.arg_spec if s[0] == "var"]
+        out = op.fn(*var_vals)
+        for vid, v in zip(op.out_vids, jax.tree_util.tree_leaves(out)):
+            env[vid] = v
+    return env
+
+
+def _tuple_tree(n):
+    return jax.tree_util.tree_structure(tuple(range(max(n, 1))))
+
+
+def _make_segment_op(seg_ops, keep_vids, type_):
+    """One composite Operator replacing `seg_ops`, emitting only the segment
+    outputs in `keep_vids` (interior activations die — that is the point)."""
+    from paddle_tpu.static.program import Operator
+
+    produced = {vid for op in seg_ops for vid in op.out_vids}
+    in_vids = []
+    for op in seg_ops:
+        for vid in op.input_vids():
+            if vid not in produced and vid not in in_vids:
+                in_vids.append(vid)
+    out_vids = [vid for op in seg_ops for vid in op.out_vids if vid in keep_vids]
+
+    def seg_fn(*vals):
+        env = _run_ops(seg_ops, dict(zip(in_vids, vals)))
+        return tuple(env[vid] for vid in out_vids)
+
+    return Operator(
+        type=type_,
+        fn=jax.checkpoint(seg_fn),
+        arg_spec=[("var", vid) for vid in in_vids],
+        kwargs={},
+        out_vids=out_vids,
+        out_tree=_tuple_tree(len(out_vids)),
+    )
+
+
+class RecomputeProgramRewrite:
+    """Reference auto_parallel_recompute.py as a Program transform.
+
+    `segments`: number of equal checkpointed chunks the forward is cut
+    into.  `fetch_vids`: vars the caller will fetch (they must survive as
+    segment outputs; the grad target and all write/late-op inputs are kept
+    automatically)."""
+
+    def __init__(self, segments=2, fetch_vids=()):
+        if segments < 1:
+            raise ValueError("segments must be >= 1")
+        self.segments = int(segments)
+        self.fetch_vids = tuple(fetch_vids)
+
+    def apply(self, program) -> int:
+        from paddle_tpu.static.autodiff import build_grad_fn
+        from paddle_tpu.static.program import Operator
+
+        fwd_ops, grad_op, _upd = _find_superops(program)
+        if len(fwd_ops) < 2:
+            return 0
+        block = program.global_block()
+        post_ops = block.ops[len(fwd_ops):]
+
+        # values that must survive the rewrite
+        keep = set(self.fetch_vids)
+        keep.update(program.writes.keys())
+        keep.update(program.writes.values())
+        for op in post_ops:
+            keep.update(op.input_vids())
+        if grad_op is not None and getattr(grad_op, "grad_meta", None):
+            keep.add(grad_op.grad_meta["target_vid"])
+        # the loss itself (last forward op's outputs) stays fetchable
+        keep.update(fwd_ops[-1].out_vids)
+
+        # cut into `segments` chunks; a chunk's outputs consumed by a LATER
+        # chunk must also survive as composite outputs
+        n = min(self.segments, len(fwd_ops))
+        bounds = [round(i * len(fwd_ops) / n) for i in range(n + 1)]
+        chunks = [fwd_ops[bounds[i]:bounds[i + 1]] for i in range(n)]
+        chunks = [c for c in chunks if c]
+        new_fwd = []
+        for ci, chunk in enumerate(chunks):
+            later_needs = set(keep)
+            for later in chunks[ci + 1:]:
+                for op in later:
+                    later_needs.update(op.input_vids())
+            new_fwd.append(_make_segment_op(chunk, later_needs, "recompute::segment"))
+
+        block.ops = new_fwd + list(post_ops)
+        program.version += 1
+
+        # rebuild the grad super-op over the checkpointed prefix
+        if grad_op is not None and getattr(grad_op, "grad_meta", None):
+            meta = grad_op.grad_meta
+            fn = build_grad_fn(program, meta["target_vid"], meta["wrt_vids"],
+                               meta["in_vids"], ops=new_fwd)
+            idx = block.ops.index(grad_op)
+            new_grad = Operator(grad_op.type, fn, grad_op.arg_spec,
+                                grad_op.kwargs, grad_op.out_vids, grad_op.out_tree)
+            new_grad.grad_meta = dict(meta)
+            block.ops[idx] = new_grad
+        return len(new_fwd)
+
+
+class GradientMergeProgramRewrite:
+    """Reference auto_parallel_gradient_merge.py as a Program transform."""
+
+    def __init__(self, k_steps=2, avg=True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.k = int(k_steps)
+        self.avg = bool(avg)
+
+    def apply(self, program) -> int:
+        from paddle_tpu.static.program import Operator
+
+        if self.k == 1:
+            return 0
+        _fwd, grad_op, upd_op = _find_superops(program)
+        if grad_op is None or upd_op is None:
+            raise ValueError(
+                "gradient-merge rewrite needs a captured training step "
+                "(grad + optimizer_update super-ops); got neither — build "
+                "the program with optimizer.minimize(loss)")
+        block = program.global_block()
+        grad_vids = list(grad_op.out_vids)
+        k, avg = self.k, self.avg
+
+        # ---- new state: step counter + one accumulator per gradient
+        counter = program.new_var(jax.ShapeDtypeStruct((), jnp.int32), "gm_counter",
+                                  persistable=True)
+        program.param_inits[counter._vid] = jnp.zeros((), jnp.int32)
+        acc_vars = []
+        for i, gvid in enumerate(grad_vids):
+            gvar = program._var_by_vid[gvid]
+            acc = program.new_var(
+                jax.ShapeDtypeStruct(gvar._value.shape, gvar._value.dtype),
+                f"gm_acc_{i}", persistable=True)
+            program.param_inits[acc._vid] = jnp.zeros(gvar._value.shape,
+                                                      gvar._value.dtype)
+            acc_vars.append(acc)
+
+        # ---- accumulate op: inserted right after the grad op
+        n_g = len(grad_vids)
+
+        def acc_fn(counter_val, *rest):
+            accs, grads = rest[:n_g], rest[n_g:]
+            new_accs = tuple(a + g for a, g in zip(accs, grads))
+            nxt = counter_val + jnp.int32(1)
+            boundary = (nxt % jnp.int32(k)) == 0
+            merged = tuple((a / jnp.asarray(k, a.dtype)) if avg else a
+                           for a in new_accs)
+            kept = tuple(jnp.where(boundary, jnp.zeros_like(a), a)
+                         for a in new_accs)
+            new_counter = jnp.where(boundary, jnp.int32(0), nxt)
+            return (new_counter, boundary) + kept + merged
+
+        new_counter = program.new_var(jax.ShapeDtypeStruct((), jnp.int32), "gm_counter_next")
+        boundary = program.new_var(jax.ShapeDtypeStruct((), jnp.bool_), "gm_boundary")
+        kept_vars = [
+            program.new_var(jax.ShapeDtypeStruct(a._value.shape, a._value.dtype),
+                            f"gm_kept_{i}")
+            for i, a in enumerate(acc_vars)
+        ]
+        merged_vars = [
+            program.new_var(jax.ShapeDtypeStruct(a._value.shape, a._value.dtype),
+                            f"gm_merged_{i}")
+            for i, a in enumerate(acc_vars)
+        ]
+        out_vids = ([new_counter._vid, boundary._vid]
+                    + [v._vid for v in kept_vars] + [v._vid for v in merged_vars])
+        acc_op = Operator(
+            "gradient_merge::accumulate", acc_fn,
+            [("var", counter._vid)] + [("var", v._vid) for v in acc_vars]
+            + [("var", vid) for vid in grad_vids],
+            {}, out_vids, _tuple_tree(len(out_vids)),
+        )
+        gi = block.ops.index(grad_op)
+        block.ops.insert(gi + 1, acc_op)
+        program.add_write(counter, new_counter)
+        for a, kpt in zip(acc_vars, kept_vars):
+            program.add_write(a, kpt)
+
+        # ---- conditional optimizer update: grads -> merged, under lax.cond
+        grad_set = set(grad_vids)
+        merged_by_grad = dict(zip(grad_vids, (v._vid for v in merged_vars)))
+        grad_pos = [i for i, s in enumerate(upd_op.arg_spec)
+                    if s[0] == "var" and s[1] in grad_set]
+        if not grad_pos:
+            raise ValueError("optimizer_update does not consume the grad vars")
+        first_g, last_g = grad_pos[0], grad_pos[-1]
+        orig_fn = upd_op.fn
+        n_out = len(upd_op.out_vids)
+
+        def cond_update(boundary_val, *vals):
+            def apply(vs):
+                return tuple(orig_fn(*vs))
+
+            def skip(vs):
+                olds = vs[:first_g] + vs[last_g + 1:]  # params + accs
+                return tuple(olds[:n_out])
+
+            return jax.lax.cond(boundary_val, apply, skip, vals)
+
+        new_spec = [("var", boundary._vid)] + [
+            ("var", merged_by_grad.get(s[1], s[1])) if s[0] == "var" else s
+            for s in upd_op.arg_spec
+        ]
+        ui = block.ops.index(upd_op)
+        block.ops[ui] = Operator(
+            "gradient_merge::" + upd_op.type, cond_update, new_spec,
+            upd_op.kwargs, upd_op.out_vids, upd_op.out_tree,
+        )
+        program.version += 1
+        return 2
+
+
+class ShardingProgramRewrite:
+    """Reference auto_parallel_sharding.py as a Program transform: ZeRO
+    stage-N sharding constraints on the update dataflow (GSPMD inserts the
+    reduce-scatter/all-gather collectives when the program runs in a mesh).
+    """
+
+    def __init__(self, mesh, stage=1, axis="dp"):
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+        if isinstance(mesh, ProcessMesh):
+            mesh = mesh.jax_mesh
+        if not isinstance(mesh, Mesh):
+            raise TypeError(f"mesh must be a jax Mesh/ProcessMesh, got {type(mesh)}")
+        if stage not in (1, 2, 3):
+            raise ValueError("stage must be 1, 2 or 3")
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.stage = int(stage)
+        self.axis = axis
+
+    def _spec_for(self, shape):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        size = self.mesh.shape[self.axis]
+        if shape and shape[0] % size == 0 and shape[0] >= size:
+            return NamedSharding(self.mesh, PartitionSpec(self.axis))
+        return None  # indivisible leading dim: leave replicated
+
+    def _constrain_outputs(self, program, op, positions, new_type):
+        """Wrap op.fn so selected flat outputs carry sharding constraints."""
+        from paddle_tpu.static.program import Operator
+
+        shardings = {}
+        for pos in positions:
+            var = program._var_by_vid.get(op.out_vids[pos])
+            if var is None:
+                continue
+            s = self._spec_for(tuple(var._value.shape))
+            if s is not None:
+                shardings[pos] = s
+        if not shardings:
+            return None
+        orig_fn = op.fn
+
+        def fn(*vals):
+            out = orig_fn(*vals)
+            flat = list(jax.tree_util.tree_leaves(out))
+            for pos, sh in shardings.items():
+                flat[pos] = jax.lax.with_sharding_constraint(flat[pos], sh)
+            return tuple(flat)
+
+        new_op = Operator(new_type, fn, op.arg_spec, op.kwargs,
+                          op.out_vids, _tuple_tree(len(op.out_vids)))
+        if getattr(op, "grad_meta", None):
+            new_op.grad_meta = dict(op.grad_meta)
+        return new_op
+
+    def apply(self, program) -> int:
+        _fwd, grad_op, upd_op = _find_superops(program)
+        if upd_op is None:
+            raise ValueError(
+                "sharding rewrite needs an optimizer_update super-op — "
+                "build the program with optimizer.minimize(loss)")
+        block = program.global_block()
+        changed = 0
+
+        # stage >= 1: optimizer state (accumulator outputs) sharded.
+        # update outputs are (new_params..., new_accs...): accs are the
+        # outputs whose vids are written to non-parameter state vars.
+        param_vids = {v._vid for v in program.all_parameters()}
+        write_to_target = {src: tgt for tgt, src in program.writes.items()}
+        acc_pos, param_pos = [], []
+        for i, vid in enumerate(upd_op.out_vids):
+            tgt = write_to_target.get(vid)
+            if tgt is None:
+                continue
+            (param_pos if tgt in param_vids else acc_pos).append(i)
+        positions = list(acc_pos)
+        if self.stage >= 3:
+            positions += param_pos
+        new_upd = self._constrain_outputs(program, upd_op, positions,
+                                          "zero::" + upd_op.type)
+        if new_upd is not None:
+            block.ops[block.ops.index(upd_op)] = new_upd
+            changed += 1
+
+        # stage >= 2: gradients sharded too (GSPMD then materializes the
+        # reduce-scatter form of the DP gradient sync)
+        if self.stage >= 2 and grad_op is not None:
+            new_grad = self._constrain_outputs(
+                program, grad_op, range(len(grad_op.out_vids)),
+                "zero::" + grad_op.type)
+            if new_grad is not None:
+                block.ops[block.ops.index(grad_op)] = new_grad
+                changed += 1
+        program.version += 1
+        return changed

@@ -89,7 +89,8 @@ class AutotuneCache:
                             f"{self.slug}.json")
 
     def _load(self):
-        for path in (self.seed_path, self._save_path(), self.user_path):
+        # priority (last wins): seed < user fallback < explicitly configured
+        for path in (self.seed_path, self.user_path, self._save_path()):
             try:
                 with open(path) as f:
                     loaded = json.load(f)
@@ -131,10 +132,15 @@ _CACHES: dict = {}
 
 
 def cache(slug=None) -> AutotuneCache:
+    from paddle_tpu._core import flags as _flags
+
     slug = slug or device_kind_slug()
-    if slug not in _CACHES:
-        _CACHES[slug] = AutotuneCache(slug)
-    return _CACHES[slug]
+    # keyed on the configured dir too: changing FLAGS_autotune_cache_dir
+    # after a lookup must take effect, not be silently memoized away
+    key = (slug, str(_flags.flag("FLAGS_autotune_cache_dir") or ""))
+    if key not in _CACHES:
+        _CACHES[key] = AutotuneCache(slug)
+    return _CACHES[key]
 
 
 def lookup(kernel: str, key: dict, slug=None):

@@ -20,13 +20,13 @@ from .program import Program, Variable, current_main_program
 __all__ = ["append_backward", "gradients"]
 
 
-def _grad_superop(prog: Program, target: Variable, wrt_vars, name):
-    """Record one op computing d(target)/d(wrt_vars); returns grad Variables."""
-    fetch = [target._vid]
-    inputs = list(prog.feed_vars) + [prog._var_by_vid[vid] for vid in prog.param_inits]
-    in_vids = [v._vid for v in inputs]
-    run_fn, feed_vids, state_vids = prog.as_function(fetch, feed_vids=[], state_vids=in_vids)
-    wrt_pos = [in_vids.index(v._vid) for v in wrt_vars]
+def build_grad_fn(prog: Program, target_vid, wrt_vids, in_vids, ops=None):
+    """d(target)/d(wrt) over the program's CURRENT op list (or an explicit
+    `ops` prefix) — factored out so program-rewriting passes (recompute) can
+    REBUILD the grad super-op after transforming the forward ops."""
+    run_fn, _, _ = prog.as_function([target_vid], feed_vids=[], state_vids=in_vids,
+                                    ops=ops)
+    wrt_pos = [in_vids.index(vid) for vid in wrt_vids]
 
     def fn(*vals):
         def scalar(*wrt_vals):
@@ -41,7 +41,24 @@ def _grad_superop(prog: Program, target: Variable, wrt_vars, name):
         )
         return tuple(grads)
 
-    return prog.record(name, fn, tuple(inputs), {})
+    return fn
+
+
+def _grad_superop(prog: Program, target: Variable, wrt_vars, name):
+    """Record one op computing d(target)/d(wrt_vars); returns grad Variables.
+
+    The op carries `grad_meta` (target/wrt/input vids) so passes can rebuild
+    it after rewriting the forward — its fn closes over a SNAPSHOT of the op
+    list, so forward rewrites alone would not reach the backward."""
+    inputs = list(prog.feed_vars) + [prog._var_by_vid[vid] for vid in prog.param_inits]
+    in_vids = [v._vid for v in inputs]
+    wrt_vids = [v._vid for v in wrt_vars]
+    fn = build_grad_fn(prog, target._vid, wrt_vids, in_vids)
+    out = prog.record(name, fn, tuple(inputs), {})
+    prog.global_block().ops[-1].grad_meta = {
+        "target_vid": target._vid, "wrt_vids": wrt_vids, "in_vids": in_vids,
+    }
+    return out
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None):

@@ -90,10 +90,22 @@ def _fp16_rewrite_factory(**kwargs):
     return Fp16ProgramRewrite(**kwargs)
 
 
+def _dist_rewrite_factory(name):
+    def factory(**kwargs):
+        from paddle_tpu.distributed.passes import program_rewrites as pr
+
+        return getattr(pr, name)(**kwargs)
+
+    return factory
+
+
 _REGISTRY = {
     "dead_code_elimination": DeadCodeEliminationPass,
     "pallas_fusion": _pallas_fusion_factory,
     "auto_parallel_fp16": _fp16_rewrite_factory,
+    "auto_parallel_recompute": _dist_rewrite_factory("RecomputeProgramRewrite"),
+    "auto_parallel_gradient_merge": _dist_rewrite_factory("GradientMergeProgramRewrite"),
+    "auto_parallel_sharding": _dist_rewrite_factory("ShardingProgramRewrite"),
 }
 
 

@@ -253,11 +253,14 @@ class Program:
         self.version += 1
 
     # ------------------------------------------------------------ execution
-    def as_function(self, fetch_vids, feed_vids=None, state_vids=None):
-        """Build fn(feed_vals, state_vals) -> (fetches, write_values)."""
+    def as_function(self, fetch_vids, feed_vids=None, state_vids=None, ops=None):
+        """Build fn(feed_vals, state_vals) -> (fetches, write_values).
+
+        `ops` overrides the executed op list (passes re-derive a grad
+        super-op over a transformed forward prefix this way)."""
         feed_vids = feed_vids if feed_vids is not None else [v._vid for v in self.feed_vars]
         state_vids = state_vids if state_vids is not None else list(self.param_inits.keys())
-        ops = list(self.global_block().ops)
+        ops = list(self.global_block().ops) if ops is None else list(ops)
         writes = dict(self.writes)
 
         def run(feed_vals, state_vals):

@@ -127,3 +127,224 @@ def test_fp16_program_rewrite_pass():
     prog2, out2 = capture()
     n = apply_pass(prog2, "auto_parallel_fp16")
     assert n == 1
+
+
+# --------------------------------------------------------------------------
+# Program-REWRITING passes (VERDICT r3 #4): recompute / gradient-merge /
+# sharding transform a CAPTURED training-step Program and preserve numerics.
+
+
+def _capture_train_step(lr=0.1, seed=0, hidden=8):
+    """model fwd + loss + minimize captured as one Program; returns
+    (program, loss_var, feed_builder, eager_twin_builder)."""
+    import jax
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.static.program import Program, program_guard
+
+    paddle.seed(seed)
+    m = nn.Sequential(
+        nn.Linear(hidden, 2 * hidden), nn.Tanh(), nn.Linear(2 * hidden, 1))
+    o = opt.Momentum(learning_rate=lr, momentum=0.9, parameters=m.parameters())
+    prog = Program()
+    with program_guard(prog):
+        xv = prog.add_feed(prog.new_var(
+            jax.ShapeDtypeStruct((4, hidden), np.float32), "x"))
+        yv = prog.add_feed(prog.new_var(
+            jax.ShapeDtypeStruct((4, 1), np.float32), "y"))
+        loss = ((m(xv) - yv) ** 2).mean()
+        o.minimize(loss)
+    return prog, loss, m, o
+
+
+def _run_steps(prog, loss_var, batches):
+    """Run steps; returns (losses, TRAINED state from the executor scope —
+    program.state_tensors() only holds the untrained inits)."""
+    import paddle_tpu.static as static
+
+    exe = static.Executor()
+    losses = []
+    for x, y in batches:
+        out = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss_var])
+        losses.append(float(np.asarray(out[0])))
+    state = {name: np.asarray(v) for name, v in exe.state_dict(prog).items()}
+    return losses, state
+
+
+def _batches(n, hidden=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.normal(size=(4, hidden)).astype(np.float32),
+             rng.normal(size=(4, 1)).astype(np.float32)) for _ in range(n)]
+
+
+def test_recompute_program_rewrite_preserves_numerics():
+    from paddle_tpu.static.passes import apply_pass
+
+    batches = _batches(3)
+    prog_ref, loss_ref, _, _ = _capture_train_step()
+    ref_losses, ref_state = _run_steps(prog_ref, loss_ref, batches)
+
+    prog, loss, _, _ = _capture_train_step()
+    n = apply_pass(prog, "auto_parallel_recompute", segments=2,
+                   fetch_vids=[loss._vid])
+    assert n == 2
+    types = [op.type for op in prog.global_block().ops]
+    assert types.count("recompute::segment") == 2
+    assert "grad" in types and "optimizer_update" in types
+    # the checkpointed composites are what the GRAD op differentiates:
+    # its jaxpr must contain the remat primitive
+    import jax
+
+    grad_op = next(op for op in prog.global_block().ops if op.type == "grad")
+    avals = [prog._var_by_vid[s[1]]._value for s in grad_op.arg_spec if s[0] == "var"]
+    jaxpr = str(jax.make_jaxpr(grad_op.fn)(*[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]))
+    assert "remat" in jaxpr or "checkpoint" in jaxpr
+
+    got_losses, got_state = _run_steps(prog, loss, batches)
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for name in ref_state:
+        np.testing.assert_allclose(got_state[name], ref_state[name],
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_gradient_merge_program_rewrite_matches_eager_wrapper():
+    """Rewritten program over 4 batches == eager GradientMergeOptimizer(k=2)
+    driving an identical model."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+    from paddle_tpu.static.passes import apply_pass
+
+    batches = _batches(4)
+
+    # eager twin
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    o = GradientMergeOptimizer(
+        opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=m.parameters()),
+        k_steps=2, avg=True)
+    for x, y in batches:
+        loss = ((m(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    eager_params = {p.name: np.asarray(p._value) for p in m.parameters()}
+
+    # rewritten static program
+    prog, loss_var, m2, _ = _capture_train_step()
+    n = apply_pass(prog, "auto_parallel_gradient_merge", k_steps=2, avg=True)
+    assert n == 2
+    types = [op.type for op in prog.global_block().ops]
+    assert "gradient_merge::accumulate" in types
+    assert "gradient_merge::optimizer_update" in types
+    _, state = _run_steps(prog, loss_var, batches)
+
+    # compare by parameter ORDER (name counters are global, so the two
+    # models' auto-names differ); the program's final param values live in
+    # its state under the static twin's names
+    eager_vals = [np.asarray(p._value) for p in m.parameters()]
+    static_names = [prog.param_vars[id(p)].name for p in m2.parameters()]
+    assert len(eager_vals) == len(static_names) == 4
+    for ev, name in zip(eager_vals, static_names):
+        np.testing.assert_allclose(state[name], ev, rtol=1e-4, atol=1e-5,
+                                   err_msg=name)
+
+
+def test_gradient_merge_counter_and_acc_state_cycle():
+    """Non-boundary steps must leave params untouched and fill the accs;
+    boundary steps apply the averaged grad and reset."""
+    from paddle_tpu.static.executor import global_scope
+    from paddle_tpu.static.passes import apply_pass
+
+    prog, loss_var, _, _ = _capture_train_step()
+    params_before = {name: np.asarray(t._value)
+                     for name, t in prog.state_tensors().items()}
+    apply_pass(prog, "auto_parallel_gradient_merge", k_steps=2, avg=True)
+    gm_vids = {v.name: v._vid for v in prog.list_vars()
+               if v.name.startswith(("gm_counter", "gm_acc"))}
+
+    import paddle_tpu.static as static
+
+    exe = static.Executor()
+    scope = global_scope()
+
+    def gm_state():
+        return {n: np.asarray(scope.find_var(vid)) for n, vid in gm_vids.items()
+                if scope.find_var(vid) is not None}
+
+    (x, y), = _batches(1)
+    exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss_var])
+    mid_params = {n: v for n, v in exe.state_dict(prog).items()
+                  if n in params_before}
+    mid = gm_state()
+    # step 1 of 2: params unchanged, counter=1, accs nonzero
+    for name, val in params_before.items():
+        if name in mid_params:
+            np.testing.assert_allclose(np.asarray(mid_params[name]), val,
+                                       err_msg=f"{name} moved early")
+    assert mid["gm_counter"] == 1
+    assert any(np.abs(v).sum() > 0 for n, v in mid.items() if n.startswith("gm_acc"))
+    exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss_var])
+    end = gm_state()
+    end_params = {n: np.asarray(v) for n, v in exe.state_dict(prog).items()}
+    # boundary: params moved, counter and accs reset
+    assert end["gm_counter"] == 0
+    assert all(np.abs(v).sum() == 0 for n, v in end.items() if n.startswith("gm_acc"))
+    moved = [n for n in params_before
+             if n in end_params and not np.allclose(end_params[n], params_before[n])]
+    assert moved, "no parameter moved on the boundary step"
+
+
+def test_sharding_program_rewrite_constrains_and_preserves_numerics():
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.static.passes import apply_pass
+
+    batches = _batches(3)
+    prog_ref, loss_ref, _, _ = _capture_train_step()
+    ref_losses, ref_state = _run_steps(prog_ref, loss_ref, batches)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    prog, loss_var, _, _ = _capture_train_step()
+    n = apply_pass(prog, "auto_parallel_sharding", mesh=mesh, stage=2, axis="dp")
+    assert n == 2  # update op + grad op rewritten
+    types = [op.type for op in prog.global_block().ops]
+    assert "zero::optimizer_update" in types
+    # constraint really present in the lowered grad computation (the grad
+    # super-op is renamed zero::grad by the rewrite)
+    grad_op = next(op for op in prog.global_block().ops
+                   if op.type.endswith("grad"))
+    avals = [prog._var_by_vid[s[1]]._value for s in grad_op.arg_spec if s[0] == "var"]
+    jaxpr = str(jax.make_jaxpr(grad_op.fn)(
+        *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]))
+    assert "sharding_constraint" in jaxpr
+
+    got_losses, got_state = _run_steps(prog, loss_var, batches)
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for name in ref_state:
+        np.testing.assert_allclose(got_state[name], ref_state[name],
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_gradient_merge_and_sharding_compose_in_both_orders():
+    """ZeRO + grad-accumulation is a standard strategy combo: the rewrites
+    must anchor on namespaced super-ops from a prior pass (either order)."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.static.passes import apply_pass
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    batches = _batches(2)
+
+    for order in ("merge_first", "shard_first"):
+        prog, loss_var, _, _ = _capture_train_step()
+        if order == "merge_first":
+            apply_pass(prog, "auto_parallel_gradient_merge", k_steps=2, avg=True)
+            n = apply_pass(prog, "auto_parallel_sharding", mesh=mesh, stage=1)
+        else:
+            apply_pass(prog, "auto_parallel_sharding", mesh=mesh, stage=1)
+            n = apply_pass(prog, "auto_parallel_gradient_merge", k_steps=2, avg=True)
+        assert n >= 1, order
+        types = [op.type for op in prog.global_block().ops]
+        assert any("gradient_merge::" in t and "optimizer_update" in t
+                   or "zero::" in t for t in types), types
+        losses, _ = _run_steps(prog, loss_var, batches)
+        assert all(np.isfinite(losses)), order

@@ -267,7 +267,7 @@ def test_flash_block_size_flags():
         paddle.set_flags({"FLAGS_flash_block_q": 100, "FLAGS_flash_block_k": 128})
         assert _block_sizes(400, 400) == (128, 128)  # 100 not a sublane multiple
     finally:
-        paddle.set_flags({"FLAGS_flash_block_q": 128, "FLAGS_flash_block_k": 128})
+        paddle.set_flags({"FLAGS_flash_block_q": 0, "FLAGS_flash_block_k": 0})
 
 
 def test_flash_nondefault_blocks_match_reference():
@@ -283,6 +283,6 @@ def test_flash_nondefault_blocks_match_reference():
         paddle.set_flags({"FLAGS_use_pallas": "true", "FLAGS_flash_block_q": 256, "FLAGS_flash_block_k": 64})
         out = fa_fn(q, q, q, causal=True)
     finally:
-        paddle.set_flags({"FLAGS_use_pallas": "auto", "FLAGS_flash_block_q": 128, "FLAGS_flash_block_k": 128})
+        paddle.set_flags({"FLAGS_use_pallas": "auto", "FLAGS_flash_block_q": 0, "FLAGS_flash_block_k": 0})
     ref = flash_attention_reference(q, q, q, causal=True)
     assert float(jnp.abs(out - ref).max()) < 2e-5
