@@ -161,10 +161,24 @@ class RecomputeProgramRewrite:
             meta = grad_op.grad_meta
             fn = build_grad_fn(program, meta["target_vid"], meta["wrt_vids"],
                                meta["in_vids"], ops=new_fwd)
+            constraints = getattr(grad_op, "sharding_constraints", None)
+            if constraints:
+                # sharding ran first: re-apply its output constraints so
+                # recompute-after-sharding keeps ZeRO gradient placement
+                inner = fn
+
+                def fn(*vals, _inner=inner, _cs=constraints):
+                    flat = list(jax.tree_util.tree_leaves(_inner(*vals)))
+                    for pos, sh in _cs.items():
+                        flat[pos] = jax.lax.with_sharding_constraint(flat[pos], sh)
+                    return tuple(flat)
+
             idx = block.ops.index(grad_op)
             new_grad = Operator(grad_op.type, fn, grad_op.arg_spec,
                                 grad_op.kwargs, grad_op.out_vids, grad_op.out_tree)
             new_grad.grad_meta = dict(meta)
+            if constraints:
+                new_grad.sharding_constraints = dict(constraints)
             block.ops[idx] = new_grad
         return len(new_fwd)
 
@@ -340,6 +354,8 @@ class ShardingProgramRewrite:
                           op.out_vids, _tuple_tree(len(op.out_vids)))
         if getattr(op, "grad_meta", None):
             new_op.grad_meta = dict(op.grad_meta)
+        # later passes that rebuild this op's fn (recompute) re-apply these
+        new_op.sharding_constraints = dict(shardings)
         return new_op
 
     def apply(self, program) -> int:

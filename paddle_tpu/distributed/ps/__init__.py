@@ -31,7 +31,17 @@ import threading
 
 import numpy as np
 
-__all__ = ["SparseTable", "PsServer", "PsClient", "SparseEmbedding"]
+__all__ = ["SparseTable", "PsServer", "PsClient", "SparseEmbedding",
+           "MeshShardedEmbedding"]
+
+
+def __getattr__(name):
+    # lazy: sharded.py pulls in jax; the host-tier classes must not
+    if name == "MeshShardedEmbedding":
+        from .sharded import MeshShardedEmbedding
+
+        return MeshShardedEmbedding
+    raise AttributeError(name)
 
 
 class SparseTable:

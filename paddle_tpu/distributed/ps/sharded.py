@@ -1,0 +1,267 @@
+"""Mesh-sharded embedding table — the TPU-native parameter-server successor.
+
+Reference: paddle/fluid/distributed/ps/table/memory_sparse_table.h (sharded
+accessor tables), brpc_ps_server.h pull_sparse/push_sparse services, and the
+distributed-lookup-table op pair.
+
+TPU-native redesign (VERDICT r3 #7): instead of brpc servers, the table is a
+ROW-SHARDED device array over a mesh axis.  Lookup and update are ONE
+compiled shard_map program each:
+
+  1. each rank buckets its local ids by owner shard (range partitioning),
+  2. `lax.all_to_all` exchanges the id buckets (the pull_sparse RPC),
+  3. owners gather their rows and all-to-all them back,
+  4. update: the same routing carries per-row GRADIENTS to the owner, which
+     applies a SelectedRows-style scatter update (only touched rows change —
+     the lazy-row semantics of the reference's accessor tables; adagrad
+     second moments live sharded next to the rows).
+
+The host `SparseTable` remains the SPILL TIER: ids >= num_rows (or an
+explicit overflow range) are served from host memory, so a vocabulary can
+exceed device HBM exactly like the reference's memory/SSD tiering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MeshShardedEmbedding"]
+
+
+def _routed_exchange(ids_local, axis, local_rows, cap):
+    """Bucket ids by owner shard and all-to-all them; returns everything
+    needed to route payloads both directions with STATIC shapes.
+
+    ids_local: [n] int32 global row ids (must be < w * local_rows).
+    Returns (recv_ids [w, cap], recv_mask [w, cap], order, so, pos, inv,
+    valid) — `order` is the owner-sort permutation, shared by id and
+    payload routing so they can never drift apart.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = ids_local.shape[0]
+    w = lax.axis_size(axis)
+    owner = jnp.clip(ids_local // local_rows, 0, w - 1)
+    order = jnp.argsort(owner, stable=True)
+    inv = jnp.argsort(order)
+    so = owner[order]
+    ids_sorted = ids_local[order]
+    # position of each request inside its destination bucket
+    first = jnp.searchsorted(so, so, side="left")
+    pos = jnp.arange(n, dtype=jnp.int32) - first.astype(jnp.int32)
+    valid = pos < cap  # requests beyond capacity are dropped (cap=n: never)
+
+    buckets = jnp.zeros((w, cap), jnp.int32).at[so, pos].set(
+        ids_sorted, mode="drop")
+    bmask = jnp.zeros((w, cap), jnp.bool_).at[so, pos].set(
+        valid, mode="drop")
+    recv_ids = lax.all_to_all(buckets, axis, split_axis=0, concat_axis=0)
+    recv_mask = lax.all_to_all(bmask, axis, split_axis=0, concat_axis=0)
+    return recv_ids, recv_mask, order, so, pos, inv, valid
+
+
+class MeshShardedEmbedding:
+    """Row-sharded device embedding with all-to-all pull/push.
+
+    Usage (mesh axis 'dp' with 8 shards):
+        table = MeshShardedEmbedding(10_000_000, 16, mesh, axis="dp")
+        rows = table.pull(ids)                 # [n, dim] device rows
+        ...loss... ; g = d(loss)/d(rows)
+        table.push(ids, g)                     # sparse per-shard update
+    """
+
+    def __init__(self, num_rows, dim, mesh, axis="dp", optimizer="adagrad",
+                 lr=0.05, capacity=None, spill_table=None, seed=0,
+                 init_scale=0.01):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        if hasattr(mesh, "jax_mesh"):
+            mesh = mesh.jax_mesh
+        if not isinstance(mesh, Mesh):
+            raise TypeError(f"mesh must be a jax Mesh/ProcessMesh, got {type(mesh)}")
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.mesh, self.axis = mesh, axis
+        self.w = int(mesh.shape[axis])
+        self.num_rows, self.dim = int(num_rows), int(dim)
+        self.local_rows = -(-self.num_rows // self.w)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("optimizer must be 'sgd' or 'adagrad'")
+        self.capacity = capacity  # None -> per-call n (no drops)
+        self.spill = spill_table
+
+        padded = self.local_rows * self.w
+        self._row_sharding = NamedSharding(mesh, PartitionSpec(axis))
+        key = jax.random.PRNGKey(seed)
+        # initialize SHARDED (jit with out_shardings): the full table never
+        # materializes on one device — the point at 10M+ rows
+        init = jax.jit(
+            lambda k: jax.random.normal(k, (padded, self.dim), jnp.float32)
+            * init_scale,
+            out_shardings=self._row_sharding,
+        )
+        self.weight = init(key)
+        self._acc = (
+            jax.jit(lambda: jnp.zeros((padded, self.dim), jnp.float32),
+                    out_shardings=self._row_sharding)()
+            if optimizer == "adagrad" else None
+        )
+        self._pull_cache: dict = {}
+        self._push_cache: dict = {}
+
+    # ----------------------------------------------------------- programs
+    def _pull_program(self, cap):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax, shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axis, local_rows = self.axis, self.local_rows
+
+        def body(w_local, ids_local):
+            r = lax.axis_index(axis)
+            recv_ids, recv_mask, _order, so, pos, inv, valid = _routed_exchange(
+                ids_local, axis, local_rows, cap)
+            local_idx = jnp.clip(recv_ids - r * local_rows, 0, local_rows - 1)
+            rows = w_local[local_idx] * recv_mask[..., None].astype(w_local.dtype)
+            back = lax.all_to_all(rows, axis, split_axis=0, concat_axis=0)
+            rows_sorted = back[so, pos] * valid[:, None].astype(w_local.dtype)
+            return rows_sorted[inv]
+
+        return jax.jit(shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(axis),
+            check_vma=False,
+        ))
+
+    def _push_program(self, cap):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax, shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axis, local_rows, lr = self.axis, self.local_rows, self.lr
+        adagrad = self.optimizer == "adagrad"
+
+        def body(w_local, acc_local, ids_local, g_local):
+            r = lax.axis_index(axis)
+            recv_ids, recv_mask, order, so, pos, _inv, valid = _routed_exchange(
+                ids_local, axis, local_rows, cap)
+            gs = g_local[order]  # the id-routing permutation routes payloads
+            gsend = jnp.zeros((lax.axis_size(axis), cap, g_local.shape[-1]),
+                              g_local.dtype).at[so, pos].set(
+                gs * valid[:, None].astype(g_local.dtype), mode="drop")
+            grecv = lax.all_to_all(gsend, axis, split_axis=0, concat_axis=0)
+            idx = jnp.clip(recv_ids - r * local_rows, 0, local_rows - 1).reshape(-1)
+            gf = (grecv * recv_mask[..., None].astype(grecv.dtype)).reshape(-1, g_local.shape[-1])
+            # SelectedRows-style lazy update: ONLY the routed rows change
+            if adagrad:
+                acc_new = acc_local.at[idx].add(gf * gf)
+                denom = jnp.sqrt(acc_new[idx]) + 1e-8
+                w_new = w_local.at[idx].add(-lr * gf / denom)
+                return w_new, acc_new
+            w_new = w_local.at[idx].add(-lr * gf)
+            return w_new, acc_local
+
+        return jax.jit(shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        ), donate_argnums=(0, 1))
+
+    # -------------------------------------------------------------- public
+    def _split_spill(self, ids_np):
+        dev_mask = ids_np < self.num_rows
+        return dev_mask, ids_np[~dev_mask]
+
+    def _pad_global(self, ids_np):
+        """Pad the flat id batch to a multiple of the shard width so the
+        P(axis) input spec tiles evenly; padded ids hit row 0, masked out."""
+        n = len(ids_np)
+        pad = (-n) % self.w
+        if pad:
+            ids_np = np.concatenate([ids_np, np.zeros(pad, np.int32)])
+        return ids_np, n
+
+    def pull(self, ids):
+        """ids: any int array -> [*, dim] float32 rows (device for in-range
+        ids; spill-tier host rows merged in for overflow ids)."""
+        import jax.numpy as jnp
+
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        shape = np.asarray(ids).shape
+        dev_mask, spill_ids = self._split_spill(ids_np)
+        padded, n = self._pad_global(
+            np.where(dev_mask, ids_np, 0).astype(np.int32))
+        cap = self.capacity or len(padded) // self.w
+        key = (len(padded), cap)
+        if key not in self._pull_cache:
+            self._pull_cache[key] = self._pull_program(cap)
+        rows = np.array(self._pull_cache[key](self.weight, jnp.asarray(padded)))[:n]
+        if spill_ids.size:
+            if self.spill is None:
+                raise IndexError(
+                    f"ids >= num_rows={self.num_rows} and no spill table")
+            rows[~dev_mask] = self.spill.pull(spill_ids)
+        return jnp.asarray(rows.reshape(shape + (self.dim,)))
+
+    def push(self, ids, grads):
+        """Sparse update: grads routed to owner shards, touched rows only."""
+        import jax.numpy as jnp
+
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        g_np = np.asarray(grads, np.float32).reshape(len(ids_np), self.dim)
+        dev_mask, spill_ids = self._split_spill(ids_np)
+        if spill_ids.size:
+            if self.spill is None:
+                raise IndexError(
+                    f"ids >= num_rows={self.num_rows} and no spill table")
+            self.spill.push(spill_ids, g_np[~dev_mask])
+        dev_g = np.where(dev_mask[:, None], g_np, 0.0).astype(np.float32)
+        padded, n = self._pad_global(
+            np.where(dev_mask, ids_np, 0).astype(np.int32))
+        pad = len(padded) - n
+        if pad:
+            dev_g = np.concatenate([dev_g, np.zeros((pad, self.dim), np.float32)])
+        cap = self.capacity or len(padded) // self.w
+        key = (len(padded), cap)
+        if key not in self._push_cache:
+            self._push_cache[key] = self._push_program(cap)
+        acc = self._acc if self._acc is not None else jnp.zeros((0, self.dim), np.float32)
+        self.weight, acc_new = self._push_cache[key](
+            self.weight, acc, jnp.asarray(padded), jnp.asarray(dev_g))
+        if self._acc is not None:
+            self._acc = acc_new
+
+    # ---------------------------------------------------------- checkpoint
+    def state_dict(self):
+        out = {"weight": np.asarray(self.weight)[: self.num_rows],
+               "num_rows": self.num_rows, "dim": self.dim,
+               "optimizer": self.optimizer}
+        if self._acc is not None:
+            out["acc"] = np.asarray(self._acc)[: self.num_rows]
+        if self.spill is not None:
+            out["spill"] = self.spill.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        padded = self.local_rows * self.w
+        w = np.zeros((padded, self.dim), np.float32)
+        w[: self.num_rows] = state["weight"]
+        self.weight = jax.device_put(jnp.asarray(w), self._row_sharding)
+        if self._acc is not None and "acc" in state:
+            a = np.zeros((padded, self.dim), np.float32)
+            a[: self.num_rows] = state["acc"]
+            self._acc = jax.device_put(jnp.asarray(a), self._row_sharding)
+        if self.spill is not None and "spill" in state:
+            self.spill.set_state_dict(state["spill"])

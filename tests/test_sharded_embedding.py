@@ -1,0 +1,157 @@
+"""Mesh-sharded embedding table — the PS successor (VERDICT r3 #7).
+
+Reference: paddle/fluid/distributed/ps/table/memory_sparse_table.h (sharded
+accessor tables) + pull_sparse/push_sparse services; here: row-sharded
+device table, all-to-all id exchange, SelectedRows-style per-shard updates,
+host SparseTable spill tier, checkpoint round-trip.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import MeshShardedEmbedding, SparseTable
+
+
+def _mesh(w=8):
+    return Mesh(np.array(jax.devices()[:w]), ("dp",))
+
+
+def test_pull_matches_direct_gather():
+    table = MeshShardedEmbedding(1000, 8, _mesh(), optimizer="sgd", seed=3)
+    full = np.asarray(table.weight)[:1000]
+    ids = np.array([0, 999, 5, 5, 123, 777, 64, 3], np.int64)
+    rows = np.asarray(table.pull(ids))
+    np.testing.assert_allclose(rows, full[ids], rtol=1e-6)
+    # 2-D id batches keep their shape
+    ids2 = ids.reshape(2, 4)
+    rows2 = np.asarray(table.pull(ids2))
+    assert rows2.shape == (2, 4, 8)
+    np.testing.assert_allclose(rows2.reshape(8, 8), full[ids], rtol=1e-6)
+
+
+def test_push_updates_only_touched_rows_sgd():
+    table = MeshShardedEmbedding(512, 4, _mesh(), optimizer="sgd", lr=0.5)
+    before = np.asarray(table.weight)[:512].copy()
+    ids = np.array([7, 300, 511, 7], np.int64)  # dup id: grads accumulate
+    g = np.ones((4, 4), np.float32)
+    table.push(ids, g)
+    after = np.asarray(table.weight)[:512]
+    touched = {7, 300, 511}
+    for r in range(512):
+        if r in touched:
+            expect = before[r] - 0.5 * (2.0 if r == 7 else 1.0)
+            np.testing.assert_allclose(after[r], expect, rtol=1e-5,
+                                       err_msg=str(r))
+        else:
+            np.testing.assert_array_equal(after[r], before[r])
+
+
+def test_adagrad_lazy_second_moments():
+    table = MeshShardedEmbedding(256, 4, _mesh(), optimizer="adagrad", lr=0.1)
+    ids = np.array([10, 200], np.int64)
+    g = np.full((2, 4), 2.0, np.float32)
+    before = np.asarray(table.weight)[:256].copy()
+    table.push(ids, g)
+    acc = np.asarray(table._acc)[:256]
+    assert np.allclose(acc[10], 4.0) and np.allclose(acc[200], 4.0)
+    assert np.abs(acc).sum() == pytest.approx(2 * 4 * 4.0)  # only touched rows
+    after = np.asarray(table.weight)[:256]
+    np.testing.assert_allclose(
+        after[10], before[10] - 0.1 * 2.0 / (np.sqrt(4.0) + 1e-8), rtol=1e-5)
+
+
+def test_spill_tier_serves_overflow_ids():
+    spill = SparseTable(dim=4, optimizer="sgd", lr=1.0)
+    table = MeshShardedEmbedding(128, 4, _mesh(), optimizer="sgd",
+                                 spill_table=spill, lr=1.0)
+    ids = np.array([5, 127, 128, 1000], np.int64)  # last two overflow
+    rows = np.asarray(table.pull(ids))
+    assert rows.shape == (4, 4)
+    assert spill.n_rows() == 2  # lazily created host rows
+    g = np.ones((4, 4), np.float32)
+    table.push(ids, g)
+    # host rows moved by -lr*g; device overflow slots untouched
+    np.testing.assert_allclose(spill.pull([128]), rows[2:3] - 1.0, rtol=1e-5)
+    # without a spill table overflow is loud
+    t2 = MeshShardedEmbedding(128, 4, _mesh(), optimizer="sgd")
+    with pytest.raises(IndexError):
+        t2.pull(np.array([4000], np.int64))
+
+
+def test_checkpoint_round_trip():
+    t1 = MeshShardedEmbedding(300, 4, _mesh(), optimizer="adagrad", seed=1)
+    t1.push(np.array([3, 250], np.int64), np.ones((2, 4), np.float32))
+    state = t1.state_dict()
+    t2 = MeshShardedEmbedding(300, 4, _mesh(), optimizer="adagrad", seed=9)
+    t2.set_state_dict(state)
+    np.testing.assert_allclose(np.asarray(t2.weight)[:300],
+                               np.asarray(t1.weight)[:300], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t2._acc)[:300],
+                               np.asarray(t1._acc)[:300], rtol=1e-6)
+
+
+def test_embedding_trains_end_to_end_on_mesh():
+    """Rows pulled into a jax loss, gradient pushed back; the looked-up
+    embedding moves toward the target while the rest of the table stays."""
+    table = MeshShardedEmbedding(4096, 8, _mesh(), optimizer="sgd", lr=0.25)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 4096, 64).astype(np.int64)
+    target = jnp.asarray(rng.normal(size=(64, 8)).astype(np.float32))
+
+    def loss_fn(rows):
+        # per-row squared error summed over the feature dim: the gradient
+        # scale is row-local, so the SGD factor is (1 - 2*lr) per step
+        return ((rows - target) ** 2).sum()
+
+    losses = []
+    for _ in range(10):
+        rows = table.pull(ids)
+        losses.append(float(loss_fn(rows)))
+        g = jax.grad(loss_fn)(rows)
+        table.push(ids, np.asarray(g))
+    assert losses[-1] < 0.2 * losses[0], losses
+
+
+@pytest.mark.slow
+def test_ten_million_rows_sparse_faster_than_replicated_dense():
+    """VERDICT done-criterion: a 10M-row embedding trains on the 8-device
+    mesh with per-shard lazy updates, measured faster than the replicated
+    dense update."""
+    V, d, n = 10_000_000, 8, 1024
+    table = MeshShardedEmbedding(V, d, _mesh(), optimizer="sgd", lr=0.1)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, n).astype(np.int64)
+    g = rng.normal(size=(n, d)).astype(np.float32)
+
+    table.push(ids, g)  # compile
+    t0 = time.perf_counter()
+    for _ in range(5):
+        table.push(ids, g)
+    jax.block_until_ready(table.weight)
+    sparse_t = (time.perf_counter() - t0) / 5
+
+    # replicated dense twin: full-table dense-gradient update each step
+    w = jnp.zeros((V, d), jnp.float32)
+
+    @jax.jit
+    def dense_step(w, ids, g):
+        dense_g = jnp.zeros_like(w).at[ids].add(g)
+        return w - 0.1 * dense_g
+
+    w = dense_step(w, jnp.asarray(ids), jnp.asarray(g))  # compile
+    jax.block_until_ready(w)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        w = dense_step(w, jnp.asarray(ids), jnp.asarray(g))
+    jax.block_until_ready(w)
+    dense_t = (time.perf_counter() - t0) / 3
+
+    assert sparse_t < dense_t, (sparse_t, dense_t)
+    # rows really trained
+    assert float(jnp.abs(table.pull(ids[:4])).sum()) > 0
