@@ -190,6 +190,25 @@ class MeshShardedEmbedding:
             ids_np = np.concatenate([ids_np, np.zeros(pad, np.int32)])
         return ids_np, n
 
+    def _check_capacity(self, padded, cap):
+        """A configured capacity smaller than the batch can overflow a
+        destination bucket — that would SILENTLY drop lookups/updates, so
+        refuse loudly here (host-side, ids are host arrays already)."""
+        if self.capacity is None:
+            return  # cap == per-rank n: overflow is impossible
+        per_rank = len(padded) // self.w
+        for r in range(self.w):
+            shard = padded[r * per_rank:(r + 1) * per_rank]
+            counts = np.bincount(
+                np.clip(shard // self.local_rows, 0, self.w - 1),
+                minlength=self.w)
+            worst = int(counts.max()) if counts.size else 0
+            if worst > cap:
+                raise ValueError(
+                    f"capacity={cap} overflows: rank {r} routes {worst} ids "
+                    f"to one owner shard; raise capacity (or leave it None "
+                    f"for the always-safe per-call bound)")
+
     def pull(self, ids):
         """ids: any int array -> [*, dim] float32 rows (device for in-range
         ids; spill-tier host rows merged in for overflow ids)."""
@@ -201,15 +220,19 @@ class MeshShardedEmbedding:
         padded, n = self._pad_global(
             np.where(dev_mask, ids_np, 0).astype(np.int32))
         cap = self.capacity or len(padded) // self.w
+        self._check_capacity(padded, cap)
         key = (len(padded), cap)
         if key not in self._pull_cache:
             self._pull_cache[key] = self._pull_program(cap)
-        rows = np.array(self._pull_cache[key](self.weight, jnp.asarray(padded)))[:n]
-        if spill_ids.size:
-            if self.spill is None:
-                raise IndexError(
-                    f"ids >= num_rows={self.num_rows} and no spill table")
-            rows[~dev_mask] = self.spill.pull(spill_ids)
+        dev_rows = self._pull_cache[key](self.weight, jnp.asarray(padded))
+        if not spill_ids.size:
+            # hot path: stay on device, no host round-trip
+            return dev_rows[:n].reshape(shape + (self.dim,))
+        if self.spill is None:
+            raise IndexError(
+                f"ids >= num_rows={self.num_rows} and no spill table")
+        rows = np.array(dev_rows)[:n]
+        rows[~dev_mask] = self.spill.pull(spill_ids)
         return jnp.asarray(rows.reshape(shape + (self.dim,)))
 
     def push(self, ids, grads):
@@ -231,6 +254,7 @@ class MeshShardedEmbedding:
         if pad:
             dev_g = np.concatenate([dev_g, np.zeros((pad, self.dim), np.float32)])
         cap = self.capacity or len(padded) // self.w
+        self._check_capacity(padded, cap)
         key = (len(padded), cap)
         if key not in self._push_cache:
             self._push_cache[key] = self._push_program(cap)

@@ -208,23 +208,16 @@ class Profiler:
     def export(self, path, format="json"):
         export_chrome_tracing(self, path)
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
-        agg = {}
-        for s in self._buffer.spans:
-            tot, cnt = agg.get(s.name, (0, 0))
-            agg[s.name] = (tot + (s.end_ns - s.start_ns), cnt + 1)
-        width = 78
-        lines = ["-" * width, f"{'Event':<40}{'Calls':>8}{'Total(ms)':>14}{'Avg(us)':>14}", "=" * width]
-        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-            lines.append(f"{name[:39]:<40}{cnt:>8}{tot / 1e6:>14.3f}{tot / cnt / 1e3:>14.1f}")
-        if self._step_spans:
-            tot = sum(d for _, d in self._step_spans)
-            lines.append("=" * width)
-            lines.append(
-                f"steps: {len(self._step_spans)}  avg step: {tot / len(self._step_spans) / 1e6:.3f} ms"
-            )
-        lines.append("-" * width)
-        out = "\n".join(lines)
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        """Aggregated statistics tables (reference profiler_statistic.py):
+        Overview + per-category (Operator/Dataloader/UserDefined/...) tables
+        with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys."""
+        from .statistics import summary_text
+
+        out = summary_text(self._buffer.spans, self._step_spans,
+                           sorted_by=sorted_by, op_detail=op_detail,
+                           time_unit=time_unit, views=views)
         print(out)
         return out
 

@@ -1,0 +1,173 @@
+"""Per-op aggregated profiler statistics tables.
+
+Reference: python/paddle/profiler/profiler_statistic.py — StatisticData
+aggregates the event tree into the Overview / Operator / Kernel / UserDefined
+summary tables printed by Profiler.summary(), sortable via SortedKeys, with
+per-row Calls / Total / Avg / Max / Min and ratio columns.
+
+TPU-native: host spans (RecordEvent) are the event source; the funnel tags
+every op span "op::<type>", steps are tagged by the profiler itself, and
+remaining spans are user-defined.  Device time on this runtime is the
+compiled step's wall share (XLA owns kernel scheduling; per-kernel device
+times live in the TensorBoard/XPlane trace the chrome export lines up with).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["EventSummary", "StatisticData", "summary_text"]
+
+_UNITS = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+@dataclass
+class EventSummary:
+    """Aggregated stats for one event name (reference EventSummary)."""
+
+    name: str
+    calls: int = 0
+    total_ns: int = 0
+    max_ns: int = 0
+    min_ns: int = field(default=2 ** 63 - 1)
+
+    def add(self, dur_ns):
+        self.calls += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = min(self.min_ns, dur_ns)
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.calls if self.calls else 0.0
+
+
+def _category(name):
+    if name.startswith("op::"):
+        return "Operator"
+    if name.startswith("step"):
+        return "ProfileStep"
+    if "dataloader" in name.lower() or name.startswith("io::"):
+        return "Dataloader"
+    if name.startswith("comm::") or name.startswith("nccl") or "all_reduce" in name:
+        return "Communication"
+    return "UserDefined"
+
+
+class StatisticData:
+    """Aggregates spans into per-category EventSummary maps
+    (reference StatisticData over the node trees)."""
+
+    def __init__(self, spans, step_spans=()):
+        self.by_category: dict[str, dict[str, EventSummary]] = {}
+        self.wall_ns = 0
+        t0, t1 = None, None
+        for s in spans:
+            cat = _category(s.name)
+            bucket = self.by_category.setdefault(cat, {})
+            ev = bucket.get(s.name)
+            if ev is None:
+                ev = bucket[s.name] = EventSummary(s.name)
+            ev.add(s.end_ns - s.start_ns)
+            t0 = s.start_ns if t0 is None else min(t0, s.start_ns)
+            t1 = s.end_ns if t1 is None else max(t1, s.end_ns)
+        self.step_spans = list(step_spans)
+        if self.step_spans:
+            self.wall_ns = sum(d for _, d in self.step_spans)
+        elif t0 is not None:
+            self.wall_ns = t1 - t0
+
+    def sorted_events(self, category, sorted_by=None):
+        from paddle_tpu.profiler import SortedKeys
+
+        events = list(self.by_category.get(category, {}).values())
+        key = {
+            None: lambda e: -e.total_ns,
+            SortedKeys.CPUTotal: lambda e: -e.total_ns,
+            SortedKeys.GPUTotal: lambda e: -e.total_ns,
+            SortedKeys.CPUAvg: lambda e: -e.avg_ns,
+            SortedKeys.GPUAvg: lambda e: -e.avg_ns,
+            SortedKeys.CPUMax: lambda e: -e.max_ns,
+            SortedKeys.GPUMax: lambda e: -e.max_ns,
+            SortedKeys.CPUMin: lambda e: e.min_ns,
+            SortedKeys.GPUMin: lambda e: e.min_ns,
+        }.get(sorted_by, lambda e: -e.total_ns)
+        return sorted(events, key=key)
+
+
+def _fmt_time(ns, unit):
+    return f"{ns / _UNITS[unit]:.3f}"
+
+
+def _table(title, headers, rows, widths):
+    total_w = sum(widths)
+    out = [
+        "-" * total_w,
+        title.center(total_w),
+        "-" * total_w,
+        "".join(h.rjust(w) if i else h.ljust(w) for i, (h, w) in enumerate(zip(headers, widths))),
+        "=" * total_w,
+    ]
+    for row in rows:
+        out.append("".join(
+            (c.rjust(w) if i else c.ljust(w))
+            for i, (c, w) in enumerate(zip(row, widths))))
+    out.append("-" * total_w)
+    return out
+
+
+def summary_text(spans, step_spans=(), sorted_by=None, op_detail=True,
+                 time_unit="ms", views=None):
+    """The reference Profiler.summary() table set: Overview + per-category
+    tables with Calls / Total / Avg / Max / Min / Ratio(%)."""
+    if time_unit not in _UNITS:
+        raise ValueError(f"time_unit must be one of {sorted(_UNITS)}")
+    data = StatisticData(spans, step_spans)
+    wall = max(data.wall_ns, 1)
+    u = time_unit
+    lines = []
+
+    # ---- Overview: wall breakdown per category (reference OverView)
+    rows = []
+    for cat, events in sorted(data.by_category.items()):
+        tot = sum(e.total_ns for e in events.values())
+        calls = sum(e.calls for e in events.values())
+        rows.append([cat, str(calls), _fmt_time(tot, u),
+                     f"{100.0 * tot / wall:.2f}"])
+    if data.step_spans:
+        rows.append(["ProfileStep(wall)", str(len(data.step_spans)),
+                     _fmt_time(data.wall_ns, u), "100.00"])
+    lines += _table(f"Overview Summary (time unit: {u})",
+                    ["Category", "Calls", f"Total({u})", "Ratio(%)"],
+                    rows, [34, 10, 16, 12])
+    lines.append("")
+
+    # ---- per-category detail tables
+    wanted = set(views) if views else None
+    for cat in sorted(data.by_category):
+        if wanted is not None and cat not in wanted:
+            continue
+        if cat == "ProfileStep" and not op_detail:
+            continue
+        rows = []
+        for e in data.sorted_events(cat, sorted_by):
+            name = e.name[4:] if e.name.startswith("op::") else e.name
+            rows.append([
+                name[:38], str(e.calls), _fmt_time(e.total_ns, u),
+                _fmt_time(e.avg_ns, u), _fmt_time(e.max_ns, u),
+                _fmt_time(e.min_ns, u), f"{100.0 * e.total_ns / wall:.2f}",
+            ])
+        title = {"Operator": "Operator Summary", "UserDefined": "UserDefined Summary",
+                 "Dataloader": "Dataloader Summary", "Communication": "Communication Summary",
+                 "ProfileStep": "ProfileStep Summary"}.get(cat, f"{cat} Summary")
+        lines += _table(f"{title} (time unit: {u})",
+                        ["Name", "Calls", f"Total({u})", f"Avg({u})",
+                         f"Max({u})", f"Min({u})", "Ratio(%)"],
+                        rows, [39, 8, 13, 13, 13, 13, 10])
+        lines.append("")
+
+    if data.step_spans:
+        n = len(data.step_spans)
+        lines.append(
+            f"steps: {n}  avg step: {data.wall_ns / n / _UNITS[u]:.3f} {u}")
+    return "\n".join(lines)

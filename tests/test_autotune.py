@@ -162,3 +162,34 @@ def test_seeded_v5e_cache_is_well_formed():
         assert at.validate_flash_tile(
             cfg["block_q"], cfg["block_k"],
             int(dims["seq_q"]), int(dims["seq_k"]), int(dims["head_dim"])) is None
+
+
+def test_v5p_readiness_geometry_and_peaks(tmp_cache):
+    """VERDICT r3 #10: tile configs validated for v5p geometry, per-device-
+    kind caches keyed by slug, peak table knows v5p, and no candidate that
+    busts the VMEM budget is ever proposed."""
+    from paddle_tpu.device.peaks import device_peak_tflops
+
+    assert device_peak_tflops("TPU v5p", "tpu") == 459.0
+    assert device_peak_tflops("TPU v5 lite", "tpu") == 197.0
+
+    # candidates at training shapes are all VMEM-valid
+    for seq in (2048, 4096):
+        cands = at.flash_candidates(seq, seq, 128)
+        assert cands, seq
+        for c in cands:
+            assert at.validate_flash_tile(
+                c["block_q"], c["block_k"], seq, seq, 128) is None
+    # beyond ~8k the whole-K/V-resident kernel cannot fit ANY tile in the
+    # 16 MiB VMEM budget: the candidate space is EMPTY rather than silently
+    # proposing an invalid tile (ring attention is the long-context path)
+    assert at.flash_candidates(8192, 8192, 128) == []
+    assert at.flash_candidates(32768, 32768, 128) == []
+
+    # a v5p cache is consulted independently of the v5e cache
+    key = {"seq_q": 4096, "seq_k": 4096, "head_dim": 128,
+           "dtype": "bfloat16", "causal": True}
+    at.record("flash_fwd", key, {"block_q": 256, "block_k": 128}, 1.0,
+              slug="tpu_v5p")
+    assert at.lookup("flash_fwd", key, slug="tpu_v5p") == {"block_q": 256, "block_k": 128}
+    assert at.lookup("flash_fwd", key, slug="tpu_v5_lite") != {"block_q": 256, "block_k": 128}

@@ -281,3 +281,137 @@ def test_watchdog_aborts_hung_collective(tmp_path):
     assert "allreduce" in out0
     assert "Task created at" in out0
     assert "UNREACHABLE" not in out0
+
+
+_P2P_PATTERN_WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    rank, world, port, store_port = (int(sys.argv[1]), int(sys.argv[2]),
+                                     sys.argv[3], sys.argv[4])
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port, num_processes=world, process_id=rank
+    )
+    sys.path.insert(0, "__REPO__")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.bootstrap import host_or_connect, store_barrier
+    from paddle_tpu.distributed.communication.watchdog import set_rendezvous_store
+    from paddle_tpu.distributed.collective import (
+        P2POp, UnmatchedP2PError, batch_isend_irecv, _coordinated_batch,
+    )
+
+    server, client = host_or_connect("127.0.0.1:" + store_port, rank == 0)
+    set_rendezvous_store(client)
+    peer = 1 - rank
+
+    def T(fill):
+        return paddle.to_tensor(np.full(3, float(fill), np.float32))
+
+    # ---- four-directions-style pattern, DIFFERENTLY-ORDERED lists ----
+    # two transfers each way; rank1's list interleaves recv/send in a
+    # different order than rank0's send/send/recv/recv
+    s_n, s_s = T(10 * rank + 1), T(10 * rank + 2)
+    r_n, r_s = T(0), T(0)
+    if rank == 0:
+        ops = [P2POp("isend", s_n, 1), P2POp("isend", s_s, 1),
+               P2POp("irecv", r_n, 1), P2POp("irecv", r_s, 1)]
+    else:
+        ops = [P2POp("irecv", r_n, 0), P2POp("isend", s_n, 0),
+               P2POp("irecv", r_s, 0), P2POp("isend", s_s, 0)]
+    for t in batch_isend_irecv(ops):
+        t.wait()
+    # FIFO per directed pair: first recv matches first send
+    assert np.allclose(np.asarray(r_n._value), 10 * peer + 1), np.asarray(r_n._value)
+    assert np.allclose(np.asarray(r_s._value), 10 * peer + 2), np.asarray(r_s._value)
+
+    # ---- partially-overlapping batches: one batch vs two calls ----
+    a, b = T(100 + rank), T(0)
+    if rank == 0:
+        for t in batch_isend_irecv([P2POp("isend", a, 1), P2POp("irecv", b, 1)]):
+            t.wait()
+    else:
+        for t in batch_isend_irecv([P2POp("irecv", b, 0)]):
+            t.wait()
+        for t in batch_isend_irecv([P2POp("isend", a, 0)]):
+            t.wait()
+    assert np.allclose(np.asarray(b._value), 100 + peer), np.asarray(b._value)
+
+    # ---- MIRROR overlap: the sender side splits across two calls ----
+    c, d = T(200 + rank), T(0)
+    if rank == 0:
+        for t in batch_isend_irecv([P2POp("isend", c, 1), P2POp("irecv", d, 1)]):
+            t.wait()
+    else:
+        for t in batch_isend_irecv([P2POp("isend", c, 0)]):
+            t.wait()
+        for t in batch_isend_irecv([P2POp("irecv", d, 0)]):
+            t.wait()
+    assert np.allclose(np.asarray(d._value), 200 + peer), np.asarray(d._value)
+
+    # ---- genuinely unmatched: LOUD error, not a hang ----
+    if rank == 0:
+        try:
+            _coordinated_batch([P2POp("irecv", T(0), 1)], client, 0,
+                               timeout_ms=2000)
+            raise SystemExit("expected UnmatchedP2PError")
+        except UnmatchedP2PError as e:
+            assert "no counterpart" in str(e)
+    store_barrier(client, "p2p_probe_done", world)
+
+    # ---- after the failed probe, the SAME direction still matches ----
+    # (tag rollback: the probe must not desync the FIFO counters)
+    e_, f_ = T(300 + rank), T(0)
+    if rank == 0:
+        ops2 = [P2POp("irecv", f_, 1)]
+    else:
+        ops2 = [P2POp("isend", e_, 0)]
+    for t in batch_isend_irecv(ops2):
+        t.wait()
+    if rank == 0:
+        assert np.allclose(np.asarray(f_._value), 301.0), np.asarray(f_._value)
+    store_barrier(client, "p2p_done", world)
+    print("rank " + str(rank) + " P2P OK", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_unmatched_p2p_patterns(tmp_path):
+    """VERDICT r3 #9: store-coordinated batch p2p resolves differently-
+    ordered and partially-overlapping send/recv patterns (four-directions
+    capability) and raises loudly on a missing counterpart."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "p2p_worker.py"
+    script.write_text(_P2P_PATTERN_WORKER.replace("__REPO__", repo))
+    import socket
+
+    ports = []
+    for _ in range(2):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    world = 2
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), str(ports[0]), str(ports[1])],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append((p.returncode, out))
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+    assert any("rank 0 P2P OK" in o for _, o in outs)
+    assert any("rank 1 P2P OK" in o for _, o in outs)

@@ -155,3 +155,28 @@ def test_ten_million_rows_sparse_faster_than_replicated_dense():
     assert sparse_t < dense_t, (sparse_t, dense_t)
     # rows really trained
     assert float(jnp.abs(table.pull(ids[:4])).sum()) > 0
+
+
+def test_configured_capacity_overflow_is_loud():
+    """A too-small explicit capacity must refuse loudly instead of silently
+    dropping lookups/gradients."""
+    table = MeshShardedEmbedding(1024, 4, _mesh(), optimizer="sgd", capacity=2)
+    # 32 ids all owned by shard 0 -> one rank's bucket needs >> 2 slots
+    ids = np.zeros(32, np.int64)
+    with pytest.raises(ValueError, match="capacity=2 overflows"):
+        table.pull(ids)
+    with pytest.raises(ValueError, match="overflows"):
+        table.push(ids, np.ones((32, 4), np.float32))
+    # a sufficient capacity still works
+    t2 = MeshShardedEmbedding(1024, 4, _mesh(), optimizer="sgd", capacity=4)
+    spread = np.arange(0, 1024, 32).astype(np.int64)  # even over shards
+    rows = t2.pull(spread)
+    assert rows.shape == (32, 4)
+
+
+def test_pull_stays_on_device_without_spill():
+    import jax
+
+    table = MeshShardedEmbedding(256, 4, _mesh(), optimizer="sgd")
+    out = table.pull(np.arange(16, dtype=np.int64))
+    assert isinstance(out, jax.Array)  # no host round-trip on the hot path
