@@ -50,16 +50,42 @@ def main():
 
     step = TrainStep(model, opt, loss_fn)
     rng = np.random.default_rng(0)
-    x = paddle.to_tensor(rng.standard_normal((B, 3, H, H)).astype(np.float32))
-    y = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int32))
-    step(x, y)
-    step(x, y)._value.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(x, y)
-    loss._value.block_until_ready()
-    dt = time.perf_counter() - t0
-    images_per_sec = B * iters / dt
+
+    def measure(batch, n_iters):
+        x = paddle.to_tensor(rng.standard_normal((batch, 3, H, H)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype(np.int32))
+        step(x, y)
+        step(x, y)._value.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            loss = step(x, y)
+        loss._value.block_until_ready()
+        return batch * n_iters / (time.perf_counter() - t0)
+
+    if on_accel:
+        # batch sweep: the MXU wants large batches (the A100 reference point
+        # runs B=256-class AMP batches); pick the best-throughput config
+        # that fits, largest first so an OOM falls through to smaller B
+        images_per_sec, best_b = 0.0, B
+        for batch in (256, 128, 64):
+            try:
+                ips = measure(batch, iters)
+            except Exception as e:
+                # only resource exhaustion is an expected sweep outcome;
+                # anything else is a real regression and must be visible
+                msg = f"{type(e).__name__}: {e}"
+                print(f"bench_resnet: B={batch} failed ({msg[:200]})",
+                      file=sys.stderr)
+                if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
+                    raise
+                continue
+            if ips > images_per_sec:
+                images_per_sec, best_b = ips, batch
+        B = best_b
+        if images_per_sec == 0.0:
+            images_per_sec = measure(B, iters)
+    else:
+        images_per_sec = measure(B, iters)
 
     # vs_baseline: peak-normalized chip-efficiency parity against the
     # written-down A100 reference point (BASELINE.md "A100 reference
@@ -75,6 +101,7 @@ def main():
         "value": round(images_per_sec, 2),
         "unit": "images/s",
         "vs_baseline": round(vs_baseline, 4),
+        "batch": B,
     }))
 
 

@@ -345,10 +345,13 @@ class UnmatchedP2PError(RuntimeError):
     loud version of the hang the reference's NCCL group launch produces."""
 
 
-# per-process FIFO tag counters per DIRECTED rank pair: the k-th send
-# src->dst matches the k-th recv src->dst posted anywhere on the receiver
-# (NCCL's implicit FIFO channel ordering)
+# per-process FIFO tag counters per (group, DIRECTED rank pair): the k-th
+# send src->dst matches the k-th recv src->dst posted anywhere on the
+# receiver within the same group (NCCL's implicit FIFO channel ordering)
 _p2p_dir_tags: dict = {}
+# per (group, unordered pair): how many slot-ordered transfers this process
+# has executed — both endpoints execute a pair's transfers in SLOT order
+_p2p_pair_done: dict = {}
 
 
 def _is_send(op):
@@ -360,100 +363,155 @@ def _is_send(op):
     return name in ("isend", "send")
 
 
+def _p2p_group_key(p):
+    """Identical on both endpoints; namespaces tags/slots so groups with
+    the same rank pair cannot cross-match."""
+    if p.group is None:
+        return "world"
+    return f"g{p.group.ring_id}." + ".".join(str(r) for r in p.group.ranks)
+
+
 def _coordinated_batch(p2p_op_list, store, me, timeout_ms=60_000):
     """Store-coordinated pattern resolution (VERDICT r3 #9; reference
-    four_directions_p2p_communication.py capability): each rank publishes
-    its op descriptors, waits for every counterpart (loud UnmatchedP2PError
-    instead of a hang), then executes its transfers as pairwise ppermute
-    executables in a canonical GLOBAL order — ranks' op lists may differ in
-    order and content as long as every transfer has a counterpart."""
+    four_directions_p2p_communication.py capability).
+
+    Protocol (race-free by construction):
+    1. every rank publishes a DESCRIPTOR per op (shape/dtype) keyed by
+       (group, direction, FIFO tag);
+    2. the SENDER of a transfer — and only the sender — proposes it into
+       the next per-pair SLOT (store.add is atomic) once the receiver's
+       descriptor is visible and the sender's lower tags of that direction
+       are already proposed;
+    3. both endpoints execute their pair's transfers strictly in slot
+       order, so they can never disagree on ordering no matter how the
+       store sweeps interleave;
+    4. anything still unexecuted at the deadline raises UnmatchedP2PError
+       naming the ops — never a silent hang — and FIFO tags roll back so a
+       failed probe does not desync later matched transfers (ghost slots
+       and descriptors are re-matched when the op is legitimately
+       re-posted at the same tag).
+    """
     import json as _json
+    import time as _time
 
     ops = []
     for p in p2p_op_list:
         is_send = _is_send(p.op)
+        gk = _p2p_group_key(p)
         src, dst = (me, p.peer) if is_send else (p.peer, me)
-        tag = _p2p_dir_tags.get((src, dst), 0)
-        _p2p_dir_tags[(src, dst)] = tag + 1
+        tag = _p2p_dir_tags.get((gk, src, dst), 0)
+        _p2p_dir_tags[(gk, src, dst)] = tag + 1
         t = p.tensor._value if hasattr(p.tensor, "_value") else p.tensor
         desc = {"shape": list(t.shape), "dtype": str(t.dtype)}
-        ops.append((src, dst, tag, is_send, p, desc))
+        ops.append({"gk": gk, "src": src, "dst": dst, "tag": tag,
+                    "is_send": is_send, "p": p, "desc": desc})
 
-    # publish EVERYTHING first — a rank must never block before its own
-    # posts are visible or two ranks can starve each other
-    for src, dst, tag, is_send, _p, desc in ops:
-        role = "s" if is_send else "r"
-        store.set(f"p2p/{src}-{dst}/{tag}/{role}", _json.dumps(desc).encode())
+    # 1. publish all descriptors first (set() also overwrites any ghost
+    # descriptor left by a previously failed probe at the same tag)
+    for o in ops:
+        role = "s" if o["is_send"] else "r"
+        store.set(
+            f"p2p/{o['gk']}/{o['src']}-{o['dst']}/{o['tag']}/{role}",
+            _json.dumps(o["desc"]).encode())
 
-    def _peek(src, dst, tag, other):
+    def _peek(key):
         try:
-            return store.get(f"p2p/{src}-{dst}/{tag}/{other}", timeout_ms=1)
+            return store.get(key, timeout_ms=1)
         except Exception:
             return None
 
-    def _canon(i):
-        src, dst, tag = ops[i][0], ops[i][1], ops[i][2]
-        return (min(src, dst), max(src, dst), src, tag)
+    def _pair_key(o):
+        a, b = sorted((o["src"], o["dst"]))
+        return f"{o['gk']}/{a}-{b}"
 
-    # AVAILABILITY-DRIVEN schedule: repeatedly execute the canonically-
-    # smallest op whose counterpart is already published.  Both endpoints
-    # of a pair see the same availability for their shared transfers, so
-    # they pick the same one — while an op whose counterpart lives in a
-    # peer's FUTURE call simply waits its turn instead of deadlocking the
-    # ops that are already matched (send-first and recv-first cross-call
-    # splits both resolve).
-    import time as _time
+    def _pg_for(p):
+        if p.group is not None:
+            return p.group
+        from paddle_tpu.distributed.communication.ops import _process_group_for
+
+        return _process_group_for(None)
 
     tasks: list = [None] * len(ops)
-    remaining = set(range(len(ops)))
-    executed: set = set()
+    remaining = dict(enumerate(ops))
+    proposed: set = set()
     deadline = _time.monotonic() + timeout_ms / 1e3
     try:
         while remaining:
-            ready = []
-            for i in remaining:
-                src, dst, tag, snd = ops[i][0], ops[i][1], ops[i][2], ops[i][3]
-                raw = _peek(src, dst, tag, "r" if snd else "s")
-                if raw is not None:
-                    ready.append((i, raw))
-            if not ready:
-                if _time.monotonic() > deadline:
+            progress = False
+
+            # 2. sender proposals
+            for i, o in sorted(remaining.items()):
+                if not o["is_send"] or i in proposed:
+                    continue
+                # direction FIFO: propose tags in order within this batch
+                if any(o2["is_send"] and i2 not in proposed
+                       and (o2["gk"], o2["src"], o2["dst"]) == (o["gk"], o["src"], o["dst"])
+                       and o2["tag"] < o["tag"]
+                       for i2, o2 in remaining.items()):
+                    continue
+                raw = _peek(f"p2p/{o['gk']}/{o['src']}-{o['dst']}/{o['tag']}/r")
+                if raw is None:
+                    continue
+                peer_desc = _json.loads(raw if isinstance(raw, str) else raw.decode())
+                if peer_desc != o["desc"]:
+                    raise ValueError(
+                        f"rank {me}: send {o['src']}->{o['dst']} tag "
+                        f"{o['tag']} descriptor mismatch: local {o['desc']} "
+                        f"vs peer {peer_desc}")
+                pk = _pair_key(o)
+                slot = store.add(f"p2pslot/{pk}/next", 1) - 1
+                store.set(f"p2pslot/{pk}/{slot}",
+                          _json.dumps([o["src"], o["dst"], o["tag"]]).encode())
+                proposed.add(i)
+                progress = True
+
+            # 3. slot-ordered execution per pair
+            for pk in sorted({_pair_key(o) for o in remaining.values()}):
+                k = _p2p_pair_done.get(pk, 0)
+                raw = _peek(f"p2pslot/{pk}/{k}")
+                if raw is None:
+                    continue
+                ident = tuple(_json.loads(raw if isinstance(raw, str) else raw.decode()))
+                mine = next(
+                    (i for i, o in remaining.items()
+                     if (o["src"], o["dst"], o["tag"]) == ident and _pair_key(o) == pk),
+                    None)
+                if mine is None:
+                    # the slot's transfer is not in this batch (a ghost from
+                    # a failed probe, or one of our future calls): the pair
+                    # stalls here — slot order is never violated
+                    continue
+                o = remaining[mine]
+                pg = _pg_for(o["p"])
+                tasks[mine] = (pg.send(o["p"].tensor, o["dst"]) if o["is_send"]
+                               else pg.recv(o["p"].tensor, o["src"]))
+                _p2p_pair_done[pk] = k + 1
+                del remaining[mine]
+                proposed.discard(mine)
+                progress = True
+
+            if remaining:
+                if progress:
+                    deadline = _time.monotonic() + timeout_ms / 1e3
+                elif _time.monotonic() > deadline:
                     missing = [
-                        f"{'send' if ops[i][3] else 'recv'} "
-                        f"{ops[i][0]}->{ops[i][1]} tag {ops[i][2]}"
-                        for i in sorted(remaining)
+                        f"{'send' if o['is_send'] else 'recv'} "
+                        f"{o['src']}->{o['dst']} tag {o['tag']}"
+                        for _i, o in sorted(remaining.items())
                     ]
                     raise UnmatchedP2PError(
-                        f"rank {me}: no counterpart posted for {missing} "
-                        f"within {timeout_ms} ms — the peer(s) never issued "
-                        "the matching op(s)")
-                _time.sleep(0.005)
-                continue
-            i, raw = min(ready, key=lambda ir: _canon(ir[0]))
-            src, dst, tag, is_send, p, desc = ops[i]
-            peer_desc = _json.loads(raw if isinstance(raw, str) else raw.decode())
-            if peer_desc != desc:
-                raise ValueError(
-                    f"rank {me}: {'send' if is_send else 'recv'} "
-                    f"{src}->{dst} tag {tag} descriptor mismatch: local "
-                    f"{desc} vs peer {peer_desc}")
-            if p.group is not None:
-                pg = p.group
-            else:
-                from paddle_tpu.distributed.communication.ops import _process_group_for
-
-                pg = _process_group_for(None)
-            tasks[i] = pg.send(p.tensor, dst) if is_send else pg.recv(p.tensor, src)
-            remaining.discard(i)
-            executed.add(i)
+                        f"rank {me}: no counterpart/slot progress for "
+                        f"{missing} within {timeout_ms} ms — the peer(s) "
+                        "never issued the matching op(s)")
+                else:
+                    _time.sleep(0.005)
     except Exception:
         # roll back the FIFO tags of every unexecuted op so a failed probe
-        # (or mismatch) cannot desync later matched transfers; our stale
-        # descriptor keys get overwritten on the re-post at the same tag
-        for i in sorted(remaining, key=lambda i: -ops[i][2]):
-            src, dst, tag = ops[i][0], ops[i][1], ops[i][2]
-            if _p2p_dir_tags.get((src, dst), 0) == tag + 1:
-                _p2p_dir_tags[(src, dst)] = tag
+        # (or mismatch) cannot desync later matched transfers
+        for _i, o in sorted(remaining.items(), key=lambda kv: -kv[1]["tag"]):
+            key = (o["gk"], o["src"], o["dst"])
+            if _p2p_dir_tags.get(key, 0) == o["tag"] + 1:
+                _p2p_dir_tags[key] = o["tag"]
         raise
     return tasks
 

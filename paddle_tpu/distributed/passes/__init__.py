@@ -96,8 +96,8 @@ class Fp16ProgramRewrite:
     casts the result back — the Variable avals (and so every consumer) are
     untouched, XLA fuses the cast pairs into the surrounding ops."""
 
-    WHITE = {"matmul", "mm", "bmm", "mv", "addmm", "einsum", "conv2d",
-             "conv1d", "conv3d", "flash_attention"}
+    WHITE = {"matmul", "mm", "bmm", "mv", "addmm", "einsum", "linear",
+             "conv2d", "conv1d", "conv3d", "flash_attention"}
 
     def __init__(self, dtype="bfloat16"):
         self.dtype = dtype

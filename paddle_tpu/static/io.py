@@ -105,16 +105,45 @@ def deserialize_program(blob: bytes):
     return jax.export.deserialize(blob)
 
 
-def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program=None, passes=None, precision=None, **kwargs):
     """Writes <prefix>.pdmodel (StableHLO bytecode via jax.export),
     <prefix>.pdmodel.txt (HLO text), <prefix>.json (manifest),
     <prefix>.pdiparams.npz (parameters, already folded into the HLO as
-    constants for serving; saved separately for inspection/re-export)."""
+    constants for serving; saved separately for inspection/re-export).
+
+    `passes` / `precision` are the export-time analog of the reference's
+    AnalysisConfig pass-pipeline + precision-mode controls
+    (paddle/fluid/inference/api/paddle_analysis_config.h pass_builder /
+    Precision): the named program passes from static.passes run over a
+    CLONE of the program before export, and precision="bfloat16"/"float16"
+    applies the fp16 cast-insertion rewrite — the optimized program is what
+    the .pdmodel bakes, so every Predictor serves it."""
     program = program or (feed_vars[0]._program if isinstance(feed_vars[0], Variable) else None)
     if program is None:
         from .program import default_main_program
 
         program = default_main_program()
+    applied = []
+    if passes or precision:
+        from .passes import apply_pass
+
+        program = program.clone(for_test=True)
+        for name in passes or []:
+            opts = dict(name) if isinstance(name, dict) else {}
+            pname = opts.pop("name", name) if isinstance(name, dict) else name
+            if pname == "dead_code_elimination":
+                # DCE without a fetch frontier is a documented no-op:
+                # forward the export's fetch set
+                opts.setdefault("fetch_vids", [v._vid for v in fetch_vars])
+            apply_pass(program, pname, **opts)
+            applied.append(pname)
+        if precision:
+            if precision not in ("bfloat16", "float16"):
+                raise ValueError(
+                    f"precision must be bfloat16/float16, got {precision!r}")
+            apply_pass(program, "auto_parallel_fp16", dtype=precision)
+            applied.append(f"auto_parallel_fp16:{precision}")
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
 
     blob, text = serialize_program(program, feed_vars, fetch_vars)
@@ -140,6 +169,7 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
             for v in fetch_vars
         ],
         "format": "stablehlo-text",
+        "passes": applied,
     }
     with open(path_prefix + ".json", "w") as f:
         json.dump(manifest, f, indent=2)

@@ -135,7 +135,7 @@ def test_two_process_process_group(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=480)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
@@ -406,7 +406,7 @@ def test_two_process_unmatched_p2p_patterns(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=480)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()

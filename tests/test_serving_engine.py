@@ -182,3 +182,56 @@ def test_mp_predictor_runs_partitioned():
         cfg.enable_tensor_parallel(mesh, input_specs=[PartitionSpec()])
         got = create_predictor(cfg).run([x])[0]
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_save_time_pass_and_precision_control():
+    """Export-time optimization surface (reference AnalysisConfig
+    pass_builder + precision mode): named passes + precision run over a
+    clone before export; the manifest records them; numerics shift by at
+    most low-precision rounding; the source program is untouched."""
+    import tempfile, os
+    import jax
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as Fn
+    import paddle_tpu.static as static
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static.program import Program, program_guard
+
+    paddle.seed(3)
+    fc1, fc2 = nn.Linear(16, 32), nn.Linear(32, 4)
+    prog = Program()
+    with program_guard(prog):
+        xv = prog.add_feed(prog.new_var(
+            jax.ShapeDtypeStruct((4, 16), np.float32), "x"))
+        out = paddle.tanh(fc2(Fn.relu(fc1(xv))))
+    types_before = [op.type for op in prog.global_block().ops]
+
+    x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        exe = static.Executor()
+        p32 = os.path.join(td, "fp32")
+        static.save_inference_model(p32, [xv], [out], exe, program=prog)
+        ref = create_predictor(Config(p32)).run([x])[0]
+
+        p16 = os.path.join(td, "bf16")
+        static.save_inference_model(
+            p16, [xv], [out], exe, program=prog,
+            passes=["dead_code_elimination"], precision="bfloat16")
+        import json as _json
+
+        manifest = _json.load(open(p16 + ".json"))
+        assert manifest["passes"] == ["dead_code_elimination",
+                                      "auto_parallel_fp16:bfloat16"]
+        got = create_predictor(Config(p16)).run([x])[0]
+        np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+        assert not np.allclose(got, ref, rtol=1e-7, atol=1e-9)  # really bf16
+
+    # the SOURCE program was cloned, not mutated
+    assert [op.type for op in prog.global_block().ops] == types_before
+
+    # invalid precision is loud
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="precision"):
+        static.save_inference_model("/tmp/x", [xv], [out], program=prog,
+                                    precision="int3")
