@@ -275,19 +275,16 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         )
 
         def step(alpha, t_lp):
+            # logaddexp keeps every operand FINITE (-1e30 sentinels), so
+            # the backward is NaN-free — the previous max-shift form
+            # produced inf*0 gradients through its log(0) dead branches
             p = jnp.take_along_axis(t_lp, jnp.clip(ext, 0, C - 1), axis=1)
-            a_prev = alpha
             a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf, lp.dtype), alpha[:, :-1]], axis=1)
             a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf, lp.dtype), alpha[:, :-2]], axis=1)
-            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
-            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
-            m_safe = jnp.where(m == neg_inf, 0.0, m)
-            summed = (
-                jnp.exp(a_prev - m_safe)
-                + jnp.exp(a_shift1 - m_safe)
-                + jnp.where(a_shift2 == neg_inf, 0.0, jnp.exp(a_shift2 - m_safe))
-            )
-            new_alpha = jnp.where(m == neg_inf, neg_inf, m_safe + jnp.log(summed)) + p
+            acc = jnp.logaddexp(alpha, a_shift1)
+            acc = jnp.where(same_as_prev2, acc, jnp.logaddexp(acc, a_shift2))
+            # clamp so dead paths cannot drift below the sentinel range
+            new_alpha = jnp.maximum(acc + p, neg_inf)
             return new_alpha, new_alpha
 
         alpha_T, alphas = jax.lax.scan(step, alpha0, lp[1:])
@@ -299,9 +296,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         sl1 = jnp.clip(2 * lbl_len - 1, 0, S - 1)
         a1 = jnp.take_along_axis(aT, sl[:, None], axis=1)[:, 0]
         a2 = jnp.take_along_axis(aT, sl1[:, None], axis=1)[:, 0]
-        m = jnp.maximum(a1, a2)
-        m_safe = jnp.where(m == neg_inf, 0.0, m)
-        ll = m_safe + jnp.log(jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe))
+        # empty target: both indices clip to 0 — mask the duplicate or the
+        # all-blank path is double-counted (exactly log 2 too likely)
+        a2 = jnp.where(lbl_len > 0, a2, neg_inf)
+        ll = jnp.logaddexp(a1, a2)
         loss = -ll
         if reduction == "mean":
             return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1.0))

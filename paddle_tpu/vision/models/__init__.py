@@ -14,6 +14,8 @@ from .mobilenet import (  # noqa: F401
     MobileNetV1, mobilenet_v1, MobileNetV2, mobilenet_v2,
     MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small, mobilenet_v3_large,
 )
+from .detection import PPYoloDet, ppyolo_tiny, ppyolo_s  # noqa: F401
+from .ocr import CRNN, ppocr_rec_tiny, ctc_greedy_decode  # noqa: F401
 from .big_nets import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
     GoogLeNet, googlenet, InceptionV3, inception_v3,
@@ -36,4 +38,6 @@ __all__ = [
     "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
     "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+    "PPYoloDet", "ppyolo_tiny", "ppyolo_s",
+    "CRNN", "ppocr_rec_tiny", "ctc_greedy_decode",
 ]
