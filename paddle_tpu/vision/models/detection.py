@@ -22,12 +22,14 @@ __all__ = ["PPYoloDet", "ppyolo_tiny", "ppyolo_s"]
 
 
 class ConvBNLayer(nn.Layer):
-    def __init__(self, cin, cout, k=3, stride=1, groups=1):
+    """conv + BN + activation (shared by the detection and OCR families)."""
+
+    def __init__(self, cin, cout, k=3, stride=1, groups=1, act="silu"):
         super().__init__()
         self.conv = nn.Conv2D(cin, cout, k, stride=stride,
                               padding=(k - 1) // 2, groups=groups, bias_attr=False)
         self.bn = nn.BatchNorm2D(cout)
-        self.act = nn.Silu()
+        self.act = {"silu": nn.Silu, "relu": nn.ReLU}[act]()
 
     def forward(self, x):
         return self.act(self.bn(self.conv(x)))

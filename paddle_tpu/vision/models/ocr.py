@@ -23,16 +23,11 @@ import paddle_tpu.nn as nn
 __all__ = ["CRNN", "ppocr_rec_tiny", "ctc_greedy_decode"]
 
 
-class _ConvBlock(nn.Layer):
-    def __init__(self, cin, cout, stride):
-        super().__init__()
-        self.conv = nn.Conv2D(cin, cout, 3, stride=stride, padding=1,
-                              bias_attr=False)
-        self.bn = nn.BatchNorm2D(cout)
-        self.act = nn.ReLU()
+from .detection import ConvBNLayer
 
-    def forward(self, x):
-        return self.act(self.bn(self.conv(x)))
+
+def _ConvBlock(cin, cout, stride):
+    return ConvBNLayer(cin, cout, k=3, stride=stride, act="relu")
 
 
 class CRNN(nn.Layer):

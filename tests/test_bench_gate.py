@@ -1,0 +1,48 @@
+"""Benchmark regression gate (reference tools/ci_op_benchmark.sh +
+check_op_benchmark_result.py: relative-regression CI gating)."""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+from tools.check_bench_regression import load_payload, main
+
+
+def _w(tmp_path, name, payload):
+    p = tmp_path / name
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_regression_detected_and_gated(tmp_path):
+    old = _w(tmp_path, "old.json",
+             {"metric": "m", "value": 100.0, "unit": "x", "vs_baseline": 1.0})
+    new = _w(tmp_path, "new.json",
+             {"metric": "m", "value": 90.0, "unit": "x", "vs_baseline": 0.9})
+    assert main([old, new, "--threshold", "0.05"]) == 1    # -10% fails
+    assert main([old, new, "--threshold", "0.15"]) == 0    # within 15%
+    ok = _w(tmp_path, "ok.json",
+            {"metric": "m", "value": 101.0, "unit": "x", "vs_baseline": 1.0})
+    assert main([old, ok]) == 0                            # improvement
+
+
+def test_driver_wrapper_payloads(tmp_path):
+    # the driver records {"rc", "tail"}; rc!=0 or value 0 must SKIP, not gate
+    bad = _w(tmp_path, "bad.json", {"rc": 3, "tail": '{"metric": "m", "value": 0.0}'})
+    good = _w(tmp_path, "good.json",
+              {"rc": 0, "tail": 'warning line\n{"metric": "m", "value": 50.0, "unit": "x"}'})
+    assert load_payload(bad)[0] is None
+    assert load_payload(good)[0] == ("m", 50.0)
+    assert main([bad, good]) == 0   # unhealthy old run never gates
+    # and the real driver files from previous rounds parse without crashing
+    import os
+
+    for f in ("BENCH_r01.json", "BENCH_r02.json", "BENCH_r03.json"):
+        if os.path.exists(f):
+            load_payload(f)
+
+
+def test_mismatched_metrics_skip(tmp_path):
+    a = _w(tmp_path, "a.json", {"metric": "a", "value": 10.0})
+    b = _w(tmp_path, "b.json", {"metric": "b", "value": 10.0})
+    assert main([a, b]) == 0

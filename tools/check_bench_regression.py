@@ -1,0 +1,85 @@
+"""Benchmark regression gate.
+
+Reference: tools/ci_op_benchmark.sh + tools/check_op_benchmark_result.py —
+the reference CI compares op-benchmark logs between base and PR builds and
+fails on relative regressions beyond a threshold.
+
+Usage:
+    python tools/check_bench_regression.py BENCH_r03.json BENCH_r04.json \
+        [--threshold 0.05]
+
+Each file holds the driver-recorded bench payload: either the raw JSON line
+bench.py prints ({"metric", "value", ...}) or the driver wrapper with
+stdout/rc fields.  Exit 1 (loud) when the new value regresses more than
+`threshold` relative to the old on the same metric; missing/failed runs
+(rc != 0 or value 0) are reported but never counted as regressions — an
+unhealthy tunnel must not mask or fabricate a perf signal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_payload(path):
+    """-> (metric, value) or (None, reason)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable ({e})"
+    if isinstance(data, dict) and ("stdout" in data or "tail" in data):
+        rc = data.get("rc", data.get("returncode"))
+        if rc not in (0, None):
+            return None, f"rc={rc}"
+        text = str(data.get("stdout") or data.get("tail") or "")
+        for line in reversed(text.strip().splitlines()):
+            try:
+                inner = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(inner, dict) and "metric" in inner:
+                data = inner
+                break
+        else:
+            return None, "no metric line in stdout"
+    if not isinstance(data, dict) or "metric" not in data:
+        return None, "no metric field"
+    try:
+        value = float(data.get("value", 0.0))
+    except (TypeError, ValueError):
+        return None, f"non-numeric value {data.get('value')!r}"
+    if value <= 0.0:
+        return None, "zero/failed value"
+    return (data["metric"], value), None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="max allowed relative regression (default 5%%)")
+    args = p.parse_args(argv)
+
+    old, old_err = load_payload(args.old)
+    new, new_err = load_payload(args.new)
+    if old is None or new is None:
+        print(f"bench gate: SKIP — old: {old_err or 'ok'}; new: {new_err or 'ok'} "
+              "(unhealthy runs are never counted as regressions)")
+        return 0
+    om, ov = old
+    nm, nv = new
+    if om != nm:
+        print(f"bench gate: SKIP — metrics differ ({om} vs {nm})")
+        return 0
+    rel = (nv - ov) / ov
+    status = "REGRESSION" if rel < -args.threshold else "ok"
+    print(f"bench gate [{om}]: {ov:.2f} -> {nv:.2f} ({rel:+.2%}) {status}")
+    return 1 if status == "REGRESSION" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
