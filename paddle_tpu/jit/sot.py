@@ -164,6 +164,7 @@ _SUPPORTED_OPS = frozenset({
     "LOAD_GLOBAL", "LOAD_DEREF", "LOAD_ATTR", "LOAD_METHOD", "KW_NAMES",
     "CALL", "BINARY_OP", "UNARY_NEGATIVE", "UNARY_NOT", "UNARY_INVERT",
     "UNARY_POSITIVE", "COMPARE_OP", "IS_OP", "CONTAINS_OP",
+    "FORMAT_VALUE", "BUILD_STRING",
     "BINARY_SUBSCR", "BINARY_SLICE", "BUILD_SLICE", "BUILD_TUPLE", "BUILD_LIST",
     "BUILD_MAP", "BUILD_SET", "BUILD_CONST_KEY_MAP", "LIST_EXTEND", "LIST_APPEND",
     "SET_ADD", "MAP_ADD", "UNPACK_SEQUENCE", "POP_JUMP_IF_FALSE",
@@ -733,6 +734,18 @@ class _Interpreter:
         if op == "BINARY_SLICE":  # 3.12: x[a:b] without BUILD_SLICE
             stop, start, obj = st.pop(), st.pop(), st.pop()
             st.append(self._call(lambda o, a, b: o[a:b], (obj, start, stop)))
+            return idx + 1
+        if op == "FORMAT_VALUE":  # f-strings (3.11/3.12 pre-3.13 encoding)
+            spec = st.pop() if inst.arg & 0x04 else ""
+            v = st.pop()
+            if _is_symbolic(v):
+                raise Unsupported("formatting a symbolic tensor")
+            conv = {0: lambda x: x, 1: str, 2: repr, 3: ascii}[inst.arg & 0x03]
+            st.append(format(conv(v), spec))
+            return idx + 1
+        if op == "BUILD_STRING":
+            parts = [st.pop() for _ in range(inst.arg)][::-1]
+            st.append("".join(parts))
             return idx + 1
         if op == "BUILD_SLICE":
             if inst.arg == 3:

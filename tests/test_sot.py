@@ -403,3 +403,29 @@ def test_layer_with_custom_call_runs_natively_not_inlined():
     sot = symbolic_translate(fn)
     x = T([1.0, 2.0])
     np.testing.assert_allclose(float(sot(x)._value), float(fn(x)._value), rtol=1e-6)
+
+
+def test_fstring_in_inlined_helper():
+    """f-strings over PYTHON values interpret fine (FORMAT_VALUE /
+    BUILD_STRING); an f-string over a symbolic tensor falls back."""
+    def helper(v, name):
+        tag = f"scale[{name}]"
+        return v * (2.0 if len(tag) > 3 else 1.0)
+
+    def fn(x):
+        return helper(x, "a").sum()
+
+    before = sot_stats()["fallbacks"]
+    sot = symbolic_translate(fn)
+    x = T([1.0, 2.0])
+    np.testing.assert_allclose(float(sot(x)._value), float(fn(x)._value), rtol=1e-6)
+    assert sot_stats()["fallbacks"] == before
+
+    def bad(x):
+        s = f"{x}"  # formatting the symbolic tensor itself
+        return x * float(len(s))
+
+    sot2 = symbolic_translate(bad)
+    np.testing.assert_allclose(
+        np.asarray(sot2(x)._value), np.asarray(bad(x)._value), rtol=1e-6)
+    assert sot_stats()["fallbacks"] == before + 1
