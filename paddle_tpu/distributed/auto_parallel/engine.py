@@ -51,9 +51,9 @@ class Strategy:
         self.sharding_degree = sharding.get("degree", 1)
         self.sharding_stage = sharding.get("stage", 1)
         self.sharding_enable = sharding.get("enable", False)
-        # gradient merge / amp accepted but handled by TrainStep/amp
         self.amp = config.get("amp", {})
         self.gradient_merge = config.get("gradient_merge", {})
+        self.recompute = config.get("recompute", {})
         self.pipeline = config.get("pipeline", {})
         # overrides merged into the auto-mode tuner_cfg (hbm_gb, candidate
         # lists, ...) — the reference reads these from the tuner json
@@ -233,6 +233,24 @@ class Engine:
         if self._train_step is not None:
             return
         from paddle_tpu.distributed.sharded_step import ShardedTrainStep
+
+        # strategy-driven transforms (reference engine.py Parallelizer
+        # applying the distributed passes before compilation)
+        gm = self._strategy.gradient_merge or {}
+        if gm.get("enable"):
+            from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+            if not isinstance(self._optimizer, GradientMergeOptimizer):
+                self._optimizer = GradientMergeOptimizer(
+                    self._optimizer, k_steps=int(gm.get("k_steps", 1)),
+                    avg=gm.get("avg", True))
+        rc = self._strategy.recompute or {}
+        if rc.get("enable"):
+            from paddle_tpu.distributed.passes import PassContext, new_pass
+
+            new_pass("auto_parallel_recompute",
+                     {"layers": rc.get("layers")}).apply(
+                PassContext(self._model, self._optimizer))
 
         loss_obj = self._loss
 

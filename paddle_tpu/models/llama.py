@@ -370,19 +370,62 @@ class LlamaForCausalLM(nn.Layer):
         return paddle.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
 
     @paddle.no_grad()
-    def generate(self, input_ids, max_new_tokens=16, cache: str = "paged", block_size: int = 16):
-        """Greedy incremental decode (serving path).
+    def generate(self, input_ids, max_new_tokens=16, cache: str = "paged",
+                 block_size: int = 16, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 seed=None, decode_strategy=None):
+        """Incremental decode (serving path): greedy by default; sampling
+        with temperature / top-k / top-p via do_sample=True (the reference
+        generate()'s decode_strategy="sampling" surface,
+        python/paddle/generation lineage).
 
         cache="naive": per-layer concat caches (reference use_cache
         semantics; shapes grow each step, eager).
         cache="paged": block-pooled KV (reference block_multihead_attention,
         paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu):
-        static shapes, so every decode step reuses ONE compiled program;
-        pool memory is allocated per block_size-token page.
+        static shapes, so every decode step reuses ONE compiled program —
+        sampling runs INSIDE it (jax.random.categorical, per-step fold_in).
         """
         import numpy as np
 
         import jax
+
+        if decode_strategy is not None:
+            if decode_strategy not in ("sampling", "greedy_search"):
+                raise ValueError(
+                    f"decode_strategy must be 'sampling' or 'greedy_search', "
+                    f"got {decode_strategy!r}")
+            do_sample = decode_strategy == "sampling"
+        if do_sample and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        base_key = None
+        if do_sample:
+            # derive the key lazily: greedy decode must not advance the
+            # global RNG stream (seed-reproducibility of existing scripts)
+            if seed is not None:
+                base_key = jax.random.PRNGKey(int(seed))
+            else:
+                from paddle_tpu._core import random as _rng
+
+                base_key = _rng.next_key()
+
+        def _select(logits2d, step):
+            """[B, V] raw logits -> [B] next ids (greedy or sampled)."""
+            if not do_sample:
+                return jnp.argmax(logits2d, axis=-1)
+            lg = logits2d.astype(jnp.float32) / jnp.float32(max(temperature, 1e-6))
+            if top_k and top_k > 0:
+                kth = jax.lax.top_k(lg, min(int(top_k), lg.shape[-1]))[0][:, -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            if top_p < 1.0:
+                sort = jnp.sort(lg, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sort, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # keep the smallest prefix with mass >= top_p (always >= 1)
+                keep = cum - probs < jnp.float32(top_p)
+                cutoff = jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True)
+                lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+            return jax.random.categorical(jax.random.fold_in(base_key, step), lg, axis=-1)
 
         cfg = self.config
         b, s0 = int(input_ids.shape[0]), int(input_ids.shape[1])
@@ -399,14 +442,18 @@ class LlamaForCausalLM(nn.Layer):
             for _ in range(n_layers)
         ]
         h, caches = _model_forward_cached(self.model, input_ids, empty, 0)
-        next_tok = paddle.argmax(self._logits(h[:, -1:, :]), axis=-1)
+        next_tok = Tensor(
+            _select(self._logits(h[:, -1:, :])._value[:, -1, :], 0)
+            .astype(jnp.int32)[:, None])
         out_tokens = [next_tok]
 
         if cache == "naive":
             cur = caches
             for step in range(1, max_new_tokens):
                 h, cur = _model_forward_cached(self.model, next_tok, cur, s0 + step - 1)
-                next_tok = paddle.argmax(self._logits(h), axis=-1)
+                next_tok = Tensor(
+                    _select(self._logits(h)._value[:, -1, :], step)
+                    .astype(jnp.int32)[:, None])
                 out_tokens.append(next_tok)
             return paddle.concat(out_tokens, axis=1)
 
@@ -441,7 +488,7 @@ class LlamaForCausalLM(nn.Layer):
 
         state = list(self.state_dict().values())
 
-        def step_fn(state_vals, pool_vals, tok, lens):
+        def step_fn(state_vals, pool_vals, tok, lens, step_i):
             originals = [t._value for t in state]
             try:
                 for t, v in zip(state, state_vals):
@@ -457,7 +504,7 @@ class LlamaForCausalLM(nn.Layer):
                         new_pools.append((kc, vc))
                     hh = self.model.norm(hh)
                     logits = self._logits(hh)
-                return jnp.argmax(logits._value[:, -1, :], axis=-1).astype(tok.dtype)[:, None], new_pools
+                return _select(logits._value[:, -1, :], step_i).astype(tok.dtype)[:, None], new_pools
             finally:
                 for t, v in zip(state, originals):
                     t._bind(v)
@@ -468,7 +515,7 @@ class LlamaForCausalLM(nn.Layer):
         state_vals = [t._value for t in state]
         for step in range(1, max_new_tokens):
             lens = lens + 1  # the new token occupies slot lens (0-based)
-            tok, pools = jit_step(state_vals, pools, tok, lens)
+            tok, pools = jit_step(state_vals, pools, tok, lens, jnp.int32(step))
             out_tokens.append(Tensor(tok))
         return paddle.concat(out_tokens, axis=1)
 
