@@ -248,8 +248,16 @@ class Engine:
         if rc.get("enable"):
             from paddle_tpu.distributed.passes import PassContext, new_pass
 
+            layers = rc.get("layers")
+            cfg = getattr(self._model, "config", None)
+            if layers is None and not hasattr(cfg, "use_recompute"):
+                raise ValueError(
+                    "strategy.recompute.enable needs 'layers' (sublayer "
+                    "names to wrap) for models without a config."
+                    "use_recompute switch — otherwise it would be a "
+                    "silent no-op")
             new_pass("auto_parallel_recompute",
-                     {"layers": rc.get("layers")}).apply(
+                     {"layers": layers}).apply(
                 PassContext(self._model, self._optimizer))
 
         loss_obj = self._loss

@@ -370,10 +370,84 @@ class LlamaForCausalLM(nn.Layer):
         return paddle.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
 
     @paddle.no_grad()
+    def _beam_search(self, input_ids, max_new_tokens, num_beams, length_penalty=0.0):
+        """Beam search over the naive cache path (the reference generate()'s
+        decode_strategy="beam_search", python/paddle generation lineage).
+
+        TPU-native shape discipline: the beam frontier is a FIXED [B*K]
+        batch — expand once after prefill, then each step scores [B, K*V],
+        takes top-K, and reorders the caches by beam index (a gather on the
+        batch axis); every step has identical shapes."""
+        import jax
+
+        cfg = self.config
+        b, s0 = int(input_ids.shape[0]), int(input_ids.shape[1])
+        K = int(num_beams)
+        n_layers = cfg.num_hidden_layers
+        nkv = cfg.num_key_value_heads
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        V = cfg.vocab_size
+
+        empty = [
+            (paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype),
+             paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype))
+            for _ in range(n_layers)
+        ]
+        h, caches = _model_forward_cached(self.model, input_ids, empty, 0)
+        logp = jax.nn.log_softmax(
+            self._logits(h[:, -1:, :])._value[:, -1, :].astype(jnp.float32), -1)
+
+        # first step: per sequence, the K best first tokens seed the beams
+        scores, first = jax.lax.top_k(logp, K)           # [B, K]
+        beams = first[:, :, None].astype(jnp.int32)      # [B, K, 1]
+        # expand caches to the beam frontier: [B, ...] -> [B*K, ...]
+        def expand(t):
+            v = t._value
+            return Tensor(jnp.repeat(v, K, axis=0))
+        caches = [(expand(k), expand(v)) for k, v in caches]
+
+        for step in range(1, max_new_tokens):
+            tok = Tensor(beams[:, :, -1].reshape(b * K, 1))
+            h, caches = _model_forward_cached(self.model, tok, caches,
+                                              s0 + step - 1)
+            lp = jax.nn.log_softmax(
+                self._logits(h)._value[:, -1, :].astype(jnp.float32), -1)
+            total = scores.reshape(b * K, 1) + lp        # [B*K, V]
+            total = total.reshape(b, K * V)
+            scores, flat = jax.lax.top_k(total, K)       # [B, K]
+            beam_idx = flat // V                         # [B, K] source beam
+            tok_idx = (flat % V).astype(jnp.int32)
+            beams = jnp.concatenate(
+                [jnp.take_along_axis(beams, beam_idx[:, :, None], axis=1),
+                 tok_idx[:, :, None]], axis=2)
+            # reorder the beam-expanded caches by the winning source beams
+            gather = (jnp.arange(b)[:, None] * K + beam_idx).reshape(-1)
+            caches = [
+                (Tensor(jnp.take(k._value, gather, axis=0)),
+                 Tensor(jnp.take(v._value, gather, axis=0)))
+                for k, v in caches
+            ]
+
+        if length_penalty:
+            # no EOS termination in this path, so every beam has the same
+            # length and a shared positive divisor cannot reorder them —
+            # accepted for reference-signature parity, surfaced as a no-op
+            import warnings
+
+            warnings.warn(
+                "length_penalty has no effect without EOS-terminated beams "
+                "(all beams share length max_new_tokens)", stacklevel=2)
+            scores = scores / (float(max_new_tokens) ** float(length_penalty))
+        best = jnp.argmax(scores, axis=1)                # [B]
+        out = jnp.take_along_axis(beams, best[:, None, None], axis=1)[:, 0, :]
+        return Tensor(out)
+
+    @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens=16, cache: str = "paged",
                  block_size: int = 16, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
-                 seed=None, decode_strategy=None):
+                 seed=None, decode_strategy=None, num_beams: int = 1,
+                 length_penalty: float = 0.0):
         """Incremental decode (serving path): greedy by default; sampling
         with temperature / top-k / top-p via do_sample=True (the reference
         generate()'s decode_strategy="sampling" surface,
@@ -391,11 +465,23 @@ class LlamaForCausalLM(nn.Layer):
         import jax
 
         if decode_strategy is not None:
-            if decode_strategy not in ("sampling", "greedy_search"):
+            if decode_strategy not in ("sampling", "greedy_search", "beam_search"):
                 raise ValueError(
-                    f"decode_strategy must be 'sampling' or 'greedy_search', "
-                    f"got {decode_strategy!r}")
+                    f"decode_strategy must be 'sampling', 'greedy_search' or "
+                    f"'beam_search', got {decode_strategy!r}")
             do_sample = decode_strategy == "sampling"
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError(
+                    "num_beams > 1 is deterministic beam search; drop "
+                    "do_sample/decode_strategy='sampling' (beam-sampling "
+                    "is not implemented)")
+            # beam frontier runs on the naive cache path (growing shapes);
+            # cache=/block_size= do not apply here
+            return self._beam_search(input_ids, max_new_tokens,
+                                     num_beams=num_beams,
+                                     length_penalty=length_penalty)
+        # decode_strategy='beam_search' with num_beams=1 IS greedy search
         if do_sample and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         base_key = None
