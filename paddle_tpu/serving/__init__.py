@@ -36,6 +36,9 @@ class _Slot:
     blocks: list = field(default_factory=list)
     last_token: int = 0
     generated: list = field(default_factory=list)
+    # per-request decode config (temperature-sampling tier; 0 = greedy)
+    temperature: float = 0.0
+    key: object = None        # precomputed PRNG key (seed + request nonce)
 
 
 class GenerationEngine:
@@ -114,6 +117,7 @@ class GenerationEngine:
         self._results: dict = {}
         self._max_blocks_per_seq = max(2, self._num_blocks // max(1, self.max_batch))
         self._step_fn = None
+        self._req_counter = 0
         self._state = list(model.state_dict().values())
 
     # ------------------------------------------------------------ requests
@@ -137,8 +141,17 @@ class GenerationEngine:
         slot.active = False
         slot.rid = None
 
-    def add_request(self, rid, prompt_ids, max_new_tokens=16):
-        """Prefill the prompt, pour K/V into pool pages, occupy a slot."""
+    def add_request(self, rid, prompt_ids, max_new_tokens=16,
+                    temperature=None, seed=0):
+        """Prefill the prompt, pour K/V into pool pages, occupy a slot.
+
+        temperature: None/0 -> greedy decode for this request;
+        > 0 -> per-request temperature sampling, deterministic per
+        (seed, join order) — the seed is folded with a per-request nonce so
+        same-seed requests still draw distinct streams, and each request
+        folds its OWN generated-token counter per step.  Requests with
+        different decode configs share the ONE compiled decode program
+        (the config rides in as per-slot arrays)."""
         import paddle_tpu as paddle
         from paddle_tpu.models.llama import _model_forward_cached
 
@@ -166,9 +179,8 @@ class GenerationEngine:
         ]
         with paddle.no_grad():
             h, caches = _model_forward_cached(model.model, paddle.to_tensor(prompt), empty, 0)
-            first = int(np.asarray(
-                paddle.argmax(model._logits(h[:, -1:, :]), axis=-1)._value
-            ).reshape(-1)[0])
+            logits_last = model._logits(h[:, -1:, :])._value[0, -1, :]
+            first = int(np.asarray(jnp.argmax(logits_last)))
 
         # pour prefill K/V into this request's pages
         bs = self.block_size
@@ -196,6 +208,19 @@ class GenerationEngine:
         slot.seq_len = s0
         slot.max_len = max_len
         slot.blocks = blocks
+        slot.temperature = float(temperature or 0.0)
+        # seed folded with a request nonce: same-seed requests get distinct
+        # streams; computed ONCE here, not per decode tick
+        nonce = self._req_counter
+        self._req_counter += 1
+        slot.key = np.asarray(
+            jax.random.fold_in(jax.random.PRNGKey(int(seed)), nonce))
+        if slot.temperature > 0.0:
+            # re-pick the FIRST token by sampling (prefill used argmax);
+            # fold index 0 = this request's first generated token
+            lg = logits_last.astype(jnp.float32) / slot.temperature
+            key = jax.random.fold_in(jnp.asarray(slot.key), 0)
+            first = int(np.asarray(jax.random.categorical(key, lg)))
         slot.last_token = first
         slot.generated = [first]
         self._results[rid] = slot.generated
@@ -218,7 +243,7 @@ class GenerationEngine:
         model = self.model
         state = self._state
 
-        def step(state_vals, kpools, vpools, tokens, tables, lens):
+        def step(state_vals, kpools, vpools, tokens, tables, lens, temps, keys, steps):
             originals = [t._value for t in state]
             try:
                 for t, v in zip(state, state_vals):
@@ -236,7 +261,17 @@ class GenerationEngine:
                         new_v.append(vc)
                     h = model.model.norm(h)
                     logits = model._logits(h)
-                    nxt = jnp.argmax(logits._value[:, -1, :], axis=-1).astype(jnp.int32)
+                lg = logits._value[:, -1, :]
+                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # per-slot temperature sampling inside the SAME program:
+                # fold the step index into each slot's key, sample per row,
+                # select sampled vs greedy by the per-slot mask
+                safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+                # each slot folds its OWN generated-token counter
+                skeys = jax.vmap(jax.random.fold_in)(keys, steps)
+                sampled = jax.vmap(jax.random.categorical)(
+                    skeys, lg.astype(jnp.float32) / safe_t).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
                 return nxt, new_k, new_v
             finally:
                 for t, v in zip(state, originals):
@@ -255,12 +290,18 @@ class GenerationEngine:
         tokens = np.zeros((B, 1), np.int32)
         tables = np.zeros((B, W), np.int32)
         lens = np.ones((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        steps = np.zeros((B,), np.uint32)
         for i, s in enumerate(self._slots):
             if s.active:
                 tokens[i, 0] = s.last_token
                 row = list(s.blocks) + [s.blocks[-1]] * (W - len(s.blocks))
                 tables[i] = row
                 lens[i] = s.seq_len + 1  # includes the token being decoded
+                temps[i] = s.temperature
+                keys[i] = s.key
+                steps[i] = len(s.generated)  # fold index for this request
             else:
                 tables[i] = self._scratch[i]  # park masked lanes off-pool
                 lens[i] = 1
@@ -269,6 +310,7 @@ class GenerationEngine:
             [t._value for t in self._state],
             list(self._kpools), list(self._vpools),
             jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(lens),
+            jnp.asarray(temps), jnp.asarray(keys), jnp.asarray(steps),
         )
         self._kpools = list(new_k)
         self._vpools = list(new_v)

@@ -235,3 +235,36 @@ def test_save_time_pass_and_precision_control():
     with _pytest.raises(ValueError, match="precision"):
         static.save_inference_model("/tmp/x", [xv], [out], program=prog,
                                     precision="int3")
+
+
+def test_per_request_sampling_in_shared_program():
+    """Greedy and temperature-sampled requests decode TOGETHER in the one
+    compiled program: the greedy slot still matches standalone generate,
+    the sampled slot is deterministic per (seed, join order)."""
+    model = _model()
+    p1, p2 = [5, 9, 17, 33, 2], [7, 11, 3]
+    ref1 = _ref_generate(model, p1, 8)
+
+    def run():
+        eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=16)
+        eng.add_request("greedy", p1, max_new_tokens=8)
+        eng.add_request("hot", p2, max_new_tokens=6, temperature=5.0, seed=42)
+        while eng.has_work():
+            eng.step()
+        return eng.result("greedy"), eng.result("hot")
+
+    g1, h1 = run()
+    g2, h2 = run()
+    assert g1 == ref1 == g2          # greedy unaffected by the hot neighbor
+    assert h1 == h2                  # deterministic per seed + join order
+    assert all(0 <= t < 128 for t in h1)
+    ref2 = _ref_generate(model, p2, 6)
+    assert h1 != ref2                # hot sampling really deviates from greedy
+
+    # same seed, two sampled requests: DISTINCT streams (per-request nonce)
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=16)
+    eng.add_request("a", p2, max_new_tokens=6, temperature=5.0, seed=1)
+    eng.add_request("b", p2, max_new_tokens=6, temperature=5.0, seed=1)
+    while eng.has_work():
+        eng.step()
+    assert eng.result("a") != eng.result("b")
