@@ -141,6 +141,7 @@ def child() -> int:
     on_accel = platform not in ("cpu",)
 
     import paddle_tpu as paddle
+    from paddle_tpu.device import hard_sync
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
@@ -190,13 +191,13 @@ def child() -> int:
     labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int64))
 
     step(ids, labels)  # builds optimizer state on host, compiles, runs
-    step(ids, labels)._value.block_until_ready()
+    hard_sync(step(ids, labels))
 
     t0 = time.perf_counter()
     loss = None
     for _ in range(iters):
         loss = step(ids, labels)
-    loss._value.block_until_ready()
+    hard_sync(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = B * S * iters / dt

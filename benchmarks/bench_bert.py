@@ -20,6 +20,7 @@ def main():
     on_accel = jax.devices()[0].platform != "cpu"
 
     import paddle_tpu as paddle
+    from paddle_tpu.device import hard_sync
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import BertConfig, BertForSequenceClassification, bert_tiny
 
@@ -47,11 +48,11 @@ def main():
     ids = paddle.to_tensor(rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32))
     y = paddle.to_tensor(rng.integers(0, 2, (B,)).astype(np.int32))
     step(ids, y)
-    step(ids, y)._value.block_until_ready()
+    hard_sync(step(ids, y))
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, y)
-    loss._value.block_until_ready()
+    hard_sync(loss)
     dt = time.perf_counter() - t0
     tokens_per_sec = B * S * iters / dt
 

@@ -27,6 +27,7 @@ def main():
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.device import hard_sync
     from paddle_tpu.jit import TrainStep
 
     d, n_exp, V = (512, 8, 32000) if on_accel else (32, 4, 128)
@@ -86,11 +87,11 @@ def main():
     ids = paddle.to_tensor(rng.integers(0, V, (B, S)).astype(np.int32))
     labels = paddle.to_tensor(rng.integers(0, V, (B, S)).astype(np.int64))
     step(ids, labels)
-    step(ids, labels)._value.block_until_ready()
+    hard_sync(step(ids, labels))
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, labels)
-    loss._value.block_until_ready()
+    hard_sync(loss)
     dt = time.perf_counter() - t0
     print(json.dumps({
         "metric": "moe_train_tokens_per_sec",

@@ -22,6 +22,7 @@ def main():
     on_accel = jax.devices()[0].platform != "cpu"
 
     import paddle_tpu as paddle
+    from paddle_tpu.device import hard_sync
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import CRNN, ppocr_rec_tiny
 
@@ -44,11 +45,11 @@ def main():
 
     step = TrainStep(model, opt, loss_fn)
     step(x, labels, lens)
-    step(x, labels, lens)._value.block_until_ready()
+    hard_sync(step(x, labels, lens))
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(x, labels, lens)
-    loss._value.block_until_ready()
+    hard_sync(loss)
     dt = time.perf_counter() - t0
     print(json.dumps({
         "metric": "ppocr_rec_train_images_per_sec",

@@ -24,6 +24,7 @@ def main():
     on_accel = jax.devices()[0].platform != "cpu"
 
     import paddle_tpu as paddle
+    from paddle_tpu.device import hard_sync
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import resnet50, resnet18
 
@@ -55,11 +56,11 @@ def main():
         x = paddle.to_tensor(rng.standard_normal((batch, 3, H, H)).astype(np.float32))
         y = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype(np.int32))
         step(x, y)
-        step(x, y)._value.block_until_ready()
+        hard_sync(step(x, y))
         t0 = time.perf_counter()
         for _ in range(n_iters):
             loss = step(x, y)
-        loss._value.block_until_ready()
+        hard_sync(loss)
         return batch * n_iters / (time.perf_counter() - t0)
 
     if on_accel:

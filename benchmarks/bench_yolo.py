@@ -22,6 +22,7 @@ def main():
     on_accel = jax.devices()[0].platform != "cpu"
 
     import paddle_tpu as paddle
+    from paddle_tpu.device import hard_sync
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import ppyolo_s, ppyolo_tiny
 
@@ -42,11 +43,11 @@ def main():
     rng = np.random.default_rng(0)
     x = paddle.to_tensor(rng.standard_normal((B, 3, H, H)).astype(np.float32))
     step(x)
-    step(x)._value.block_until_ready()
+    hard_sync(step(x))
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(x)
-    loss._value.block_until_ready()
+    hard_sync(loss)
     dt = time.perf_counter() - t0
     print(json.dumps({
         "metric": "ppyolo_train_images_per_sec",

@@ -53,16 +53,18 @@ class OpCostModel:
         """Profile a jax-jittable callable; records and returns seconds/call."""
         import jax
 
+        from paddle_tpu.device import hard_sync
+
         jfn = jax.jit(fn)
         out = jfn(*args)
-        jax.block_until_ready(out)
-        for _ in range(warmup):
+        hard_sync(out)  # true barrier — block_until_ready lies on the
+        for _ in range(warmup):  # remote transport (see device.hard_sync)
             out = jfn(*args)
-        jax.block_until_ready(out)
+        hard_sync(out)
         t0 = time.perf_counter()
         for _ in range(iters):
             out = jfn(*args)
-        jax.block_until_ready(out)
+        hard_sync(out)
         dt = (time.perf_counter() - t0) / iters
         self.table[name] = {"time_s": dt, "device": str(jax.devices()[0].device_kind)}
         return dt

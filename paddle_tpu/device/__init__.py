@@ -25,6 +25,7 @@ __all__ = [
     "get_available_device",
     "device_count",
     "synchronize",
+    "hard_sync",
     "Stream",
     "Event",
     "current_stream",
@@ -49,8 +50,48 @@ def get_all_custom_device_type():
 
 
 def synchronize(device=None):
-    """Block until all launched device work completes."""
+    """Block until all launched device work completes.
+
+    `jax.effects_barrier` / `block_until_ready` resolve at dispatch on
+    remote transports (see `hard_sync`), so this additionally enqueues a
+    trivial computation per addressable device and reads it back — each
+    device executes its stream in order, so the readback implies all
+    previously enqueued work finished.
+    """
+    import jax.numpy as jnp
+
     jax.effects_barrier()
+    for d in jax.local_devices():
+        with jax.default_device(d):
+            hard_sync(jnp.zeros(8) + 1.0)
+
+
+def hard_sync(x):
+    """TRUE device barrier: read one element of `x` back to the host.
+
+    On some remote PJRT transports (the axon TPU tunnel in this image),
+    `jax.block_until_ready` resolves when the dispatch future settles —
+    NOT when the device has finished executing — so wall-clock timing
+    around it measures dispatch latency, not device time (measured: a
+    chain of 8192^3 matmuls "completed" at 40 PFLOPs).  A device→host
+    readback is the only barrier that provably waits.  The device runs
+    its stream in order, so fetching the last enqueued value implies
+    everything enqueued before it has completed.
+
+    Accepts a jax array, a Tensor-like with `._value`, or any pytree;
+    syncs on the last leaf and returns `x` unchanged.
+    """
+    leaf = x._value if hasattr(x, "_value") else x
+    device_leaves = [
+        l for l in jax.tree_util.tree_leaves(leaf)
+        if isinstance(l, jax.Array) and l.size
+    ]
+    if device_leaves:
+        # one element of EVERY device leaf (leaves may live on different
+        # devices); host numpy / zero-size leaves must not satisfy the
+        # barrier — that silently reverts to the dispatch-only fiction
+        jax.device_get([l.ravel()[:1] for l in device_leaves])
+    return x
 
 
 class Stream:

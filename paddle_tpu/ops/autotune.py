@@ -174,24 +174,84 @@ def record(kernel, key, config, ms, slug=None, save=True):
 # measurement
 
 
-def _time_fn(fn, args, warmup=1, iters=3, timer=None):
-    """Median wall ms of fn(*args) with block_until_ready."""
-    import jax
+def _time_fn(fn, args, warmup=1, iters=3, timer=None, inner=None,
+             target_ms=300.0):
+    """Estimate per-call device ms of fn(*args).
+
+    The only true barrier on the remote transport is a device→host
+    readback (see paddle_tpu.device.hard_sync — block_until_ready
+    resolves at dispatch), and that round trip is both large (~tens of
+    ms) and NOISY (±tens of ms), so neither per-call timing nor a
+    fixed-length difference survives it.  Methodology:
+
+    1. measure the pure readback round trip on an already-ready array;
+    2. pilot-run a short batch to rough-estimate the per-call cost;
+    3. size `inner` so one batch costs ~`target_ms` of device time —
+       the RTT noise then perturbs the estimate by noise/target only;
+    4. per sample, time `inner` and `2*inner` back-to-back dispatches
+       and difference the totals: the constant readback + dispatch
+       latency cancels, leaving inner * kernel_ms.  Median over iters.
+
+    Pass `inner` explicitly to skip the adaptive sizing (tests)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.device import hard_sync
 
     if timer is not None:  # deterministic tests inject a fake timer
         return timer(fn, args)
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        hard_sync(fn(*args))
+
+    def total_ms(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        hard_sync(out)
+        return (time.perf_counter() - t0) * 1e3
+
+    if inner is None:
+        ready = jnp.zeros(8)
+        hard_sync(ready)
+        rtt_samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            hard_sync(ready)
+            rtt_samples.append((time.perf_counter() - t0) * 1e3)
+        rtt = min(rtt_samples)
+        pilot = total_ms(8)
+        per_call = max((pilot - rtt) / 8, 1e-3)
+        inner = int(min(max(target_ms / per_call, 8), 4096))
+
     times = []
     for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append((time.perf_counter() - t0) * 1e3)
+        cur = inner
+        for _attempt in range(3):
+            t1 = total_ms(cur)
+            t2 = total_ms(2 * cur)
+            diff = (t2 - t1) / cur
+            if diff > 1e-4:
+                times.append(diff)
+                break
+            # RTT noise swamped the signal: a nonpositive difference is a
+            # FAILED sample, never a result — grow the batch and retry
+            # (silently clamping here once shipped noise-picked tiles)
+            cur = min(cur * 4, 8192)
+        else:
+            import warnings
+
+            warnings.warn(
+                "autotune: timing sample degenerate even at inner=%d "
+                "(readback RTT noise exceeds the kernel signal)" % cur)
+    if not times:
+        raise RuntimeError(
+            "autotune: every timing sample was degenerate — transport too "
+            "noisy to rank candidates; not recording a winner")
     times.sort()
     return times[len(times) // 2]
 
 
-def tune_kernel(kernel, key, build, candidates, args, *, iters=3,
+def tune_kernel(kernel, key, build, candidates, args, *, iters=3, inner=None,
                 budget_s=None, timer=None, slug=None, save=True, verbose=False):
     """Search `candidates` (list of config dicts) for the fastest
     `build(config)(*args)`; record and return (best_config, best_ms).
@@ -205,7 +265,7 @@ def tune_kernel(kernel, key, build, candidates, args, *, iters=3,
             break
         try:
             fn = build(cfg)
-            ms = _time_fn(fn, args, iters=iters, timer=timer)
+            ms = _time_fn(fn, args, iters=iters, timer=timer, inner=inner)
         except Exception as e:  # noqa: BLE001 — candidate invalid on this device
             if verbose:
                 print(f"  {kernel} {cfg}: invalid ({type(e).__name__})")
@@ -272,7 +332,11 @@ def tune_flash(batch=1, num_heads=8, seq=2048, head_dim=128, dtype="bfloat16",
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.ops import flash_attention as fa
+    import importlib
+
+    # NOT `from paddle_tpu.ops import flash_attention`: the package exports a
+    # *function* named flash_attention that shadows the submodule attribute.
+    fa = importlib.import_module("paddle_tpu.ops.flash_attention")
 
     jd = jnp.dtype(dtype)
     key = {"seq_q": seq, "seq_k": seq, "head_dim": head_dim,
@@ -305,7 +369,9 @@ def tune_fused_norm(rows=4096, hidden=4096, dtype="bfloat16", **kw):
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.ops import fused_norm as fnorm
+    import importlib
+
+    fnorm = importlib.import_module("paddle_tpu.ops.fused_norm")
 
     jd = jnp.dtype(dtype)
     key = {"rows": rows, "hidden": hidden, "dtype": jd.name}
@@ -342,7 +408,10 @@ def tune_swiglu(rows=4096, cols=11008, dtype="bfloat16", **kw):
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.ops import swiglu as sw
+    import importlib
+
+    # see tune_flash: the swiglu function shadows its submodule on the package
+    sw = importlib.import_module("paddle_tpu.ops.swiglu")
 
     jd = jnp.dtype(dtype)
     key = {"rows": rows, "cols": cols, "dtype": jd.name}
@@ -385,6 +454,10 @@ def main(argv=None):
     p.add_argument("--budget-seconds", type=float, default=300.0,
                    help="total wall budget; stops between candidates")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--inner", type=int, default=None,
+                   help="dispatches per timing sample (default: adaptive — "
+                        "sized so one sample is ~300ms of device time; the "
+                        "RTT-cancelling difference times inner and 2*inner)")
     args = p.parse_args(argv)
 
     t0 = time.perf_counter()
@@ -399,7 +472,7 @@ def main(argv=None):
                 print("budget exhausted")
                 break
             cfg, ms = runners[name](dtype=args.dtype, budget_s=left, verbose=True,
-                                    **shape)
+                                    inner=args.inner, **shape)
             print(f"{name} {shape}: best {cfg} @ {ms:.3f} ms")
     path = cache(slug).save()
     print(f"cache written: {path}")

@@ -329,10 +329,12 @@ def estimate_mfu(fn, *example_args, runtime_s=None, peak_tflops=None):
     compiled, vals, analyses = _compile_and_analyze(fn, example_args)
     flops = float(analyses.get("flops", 0.0))
     if runtime_s is None:
-        jax.block_until_ready(compiled(*vals))  # warmup
-        t0 = time.perf_counter()
-        jax.block_until_ready(compiled(*vals))
-        runtime_s = time.perf_counter() - t0
+        # RTT-cancelling adaptive timer (readback-synced, differences two
+        # batch lengths so the tunnel round trip drops out — the same
+        # methodology the kernel autotuner uses)
+        from paddle_tpu.ops.autotune import _time_fn
+
+        runtime_s = _time_fn(compiled, vals, iters=2) / 1e3
     d = jax.devices()[0]
     if peak_tflops is None:
         peak_tflops = device_peak_tflops(d.device_kind, d.platform)

@@ -193,3 +193,25 @@ def test_v5p_readiness_geometry_and_peaks(tmp_cache):
               slug="tpu_v5p")
     assert at.lookup("flash_fwd", key, slug="tpu_v5p") == {"block_q": 256, "block_k": 128}
     assert at.lookup("flash_fwd", key, slug="tpu_v5_lite") != {"block_q": 256, "block_k": 128}
+
+
+def test_tune_drivers_execute_real_kernels(tmp_cache):
+    """The tune_* drivers must build AND RUN their kernels end-to-end.
+
+    Regression: the ops package exports *functions* named flash_attention /
+    swiglu that shadow the submodule attributes, so `from paddle_tpu.ops
+    import flash_attention as fa` bound the function and every candidate
+    died with AttributeError on-chip.  The fake-timer test never called the
+    built fn, so only a real execution catches this class.
+    """
+    cfg, ms = at.tune_flash(batch=1, num_heads=1, seq=128, head_dim=8,
+                            dtype="float32", slug="testdev", iters=1, inner=1)
+    # strictly above the degenerate-sample floor: a clamped/failed timing
+    # must not satisfy this (1e-4 is _time_fn's failed-sample sentinel)
+    assert cfg["block_q"] in (64, 128) and ms > 1e-4
+    cfg, _ = at.tune_fused_norm(rows=16, hidden=128, dtype="float32",
+                                slug="testdev", iters=1, inner=1)
+    assert 16 % cfg["rows_block"] == 0
+    cfg, _ = at.tune_swiglu(rows=64, cols=128, dtype="float32",
+                            slug="testdev", iters=1, inner=1)
+    assert 64 % cfg["rows_block"] == 0 and 128 % cfg["cols_block"] == 0

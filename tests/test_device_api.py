@@ -1,0 +1,17 @@
+
+
+def test_hard_sync_barriers_and_passthrough():
+    """hard_sync returns its argument and forces a host readback on jax
+    arrays, Tensor-likes (._value) and pytrees (syncs the last leaf)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.device import hard_sync
+
+    a = jnp.arange(8.0)
+    assert hard_sync(a) is a
+    t = paddle.to_tensor([1.0, 2.0])
+    assert hard_sync(t) is t
+    tree = {"x": jnp.ones((2, 2)), "y": [jnp.zeros(3)]}
+    assert hard_sync(tree) is tree
+    assert hard_sync(3.5) == 3.5  # no array leaves: no-op
