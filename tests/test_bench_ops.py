@@ -1,0 +1,50 @@
+"""Per-op micro-benchmark harness (tools/bench_ops.py).
+
+Reference roles: test/legacy_test/benchmark.py + tools/ci_op_benchmark.sh /
+check_op_benchmark_result.py (per-op timing + CI regression gate).
+"""
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import bench_ops  # noqa: E402
+
+
+def test_quick_sweep_all_ops_time_cleanly(tmp_path):
+    out = tmp_path / "ops.json"
+    rc = bench_ops.main(["--quick", "--out", str(out)])
+    assert rc == 0
+    res = json.loads(out.read_text())
+    assert res["ops"], "no ops ran"
+    errors = {k: v for k, v in res["ops"].items() if "error" in v}
+    assert not errors, errors
+    for name, entry in res["ops"].items():
+        assert entry["ms"] > 0, name
+
+
+def test_compare_gate_flags_regressions_and_passes_clean(tmp_path):
+    res = bench_ops.run(quick=True, iters=1)
+    # identical runs pass at any threshold
+    assert bench_ops.compare(res, res, threshold=0.0) == []
+    # a 2x slowdown on one op is flagged at 5%
+    slower = copy.deepcopy(res)
+    name = next(k for k, v in res["ops"].items() if "ms" in v)
+    slower["ops"][name]["ms"] = res["ops"][name]["ms"] * 2
+    bad = bench_ops.compare(slower, res, threshold=0.05)
+    assert len(bad) == 1 and name in bad[0]
+    # faster is never a regression
+    assert bench_ops.compare(res, slower, threshold=0.05) == []
+
+
+def test_compare_gate_flags_broken_and_missing_ops():
+    old = {"ops": {"matmul": {"ms": 2.0}, "softmax": {"ms": 1.0}}}
+    new = {"ops": {"matmul": {"error": "TypeError: boom"}}}
+    bad = bench_ops.compare(new, old, threshold=0.05)
+    assert len(bad) == 2
+    assert any("boom" in b for b in bad)
+    assert any("MISSING" in b for b in bad)
