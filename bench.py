@@ -193,14 +193,10 @@ def child() -> int:
     step(ids, labels)  # builds optimizer state on host, compiles, runs
     hard_sync(step(ids, labels))
 
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(iters):
-        loss = step(ids, labels)
-    hard_sync(loss)
-    dt = time.perf_counter() - t0
+    from paddle_tpu.device import time_step_ms
 
-    tokens_per_sec = B * S * iters / dt
+    step_ms = time_step_ms(lambda: step(ids, labels), inner=iters)
+    tokens_per_sec = B * S / (step_ms / 1e3)
 
     # achieved model FLOPs (6 * n_params per token, attention term included)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())

@@ -49,12 +49,10 @@ def main():
     y = paddle.to_tensor(rng.integers(0, 2, (B,)).astype(np.int32))
     step(ids, y)
     hard_sync(step(ids, y))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, y)
-    hard_sync(loss)
-    dt = time.perf_counter() - t0
-    tokens_per_sec = B * S * iters / dt
+    from paddle_tpu.device import time_step_ms
+
+    rate_denom_s = time_step_ms(lambda: step(ids, y), inner=iters) / 1e3
+    tokens_per_sec = B * S / rate_denom_s
 
     # vs_baseline: peak-normalized chip-efficiency parity against the
     # written-down A100 reference point (BASELINE.md "A100 reference

@@ -57,11 +57,9 @@ def main():
         y = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype(np.int32))
         step(x, y)
         hard_sync(step(x, y))
-        t0 = time.perf_counter()
-        for _ in range(n_iters):
-            loss = step(x, y)
-        hard_sync(loss)
-        return batch * n_iters / (time.perf_counter() - t0)
+        from paddle_tpu.device import time_step_ms
+
+        return batch / (time_step_ms(lambda: step(x, y), inner=n_iters) / 1e3)
 
     if on_accel:
         # batch sweep: the MXU wants large batches (the A100 reference point

@@ -26,6 +26,7 @@ __all__ = [
     "device_count",
     "synchronize",
     "hard_sync",
+    "time_step_ms",
     "Stream",
     "Event",
     "current_stream",
@@ -92,6 +93,22 @@ def hard_sync(x):
         # barrier — that silently reverts to the dispatch-only fiction
         jax.device_get([l.ravel()[:1] for l in device_leaves])
     return x
+
+
+def time_step_ms(fn, args=(), *, inner=10, samples=2):
+    """Steady-state per-call wall ms of a compiled step function.
+
+    The public timing primitive for benchmarks: each sample readback-syncs
+    (`hard_sync`) batches of `inner` and `2*inner` back-to-back calls and
+    differences the totals, so the (large, noisy) transport round trip
+    cancels; returns the MIN over `samples` — an RTT noise spike can only
+    inflate a sample, so min is the faithful steady-state estimate."""
+    from paddle_tpu.ops.autotune import _time_fn
+
+    return min(
+        _time_fn(fn, args, warmup=0, iters=1, inner=inner)
+        for _ in range(samples)
+    )
 
 
 class Stream:

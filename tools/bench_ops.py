@@ -181,25 +181,24 @@ def main(argv=None):
         with open(args.out, "w") as f:
             json.dump(res, f, indent=1)
         print(f"wrote {args.out}")
-    if args.compare:
-        with open(args.compare) as f:
-            old = json.load(f)
-        for field in ("device_kind", "tier"):
-            if old.get(field) != res.get(field):
-                print(f"compare: {field} mismatch "
-                      f"({old.get(field)} vs {res.get(field)}); not gating")
-                return 0
-        bad = compare(res, old, args.threshold)
-        for b in bad:
-            print(f"REGRESSION {b}")
-        if bad:
-            return 1
-        print("no regressions")
     errors = [k for k, v in res["ops"].items() if "error" in v]
     if errors:
         print(f"ERRORS in: {', '.join(errors)}")
-        return 2
-    return 0
+    if args.compare:
+        with open(args.compare) as f:
+            old = json.load(f)
+        mismatch = [f for f in ("device_kind", "tier")
+                    if old.get(f) != res.get(f)]
+        if mismatch:
+            print(f"compare: {'/'.join(mismatch)} mismatch; not gating")
+        else:
+            bad = compare(res, old, args.threshold)
+            for b in bad:
+                print(f"REGRESSION {b}")
+            if bad:
+                return 1
+            print("no regressions")
+    return 2 if errors else 0
 
 
 if __name__ == "__main__":
