@@ -187,10 +187,11 @@ def main(argv=None):
     if args.compare:
         with open(args.compare) as f:
             old = json.load(f)
-        mismatch = [f for f in ("device_kind", "tier")
+        mismatch = [f"{f} ({old.get(f)} vs {res.get(f)})"
+                    for f in ("device_kind", "tier")
                     if old.get(f) != res.get(f)]
         if mismatch:
-            print(f"compare: {'/'.join(mismatch)} mismatch; not gating")
+            print(f"compare: {', '.join(mismatch)} mismatch; not gating")
         else:
             bad = compare(res, old, args.threshold)
             for b in bad:
