@@ -12,6 +12,9 @@ __all__ = [
     "ModelCheckpoint",
     "EarlyStopping",
     "LRScheduler",
+    "ReduceLROnPlateau",
+    "VisualDL",
+    "WandbCallback",
 ]
 
 
@@ -103,6 +106,30 @@ class ModelCheckpoint(Callback):
             self.model.save(path)
 
 
+def _monitored(logs, monitor):
+    """Fetch a monitored metric from eval logs, tolerating the `eval_`
+    prefix Model.evaluate puts on its keys (monitor='loss' must match
+    'eval_loss', and 'eval_acc' must match whether or not the user wrote
+    the prefix).  Returns a float or None."""
+    logs = logs or {}
+    cur = logs.get(monitor)
+    if cur is None:
+        cur = logs.get(f"eval_{monitor}")
+    if cur is None and monitor.startswith("eval_"):
+        cur = logs.get(monitor[len("eval_"):])
+    if isinstance(cur, (list, tuple)):
+        cur = cur[0] if cur else None
+    return cur
+
+
+def _improved(cur, best, mode, min_delta):
+    if best is None:
+        return True
+    if mode == "min":
+        return cur < best - min_delta
+    return cur > best + min_delta
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="min", patience=0, min_delta=0, baseline=None, save_best_model=True):
         self.monitor = monitor
@@ -114,18 +141,12 @@ class EarlyStopping(Callback):
         self.stopped_epoch = 0
 
     def _better(self, cur, best):
-        if best is None:
-            return True
-        if self.mode == "min":
-            return cur < best - self.min_delta
-        return cur > best + self.min_delta
+        return _improved(cur, best, self.mode, self.min_delta)
 
     def on_eval_end(self, logs=None):
-        cur = (logs or {}).get(self.monitor)
+        cur = _monitored(logs, self.monitor)
         if cur is None:
             return
-        if isinstance(cur, (list, tuple)):
-            cur = cur[0]
         if self._better(cur, self.best):
             self.best = cur
             self.wait = 0
@@ -153,3 +174,183 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric stops improving.
+
+    Reference: python/paddle/hapi/callbacks.py ReduceLROnPlateau (keras-
+    style callback tier over optimizer.set_lr; distinct from the
+    optimizer.lr.ReduceOnPlateau scheduler, which owns the LR inside the
+    compiled step).
+    """
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="min", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_eval_end(self, logs=None):
+        cur = _monitored(logs, self.monitor)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if _improved(cur, self.best, self.mode, self.min_delta):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self._reduce()
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+    def _reduce(self):
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        old = float(opt.get_lr())
+        new = max(old * self.factor, self.min_lr)
+        if old - new <= 1e-12:
+            return
+        try:
+            opt.set_lr(new)
+        except RuntimeError:
+            # an LRScheduler owns the LR — reducing would fight it; warn
+            # once instead of crashing fit() mid-training
+            import warnings
+
+            warnings.warn(
+                "ReduceLROnPlateau: optimizer uses an LRScheduler; "
+                "skipping plateau reduction (use the "
+                "optimizer.lr.ReduceOnPlateau scheduler instead)")
+            self.factor = 1.0  # disables further attempts
+            return
+        if self.verbose:
+            print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+
+
+class VisualDL(Callback):
+    """Scalar logging callback.
+
+    Reference: python/paddle/hapi/callbacks.py VisualDL.  Uses the real
+    visualdl LogWriter when the package is importable; otherwise falls
+    back to a self-contained JSONL scalar log (one
+    {"tag", "step", "value"} per line under `log_dir/scalars.jsonl`) so
+    the callback works in hermetic environments — same tags, same
+    train/eval split.
+    """
+
+    def __init__(self, log_dir="./log"):
+        self.log_dir = log_dir
+        self.epochs = None
+        self._writer = None
+        self._jsonl = None
+        self._train_step = 0
+
+    def _ensure_writer(self):
+        if self._writer is None and self._jsonl is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            try:
+                from visualdl import LogWriter  # type: ignore
+
+                self._writer = LogWriter(self.log_dir)
+            except ImportError:
+                self._jsonl = open(
+                    os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _add_scalar(self, tag, value, step):
+        self._ensure_writer()
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        if self._writer is not None:
+            self._writer.add_scalar(tag=tag, value=value, step=step)
+        else:
+            import json as _json
+
+            self._jsonl.write(_json.dumps(
+                {"tag": tag, "step": step, "value": value}) + "\n")
+            self._jsonl.flush()
+
+    def _log(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            if k in ("batch_size", "step", "steps"):
+                continue
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if v is None:
+                continue
+            if k.startswith(f"{prefix}_"):  # avoid eval/eval_loss tags
+                k = k[len(prefix) + 1:]
+            self._add_scalar(f"{prefix}/{k}", v, step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log("train", logs, self._train_step)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self._train_step)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference: hapi/callbacks.py
+    WandbCallback).  Requires the wandb package; raises at construction
+    when absent rather than silently dropping metrics."""
+
+    def __init__(self, project=None, job_type="train", **kwargs):
+        try:
+            import wandb  # type: ignore
+        except ImportError as e:
+            raise ModuleNotFoundError(
+                "WandbCallback requires the wandb package "
+                "(pip install wandb)") from e
+        self.wandb = wandb
+        self.run = wandb.init(project=project, job_type=job_type, **kwargs)
+        self._train_step = 0
+
+    def _log(self, prefix, logs, step):
+        payload = {}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if v is None or k in ("batch_size",):
+                continue
+            if k.startswith(f"{prefix}_"):  # avoid eval/eval_loss tags
+                k = k[len(prefix) + 1:]
+            try:
+                payload[f"{prefix}/{k}"] = float(v)
+            except (TypeError, ValueError):
+                continue
+        if payload:
+            self.run.log(payload, step=step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log("train", logs, self._train_step)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self._train_step)
+
+    def on_train_end(self, logs=None):
+        self.run.finish()

@@ -133,3 +133,60 @@ def test_dataloader_prefetch_to_device():
         assert isinstance(xb._value, jax.Array)  # already device-resident
         seen.append(np.asarray(yb._value))
     np.testing.assert_array_equal(np.concatenate(seen), np.arange(12))
+
+
+def test_reduce_lr_on_plateau_callback(tmp_path):
+    """LR drops by `factor` after `patience` evals without improvement
+    (reference hapi/callbacks.py ReduceLROnPlateau)."""
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    paddle.seed(2)
+    model = paddle.Model(_net())
+    opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1, verbose=0)
+    cb.set_model(model)
+    # Model.evaluate keys its logs eval_loss/eval_<metric>: monitor='loss'
+    # must match them (the silent-no-op class of bug)
+    cb.on_eval_end({"eval_loss": 1.0})   # best
+    cb.on_eval_end({"eval_loss": 1.0})   # wait=1 >= patience -> reduce
+    assert abs(opt.get_lr() - 0.25) < 1e-6
+    cb.on_eval_end({"loss": 0.5})   # improvement: no change
+    assert abs(opt.get_lr() - 0.25) < 1e-6
+    # min_lr floor respected
+    cb2 = ReduceLROnPlateau(monitor="loss", factor=0.1, patience=0,
+                            min_lr=0.2, verbose=0)
+    cb2.set_model(model)
+    cb2.on_eval_end({"loss": 3.0})
+    cb2.on_eval_end({"loss": 3.0})
+    assert abs(opt.get_lr() - 0.2) < 1e-6
+
+
+def test_visualdl_callback_writes_scalars(tmp_path, monkeypatch):
+    """VisualDL callback logs train/eval scalars through Model.fit; the
+    JSONL fallback (forced here so the test is env-independent) carries
+    the same tags, with the eval_ key prefix folded into the tag."""
+    import json as _json
+    import sys as _sys
+
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    monkeypatch.setitem(_sys.modules, "visualdl", None)  # force fallback
+
+    paddle.seed(3)
+    model = paddle.Model(_net())
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = _XorDataset()
+    log_dir = tmp_path / "vdl"
+    model.fit(ds, ds, batch_size=32, epochs=2, verbose=0,
+              callbacks=[VisualDL(log_dir=str(log_dir))])
+    path = log_dir / "scalars.jsonl"
+    assert path.exists()
+    rows = [_json.loads(l) for l in path.read_text().splitlines()]
+    tags = {r["tag"] for r in rows}
+    assert any(t.startswith("train/loss") for t in tags), tags
+    assert any(t.startswith("eval/") for t in tags), tags
+    assert not any(t.startswith("eval/eval_") for t in tags), tags
+    steps = [r["step"] for r in rows if r["tag"].startswith("train/loss")]
+    assert steps == sorted(steps) and len(steps) >= 2

@@ -429,3 +429,43 @@ def test_fstring_in_inlined_helper():
     np.testing.assert_allclose(
         np.asarray(sot2(x)._value), np.asarray(bad(x)._value), rtol=1e-6)
     assert sot_stats()["fallbacks"] == before + 1
+
+
+def test_real_gpt_and_bert_forward_capture_fraction():
+    """The zero-fallback single-segment criterion must hold across the
+    transformer zoo, not just LLaMA — GPT (learned positions, gelu MLP)
+    and BERT (token-type embeddings, pooler) exercise different forward
+    code paths through the interpreter."""
+    from paddle_tpu.models import (
+        BertForSequenceClassification,
+        GPTForCausalLM,
+        bert_tiny,
+        gpt_tiny,
+    )
+
+    paddle.seed(4)
+    cases = []
+    gpt = GPTForCausalLM(gpt_tiny())
+    gpt.eval()
+    ids = paddle.randint(0, 256, [1, 8])
+    cases.append((gpt, (ids,)))
+    bert = BertForSequenceClassification(bert_tiny())
+    bert.eval()
+    cases.append((bert, (paddle.randint(0, 256, [1, 8]),)))
+
+    for model, args in cases:
+        name = type(model).__name__
+        ref = model(*args)
+        ref_t = ref[0] if isinstance(ref, (tuple, list)) else ref
+        before_fb = sot_stats()["fallbacks"]
+        sot = symbolic_translate(model.forward)
+        out = sot(*args)
+        out_t = out[0] if isinstance(out, (tuple, list)) else out
+        np.testing.assert_allclose(
+            np.asarray(out_t._value), np.asarray(ref_t._value),
+            rtol=1e-4, atol=1e-5, err_msg=name)
+        assert sot_stats()["fallbacks"] == before_fb, f"{name} fell back"
+        caps = list(sot._captures.values())
+        assert len(caps) == 1, name
+        (capture,) = caps[0].values()
+        assert len(capture.segments) == 1, f"{name} broke into segments"
