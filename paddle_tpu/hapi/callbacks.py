@@ -307,10 +307,14 @@ class VisualDL(Callback):
         self._log("eval", logs, self._train_step)
 
     def on_train_end(self, logs=None):
+        # reset to None so a reused callback instance (second fit(), or a
+        # standalone evaluate()) reopens instead of writing to a closed file
         if self._writer is not None:
             self._writer.close()
+            self._writer = None
         if self._jsonl is not None:
             self._jsonl.close()
+            self._jsonl = None
 
 
 class WandbCallback(Callback):

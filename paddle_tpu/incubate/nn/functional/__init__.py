@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu import ops as _ops
+from paddle_tpu._core import random as _random
 from paddle_tpu.tensor._ops_common import apply, ensure_tensor
 
 __all__ = [
@@ -31,6 +32,8 @@ __all__ = [
     "block_multihead_attention",
     "fused_ec_moe",
     "variable_length_memory_efficient_attention",
+    "fused_dot_product_attention",
+    "fused_gate_attention",
 ]
 
 
@@ -509,3 +512,143 @@ __all__ += [
     "fused_bias_dropout_residual_layer_norm",
     "fused_multi_transformer",
 ]
+
+
+def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
+                                dropout_prob=0.0, is_training=True,
+                                is_causal_masking=False,
+                                return_softmax=False):
+    """Reference: python/paddle/incubate/nn/functional/
+    fused_dot_product_attention.py (cuDNN fused attention, layout
+    [B, S, N, H], int32/bool mask broadcast [B, 1, Sq, Sk]).
+
+    TPU-native: the causal no-mask path routes through the Pallas flash
+    kernel; masked paths compute the reference math in one jit region
+    (XLA fuses).  `return_softmax` returns the probabilities — only
+    available on the non-flash path, as flash never materializes them.
+    """
+    q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
+    head_dim = int(q.shape[-1])
+    scale = (1.0 / math.sqrt(head_dim)) if scaling_factor is None else float(scaling_factor)
+    dropout_active = dropout_prob > 0.0 and is_training
+    if is_causal_masking and mask is None and not return_softmax \
+            and not dropout_active \
+            and abs(scale - 1.0 / math.sqrt(head_dim)) < 1e-12:
+        return apply(
+            "flash_attention",
+            lambda qv, kv, vv: _ops.flash_attention(qv, kv, vv, causal=True),
+            q, k, v)
+    extras = [] if mask is None else [ensure_tensor(mask)]
+    # probability dropout: key fetched at trace time, the canonical pattern
+    # (nn/functional/common.py dropout)
+    drop_key = _random.next_key() if dropout_active else None
+
+    def _fn(qv, kv, vv, *rest):
+        s = jnp.einsum("bqnh,bknh->bnqk", qv.astype(jnp.float32),
+                       kv.astype(jnp.float32)) * scale
+        if is_causal_masking:
+            causal = jnp.tril(jnp.ones((qv.shape[1], kv.shape[1]), bool))
+            s = jnp.where(causal[None, None], s, -1e30)
+        elif rest:
+            keep = rest[0].astype(bool)  # [B, 1, Sq, Sk], True = attend
+            s = jnp.where(keep, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        if dropout_active:
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_prob, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout_prob), 0.0)
+        o = jnp.einsum("bnqk,bknh->bqnh", p, vv.astype(jnp.float32))
+        out = o.astype(qv.dtype)
+        if return_softmax:
+            return out, p.astype(qv.dtype)
+        return out
+
+    return apply("fused_dot_product_attention", _fn, q, k, v, *extras)
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """Reference: python/paddle/incubate/nn/functional/
+    fused_gate_attention.py (AlphaFold-style gated self-attention over
+    [B, msa, res, dim] inputs; merge_qkv=True uses one [3, N, H, D]
+    weight, else separate [D, N, H] projections with key != query).
+
+    TPU-native: one jit region of einsums — XLA fuses the projection +
+    attention + gating chain; `use_flash_attn` is accepted for signature
+    parity (the 5-D layout keeps the whole computation in one fusion, so
+    a separate flash path buys nothing at AlphaFold's res_len scales).
+    """
+    query = ensure_tensor(query)
+    if merge_qkv:
+        if qkv_weight is None:
+            raise ValueError("merge_qkv=True requires qkv_weight")
+        if key is not None and key is not query:
+            raise ValueError("merge_qkv=True is self-attention: key must be "
+                             "None (reference semantics)")
+        named = {"qkv_weight": ensure_tensor(qkv_weight)}
+    else:
+        missing = [n for n, w in (("query_weight", query_weight),
+                                  ("key_weight", key_weight),
+                                  ("value_weight", value_weight)) if w is None]
+        if missing:
+            raise ValueError(f"merge_qkv=False requires {missing}")
+        named = {"query_weight": ensure_tensor(query_weight),
+                 "key_weight": ensure_tensor(key_weight),
+                 "value_weight": ensure_tensor(value_weight)}
+        key = query if key is None else ensure_tensor(key)
+        named["key_input"] = key
+    if has_gating:
+        if gate_linear_weight is None or gate_linear_bias is None:
+            raise ValueError("has_gating=True requires gate_linear_weight "
+                             "and gate_linear_bias")
+        named["gate_w"] = ensure_tensor(gate_linear_weight)
+        named["gate_b"] = ensure_tensor(gate_linear_bias)
+    if out_linear_weight is None or out_linear_bias is None:
+        raise ValueError("fused_gate_attention requires out_linear_weight "
+                         "and out_linear_bias")
+    named["out_w"] = ensure_tensor(out_linear_weight)
+    named["out_b"] = ensure_tensor(out_linear_bias)
+    if nonbatched_bias is not None:
+        named["nb_bias"] = ensure_tensor(nonbatched_bias)
+    if attn_mask is not None:
+        named["attn_mask"] = ensure_tensor(attn_mask)
+    keys = list(named)
+
+    def _fn(qv, *vals):
+        t = dict(zip(keys, vals))
+        f32 = jnp.float32
+        if merge_qkv:
+            # qkv_weight [3, N, H, D]; q/k/v: [B, M, R, D] @ w -> [B, M, R, N, H]
+            qkv = jnp.einsum("bmrd,snhd->sbmrnh", qv.astype(f32),
+                             t["qkv_weight"].astype(f32))
+            q_p, k_p, v_p = qkv[0], qkv[1], qkv[2]
+            head_dim = q_p.shape[-1]
+        else:
+            kv_in = t["key_input"].astype(f32)
+            q_p = jnp.einsum("bmrd,dnh->bmrnh", qv.astype(f32),
+                             t["query_weight"].astype(f32))
+            k_p = jnp.einsum("bmkd,dnh->bmknh", kv_in, t["key_weight"].astype(f32))
+            v_p = jnp.einsum("bmkd,dnh->bmknh", kv_in, t["value_weight"].astype(f32))
+            head_dim = q_p.shape[-1]
+        q_p = q_p * (float(head_dim) ** -0.5)
+        logits = jnp.einsum("bmqnh,bmknh->bmnqk", q_p, k_p)
+        if "attn_mask" in t:
+            mask = t["attn_mask"].astype(f32)
+            logits = logits + (1.0 - mask) * -1e9
+        if "nb_bias" in t:
+            logits = logits + t["nb_bias"].astype(f32)[:, None]
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bmnqk,bmknh->bmqnh", probs, v_p)
+        if has_gating:
+            gate = jnp.einsum("bmrd,dnh->bmrnh", qv.astype(f32),
+                              t["gate_w"].astype(f32)) + t["gate_b"].astype(f32)
+            ctx = ctx * jax.nn.sigmoid(gate)
+        out = jnp.einsum("bmrnh,nhd->bmrd", ctx, t["out_w"].astype(f32))
+        out = out + t["out_b"].astype(f32)
+        return out.astype(qv.dtype)
+
+    return apply("fused_gate_attention", _fn, query, *named.values())

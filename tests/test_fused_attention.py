@@ -1,0 +1,188 @@
+"""Fused attention tier: fused_dot_product_attention (reference
+python/paddle/incubate/nn/functional/fused_dot_product_attention.py,
+cuDNN layout [B, S, N, H]) and fused_gate_attention (reference
+fused_gate_attention.py, AlphaFold-style gated self-attention)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _np_sdpa(q, k, v, mask=None, causal=False, scale=None):
+    """[B, S, N, H] reference attention in float64 numpy."""
+    q, k, v = (np.asarray(t, np.float64) for t in (q, k, v))
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    s = np.einsum("bqnh,bknh->bnqk", q, k) * scale
+    if causal:
+        tri = np.tril(np.ones((q.shape[1], k.shape[1]), bool))
+        s = np.where(tri[None, None], s, -1e30)
+    elif mask is not None:
+        s = np.where(np.asarray(mask, bool), s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bnqk,bknh->bqnh", p, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_fused_dot_product_attention_matches_reference(causal):
+    rng = np.random.default_rng(0)
+    B, S, N, H = 2, 16, 4, 8
+    q, k, v = (paddle.to_tensor(rng.standard_normal((B, S, N, H)).astype("float32"))
+               for _ in range(3))
+    out = IF.fused_dot_product_attention(
+        q, k, v, is_causal_masking=causal, is_training=False)
+    ref = _np_sdpa(q.numpy(), k.numpy(), v.numpy(), causal=causal)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_dot_product_attention_mask_and_softmax():
+    rng = np.random.default_rng(1)
+    B, S, N, H = 2, 8, 2, 4
+    q, k, v = (paddle.to_tensor(rng.standard_normal((B, S, N, H)).astype("float32"))
+               for _ in range(3))
+    mask = (rng.random((B, 1, S, S)) > 0.3).astype("int32")
+    mask[..., 0] = 1  # every query attends to at least one key
+    out, probs = IF.fused_dot_product_attention(
+        q, k, v, mask=paddle.to_tensor(mask), is_training=False,
+        return_softmax=True)
+    ref = _np_sdpa(q.numpy(), k.numpy(), v.numpy(), mask=mask)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4, atol=2e-5)
+    p = np.asarray(probs._value)
+    np.testing.assert_allclose(p.sum(-1), np.ones(p.shape[:-1]), rtol=1e-5)
+    assert np.all(p[~np.broadcast_to(mask.astype(bool), p.shape)] < 1e-12)
+
+
+def test_fused_dot_product_attention_grad_flows():
+    rng = np.random.default_rng(2)
+    q = paddle.to_tensor(rng.standard_normal((1, 8, 2, 4)).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.standard_normal((1, 8, 2, 4)).astype("float32"),
+                         stop_gradient=False)
+    v = paddle.to_tensor(rng.standard_normal((1, 8, 2, 4)).astype("float32"),
+                         stop_gradient=False)
+    out = IF.fused_dot_product_attention(q, k, v, is_causal_masking=True,
+                                         is_training=False)
+    out.sum().backward()
+    for t in (q, k, v):
+        g = np.asarray(t.grad._value)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def _np_gate_attention(qd, qkv_w, gate_w, gate_b, out_w, out_b,
+                       nb_bias=None, mask=None, gating=True):
+    """Reference pseudo-code (fused_gate_attention.py docstring) in numpy."""
+    qd = np.asarray(qd, np.float64)
+    c = 1.0 / np.sqrt(qkv_w.shape[2])
+    qkv = np.einsum("bmrd,snhd->sbmrnh", qd, np.asarray(qkv_w, np.float64))
+    q, k, v = qkv[0] * c, qkv[1], qkv[2]
+    logits = np.einsum("bmqnh,bmknh->bmnqk", q, k)
+    if mask is not None:
+        logits = logits + (1.0 - np.asarray(mask, np.float64)) * -1e9
+    if nb_bias is not None:
+        logits = logits + np.asarray(nb_bias, np.float64)[:, None]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ctx = np.einsum("bmnqk,bmknh->bmqnh", p, v)
+    if gating:
+        g = 1.0 / (1.0 + np.exp(-(np.einsum("bmrd,dnh->bmrnh", qd,
+                                            np.asarray(gate_w, np.float64))
+                                  + np.asarray(gate_b, np.float64))))
+        ctx = ctx * g
+    return np.einsum("bmrnh,nhd->bmrd", ctx, np.asarray(out_w, np.float64)) \
+        + np.asarray(out_b, np.float64)
+
+
+@pytest.mark.parametrize("gating", [True, False])
+def test_fused_gate_attention_merge_qkv_matches_reference(gating):
+    rng = np.random.default_rng(3)
+    B, M, R, D, N, H = 1, 2, 6, 8, 2, 4
+    qd = rng.standard_normal((B, M, R, D)).astype("float32")
+    qkv_w = rng.standard_normal((3, N, H, D)).astype("float32") * 0.3
+    gate_w = rng.standard_normal((D, N, H)).astype("float32") * 0.3
+    gate_b = rng.standard_normal((N, H)).astype("float32") * 0.1
+    out_w = rng.standard_normal((N, H, D)).astype("float32") * 0.3
+    out_b = rng.standard_normal((D,)).astype("float32") * 0.1
+    nb = rng.standard_normal((B, N, R, R)).astype("float32") * 0.2
+    mask = (rng.random((B, M, 1, 1, R)) > 0.2).astype("float32")
+    kw = dict(has_gating=gating)
+    if gating:
+        kw.update(gate_linear_weight=paddle.to_tensor(gate_w),
+                  gate_linear_bias=paddle.to_tensor(gate_b))
+    out = IF.fused_gate_attention(
+        paddle.to_tensor(qd), qkv_weight=paddle.to_tensor(qkv_w),
+        out_linear_weight=paddle.to_tensor(out_w),
+        out_linear_bias=paddle.to_tensor(out_b),
+        nonbatched_bias=paddle.to_tensor(nb),
+        attn_mask=paddle.to_tensor(mask), **kw)
+    ref = _np_gate_attention(qd, qkv_w, gate_w, gate_b, out_w, out_b,
+                             nb_bias=nb, mask=mask, gating=gating)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=3e-4, atol=3e-5)
+
+
+def test_fused_gate_attention_separate_weights_cross_attention():
+    rng = np.random.default_rng(4)
+    B, M, R, K, D, N, H = 1, 2, 5, 7, 8, 2, 4
+    qd = rng.standard_normal((B, M, R, D)).astype("float32")
+    kd = rng.standard_normal((B, M, K, D)).astype("float32")
+    q_w = rng.standard_normal((D, N, H)).astype("float32") * 0.3
+    k_w = rng.standard_normal((D, N, H)).astype("float32") * 0.3
+    v_w = rng.standard_normal((D, N, H)).astype("float32") * 0.3
+    out_w = rng.standard_normal((N, H, D)).astype("float32") * 0.3
+    out_b = np.zeros((D,), "float32")
+    out = IF.fused_gate_attention(
+        paddle.to_tensor(qd), key=paddle.to_tensor(kd),
+        query_weight=paddle.to_tensor(q_w), key_weight=paddle.to_tensor(k_w),
+        value_weight=paddle.to_tensor(v_w),
+        out_linear_weight=paddle.to_tensor(out_w),
+        out_linear_bias=paddle.to_tensor(out_b),
+        has_gating=False, merge_qkv=False)
+    # numpy reference for the separate-projection path
+    f64 = np.float64
+    q = np.einsum("bmrd,dnh->bmrnh", qd.astype(f64), q_w.astype(f64)) / np.sqrt(H)
+    k = np.einsum("bmkd,dnh->bmknh", kd.astype(f64), k_w.astype(f64))
+    v = np.einsum("bmkd,dnh->bmknh", kd.astype(f64), v_w.astype(f64))
+    logits = np.einsum("bmqnh,bmknh->bmnqk", q, k)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ctx = np.einsum("bmnqk,bmknh->bmqnh", p, v)
+    ref = np.einsum("bmrnh,nhd->bmrd", ctx, out_w.astype(f64))
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=3e-4, atol=3e-5)
+
+
+def test_fused_gate_attention_loud_misconfiguration():
+    q = paddle.ones([1, 1, 2, 4])
+    w = paddle.ones([3, 2, 2, 4])
+    with pytest.raises(ValueError, match="qkv_weight"):
+        IF.fused_gate_attention(q, out_linear_weight=paddle.ones([2, 2, 4]),
+                                out_linear_bias=paddle.ones([4]))
+    with pytest.raises(ValueError, match="gate_linear_weight"):
+        IF.fused_gate_attention(q, qkv_weight=w,
+                                out_linear_weight=paddle.ones([2, 2, 4]),
+                                out_linear_bias=paddle.ones([4]))
+
+
+def test_fused_dot_product_attention_dropout_training_path():
+    """Dropout must actually execute in training (the broken-rng-import /
+    silently-skipped-on-flash-path class): zeros appear in the
+    probabilities and the causal fast path is NOT taken when dropout is
+    active."""
+    rng = np.random.default_rng(5)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((1, 16, 2, 4)).astype("float32"))
+               for _ in range(3))
+    paddle.seed(7)
+    out_a = IF.fused_dot_product_attention(
+        q, k, v, is_causal_masking=True, dropout_prob=0.5, is_training=True)
+    paddle.seed(8)
+    out_b = IF.fused_dot_product_attention(
+        q, k, v, is_causal_masking=True, dropout_prob=0.5, is_training=True)
+    a, b = np.asarray(out_a._value), np.asarray(out_b._value)
+    assert np.isfinite(a).all() and np.isfinite(b).all()
+    assert np.abs(a - b).max() > 1e-6  # different keys -> different drops
+    # and inference ignores dropout entirely (matches the clean reference)
+    out_inf = IF.fused_dot_product_attention(
+        q, k, v, is_causal_masking=True, dropout_prob=0.5, is_training=False)
+    ref = _np_sdpa(q.numpy(), k.numpy(), v.numpy(), causal=True)
+    np.testing.assert_allclose(np.asarray(out_inf._value), ref,
+                               rtol=2e-4, atol=2e-5)
