@@ -522,23 +522,25 @@ def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
     fused_dot_product_attention.py (cuDNN fused attention, layout
     [B, S, N, H], int32/bool mask broadcast [B, 1, Sq, Sk]).
 
-    TPU-native: the causal no-mask path routes through the Pallas flash
-    kernel; masked paths compute the reference math in one jit region
-    (XLA fuses).  `return_softmax` returns the probabilities — only
-    available on the non-flash path, as flash never materializes them.
+    TPU-native: the causal path routes through the Pallas flash kernel;
+    masked paths compute the reference math in one jit region (XLA
+    fuses).  `return_softmax` returns the probabilities — only available
+    on the non-flash path, as flash never materializes them.  When
+    `is_causal_masking` is True an explicit `mask` is IGNORED (reference
+    docstring semantics); causal masking is bottom-right aligned for
+    Sq != Sk on both paths.
     """
     q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
     head_dim = int(q.shape[-1])
     scale = (1.0 / math.sqrt(head_dim)) if scaling_factor is None else float(scaling_factor)
     dropout_active = dropout_prob > 0.0 and is_training
-    if is_causal_masking and mask is None and not return_softmax \
-            and not dropout_active \
-            and abs(scale - 1.0 / math.sqrt(head_dim)) < 1e-12:
+    if is_causal_masking and not return_softmax and not dropout_active:
         return apply(
             "flash_attention",
-            lambda qv, kv, vv: _ops.flash_attention(qv, kv, vv, causal=True),
+            lambda qv, kv, vv: _ops.flash_attention(qv, kv, vv, causal=True,
+                                                    scale=scale),
             q, k, v)
-    extras = [] if mask is None else [ensure_tensor(mask)]
+    extras = [] if mask is None or is_causal_masking else [ensure_tensor(mask)]
     # probability dropout: key fetched at trace time, the canonical pattern
     # (nn/functional/common.py dropout)
     drop_key = _random.next_key() if dropout_active else None
@@ -547,7 +549,9 @@ def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
         s = jnp.einsum("bqnh,bknh->bnqk", qv.astype(jnp.float32),
                        kv.astype(jnp.float32)) * scale
         if is_causal_masking:
-            causal = jnp.tril(jnp.ones((qv.shape[1], kv.shape[1]), bool))
+            # bottom-right aligned (matches the flash kernel for Sq != Sk)
+            causal = jnp.tril(jnp.ones((qv.shape[1], kv.shape[1]), bool),
+                              k=kv.shape[1] - qv.shape[1])
             s = jnp.where(causal[None, None], s, -1e30)
         elif rest:
             keep = rest[0].astype(bool)  # [B, 1, Sq, Sk], True = attend

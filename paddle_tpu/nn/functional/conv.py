@@ -83,6 +83,14 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data
 
 
 def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, nd, data_format, output_size):
+    """Reference conv_transpose semantics (paddle/torch):
+    out = (in - 1)*s - 2p + d*(k - 1) + output_padding + 1.
+
+    lax.conv_transpose is conv_general_dilated with lhs_dilation=strides
+    and a FORWARD-conv padding spec, so the paddle padding p maps to
+    lax pads (d*(k-1) - p, d*(k-1) - p + output_padding), with
+    transpose_kernel=True for the spatial flip + I/O swap of the adjoint
+    (verified element-wise vs torch.conv_transpose{1,2,3}d)."""
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     strides = _tuple(stride, nd)
     dilations = _tuple(dilation, nd)
@@ -90,21 +98,41 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
     channel_last = data_format[-1] == "C"
     spatial = "DHW"[-nd:]
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
-    rhs_spec = "IO" + spatial  # paddle conv_transpose weight is [in, out/groups, *k]
+    # paddle weight layout is [in, out/groups, *k]; with transpose_kernel
+    # lax wants the FORWARD kernel's spec, whose O axis is our in axis
+    rhs_spec = "OI" + spatial
     dn = jax.lax.conv_dimension_numbers(tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, lhs_spec))
     pad_spec = _padding(padding, nd, strides, dilations, weight.shape[2:])
+    kernel = [int(k) for k in weight.shape[2:]]
+    in_spatial = [int(s) for s in (x.shape[1:-1] if channel_last else x.shape[2:])]
+
+    if not isinstance(pad_spec, str):
+        if output_size is not None:
+            # paddle: output_size picks the target within the stride-sized
+            # ambiguity window — expressed as extra output_padding
+            target = [int(s) for s in (output_size if isinstance(output_size, (list, tuple)) else [output_size] * nd)]
+            default = [
+                (i - 1) * s - (p[0] + p[1]) + d * (k - 1) + 1
+                for i, s, p, d, k in zip(in_spatial, strides, pad_spec, dilations, kernel)
+            ]
+            opad = tuple(t - dflt for t, dflt in zip(target, default))
+            for o, s in zip(opad, strides):
+                if not 0 <= o < max(s, 1) + 1:
+                    raise ValueError(
+                        f"output_size {target} unreachable: implied "
+                        f"output_padding {opad} outside [0, stride)")
+        pads = [
+            (d * (k - 1) - p[0], d * (k - 1) - p[1] + o)
+            for p, o, d, k in zip(pad_spec, opad, dilations, kernel)
+        ]
+    else:
+        pads = pad_spec
 
     def _cvt(v, w, *rest):
-        if isinstance(pad_spec, str):
-            pads = pad_spec
-        else:
-            # transpose padding: lax.conv_transpose handles via 'padding' on the fwd conv
-            pads = [(p[0], p[1] + o) for p, o in zip(pad_spec, opad)] if opad != (0,) * nd else pad_spec
-
         if groups == 1:
             out = jax.lax.conv_transpose(
                 v, w, strides=strides, padding=pads, rhs_dilation=dilations,
-                dimension_numbers=dn, transpose_kernel=False,
+                dimension_numbers=dn, transpose_kernel=True,
             )
         else:
             # grouped transpose: split and concat along channel axis
@@ -114,7 +142,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
             outs = [
                 jax.lax.conv_transpose(
                     vv, ww, strides=strides, padding=pads, rhs_dilation=dilations,
-                    dimension_numbers=dn, transpose_kernel=False,
+                    dimension_numbers=dn, transpose_kernel=True,
                 )
                 for vv, ww in zip(vs, ws)
             ]
@@ -126,19 +154,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
             out = out + b.reshape(shape)
         return out
 
-    out = apply("conv_transpose", _cvt, x, weight, *( [ensure_tensor(bias)] if bias is not None else [] ))
-    if output_size is not None:
-        target = [int(s) for s in (output_size if isinstance(output_size, (list, tuple)) else [output_size] * nd)]
-        cur = out.shape[2:] if not channel_last else out.shape[1:-1]
-        if list(cur) != target:
-            # crop/pad to requested size
-            from paddle_tpu.tensor.manipulation import slice as _slice
-
-            axes = list(range(2, 2 + nd)) if not channel_last else list(range(1, 1 + nd))
-            starts = [0] * nd
-            ends = target
-            out = _slice(out, axes, starts, ends)
-    return out
+    return apply("conv_transpose", _cvt, x, weight, *( [ensure_tensor(bias)] if bias is not None else [] ))
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):

@@ -102,9 +102,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
     q = q_ref[:].astype(jnp.float32) * jnp.float32(scale)
 
     num_kv = seq_k // block_k
+    # bottom-right causal alignment for Sq != Sk (the kv-cache/decode
+    # convention; matches flash_attention_reference's tril(k=Sk-Sq))
+    q_off = seq_k - pl.num_programs(2) * bq
     if causal:
-        # only kv blocks whose start <= last q row
-        num_kv_dyn = jnp.int32((qi + 1) * bq + block_k - 1) // jnp.int32(block_k)
+        # only kv blocks whose start <= last (aligned) q row
+        num_kv_dyn = (jnp.int32((qi + 1) * bq + q_off + block_k - 1)
+                      // jnp.int32(block_k))
         num_kv_dyn = jnp.minimum(num_kv_dyn, num_kv)
     else:
         num_kv_dyn = jnp.int32(num_kv)
@@ -117,7 +121,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_pos = q_off + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _mask_val())
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
@@ -185,8 +189,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     scale = jnp.float32(scale)
 
     num_kv = seq_k // block_k
+    q_off = seq_k - pl.num_programs(2) * bq  # bottom-right alignment
     if causal:
-        num_kv_dyn = jnp.minimum(jnp.int32((qi + 1) * bq + block_k - 1) // jnp.int32(block_k), num_kv)
+        num_kv_dyn = jnp.minimum(
+            jnp.int32((qi + 1) * bq + q_off + block_k - 1) // jnp.int32(block_k),
+            num_kv)
     else:
         num_kv_dyn = jnp.int32(num_kv)
 
@@ -195,7 +202,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
         v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_pos = q_off + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _mask_val())
         p = jnp.exp(s - lse)
@@ -216,9 +223,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     scale = jnp.float32(scale)
 
     num_q = seq_q // block_q
+    q_off = pl.num_programs(2) * bk - seq_q  # bottom-right alignment
     if causal:
-        # q blocks starting before this kv block contribute nothing
-        start_q = jnp.int32(ki * bk) // jnp.int32(block_q)
+        # q blocks whose last aligned row precedes this kv block start
+        # contribute nothing
+        start_q = jnp.maximum(jnp.int32(ki * bk) - jnp.int32(q_off),
+                              jnp.int32(0)) // jnp.int32(block_q)
     else:
         start_q = jnp.int32(0)
 
@@ -230,7 +240,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         delta = delta_ref[pl.ds(i * block_q, block_q), :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            q_pos = q_off + i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _mask_val())
         p = jnp.exp(s - lse)  # [bq_blk, bk]

@@ -19,18 +19,75 @@ from paddle_tpu.static.control_flow import (  # noqa: F401
     switch_case,
     while_loop,
 )
+from paddle_tpu.static.sequence import (  # noqa: F401
+    sequence_concat,
+    sequence_enumerate,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_pad,
+    sequence_pool,
+    sequence_reshape,
+    sequence_reverse,
+    sequence_scatter,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
 
 __all__ = [
     "fc", "embedding", "batch_norm", "conv2d",
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "deform_conv2d",
+    "group_norm", "instance_norm", "layer_norm", "data_norm",
+    "spectral_norm", "prelu", "nce", "row_conv", "sparse_embedding",
+    "bilinear_tensor_product", "py_func", "static_pylayer",
     "cond", "while_loop", "case", "switch_case", "Print",
+    "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse",
 ]
+
+
+def _act(out, act):
+    """Apply a reference activation string; unknown strings raise instead
+    of silently returning un-activated output."""
+    if act is None:
+        return out
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise ValueError(f"unsupported act {act!r}")
+    return fn(out)
+
+
+def _transpose_filter_size(filter_size, output_size, in_spatial, stride,
+                           padding, nd, name):
+    """Reference conv*_transpose derives the kernel from output_size when
+    filter_size is None: k = out + 2*p - (in - 1) * s (per dim)."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError(f"{name}: one of filter_size or output_size is "
+                         "required")
+    outs = [int(output_size)] * nd if isinstance(output_size, int) \
+        else [int(o) for o in output_size]
+    ss = [stride] * nd if isinstance(stride, int) else list(stride)
+    ps = [padding] * nd if isinstance(padding, int) else list(padding)
+    ks = [o + 2 * p - (i - 1) * s
+          for o, p, i, s in zip(outs, ps, in_spatial, ss)]
+    if any(k < 1 for k in ks):
+        raise ValueError(f"{name}: output_size {outs} unreachable from "
+                         f"input {list(in_spatial)} with stride {ss}")
+    return ks
 
 
 def _make_param(shape, dtype, initializer):
     from paddle_tpu._core.dtype import to_jax_dtype
 
     val = initializer._init_value(tuple(shape), to_jax_dtype(dtype))
-    return Parameter(val, stop_gradient=False)
+    return Parameter(val)  # trainable=True -> stop_gradient False
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
@@ -68,3 +125,296 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, gro
 
     conv = nn.Conv2D(input.shape[1], num_filters, filter_size, stride, padding, dilation, groups, data_format=data_format)
     return conv(input)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, data_format="NCHW"):
+    import paddle_tpu.nn as nn
+
+    in_ch = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    spatial = [int(d) for d in (input.shape[2:] if data_format == "NCHW"
+                                else input.shape[1:-1])]
+    filter_size = _transpose_filter_size(filter_size, output_size, spatial,
+                                         stride, padding, 2,
+                                         "conv2d_transpose")
+    conv = nn.Conv2DTranspose(in_ch, num_filters, filter_size, stride,
+                              padding, dilation=dilation, groups=groups,
+                              data_format=data_format)
+    return conv(input, output_size=output_size)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, data_format="NCDHW"):
+    import paddle_tpu.nn as nn
+
+    in_ch = int(input.shape[1] if data_format == "NCDHW" else input.shape[-1])
+    conv = nn.Conv3D(in_ch, num_filters, filter_size, stride, padding,
+                     dilation, groups, data_format=data_format)
+    return conv(input)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, data_format="NCDHW"):
+    import paddle_tpu.nn as nn
+
+    in_ch = int(input.shape[1] if data_format == "NCDHW" else input.shape[-1])
+    spatial = [int(d) for d in (input.shape[2:] if data_format == "NCDHW"
+                                else input.shape[1:-1])]
+    filter_size = _transpose_filter_size(filter_size, output_size, spatial,
+                                         stride, padding, 3,
+                                         "conv3d_transpose")
+    conv = nn.Conv3DTranspose(in_ch, num_filters, filter_size, stride,
+                              padding, dilation=dilation, groups=groups,
+                              data_format=data_format)
+    return conv(input, output_size=output_size)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None):
+    from paddle_tpu.vision.ops import deform_conv2d as _dc
+
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _make_param([num_filters, int(input.shape[1]) // groups, *ks],
+                    "float32", I.XavierNormal())
+    return _dc(input, offset, w, mask=mask, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW"):
+    import paddle_tpu.nn as nn
+
+    ch = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    gn = nn.GroupNorm(groups, ch, epsilon, data_format=data_layout)
+    return _act(gn(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None):
+    import paddle_tpu.nn as nn
+
+    inorm = nn.InstanceNorm2D(int(input.shape[1]), epsilon=epsilon)
+    return inorm(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None):
+    import paddle_tpu.nn as nn
+
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    ln = nn.LayerNorm(shape, epsilon=epsilon)
+    return _act(ln(input), act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Global data normalization via learned batch statistics (reference
+    data_norm op: batch_size/batch_sum/batch_square_sum accumulators;
+    normalizes with their running ratio, no gamma/beta by default)."""
+    d = int(input.shape[-1])
+    batch_size = _make_param([d], "float32", I.Constant(1e4))
+    batch_sum = _make_param([d], "float32", I.Constant(0.0))
+    batch_sq = _make_param([d], "float32", I.Constant(1e4))
+    mean = batch_sum / batch_size
+    scale = (batch_size / batch_sq) ** 0.5
+    return _act((input - mean) * scale, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    import paddle_tpu.nn as nn
+
+    sn = nn.SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                         epsilon=eps)
+    return sn(weight)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [int(x.shape[1] if data_format == "NCHW" else x.shape[-1])]
+    elif mode == "element":
+        shape = [int(s) for s in x.shape[1:]]
+    else:
+        raise ValueError(f"prelu mode must be all/channel/element, got {mode}")
+    alpha = _make_param(shape, "float32", I.Constant(0.25))
+    if mode == "channel" and data_format == "NCHW":
+        a = paddle.reshape(alpha, [1, -1] + [1] * (len(x.shape) - 2))
+    else:
+        a = alpha
+    return paddle.maximum(x, paddle.zeros_like(x)) + a * paddle.minimum(
+        x, paddle.zeros_like(x))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[:, k] = x W_k y^T + b_k (reference bilinear_tensor_product)."""
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = _make_param([size, dx, dy], "float32", I.XavierNormal())
+    b = _make_param([size], "float32", I.Constant(0.0))
+    return _act(F.bilinear(x, y, w, b), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             lod=None):
+    """Lookahead (row) convolution (reference row_conv op, the
+    DeepSpeech2 streaming context layer): out[t] = sum_{i=0..C}
+    x[t+i] * w[i], within each sequence.  Dense [B, T, D] input applies
+    per batch row; flat input needs `lod`."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import apply as _apply
+
+    x = paddle.to_tensor(input) if not hasattr(input, "_value") else input
+    ctx = int(future_context_size) + 1
+    d = int(x.shape[-1])
+    w = _make_param([ctx, d], "float32", I.XavierNormal())
+    if len(x.shape) == 3:  # dense batch [B, T, D]
+        def _fn(v, wv):
+            out = jnp.zeros_like(v)
+            T = v.shape[1]
+            for i in range(ctx):
+                seg = v[:, i:, :] if i else v
+                pad = jnp.zeros((v.shape[0], i, v.shape[2]), v.dtype)
+                out = out + jnp.concatenate([seg, pad], 1)[:, :T] * wv[i]
+            return out
+
+        out = _apply("row_conv", _fn, x, w)
+    else:
+        from paddle_tpu.static.sequence import _lod_np
+
+        lod_np = _lod_np(lod, "row_conv")
+
+        def _fn(v, wv):
+            out = jnp.zeros_like(v)
+            for i in range(ctx):
+                shifted = jnp.concatenate(
+                    [v[i:], jnp.zeros((i, v.shape[1]), v.dtype)], 0) if i else v
+                # zero the contributions that crossed a sequence boundary
+                t = np.arange(v.shape[0])
+                seq_end = np.zeros(v.shape[0], np.int64)
+                for s in range(len(lod_np) - 1):
+                    seq_end[lod_np[s]:lod_np[s + 1]] = lod_np[s + 1]
+                valid = (t + i) < seq_end
+                out = out + shifted * wv[i] * jnp.asarray(valid)[:, None]
+            return out
+
+        out = _apply("row_conv", _fn, x, w)
+    return _act(out, act)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32"):
+    """Embedding with row-sparse gradients (reference sparse_embedding —
+    the large-scale PS path; here the SelectedRows sparse-grad tier)."""
+    import paddle_tpu.nn as nn
+
+    emb = nn.Embedding(int(size[0]), int(size[1]), padding_idx=padding_idx,
+                       sparse=True)
+    return emb(input)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce op): logistic
+    loss over the true class plus `num_neg_samples` sampled noise
+    classes.  Returns the per-example loss [B, 1]."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor._ops_common import apply as _apply
+
+    d = int(input.shape[-1])
+    w = _make_param([num_total_classes, d], "float32", I.XavierNormal())
+    b = _make_param([num_total_classes], "float32", I.Constant(0.0))
+    rng = np.random.default_rng(seed or None)
+    if sampler == "uniform":
+        noise = rng.integers(0, num_total_classes, num_neg_samples)
+        noise_p = np.full(num_neg_samples, 1.0 / num_total_classes)
+    elif sampler == "log_uniform":
+        u = rng.random(num_neg_samples)
+        noise = np.minimum(
+            (np.exp(u * np.log(num_total_classes + 1)) - 1).astype(np.int64),
+            num_total_classes - 1)
+        noise_p = (np.log((noise + 2.0) / (noise + 1.0))
+                   / np.log(num_total_classes + 1.0))
+    elif sampler == "custom_dist":
+        p = np.asarray(custom_dist, np.float64)
+        p = p / p.sum()
+        noise = rng.choice(num_total_classes, num_neg_samples, p=p)
+        noise_p = p[noise]
+    else:
+        raise ValueError(f"unknown sampler {sampler}")
+
+    # noise probability OF THE LABEL, per the chosen sampler — using the
+    # uniform value for every sampler biases the NCE objective
+    if sampler == "uniform":
+        label_p = None  # constant 1/num_total_classes
+    elif sampler == "log_uniform":
+        label_p = "log_uniform"
+    else:
+        label_p = np.asarray(custom_dist, np.float64)
+        label_p = label_p / label_p.sum()
+
+    def _fn(xv, yv, wv, bv):
+        y = yv.reshape(-1).astype(jnp.int32)
+        pos_logit = jnp.sum(xv * wv[y], -1) + bv[y]
+        if label_p is None:
+            pos_p = 1.0 / num_total_classes
+        elif isinstance(label_p, str):
+            pos_p = (jnp.log((y + 2.0) / (y + 1.0))
+                     / jnp.log(num_total_classes + 1.0))
+        else:
+            pos_p = jnp.asarray(label_p)[y]
+        pos = jax.nn.log_sigmoid(
+            pos_logit - jnp.log(num_neg_samples * pos_p))
+        neg_logit = xv @ wv[jnp.asarray(noise)].T + bv[jnp.asarray(noise)]
+        neg = jax.nn.log_sigmoid(
+            -(neg_logit - jnp.log(num_neg_samples * jnp.asarray(noise_p))))
+        return -(pos + neg.sum(-1)).reshape(-1, 1)
+
+    return _apply("nce", _fn, input, label, w, b)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    import paddle_tpu.static as st
+
+    return st.py_func(func, x, out, backward_func=backward_func,
+                      skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference static_pylayer: a forward callable with a custom backward
+    captured into the program.  Implemented over the py_func tier's
+    custom-backward path when backward_fn is given; a plain call
+    otherwise (autograd differentiates through it)."""
+    if backward_fn is None:
+        return forward_fn(*inputs)
+    from paddle_tpu.autograd import PyLayer
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor  # property: the saved tuple
+            return backward_fn(*saved, *grads)
+
+    return _StaticPyLayer.apply(*inputs)

@@ -1,0 +1,364 @@
+"""Sequence (LoD) op tier for paddle.static.nn.
+
+Reference: python/paddle/static/nn/sequence_lod.py — ops over LoDTensors,
+variable-length sequences stored as one flat tensor plus level-0 row
+offsets.  TPUs want static shapes, so the TPU-native representation makes
+the offsets EXPLICIT: every op takes `x` (the flat [total, ...] data) and
+`lod` (the [n+1] int offsets vector, exactly the reference's level-0 LoD),
+and computes with XLA segment ops / gathers instead of per-sequence host
+loops.  Ops that return sequences return (flat, lod) pairs; ops that
+reduce return dense [n, ...] tensors.
+
+A missing `lod` raises immediately — the reference reads it off the
+LoDTensor; here it must be passed, and silently assuming one-big-sequence
+would be a wrong-results class.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor._ops_common import apply, ensure_tensor
+
+__all__ = [
+    "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse",
+]
+
+
+def _lod_np(lod, name):
+    if lod is None:
+        raise ValueError(
+            f"{name}: `lod` (the [n+1] sequence offsets vector) is required "
+            "— the TPU-native sequence tier stores offsets explicitly "
+            "(reference LoDTensors carry them implicitly)")
+    arr = np.asarray(lod._value if hasattr(lod, "_value") else lod,
+                     dtype=np.int64)
+    if arr.ndim != 1 or arr.size < 2 or arr[0] != 0 or np.any(np.diff(arr) < 0):
+        raise ValueError(f"{name}: malformed lod {arr!r} (want monotonic "
+                         "offsets starting at 0)")
+    return arr
+
+
+def _segment_ids(lod, total):
+    """[total] int vector mapping each row to its sequence index."""
+    total = int(total)
+    ids = np.zeros(total, dtype=np.int32)
+    # offsets == total belong to trailing EMPTY sequences — no rows carry
+    # them (indexing with them would be out of bounds)
+    starts = lod[1:-1].astype(np.int64)
+    np.add.at(ids, starts[starts < total], 1)
+    return np.cumsum(ids, dtype=np.int32)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, lod=None):
+    """Softmax over each sequence (input [total, 1] or [total])."""
+    x = ensure_tensor(input)
+    lod_np = _lod_np(lod, "sequence_softmax")
+    seg = _segment_ids(lod_np, x.shape[0])
+    n = len(lod_np) - 1
+
+    def _fn(v):
+        flat = v.reshape(v.shape[0], -1)
+        m = jax.ops.segment_max(flat, seg, num_segments=n)[seg]
+        e = jnp.exp(flat - m)
+        z = jax.ops.segment_sum(e, seg, num_segments=n)[seg]
+        return (e / z).reshape(v.shape)
+
+    return apply("sequence_softmax", _fn, x)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0, lod=None):
+    """sum/average/sqrt/max/min/first/last pooling per sequence -> [n, ...].
+    Empty sequences yield `pad_value` (reference semantics)."""
+    x = ensure_tensor(input)
+    pool_type = pool_type.lower()
+    lod_np = _lod_np(lod, "sequence_pool")
+    seg = _segment_ids(lod_np, x.shape[0])
+    n = len(lod_np) - 1
+    lens = np.diff(lod_np)
+    empty = lens == 0
+
+    def _fn(v):
+        flat = v.reshape(v.shape[0], -1)
+        if pool_type == "sum":
+            out = jax.ops.segment_sum(flat, seg, num_segments=n)
+        elif pool_type in ("average", "mean"):
+            s = jax.ops.segment_sum(flat, seg, num_segments=n)
+            out = s / jnp.maximum(jnp.asarray(lens)[:, None], 1)
+        elif pool_type == "sqrt":
+            s = jax.ops.segment_sum(flat, seg, num_segments=n)
+            out = s / jnp.sqrt(jnp.maximum(jnp.asarray(lens)[:, None], 1))
+        elif pool_type == "max":
+            out = jax.ops.segment_max(flat, seg, num_segments=n)
+        elif pool_type == "min":
+            out = jax.ops.segment_min(flat, seg, num_segments=n)
+        elif pool_type == "first":
+            idx = np.clip(lod_np[:-1], 0, max(v.shape[0] - 1, 0))
+            out = flat[jnp.asarray(idx)]
+        elif pool_type == "last":
+            idx = np.clip(lod_np[1:] - 1, 0, max(v.shape[0] - 1, 0))
+            out = flat[jnp.asarray(idx)]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        if empty.any():
+            out = jnp.where(jnp.asarray(empty)[:, None], pad_value, out)
+        return out.reshape((n,) + v.shape[1:])
+
+    return apply("sequence_pool", _fn, x)
+
+
+def sequence_first_step(input, lod=None):
+    return sequence_pool(input, "first", lod=lod)
+
+
+def sequence_last_step(input, lod=None):
+    return sequence_pool(input, "last", lod=lod)
+
+
+def sequence_concat(input, name=None, lod=None):
+    """Concat the i-th sequences of every input -> (flat, lod).
+    `input`/`lod` are same-length lists."""
+    if lod is None or len(input) != len(lod):
+        raise ValueError("sequence_concat needs one lod per input")
+    xs = [ensure_tensor(x) for x in input]
+    lods = [_lod_np(l, "sequence_concat") for l in lod]
+    n = len(lods[0]) - 1
+    if any(len(l) - 1 != n for l in lods):
+        raise ValueError("sequence_concat: inputs disagree on sequence count")
+    order = []  # (input_idx, start, stop) in output order
+    out_lens = []
+    for i in range(n):
+        tot = 0
+        for j, l in enumerate(lods):
+            order.append((j, int(l[i]), int(l[i + 1])))
+            tot += int(l[i + 1] - l[i])
+        out_lens.append(tot)
+    gather_src = np.concatenate(
+        [np.arange(s, e) + sum(x.shape[0] for x in xs[:j])
+         for j, s, e in order]) if order else np.zeros(0, np.int64)
+    new_lod = np.concatenate([[0], np.cumsum(out_lens)])
+
+    def _fn(*vs):
+        allv = jnp.concatenate([v.reshape(v.shape[0], -1) for v in vs], 0)
+        out = allv[jnp.asarray(gather_src)]
+        return out.reshape((out.shape[0],) + vs[0].shape[1:])
+
+    from paddle_tpu._core.tensor import Tensor
+
+    flat = apply("sequence_concat", _fn, *xs)
+    return flat, Tensor(jnp.asarray(new_lod))
+
+
+def sequence_slice(input, offset, length, name=None, lod=None):
+    """Per-sequence slice: sequence i keeps rows [offset[i], offset[i]+length[i])."""
+    x = ensure_tensor(input)
+    lod_np = _lod_np(lod, "sequence_slice")
+    off = np.asarray(offset._value if hasattr(offset, "_value") else offset,
+                     np.int64).reshape(-1)
+    ln = np.asarray(length._value if hasattr(length, "_value") else length,
+                    np.int64).reshape(-1)
+    n = len(lod_np) - 1
+    if off.size != n or ln.size != n:
+        raise ValueError("sequence_slice: offset/length must have one entry "
+                         "per sequence")
+    idx, new_lens = [], []
+    for i in range(n):
+        s = lod_np[i] + off[i]
+        e = s + ln[i]
+        if off[i] < 0 or e > lod_np[i + 1]:
+            raise ValueError(f"sequence_slice: slice [{off[i]}, {off[i]+ln[i]}) "
+                             f"out of bounds for sequence {i} of length "
+                             f"{lod_np[i+1]-lod_np[i]}")
+        idx.append(np.arange(s, e))
+        new_lens.append(int(ln[i]))
+    gather = np.concatenate(idx) if idx else np.zeros(0, np.int64)
+    new_lod = np.concatenate([[0], np.cumsum(new_lens)])
+
+    from paddle_tpu._core.tensor import Tensor
+
+    flat = apply("sequence_slice", lambda v: v[jnp.asarray(gather)], x)
+    return flat, Tensor(jnp.asarray(new_lod))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, x_lod=None, y_lod=None):
+    """Repeat sequence i of x once per entry of y's sequence i
+    (level-0 semantics of the reference op)."""
+    xt = ensure_tensor(x)
+    ylod = _lod_np(y_lod, "sequence_expand")
+    xlod = _lod_np(x_lod, "sequence_expand") if x_lod is not None else None
+    n = len(ylod) - 1
+    reps = np.diff(ylod)
+    if xlod is None:  # x dense [n, ...]: repeat rows
+        if int(xt.shape[0]) != n:
+            raise ValueError("sequence_expand: dense x rows must equal y's "
+                             "sequence count")
+        gather = np.repeat(np.arange(n), reps)
+        new_lod = np.concatenate([[0], np.cumsum(reps)])
+    else:
+        if len(xlod) - 1 != n:
+            raise ValueError("sequence_expand: x and y sequence counts differ")
+        idx, lens = [], []
+        for i in range(n):
+            seq = np.arange(xlod[i], xlod[i + 1])
+            for _ in range(int(reps[i])):
+                idx.append(seq)
+                lens.append(seq.size)
+        gather = np.concatenate(idx) if idx else np.zeros(0, np.int64)
+        new_lod = np.concatenate([[0], np.cumsum(lens)]) if lens else np.array([0, 0])
+
+    from paddle_tpu._core.tensor import Tensor
+
+    flat = apply("sequence_expand", lambda v: v[jnp.asarray(gather)], xt)
+    return flat, Tensor(jnp.asarray(new_lod))
+
+
+def sequence_expand_as(x, y, name=None, y_lod=None):
+    """Expand row i of x to the length of y's sequence i."""
+    xt = ensure_tensor(x)
+    ylod = _lod_np(y_lod, "sequence_expand_as")
+    n = len(ylod) - 1
+    if int(xt.shape[0]) != n:
+        raise ValueError("sequence_expand_as: x rows must equal y's "
+                         "sequence count")
+    reps = np.diff(ylod)
+    gather = np.repeat(np.arange(n), reps)
+
+    from paddle_tpu._core.tensor import Tensor
+
+    flat = apply("sequence_expand_as", lambda v: v[jnp.asarray(gather)], xt)
+    return flat, Tensor(jnp.asarray(ylod))
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, lod=None):
+    """(flat, lod) -> ([n, maxlen, ...] padded, [n] lengths)."""
+    xt = ensure_tensor(x)
+    pv = ensure_tensor(pad_value)
+    lod_np = _lod_np(lod, "sequence_pad")
+    lens = np.diff(lod_np)
+    n = len(lens)
+    m = int(maxlen) if maxlen is not None else int(lens.max() if n else 0)
+    if n and lens.max() > m:
+        raise ValueError(f"sequence_pad: maxlen={m} < longest sequence "
+                         f"({int(lens.max())})")
+    # gather index per (seq, pos): row in flat, or a sentinel for padding
+    gather = np.zeros((n, m), np.int64)
+    is_pad = np.ones((n, m), bool)
+    for i in range(n):
+        gather[i, : lens[i]] = np.arange(lod_np[i], lod_np[i + 1])
+        is_pad[i, : lens[i]] = False
+
+    def _fn(v, p):
+        flat = v.reshape(v.shape[0], -1)
+        out = flat[jnp.asarray(gather.reshape(-1))]
+        out = jnp.where(jnp.asarray(is_pad.reshape(-1))[:, None],
+                        p.reshape(-1), out)
+        return out.reshape((n, m) + v.shape[1:])
+
+    from paddle_tpu._core.tensor import Tensor
+
+    padded = apply("sequence_pad", _fn, xt, pv)
+    return padded, Tensor(jnp.asarray(lens))
+
+
+def sequence_unpad(x, length, name=None):
+    """([n, maxlen, ...], [n] lengths) -> (flat, lod)."""
+    xt = ensure_tensor(x)
+    lens = np.asarray(length._value if hasattr(length, "_value") else length,
+                      np.int64).reshape(-1)
+    n, m = int(xt.shape[0]), int(xt.shape[1])
+    if lens.size != n or (lens > m).any():
+        raise ValueError("sequence_unpad: bad lengths")
+    pairs = np.concatenate([np.stack([np.full(l, i), np.arange(l)], 1)
+                            for i, l in enumerate(lens) if l],
+                           0) if lens.sum() else np.zeros((0, 2), np.int64)
+    lod_np = np.concatenate([[0], np.cumsum(lens)])
+
+    def _fn(v):
+        flat = v.reshape(n * m, -1)
+        out = flat[jnp.asarray(pairs[:, 0] * m + pairs[:, 1])]
+        return out.reshape((out.shape[0],) + v.shape[2:])
+
+    from paddle_tpu._core.tensor import Tensor
+
+    return apply("sequence_unpad", _fn, xt), Tensor(jnp.asarray(lod_np))
+
+
+def sequence_reshape(input, new_dim, lod=None):
+    """Re-chunk each sequence's flattened features into rows of new_dim."""
+    x = ensure_tensor(input)
+    lod_np = _lod_np(lod, "sequence_reshape")
+    d = int(x.shape[-1])
+    lens = np.diff(lod_np) * d
+    if (lens % new_dim).any():
+        raise ValueError("sequence_reshape: each sequence's total elements "
+                         "must divide new_dim")
+    new_lens = lens // new_dim
+    new_lod = np.concatenate([[0], np.cumsum(new_lens)])
+
+    from paddle_tpu._core.tensor import Tensor
+
+    flat = apply("sequence_reshape",
+                 lambda v: v.reshape(-1, new_dim), x)
+    return flat, Tensor(jnp.asarray(new_lod))
+
+
+def sequence_scatter(input, index, updates, name=None, index_lod=None):
+    """Scatter-add per-sequence updates into rows of a dense input:
+    sequence i's (index, update) pairs modify input row i."""
+    x = ensure_tensor(input)
+    upd = ensure_tensor(updates)
+    lod_np = _lod_np(index_lod, "sequence_scatter")
+    idx = np.asarray(index._value if hasattr(index, "_value") else index,
+                     np.int64).reshape(-1)
+    seg = _segment_ids(lod_np, idx.size)
+
+    def _fn(v, u):
+        rows = jnp.asarray(seg)
+        cols = jnp.asarray(idx)
+        return v.at[rows, cols].add(u.reshape(-1).astype(v.dtype))
+
+    return apply("sequence_scatter", _fn, x, upd)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None, lod=None):
+    """Sliding windows of ids per sequence -> [total, win_size]."""
+    x = ensure_tensor(input)
+    lod_np = _lod_np(lod, "sequence_enumerate")
+    total = int(x.shape[0])
+    gather = np.zeros((total, win_size), np.int64)
+    pad = np.zeros((total, win_size), bool)
+    n = len(lod_np) - 1
+    for i in range(n):
+        for t in range(lod_np[i], lod_np[i + 1]):
+            for w in range(win_size):
+                src = t + w
+                if src < lod_np[i + 1]:
+                    gather[t, w] = src
+                else:
+                    pad[t, w] = True
+
+    def _fn(v):
+        flat = v.reshape(-1)
+        out = flat[jnp.asarray(gather.reshape(-1))]
+        out = jnp.where(jnp.asarray(pad.reshape(-1)), pad_value, out)
+        return out.reshape(total, win_size)
+
+    return apply("sequence_enumerate", _fn, x)
+
+
+def sequence_reverse(x, name=None, lod=None):
+    """Reverse rows within each sequence."""
+    xt = ensure_tensor(x)
+    lod_np = _lod_np(lod, "sequence_reverse")
+    gather = np.concatenate(
+        [np.arange(lod_np[i + 1] - 1, lod_np[i] - 1, -1)
+         for i in range(len(lod_np) - 1)]
+    ) if lod_np[-1] else np.zeros(0, np.int64)
+    return apply("sequence_reverse", lambda v: v[jnp.asarray(gather)], xt)

@@ -186,3 +186,20 @@ def test_fused_dot_product_attention_dropout_training_path():
     ref = _np_sdpa(q.numpy(), k.numpy(), v.numpy(), causal=True)
     np.testing.assert_allclose(np.asarray(out_inf._value), ref,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_causal_alignment_matches_between_paths_for_cross_lengths():
+    """Sq != Sk causal: the flash fast path and the fallback einsum path
+    must agree (bottom-right alignment) — return_softmax forces the
+    fallback on an otherwise identical call."""
+    rng = np.random.default_rng(6)
+    q = paddle.to_tensor(rng.standard_normal((1, 4, 2, 8)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((1, 12, 2, 8)).astype("float32"))
+    v = paddle.to_tensor(rng.standard_normal((1, 12, 2, 8)).astype("float32"))
+    fast = IF.fused_dot_product_attention(q, k, v, is_causal_masking=True,
+                                          is_training=False)
+    slow, _ = IF.fused_dot_product_attention(q, k, v, is_causal_masking=True,
+                                             is_training=False,
+                                             return_softmax=True)
+    np.testing.assert_allclose(np.asarray(fast._value),
+                               np.asarray(slow._value), rtol=2e-4, atol=2e-5)

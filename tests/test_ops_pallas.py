@@ -286,3 +286,43 @@ def test_flash_nondefault_blocks_match_reference():
         paddle.set_flags({"FLAGS_use_pallas": "auto", "FLAGS_flash_block_q": 0, "FLAGS_flash_block_k": 0})
     ref = flash_attention_reference(q, q, q, causal=True)
     assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_flash_causal_cross_length_bottom_right_alignment():
+    """Sq != Sk causal must be bottom-right aligned (kv-cache/decode
+    convention), matching flash_attention_reference — fwd AND bwd.  The
+    kernel previously used top-left (query i sees keys <= i), silently
+    wrong for any chunked-prefill / cache-extension call."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.flash_attention import _flash_bnsh
+
+    ffn = flash_attention
+
+    rng = jax.random.PRNGKey(0)
+    B, N, H = 1, 2, 8
+    Sq, Sk = 128, 256  # block-multiples: the Pallas path, not the fallback
+    q, k, v = (jax.random.normal(kk, (B, Sq if i == 0 else Sk, N, H),
+                                 jnp.float32)
+               for i, kk in enumerate(jax.random.split(rng, 3)))
+
+    out = ffn(q, k, v, causal=True)
+    ref = flash_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    # bwd: compare flash vjp against autodiff through the reference
+    def loss_flash(q, k, v):
+        qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        return jnp.sum(_flash_bnsh(qt, kt, vt, H ** -0.5, True, 64, 64) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(flash_attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)

@@ -1,0 +1,212 @@
+"""Widened paddle.static.nn roster (reference python/paddle/static/nn/
+__init__.py __all__): dense layer functions + the TPU-native sequence
+(LoD) tier over explicit offsets (reference sequence_lod.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static.nn as snn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ---------------------------------------------------------------- dense tier
+
+
+def test_dense_layer_functions_shapes_and_finiteness():
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    x4 = _t(rng.standard_normal((2, 3, 8, 8)).astype("float32"))
+    assert snn.conv2d_transpose(x4, 5, 3).shape[1] == 5
+    assert snn.group_norm(x4, groups=3).shape == x4.shape
+    assert snn.instance_norm(x4).shape == x4.shape
+    assert snn.layer_norm(x4, begin_norm_axis=1).shape == x4.shape
+    assert snn.prelu(x4, "channel").shape == x4.shape
+    x5 = _t(rng.standard_normal((1, 2, 4, 6, 6)).astype("float32"))
+    assert snn.conv3d(x5, 3, 3).shape[1] == 3
+    assert snn.conv3d_transpose(x5, 3, 3).shape[1] == 3
+    w = _t(rng.standard_normal((6, 4)).astype("float32"))
+    sn_w = snn.spectral_norm(w)
+    assert sn_w.shape == w.shape
+    # spectral norm scales the top singular value to ~1
+    s = np.linalg.svd(np.asarray(sn_w._value), compute_uv=False)
+    assert s[0] < 2.0
+    x2 = _t(rng.standard_normal((4, 6)).astype("float32"))
+    y2 = _t(rng.standard_normal((4, 3)).astype("float32"))
+    assert tuple(snn.bilinear_tensor_product(x2, y2, 5).shape) == (4, 5)
+    dn = snn.data_norm(x2)
+    assert dn.shape == x2.shape and np.isfinite(np.asarray(dn._value)).all()
+    ids = _t(rng.integers(0, 10, (4, 3)).astype("int64"))
+    emb = snn.sparse_embedding(ids, size=[10, 6])
+    assert tuple(emb.shape) == (4, 3, 6)
+
+
+def test_prelu_matches_definition():
+    x = _t(np.array([[-2.0, 3.0]], dtype="float32"))
+    out = snn.prelu(x, "all")
+    np.testing.assert_allclose(np.asarray(out._value),
+                               [[-0.5, 3.0]], rtol=1e-6)  # alpha init 0.25
+
+
+def test_nce_loss_positive_and_grad():
+    paddle.seed(1)
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"),
+                         stop_gradient=False)
+    y = _t(rng.integers(0, 20, (4, 1)).astype("int64"))
+    loss = snn.nce(x, y, num_total_classes=20, num_neg_samples=5, seed=7)
+    assert tuple(loss.shape) == (4, 1)
+    vals = np.asarray(loss._value)
+    assert (vals > 0).all() and np.isfinite(vals).all()
+    loss.sum().backward()
+    assert np.abs(np.asarray(x.grad._value)).max() > 0
+
+
+def test_row_conv_dense_lookahead():
+    x = _t(np.ones((1, 4, 2), dtype="float32"))
+    paddle.seed(2)
+    out = snn.row_conv(x, future_context_size=1)
+    v = np.asarray(out._value)
+    assert v.shape == (1, 4, 2)
+    # last timestep sees only itself (no future), so differs from interior
+    assert not np.allclose(v[0, -1], v[0, 0])
+
+
+def test_static_pylayer_custom_backward():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+    out = snn.static_pylayer(
+        lambda a: a * 2.0, [x],
+        backward_fn=lambda a, g: g * 10.0)  # deliberately not the true vjp
+    np.testing.assert_allclose(np.asarray(out._value), [2.0, 4.0])
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), [10.0, 10.0])
+
+
+# -------------------------------------------------------------- sequence tier
+
+
+def _flat_and_lod():
+    # sequences: [0..2], [3..6], [] , [7]
+    x = np.arange(8, dtype="float32").reshape(8, 1)
+    lod = np.array([0, 3, 7, 7, 8])
+    return _t(x), _t(lod), lod
+
+
+def test_sequence_requires_lod():
+    x, _, _ = _flat_and_lod()
+    with pytest.raises(ValueError, match="lod"):
+        snn.sequence_softmax(x)
+
+
+def test_sequence_softmax_and_pool():
+    x, lod, lod_np = _flat_and_lod()
+    sm = np.asarray(snn.sequence_softmax(x, lod=lod)._value).ravel()
+    for s, e in zip(lod_np[:-1], lod_np[1:]):
+        if e > s:
+            np.testing.assert_allclose(sm[s:e].sum(), 1.0, rtol=1e-5)
+    pooled = np.asarray(snn.sequence_pool(x, "sum", lod=lod)._value).ravel()
+    np.testing.assert_allclose(pooled, [0 + 1 + 2, 3 + 4 + 5 + 6, 0.0, 7.0])
+    mx = np.asarray(snn.sequence_pool(x, "max", lod=lod,
+                                      pad_value=-1.0)._value).ravel()
+    np.testing.assert_allclose(mx, [2.0, 6.0, -1.0, 7.0])
+    first = np.asarray(snn.sequence_first_step(x, lod=lod)._value).ravel()
+    last = np.asarray(snn.sequence_last_step(x, lod=lod)._value).ravel()
+    np.testing.assert_allclose(first[[0, 1, 3]], [0.0, 3.0, 7.0])
+    np.testing.assert_allclose(last[[0, 1, 3]], [2.0, 6.0, 7.0])
+
+
+def test_sequence_reverse_pad_unpad_roundtrip():
+    x, lod, lod_np = _flat_and_lod()
+    rev = np.asarray(snn.sequence_reverse(x, lod=lod)._value).ravel()
+    np.testing.assert_allclose(rev, [2, 1, 0, 6, 5, 4, 3, 7])
+    padded, lens = snn.sequence_pad(x, _t(np.float32(-9.0)), lod=lod)
+    p = np.asarray(padded._value)
+    assert p.shape == (4, 4, 1)
+    np.testing.assert_allclose(p[0].ravel(), [0, 1, 2, -9])
+    np.testing.assert_allclose(np.asarray(lens._value), [3, 4, 0, 1])
+    flat, lod2 = snn.sequence_unpad(padded, lens)
+    np.testing.assert_allclose(np.asarray(flat._value), np.asarray(x._value))
+    np.testing.assert_allclose(np.asarray(lod2._value), lod_np)
+
+
+def test_sequence_concat_slice_expand():
+    a = _t(np.array([[1.0], [2.0], [3.0]], "float32"))
+    a_lod = _t(np.array([0, 2, 3]))
+    b = _t(np.array([[10.0], [20.0]], "float32"))
+    b_lod = _t(np.array([0, 1, 2]))
+    flat, lod = snn.sequence_concat([a, b], lod=[a_lod, b_lod])
+    np.testing.assert_allclose(np.asarray(flat._value).ravel(),
+                               [1, 2, 10, 3, 20])
+    np.testing.assert_allclose(np.asarray(lod._value), [0, 3, 5])
+
+    x, xlod, _ = _flat_and_lod()
+    sl, sl_lod = snn.sequence_slice(x, _t(np.array([1, 0, 0, 0])),
+                                    _t(np.array([2, 1, 0, 1])), lod=xlod)
+    np.testing.assert_allclose(np.asarray(sl._value).ravel(), [1, 2, 3, 7])
+    np.testing.assert_allclose(np.asarray(sl_lod._value), [0, 2, 3, 3, 4])
+
+    dense = _t(np.array([[1.0], [2.0]], "float32"))
+    ylod = _t(np.array([0, 2, 5]))
+    ex, ex_lod = snn.sequence_expand(dense, None, y_lod=ylod)
+    np.testing.assert_allclose(np.asarray(ex._value).ravel(), [1, 1, 2, 2, 2])
+    ex2, _ = snn.sequence_expand_as(dense, None, y_lod=ylod)
+    np.testing.assert_allclose(np.asarray(ex2._value).ravel(),
+                               [1, 1, 2, 2, 2])
+
+
+def test_sequence_reshape_enumerate_scatter():
+    x = _t(np.arange(12, dtype="float32").reshape(6, 2))
+    lod = _t(np.array([0, 2, 6]))
+    flat, new_lod = snn.sequence_reshape(x, 4, lod=lod)
+    assert np.asarray(flat._value).shape == (3, 4)
+    np.testing.assert_allclose(np.asarray(new_lod._value), [0, 1, 3])
+
+    ids = _t(np.array([5, 6, 7, 1], "int64"))
+    idlod = _t(np.array([0, 3, 4]))
+    win = np.asarray(snn.sequence_enumerate(ids, 2, pad_value=0,
+                                            lod=idlod)._value)
+    np.testing.assert_array_equal(win, [[5, 6], [6, 7], [7, 0], [1, 0]])
+
+    dense = _t(np.zeros((2, 4), "float32"))
+    upd = _t(np.array([1.0, 2.0, 3.0], "float32"))
+    out = snn.sequence_scatter(dense, _t(np.array([0, 2, 1], "int64")), upd,
+                               index_lod=_t(np.array([0, 2, 3])))
+    np.testing.assert_allclose(np.asarray(out._value),
+                               [[1, 0, 2, 0], [0, 3, 0, 0]])
+
+
+def test_sequence_ops_differentiable():
+    x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(8, 1),
+                         stop_gradient=False)
+    lod = _t(np.array([0, 3, 7, 7, 8]))
+    out = snn.sequence_pool(snn.sequence_softmax(x, lod=lod), "sum", lod=lod)
+    out.sum().backward()
+    g = np.asarray(x.grad._value)
+    assert np.isfinite(g).all()
+
+
+def test_trailing_empty_sequence_and_act_strings():
+    # trailing empty sequences must not crash segment mapping
+    x = _t(np.arange(3, dtype="float32").reshape(3, 1))
+    lod = _t(np.array([0, 3, 3]))
+    pooled = np.asarray(snn.sequence_pool(x, "sum", lod=lod)._value).ravel()
+    np.testing.assert_allclose(pooled, [3.0, 0.0])
+    # unknown act raises instead of silently skipping the activation
+    x4 = _t(np.ones((1, 2, 4, 4), "float32"))
+    with pytest.raises(ValueError, match="unsupported act"):
+        snn.group_norm(x4, groups=2, act="definitely_not_an_act")
+    s = np.asarray(snn.group_norm(x4, groups=2, act="sigmoid")._value)
+    assert ((s >= 0) & (s <= 1)).all()
+
+
+def test_conv2d_transpose_derives_kernel_from_output_size():
+    paddle.seed(5)
+    x = _t(np.random.default_rng(5).standard_normal((1, 2, 8, 8))
+           .astype("float32"))
+    out = snn.conv2d_transpose(x, 3, output_size=17, stride=2)
+    assert tuple(out.shape)[2:] == (17, 17)
+    with pytest.raises(ValueError, match="filter_size or output_size"):
+        snn.conv2d_transpose(x, 3)
