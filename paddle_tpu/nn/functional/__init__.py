@@ -1,7 +1,15 @@
 """paddle.nn.functional surface (reference: python/paddle/nn/functional/__init__.py)."""
 
 from .activation import *  # noqa: F401,F403
-from .attention import flash_attention, scaled_dot_product_attention, sdpa_reference, sparse_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_attention,
+    flash_attn_qkvpacked,
+    flash_attn_unpadded,
+    scaled_dot_product_attention,
+    sdp_kernel,
+    sdpa_reference,
+    sparse_attention,
+)
 from .vision import affine_grid, grid_sample  # noqa: F401
 from .common import *  # noqa: F401,F403
 from .conv import (  # noqa: F401

@@ -32,7 +32,7 @@ def scaled_dot_product_attention(
     def _sdpa(q, k, v, *rest):
         # jax.nn.dot_product_attention expects BSNH as well.
         mask = rest[0] if rest else None
-        if mask is None:
+        if mask is None and _SDPBackendState.enable_flash:
             from paddle_tpu import ops as _ops
 
             if _ops.use_pallas():
@@ -128,3 +128,122 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, k
         return jnp.einsum("bhst,bhtd->bhsd", p, v.astype(jnp.float32)).astype(q.dtype)
 
     return _apply("sparse_attention", _fn, query, key, value, off, cols)
+
+
+class _SDPBackendState:
+    enable_math = True
+    enable_flash = True
+    enable_mem_efficient = True
+
+
+def sdp_kernel(enable_math=False, enable_flash=True,
+               enable_mem_efficient=True):
+    """Context manager selecting the scaled-dot-product backend (reference
+    nn/functional/flash_attention.py sdp_kernel).  TPU-native mapping:
+    'flash' = the Pallas kernel path, 'math'/'mem_efficient' = the XLA
+    einsum path (XLA's fusion IS the memory-efficient tier); disabling
+    every backend raises at entry like the reference's kernel-dispatch
+    failure, but eagerly and readably."""
+    import contextlib
+
+    if not (enable_math or enable_flash or enable_mem_efficient):
+        raise ValueError("sdp_kernel: at least one backend must be enabled")
+
+    @contextlib.contextmanager
+    def _ctx():
+        prev = (_SDPBackendState.enable_math, _SDPBackendState.enable_flash,
+                _SDPBackendState.enable_mem_efficient)
+        _SDPBackendState.enable_math = enable_math
+        _SDPBackendState.enable_flash = enable_flash
+        _SDPBackendState.enable_mem_efficient = enable_mem_efficient
+        try:
+            yield
+        finally:
+            (_SDPBackendState.enable_math, _SDPBackendState.enable_flash,
+             _SDPBackendState.enable_mem_efficient) = prev
+
+    return _ctx()
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Packed-QKV flash attention (reference flash_attn_qkvpacked):
+    qkv is [B, S, 3, N, H]."""
+    qkv = ensure_tensor(qkv)
+    from paddle_tpu.tensor.manipulation import squeeze, split
+
+    q, k, v = (squeeze(t, axis=2) for t in split(qkv, 3, axis=2))
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention over packed sequences (reference
+    flash_attn_unpadded): query/key/value are [total, N, H] with
+    cumulative sequence offsets (cu_seqlens, the LoD vector).
+
+    TPU-native: the ragged batch is masked block-diagonally in one jit
+    region — XLA keeps the matmuls dense on the MXU; sequences never
+    attend across boundaries.  Returns (out, None) like flash_attention.
+    """
+    import numpy as np
+
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    cq = np.asarray(cu_seqlens_q._value if hasattr(cu_seqlens_q, "_value")
+                    else cu_seqlens_q, np.int64)
+    ck = np.asarray(cu_seqlens_k._value if hasattr(cu_seqlens_k, "_value")
+                    else cu_seqlens_k, np.int64)
+    if len(cq) != len(ck):
+        raise ValueError("flash_attn_unpadded: cu_seqlens_q and cu_seqlens_k "
+                         "must describe the same number of sequences")
+    tq, tk = int(query.shape[0]), int(key.shape[0])
+    if cq[-1] != tq or ck[-1] != tk:
+        # padded/mismatched packed buffers would silently let the last
+        # sequence attend to garbage pad rows
+        raise ValueError(
+            f"flash_attn_unpadded: cu_seqlens must cover the packed buffer "
+            f"exactly (cu_seqlens_q[-1]={int(cq[-1])} vs {tq} rows, "
+            f"cu_seqlens_k[-1]={int(ck[-1])} vs {tk} rows)")
+
+    def _seg(cu, total):
+        seg = np.zeros(total, np.int64)
+        starts = cu[1:-1]
+        np.add.at(seg, starts[starts < total], 1)
+        return np.cumsum(seg)
+
+    seg_q, seg_k = _seg(cq, tq), _seg(ck, tk)
+    # per-row position within its sequence (for causal alignment)
+    pos_q = np.arange(tq) - cq[seg_q]
+    pos_k = np.arange(tk) - ck[seg_k]
+    len_q = (cq[1:] - cq[:-1])[seg_q]
+    len_k = (ck[1:] - ck[:-1])[seg_k]
+
+    allowed = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        # bottom-right aligned within each sequence pair
+        allowed &= (pos_q[:, None] + (len_k[None, :] - len_q[:, None])
+                    >= pos_k[None, :])
+
+    dropout_active = dropout > 0.0 and training
+    if dropout_active:  # key at trace time (common.py dropout pattern)
+        from paddle_tpu._core import random as _random
+
+        drop_key = _random.next_key()
+
+    def _fn(q, k, v):
+        s = jnp.einsum("qnh,knh->nqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * jnp.float32(scale)
+        s = jnp.where(jnp.asarray(allowed)[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        if dropout_active:
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        return jnp.einsum("nqk,knh->qnh", p, v.astype(jnp.float32)).astype(q.dtype)
+
+    out = apply("flash_attn_unpadded", _fn, query, key, value)
+    return out, None

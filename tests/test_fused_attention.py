@@ -203,3 +203,102 @@ def test_causal_alignment_matches_between_paths_for_cross_lengths():
                                              return_softmax=True)
     np.testing.assert_allclose(np.asarray(fast._value),
                                np.asarray(slow._value), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attn_unpadded_blocks_cross_sequence_attention():
+    """Varlen flash (reference flash_attn_unpadded): packed sequences
+    must attend only within their own boundaries; per-sequence results
+    equal running plain attention on each sequence separately."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(7)
+    lens = [3, 5, 2]
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype("int32")
+    N, H = 2, 4
+    total = sum(lens)
+    q = rng.standard_normal((total, N, H)).astype("float32")
+    k = rng.standard_normal((total, N, H)).astype("float32")
+    v = rng.standard_normal((total, N, H)).astype("float32")
+    scale = 1.0 / np.sqrt(H)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), max(lens), max(lens),
+        scale, training=False)
+    got = np.asarray(out._value)
+    for s, e in zip(cu[:-1], cu[1:]):
+        ref = _np_sdpa(q[None, s:e], k[None, s:e], v[None, s:e],
+                       scale=scale)[0]
+        np.testing.assert_allclose(got[s:e], ref, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attn_unpadded_causal_matches_per_sequence():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(8)
+    lens = [4, 2]
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype("int32")
+    N, H = 1, 4
+    total = sum(lens)
+    q, k, v = (rng.standard_normal((total, N, H)).astype("float32")
+               for _ in range(3))
+    scale = 1.0 / np.sqrt(H)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 4, 4, scale,
+        causal=True, training=False)
+    got = np.asarray(out._value)
+    for s, e in zip(cu[:-1], cu[1:]):
+        ref = _np_sdpa(q[None, s:e], k[None, s:e], v[None, s:e],
+                       causal=True, scale=scale)[0]
+        np.testing.assert_allclose(got[s:e], ref, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attn_qkvpacked_and_sdp_kernel():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(9)
+    qkv = rng.standard_normal((1, 8, 3, 2, 4)).astype("float32")
+    with F.sdp_kernel(enable_math=True, enable_flash=False,
+                      enable_mem_efficient=False):
+        out, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv), causal=True,
+                                        training=False)
+    ref = _np_sdpa(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True)
+    np.testing.assert_allclose(np.asarray(out._value), ref,
+                               rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="at least one backend"):
+        F.sdp_kernel(enable_math=False, enable_flash=False,
+                     enable_mem_efficient=False)
+
+
+def test_sdp_kernel_actually_gates_flash_dispatch(monkeypatch):
+    """sdp_kernel must change dispatch, not just record flags: with flash
+    disabled the Pallas kernel is never invoked."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import ops as _ops
+
+    rng = np.random.default_rng(10)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((1, 8, 2, 4))
+                                .astype("float32")) for _ in range(3))
+
+    calls = []
+    real = _ops.flash_attention
+    monkeypatch.setattr(_ops, "flash_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    monkeypatch.setattr(_ops, "use_pallas", lambda: True)
+    with F.sdp_kernel(enable_math=True, enable_flash=False,
+                      enable_mem_efficient=False):
+        F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    assert calls == [], "flash path ran despite enable_flash=False"
+    F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    assert calls == [1], "flash path should run by default"
+
+
+def test_flash_attn_unpadded_rejects_padded_buffers():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(11)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((12, 1, 4))
+                                .astype("float32")) for _ in range(3))
+    cu = paddle.to_tensor(np.array([0, 3, 8, 10], "int32"))  # 10 != 12 rows
+    with pytest.raises(ValueError, match="cover the packed buffer"):
+        F.flash_attn_unpadded(q, k, v, cu, cu, 5, 5, 0.5, training=False)
