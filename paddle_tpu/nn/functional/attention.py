@@ -37,6 +37,18 @@ def scaled_dot_product_attention(
 
             if _ops.use_pallas():
                 return _ops.flash_attention(q, k, v, causal=bool(is_causal))
+        if not (_SDPBackendState.enable_math
+                or _SDPBackendState.enable_mem_efficient):
+            # the XLA einsum path plays both the math and mem-efficient
+            # roles; with both disabled there is no backend left for this
+            # call (masked, or flash unavailable) — raise like the
+            # reference's kernel-dispatch failure instead of silently
+            # running a disabled backend
+            raise RuntimeError(
+                "scaled_dot_product_attention: no enabled backend can "
+                "serve this call (flash cannot take an attn_mask / is "
+                "unavailable, and math+mem_efficient are disabled by "
+                "sdp_kernel)")
         bias = None
         if mask is not None and mask.dtype != jnp.bool_:
             bias = mask
@@ -217,17 +229,16 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         return np.cumsum(seg)
 
     seg_q, seg_k = _seg(cq, tq), _seg(ck, tk)
-    # per-row position within its sequence (for causal alignment)
+    # per-row position within its sequence (for causal alignment); these
+    # ride as RUNTIME int32 args, not closure constants — a baked
+    # [total_q, total_k] mask would cost O(total^2) host memory and a
+    # recompile per distinct packing
     pos_q = np.arange(tq) - cq[seg_q]
     pos_k = np.arange(tk) - ck[seg_k]
     len_q = (cq[1:] - cq[:-1])[seg_q]
     len_k = (ck[1:] - ck[:-1])[seg_k]
-
-    allowed = seg_q[:, None] == seg_k[None, :]
-    if causal:
-        # bottom-right aligned within each sequence pair
-        allowed &= (pos_q[:, None] + (len_k[None, :] - len_q[:, None])
-                    >= pos_k[None, :])
+    row_q = ensure_tensor(np.stack([seg_q, pos_q, len_q]).astype(np.int32))
+    row_k = ensure_tensor(np.stack([seg_k, pos_k, len_k]).astype(np.int32))
 
     dropout_active = dropout > 0.0 and training
     if dropout_active:  # key at trace time (common.py dropout pattern)
@@ -235,15 +246,23 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
         drop_key = _random.next_key()
 
-    def _fn(q, k, v):
+    def _fn(q, k, v, rq, rk):
+        allowed = rq[0][:, None] == rk[0][None, :]
+        if causal:
+            # bottom-right aligned within each sequence pair
+            allowed &= (rq[1][:, None] + (rk[2][None, :] - rq[2][:, None])
+                        >= rk[1][None, :])
         s = jnp.einsum("qnh,knh->nqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) * jnp.float32(scale)
-        s = jnp.where(jnp.asarray(allowed)[None], s, -1e30)
+        s = jnp.where(allowed[None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
+        # a query row with ZERO allowed keys (causal with len_k < len_q)
+        # must output zeros, not a uniform average over foreign sequences
+        p = jnp.where(allowed.any(axis=1)[None, :, None], p, 0.0)
         if dropout_active:
             keep = jax.random.bernoulli(drop_key, 1.0 - dropout, p.shape)
             p = jnp.where(keep, p / (1.0 - dropout), 0.0)
         return jnp.einsum("nqk,knh->qnh", p, v.astype(jnp.float32)).astype(q.dtype)
 
-    out = apply("flash_attn_unpadded", _fn, query, key, value)
+    out = apply("flash_attn_unpadded", _fn, query, key, value, row_q, row_k)
     return out, None

@@ -302,3 +302,40 @@ def test_flash_attn_unpadded_rejects_padded_buffers():
     cu = paddle.to_tensor(np.array([0, 3, 8, 10], "int32"))  # 10 != 12 rows
     with pytest.raises(ValueError, match="cover the packed buffer"):
         F.flash_attn_unpadded(q, k, v, cu, cu, 5, 5, 0.5, training=False)
+
+
+def test_flash_attn_unpadded_zero_key_rows_output_zero():
+    """causal with len_k < len_q: query rows preceding every key must
+    output ZEROS, never a uniform average over other sequences' values."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(12)
+    # q: two sequences of 4; k: two sequences of 2 (packed totals differ)
+    cq = paddle.to_tensor(np.array([0, 4, 8], "int32"))
+    ck = paddle.to_tensor(np.array([0, 2, 4], "int32"))
+    q = paddle.to_tensor(rng.standard_normal((8, 1, 4)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((4, 1, 4)).astype("float32"))
+    v = paddle.to_tensor(np.ones((4, 1, 4), "float32") * 100.0)
+    out, _ = F.flash_attn_unpadded(q, k, v, cq, ck, 4, 2, 0.5, causal=True,
+                                   training=False)
+    got = np.asarray(out._value)
+    # bottom-right alignment: q rows 0,1 (pos 0,1; len_k-len_q = -2) have
+    # no visible keys in each sequence
+    np.testing.assert_allclose(got[0], 0.0)
+    np.testing.assert_allclose(got[1], 0.0)
+    np.testing.assert_allclose(got[4], 0.0)
+    np.testing.assert_allclose(got[5], 0.0)
+    assert np.abs(got[[2, 3, 6, 7]]).max() > 1.0  # visible rows attend
+
+
+def test_sdp_kernel_all_xla_backends_disabled_raises_on_masked_call():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(13)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((1, 4, 1, 4))
+                                .astype("float32")) for _ in range(3))
+    mask = paddle.to_tensor(np.zeros((1, 1, 4, 4), "float32"))
+    with F.sdp_kernel(enable_math=False, enable_flash=True,
+                      enable_mem_efficient=False):
+        with pytest.raises(RuntimeError, match="no enabled backend"):
+            F.scaled_dot_product_attention(q, k, v, attn_mask=mask)
