@@ -121,6 +121,7 @@ try:
     from .hapi import callbacks  # noqa: F401,E402
 except ImportError:
     pass
+from . import regularizer  # noqa: F401,E402
 from .static.program import enable_static, disable_static, in_dynamic_mode  # noqa: F401,E402
 
 # Framework defaults / dtype info / compat surface (reference top-level names)

@@ -48,9 +48,16 @@ class Optimizer:
             base_lr = float(learning_rate)
         self._lr_t = Tensor(jnp.asarray(base_lr, jnp.float32))
 
+        from paddle_tpu.regularizer import WeightDecayRegularizer
+
+        self._regularizer = None  # optimizer-level L1Decay/L2Decay
         if isinstance(weight_decay, (int, float)):
             self._weight_decay = float(weight_decay)
             self._wd_is_l2 = True  # plain L2 into grads (reference L2Decay)
+        elif isinstance(weight_decay, WeightDecayRegularizer):
+            self._regularizer = weight_decay
+            self._weight_decay = float(weight_decay.coeff)
+            self._wd_is_l2 = True
         else:
             self._weight_decay = 0.0
             self._wd_is_l2 = True
@@ -114,7 +121,9 @@ class Optimizer:
                     # only the looked-up rows are touched; master-weight and
                     # L2 interplay stay dense-path-only by design — surface
                     # that divergence once instead of silently skipping it
-                    if (self._weight_decay or p._value.dtype in (jnp.bfloat16, jnp.float16)) \
+                    if (self._weight_decay
+                            or getattr(p, "regularizer", None) is not None
+                            or p._value.dtype in (jnp.bfloat16, jnp.float16)) \
                             and not getattr(self, "_warned_sparse_path", False):
                         import warnings
 
@@ -130,7 +139,6 @@ class Optimizer:
                     p._bind(new_val.astype(p._value.dtype))
                     continue
                 gv = g._value.astype(jnp.float32) if g._value.dtype == jnp.float16 else g._value
-                use_l2 = self._weight_decay and self._wd_is_l2 and not self._decoupled_wd()
                 if p._value.dtype in (jnp.bfloat16, jnp.float16):
                     # Persistent fp32 master weights (reference multi_precision,
                     # python/paddle/optimizer/adamw.py + fleet/utils/
@@ -141,9 +149,10 @@ class Optimizer:
                     # signatures for API parity only).
                     low_dtype = p._value.dtype
                     mw = self._acc("master_weight", p, init=lambda p=p: p._value.astype(jnp.float32))
-                    if use_l2:
+                    reg = self._reg_grad_term(p, mw._value)
+                    if reg is not None:
                         # decay term from the fp32 master, not the quantized copy
-                        gv = gv.astype(jnp.float32) + self._weight_decay * mw._value
+                        gv = gv.astype(jnp.float32) + reg
                     orig_val = p._value
                     try:
                         p._bind(mw._value)  # _single_update reads the master
@@ -154,14 +163,33 @@ class Optimizer:
                     mw._bind(new32)
                     p._bind(new32.astype(low_dtype))
                 else:
-                    if use_l2:
-                        gv = gv + self._weight_decay * p._value.astype(gv.dtype)
+                    reg = self._reg_grad_term(p, p._value.astype(gv.dtype))
+                    if reg is not None:
+                        gv = gv + reg
                     new_val = self._single_update(p, gv, lr)
                     p._bind(new_val.astype(p._value.dtype) if new_val.dtype != p._value.dtype else new_val)
         self._step_count += 1
 
     def _decoupled_wd(self) -> bool:
         return False
+
+    def _reg_grad_term(self, p, value):
+        """Penalty gradient for `p`, or None.  A per-parameter regularizer
+        (the ParamAttr path: `param.regularizer = L1Decay(...)`) takes
+        priority over the optimizer-level weight_decay, matching the
+        reference's append_regularization_ops resolution order; the
+        optimizer-level term is skipped for decoupled-decay optimizers
+        (AdamW applies its own decay outside the gradient)."""
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            return reg._grad_term(value)
+        if self._decoupled_wd():
+            return None
+        if self._regularizer is not None:
+            return self._regularizer._grad_term(value)
+        if self._weight_decay and self._wd_is_l2:
+            return self._weight_decay * value
+        return None
 
     def _sparse_update(self, p, sr, lr):
         """Row-sparse update for a coalesced SelectedRows grad.  Base class:

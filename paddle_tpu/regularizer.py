@@ -1,0 +1,55 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py).
+
+`L1Decay` / `L2Decay` instances are accepted wherever the reference takes
+them: as an optimizer's `weight_decay=` (applied to every trainable
+parameter without its own regularizer) and as a per-parameter override
+(`param.regularizer = L1Decay(...)`, the ParamAttr path) — the
+per-parameter setting takes priority, matching the reference's
+append_regularization_ops resolution order.
+
+TPU-native: the penalty gradient folds into the grad inside the one
+compiled optimizer update (L2: coeff * p; L1: coeff * sign(p)) — there is
+no separate graph op to append.
+"""
+
+from __future__ import annotations
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class; subclasses define the penalty gradient."""
+
+    coeff: float = 0.0
+
+    def _grad_term(self, value):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 penalty: loss += coeff * sum|w|; grad term coeff * sign(w)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def _grad_term(self, value):
+        import jax.numpy as jnp
+
+        return self.coeff * jnp.sign(value)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 penalty: loss += 0.5 * coeff * sum(w^2); grad term coeff * w.
+
+    (The reference folds the 1/2 into the coefficient exactly the same
+    way: the applied gradient is coeff * w.)
+    """
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def _grad_term(self, value):
+        return self.coeff * value
