@@ -122,6 +122,11 @@ try:
 except ImportError:
     pass
 from . import regularizer  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import pir  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
 from .static.program import enable_static, disable_static, in_dynamic_mode  # noqa: F401,E402
 
 # Framework defaults / dtype info / compat surface (reference top-level names)

@@ -1,0 +1,12 @@
+"""Legacy reader-style dataset loaders (reference: python/paddle/dataset/
+— mnist.train() etc. return sample-yielding reader callables).
+
+TPU-native: these adapt the class-based datasets (paddle_tpu.vision.datasets,
+paddle_tpu.text.datasets, which parse reference-layout local files) into
+the reader protocol.  `common.download` raises in hermetic environments
+instead of hanging — pass local paths to the loaders.
+"""
+
+from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "common"]

@@ -1,0 +1,37 @@
+"""Reference: python/paddle/dataset/cifar.py — readers yielding
+(flat float32[3072] image scaled to [0, 1], int label)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader(cls_name, mode, data_file):
+    def reader():
+        import paddle_tpu.vision.datasets as vd
+
+        ds = getattr(vd, cls_name)(data_file=data_file, mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            arr = np.asarray(img, np.float32).reshape(-1) / 255.0
+            yield arr, int(np.asarray(label).reshape(()))
+
+    return reader
+
+
+def train10(data_file=None):
+    return _reader("Cifar10", "train", data_file)
+
+
+def test10(data_file=None):
+    return _reader("Cifar10", "test", data_file)
+
+
+def train100(data_file=None):
+    return _reader("Cifar100", "train", data_file)
+
+
+def test100(data_file=None):
+    return _reader("Cifar100", "test", data_file)

@@ -1,0 +1,36 @@
+"""Reference: python/paddle/dataset/common.py (download/md5 helpers)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "download", "md5file"]
+
+DATA_HOME = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         "dataset")
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None) -> str:
+    """Return the cached path if the file is already present; otherwise
+    raise — this environment has no network egress.  Drop the file into
+    DATA_HOME/<module_name>/ yourself (reference layout)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname,
+                            save_name or url.split("/")[-1].split("?")[0])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise RuntimeError(f"{filename} exists but fails its md5 check")
+        return filename
+    raise RuntimeError(
+        f"dataset download needs network access (wanted {url}); this "
+        f"environment has none. Place the file at {filename} and retry")

@@ -1,0 +1,32 @@
+"""Reference: python/paddle/dataset/imdb.py — readers yielding
+(word-id list, 0/1 label) plus word_dict()."""
+
+from __future__ import annotations
+
+__all__ = ["train", "test", "word_dict"]
+
+
+def _reader(mode, data_file, cutoff):
+    def reader():
+        from paddle_tpu.text.datasets import Imdb
+
+        ds = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
+        for i in range(len(ds)):
+            doc, label = ds[i]
+            yield [int(w) for w in doc], int(label)
+
+    return reader
+
+
+def train(word_idx=None, data_file=None, cutoff=150):
+    return _reader("train", data_file, cutoff)
+
+
+def test(word_idx=None, data_file=None, cutoff=150):
+    return _reader("test", data_file, cutoff)
+
+
+def word_dict(data_file=None, cutoff=150):
+    from paddle_tpu.text.datasets import Imdb
+
+    return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx

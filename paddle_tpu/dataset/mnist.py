@@ -1,0 +1,30 @@
+"""Reference: python/paddle/dataset/mnist.py — readers over the IDX files
+yielding (normalized flat float32[784] image, int label)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode, image_path, label_path):
+    def reader():
+        from paddle_tpu.vision.datasets import MNIST
+
+        ds = MNIST(image_path=image_path, label_path=label_path, mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            arr = np.asarray(img, np.float32).reshape(-1)
+            # reference normalization: [0, 255] -> [-1, 1]
+            yield arr / 127.5 - 1.0, int(np.asarray(label).reshape(()))
+
+    return reader
+
+
+def train(image_path=None, label_path=None):
+    return _reader("train", image_path, label_path)
+
+
+def test(image_path=None, label_path=None):
+    return _reader("test", image_path, label_path)

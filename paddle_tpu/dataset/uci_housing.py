@@ -1,0 +1,28 @@
+"""Reference: python/paddle/dataset/uci_housing.py — readers yielding
+(feature float32[13], target float32[1])."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode, data_file):
+    def reader():
+        from paddle_tpu.text.datasets import UCIHousing
+
+        ds = UCIHousing(data_file=data_file, mode=mode)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, np.float32), np.asarray(y, np.float32)
+
+    return reader
+
+
+def train(data_file=None):
+    return _reader("train", data_file)
+
+
+def test(data_file=None):
+    return _reader("test", data_file)

@@ -1,0 +1,86 @@
+"""Model hub (reference: python/paddle/hub.py — torch.hub-style list /
+help / load over a repo's hubconf.py).
+
+Local sources (`source='local'`, a directory containing hubconf.py) are
+fully supported.  GitHub/gitee sources require network access; in
+hermetic environments the download step raises a clear error instead of
+hanging — pass a pre-downloaded checkout as a local source instead.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str) -> str:
+    source = source.lower()
+    if source == "local":
+        if not os.path.isdir(repo_dir):
+            raise FileNotFoundError(f"local hub repo {repo_dir!r} not found")
+        return repo_dir
+    if source in ("github", "gitee"):
+        # hermetic environment: no network egress. A pre-fetched checkout
+        # in the hub cache dir is honored; otherwise fail loudly.
+        cache = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                             "hub", repo_dir.replace("/", "_").replace(":", "_"))
+        if os.path.isdir(cache):
+            return cache
+        raise RuntimeError(
+            f"hub source {source!r} needs network access to fetch "
+            f"{repo_dir!r}; this environment has none. Clone the repo "
+            f"yourself and pass source='local' (or place it at {cache})")
+    raise ValueError(f"unknown hub source {source!r} "
+                     "(expected 'github', 'gitee' or 'local')")
+
+
+def _entrypoints(mod):
+    return {n: f for n, f in vars(mod).items()
+            if callable(f) and not n.startswith("_")}
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):  # noqa: A001 — reference name
+    """Names of the callable entrypoints in the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return sorted(_entrypoints(mod))
+
+
+def help(repo_dir: str, model: str, source: str = "github",  # noqa: A001
+         force_reload: bool = False):
+    """The docstring of one entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    eps = _entrypoints(mod)
+    if model not in eps:
+        raise RuntimeError(f"entrypoint {model!r} not found; available: "
+                           f"{sorted(eps)}")
+    return eps[model].__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Call the named entrypoint and return its result (usually a Layer)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    eps = _entrypoints(mod)
+    if model not in eps:
+        raise RuntimeError(f"entrypoint {model!r} not found; available: "
+                           f"{sorted(eps)}")
+    return eps[model](**kwargs)

@@ -48,19 +48,19 @@ class Optimizer:
             base_lr = float(learning_rate)
         self._lr_t = Tensor(jnp.asarray(base_lr, jnp.float32))
 
-        from paddle_tpu.regularizer import WeightDecayRegularizer
+        from paddle_tpu.regularizer import L2Decay, WeightDecayRegularizer
 
-        self._regularizer = None  # optimizer-level L1Decay/L2Decay
-        if isinstance(weight_decay, (int, float)):
-            self._weight_decay = float(weight_decay)
-            self._wd_is_l2 = True  # plain L2 into grads (reference L2Decay)
-        elif isinstance(weight_decay, WeightDecayRegularizer):
+        # one source of truth: the optimizer-level decay is ALWAYS a
+        # regularizer instance (a float is reference L2Decay semantics);
+        # _weight_decay mirrors the coeff for cheap truthiness checks
+        if isinstance(weight_decay, WeightDecayRegularizer):
             self._regularizer = weight_decay
-            self._weight_decay = float(weight_decay.coeff)
-            self._wd_is_l2 = True
+        elif isinstance(weight_decay, (int, float)) and float(weight_decay):
+            self._regularizer = L2Decay(float(weight_decay))
         else:
-            self._weight_decay = 0.0
-            self._wd_is_l2 = True
+            self._regularizer = None
+        self._weight_decay = float(self._regularizer.coeff) if self._regularizer else 0.0
+        self._wd_is_l2 = True  # legacy flag (L2-into-grads convention)
         self._grad_clip = grad_clip
         self._accumulators: dict = {}
         self._step_count = 0
@@ -181,15 +181,13 @@ class Optimizer:
         optimizer-level term is skipped for decoupled-decay optimizers
         (AdamW applies its own decay outside the gradient)."""
         reg = getattr(p, "regularizer", None)
-        if reg is not None:
-            return reg._grad_term(value)
-        if self._decoupled_wd():
-            return None
-        if self._regularizer is not None:
-            return self._regularizer._grad_term(value)
-        if self._weight_decay and self._wd_is_l2:
-            return self._weight_decay * value
-        return None
+        if reg is None:
+            if self._decoupled_wd():
+                return None  # AdamW applies its own decay to the weight
+            reg = self._regularizer
+        if reg is None or not reg.coeff:
+            return None  # zero-coeff = the "disable for this param" idiom
+        return reg._grad_term(value)
 
     def _sparse_update(self, p, sr, lr):
         """Row-sparse update for a coalesced SelectedRows grad.  Base class:

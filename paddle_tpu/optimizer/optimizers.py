@@ -110,20 +110,21 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name)
-        from paddle_tpu.regularizer import WeightDecayRegularizer
+        from paddle_tpu.regularizer import L2Decay
 
         if isinstance(weight_decay, (int, float)):
             self._wd_coeff = float(weight_decay)
-        elif isinstance(weight_decay, WeightDecayRegularizer):
-            # reference AdamW accepts L2Decay-style coefficients; the decay
-            # stays decoupled (applied to the weight, not the gradient)
+        elif isinstance(weight_decay, L2Decay):
+            # decoupled decay IS L2-style; the coeff carries over
             self._wd_coeff = float(weight_decay.coeff)
         elif weight_decay is None:
             self._wd_coeff = 0.01
         else:
+            # L1Decay etc. cannot be expressed as multiplicative decoupled
+            # decay — refusing beats silently applying the wrong penalty
             raise TypeError(
-                f"AdamW weight_decay must be a float or a "
-                f"WeightDecayRegularizer, got {type(weight_decay).__name__}")
+                f"AdamW weight_decay must be a float or L2Decay, got "
+                f"{type(weight_decay).__name__}")
         self._apply_decay_fn = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 

@@ -1,0 +1,40 @@
+"""paddle.pir surface (reference: python/paddle/pir/ over the C++ PIR
+dialect).
+
+TPU-native: there is ONE Program abstraction (static/program.py) playing
+the roles of both the legacy ProgramDesc and PIR (SURVEY §7's folding);
+this module exposes it under the pir names so reference code addressing
+`paddle.pir` resolves.  Translation helpers are identity: every captured
+program already IS the "new IR" here.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.static.program import (  # noqa: F401
+    Block,
+    Operator,
+    Program,
+    Variable as Value,  # pir.Value ~ the SSA value handle
+)
+from paddle_tpu.static.program import in_dynamic_mode  # noqa: F401
+
+__all__ = ["Program", "Block", "Operator", "Value", "core",
+           "translate_to_pir", "is_pir_mode"]
+
+
+class core:  # noqa: N801 — reference exposes pir.core
+    """Minimal pir.core namespace."""
+
+    @staticmethod
+    def _to_pir(program):
+        return program
+
+
+def translate_to_pir(program):
+    """Identity: the one Program IS the new IR (see module docstring)."""
+    return program
+
+
+def is_pir_mode() -> bool:
+    """Always true: there is no legacy IR to be in."""
+    return True

@@ -1,0 +1,223 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py).
+
+Pure-Python composable iterators over sample-yielding callables — the
+pre-DataLoader data tier.  TPU-native note: `paddle.io.DataLoader` is the
+performant path (thread prefetch + spawned workers over the native shm
+ring); this tier exists for reference-API compatibility and light glue.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache the first full pass in memory; later passes replay it."""
+    all_data = []
+    filled = [False]
+
+    def __impl__():
+        if not filled[0]:
+            for d in reader():
+                all_data.append(d)
+                yield d
+            filled[0] = True
+        else:
+            yield from all_data
+
+    return __impl__
+
+
+def map_readers(func, *readers):
+    """Yield func(*samples) over readers zipped in lockstep."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill `buf_size`, emit in random order."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: (a, b1, b2) from ((a,), (b1, b2)).
+    check_alignment=True (default) raises when lengths diverge."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {sorted(kwargs)}")
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        zipper = zip(*rs) if not check_alignment else itertools.zip_longest(
+            *rs, fillvalue=_SENTINEL)
+        for outputs in zipper:
+            if check_alignment and _SENTINEL in outputs:
+                raise ValueError("readers have different lengths")
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+_SENTINEL = object()
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples."""
+
+    def data_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def read_worker():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=read_worker, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _SENTINEL:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Only the first n samples."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with `process_num` worker THREADS
+    (reference uses threads too — the GIL is released in numpy/IO
+    mappers).  order=True preserves input order."""
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        done = [0]
+        lock = threading.Lock()
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_SENTINEL)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _SENTINEL:
+                    with lock:
+                        done[0] += 1
+                        if done[0] == process_num:
+                            out_q.put(_SENTINEL)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        if not order:
+            while True:
+                e = out_q.get()
+                if e is _SENTINEL:
+                    break
+                yield e[1]
+        else:
+            pending = {}
+            want = 0
+            while True:
+                e = out_q.get()
+                if e is _SENTINEL:
+                    break
+                pending[e[0]] = e[1]
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            while want in pending:  # drain tail
+                yield pending.pop(want)
+                want += 1
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers via worker threads (reference uses
+    processes; the sample producers here are Python callables whose
+    numpy/IO work releases the GIL — see io.DataLoader for the true
+    spawned-worker tier)."""
+
+    def data_reader():
+        q = _queue.Queue(queue_size)
+        remaining = [len(readers)]
+        lock = threading.Lock()
+
+        def work(r):
+            try:
+                for d in r():
+                    q.put(d)
+            finally:
+                with lock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        q.put(_SENTINEL)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        while True:
+            e = q.get()
+            if e is _SENTINEL:
+                break
+            yield e
+
+    return data_reader

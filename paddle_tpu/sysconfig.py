@@ -1,0 +1,20 @@
+"""Build configuration queries (reference: python/paddle/sysconfig.py)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory containing the framework's C headers (the custom-op
+    extension tier's include root — see utils/cpp_extension)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(root, "utils", "cpp_extension")  # paddle_tpu_ext.h
+
+
+def get_lib() -> str:
+    """Directory containing the framework's native libraries (the build
+    cache _native compiles libpaddle_tpu_native.so into)."""
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")

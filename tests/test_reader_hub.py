@@ -1,0 +1,112 @@
+"""paddle.reader decorators, paddle.hub, paddle.sysconfig, paddle.pir
+(reference: python/paddle/reader/decorator.py, hub.py, sysconfig.py,
+pir/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _r(n=6):
+    def reader():
+        yield from range(n)
+    return reader
+
+
+def test_reader_decorators_compose():
+    rd = paddle.reader
+    assert list(rd.firstn(_r(), 3)()) == [0, 1, 2]
+    assert list(rd.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+    assert list(rd.map_readers(lambda a, b: a + b, _r(3), _r(3))()) == [0, 2, 4]
+    assert sorted(rd.shuffle(_r(), 4)()) == list(range(6))
+    assert list(rd.buffered(_r(), 2)()) == list(range(6))
+    got = list(rd.compose(_r(3), _r(3))())
+    assert got == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(ValueError, match="different lengths"):
+        list(rd.compose(_r(2), _r(4))())
+    # cache: second pass replays without re-running the source
+    calls = []
+    def counting():
+        calls.append(1)
+        yield from range(3)
+    c = rd.cache(counting)
+    assert list(c()) == [0, 1, 2] and list(c()) == [0, 1, 2]
+    assert len(calls) == 1
+
+
+def test_xmap_readers_ordered_and_unordered():
+    rd = paddle.reader
+    out = list(rd.xmap_readers(lambda x: x * 10, _r(8), 3, 4, order=True)())
+    assert out == [x * 10 for x in range(8)]
+    out2 = sorted(rd.xmap_readers(lambda x: x * 10, _r(8), 3, 4)())
+    assert out2 == [x * 10 for x in range(8)]
+    merged = sorted(rd.multiprocess_reader([_r(3), _r(4)])())
+    assert merged == sorted([*range(3), *range(4)])
+
+
+def test_hub_local_source(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    '''A tiny test model.'''\n"
+        "    return {'scale': scale}\n"
+        "def _private():\n"
+        "    pass\n")
+    assert paddle.hub.list(str(tmp_path), source="local") == ["tiny_model"]
+    assert "tiny test model" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                                source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                           scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError, match="network access"):
+        paddle.hub.load("some/repo", "m", source="github")
+    with pytest.raises(RuntimeError, match="not found"):
+        paddle.hub.load(str(tmp_path), "missing", source="local")
+
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.isdir(inc) and any(
+        f.endswith(".h") for f in os.listdir(inc))
+    assert isinstance(paddle.sysconfig.get_lib(), str)
+
+
+def test_pir_names_resolve():
+    assert paddle.pir.is_pir_mode()
+    prog = paddle.static.Program()
+    assert paddle.pir.translate_to_pir(prog) is prog
+    assert paddle.pir.Program is paddle.static.Program
+
+
+def test_dataset_mnist_and_uci_readers(tmp_path):
+    """paddle.dataset legacy reader tier adapts the class datasets
+    (reference mnist.py normalization: [0,255] -> [-1,1] flat float32)."""
+    import gzip
+    import struct
+
+    n = 4
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (n, 28, 28)).astype(np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    ip = str(tmp_path / "imgs.idx3-ubyte.gz")
+    lp = str(tmp_path / "labels.idx1-ubyte.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+    samples = list(paddle.dataset.mnist.train(image_path=ip,
+                                              label_path=lp)())
+    assert len(samples) == n
+    x, y = samples[0]
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert -1.0 <= x.min() and x.max() <= 1.0 and y == int(labels[0])
+
+    raw = rng.normal(size=(50, 14))
+    hp = str(tmp_path / "housing.data")
+    np.savetxt(hp, raw)
+    rows = list(paddle.dataset.uci_housing.train(data_file=hp)())
+    assert len(rows) == 40 and rows[0][0].shape == (13,)
+
+    with pytest.raises(RuntimeError, match="network access"):
+        paddle.dataset.common.download("http://x/y.tgz", "mnist")

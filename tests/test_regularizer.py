@@ -36,17 +36,26 @@ def test_l1decay_applies_sign_penalty():
 
 
 def test_per_parameter_regularizer_overrides_optimizer_level():
+    # coefficients chosen so the override and fallback paths DIVERGE:
+    # a broken override (optimizer L2 0.5*2 = 1.0) would give 1.9, not 1.98
     p_own, p_plain = _param([2.0]), _param([2.0])
-    p_own.regularizer = L1Decay(1.0)
+    p_own.regularizer = L1Decay(0.2)
     opt = paddle.optimizer.SGD(0.1, parameters=[p_own, p_plain],
                                weight_decay=L2Decay(0.5))
     (p_own * 0.0 + p_plain * 0.0).sum().backward()
     opt.step()
-    # p_own: L1 term sign(2)*1.0 -> 2 - 0.1*1.0 = 1.9
-    np.testing.assert_allclose(np.asarray(p_own._value), [1.9], rtol=1e-6)
-    # p_plain: optimizer-level L2 0.5*2 -> 2 - 0.1*1.0 = 1.9 as well,
-    # but via the L2 path: verify with a different coeff sanity
+    # p_own: L1 term sign(2)*0.2 -> 2 - 0.1*0.2 = 1.98
+    np.testing.assert_allclose(np.asarray(p_own._value), [1.98], rtol=1e-6)
+    # p_plain: optimizer-level L2 0.5*2 = 1.0 -> 2 - 0.1*1.0 = 1.9
     np.testing.assert_allclose(np.asarray(p_plain._value), [1.9], rtol=1e-6)
+    # zero-coeff per-param regularizer = "disable decay for this param"
+    p_off = _param([2.0])
+    p_off.regularizer = L2Decay(0.0)
+    opt2 = paddle.optimizer.SGD(0.1, parameters=[p_off],
+                                weight_decay=L2Decay(0.5))
+    (p_off * 0.0).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(np.asarray(p_off._value), [2.0], rtol=1e-7)
 
 
 def test_adamw_decoupled_ignores_optimizer_level_regularizer_path():
@@ -80,3 +89,11 @@ def test_param_attr_regularizer_reaches_optimizer():
     opt.step()
     np.testing.assert_allclose(np.asarray(lin.weight._value),
                                w0 - 0.1 * 0.5 * np.sign(w0), rtol=1e-6)
+
+
+def test_adamw_rejects_l1decay():
+    import pytest
+
+    p = _param([1.0])
+    with pytest.raises(TypeError, match="L2Decay"):
+        paddle.optimizer.AdamW(0.1, parameters=[p], weight_decay=L1Decay(0.1))
