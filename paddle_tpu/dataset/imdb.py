@@ -3,27 +3,41 @@
 
 from __future__ import annotations
 
+import re
+
 __all__ = ["train", "test", "word_dict"]
 
 
-def _reader(mode, data_file, cutoff):
+def _reader(mode, word_idx, data_file, cutoff):
     def reader():
         from paddle_tpu.text.datasets import Imdb
 
-        ds = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
-        for i in range(len(ds)):
-            doc, label = ds[i]
-            yield [int(w) for w in doc], int(label)
+        if word_idx is None:
+            ds = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
+            for i in range(len(ds)):
+                doc, label = ds[i]
+                yield [int(w) for w in doc], int(label)
+            return
+        # reference semantics: docs are encoded with the CALLER's dict —
+        # ids must index an embedding sized to it, not a rebuilt vocab
+        if data_file is None:
+            raise ValueError("imdb reader needs data_file (the "
+                             "aclImdb_v1.tar.gz archive)")
+        unk = word_idx.get("<unk>", len(word_idx))
+        for pat, label in ((re.compile(rf"aclImdb/{mode}/pos/.*\.txt$"), 0),
+                           (re.compile(rf"aclImdb/{mode}/neg/.*\.txt$"), 1)):
+            for doc in Imdb._tokenize(data_file, pat):
+                yield [word_idx.get(w, unk) for w in doc], label
 
     return reader
 
 
 def train(word_idx=None, data_file=None, cutoff=150):
-    return _reader("train", data_file, cutoff)
+    return _reader("train", word_idx, data_file, cutoff)
 
 
 def test(word_idx=None, data_file=None, cutoff=150):
-    return _reader("test", data_file, cutoff)
+    return _reader("test", word_idx, data_file, cutoff)
 
 
 def word_dict(data_file=None, cutoff=150):

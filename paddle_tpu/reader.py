@@ -18,15 +18,19 @@ __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
 
 
 def cache(reader):
-    """Cache the first full pass in memory; later passes replay it."""
+    """Cache the first COMPLETE pass in memory; later passes replay it.
+    A pass abandoned early (e.g. via firstn) does not poison the cache —
+    the next pass re-reads the source from the start."""
     all_data = []
     filled = [False]
 
     def __impl__():
         if not filled[0]:
+            fresh = []
             for d in reader():
-                all_data.append(d)
+                fresh.append(d)
                 yield d
+            all_data[:] = fresh  # only a finished pass becomes the cache
             filled[0] = True
         else:
             yield from all_data
@@ -88,7 +92,7 @@ def compose(*readers, **kwargs):
         zipper = zip(*rs) if not check_alignment else itertools.zip_longest(
             *rs, fillvalue=_SENTINEL)
         for outputs in zipper:
-            if check_alignment and _SENTINEL in outputs:
+            if check_alignment and any(o is _SENTINEL for o in outputs):
                 raise ValueError("readers have different lengths")
             yield sum((make_tuple(o) for o in outputs), ())
 
@@ -96,6 +100,16 @@ def compose(*readers, **kwargs):
 
 
 _SENTINEL = object()
+
+
+class _Raise:
+    """Exception envelope crossing a worker-thread queue: the consumer
+    re-raises, so a failed source never masquerades as a short epoch."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 def buffered(reader, size):
@@ -108,7 +122,9 @@ def buffered(reader, size):
             try:
                 for d in reader():
                     q.put(d)
-            finally:
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                q.put(_Raise(exc))
+            else:
                 q.put(_SENTINEL)
 
         t = threading.Thread(target=read_worker, daemon=True)
@@ -117,6 +133,8 @@ def buffered(reader, size):
             e = q.get()
             if e is _SENTINEL:
                 break
+            if isinstance(e, _Raise):
+                raise e.exc  # a failed source must not look like a short epoch
             yield e
 
     return data_reader
@@ -146,10 +164,14 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         lock = threading.Lock()
 
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(_SENTINEL)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as exc:  # noqa: BLE001
+                out_q.put(_Raise(exc))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_SENTINEL)
 
         def work():
             while True:
@@ -161,25 +183,32 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                             out_q.put(_SENTINEL)
                     return
                 i, sample = item
-                out_q.put((i, mapper(sample)))
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as exc:  # noqa: BLE001 — a raising
+                    out_q.put(_Raise(exc))  # mapper must not deadlock the
+                    # consumer: keep draining so the sentinel still arrives
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=work, daemon=True).start()
 
-        if not order:
+        def _next_items():
             while True:
                 e = out_q.get()
                 if e is _SENTINEL:
-                    break
+                    return
+                if isinstance(e, _Raise):
+                    raise e.exc
+                yield e
+
+        if not order:
+            for e in _next_items():
                 yield e[1]
         else:
             pending = {}
             want = 0
-            while True:
-                e = out_q.get()
-                if e is _SENTINEL:
-                    break
+            for e in _next_items():
                 pending[e[0]] = e[1]
                 while want in pending:
                     yield pending.pop(want)
@@ -206,6 +235,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for d in r():
                     q.put(d)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                q.put(_Raise(exc))
             finally:
                 with lock:
                     remaining[0] -= 1
@@ -218,6 +249,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             e = q.get()
             if e is _SENTINEL:
                 break
+            if isinstance(e, _Raise):
+                raise e.exc
             yield e
 
     return data_reader

@@ -110,3 +110,50 @@ def test_dataset_mnist_and_uci_readers(tmp_path):
 
     with pytest.raises(RuntimeError, match="network access"):
         paddle.dataset.common.download("http://x/y.tgz", "mnist")
+
+
+def test_reader_error_propagation_and_cache_integrity():
+    rd = paddle.reader
+
+    def bad():
+        yield 1
+        raise IOError("disk died")
+
+    with pytest.raises(IOError, match="disk died"):
+        list(rd.buffered(bad, 2)())
+    with pytest.raises(IOError, match="disk died"):
+        list(rd.multiprocess_reader([bad])())
+    with pytest.raises(ZeroDivisionError):
+        list(rd.xmap_readers(lambda x: 1 // x, _r(4), 2, 2)())
+    with pytest.raises(IOError, match="disk died"):
+        list(rd.xmap_readers(lambda x: x, bad, 2, 2, order=True)())
+
+    # compose alignment check must survive numpy-array samples
+    def np_r():
+        for i in range(3):
+            yield np.ones(4) * i
+    got = list(rd.compose(np_r, np_r)())
+    assert len(got) == 3 and len(got[0]) == 2
+
+    # an abandoned first pass must not poison the cache
+    c = rd.cache(_r(6))
+    assert list(rd.firstn(c, 2)()) == [0, 1]
+    assert list(c()) == [0, 1, 2, 3, 4, 5]
+    assert list(c()) == [0, 1, 2, 3, 4, 5]
+
+
+def test_imdb_reader_honors_caller_word_idx(tmp_path):
+    import tarfile
+
+    root = tmp_path / "aclImdb" / "train"
+    (root / "pos").mkdir(parents=True)
+    (root / "neg").mkdir(parents=True)
+    (root / "pos" / "0.txt").write_text("good good film")
+    (root / "neg" / "0.txt").write_text("bad film")
+    arc = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(arc, "w:gz") as t:
+        t.add(tmp_path / "aclImdb", arcname="aclImdb")
+    word_idx = {"good": 7, "film": 3, "<unk>": 9}
+    rows = list(paddle.dataset.imdb.train(word_idx, data_file=str(arc))())
+    assert ([7, 7, 3], 0) in rows      # encoded with the CALLER's ids
+    assert ([9, 3], 1) in rows         # oov -> caller's <unk>
