@@ -162,6 +162,7 @@ _SUPPORTED_OPS = frozenset({
     "LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_AND_CLEAR", "STORE_FAST",
     "DELETE_FAST", "LOAD_CONST", "RETURN_CONST", "RETURN_VALUE",
     "LOAD_GLOBAL", "LOAD_DEREF", "LOAD_ATTR", "LOAD_METHOD", "KW_NAMES",
+    "IMPORT_NAME", "IMPORT_FROM",
     "CALL", "BINARY_OP", "UNARY_NEGATIVE", "UNARY_NOT", "UNARY_INVERT",
     "UNARY_POSITIVE", "COMPARE_OP", "IS_OP", "CONTAINS_OP",
     "FORMAT_VALUE", "BUILD_STRING",
@@ -620,6 +621,40 @@ class _Interpreter:
                 st.append(f.builtins[name])
             else:
                 raise Unsupported(f"unresolvable global {name}")
+            return idx + 1
+        if op == "IMPORT_NAME":
+            # inline `import x` / `from x import y`: a trace-time effect
+            # yielding a concrete module object (vision forwards do this —
+            # resnet.py's `from ...manipulation import flatten`)
+            fromlist = st.pop()
+            level = st.pop()
+            from paddle_tpu.static.program import suspend_capture
+
+            try:
+                with suspend_capture():
+                    # a FIRST import runs the module body: that must execute
+                    # eagerly, not record ops into the active capture (a
+                    # module-level paddle op would otherwise bake a spurious
+                    # program op and cache a symbolic Variable in the module)
+                    mod = __import__(inst.argval, f.globals, None,
+                                     fromlist or None, level or 0)
+            except ImportError as e:
+                raise Unsupported(f"import {inst.argval!r} failed: {e}") from e
+            st.append(mod)
+            return idx + 1
+        if op == "IMPORT_FROM":
+            mod = st[-1]  # module stays for further IMPORT_FROMs
+            try:
+                st.append(getattr(mod, inst.argval))
+            except AttributeError:
+                import importlib
+
+                try:  # CPython falls back to the submodule
+                    st.append(importlib.import_module(
+                        f"{mod.__name__}.{inst.argval}"))
+                except Exception as e:  # noqa: BLE001
+                    raise Unsupported(
+                        f"IMPORT_FROM {inst.argval!r}: {e}") from e
             return idx + 1
         if op == "LOAD_DEREF":
             if inst.argval in f.closure:

@@ -469,3 +469,62 @@ def test_real_gpt_and_bert_forward_capture_fraction():
         assert len(caps) == 1, name
         (capture,) = caps[0].values()
         assert len(capture.segments) == 1, f"{name} broke into segments"
+
+
+def test_real_resnet_forward_capture_fraction():
+    """The vision family exercises conv/BN/pool/Sequential paths AND an
+    inline `from ... import flatten` in the forward (the IMPORT_NAME /
+    IMPORT_FROM opcodes — previously an eager fallback)."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(5)
+    model = resnet18()
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.default_rng(5).standard_normal((1, 3, 32, 32))
+        .astype("float32"))
+    ref = model(x)
+    before_fb = sot_stats()["fallbacks"]
+    sot = symbolic_translate(model.forward)
+    out = sot(x)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref._value),
+                               rtol=1e-4, atol=1e-5)
+    assert sot_stats()["fallbacks"] == before_fb, "resnet forward fell back"
+    caps = list(sot._captures.values())
+    assert len(caps) == 1
+    (capture,) = caps[0].values()
+    assert len(capture.segments) == 1
+
+
+def test_first_time_import_in_trace_runs_module_body_eagerly(tmp_path):
+    """A module FIRST imported inside a traced forward executes its body
+    eagerly — module-level paddle ops must not be recorded into the
+    capture or leave symbolic Variables cached in the module."""
+    import sys
+    import textwrap
+
+    mod_name = "sot_import_victim"
+    (tmp_path / f"{mod_name}.py").write_text(textwrap.dedent("""
+        import paddle_tpu as paddle
+        SCALE = paddle.ones([1]) * 3.0
+    """))
+    sys.path.insert(0, str(tmp_path))
+    sys.modules.pop(mod_name, None)
+    try:
+        def fn(x):
+            import sot_import_victim
+            return x * sot_import_victim.SCALE
+
+        sot = symbolic_translate(fn)
+        out = sot(T([2.0]))
+        np.testing.assert_allclose(np.asarray(out._value), [6.0])
+        victim = sys.modules[mod_name]
+        # the module-level op ran for real: a concrete value, not a
+        # program Variable
+        from paddle_tpu.static.program import Variable
+
+        assert not isinstance(victim.SCALE, Variable)
+        assert float(victim.SCALE._value[0]) == 3.0
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop(mod_name, None)
