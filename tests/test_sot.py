@@ -528,3 +528,35 @@ def test_first_time_import_in_trace_runs_module_body_eagerly(tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         sys.modules.pop(mod_name, None)
+
+
+def test_detector_and_crnn_forward_capture_fraction():
+    """Every model family in the zoo holds the zero-fallback single-
+    segment criterion — detection (CSP/FPN/yolo_box decode) and OCR
+    (stride-collapsed conv + BiLSTM) close the set."""
+    from paddle_tpu.vision.models.detection import ppyolo_tiny
+    from paddle_tpu.vision.models.ocr import ppocr_rec_tiny
+
+    paddle.seed(6)
+    rng = np.random.default_rng(6)
+    cases = [
+        (ppyolo_tiny(num_classes=4),
+         paddle.to_tensor(rng.standard_normal((1, 3, 64, 64)).astype("float32"))),
+        (ppocr_rec_tiny(),
+         paddle.to_tensor(rng.standard_normal((1, 3, 32, 64)).astype("float32"))),
+    ]
+    for model, x in cases:
+        name = type(model).__name__
+        model.eval()
+        ref = model(x)
+        ref_t = ref[0] if isinstance(ref, (tuple, list)) else ref
+        before_fb = sot_stats()["fallbacks"]
+        sot = symbolic_translate(model.forward)
+        out = sot(x)
+        out_t = out[0] if isinstance(out, (tuple, list)) else out
+        np.testing.assert_allclose(
+            np.asarray(out_t._value), np.asarray(ref_t._value),
+            rtol=1e-4, atol=1e-5, err_msg=name)
+        assert sot_stats()["fallbacks"] == before_fb, f"{name} fell back"
+        (capture,) = list(sot._captures.values())[0].values()
+        assert len(capture.segments) == 1, f"{name} broke into segments"
