@@ -25,7 +25,9 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "amp_state", "whi
 # Ops that benefit from low precision (MXU ops) — reference white list.
 WHITE_LIST = {
     "matmul", "linear", "conv", "conv_transpose", "mm", "bmm", "einsum", "addmm",
-    "scaled_dot_product_attention",
+    "scaled_dot_product_attention", "flash_attention",
+    "fused_dot_product_attention", "flash_attn_unpadded",
+    "fused_gate_attention",
 }
 # Numerically sensitive ops stay fp32 — reference black list.
 BLACK_LIST = {

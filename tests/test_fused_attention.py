@@ -339,3 +339,15 @@ def test_sdp_kernel_all_xla_backends_disabled_raises_on_masked_call():
                       enable_mem_efficient=False):
         with pytest.raises(RuntimeError, match="no enabled backend"):
             F.scaled_dot_product_attention(q, k, v, attn_mask=mask)
+
+
+def test_fused_attention_ops_join_amp_white_list():
+    """auto_cast must route the fused attention tier to bf16 (MXU ops) —
+    an un-whitelisted name would silently stay fp32."""
+    rng = np.random.default_rng(14)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((1, 8, 2, 8))
+                                .astype("float32")) for _ in range(3))
+    with paddle.amp.auto_cast():
+        out = IF.fused_dot_product_attention(q, k, v, is_causal_masking=True,
+                                             is_training=False)
+    assert "bfloat16" in str(out.dtype)
