@@ -65,7 +65,7 @@ def main():
         # runs B=256-class AMP batches); pick the best-throughput config
         # that fits, largest first so an OOM falls through to smaller B
         images_per_sec, best_b = 0.0, B
-        for batch in (256, 128, 64):
+        for batch in (512, 256, 128, 64):
             try:
                 ips = measure(batch, iters)
             except Exception as e:
