@@ -1,0 +1,26 @@
+"""The driver's bench entry point (bench.py parent->probe->child) must
+stay runnable — a syntax/import/harness regression here forfeits the
+round's one driver-recorded measurement."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_parent_harness_cpu_smoke():
+    env = dict(os.environ, PADDLE_TPU_BENCH_CPU="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py")],
+        capture_output=True, text=True, timeout=840, env=env)
+    assert out.returncode == 0, out.stderr[-500:]
+    line = next(ln for ln in reversed(out.stdout.splitlines())
+                if ln.startswith("{"))
+    payload = json.loads(line)
+    assert payload["metric"] == "llama_pretrain_tokens_per_sec_per_chip"
+    assert payload["value"] > 0
+    assert payload["config"] == "cpu_smoke"
