@@ -3,6 +3,12 @@
 import os
 import sys
 
+if "--cpu" in sys.argv:  # hermetic smoke without the TPU tunnel
+    sys.argv.remove("--cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
