@@ -177,14 +177,10 @@ class LlamaAttention(nn.Layer):
             rep = self.num_heads // self.num_kv_heads
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
-        if kv_cache is not None and s > 1 and kv_cache[0].shape[1] > 0:
-            # jax's causal mask is top-left aligned: with L cached keys a
-            # multi-token chunk would mask the cache out — reject rather
-            # than silently compute wrong logits
-            raise NotImplementedError(
-                "chunked prefill (multi-token input on a non-empty cache) is "
-                "not supported; decode one token at a time"
-            )
+        # multi-token chunk on a non-empty cache (chunked prefill /
+        # speculative verify) is safe: both attention paths are
+        # bottom-right aligned for Sq != Sk, so chunk token i attends to
+        # the cache plus chunk positions <= i
         if sep_ax is not None:
             # context parallelism (context_parallel_llama): the sequence is
             # sharded over the 'sep' axis — ring/Ulysses attention exchange
@@ -327,6 +323,18 @@ def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens):
     return residual + h2, kc, vc
 
 
+def _empty_caches(config: "LlamaConfig", batch):
+    """Per-layer empty naive KV caches (one constructor for generate /
+    beam search / speculative decode)."""
+    nkv = config.num_key_value_heads
+    head_dim = config.hidden_size // config.num_attention_heads
+    return [
+        (paddle.zeros([batch, 0, nkv, head_dim], dtype=config.dtype),
+         paddle.zeros([batch, 0, nkv, head_dim], dtype=config.dtype))
+        for _ in range(config.num_hidden_layers)
+    ]
+
+
 def _model_forward_cached(model: "LlamaModel", input_ids, caches, position_offset=0):
     """Thread per-layer naive KV caches (prefill or decode)."""
     h = model.embed_tokens(input_ids)
@@ -370,6 +378,107 @@ class LlamaForCausalLM(nn.Layer):
         return paddle.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
 
     @paddle.no_grad()
+    def _speculative_decode(self, input_ids, max_new_tokens, draft_model, K):
+        """Draft-and-verify greedy decoding (speculative decoding,
+        Leviathan et al.; the serving tier beyond the reference repo).
+
+        The draft proposes K tokens autoregressively; the target verifies
+        all of them in ONE chunked forward over its cache (K+1 query
+        tokens against cache+K keys — the bottom-right-aligned
+        cross-length attention path).  Greedy acceptance: the longest
+        prefix where the target's argmax agrees, then the target's own
+        token at the first disagreement — so the output is EXACTLY the
+        target's plain greedy decode, in ~1/(mean_accepted+1) target
+        forwards.  Caches are naive (concat) so rejected tail entries
+        trim with a slice.
+        """
+        import jax.numpy as jnp  # noqa: F811 — module alias shadow-safe
+
+        cfg = self.config
+        if draft_model.config.vocab_size != cfg.vocab_size:
+            raise ValueError("draft and target must share a vocabulary")
+        b, s0 = int(input_ids.shape[0]), int(input_ids.shape[1])
+        self._spec_stats = {"target_forwards": 0, "draft_forwards": 0,
+                            "accepted": 0, "proposed": 0}
+
+        def _trim(caches, n):
+            return [(Tensor(k._value[:, :n]), Tensor(v._value[:, :n]))
+                    for k, v in caches]
+
+        import numpy as np
+
+        prompt = [int(t) for t in np.asarray(input_ids._value)[0]]
+
+        # target prefill: cache covers the prompt; first token from the
+        # last logit
+        h, t_caches = _model_forward_cached(
+            self.model, input_ids, _empty_caches(self.config, b), 0)
+        self._spec_stats["target_forwards"] += 1
+        first = int(jnp.argmax(
+            self._logits(h[:, -1:, :])._value[0, -1, :]))
+        out = [first]
+        # draft prefill over the same prompt
+        _, d_caches = _model_forward_cached(
+            draft_model.model, input_ids,
+            _empty_caches(draft_model.config, b), 0)
+        self._spec_stats["draft_forwards"] += 1
+        d_len = s0  # draft cache length (cache position p holds full[p])
+
+        while len(out) < max_new_tokens:
+            full = prompt + out
+            base = len(full) - 1  # both caches must cover full[:base]
+            # draft catch-up: one chunk over whatever the last round's
+            # acceptance left unconsumed (incl. the bonus token)
+            if d_len < base:
+                _, d_caches = _model_forward_cached(
+                    draft_model.model,
+                    paddle.to_tensor([full[d_len:base]], dtype="int32"),
+                    d_caches, d_len)
+                self._spec_stats["draft_forwards"] += 1
+                d_len = base
+            k_prop = min(K, max_new_tokens - len(out))
+            # ---- draft proposes k_prop tokens after `out[-1]` ----------
+            proposals = []
+            d_tok = out[-1]
+            for j in range(k_prop):
+                dh, d_caches = _model_forward_cached(
+                    draft_model.model,
+                    paddle.to_tensor([[d_tok]], dtype="int32"),
+                    d_caches, d_len)
+                self._spec_stats["draft_forwards"] += 1
+                d_len += 1
+                d_tok = int(jnp.argmax(
+                    draft_model._logits(dh)._value[0, -1, :]))
+                proposals.append(d_tok)
+            # ---- target verifies the whole chunk in ONE forward --------
+            chunk = [out[-1]] + proposals
+            h, t_caches = _model_forward_cached(
+                self.model,
+                paddle.to_tensor([chunk], dtype="int32"),
+                t_caches, base)
+            self._spec_stats["target_forwards"] += 1
+            preds = jnp.argmax(self._logits(h)._value[0], axis=-1)
+            # preds[i] = target's next token after chunk[i]
+            accepted = 0
+            while accepted < k_prop and int(preds[accepted]) == proposals[accepted]:
+                accepted += 1
+            self._spec_stats["proposed"] += k_prop
+            self._spec_stats["accepted"] += accepted
+            # accepted proposals, then the target's own token at the first
+            # disagreement (or the bonus token when everything matched)
+            new = proposals[:accepted] + [int(preds[accepted])]
+            out.extend(new[: max_new_tokens - len(out)])
+            # trusted cache = prompt + out[:-1]: chunk[0..accepted-1] were
+            # appended beyond `base`; the rejected tail trims away
+            keep = base + accepted + 1
+            t_caches = _trim(t_caches, keep)
+            d_caches = _trim(d_caches, min(d_len, keep))
+            d_len = min(d_len, keep)
+
+        return paddle.to_tensor(
+            np.asarray(out, np.int32)[None][:, :max_new_tokens])
+
+    @paddle.no_grad()
     def _beam_search(self, input_ids, max_new_tokens, num_beams, length_penalty=0.0):
         """Beam search over the naive cache path (the reference generate()'s
         decode_strategy="beam_search", python/paddle generation lineage).
@@ -388,11 +497,7 @@ class LlamaForCausalLM(nn.Layer):
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         V = cfg.vocab_size
 
-        empty = [
-            (paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype),
-             paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype))
-            for _ in range(n_layers)
-        ]
+        empty = _empty_caches(cfg, b)
         h, caches = _model_forward_cached(self.model, input_ids, empty, 0)
         logp = jax.nn.log_softmax(
             self._logits(h[:, -1:, :])._value[:, -1, :].astype(jnp.float32), -1)
@@ -447,7 +552,8 @@ class LlamaForCausalLM(nn.Layer):
                  block_size: int = 16, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  seed=None, decode_strategy=None, num_beams: int = 1,
-                 length_penalty: float = 0.0):
+                 length_penalty: float = 0.0, draft_model=None,
+                 num_speculative_tokens: int = 4):
         """Incremental decode (serving path): greedy by default; sampling
         with temperature / top-k / top-p via do_sample=True (the reference
         generate()'s decode_strategy="sampling" surface,
@@ -476,11 +582,27 @@ class LlamaForCausalLM(nn.Layer):
                     "num_beams > 1 is deterministic beam search; drop "
                     "do_sample/decode_strategy='sampling' (beam-sampling "
                     "is not implemented)")
+            if draft_model is not None:
+                raise ValueError(
+                    "draft_model (speculative decoding) is greedy-only; "
+                    "drop num_beams")
             # beam frontier runs on the naive cache path (growing shapes);
             # cache=/block_size= do not apply here
             return self._beam_search(input_ids, max_new_tokens,
                                      num_beams=num_beams,
                                      length_penalty=length_penalty)
+        if draft_model is not None:
+            if do_sample:
+                raise ValueError(
+                    "speculative decoding is greedy-only here (sampling "
+                    "needs rejection-sampling acceptance; drop do_sample)")
+            if int(input_ids.shape[0]) != 1:
+                raise ValueError(
+                    "speculative decoding supports batch size 1 at the "
+                    "model-level API (per-row acceptance lengths diverge)")
+            return self._speculative_decode(
+                input_ids, max_new_tokens, draft_model,
+                int(num_speculative_tokens))
         # decode_strategy='beam_search' with num_beams=1 IS greedy search
         if do_sample and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
@@ -520,13 +642,7 @@ class LlamaForCausalLM(nn.Layer):
         head_dim = cfg.hidden_size // cfg.num_attention_heads
 
         # prefill with naive caches (causal), collect per-layer K/V
-        empty = [
-            (
-                paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype),
-                paddle.zeros([b, 0, nkv, head_dim], dtype=cfg.dtype),
-            )
-            for _ in range(n_layers)
-        ]
+        empty = _empty_caches(cfg, b)
         h, caches = _model_forward_cached(self.model, input_ids, empty, 0)
         next_tok = Tensor(
             _select(self._logits(h[:, -1:, :])._value[:, -1, :], 0)

@@ -53,13 +53,23 @@ def scaled_dot_product_attention(
         if mask is not None and mask.dtype != jnp.bool_:
             bias = mask
             mask = None
+        causal = bool(is_causal)
+        if causal and q.shape[1] != k.shape[1]:
+            # jax.nn.dot_product_attention's is_causal is TOP-LEFT aligned;
+            # cross lengths (chunked prefill / speculative verify: query
+            # chunk against a longer cache) need the bottom-right
+            # convention — build it explicitly (matches the flash kernel)
+            tri = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool),
+                           k=k.shape[1] - q.shape[1])[None, None]
+            mask = tri if mask is None else jnp.logical_and(mask, tri)
+            causal = False
         out = jax.nn.dot_product_attention(
             q,
             k,
             v,
             bias=bias,
             mask=mask,
-            is_causal=bool(is_causal),
+            is_causal=causal,
         )
         return out
 

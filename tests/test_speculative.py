@@ -1,0 +1,83 @@
+"""Speculative decoding (draft-and-verify greedy; serving tier) and the
+chunked multi-token-on-cache attention path it rides on."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, _model_forward_cached, llama_tiny
+
+
+def _model(seed):
+    paddle.seed(seed)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32"))
+    m.eval()
+    return m
+
+
+def _prompt():
+    return paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 1024, (1, 9)).astype(np.int32))
+
+
+def test_chunked_prefill_matches_full_prefill():
+    """Feeding the prompt in two chunks over a growing cache must produce
+    the same final hidden state as one full prefill (the bottom-right
+    cross-length attention path; previously raised NotImplementedError)."""
+    m = _model(0)
+    ids = _prompt()
+    empty = [
+        (paddle.zeros([1, 0, m.config.num_key_value_heads,
+                       m.config.hidden_size // m.config.num_attention_heads]),
+         paddle.zeros([1, 0, m.config.num_key_value_heads,
+                       m.config.hidden_size // m.config.num_attention_heads]))
+        for _ in range(m.config.num_hidden_layers)
+    ]
+    h_full, _ = _model_forward_cached(m.model, ids, empty, 0)
+
+    a = paddle.to_tensor(np.asarray(ids._value)[:, :4])
+    b = paddle.to_tensor(np.asarray(ids._value)[:, 4:])
+    _, caches = _model_forward_cached(m.model, a, empty, 0)
+    h_b, _ = _model_forward_cached(m.model, b, caches, 4)
+    np.testing.assert_allclose(
+        np.asarray(h_b._value)[:, -1], np.asarray(h_full._value)[:, -1],
+        rtol=2e-5, atol=2e-6)
+
+
+def test_self_speculation_is_exact_and_saves_target_forwards():
+    """Draft == target: every proposal accepted, output EXACTLY the plain
+    greedy decode, target forwards ~ N/(K+1)."""
+    m = _model(1)
+    ids = _prompt()
+    ref = np.asarray(m.generate(ids, max_new_tokens=12, cache="naive")._value)
+    out = np.asarray(m.generate(ids, max_new_tokens=12, draft_model=m,
+                                num_speculative_tokens=3)._value)
+    np.testing.assert_array_equal(out, ref)
+    st = m._spec_stats
+    assert st["accepted"] == st["proposed"], st  # self-draft never rejected
+    # 12 tokens at K=3: prefill + ceil(11/4) = 4 verify forwards
+    assert st["target_forwards"] == 1 + -(-11 // 4), st
+
+
+def test_cross_model_speculation_matches_plain_greedy():
+    """An UNRELATED draft still yields exactly the target's greedy output
+    — acceptance only changes the step count, never the tokens."""
+    target, draft = _model(2), _model(3)
+    ids = _prompt()
+    ref = np.asarray(target.generate(ids, max_new_tokens=10,
+                                     cache="naive")._value)
+    out = np.asarray(target.generate(ids, max_new_tokens=10,
+                                     draft_model=draft,
+                                     num_speculative_tokens=4)._value)
+    np.testing.assert_array_equal(out, ref)
+    st = target._spec_stats
+    assert st["proposed"] >= st["accepted"] >= 0
+
+
+def test_speculative_rejects_sampling_and_batch():
+    m = _model(4)
+    with pytest.raises(ValueError, match="greedy-only"):
+        m.generate(_prompt(), draft_model=m, do_sample=True)
+    two = paddle.to_tensor(np.zeros((2, 4), np.int32))
+    with pytest.raises(ValueError, match="batch size 1"):
+        m.generate(two, draft_model=m)
