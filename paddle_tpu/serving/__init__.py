@@ -53,7 +53,8 @@ class GenerationEngine:
     """
 
     def __init__(self, model, max_batch=4, block_size=16, num_blocks=128,
-                 eos_token_id=None, mesh=None, mp_axis="mp"):
+                 eos_token_id=None, mesh=None, mp_axis="mp",
+                 prefill_chunk=None):
         """mesh: optional ProcessMesh/jax Mesh with an `mp_axis` dimension —
         the engine then serves TENSOR-PARALLEL: weights get Megatron
         placements (models.llama.shard_llama), the paged-KV pool is sharded
@@ -62,6 +63,9 @@ class GenerationEngine:
         analysis_predictor multi-device serving)."""
         cfg = model.config
         self.model = model
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError("prefill_chunk must be a positive token count")
+        self.prefill_chunk = None if prefill_chunk is None else int(prefill_chunk)
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
         self.eos_token_id = eos_token_id
@@ -178,7 +182,19 @@ class GenerationEngine:
             for _ in range(self._n_layers)
         ]
         with paddle.no_grad():
-            h, caches = _model_forward_cached(model.model, paddle.to_tensor(prompt), empty, 0)
+            if self.prefill_chunk is None or s0 <= self.prefill_chunk:
+                h, caches = _model_forward_cached(
+                    model.model, paddle.to_tensor(prompt), empty, 0)
+            else:
+                # chunked prefill: fixed-size chunks through the cached
+                # forward (bottom-right-aligned cross-length attention)
+                # cap the peak activation footprint for long prompts
+                caches, off = empty, 0
+                while off < s0:
+                    chunk = prompt[:, off:off + self.prefill_chunk]
+                    h, caches = _model_forward_cached(
+                        model.model, paddle.to_tensor(chunk), caches, off)
+                    off += chunk.shape[1]
             logits_last = model._logits(h[:, -1:, :])._value[0, -1, :]
             first = int(np.asarray(jnp.argmax(logits_last)))
 

@@ -268,3 +268,21 @@ def test_per_request_sampling_in_shared_program():
     while eng.has_work():
         eng.step()
     assert eng.result("a") != eng.result("b")
+
+
+def test_chunked_prefill_engine_matches_unchunked():
+    """prefill_chunk processes long prompts in fixed-size chunks through
+    the shared cached forward; decode output is identical to whole-prompt
+    prefill (the bottom-right cross-length attention path)."""
+    prompt = list(np.random.default_rng(11).integers(0, 128, 23))
+    ref_eng = GenerationEngine(_model(), max_batch=2, block_size=8,
+                               num_blocks=32)
+    ref_eng.add_request("r", prompt, max_new_tokens=7)
+    while ref_eng.has_work():
+        ref_eng.step()
+    chunked = GenerationEngine(_model(), max_batch=2, block_size=8,
+                               num_blocks=32, prefill_chunk=5)
+    chunked.add_request("r", prompt, max_new_tokens=7)
+    while chunked.has_work():
+        chunked.step()
+    assert chunked.result("r") == ref_eng.result("r")
