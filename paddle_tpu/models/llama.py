@@ -323,6 +323,37 @@ def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens):
     return residual + h2, kc, vc
 
 
+def _decode_layer_paged_chunk(layer, h, cos, sin, kc, vc, tables, lens):
+    """One decoder layer on a T-token chunk against the paged KV pools
+    (speculative verify / chunked paged decode).
+
+    h: Tensor [B, T, D]; lens: [B] lengths INCLUDING all T chunk tokens.
+    Chunk token j sits at global position lens - T + j.  Returns
+    (Tensor h', kc', vc')."""
+    from paddle_tpu.ops import paged_attention as pa
+
+    attn = layer.self_attn
+    residual = h
+    x = layer.input_layernorm(h)
+    b, t = int(x.shape[0]), int(x.shape[1])
+    n, nkv, hd = attn.num_heads, attn.num_kv_heads, attn.head_dim
+    qv = attn.q_proj(x)._value.reshape(b, t, n, hd)
+    kv_ = attn.k_proj(x)._value.reshape(b, t, nkv, hd)
+    vv = attn.v_proj(x)._value.reshape(b, t, nkv, hd)
+    pos = lens[:, None] - t + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
+    qv = pa.rope_rotate_chunk(qv, cos, sin, pos)
+    kv_ = pa.rope_rotate_chunk(kv_, cos, sin, pos)
+    kc = pa.paged_write_chunk(kc, kv_, tables, pos)
+    vc = pa.paged_write_chunk(vc, vv, tables, pos)
+    o = pa.paged_chunk_attention(qv, kc, vc, tables, lens)
+    out = attn.o_proj(Tensor(o.reshape(b, t, n * hd)))
+    h = residual + out
+    residual = h
+    h2 = layer.post_attention_layernorm(h)
+    h2 = layer.mlp(h2)
+    return residual + h2, kc, vc
+
+
 def _empty_caches(config: "LlamaConfig", batch):
     """Per-layer empty naive KV caches (one constructor for generate /
     beam search / speculative decode)."""

@@ -41,13 +41,9 @@ def rope_rotate_by_position(t, cos, sin, positions):
     same pair convention in models/llama.py apply_rotary_pos_emb) — change
     rope semantics here and there together.
     """
-    b, n, h = t.shape
-    c = jnp.take(jnp.asarray(cos), positions, axis=0)[:, None, :]  # [B,1,H/2]
-    s = jnp.take(jnp.asarray(sin), positions, axis=0)[:, None, :]
-    t2 = t.astype(jnp.float32).reshape(b, n, h // 2, 2)
-    r1 = t2[..., 0] * c - t2[..., 1] * s
-    r2 = t2[..., 1] * c + t2[..., 0] * s
-    return jnp.stack([r1, r2], -1).reshape(b, n, h).astype(t.dtype)
+    # the T=1 case of rope_rotate_chunk — ONE implementation of the pair
+    # convention (change rope semantics there, not here)
+    return rope_rotate_chunk(t[:, None], cos, sin, positions[:, None])[:, 0]
 
 
 def alloc_paged_cache(num_blocks, num_kv_heads, block_size, head_dim, dtype=jnp.bfloat16):
@@ -63,13 +59,9 @@ def paged_write(cache, new, block_tables, positions):
     block_tables: [B, max_blocks] int32; positions: [B] int32 (token index
     within the sequence).  Returns the updated cache.
     """
-    bs = cache.shape[2]
-    block_idx = jnp.take_along_axis(
-        block_tables, (positions // bs)[:, None], axis=1
-    )[:, 0]  # [B] physical block per sequence
-    slot = positions % bs  # [B]
-    # scatter: cache[block_idx[b], :, slot[b], :] = new[b]
-    return cache.at[block_idx, :, slot, :].set(new)
+    # the T=1 case of paged_write_chunk — one scatter implementation
+    return paged_write_chunk(cache, new[:, None], block_tables,
+                             positions[:, None])
 
 
 def paged_gather(cache, block_tables):
@@ -91,7 +83,47 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens, *,
     [B, max_blocks]; seq_lens: [B] VALID length (including the new token).
     GQA: N may be a multiple of Nkv.  Returns [B, N, H].
     """
-    b, n, h = q.shape
+    # the T=1 case of paged_chunk_attention — one masked-softmax
+    # implementation for the decode tier
+    return paged_chunk_attention(q[:, None], key_cache, value_cache,
+                                 block_tables, seq_lens, scale=scale)[:, 0]
+
+
+def rope_rotate_chunk(t, cos, sin, positions):
+    """Chunk variant of rope_rotate_by_position: t [B, T, N, H],
+    positions [B, T] int32."""
+    b, tt, n, h = t.shape
+    c = jnp.take(jnp.asarray(cos), positions, axis=0)[:, :, None, :]  # [B,T,1,H/2]
+    s = jnp.take(jnp.asarray(sin), positions, axis=0)[:, :, None, :]
+    t2 = t.astype(jnp.float32).reshape(b, tt, n, h // 2, 2)
+    r1 = t2[..., 0] * c - t2[..., 1] * s
+    r2 = t2[..., 1] * c + t2[..., 0] * s
+    return jnp.stack([r1, r2], -1).reshape(b, tt, n, h).astype(t.dtype)
+
+
+def paged_write_chunk(cache, new, block_tables, positions):
+    """Write T tokens per sequence into their pages.
+
+    cache: [num_blocks, Nkv, bs, H]; new: [B, T, Nkv, H]; positions:
+    [B, T] int32 (token index within each sequence).  The [B, T] scatter
+    is one advanced-indexing update — speculative verify writes its whole
+    chunk in one shot."""
+    bs = cache.shape[2]
+    block_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # [B,T]
+    slot = positions % bs
+    # advanced indexing on dims 0 and 2 with [B, T] index arrays puts the
+    # broadcast [B, T] in front: value shape [B, T, Nkv, H] == new
+    return cache.at[block_idx, :, slot, :].set(new)
+
+
+def paged_chunk_attention(q, key_cache, value_cache, block_tables, seq_lens,
+                          *, scale=None):
+    """Multi-token decode attention over the paged cache (speculative
+    verify / chunked decode): q [B, T, N, H]; seq_lens [B] INCLUDING all
+    T chunk tokens.  Chunk position j sits at global position
+    seq_lens - T + j and attends keys <= that position (bottom-right
+    causal within the chunk).  Returns [B, T, N, H]."""
+    b, t, n, h = q.shape
     nkv = key_cache.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(h)
@@ -102,10 +134,12 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens, *,
         keys = jnp.repeat(keys, group, axis=1)
         vals = jnp.repeat(vals, group, axis=1)
     logits = jnp.einsum(
-        "bnh,bnsh->bns", q.astype(jnp.float32), keys.astype(jnp.float32)
+        "btnh,bnsh->bnts", q.astype(jnp.float32), keys.astype(jnp.float32)
     ) * jnp.float32(scale)
-    span = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
-    logits = jnp.where(span < seq_lens[:, None, None], logits, jnp.float32(-1e30))
+    span = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)  # key pos
+    qpos = (seq_lens[:, None] - t + jnp.arange(t, dtype=jnp.int32)[None, :])
+    allowed = span <= qpos[:, None, :, None]
+    logits = jnp.where(allowed, logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bns,bnsh->bnh", probs, vals.astype(jnp.float32))
+    out = jnp.einsum("bnts,bnsh->btnh", probs, vals.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -39,6 +39,7 @@ class _Slot:
     # per-request decode config (temperature-sampling tier; 0 = greedy)
     temperature: float = 0.0
     key: object = None        # precomputed PRNG key (seed + request nonce)
+    d_seq_len: int = 0        # draft-pool coverage (speculative tier)
 
 
 class GenerationEngine:
@@ -54,7 +55,8 @@ class GenerationEngine:
 
     def __init__(self, model, max_batch=4, block_size=16, num_blocks=128,
                  eos_token_id=None, mesh=None, mp_axis="mp",
-                 prefill_chunk=None):
+                 prefill_chunk=None, draft_model=None,
+                 num_speculative_tokens=4):
         """mesh: optional ProcessMesh/jax Mesh with an `mp_axis` dimension —
         the engine then serves TENSOR-PARALLEL: weights get Megatron
         placements (models.llama.shard_llama), the paged-KV pool is sharded
@@ -124,6 +126,31 @@ class GenerationEngine:
         self._req_counter = 0
         self._state = list(model.state_dict().values())
 
+        # ---- speculative tier: draft model + its own paged pools --------
+        self.draft_model = draft_model
+        self.num_speculative = int(num_speculative_tokens)
+        self._draft_fn = self._verify_fn = None
+        if draft_model is not None:
+            if self.num_speculative < 1:
+                raise ValueError("num_speculative_tokens must be >= 1")
+            dc = draft_model.config
+            if dc.vocab_size != cfg.vocab_size:
+                raise ValueError("draft and target must share a vocabulary")
+            if mesh is not None:
+                raise ValueError(
+                    "speculative decoding is not combined with the "
+                    "tensor-parallel mesh engine yet")
+            self._d_layers = dc.num_hidden_layers
+            self._d_nkv = dc.num_key_value_heads
+            self._d_hd = dc.hidden_size // dc.num_attention_heads
+            ddt = jnp.bfloat16 if dc.dtype == "bfloat16" else jnp.float32
+            self._d_kpools = [
+                jnp.zeros((total, self._d_nkv, self.block_size, self._d_hd), ddt)
+                for _ in range(self._d_layers)
+            ]
+            self._d_vpools = [jnp.zeros_like(k) for k in self._d_kpools]
+            self._d_state = list(draft_model.state_dict().values())
+
     # ------------------------------------------------------------ requests
     def has_work(self):
         return any(s.active for s in self._slots)
@@ -159,13 +186,23 @@ class GenerationEngine:
         import paddle_tpu as paddle
         from paddle_tpu.models.llama import _model_forward_cached
 
+        if self.draft_model is not None and float(temperature or 0.0) > 0.0:
+            # checked BEFORE any allocation/prefill: a rejected request
+            # must not leak pool blocks or burn two prefills
+            raise ValueError(
+                "speculative decoding slots are greedy-only (sampled "
+                "acceptance needs rejection sampling); drop temperature")
         slot = next((s for s in self._slots if not s.active), None)
         if slot is None:
             raise RuntimeError("no free decode slot; call step() until one drains")
         prompt = np.asarray(prompt_ids, np.int32).reshape(1, -1)
         s0 = prompt.shape[1]
         max_len = s0 + int(max_new_tokens)
-        n_blocks = -(-max_len // self.block_size)
+        # speculative verify overshoots by up to K+1 positions past the
+        # budget before lens bookkeeping rolls back — those writes must
+        # land in pages the request OWNS, never in the table-padding block
+        headroom = 0 if self.draft_model is None else self.num_speculative + 1
+        n_blocks = -(-(max_len + headroom) // self.block_size)
         if n_blocks > self._max_blocks_per_seq:
             raise RuntimeError(
                 f"request needs {n_blocks} blocks > per-seq table width "
@@ -199,25 +236,24 @@ class GenerationEngine:
             first = int(np.asarray(jnp.argmax(logits_last)))
 
         # pour prefill K/V into this request's pages
-        bs = self.block_size
-        pad = n_blocks * bs - s0
-        for li, (k, v) in enumerate(caches):
-            kv = jnp.moveaxis(k._value, 1, 2)  # [1, Nkv, S, H]
-            vv = jnp.moveaxis(v._value, 1, 2)
-            if pad:
-                kv = jnp.pad(kv, ((0, 0), (0, 0), (0, pad), (0, 0)))
-                vv = jnp.pad(vv, ((0, 0), (0, 0), (0, pad), (0, 0)))
-            # [1, Nkv, n_blocks*bs, H] -> n_blocks x [Nkv, bs, H]
-            kv = kv.reshape(self._nkv, n_blocks, bs, self._head_dim).swapaxes(0, 1)
-            vv = vv.reshape(self._nkv, n_blocks, bs, self._head_dim).swapaxes(0, 1)
-            idx = jnp.asarray(blocks, jnp.int32)
-            self._kpools[li] = self._kpools[li].at[idx].set(kv.astype(self._kpools[li].dtype))
-            self._vpools[li] = self._vpools[li].at[idx].set(vv.astype(self._vpools[li].dtype))
-            if self._pool_sharding is not None:
-                # keep the pool committed to its head-sharded layout so the
-                # decode executable's input shardings stay stable
-                self._kpools[li] = jax.device_put(self._kpools[li], self._pool_sharding)
-                self._vpools[li] = jax.device_put(self._vpools[li], self._pool_sharding)
+        self._pour(self._kpools, self._vpools, caches, blocks, s0,
+                   self._nkv, self._head_dim, sharded=True)
+        if self.draft_model is not None:
+            # draft prefill over the same prompt into the draft pools
+            d_empty = [
+                (paddle.zeros([1, 0, self._d_nkv, self._d_hd],
+                              dtype=self.draft_model.config.dtype),
+                 paddle.zeros([1, 0, self._d_nkv, self._d_hd],
+                              dtype=self.draft_model.config.dtype))
+                for _ in range(self._d_layers)
+            ]
+            with paddle.no_grad():
+                _, d_caches = _model_forward_cached(
+                    self.draft_model.model, paddle.to_tensor(prompt),
+                    d_empty, 0)
+            self._pour(self._d_kpools, self._d_vpools, d_caches, blocks,
+                       s0, self._d_nkv, self._d_hd)
+            slot.d_seq_len = s0
 
         slot.rid = rid
         slot.active = True
@@ -245,6 +281,30 @@ class GenerationEngine:
         elif slot.seq_len + 1 >= slot.max_len:
             self._finish(slot)
         return first
+
+    def _pour(self, kpools, vpools, caches, blocks, s0, nkv, head_dim,
+              sharded=False):
+        """Scatter naive prefill caches into a request's pool pages."""
+        bs = self.block_size
+        n_blocks = len(blocks)
+        pad = n_blocks * bs - s0
+        for li, (k, v) in enumerate(caches):
+            kv = jnp.moveaxis(k._value, 1, 2)  # [1, Nkv, S, H]
+            vv = jnp.moveaxis(v._value, 1, 2)
+            if pad:
+                kv = jnp.pad(kv, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                vv = jnp.pad(vv, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            # [1, Nkv, n_blocks*bs, H] -> n_blocks x [Nkv, bs, H]
+            kv = kv.reshape(nkv, n_blocks, bs, head_dim).swapaxes(0, 1)
+            vv = vv.reshape(nkv, n_blocks, bs, head_dim).swapaxes(0, 1)
+            idx = jnp.asarray(blocks, jnp.int32)
+            kpools[li] = kpools[li].at[idx].set(kv.astype(kpools[li].dtype))
+            vpools[li] = vpools[li].at[idx].set(vv.astype(vpools[li].dtype))
+            if sharded and self._pool_sharding is not None:
+                # keep the pool committed to its head-sharded layout so the
+                # decode executable's input shardings stay stable
+                kpools[li] = jax.device_put(kpools[li], self._pool_sharding)
+                vpools[li] = jax.device_put(vpools[li], self._pool_sharding)
 
     def _finish(self, slot):
         self._results[slot.rid] = list(slot.generated)
@@ -295,10 +355,175 @@ class GenerationEngine:
 
         return jax.jit(step)
 
+    def _build_draft_step(self):
+        from paddle_tpu._core.autograd import no_grad
+        from paddle_tpu._core.tensor import Tensor
+        from paddle_tpu.models.llama import _decode_layer_paged
+
+        model = self.draft_model
+        state = self._d_state
+
+        def dstep(state_vals, kpools, vpools, tokens, tables, lens):
+            originals = [t._value for t in state]
+            try:
+                for t, v in zip(state, state_vals):
+                    t._bind(v)
+                with no_grad():
+                    h = model.model.embed_tokens(Tensor(tokens))
+                    cos = model.model.rope_cos._value
+                    sin = model.model.rope_sin._value
+                    new_k, new_v = [], []
+                    for li, layer in enumerate(model.model.layers):
+                        h, kc, vc = _decode_layer_paged(
+                            layer, h, cos, sin, kpools[li], vpools[li],
+                            tables, lens)
+                        new_k.append(kc)
+                        new_v.append(vc)
+                    h = model.model.norm(h)
+                    logits = model._logits(h)
+                return (jnp.argmax(logits._value[:, -1, :], axis=-1)
+                        .astype(jnp.int32), new_k, new_v)
+            finally:
+                for t, v in zip(state, originals):
+                    t._bind(v)
+
+        return jax.jit(dstep)
+
+    def _build_verify(self):
+        from paddle_tpu._core.autograd import no_grad
+        from paddle_tpu._core.tensor import Tensor
+        from paddle_tpu.models.llama import _decode_layer_paged_chunk
+
+        model = self.model
+        state = self._state
+
+        def verify(state_vals, kpools, vpools, tokens, tables, lens):
+            """tokens [B, K+1]; lens INCLUDING the whole chunk; returns
+            preds [B, K+1] (greedy next token after each chunk position)
+            plus the written pools."""
+            originals = [t._value for t in state]
+            try:
+                for t, v in zip(state, state_vals):
+                    t._bind(v)
+                with no_grad():
+                    h = model.model.embed_tokens(Tensor(tokens))
+                    cos = model.model.rope_cos._value
+                    sin = model.model.rope_sin._value
+                    new_k, new_v = [], []
+                    for li, layer in enumerate(model.model.layers):
+                        h, kc, vc = _decode_layer_paged_chunk(
+                            layer, h, cos, sin, kpools[li], vpools[li],
+                            tables, lens)
+                        new_k.append(kc)
+                        new_v.append(vc)
+                    h = model.model.norm(h)
+                    logits = model._logits(h)
+                return (jnp.argmax(logits._value, axis=-1).astype(jnp.int32),
+                        new_k, new_v)
+            finally:
+                for t, v in zip(state, originals):
+                    t._bind(v)
+
+        return jax.jit(verify)
+
+    def _spec_step(self):
+        """One speculative tick: the draft proposes K tokens per live slot
+        (K compiled single-token draft steps, batched over slots), the
+        target verifies every chunk in ONE compiled multi-token step, and
+        per-slot greedy acceptance emits 1..K+1 tokens.  Rejected tail
+        entries in the pools die by lens bookkeeping — pages are
+        positional, so rollback costs nothing."""
+        if self._draft_fn is None:
+            self._draft_fn = self._build_draft_step()
+            self._verify_fn = self._build_verify()
+        K = self.num_speculative
+        B, W = self.max_batch, self._max_blocks_per_seq
+        tables = np.zeros((B, W), np.int32)
+        last = np.zeros((B, 1), np.int32)
+        seq0 = np.zeros((B,), np.int32)
+        d0 = np.zeros((B,), np.int32)
+        for i, sl in enumerate(self._slots):
+            if sl.active:
+                row = list(sl.blocks) + [sl.blocks[-1]] * (W - len(sl.blocks))
+                tables[i] = row
+                last[i, 0] = sl.last_token
+                seq0[i] = sl.seq_len
+                d0[i] = sl.d_seq_len
+            else:
+                tables[i] = self._scratch[i]
+        tables_j = jnp.asarray(tables)
+
+        # ---- draft proposes K tokens (inactive lanes ride scratch) -----
+        # K+1 draft steps: the extra step feeds the LAST proposal so the
+        # draft pool always covers its own proposals — acceptance then
+        # never needs a per-slot catch-up pass, whatever gets accepted
+        d_state = [t._value for t in self._d_state]
+        prop_dev = []
+        tok = jnp.asarray(last)
+        for j in range(K + 1):
+            lens_d = jnp.asarray(d0 + 1 + j)
+            tok1, dk, dv = self._draft_fn(
+                d_state, list(self._d_kpools), list(self._d_vpools),
+                tok, tables_j, lens_d)
+            self._d_kpools, self._d_vpools = list(dk), list(dv)
+            if j < K:
+                prop_dev.append(tok1)
+                tok = tok1[:, None]  # stays on device: steps pipeline
+        proposals = np.stack([np.asarray(t) for t in prop_dev], axis=1)
+
+        # ---- target verifies the whole chunk in one step ---------------
+        chunk = np.concatenate([last, proposals], axis=1)  # [B, K+1]
+        lens_v = jnp.asarray(seq0 + K + 1)
+        preds, nk, nv = self._verify_fn(
+            [t._value for t in self._state],
+            list(self._kpools), list(self._vpools),
+            jnp.asarray(chunk), tables_j, lens_v)
+        self._kpools, self._vpools = list(nk), list(nv)
+        preds = np.asarray(preds)  # [B, K+1]
+
+        # ---- per-slot acceptance + emission ----------------------------
+        out = {}
+        for i, sl in enumerate(self._slots):
+            if not sl.active:
+                continue
+            accepted = 0
+            while accepted < K and preds[i, accepted] == proposals[i, accepted]:
+                accepted += 1
+            new_toks = [int(t) for t in proposals[i, :accepted]]
+            new_toks.append(int(preds[i, accepted]))
+            base_seq = sl.seq_len  # pre-round trusted pool coverage
+            emitted = []
+            finish = False
+            for t in new_toks:
+                emitted.append(t)
+                sl.generated.append(t)
+                if self.eos_token_id is not None and t == self.eos_token_id:
+                    finish = True
+                    break
+                # total = prompt + generated = base_seq + 1 + emitted
+                if base_seq + 1 + len(emitted) >= sl.max_len:
+                    finish = True
+                    break
+            # trusted pool coverage = prompt + generated[:-1]; the draft
+            # pool covers the same prefix (its stale tail dies positionally)
+            sl.seq_len = base_seq + len(emitted)
+            sl.d_seq_len = sl.seq_len
+            sl.last_token = emitted[-1]
+            out[sl.rid] = emitted
+            if finish:
+                self._finish(sl)
+        return out
+
     def step(self):
-        """One decode tick for every live request; returns {rid: token}."""
+        """One decode tick for every live request.
+
+        Plain engines return {rid: token}; SPECULATIVE engines emit a
+        LIST of tokens per request per tick ({rid: [tok, ...]}) — one
+        accepted run plus the target's correction/bonus token."""
         if not self.has_work():
             return {}
+        if self.draft_model is not None:
+            return self._spec_step()
         if self._step_fn is None:
             self._step_fn = self._build_step()
 

@@ -286,3 +286,82 @@ def test_chunked_prefill_engine_matches_unchunked():
     while chunked.has_work():
         chunked.step()
     assert chunked.result("r") == ref_eng.result("r")
+
+
+def test_speculative_engine_matches_plain_engine():
+    """Continuous-batching speculative decoding: per-slot greedy
+    acceptance over the shared paged pool produces EXACTLY the plain
+    engine's tokens — including a request that joins mid-flight."""
+    p1, p2 = [5, 9, 17, 33, 2], [7, 11, 3]
+    ref = GenerationEngine(_model(), max_batch=2, block_size=8, num_blocks=32)
+    ref.add_request("a", p1, max_new_tokens=9)
+    ref.step()
+    ref.add_request("b", p2, max_new_tokens=6)
+    while ref.has_work():
+        ref.step()
+
+    paddle.seed(77)
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    draft = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, dtype="float32"))
+    draft.eval()
+    eng = GenerationEngine(_model(), max_batch=2, block_size=8,
+                           num_blocks=32, draft_model=draft,
+                           num_speculative_tokens=3)
+    eng.add_request("a", p1, max_new_tokens=9)
+    eng.step()
+    eng.add_request("b", p2, max_new_tokens=6)
+    while eng.has_work():
+        eng.step()
+    assert eng.result("a") == ref.result("a")
+    assert eng.result("b") == ref.result("b")
+
+
+def test_speculative_engine_self_draft_accepts_everything():
+    """Draft == target: all proposals accepted, output identical, and the
+    whole request completes in ~N/(K+1) verify steps."""
+    prompt = [5, 9, 17, 33, 2]
+    ref = GenerationEngine(_model(), max_batch=2, block_size=8, num_blocks=32)
+    ref.add_request("r", prompt, max_new_tokens=12)
+    while ref.has_work():
+        ref.step()
+    target = _model()
+    eng = GenerationEngine(target, max_batch=2, block_size=8, num_blocks=32,
+                           draft_model=target, num_speculative_tokens=3)
+    eng.add_request("r", prompt, max_new_tokens=12)
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+    assert eng.result("r") == ref.result("r")
+    assert steps <= -(-11 // 4) + 1, steps  # 11 post-prefill tokens, K+1=4
+
+
+def test_speculative_engine_rejects_sampled_slots():
+    target = _model()
+    eng = GenerationEngine(target, max_batch=2, block_size=8, num_blocks=32,
+                           draft_model=target)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.add_request("r", [1, 2, 3], max_new_tokens=4, temperature=0.7)
+
+
+def test_speculative_engine_zero_slack_blocks_no_corruption():
+    """Verify overshoot near max_len must land in OWNED headroom pages,
+    never through the table-padding column into trusted K/V: prompt 5 +
+    max_new 11 = exactly 2 blocks of 8 with zero slack (the corruption
+    geometry), K=3."""
+    prompt = [5, 9, 17, 33, 2]
+    ref = GenerationEngine(_model(), max_batch=2, block_size=8, num_blocks=32)
+    ref.add_request("r", prompt, max_new_tokens=11)
+    while ref.has_work():
+        ref.step()
+    target = _model()
+    eng = GenerationEngine(target, max_batch=2, block_size=8, num_blocks=32,
+                           draft_model=target, num_speculative_tokens=3)
+    eng.add_request("r", prompt, max_new_tokens=11)
+    while eng.has_work():
+        eng.step()
+    assert eng.result("r") == ref.result("r")

@@ -81,3 +81,53 @@ def test_speculative_rejects_sampling_and_batch():
     two = paddle.to_tensor(np.zeros((2, 4), np.int32))
     with pytest.raises(ValueError, match="batch size 1"):
         m.generate(two, draft_model=m)
+
+
+def test_paged_chunk_layer_matches_single_token_steps():
+    """A T-token chunk through _decode_layer_paged_chunk must equal T
+    successive single-token _decode_layer_paged steps (same pools, same
+    tables) — the primitive under engine speculative verify."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import (
+        _decode_layer_paged,
+        _decode_layer_paged_chunk,
+    )
+    from paddle_tpu.ops import paged_attention as pa
+
+    m = _model(7)
+    layer = m.model.layers[0]
+    cos, sin = m.model.rope_cos._value, m.model.rope_sin._value
+    nkv = m.config.num_key_value_heads
+    hd = m.config.hidden_size // m.config.num_attention_heads
+    B, T, bs = 2, 3, 4
+    kc, vc = pa.alloc_paged_cache(8, nkv, bs, hd, jnp.float32)
+    kc2, vc2 = kc, vc
+    tables = jnp.asarray(np.arange(8, dtype=np.int32).reshape(B, 4))
+    rng = np.random.default_rng(7)
+    hs = paddle.to_tensor(rng.standard_normal(
+        (B, T, m.config.hidden_size)).astype("float32"))
+    # warm the pools with 2 pre-existing positions per sequence
+    pre = paddle.to_tensor(rng.standard_normal(
+        (B, 1, m.config.hidden_size)).astype("float32"))
+    for j in range(2):
+        _, kc, vc = _decode_layer_paged(layer, pre, cos, sin, kc, vc,
+                                        tables, jnp.full((B,), j + 1, jnp.int32))
+        _, kc2, vc2 = _decode_layer_paged(layer, pre, cos, sin, kc2, vc2,
+                                          tables, jnp.full((B,), j + 1, jnp.int32))
+    # path A: chunk
+    hA, kcA, vcA = _decode_layer_paged_chunk(
+        layer, hs, cos, sin, kc, vc, tables, jnp.full((B,), 2 + T, jnp.int32))
+    # path B: token by token
+    outs = []
+    for j in range(T):
+        hj, kc2, vc2 = _decode_layer_paged(
+            layer, paddle.to_tensor(np.asarray(hs._value)[:, j:j + 1]),
+            cos, sin, kc2, vc2, tables, jnp.full((B,), 3 + j, jnp.int32))
+        outs.append(np.asarray(hj._value))
+    np.testing.assert_allclose(np.asarray(hA._value),
+                               np.concatenate(outs, 1), rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(kcA), np.asarray(kc2),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(vcA), np.asarray(vc2),
+                               rtol=1e-6, atol=1e-7)
