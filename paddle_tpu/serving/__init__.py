@@ -150,6 +150,8 @@ class GenerationEngine:
             ]
             self._d_vpools = [jnp.zeros_like(k) for k in self._d_kpools]
             self._d_state = list(draft_model.state_dict().values())
+            self._spec_stats = {"ticks": 0, "proposed": 0, "accepted": 0,
+                                "emitted": 0}
 
     # ------------------------------------------------------------ requests
     def has_work(self):
@@ -482,6 +484,7 @@ class GenerationEngine:
         preds = np.asarray(preds)  # [B, K+1]
 
         # ---- per-slot acceptance + emission ----------------------------
+        self._spec_stats["ticks"] += 1
         out = {}
         for i, sl in enumerate(self._slots):
             if not sl.active:
@@ -489,6 +492,8 @@ class GenerationEngine:
             accepted = 0
             while accepted < K and preds[i, accepted] == proposals[i, accepted]:
                 accepted += 1
+            self._spec_stats["proposed"] += K
+            self._spec_stats["accepted"] += accepted
             new_toks = [int(t) for t in proposals[i, :accepted]]
             new_toks.append(int(preds[i, accepted]))
             base_seq = sl.seq_len  # pre-round trusted pool coverage
@@ -510,9 +515,16 @@ class GenerationEngine:
             sl.d_seq_len = sl.seq_len
             sl.last_token = emitted[-1]
             out[sl.rid] = emitted
+            self._spec_stats["emitted"] += len(emitted)
             if finish:
                 self._finish(sl)
         return out
+
+    def spec_stats(self):
+        """Speculative acceptance counters (None on plain engines):
+        mean acceptance = accepted/proposed sizes num_speculative_tokens;
+        emitted/ticks is the per-tick speedup over plain decode."""
+        return None if self.draft_model is None else dict(self._spec_stats)
 
     def step(self):
         """One decode tick for every live request.

@@ -365,3 +365,18 @@ def test_speculative_engine_zero_slack_blocks_no_corruption():
     while eng.has_work():
         eng.step()
     assert eng.result("r") == ref.result("r")
+
+
+def test_spec_stats_observability():
+    target = _model()
+    eng = GenerationEngine(target, max_batch=2, block_size=8, num_blocks=32,
+                           draft_model=target, num_speculative_tokens=3)
+    eng.add_request("r", [5, 9, 17], max_new_tokens=9)
+    while eng.has_work():
+        eng.step()
+    st = eng.spec_stats()
+    assert st["ticks"] >= 1 and st["emitted"] >= 8
+    assert st["accepted"] == st["proposed"]  # self-draft accepts all
+    plain = GenerationEngine(_model(), max_batch=1, block_size=8,
+                             num_blocks=16)
+    assert plain.spec_stats() is None
