@@ -1,0 +1,76 @@
+"""Serving decode throughput: continuous-batching engine tokens/s, plain
+vs speculative (BASELINE.md serving tier; reference lineage
+block_multi_head_attention + the decode servers over it).
+
+Prints ONE JSON line like the other benches.  vs_baseline is 0.0 until a
+reference serving point is recorded (none published in-repo)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    on_accel = jax.devices()[0].platform != "cpu"
+
+    import contextlib
+
+    import paddle_tpu as paddle
+    from paddle_tpu.device import time_step_ms
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.seed(0)
+    cpu = None
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        pass
+    with (jax.default_device(cpu) if cpu else contextlib.nullcontext()):
+        if on_accel:
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+                dtype="bfloat16")
+            model = LlamaForCausalLM(cfg)
+            B, prompt_len, iters = 8, 128, 16
+            max_new = 256  # > total timed ticks: slots stay live throughout
+        else:
+            model = LlamaForCausalLM(llama_tiny(dtype="float32"))
+            B, prompt_len, iters = 2, 8, 3
+            max_new = 64
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    blocks_per_seq = -(-(prompt_len + max_new) // 16) + 1
+    eng = GenerationEngine(model, max_batch=B, block_size=16,
+                           num_blocks=B * blocks_per_seq)
+    for i in range(B):
+        eng.add_request(
+            f"r{i}", list(rng.integers(0, model.config.vocab_size, prompt_len)),
+            max_new_tokens=max_new)
+
+    eng.step()  # compile
+    ms = time_step_ms(eng.step, inner=iters)
+    tokens_per_sec = B / (ms / 1e3)  # one token per live slot per tick
+    print(json.dumps({
+        "metric": "serving_decode_tokens_per_sec",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "batch": B,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
