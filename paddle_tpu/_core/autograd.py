@@ -128,6 +128,7 @@ class GradNode:
         "n_outputs",
         "out_refs",
         "released",
+        "rebuild",
         "__weakref__",
     )
 
@@ -140,9 +141,18 @@ class GradNode:
         self.n_outputs = len(out_avals)
         self.out_refs = []  # list[weakref to output Tensors], for hooks
         self.released = False
+        # (fn, fixed_vals, diff_set, n_args, kwargs, input_snapshot): enough
+        # to re-run the forward under jax.vjp with the cotangents as EXTRA
+        # differentiable inputs — the create_graph=True path (double
+        # backward; reference builds generated double-grad nodes,
+        # python/paddle/base/dygraph/base.py:645).  input_snapshot holds the
+        # record-time values so in-place mutation between forward and the
+        # create_graph walk is detected, not silently recomputed-over.
+        self.rebuild = None
 
     def release(self):
         self.vjp_fn = None
+        self.rebuild = None
         self.released = True
 
 
@@ -285,6 +295,8 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
         _check_nan_inf(name, flat_out)
     out_avals = [(v.shape, v.dtype) for v in flat_out]
     node = GradNode(name, vjp_fn, diff_tensors, out_avals, out_tree)
+    node.rebuild = (fn, fixed_vals, diff_set, len(args), kwargs,
+                    tuple(t._value for t in diff_tensors))
 
     out_tensors = []
     for i, v in enumerate(flat_out):
@@ -327,9 +339,14 @@ def backward_multi(roots, grad_vals, retain_graph: bool = False):
         _backward_impl(roots, grad_vals, retain_graph, leaf_targets=None)
 
 
-def _reachable_graph(root_nodes):
+def _reachable_graph(root_nodes, create_graph=False):
     """BFS the node graph; return set of nodes + in-degree (number of consumer
-    nodes whose vjp contributes cotangents into this node)."""
+    nodes whose vjp contributes cotangents into this node).
+
+    Normal mode stops at released nodes (their outputs act as leaves, the
+    long-standing partial-backward boundary); create_graph mode keeps them so
+    the walk raises the clear already-released error instead of silently
+    truncating the second-order graph."""
     seen = set()
     indeg = {}
     q = deque(root_nodes)
@@ -340,7 +357,7 @@ def _reachable_graph(root_nodes):
         node = q.popleft()
         for t in node.inputs:
             child = t._grad_node
-            if child is not None and not child.released:
+            if child is not None and (create_graph or not child.released):
                 indeg[child] = indeg.get(child, 0) + 1
                 if child not in seen:
                     seen.add(child)
@@ -349,16 +366,68 @@ def _reachable_graph(root_nodes):
 
 
 def _run_hooks(tensor, grad_val):
+    """Type-preserving: raw in → raw out; Tensor in (create_graph walk) →
+    Tensor out, so hook results stay on the tape."""
+    as_tensor = isinstance(grad_val, Tensor)
     for hook in list(tensor._hooks):
-        res = hook(Tensor(grad_val))
+        res = hook(grad_val if as_tensor else Tensor(grad_val))
         if res is not None:
-            grad_val = res._value if isinstance(res, Tensor) else res
+            if as_tensor:
+                grad_val = res if isinstance(res, Tensor) else Tensor(res)
+            else:
+                grad_val = res._value if isinstance(res, Tensor) else res
     return grad_val
 
 
-def _backward_impl(roots, grad_vals, retain_graph, leaf_targets):
+def _vjp_through_tape(node, cot_tensors):
+    """Compute node's input cotangents THROUGH the tape (create_graph=True).
+
+    Re-runs the recorded forward under jax.vjp inside `apply`, with both the
+    original differentiable inputs and the incoming cotangents as
+    differentiable inputs of a new '<name>_grad' node — so the returned
+    grads carry grad nodes and support another backward() (the reference's
+    generated double-grad GradNodes, e.g. MatmulDoubleGradNode).  Costs one
+    forward recompute per node, the standard higher-order trade.
+    """
+    if node.released or node.rebuild is None:
+        raise RuntimeError(
+            f"Grad node '{node.name}' already released; pass retain_graph=True "
+            "to the earlier backward()/grad() call to differentiate through "
+            "this graph again."
+        )
+    fn, fixed_vals, diff_set, n_args, kwargs, snapshot = node.rebuild
+    for t, snap in zip(node.inputs, snapshot):
+        if t._value is not snap:
+            raise RuntimeError(
+                f"an input of op '{node.name}' needed for create_graph=True "
+                "has been modified by an in-place operation since it was "
+                "recorded"
+            )
+    k = len(node.inputs)
+
+    def vjp_apply(*vals):
+        diff_vals, cot_flat = vals[:k], vals[k:]
+
+        def g(*dv):
+            it = iter(dv)
+            full = [next(it) if i in diff_set else fixed_vals[i] for i in range(n_args)]
+            return fn(*full, **kwargs)
+
+        _, vjp_fn = jax.vjp(g, *diff_vals)
+        cot = jax.tree_util.tree_unflatten(node.out_tree, list(cot_flat))
+        return tuple(vjp_fn(cot))
+
+    outs = apply(f"{node.name}_grad", vjp_apply, *node.inputs, *cot_tensors)
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
+def _backward_impl(roots, grad_vals, retain_graph, leaf_targets, create_graph=False):
     """If leaf_targets is not None: return grads for those tensors instead of
-    writing .grad (used by paddle.grad)."""
+    writing .grad (used by paddle.grad).
+
+    With create_graph=True every cotangent in flight is a Tensor and every
+    vjp runs through `apply` (see _vjp_through_tape), so the returned grads
+    are themselves differentiable."""
     holders = {}  # node -> list of cotangent values per output
     root_nodes = []
     leaf_grads = {}  # id(tensor) -> value (for leaf_targets mode)
@@ -388,7 +457,7 @@ def _backward_impl(roots, grad_vals, retain_graph, leaf_targets):
     if not root_nodes:
         return leaf_grads
 
-    nodes, indeg = _reachable_graph(root_nodes)
+    nodes, indeg = _reachable_graph(root_nodes, create_graph=create_graph)
     ready = deque(n for n in nodes if indeg.get(n, 0) == 0)
     processed = set()
 
@@ -402,19 +471,25 @@ def _backward_impl(roots, grad_vals, retain_graph, leaf_targets):
         for i, (shape, dt) in enumerate(node.out_avals):
             v = cots[i]
             if v is None:
-                v = jnp.zeros(shape, dt)
+                v = Tensor(jnp.zeros(shape, dt)) if create_graph else jnp.zeros(shape, dt)
             else:
                 ref = node.out_refs[i]() if i < len(node.out_refs) else None
                 if ref is not None and ref._hooks:
                     v = _run_hooks(ref, v)
             full.append(v)
-        cot_struct = jax.tree_util.tree_unflatten(node.out_tree, full)
-        if node.released or node.vjp_fn is None:
-            raise RuntimeError(
-                f"Grad node '{node.name}' already released; pass retain_graph=True "
-                "to backward() to backprop twice through the same graph."
-            )
-        in_grads = node.vjp_fn(cot_struct)
+        if create_graph:
+            in_grads = _vjp_through_tape(node, full)
+        else:
+            cot_struct = jax.tree_util.tree_unflatten(node.out_tree, full)
+            if node.released or node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Grad node '{node.name}' already released; pass retain_graph=True "
+                    "to backward() to backprop twice through the same graph."
+                )
+            in_grads = node.vjp_fn(cot_struct)
+        # An explicit retain_graph=False releases even under create_graph:
+        # the grad-of-grad nodes built by _vjp_through_tape carry their own
+        # closures, so the first-order residuals can be freed.
         if not retain_graph:
             node.release()
 
@@ -463,17 +538,9 @@ def grad(
     only_inputs: bool = True,
     allow_unused: bool = False,
 ):
-    """paddle.grad equivalent (reference python/paddle/base/dygraph/base.py).
-
-    create_graph (double backward) is not yet supported on the tape; use
-    paddle_tpu.incubate.autograd functional transforms (jax.grad composition)
-    for higher-order derivatives.
-    """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use functional jax.grad composition via "
-            "paddle_tpu.autograd.functional for higher-order gradients"
-        )
+    """paddle.grad equivalent (reference python/paddle/base/dygraph/base.py:615;
+    create_graph=True builds the double-backward graph like the reference's
+    generated double-grad nodes — see _vjp_through_tape)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -484,9 +551,24 @@ def grad(
             jnp.ones_like(o._value) if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g))
             for o, g in zip(outputs, grad_outputs)
         ]
-    retain = bool(retain_graph) if retain_graph is not None else False
-    with no_grad():
-        leaf_grads = _backward_impl(outputs, grad_vals, retain, leaf_targets=inputs)
+    # Reference semantics: retain_graph defaults to create_graph.
+    retain = bool(retain_graph) if retain_graph is not None else bool(create_graph)
+    if create_graph:
+        # Cotangents must ride the tape: seed with Tensors (a grad_outputs
+        # Tensor keeps its own grad node so grads can flow into it too) and
+        # walk with grad recording ON.
+        seeds = []
+        for gv, go in zip(
+            grad_vals, grad_outputs if grad_outputs is not None else [None] * len(grad_vals)
+        ):
+            seeds.append(go if isinstance(go, Tensor) else Tensor(gv))
+        with enable_grad():
+            leaf_grads = _backward_impl(
+                outputs, seeds, retain, leaf_targets=inputs, create_graph=True
+            )
+    else:
+        with no_grad():
+            leaf_grads = _backward_impl(outputs, grad_vals, retain, leaf_targets=inputs)
     results = []
     for t in inputs:
         g = leaf_grads.get(id(t))
@@ -496,6 +578,8 @@ def grad(
                     "One of the differentiated tensors appears unused; pass allow_unused=True"
                 )
             results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results

@@ -1,0 +1,194 @@
+"""Double-backward (create_graph=True) on the imperative tape.
+
+Reference: paddle.grad create_graph (python/paddle/base/dygraph/base.py:615)
+backed by generated double-grad GradNodes; behavioral model
+test/legacy_test/test_imperative_double_grad.py.  Here the tape computes each
+first-order vjp THROUGH the funnel (autograd._vjp_through_tape), so returned
+grads carry grad nodes; values are checked against jax.grad-of-grad oracles.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+def _param(arr):
+    t = paddle.to_tensor(np.asarray(arr, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def test_second_order_elementwise():
+    x = _param([1.0, 2.0, 3.0])
+    y = (x * x * x).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    assert not gx.stop_gradient  # part of the graph
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([1.0, 4.0, 9.0]), rtol=1e-6)
+    (ggx,) = paddle.grad(gx.sum(), x)
+    np.testing.assert_allclose(ggx.numpy(), 6 * np.array([1.0, 2.0, 3.0]), rtol=1e-6)
+
+
+def test_third_order_chain():
+    x = _param([2.0])
+    y = x**4
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1, x, create_graph=True)
+    (g3,) = paddle.grad(g2, x)
+    np.testing.assert_allclose(g1.numpy(), [32.0], rtol=1e-6)  # 4x^3
+    np.testing.assert_allclose(g2.numpy(), [48.0], rtol=1e-6)  # 12x^2
+    np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-6)  # 24x
+
+
+def test_create_graph_default_retains():
+    # retain_graph defaults to create_graph: the same first-order graph can
+    # be differentiated again.
+    x = _param([1.5])
+    y = (x**3).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    (ggx_a,) = paddle.grad(gx.sum(), x, create_graph=True)
+    (ggx_b,) = paddle.grad(gx.sum(), x)  # second walk over the same graph
+    np.testing.assert_allclose(ggx_a.numpy(), ggx_b.numpy(), rtol=1e-6)
+
+
+def test_second_order_matmul_vs_jax():
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((4, 5)).astype(np.float32)
+    wv = rng.standard_normal((5, 3)).astype(np.float32)
+
+    x, w = _param(xv), _param(wv)
+    out = paddle.matmul(x, w)
+    s = (out * out).sum()
+    (gx,) = paddle.grad(s, x, create_graph=True)
+    # scalar functional of the first-order grad, differentiated wrt w
+    q = (gx * gx).sum()
+    (gw,) = paddle.grad(q, w)
+
+    def f(xa, wa):
+        o = xa @ wa
+        return (o * o).sum()
+
+    def q_of_w(wa):
+        gxa = jax.grad(f, argnums=0)(xv, wa)
+        return (gxa * gxa).sum()
+
+    oracle = jax.grad(q_of_w)(wv)
+    np.testing.assert_allclose(gw.numpy(), np.asarray(oracle), rtol=1e-4, atol=1e-4)
+
+
+def test_wgan_gp_gradient_penalty_vs_jax():
+    """Gradient-penalty training step: penalty = (||d D(x)/d x|| - 1)^2
+    backprops into D's parameters — the workload create_graph exists for."""
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((8, 6)).astype(np.float32)
+    w1v = (rng.standard_normal((6, 16)) * 0.4).astype(np.float32)
+    w2v = (rng.standard_normal((16, 1)) * 0.4).astype(np.float32)
+
+    x, w1, w2 = _param(xv), _param(w1v), _param(w2v)
+    h = paddle.tanh(paddle.matmul(x, w1))
+    d = paddle.matmul(h, w2).sum()
+    (gx,) = paddle.grad(d, x, create_graph=True)
+    norm = paddle.sqrt((gx * gx).sum(axis=1) + 1e-12)
+    penalty = ((norm - 1.0) ** 2).mean()
+    penalty.backward()
+
+    def discriminator(xa, w1a, w2a):
+        return (jnp.tanh(xa @ w1a) @ w2a).sum()
+
+    def penalty_fn(w1a, w2a):
+        gxa = jax.grad(discriminator, argnums=0)(xv, w1a, w2a)
+        n = jnp.sqrt((gxa * gxa).sum(axis=1) + 1e-12)
+        return ((n - 1.0) ** 2).mean()
+
+    gw1, gw2 = jax.grad(penalty_fn, argnums=(0, 1))(w1v, w2v)
+    np.testing.assert_allclose(w1.grad.numpy(), np.asarray(gw1), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w2.grad.numpy(), np.asarray(gw2), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_outputs_tensor_participates():
+    # A grad_outputs Tensor with its own graph keeps receiving gradient:
+    # d/dv of <v, dy/dx-seeded-by-v> where y = x*x.
+    x = _param([1.0, 2.0])
+    v = _param([3.0, 4.0])
+    y = x * x
+    (gx,) = paddle.grad(y, x, grad_outputs=v, create_graph=True)  # 2x*v
+    np.testing.assert_allclose(gx.numpy(), [6.0, 16.0], rtol=1e-6)
+    (gv,) = paddle.grad(gx.sum(), v)  # d/dv sum(2x*v) = 2x
+    np.testing.assert_allclose(gv.numpy(), [2.0, 4.0], rtol=1e-6)
+
+
+def test_create_graph_inside_jit():
+    """The whole double-backward step traces under jax.jit (tape composes
+    with tracing — the TPU hot path)."""
+
+    def step(xval):
+        x = paddle.to_tensor(xval)
+        x.stop_gradient = False
+        y = (x**3).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        (ggx,) = paddle.grad(gx.sum(), x)
+        return ggx._value
+
+    out = jax.jit(step)(jnp.array([1.0, 2.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [6.0, 12.0], rtol=1e-5)
+
+
+def test_create_graph_on_released_graph_raises():
+    # backward() without retain released the graph; create_graph over it must
+    # raise loudly (reference: 'trying to backward a second time'), not
+    # silently truncate at the released node.
+    x = _param([2.0])
+    a = x * x
+    y = (a * a).sum()
+    y.backward()
+    try:
+        paddle.grad(y, x, create_graph=True, allow_unused=True)
+    except RuntimeError as e:
+        assert "released" in str(e)
+    else:
+        raise AssertionError("expected released-node RuntimeError")
+
+
+def test_create_graph_detects_inplace_mutation():
+    # The rebuild path recomputes the forward; a set_value between forward
+    # and the create_graph walk must error, not silently change the grad.
+    x = _param([2.0])
+    y = (x * x).sum()
+    x.set_value(np.array([10.0], np.float32))
+    try:
+        paddle.grad(y, x, create_graph=True)
+    except RuntimeError as e:
+        assert "in-place" in str(e)
+    else:
+        raise AssertionError("expected in-place mutation RuntimeError")
+
+
+def test_create_graph_explicit_retain_false_releases():
+    # retain_graph=False with create_graph frees the first-order graph: the
+    # returned grad stays differentiable, but a second walk over the
+    # original graph raises.
+    x = _param([3.0])
+    y = (x**3).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True, retain_graph=False)
+    (ggx,) = paddle.grad(gx.sum(), x)  # second-order graph still alive
+    np.testing.assert_allclose(ggx.numpy(), [18.0], rtol=1e-6)
+    try:
+        paddle.grad(y, x)
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("expected released-node RuntimeError")
+
+
+def test_first_order_release_still_enforced():
+    # Without create_graph nothing changed: second backward still raises.
+    x = _param([1.0])
+    y = (x * x).sum()
+    y.backward()
+    try:
+        y.backward()
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("expected released-node RuntimeError")
