@@ -260,6 +260,7 @@ class VisualDL(Callback):
         self._writer = None
         self._jsonl = None
         self._train_step = 0
+        self._in_fit = False
 
     def _ensure_writer(self):
         if self._writer is None and self._jsonl is None:
@@ -303,10 +304,21 @@ class VisualDL(Callback):
         self._train_step += 1
         self._log("train", logs, self._train_step)
 
+    def on_train_begin(self, logs=None):
+        self._in_fit = True
+
     def on_eval_end(self, logs=None):
         self._log("eval", logs, self._train_step)
+        if not self._in_fit:
+            # standalone Model.evaluate(): nothing will call on_train_end,
+            # so release the lazily-opened handle here
+            self._close()
 
     def on_train_end(self, logs=None):
+        self._in_fit = False
+        self._close()
+
+    def _close(self):
         # reset to None so a reused callback instance (second fit(), or a
         # standalone evaluate()) reopens instead of writing to a closed file
         if self._writer is not None:

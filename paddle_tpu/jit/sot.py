@@ -649,9 +649,14 @@ class _Interpreter:
             except AttributeError:
                 import importlib
 
+                from paddle_tpu.static.program import suspend_capture
+
                 try:  # CPython falls back to the submodule
-                    st.append(importlib.import_module(
-                        f"{mod.__name__}.{inst.argval}"))
+                    with suspend_capture():
+                        # first-time submodule import runs its module body:
+                        # same eager-execution rule as IMPORT_NAME above
+                        st.append(importlib.import_module(
+                            f"{mod.__name__}.{inst.argval}"))
                 except Exception as e:  # noqa: BLE001
                     raise Unsupported(
                         f"IMPORT_FROM {inst.argval!r}: {e}") from e

@@ -117,7 +117,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
             ]
             opad = tuple(t - dflt for t, dflt in zip(target, default))
             for o, s in zip(opad, strides):
-                if not 0 <= o < max(s, 1) + 1:
+                if not 0 <= o < max(s, 1):
                     raise ValueError(
                         f"output_size {target} unreachable: implied "
                         f"output_padding {opad} outside [0, stride)")

@@ -96,7 +96,31 @@ def batch_norm(
 
 
 def with_no_grad_update(running, momentum, batch_stat):
-    running._bind(running._value * momentum + batch_stat._value * (1.0 - momentum))
+    from paddle_tpu._core import autograd as _ag
+
+    # Through the funnel (not raw _value math) so the update also records
+    # under static capture, where _value is symbolic.
+    with _ag.no_grad():
+        new = running * momentum + batch_stat * (1.0 - momentum)
+    from paddle_tpu.static import program as _spm
+
+    if _spm.in_static_capture():
+        # Register the state write so the executor persists the new value
+        # across runs (same mechanism as optimizer param updates).  Do NOT
+        # bind the dygraph tensor itself: its concrete value must survive
+        # the capture for later eager use.
+        from paddle_tpu._core.tensor import Parameter as _Param
+
+        prog = _spm.current_main_program()
+        if isinstance(running, _spm.Variable):
+            target = running
+        elif isinstance(running, _Param):
+            target = prog.var_for_parameter(running)
+        else:
+            target = prog.var_for_state(running)
+        prog.add_write(target, new)
+    else:
+        running._bind(new._value)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):

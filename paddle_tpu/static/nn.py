@@ -216,16 +216,37 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
               do_model_average_for_mean_and_var=True, slot_dim=-1,
               sync_stats=False, summary_decay_rate=0.9999999,
               enable_scale_and_shift=False):
-    """Global data normalization via learned batch statistics (reference
-    data_norm op: batch_size/batch_sum/batch_square_sum accumulators;
-    normalizes with their running ratio, no gamma/beta by default)."""
-    d = int(input.shape[-1])
+    """Global data normalization via accumulated batch statistics (reference
+    data_norm op: batch_size/batch_sum/batch_square_sum accumulators).
+
+    Normalizes with the running ratio, then ACCUMULATES the current batch
+    into the buffers (the reference folds the accumulation into its
+    optimizer step via synthetic gradients; here the buffers are mutated on
+    forward like batch_norm's running stats — same `_bind` mechanism)."""
+    from paddle_tpu.nn.functional.norm import with_no_grad_update
+
+    ndim = len(input.shape)
+    ch_ax = 1 if (data_layout == "NCHW" and ndim > 1) else ndim - 1
+    d = int(input.shape[ch_ax])
     batch_size = _make_param([d], "float32", I.Constant(1e4))
     batch_sum = _make_param([d], "float32", I.Constant(0.0))
     batch_sq = _make_param([d], "float32", I.Constant(1e4))
+    for buf in (batch_size, batch_sum, batch_sq):
+        buf.stop_gradient = True
     mean = batch_sum / batch_size
     scale = (batch_size / batch_sq) ** 0.5
-    return _act((input - mean) * scale, act)
+    bshape = [1] * ndim
+    bshape[ch_ax] = d
+    out = _act((input - mean.reshape(bshape)) * scale.reshape(bshape), act)
+    # Per-channel accumulation of the current batch (momentum 0 == pure add).
+    reduce_axes = tuple(i for i in range(ndim) if i != ch_ax)
+    n_elems = 1.0
+    for i in reduce_axes:
+        n_elems *= float(input.shape[i])
+    with_no_grad_update(batch_size, 0.0, batch_size + n_elems)
+    with_no_grad_update(batch_sum, 0.0, batch_sum + input.sum(axis=reduce_axes))
+    with_no_grad_update(batch_sq, 0.0, batch_sq + (input * input).sum(axis=reduce_axes))
+    return out
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):

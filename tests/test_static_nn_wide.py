@@ -210,3 +210,53 @@ def test_conv2d_transpose_derives_kernel_from_output_size():
     assert tuple(out.shape)[2:] == (17, 17)
     with pytest.raises(ValueError, match="filter_size or output_size"):
         snn.conv2d_transpose(x, 3)
+
+
+def test_data_norm_accumulates_running_stats():
+    # Reference data_norm accumulates batch_size/batch_sum/batch_square_sum
+    # every training step (the op's synthetic-gradient trick); repeated
+    # executor runs over ONE program must drive the normalized output toward
+    # (x - mean(x)) / rms(x) of the streamed data.
+    from paddle_tpu import static
+
+    rng = np.random.default_rng(7)
+    xv = (rng.standard_normal((256, 5)) * 3.0 + 2.0).astype("float32")
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [256, 5], "float32")
+        out = snn.data_norm(x)
+    exe = static.Executor()
+    outs = [exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+            for _ in range(60)]
+    # init stats (batch_size=1e4, sum=0, sq=1e4) give ~identity at first ...
+    np.testing.assert_allclose(outs[0], xv, rtol=1e-3, atol=1e-3)
+    # ... and accumulation dominates the init prior after enough batches
+    expect = (xv - xv.mean(axis=0)) / np.sqrt((xv * xv).mean(axis=0))
+    err0 = np.abs(outs[0] - expect).mean()
+    errN = np.abs(outs[-1] - expect).mean()
+    assert errN < err0 * 0.2, (err0, errN)
+
+
+def test_data_norm_honors_data_layout():
+    from paddle_tpu import static
+
+    rng = np.random.default_rng(8)
+    xv = rng.standard_normal((2, 3, 4, 4)).astype("float32")
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3, 4, 4], "float32")
+        out_nchw = snn.data_norm(x, data_layout="NCHW")  # channel axis 1
+    assert tuple(out_nchw.shape) == (2, 3, 4, 4)
+
+
+def test_conv_transpose_output_padding_strictly_below_stride():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(3)
+    x = _t(rng.standard_normal((1, 2, 8)).astype("float32"))
+    w = _t(rng.standard_normal((2, 3, 3)).astype("float32"))
+    # stride 2: reachable window is [default, default + stride - 1]
+    assert F.conv1d_transpose(x, w, stride=2, output_size=[18]).shape[-1] == 18
+    with pytest.raises(ValueError, match=r"outside \[0, stride\)"):
+        F.conv1d_transpose(x, w, stride=2, output_size=[19])
