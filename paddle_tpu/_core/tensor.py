@@ -250,7 +250,18 @@ class Tensor:
                     dev_obj = target_device.jax_device()
                 else:
                     name, _, idx = str(target_device).partition(":")
+                    # accelerator names (gpu/tpu/...) mean "the accelerator":
+                    # the default backend in this framework
                     plat = "cpu" if name.lower() == "cpu" else _jax.default_backend()
+                    if name.lower() != "cpu" and plat == "cpu":
+                        import warnings
+
+                        warnings.warn(
+                            f"Tensor.to({target_device!r}): no accelerator "
+                            "backend available; keeping CPU placement",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
                     devs = _jax.devices(plat)
                     if idx:
                         if int(idx) >= len(devs):
@@ -266,8 +277,18 @@ class Tensor:
                     out = Tensor(moved, stop_gradient=self.stop_gradient)
                 else:
                     out._bind(moved)
-            except RuntimeError:
-                pass  # backend unavailable: keep placement
+            except RuntimeError as e:
+                # A requested device move that cannot happen must be loud
+                # (same silent-fallback class as the round-3 flags/tiles):
+                # keep the placement but tell the user.
+                import warnings
+
+                warnings.warn(
+                    f"Tensor.to({target_device!r}): backend unavailable "
+                    f"({e}); keeping current placement",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return out
 
     def cpu(self):
