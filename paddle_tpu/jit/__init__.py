@@ -376,7 +376,12 @@ def save(layer, path, input_spec=None, **configs):
                 if was_training:
                     layer.train()
             fetch = list(out) if isinstance(out, (tuple, list)) else [out]
-        static.save_inference_model(path, feeds, fetch, program=main)
+        # forward deploy-time optimization configs (passes/precision/
+        # extra_precisions — the reference jit.save's build_strategy analog)
+        export_kw = {k: configs[k] for k in
+                     ("passes", "precision", "extra_precisions") if k in configs}
+        static.save_inference_model(path, feeds, fetch, program=main,
+                                    **export_kw)
 
 
 def load(path, **configs):

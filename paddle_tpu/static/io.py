@@ -37,6 +37,23 @@ __all__ = [
 ]
 
 
+_PRECISION_ALIASES = {"int8": "weight_only_int8", "fp16": "float16",
+                      "half": "float16", "bf16": "bfloat16"}
+_PRECISIONS = ("float32", "float16", "bfloat16",
+               "weight_only_int8", "weight_only_int4")
+
+
+def canonicalize_precision(precision):
+    """One canonical spelling for precision modes, shared by the export path
+    and inference.Config so manifests and load-time requests always agree."""
+    p = _PRECISION_ALIASES.get(str(precision).lower(), str(precision).lower())
+    if p not in _PRECISIONS:
+        raise ValueError(
+            f"unknown precision {precision!r}; expected one of "
+            f"{_PRECISIONS} (aliases {sorted(_PRECISION_ALIASES)})")
+    return p
+
+
 def save(program: Program, model_path: str):
     """paddle.static.save parity: persist parameters+state (pickled npz)."""
     scope = global_scope()
@@ -124,11 +141,24 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
         from .program import default_main_program
 
         program = default_main_program()
+    extra_precisions = [canonicalize_precision(p)
+                        for p in kwargs.pop("extra_precisions", ()) or ()]
+    precision = canonicalize_precision(precision) if precision else None
+    base_program = program
     applied = []
-    if passes or precision:
+
+    def _apply_precision(prog, prec):
         from .passes import apply_pass
 
-        program = program.clone(for_test=True)
+        if prec in ("bfloat16", "float16"):
+            apply_pass(prog, "auto_parallel_fp16", dtype=prec)
+            return f"auto_parallel_fp16:{prec}"
+        apply_pass(prog, "weight_only_quant", algo=prec)
+        return f"weight_only_quant:{prec}"
+
+    def _apply_passes(prog, record):
+        from .passes import apply_pass
+
         for name in passes or []:
             opts = dict(name) if isinstance(name, dict) else {}
             pname = opts.pop("name", name) if isinstance(name, dict) else name
@@ -136,15 +166,33 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
                 # DCE without a fetch frontier is a documented no-op:
                 # forward the export's fetch set
                 opts.setdefault("fetch_vids", [v._vid for v in fetch_vars])
-            apply_pass(program, pname, **opts)
-            applied.append(pname)
+            apply_pass(prog, pname, **opts)
+            if record:
+                applied.append(pname)
+
+    if passes or precision:
+        program = program.clone(for_test=True)
+        _apply_passes(program, record=True)
         if precision:
-            if precision not in ("bfloat16", "float16"):
-                raise ValueError(
-                    f"precision must be bfloat16/float16, got {precision!r}")
-            apply_pass(program, "auto_parallel_fp16", dtype=precision)
-            applied.append(f"auto_parallel_fp16:{precision}")
+            applied.append(_apply_precision(program, precision))
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+
+    # Additional precision variants of the SAME program — each gets the SAME
+    # pass pipeline as the main artifact plus its precision rewrite, exported
+    # as <prefix>.<precision>.pdmodel and listed in the manifest: the
+    # build-per-precision-engine analog of the reference's TensorRT flow
+    # (paddle_analysis_config.h:676 Precision modes); the Predictor selects
+    # a variant at load via Config.set_precision.
+    variants = {}
+    for prec in extra_precisions:
+        vprog = base_program.clone(for_test=True)
+        _apply_passes(vprog, record=False)
+        _apply_precision(vprog, prec)
+        vblob, _ = serialize_program(vprog, feed_vars, fetch_vars)
+        vname = f"{os.path.basename(path_prefix)}.{prec}.pdmodel"
+        with open(f"{path_prefix}.{prec}.pdmodel", "wb") as f:
+            f.write(vblob)
+        variants[prec] = vname
 
     blob, text = serialize_program(program, feed_vars, fetch_vars)
     with open(path_prefix + ".pdmodel", "wb") as f:
@@ -170,6 +218,8 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
         ],
         "format": "stablehlo-text",
         "passes": applied,
+        "precision": precision or "float32",
+        "variants": variants,
     }
     with open(path_prefix + ".json", "w") as f:
         json.dump(manifest, f, indent=2)
