@@ -42,9 +42,10 @@ Scope notes vs the reference's 32k-LoC tier (documented limits, not bugs):
 framework internals (paddle_tpu.*, jax, numpy) always execute natively —
 they are designed to run on symbolic Variables through the apply() funnel,
 so inlining them would only add interpreter surface; cell/global STORE
-falls back; inlined-callee globals/closures are not guarded (rebinding a
-helper between calls without changing the input signature replays the old
-capture — same exposure as the natively-called design).
+falls back.  Binding guards (globals read during the trace, attribute-
+loaded callables, inlined callees' closure cells) are re-resolved on every
+replay — rebinding a helper or monkey-patching a method re-traces instead
+of replaying stale code (guard.py lineage).
 """
 
 from __future__ import annotations
@@ -68,7 +69,7 @@ class Unsupported(Exception):
 
 
 _STATS = {"captures": 0, "graph_breaks": 0, "fallbacks": 0, "replays": 0,
-          "inlines": 0}
+          "inlines": 0, "guard_misses": 0}
 
 
 def sot_stats():
@@ -95,12 +96,82 @@ class _Segment:
 class _Capture:
     """A traced path: segments separated by concrete branch decisions."""
 
-    __slots__ = ("segments", "decisions", "out_builder")
+    __slots__ = ("segments", "decisions", "out_builder", "guards")
 
-    def __init__(self, segments, decisions, out_builder):
+    def __init__(self, segments, decisions, out_builder, guards=()):
         self.segments = segments        # list[_Segment]
         self.decisions = tuple(decisions)  # bools taken at each break
         self.out_builder = out_builder  # (fetched values of last seg) -> result
+        self.guards = guards            # binding guards, see _guards_hold
+
+
+# --------------------------------------------------------------------------
+# binding guards (reference: sot guard chain over globals/closure cells,
+# python/paddle/jit/sot/opcode_translator/executor/guard.py) — every
+# trace-time binding the capture baked (globals read, attribute-loaded
+# callables, inlined callees' closure cells) is re-resolved at replay;
+# a mismatch re-traces instead of replaying stale code.
+
+_MISSING = object()
+_EQ_TYPES = (int, float, str, bool, bytes, type(None))
+
+
+def _underlying_code(v):
+    f = getattr(v, "__func__", v)
+    return getattr(f, "__code__", None)
+
+
+def _guard_expected(v):
+    code = _underlying_code(v)
+    if code is not None:
+        # functions/methods: code identity + closure-cell identity — a
+        # rebind to the same code but fresh cells (factory re-invocation)
+        # must re-trace, because the baked constants came from those cells
+        f = getattr(v, "__func__", v)
+        return ("code", code, getattr(f, "__closure__", None))
+    if isinstance(v, _EQ_TYPES):
+        return ("eq", type(v), v)
+    return ("is", v)
+
+
+def _guards_hold(guards):
+    for g in guards:
+        kind = g[0]
+        if kind == "global":
+            _, gl, bl, name, exp = g
+            cur = gl.get(name, _MISSING)
+            if cur is _MISSING and hasattr(bl, "get"):
+                cur = bl.get(name, _MISSING)
+        elif kind == "attr":
+            _, obj, name, exp = g
+            cur = getattr(obj, name, _MISSING)
+        else:  # cell
+            _, cell, exp = g
+            try:
+                cur = cell.cell_contents
+            except ValueError:
+                cur = _MISSING
+        if cur is _MISSING:
+            return False
+        ekind = exp[0]
+        if ekind == "code":
+            if _underlying_code(cur) is not exp[1]:
+                return False
+            curf = getattr(cur, "__func__", cur)
+            cells, exp_cells = getattr(curf, "__closure__", None), exp[2]
+            if (cells is None) != (exp_cells is None):
+                return False
+            if cells is not None and (
+                len(cells) != len(exp_cells)
+                or any(a is not b for a, b in zip(cells, exp_cells))
+            ):
+                return False
+        elif ekind == "eq":
+            if type(cur) is not exp[1] or cur != exp[2]:
+                return False
+        elif cur is not exp[1]:
+            return False
+    return True
 
 
 # --------------------------------------------------------------------------
@@ -283,7 +354,8 @@ class _Frame:
     same per-frame state on its executor objects)."""
 
     __slots__ = ("fn", "code", "instructions", "by_offset", "globals",
-                 "builtins", "closure", "locals", "stack", "kw_names", "idx")
+                 "builtins", "closure", "cellmap", "locals", "stack",
+                 "kw_names", "idx")
 
     def __init__(self, fn, local_vars):
         self.fn = fn
@@ -295,10 +367,12 @@ class _Frame:
             b = b.__dict__
         self.builtins = b
         self.closure = {}
+        self.cellmap = {}  # name -> cell object (for replay binding guards)
         if fn.__closure__:
             for name, cell in zip(self.code.co_freevars, fn.__closure__):
                 try:
                     self.closure[name] = cell.cell_contents
+                    self.cellmap[name] = cell
                 except ValueError:  # empty cell
                     pass
         self.locals = local_vars
@@ -324,9 +398,52 @@ class _Interpreter:
         self.frames: list[_Frame] = [root]
         self.segments: list[_Segment] = []
         self.decisions: list[bool] = []
+        self._guards: list = []
+        self._guard_keys: set = set()
         self._tensor_inputs = [
             (k, v) for k, v in root.locals.items() if isinstance(v, Tensor)
         ]
+
+    # ------------------------------------------------------------- guards
+    def _note_global_guard(self, f, name, value):
+        key = ("g", id(f.globals), name)
+        if key not in self._guard_keys:
+            self._guard_keys.add(key)
+            self._guards.append(
+                ("global", f.globals, f.builtins, name, _guard_expected(value)))
+
+    def _note_attr_guard(self, obj, name, value):
+        key = ("a", id(obj), name)
+        if key not in self._guard_keys:
+            self._guard_keys.add(key)
+            self._guards.append(("attr", obj, name, _guard_expected(value)))
+
+    def _maybe_attr_guard(self, obj, name, value):
+        """Guard attribute-loaded CALLABLES on concrete objects (method
+        monkey-patching must invalidate); plain data attrs are left to the
+        tensor-signature guards."""
+        from paddle_tpu._core.tensor import Tensor
+
+        if isinstance(obj, Tensor) or isinstance(value, Tensor):
+            return
+        if _underlying_code(value) is not None:
+            self._note_attr_guard(obj, name, value)
+
+    def _note_cell_guard(self, cell, contents):
+        key = ("c", id(cell))
+        if key not in self._guard_keys:
+            self._guard_keys.add(key)
+            self._guards.append(("cell", cell, _guard_expected(contents)))
+
+    def _note_cell_guards(self, tfn):
+        if not getattr(tfn, "__closure__", None):
+            return
+        for name, cell in zip(tfn.__code__.co_freevars, tfn.__closure__):
+            try:
+                contents = cell.cell_contents
+            except ValueError:
+                continue
+            self._note_cell_guard(cell, contents)
 
     # ---------------------------------------------------------- segments
     def _begin_segment(self, concrete_tensors):
@@ -548,7 +665,8 @@ class _Interpreter:
         seg.fetch_vars = sym
         seg.pred_index = None
         result = out_builder(fetched)
-        capture = _Capture(self.segments, self.decisions, out_builder)
+        capture = _Capture(self.segments, self.decisions, out_builder,
+                           guards=tuple(self._guards))
         return result, capture
 
     # -------------------------------------------------------------- steps
@@ -616,11 +734,13 @@ class _Interpreter:
             if inst.arg & 1:  # 3.11+: low bit = push NULL before the global
                 st.append(None)
             if name in f.globals:
-                st.append(f.globals[name])
+                val = f.globals[name]
             elif name in f.builtins:
-                st.append(f.builtins[name])
+                val = f.builtins[name]
             else:
                 raise Unsupported(f"unresolvable global {name}")
+            self._note_global_guard(f, name, val)
+            st.append(val)
             return idx + 1
         if op == "IMPORT_NAME":
             # inline `import x` / `from x import y`: a trace-time effect
@@ -663,6 +783,10 @@ class _Interpreter:
             return idx + 1
         if op == "LOAD_DEREF":
             if inst.argval in f.closure:
+                cell = f.cellmap.get(inst.argval)
+                if cell is not None:
+                    # rebinding this cell between calls must re-trace
+                    self._note_cell_guard(cell, f.closure[inst.argval])
                 st.append(f.closure[inst.argval])
             elif inst.argval in f.locals:
                 # MAKE_CELL'd local (a cellvar) reads through locals here
@@ -677,18 +801,23 @@ class _Interpreter:
             # would corrupt the stack on odd indices
             if sys.version_info >= (3, 12) and (getattr(inst, "arg", 0) & 1):
                 attr = self._call(getattr, (obj, inst.argval))
+                self._maybe_attr_guard(obj, inst.argval, attr)
                 st.append(attr)
                 st.append(None)  # self_or_null slot consumed by CALL
                 # NOTE: CPython pushes (method, self); calling the bound
                 # attr directly keeps CALL's layout consistent below
                 st[-2], st[-1] = st[-1], st[-2]
             else:
-                st.append(self._call(getattr, (obj, inst.argval)))
+                attr = self._call(getattr, (obj, inst.argval))
+                self._maybe_attr_guard(obj, inst.argval, attr)
+                st.append(attr)
             return idx + 1
         if op == "LOAD_METHOD":  # 3.11
             obj = st.pop()
             st.append(None)
-            st.append(self._call(getattr, (obj, inst.argval)))
+            attr = self._call(getattr, (obj, inst.argval))
+            self._maybe_attr_guard(obj, inst.argval, attr)
+            st.append(attr)
             return idx + 1
         if op == "KW_NAMES":
             f.kw_names = inst.argval
@@ -725,6 +854,10 @@ class _Interpreter:
                     except Unsupported:
                         loc = None  # odd binding: run it natively instead
                     if loc is not None:
+                        # rebinding the callee's closure cells must
+                        # invalidate this capture (guard.py lineage); its
+                        # own name binding is guarded at the load opcode
+                        self._note_cell_guards(tfn)
                         f.idx = idx + 1  # resume here after the callee returns
                         self.frames.append(_Frame(tfn, loc))
                         _STATS["inlines"] += 1
@@ -956,6 +1089,7 @@ class SOTFunction:
         except Unsupported:
             return _MISS
         decisions: list[bool] = []
+        guards_ok: set = set()
         carry = tensors
         seg_i = 0
         while True:
@@ -966,6 +1100,11 @@ class SOTFunction:
             if not matches:
                 return _MISS
             current = min(matches, key=lambda c: len(c.decisions))
+            if id(current) not in guards_ok:
+                if current.guards and not _guards_hold(current.guards):
+                    _STATS["guard_misses"] += 1
+                    return _MISS  # stale binding: caller re-traces
+                guards_ok.add(id(current))
             seg = current.segments[seg_i]
             if len(seg.feed_vars) != len(carry):
                 return _MISS

@@ -560,3 +560,81 @@ def test_detector_and_crnn_forward_capture_fraction():
         assert sot_stats()["fallbacks"] == before_fb, f"{name} fell back"
         (capture,) = list(sot._captures.values())[0].values()
         assert len(capture.segments) == 1, f"{name} broke into segments"
+
+
+# ----------------------------------------------------------- binding guards
+
+def test_rebound_global_helper_recaptures():
+    """Rebinding a module-global helper between calls must invalidate the
+    capture (reference guard.py chain), not replay the stale code."""
+    import types as _types
+
+    mod = _types.ModuleType("sot_guard_mod")
+
+    def mk(body):
+        code = compile(body, "<sot_guard>", "exec")
+        exec(code, mod.__dict__)
+        return mod.__dict__["fn"]
+
+    mk("def helper(x):\n    return x * 2.0\n"
+       "def fn(x):\n    return helper(x) + 1.0\n")
+    fn = mod.__dict__["fn"]
+    sot = symbolic_translate(fn)
+    x = T([1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(sot(x)._value), [3.0, 5.0])
+    np.testing.assert_allclose(np.asarray(sot(x)._value), [3.0, 5.0])  # replay
+
+    before = sot_stats()["guard_misses"]
+    exec(compile("def helper(x):\n    return x * 10.0\n", "<g2>", "exec"),
+         mod.__dict__)
+    np.testing.assert_allclose(np.asarray(sot(x)._value), [11.0, 21.0])
+    assert sot_stats()["guard_misses"] > before
+
+
+def test_rebound_closure_cell_recaptures():
+    def make(factor):
+        def helper(x):
+            return x * factor
+        return helper
+
+    helper = make(2.0)
+
+    def fn(x):
+        return helper(x) + 0.0
+
+    sot = symbolic_translate(fn)
+    x = T([1.0, 3.0])
+    np.testing.assert_allclose(np.asarray(sot(x)._value), [2.0, 6.0])
+    np.testing.assert_allclose(np.asarray(sot(x)._value), [2.0, 6.0])
+    # rebinding the test-local rebinds fn's closure cell to a function
+    # with the SAME code but fresh cells (factory re-invocation) — the
+    # closure-identity part of the guard must catch it
+    helper = make(5.0)  # noqa: F841
+    np.testing.assert_allclose(np.asarray(sot(x)._value), [5.0, 15.0])
+
+
+def test_monkeypatched_layer_forward_recaptures():
+    import paddle_tpu.nn as nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.helper(x)
+
+        def helper(self, x):
+            return self.lin(x) * 1.0
+
+    m = M()
+    sot = symbolic_translate(m.forward)
+    x = T(np.random.default_rng(0).standard_normal((2, 4)).astype("f4"))
+    a = np.asarray(sot(x)._value)
+    np.testing.assert_allclose(np.asarray(sot(x)._value), a)  # replay path
+    M.helper = lambda self, x: self.lin(x) * -1.0  # monkey-patch the method
+    try:
+        b = np.asarray(sot(x)._value)
+        np.testing.assert_allclose(b, -a, rtol=1e-6)
+    finally:
+        del M.helper  # restore class namespace for other tests
