@@ -256,20 +256,26 @@ class PipelineStack(Layer):
         self._bcast_template = [b if isinstance(b, Tensor) else None for b in bcast]
 
         # trunk-activation and output shapes per microbatch: the first/last
-        # stage layers may change both (ids -> hidden, hidden -> logits)
+        # stage layers may change both (ids -> hidden, hidden -> logits).
+        # The probes run layers through the funnel, so under static capture
+        # they MUST suspend recording (same rule as program.record's op
+        # bodies) — otherwise eval_shape tracers get baked into the program.
+        from paddle_tpu.static.program import suspend_capture
+
         mb_struct = jax.ShapeDtypeStruct((B // M,) + tuple(int(s) for s in h.shape[1:]), h._value.dtype)
-        if self._first is not None:
-            call = self._edge_call(self._first, self._first_tensors)
-            vals = [t._value for t in self._first_tensors]
-            h_struct = jax.eval_shape(lambda hv: call(hv, vals), mb_struct)
-        else:
-            h_struct = mb_struct
-        if self._last is not None:
-            call = self._edge_call(self._last, self._last_tensors)
-            vals = [t._value for t in self._last_tensors]
-            out_struct = jax.eval_shape(lambda hv: call(hv, vals), h_struct)
-        else:
-            out_struct = h_struct
+        with suspend_capture():
+            if self._first is not None:
+                call = self._edge_call(self._first, self._first_tensors)
+                vals = [t._value for t in self._first_tensors]
+                h_struct = jax.eval_shape(lambda hv: call(hv, vals), mb_struct)
+            else:
+                h_struct = mb_struct
+            if self._last is not None:
+                call = self._edge_call(self._last, self._last_tensors)
+                vals = [t._value for t in self._last_tensors]
+                out_struct = jax.eval_shape(lambda hv: call(hv, vals), h_struct)
+            else:
+                out_struct = h_struct
         self._h_struct, self._out_struct = h_struct, out_struct
 
         x = h.reshape([M, B // M] + list(h.shape[1:]))

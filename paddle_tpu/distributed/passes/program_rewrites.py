@@ -204,7 +204,10 @@ class GradientMergeProgramRewrite:
                 "(grad + optimizer_update super-ops); got neither — build "
                 "the program with optimizer.minimize(loss)")
         block = program.global_block()
-        grad_vids = list(grad_op.out_vids)
+        meta = getattr(grad_op, "grad_meta", None)
+        # grad-op outputs are (grads..., loss): accumulate only the grads
+        n_grads = len(meta["wrt_vids"]) if meta else len(grad_op.out_vids)
+        grad_vids = list(grad_op.out_vids[:n_grads])
         k, avg = self.k, self.avg
 
         # ---- new state: step counter + one accumulator per gradient
@@ -327,8 +330,17 @@ class ShardingProgramRewrite:
             return NamedSharding(self.mesh, PartitionSpec(self.axis))
         return None  # indivisible leading dim: leave replicated
 
-    def _constrain_outputs(self, program, op, positions, new_type):
-        """Wrap op.fn so selected flat outputs carry sharding constraints."""
+    def _constrain_outputs(self, program, op, positions, new_type,
+                           barrier_inputs=False):
+        """Wrap op.fn so selected flat outputs carry sharding constraints.
+
+        barrier_inputs ties all inputs together with an optimization
+        barrier before the op computes: the ZeRO reshard collectives this
+        op's constraints introduce must not interleave with collectives
+        still in flight from the producing chain (pipeline ppermutes) —
+        XLA:CPU's in-process communicator deadlocks on such cross-chain
+        overlap, and on TPU the barrier costs nothing measurable next to
+        the update itself."""
         from paddle_tpu.static.program import Operator
 
         shardings = {}
@@ -344,6 +356,8 @@ class ShardingProgramRewrite:
         orig_fn = op.fn
 
         def fn(*vals):
+            if barrier_inputs and vals:
+                vals = jax.lax.optimization_barrier(tuple(vals))
             out = orig_fn(*vals)
             flat = list(jax.tree_util.tree_leaves(out))
             for pos, sh in shardings.items():
@@ -382,7 +396,8 @@ class ShardingProgramRewrite:
         if self.stage >= 3:
             positions += param_pos
         new_upd = self._constrain_outputs(program, upd_op, positions,
-                                          "zero::" + upd_op.type)
+                                          "zero::" + upd_op.type,
+                                          barrier_inputs=True)
         if new_upd is not None:
             block.ops[block.ops.index(upd_op)] = new_upd
             changed += 1

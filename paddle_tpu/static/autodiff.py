@@ -4,9 +4,14 @@ Reference: python/paddle/autograd/ir_backward.py:885 (PIR autodiff appending
 grad ops per forward op via VJP interfaces).
 
 TPU-native: the whole recorded prefix is one traceable function, so backward
-is jax.grad of that function — one grad "super-op" appended to the program
-whose outputs are the per-parameter grad Variables.  XLA CSEs the re-executed
-forward against the original, so the compiled step computes the forward once.
+is jax.value_and_grad of that function — one grad "super-op" appended to the
+program whose outputs are the per-parameter grad Variables PLUS the loss
+value, and the program's loss variable is re-bound (via an alias op) to that
+loss output.  With the executor pruning to the fetch frontier, the compiled
+step then contains exactly ONE traced forward — important beyond perf:
+a duplicated forward chain that carries collectives (pipeline ppermute,
+TP psum) deadlocks XLA:CPU's in-process communicator when the two chains'
+collectives interleave, and relying on CSE to dedupe them is not sound.
 """
 
 from __future__ import annotations
@@ -36,10 +41,12 @@ def build_grad_fn(prog: Program, target_vid, wrt_vids, in_vids, ops=None):
             (out,), _ = run_fn([], full)
             return out.sum() if out.ndim else out
 
-        grads = jax.grad(scalar, argnums=tuple(range(len(wrt_pos))))(
-            *[vals[p] for p in wrt_pos]
-        )
-        return tuple(grads)
+        loss, grads = jax.value_and_grad(
+            scalar, argnums=tuple(range(len(wrt_pos)))
+        )(*[vals[p] for p in wrt_pos])
+        # output layout: (grads..., loss) — consumers that need only the
+        # grads slice by grad_meta["wrt_vids"] length
+        return tuple(grads) + (loss,)
 
     return fn
 
@@ -55,10 +62,23 @@ def _grad_superop(prog: Program, target: Variable, wrt_vars, name):
     wrt_vids = [v._vid for v in wrt_vars]
     fn = build_grad_fn(prog, target._vid, wrt_vids, in_vids)
     out = prog.record(name, fn, tuple(inputs), {})
+    outs = list(out)
+    grads, loss_out = outs[:-1], outs[-1]
     prog.global_block().ops[-1].grad_meta = {
         "target_vid": target._vid, "wrt_vids": wrt_vids, "in_vids": in_vids,
     }
-    return out
+    # Re-bind the loss variable to the grad op's loss output: later reads
+    # (fetches) take the value_and_grad forward, so a fetch-frontier prune
+    # can drop the original forward chain entirely.
+    import jax as _jax
+
+    single = _jax.tree_util.tree_structure(0)
+    alias = type(prog.global_block().ops[-1])(
+        "share_loss", lambda v: v, [("var", loss_out._vid)], {},
+        [target._vid], single)
+    prog.global_block().ops.append(alias)
+    prog.version += 1
+    return grads
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None):

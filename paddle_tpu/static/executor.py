@@ -126,7 +126,20 @@ class Executor:
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
         key = (id(program), program.version, sig, fetch_vids)
         if key not in self._cache:
-            run_fn, feed_vids, state_vids = program.as_function(list(fetch_vids))
+            # Prune to the fetch/write frontier (non-mutating): ops whose
+            # outputs no fetch or state write needs don't execute.  Beyond
+            # wasted compute, a dead duplicate of a collective-carrying
+            # chain (value_and_grad's forward vs the recorded forward ops)
+            # can deadlock XLA:CPU's in-process communicator.
+            live = set(fetch_vids) | set(program.writes) | set(program.writes.values())
+            pruned = []
+            for op in reversed(program.global_block().ops):
+                if any(v in live for v in op.out_vids):
+                    pruned.append(op)
+                    live.update(op.input_vids())
+            pruned.reverse()
+            run_fn, feed_vids, state_vids = program.as_function(
+                list(fetch_vids), ops=pruned)
 
             prev = _st.main_program
             _st.main_program = None  # never capture while executing

@@ -371,3 +371,14 @@ def test_recompute_after_sharding_keeps_grad_constraints():
     assert "remat" in jaxpr or "checkpoint" in jaxpr  # recompute applied
     losses, _ = _run_steps(prog, loss_var, _batches(2))
     assert all(np.isfinite(losses))
+
+
+def test_zero_rewrite_composes_with_pipeline_mesh():
+    """VERDICT r4 item 10: the ZeRO program-rewrite composed with pp — a
+    dp2 x pp2 x mp2 captured train step (pipelined trunk, TP shardings)
+    rewritten by auto_parallel_sharding stage 2 reproduces the unrewritten
+    program's losses on the 8-device mesh.  (Also the driver-visible
+    __graft_entry__ dryrun config D.)"""
+    import __graft_entry__ as ge
+
+    ge._dryrun_hybrid_zero_rewrite(8)
