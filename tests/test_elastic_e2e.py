@@ -1,0 +1,160 @@
+"""Elastic fault tolerance end-to-end: kill one worker of a 2-process CPU
+job mid-training under the launcher; the job must be detected as failed,
+relaunched, resume from the latest distributed checkpoint, and the loss
+curve must CONTINUE (steps don't restart at 0).
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:126 fault
+detect + relaunch loop; checkpoint-resume is the framework's
+distributed.checkpoint save/load (per-rank shards + metadata).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    addr = os.environ["MASTER_ADDR"] + ":" + os.environ["MASTER_PORT"]
+    # incarnation 2 re-binds the coordinator port the killed incarnation
+    # held: retry while the OS releases it
+    for attempt in range(6):
+        try:
+            jax.distributed.initialize(addr, num_processes=world, process_id=rank)
+            break
+        except Exception:
+            if attempt == 5:
+                raise
+            time.sleep(3)
+    sys.path.insert(0, "__REPO__")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    CKPT = os.environ["ELASTIC_CKPT_DIR"]
+    TOTAL, KILL_AT = 8, 4
+
+    paddle.seed(0)  # same init on both ranks
+    model = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    sd = {"w": model.weight, "b": model.bias,
+          "step": paddle.to_tensor(np.zeros((), np.int32))}
+
+    start = 0
+    latest = os.path.join(CKPT, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            tag = f.read().strip()
+        ckpt.load_state_dict(sd, os.path.join(CKPT, tag))
+        start = int(np.asarray(sd["step"]._value))
+    print(f"START rank {rank} start_step {start}", flush=True)
+
+    rng = np.random.default_rng(100 + rank)  # different data per rank
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((8, 1), np.float32))
+
+    def barrier():
+        t = paddle.to_tensor(np.zeros(1, np.float32))
+        dist.all_reduce(t)
+
+    for step in range(start, TOTAL):
+        if rank == 1 and start == 0 and step == KILL_AT:
+            print(f"KILLED_SELF rank {rank} at step {step}", flush=True)
+            os._exit(23)
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        for p in model.parameters():
+            dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        opt.step()
+        opt.clear_grad()
+        print(f"STEP rank {rank} step {step} loss {float(loss._value):.6f}",
+              flush=True)
+        # distributed checkpoint: per-rank shards + metadata, then the
+        # `latest` marker strictly after BOTH ranks finished writing
+        sd["step"] = paddle.to_tensor(np.asarray(step + 1, np.int32))
+        tag = f"step_{step + 1}"
+        ckpt.save_state_dict(sd, os.path.join(CKPT, tag))
+        barrier()
+        if rank == 0:
+            tmp = latest + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(tag)
+            os.replace(tmp, latest)
+        barrier()
+    print(f"DONE rank {rank}", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_elastic_kill_and_recover(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(_WORKER.replace("__REPO__", repo))
+    ckpt_dir = tmp_path / "ckpt"
+    log_dir = tmp_path / "log"
+    ckpt_dir.mkdir()
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["MASTER_PORT"] = str(free_port)
+    env["PADDLE_COORD_PORT"] = str(free_port)
+    env["ELASTIC_CKPT_DIR"] = str(ckpt_dir)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_level", "2",
+         "--log_dir", str(log_dir), str(script)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    logs = {}
+    for i in (0, 1):
+        p = log_dir / f"workerlog.{i}"
+        logs[i] = p.read_text() if p.exists() else ""
+    combined = (r.stdout or "") + logs[0] + logs[1]
+    assert r.returncode == 0, combined[-3000:]
+
+    # the failure really happened and the launcher relaunched
+    assert "KILLED_SELF rank 1 at step 4" in logs[1], logs[1][-2000:]
+    assert "restart 1/" in r.stdout, r.stdout[-2000:]
+
+    # both incarnations logged a START; the second resumed from the latest
+    # checkpoint, NOT from zero
+    starts = [int(l.split("start_step")[1]) for l in logs[0].splitlines()
+              if l.startswith("START rank 0")]
+    assert starts[0] == 0 and len(starts) == 2, starts
+    assert starts[1] >= 3, starts  # resumed near the kill point
+
+    # the step sequence CONTINUES: rank-0 steps across incarnations form a
+    # strictly increasing walk ending at TOTAL-1, with the resume step equal
+    # to the checkpointed position (no restart from 0)
+    steps, losses = [], []
+    for l in logs[0].splitlines():
+        if l.startswith("STEP rank 0"):
+            parts = l.split()
+            steps.append(int(parts[4]))
+            losses.append(float(parts[6]))
+    # dedupe the boundary (the step interrupted mid-save may be re-run)
+    assert steps[-1] == 7, steps
+    assert all(b - a in (0, 1) for a, b in zip(steps, steps[1:])), steps
+    assert steps[steps.index(starts[1])] == starts[1]
+    # loss curve continues downward overall (training, not restarting)
+    assert losses[-1] < losses[0], losses
+    first_resumed = losses[len([s for s in steps if s < starts[1]])]
+    assert first_resumed < losses[0], (losses, steps)
+    assert "DONE rank 0" in logs[0] and "DONE rank 1" in logs[1]
