@@ -12,8 +12,12 @@ embedding table too large for HBM, pulled/pushed per batch.  That slice is
 implemented here, for real:
 
 - `SparseTable`: host (numpy) embedding table with lazy row creation and
-  row-wise SGD/Adagrad updates — the accessor-table analog (memory tier
-  only; SSD spill and geo-SGD are explicitly out of scope).
+  row-wise SGD/Adagrad updates — the accessor-table analog, memory tier.
+- `SSDSparseTable` (disk_table.py): the ssd_sparse_table.cc analog — disk
+  bucket files of id-tagged records, crash-rebuildable index, LRU hot-row
+  cache, write-through durability mode.
+- `AsyncPsClient` / `GeoPsClient` (disk_table.py): async pushes with a
+  bounded staleness window, and geo-SGD local-delta training.
 - `PsServer` / `PsClient`: pull/push served over paddle_tpu.distributed.rpc
   (the brpc PS service analog); single-process mode short-circuits to the
   local table so the layer works without a cluster.
@@ -21,8 +25,8 @@ implemented here, for real:
   program and whose backward pushes per-row gradients back to the table —
   the distributed-lookup-table op pair (pull_sparse/push_sparse).
 
-Async/geo-SGD modes, dense PS tables, and GPU-PS have no counterpart and
-are deliberately out of scope — collective training covers them on TPU.
+Dense PS tables and GPU-PS have no counterpart and are deliberately out of
+scope — collective training covers them on TPU.
 """
 
 from __future__ import annotations
@@ -32,7 +36,8 @@ import threading
 import numpy as np
 
 __all__ = ["SparseTable", "PsServer", "PsClient", "SparseEmbedding",
-           "MeshShardedEmbedding"]
+           "MeshShardedEmbedding", "SSDSparseTable", "AsyncPsClient",
+           "GeoPsClient"]
 
 
 def __getattr__(name):
@@ -41,7 +46,18 @@ def __getattr__(name):
         from .sharded import MeshShardedEmbedding
 
         return MeshShardedEmbedding
+    if name in ("SSDSparseTable", "AsyncPsClient", "GeoPsClient"):
+        from . import disk_table
+
+        return getattr(disk_table, name)
     raise AttributeError(name)
+
+
+def _row_rng(rid):
+    """Per-id deterministic init stream: a row's fresh value must not depend
+    on the ORDER rows were first touched (crash-resume / async replicas
+    would otherwise diverge on re-created rows)."""
+    return np.random.default_rng((int(rid) * 2654435761) & 0xFFFFFFFF)
 
 
 class SparseTable:
@@ -58,7 +74,6 @@ class SparseTable:
         self._init = initializer or (
             lambda rng, dim: (rng.standard_normal(dim) * 0.01).astype(np.float32)
         )
-        self._rng = np.random.default_rng(0)
 
     def pull(self, ids):
         ids = np.asarray(ids, np.int64).reshape(-1)
@@ -67,7 +82,7 @@ class SparseTable:
             for i, rid in enumerate(ids):
                 row = self._rows.get(int(rid))
                 if row is None:
-                    row = self._init(self._rng, self.dim)
+                    row = self._init(_row_rng(rid), self.dim)
                     self._rows[int(rid)] = row
                 out[i] = row
         return out
@@ -87,6 +102,19 @@ class SparseTable:
                     row -= self._lr * g / (np.sqrt(acc) + 1e-8)
                 else:  # sgd
                     row -= self._lr * g
+
+    def push_delta(self, ids, deltas):
+        """row -= delta (geo-SGD merge; bypasses the optimizer rule)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            for rid, d in zip(ids, deltas):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    row = self._init(_row_rng(rid), self.dim)
+                    self._rows[rid] = row
+                row -= d
 
     def n_rows(self):
         with self._lock:
@@ -130,6 +158,11 @@ def _ps_push(table_name, ids, grads):
     return True
 
 
+def _ps_push_delta(table_name, ids, deltas):
+    PsServer._tables[table_name].push_delta(ids, deltas)
+    return True
+
+
 class PsClient:
     """pull_sparse / push_sparse against a local or remote table."""
 
@@ -153,6 +186,13 @@ class PsClient:
         from paddle_tpu.distributed import rpc
 
         return rpc.rpc_sync(self._server, _ps_push, args=(self._table_name, np.asarray(ids), np.asarray(grads)))
+
+    def push_delta(self, ids, deltas):
+        if self._table is not None:
+            return self._table.push_delta(ids, deltas)
+        from paddle_tpu.distributed import rpc
+
+        return rpc.rpc_sync(self._server, _ps_push_delta, args=(self._table_name, np.asarray(ids), np.asarray(deltas)))
 
 
 class SparseEmbedding:

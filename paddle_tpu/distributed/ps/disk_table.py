@@ -1,0 +1,376 @@
+"""Persistent + async parameter-server tiers.
+
+Reference: paddle/fluid/distributed/ps/table/ssd_sparse_table.cc (rocksdb-
+backed accessor table with a hot-row memory cache), async/geo-SGD update
+modes (paddle/fluid/distributed/ps/service/, the_one_ps.py geo strategy).
+
+TPU-native redesign, host-side by construction (the PS tier exists exactly
+for state that does NOT fit device HBM):
+
+- `SSDSparseTable`: disk-backed sparse rows.  Storage is N bucket files of
+  fixed-size records `[int64 id | f32 row*dim | f32 acc*dim]` with an
+  in-memory {id -> offset} index per bucket; records are written in place
+  (update) or appended (first write).  The index is a pure cache: after a
+  crash it is rebuilt by scanning record headers, so a kill -9 loses at
+  most rows not yet flushed (nothing, in write_through mode).  A bounded
+  LRU keeps hot rows in RAM; evictions write back (the rocksdb+memcache
+  split of the reference, with the same durability story).
+- `AsyncPsClient`: pushes are applied by a background thread; pulls are
+  allowed to run ahead of at most `max_staleness` pending pushes (the
+  async-SGD staleness bound of the reference's async mode).
+- `GeoPsClient`: geo-SGD — train against a local table copy and push the
+  accumulated DELTA of touched rows every `geo_steps` steps (reference
+  geo strategy), then refresh from the global table.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["SSDSparseTable", "AsyncPsClient", "GeoPsClient"]
+
+_HDR = struct.Struct("<q")  # row id per record: the crash-rebuild anchor
+
+
+class _Bucket:
+    """One record file: fixed-size [id|row|acc] records, offset index."""
+
+    def __init__(self, path, dim):
+        self.path = path
+        self.dim = dim
+        self.rec_size = _HDR.size + 2 * 4 * dim
+        exists = os.path.exists(path)
+        self.fp = open(path, "r+b" if exists else "w+b")
+        self.index: dict[int, int] = {}
+        if exists:
+            self._rebuild_index()
+
+    def _rebuild_index(self):
+        """Scan record headers — works with no sidecar, including after an
+        unclean shutdown (a torn trailing record is truncated away)."""
+        self.fp.seek(0, os.SEEK_END)
+        size = self.fp.tell()
+        n_complete = size // self.rec_size
+        self.fp.seek(0)
+        for i in range(n_complete):
+            hdr = self.fp.read(_HDR.size)
+            (rid,) = _HDR.unpack(hdr)
+            self.index[rid] = i * self.rec_size
+            self.fp.seek((i + 1) * self.rec_size)
+        if size != n_complete * self.rec_size:
+            self.fp.truncate(n_complete * self.rec_size)
+
+    def read(self, rid):
+        off = self.index.get(rid)
+        if off is None:
+            return None
+        self.fp.seek(off + _HDR.size)
+        buf = self.fp.read(2 * 4 * self.dim)
+        arr = np.frombuffer(buf, np.float32).copy()
+        return arr[: self.dim], arr[self.dim:]
+
+    def write(self, rid, row, acc, sync=False):
+        off = self.index.get(rid)
+        if off is None:
+            self.fp.seek(0, os.SEEK_END)
+            off = self.fp.tell()
+            self.index[rid] = off
+        self.fp.seek(off)
+        self.fp.write(_HDR.pack(rid))
+        self.fp.write(np.asarray(row, np.float32).tobytes())
+        self.fp.write(np.asarray(acc, np.float32).tobytes())
+        if sync:
+            self.fp.flush()
+            os.fsync(self.fp.fileno())
+
+    def ids(self):
+        return list(self.index)
+
+    def close(self):
+        self.fp.flush()
+        self.fp.close()
+
+
+class SSDSparseTable:
+    """Disk-backed accessor table with an LRU hot-row cache.
+
+    Drop-in for SparseTable (pull/push/n_rows/state_dict) so PsClient /
+    SparseEmbedding / MeshShardedEmbedding spill tiers work unchanged.
+
+    write_through=True makes every push durable before it returns (the
+    crash-consistency mode); otherwise dirty rows ride the LRU and are
+    written on eviction / flush() / close().
+    """
+
+    def __init__(self, dim, path, optimizer="adagrad", lr=0.01,
+                 n_buckets=16, cache_rows=100_000, write_through=False,
+                 initializer=None, name="ssd_emb"):
+        self.dim = int(dim)
+        self.name = name
+        self._opt = optimizer
+        self._lr = float(lr)
+        self._wt = bool(write_through)
+        self._cap = int(cache_rows)
+        self._init = initializer or (
+            lambda rng, dim: (rng.standard_normal(dim) * 0.01).astype(np.float32)
+        )
+        self._lock = threading.RLock()
+        os.makedirs(path, exist_ok=True)
+        self._buckets = [
+            _Bucket(os.path.join(path, f"bucket_{b:04d}.bin"), self.dim)
+            for b in range(int(n_buckets))
+        ]
+        # LRU: rid -> [row, acc, dirty]
+        self._cache: OrderedDict[int, list] = OrderedDict()
+
+    # ------------------------------------------------------------ internals
+    def _bucket(self, rid):
+        return self._buckets[rid % len(self._buckets)]
+
+    def _load(self, rid):
+        """Row into cache (from disk or fresh); returns the cache slot."""
+        slot = self._cache.get(rid)
+        if slot is not None:
+            self._cache.move_to_end(rid)
+            return slot
+        rec = self._bucket(rid).read(rid)
+        if rec is None:
+            from . import _row_rng
+
+            row = self._init(_row_rng(rid), self.dim).astype(np.float32)
+            acc = np.zeros(self.dim, np.float32)
+            if self._wt:
+                self._bucket(rid).write(rid, row, acc, sync=True)
+        else:
+            row, acc = rec
+        slot = [row, acc, rec is None and not self._wt]
+        self._cache[rid] = slot
+        self._evict()
+        return slot
+
+    def _evict(self):
+        while len(self._cache) > self._cap:
+            rid, (row, acc, dirty) = self._cache.popitem(last=False)
+            if dirty:
+                self._bucket(rid).write(rid, row, acc)
+
+    # ------------------------------------------------------------- core API
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, rid in enumerate(ids):
+                out[i] = self._load(int(rid))[0]
+        return out
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                slot = self._load(rid)
+                row, acc, _ = slot
+                if self._opt == "adagrad":
+                    acc += g * g
+                    row -= self._lr * g / (np.sqrt(acc) + 1e-8)
+                else:  # sgd
+                    row -= self._lr * g
+                if self._wt:
+                    self._bucket(rid).write(rid, row, acc, sync=True)
+                    slot[2] = False
+                else:
+                    slot[2] = True
+
+    def push_delta(self, ids, deltas):
+        """row -= delta (geo-SGD merge; bypasses the optimizer rule)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            for rid, d in zip(ids, deltas):
+                rid = int(rid)
+                slot = self._load(rid)
+                slot[0] -= d
+                if self._wt:
+                    self._bucket(rid).write(rid, slot[0], slot[1], sync=True)
+                    slot[2] = False
+                else:
+                    slot[2] = True
+
+    # ------------------------------------------------------- mgmt / durability
+    def flush(self):
+        with self._lock:
+            for rid, slot in self._cache.items():
+                if slot[2]:
+                    self._bucket(rid).write(rid, slot[0], slot[1])
+                    slot[2] = False
+            for b in self._buckets:
+                b.fp.flush()
+                os.fsync(b.fp.fileno())
+
+    def close(self):
+        self.flush()
+        with self._lock:
+            for b in self._buckets:
+                b.close()
+
+    def n_rows(self):
+        with self._lock:
+            on_disk = set()
+            for b in self._buckets:
+                on_disk.update(b.ids())
+            on_disk.update(self._cache)
+            return len(on_disk)
+
+    def cached_rows(self):
+        with self._lock:
+            return len(self._cache)
+
+    def state_dict(self):
+        """Full materialization — for parity with SparseTable / checkpoints
+        of SMALL tables; big tables should be copied at the file level."""
+        self.flush()
+        with self._lock:
+            rows, acc = {}, {}
+            for b in self._buckets:
+                for rid in b.ids():
+                    r, a = b.read(rid)
+                    rows[rid], acc[rid] = r, a
+            for rid, slot in self._cache.items():
+                rows[rid], acc[rid] = slot[0].copy(), slot[1].copy()
+            return {"rows": rows, "acc": acc}
+
+    def set_state_dict(self, state):
+        with self._lock:
+            self._cache.clear()
+            for rid, row in state["rows"].items():
+                a = state.get("acc", {}).get(rid)
+                self._bucket(int(rid)).write(
+                    int(rid), row,
+                    a if a is not None else np.zeros(self.dim, np.float32))
+            self.flush()
+
+
+class AsyncPsClient:
+    """Asynchronous push with a bounded staleness window.
+
+    push() enqueues and returns immediately; a background thread applies
+    updates in order.  pull() waits only until at most `max_staleness`
+    pushes are pending — the async-SGD staleness bound (reference async
+    mode; max_staleness=0 degenerates to fully-synchronous)."""
+
+    def __init__(self, client, max_staleness=4):
+        self._client = client
+        self._limit = int(max_staleness)
+        self._q: queue.Queue = queue.Queue()
+        self._err = None
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            try:
+                self._client.push(*item)
+            except Exception as e:  # surfaced on the next pull/push
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending_error(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def pending(self):
+        return self._q.unfinished_tasks
+
+    def push(self, ids, grads):
+        self._raise_pending_error()
+        self._q.put((np.asarray(ids), np.asarray(grads)))
+
+    def pull(self, ids):
+        # staleness bound: let the worker drain to within the window
+        while self.pending() > self._limit:
+            import time
+
+            time.sleep(0.001)
+        self._raise_pending_error()
+        return self._client.pull(ids)
+
+    def wait(self):
+        self._q.join()
+        self._raise_pending_error()
+
+    def close(self):
+        self._q.put(None)
+        self._q.join()
+
+
+class GeoPsClient:
+    """Geo-SGD: train against a local copy, push accumulated row DELTAS
+    every `geo_steps` barriers, then refresh the touched rows (reference
+    geo strategy: delta push beats gradient push for its staleness class)."""
+
+    def __init__(self, client, dim, geo_steps=8, lr=0.01, optimizer="sgd"):
+        from . import SparseTable
+
+        self._client = client
+        self._local = SparseTable(dim, optimizer=optimizer, lr=lr,
+                                  name="geo_local")
+        # local rows initialize FROM the global table on first touch
+        self._local.pull = self._pull_into_local(self._local.pull)
+        self._base: dict[int, np.ndarray] = {}
+        self._geo = int(geo_steps)
+        self._step = 0
+        self.dim = int(dim)
+
+    def _pull_into_local(self, orig_pull):
+        def pull(ids):
+            ids_arr = np.asarray(ids, np.int64).reshape(-1)
+            missing = [int(i) for i in ids_arr if int(i) not in self._local._rows]
+            if missing:
+                rows = self._client.pull(np.asarray(missing, np.int64))
+                with self._local._lock:
+                    for rid, row in zip(missing, rows):
+                        self._local._rows[rid] = row.copy()
+                        self._base[rid] = row.copy()
+            return orig_pull(ids)
+
+        return pull
+
+    def pull(self, ids):
+        return self._local.pull(ids)
+
+    def push(self, ids, grads):
+        self._local.push(ids, grads)
+        self._step += 1
+        if self._step % self._geo == 0:
+            self.sync()
+
+    def sync(self):
+        """Push deltas of every touched row; refresh local from global."""
+        with self._local._lock:
+            touched = {rid: row for rid, row in self._local._rows.items()
+                       if rid in self._base}
+        if not touched:
+            return
+        ids = np.asarray(sorted(touched), np.int64)
+        # raw row deltas (reference geo strategy pushes deltas, not grads)
+        deltas = np.stack([self._base[int(i)] - touched[int(i)] for i in ids])
+        self._client.push_delta(ids, deltas)
+        fresh = self._client.pull(ids)
+        with self._local._lock:
+            for rid, row in zip(ids, fresh):
+                self._local._rows[int(rid)] = row.copy()
+                self._base[int(rid)] = row.copy()
+
