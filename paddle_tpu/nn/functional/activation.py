@@ -27,7 +27,12 @@ log_sigmoid = unary("log_sigmoid", jax.nn.log_sigmoid)
 
 def gelu(x, approximate=False, name=None):
     x = ensure_tensor(x)
-    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=bool(approximate)), x)
+    # approximate rides kwargs (static, recorded on the Operator) so the
+    # Pallas matmul-epilogue fusion pattern can read which gelu this is
+    return apply(
+        "gelu",
+        lambda v, approximate=False: jax.nn.gelu(v, approximate=approximate),
+        x, approximate=bool(approximate))
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):

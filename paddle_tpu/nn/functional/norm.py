@@ -16,7 +16,7 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
     nd = len(ns)
 
-    def _ln(v, *rest):
+    def _ln(v, *rest, epsilon=1e-05):
         axes = tuple(range(v.ndim - nd, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.mean(jnp.square(v - mean), axis=axes, keepdims=True)
@@ -29,7 +29,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
         return out
 
     extra = [ensure_tensor(t) for t in (weight, bias) if t is not None]
-    return apply("layer_norm", _ln, x, *extra)
+    # epsilon as a static kwarg: recorded on the Operator, so fusion
+    # patterns (AddNormPattern) can read it
+    return apply("layer_norm", _ln, x, *extra, epsilon=float(epsilon))
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
@@ -37,7 +39,7 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     it as incubate fused_rms_norm."""
     x = ensure_tensor(x)
 
-    def _rms(v, *rest):
+    def _rms(v, *rest, epsilon=1e-6):
         var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
         out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
         if rest:
@@ -45,7 +47,7 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
         return out
 
     extra = [ensure_tensor(weight)] if weight is not None else []
-    return apply("rms_norm", _rms, x, *extra)
+    return apply("rms_norm", _rms, x, *extra, epsilon=float(epsilon))
 
 
 def batch_norm(

@@ -55,4 +55,5 @@ from .flash_attention import flash_attention, flash_attention_reference  # noqa:
 from .fused_norm import fused_rms_norm, fused_layer_norm  # noqa: E402,F401
 from .fused_rope import fused_rotary_position_embedding  # noqa: E402,F401
 from .swiglu import swiglu  # noqa: E402,F401
+from .matmul_epilogue import matmul_bias_act  # noqa: E402,F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: E402,F401

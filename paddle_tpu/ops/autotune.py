@@ -426,6 +426,43 @@ def tune_swiglu(rows=4096, cols=11008, dtype="bfloat16", **kw):
                        (x, y), **kw)
 
 
+def matmul_epilogue_candidates(M, K, N):
+    out = []
+    for bm in (128, 256, 512):
+        for bn in (128, 256):
+            for bk in (256, 512, 1024):
+                if (bm <= M and M % bm == 0 and bn <= N and N % bn == 0
+                        and bk <= K and K % bk == 0
+                        # f32 acc + double-buffered in/out blocks
+                        and (bm * bn * 4 + 2 * (bm * bk + bk * bn + bm * bn) * 2)
+                        <= _VMEM_BUDGET):
+                    out.append({"bm": bm, "bk": bk, "bn": bn})
+    return out or [{"bm": min(M, 128), "bk": K, "bn": min(N, 128)}]
+
+
+def tune_matmul_epilogue(m=4096, k=4096, n=4096, dtype="bfloat16", **kw):
+    import jax
+    import jax.numpy as jnp
+
+    import importlib
+
+    me = importlib.import_module("paddle_tpu.ops.matmul_epilogue")
+
+    jd = jnp.dtype(dtype)
+    key = {"m": m, "k": k, "n": n, "dtype": jd.name}
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, k), jd)
+    w = jax.random.normal(jax.random.PRNGKey(1), (k, n), jd)
+    b = jax.random.normal(jax.random.PRNGKey(2), (n,), jd)
+
+    def build(cfg):
+        tiles = (cfg["bm"], cfg["bk"], cfg["bn"])
+        return jax.jit(lambda a, ww, bb: me._fused_2d(a, ww, bb, "gelu",
+                                                      tiles=tiles))
+
+    return tune_kernel("matmul_epilogue", key, build,
+                       matmul_epilogue_candidates(m, k, n), (x, w, b), **kw)
+
+
 # ---------------------------------------------------------------------------
 # CLI: bounded-time sweep over the standard shape set
 
@@ -443,6 +480,10 @@ _STANDARD_SHAPES = {
         dict(rows=4096, cols=5504), dict(rows=8192, cols=5632),
         dict(rows=4096, cols=11008),
     ],
+    "matmul": [
+        dict(m=4096, k=2048, n=8192), dict(m=4096, k=4096, n=4096),
+        dict(m=8192, k=2048, n=2048),
+    ],
 }
 
 
@@ -450,7 +491,8 @@ def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser(description="Pallas kernel tile autotuner")
-    p.add_argument("--kernel", default="all", choices=["all", "flash", "norm", "swiglu"])
+    p.add_argument("--kernel", default="all",
+                   choices=["all", "flash", "norm", "swiglu", "matmul"])
     p.add_argument("--budget-seconds", type=float, default=300.0,
                    help="total wall budget; stops between candidates")
     p.add_argument("--dtype", default="bfloat16")
@@ -463,7 +505,8 @@ def main(argv=None):
     t0 = time.perf_counter()
     slug = device_kind_slug()
     print(f"tuning for device kind: {slug}")
-    runners = {"flash": tune_flash, "norm": tune_fused_norm, "swiglu": tune_swiglu}
+    runners = {"flash": tune_flash, "norm": tune_fused_norm,
+               "swiglu": tune_swiglu, "matmul": tune_matmul_epilogue}
     todo = [args.kernel] if args.kernel != "all" else list(runners)
     for name in todo:
         for shape in _STANDARD_SHAPES[name]:

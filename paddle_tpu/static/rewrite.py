@@ -169,15 +169,16 @@ class PatternRewritePass:
         return total
 
 
-def _make_op(type_, fn, var_vids, template_op):
-    """New Operator producing template_op's outputs from var inputs."""
+def _make_op(type_, fn, var_vids, template_op, kwargs=None):
+    """New Operator producing template_op's outputs from var inputs.
+    kwargs are METADATA for later passes (the fn has them baked in)."""
     from paddle_tpu.static.program import Operator
 
     return Operator(
         type=type_,
         fn=fn,
         arg_spec=[("var", vid) for vid in var_vids],
-        kwargs={},
+        kwargs=dict(kwargs or {}),
         out_vids=list(template_op.out_vids),
         out_tree=template_op.out_tree,
     )
@@ -392,7 +393,8 @@ class RMSNormPattern(RewritePattern):
 
             return fused_rms_norm(x, w, epsilon=eps)
 
-        graph.replace_op(op, _make_op("fused_rms_norm", fused, [x_vid, w_vid], op))
+        graph.replace_op(op, _make_op("fused_rms_norm", fused, [x_vid, w_vid],
+                                      op, kwargs={"epsilon": eps}))
         return True
 
 
@@ -426,6 +428,184 @@ class SwiGLUPattern(RewritePattern):
         return False
 
 
+def _entry_shape(graph, entry):
+    if entry[0] == "var":
+        return graph.shape(entry[1])
+    import numpy as _np
+
+    try:
+        return tuple(_np.shape(entry[1]))
+    except Exception:
+        return None
+
+
+def _mixed(entries):
+    """(var_vids, rebuild): rebuild(var_vals) -> full positional values with
+    const entries baked in (weights captured as concrete tensors record as
+    consts, not vars)."""
+    var_vids = [e[1] for e in entries if e[0] == "var"]
+
+    def rebuild(var_vals):
+        it = iter(var_vals)
+        return [next(it) if e[0] == "var" else e[1] for e in entries]
+
+    return var_vids, rebuild
+
+
+class MatmulEpiloguePattern(RewritePattern):
+    """act(linear(x, w[, b]))  ⇒  Pallas matmul_bias_act
+    (ops/matmul_epilogue.py — the epilogue runs on the f32 accumulator in
+    VMEM; the pre-activation never round-trips HBM).
+
+    Anchored at the activation (gelu/silu/relu) whose single input is the
+    single-use output of a linear/matmul op."""
+
+    name = "matmul_epilogue_fuse"
+    root_type = None  # three root types; filtered in match
+    _ROOTS = {"gelu", "silu", "relu"}
+
+    def match_and_rewrite(self, op, graph):
+        base = _base_type(op.type)
+        if base not in self._ROOTS:
+            return False
+        if len(op.arg_spec) != 1 or op.arg_spec[0][0] != "var":
+            return False
+        if base == "silu" and op.out_vids:
+            # silu feeding a multiply is SwiGLUPattern's subgraph (the
+            # LLaMA-canonical kernel with analytic backward): stand down
+            cons = graph.consumers.get(op.out_vids[0], [])
+            if any(_base_type(c.type) == "multiply" for c in cons):
+                return False
+        pre_vid = op.arg_spec[0][1]
+        if not graph.single_use(pre_vid):
+            return False
+        mm = graph.def_op(pre_vid)
+        if mm is None or _base_type(mm.type) not in ("linear", "matmul"):
+            return False
+        if len(mm.arg_spec) not in (2, 3):
+            return False
+        x_entry, w_entry = mm.arg_spec[0], mm.arg_spec[1]
+        b_entry = mm.arg_spec[2] if len(mm.arg_spec) == 3 else None
+        if x_entry[0] != "var":  # activations are always program values
+            return False
+        w_shape = _entry_shape(graph, w_entry)
+        x_shape = graph.shape(x_entry[1])
+        if not w_shape or not x_shape or len(w_shape) != 2 or x_shape[-1] != w_shape[0]:
+            return False
+        if b_entry is not None and _entry_shape(graph, b_entry) != (w_shape[1],):
+            return False
+        act = base
+        if base == "gelu" and op.kwargs.get("approximate"):
+            act = "gelu_tanh"
+
+        entries = [x_entry, w_entry] + ([b_entry] if b_entry is not None else [])
+        var_vids, rebuild = _mixed(entries)
+        has_bias = b_entry is not None
+
+        def fused(*var_vals, act=act, has_bias=has_bias, rebuild=rebuild):
+            from paddle_tpu.ops import matmul_bias_act
+
+            full = rebuild(var_vals)
+            x, w = full[0], full[1]
+            b = full[2] if has_bias else None
+            return matmul_bias_act(x, w, b, act)
+
+        graph.replace_op(op, _make_op("matmul_epilogue", fused, var_vids, op))
+        return True
+
+
+class AddNormPattern(RewritePattern):
+    """norm(x + residual)  ⇒  fused residual-add norm (ops/fused_norm.py
+    residual= contract) — the transformer residual-stream chain.
+
+    Anchors on fused_rms_norm (produced by RMSNormPattern, so this fires on
+    the same pass's fixpoint iteration), raw rms_norm, or layer_norm, whose
+    input comes from an add of two same-shape tensors.  The fused op emits
+    BOTH the normed output and the sum (the residual stream usually feeds
+    the next block too), replacing the add at its own position so every
+    consumer of the sum still reads a defined value."""
+
+    name = "add_norm_fuse"
+    root_type = None
+    _ROOTS = {"fused_rms_norm", "rms_norm", "layer_norm"}
+
+    def match_and_rewrite(self, op, graph):
+        import jax as _jax
+
+        base = _base_type(op.type)
+        if base not in self._ROOTS:
+            return False
+        if op.arg_spec[0][0] != "var":
+            return False
+        if base == "layer_norm":
+            if len(op.arg_spec) != 3:  # x, weight, bias (elementwise affine)
+                return False
+            x_vid = op.arg_spec[0][1]
+            w_entry, b_entry = op.arg_spec[1], op.arg_spec[2]
+        else:
+            if len(op.arg_spec) != 2:  # x, weight
+                return False
+            x_vid = op.arg_spec[0][1]
+            w_entry, b_entry = op.arg_spec[1], None
+        add = graph.def_op(x_vid, "add")
+        if add is None:
+            return False
+        if len(add.arg_spec) != 2 or any(s[0] != "var" for s in add.arg_spec):
+            return False
+        a_vid, r_vid = add.arg_spec[0][1], add.arg_spec[1][1]
+        if graph.shape(a_vid) != graph.shape(r_vid):
+            return False
+        eps = op.kwargs.get("epsilon", op.kwargs.get("eps"))
+        if eps is None:
+            return False  # can't recover the recorded epsilon: don't fuse
+
+        # the fused op replaces the ADD at its position: every other VAR
+        # input (norm weight/bias) must already be defined there
+        block = graph.block
+        add_idx = block.ops.index(add)
+
+        def _defined_before(entry):
+            if entry is None or entry[0] != "var":
+                return True
+            prod = graph.producer.get(entry[1])
+            return prod is None or block.ops.index(prod) < add_idx
+
+        if not (_defined_before(w_entry) and _defined_before(b_entry)):
+            return False
+
+        from paddle_tpu.static.program import Operator
+
+        entries = [("var", a_vid), ("var", r_vid), w_entry] + (
+            [b_entry] if b_entry is not None else [])
+        var_vids, rebuild = _mixed(entries)
+        is_ln = base == "layer_norm"
+
+        def fused(*var_vals, eps=eps, is_ln=is_ln, rebuild=rebuild):
+            from paddle_tpu.ops import fused_layer_norm, fused_rms_norm
+
+            full = rebuild(var_vals)
+            if is_ln:
+                out, s = fused_layer_norm(full[0], full[2], full[3],
+                                          residual=full[1], epsilon=eps)
+            else:
+                out, s = fused_rms_norm(full[0], full[2], residual=full[1],
+                                        epsilon=eps)
+            return s, out
+
+        new_op = Operator(
+            "add_" + ("layer_norm" if is_ln else "rms_norm"),
+            fused,
+            [("var", v) for v in var_vids],
+            {"epsilon": eps},
+            [add.out_vids[0], op.out_vids[0]],
+            _jax.tree_util.tree_structure((0, 0)),
+        )
+        block.ops[add_idx] = new_op
+        block.ops.remove(op)
+        graph.program.version += 1
+        return True
+
+
 class PallasFusionPass(PatternRewritePass):
     """The default Pallas-substitution pipeline (SURVEY §7's CINN analog)."""
 
@@ -433,6 +613,7 @@ class PallasFusionPass(PatternRewritePass):
 
     def __init__(self, fetch_vids=()):
         super().__init__(
-            [FlashAttentionPattern(), RMSNormPattern(), SwiGLUPattern()],
+            [FlashAttentionPattern(), RMSNormPattern(), SwiGLUPattern(),
+             MatmulEpiloguePattern(), AddNormPattern()],
             fetch_vids=fetch_vids,
         )

@@ -188,7 +188,8 @@ def test_vanilla_llama_block_gets_flash_substituted():
     got = exe.run(prog, feed={"x": x_np}, fetch_list=[out])[0]
     types = _optypes(prog)
     assert "flash_attention" in types
-    assert types.count("fused_rms_norm") == 2
+    # the residual-stream norm upgrades further to add_rms_norm
+    assert types.count("fused_rms_norm") + types.count("add_rms_norm") == 2
     assert "swiglu" in types
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
@@ -282,3 +283,139 @@ def test_fp16_rewrite_then_fusion_still_substitutes_in_low_dtype():
     # bf16-tolerance match against the fp32 unfused program
     for r, g_ in zip(ref, got):
         np.testing.assert_allclose(r, g_, rtol=3e-2, atol=3e-2)
+
+
+# ------------------------------------------------- matmul epilogue / add-norm
+
+def _capture(fn, *feed_shapes):
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main):
+        feeds = [static.data(f"x{i}", list(s), "float32")
+                 for i, s in enumerate(feed_shapes)]
+        out = fn(*feeds)
+    return main, feeds, out
+
+
+def test_matmul_epilogue_pattern_fires_and_matches():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import static
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    paddle.seed(0)
+    lin = nn.Linear(64, 128)
+
+    main, (x,), out = _capture(lambda v: F.gelu(lin(v)), (8, 64))
+    exe = static.Executor()
+    xv = np.random.default_rng(0).standard_normal((8, 64)).astype(np.float32)
+    (ref,) = exe.run(main, feed={"x0": xv}, fetch_list=[out])
+
+    n = PallasFusionPass([out._vid]).apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "matmul_epilogue" in types, (n, types)
+    (got,) = static.Executor().run(main, feed={"x0": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_matmul_epilogue_gelu_tanh_variant():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import static
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    paddle.seed(1)
+    lin = nn.Linear(64, 128)
+    main, (x,), out = _capture(lambda v: F.gelu(lin(v), approximate=True), (8, 64))
+    exe = static.Executor()
+    xv = np.random.default_rng(1).standard_normal((8, 64)).astype(np.float32)
+    (ref,) = exe.run(main, feed={"x0": xv}, fetch_list=[out])
+    PallasFusionPass([out._vid]).apply(main)
+    ep = next(op for op in main.global_block().ops
+              if op.type == "matmul_epilogue")
+    (got,) = static.Executor().run(main, feed={"x0": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_add_norm_pattern_fuses_residual_stream():
+    """norm(x + residual) with the sum ALSO consumed later (the transformer
+    residual stream) — the fused op must emit both outputs."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import static
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    paddle.seed(2)
+    wv = np.random.default_rng(2).standard_normal(32).astype(np.float32)
+
+    def body(a, b):
+        w = paddle.to_tensor(wv)
+        h = a + b
+        normed = F.rms_norm(h, weight=w, epsilon=1e-5)
+        return normed * 2.0 + h  # h reused: the residual stream
+
+    main, feeds, out = _capture(body, (4, 32), (4, 32))
+    rng = np.random.default_rng(3)
+    av = rng.standard_normal((4, 32)).astype(np.float32)
+    bv = rng.standard_normal((4, 32)).astype(np.float32)
+    (ref,) = static.Executor().run(main, feed={"x0": av, "x1": bv},
+                                   fetch_list=[out])
+    n = PallasFusionPass([out._vid]).apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "add_rms_norm" in types, (n, types)
+    (got,) = static.Executor().run(main, feed={"x0": av, "x1": bv},
+                                   fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_add_layer_norm_pattern():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import static
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    paddle.seed(3)
+    rng = np.random.default_rng(4)
+    wv = rng.standard_normal(32).astype(np.float32)
+    bv_ = rng.standard_normal(32).astype(np.float32)
+
+    def body(a, b):
+        w = paddle.to_tensor(wv)
+        bb = paddle.to_tensor(bv_)
+        return F.layer_norm(a + b, 32, weight=w, bias=bb, epsilon=1e-5)
+
+    main, feeds, out = _capture(body, (4, 32), (4, 32))
+    av = rng.standard_normal((4, 32)).astype(np.float32)
+    bv = rng.standard_normal((4, 32)).astype(np.float32)
+    (ref,) = static.Executor().run(main, feed={"x0": av, "x1": bv},
+                                   fetch_list=[out])
+    PallasFusionPass([out._vid]).apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "add_layer_norm" in types, types
+    (got,) = static.Executor().run(main, feed={"x0": av, "x1": bv},
+                                   fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_epilogue_patterns_fire_on_bert_program():
+    """The reference criterion: the new patterns fire on a captured
+    real-model program (BERT: gelu FFN + residual layer-norms)."""
+    from paddle_tpu import static
+    from paddle_tpu.models import BertForSequenceClassification, bert_tiny
+    from paddle_tpu.static.rewrite import PallasFusionPass
+
+    paddle.seed(0)
+    m = BertForSequenceClassification(bert_tiny(), num_classes=2)
+    m.eval()
+    main = static.Program()
+    with static.program_guard(main):
+        ids = static.data("ids", [2, 16], "int32")
+        out = m(ids)
+        out = out[0] if isinstance(out, (tuple, list)) else out
+    ids_v = np.random.default_rng(0).integers(1, 500, (2, 16)).astype(np.int32)
+    (ref,) = static.Executor().run(main, feed={"ids": ids_v}, fetch_list=[out])
+    PallasFusionPass([out._vid]).apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "matmul_epilogue" in types, set(types)
+    assert "add_layer_norm" in types, set(types)
+    (got,) = static.Executor().run(main, feed={"ids": ids_v}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)

@@ -97,6 +97,24 @@ def _cases(quick=False):
         return (jax.jit(lambda t, i: jnp.take(t, i, axis=0)), (tbl, ids),
                 0, B * L * H * isz * 2)
 
+    def matmul_epilogue_fused():
+        from paddle_tpu.ops import matmul_bias_act
+
+        x = jax.random.normal(k0, (S, H), dt)
+        w = jax.random.normal(k0, (H, H), dt)
+        b = jnp.zeros((H,), dt)
+        return (jax.jit(lambda x, w, b: matmul_bias_act(x, w, b, "gelu")),
+                (x, w, b), 2 * S * H * H, (S * H * 2 + H * H) * isz)
+
+    def matmul_epilogue_unfused():
+        # the XLA chain the fusion replaces — same shapes, same JSON block,
+        # so the gate can compare fused vs unfused directly on chip
+        x = jax.random.normal(k0, (S, H), dt)
+        w = jax.random.normal(k0, (H, H), dt)
+        b = jnp.zeros((H,), dt)
+        return (jax.jit(lambda x, w, b: jax.nn.gelu(x @ w + b, approximate=False)),
+                (x, w, b), 2 * S * H * H, (S * H * 2 + H * H) * isz)
+
     def adamw_update():
         n = S * H
         p, g, m, v = (jax.random.normal(kk, (n,), jnp.float32)
@@ -111,7 +129,8 @@ def _cases(quick=False):
 
     return [(f.__name__, f) for f in (
         matmul, batched_matmul, softmax, layer_norm, rms_norm, swiglu,
-        flash_attention, embedding, adamw_update)]
+        flash_attention, embedding, matmul_epilogue_fused,
+        matmul_epilogue_unfused, adamw_update)]
 
 
 def run(quick=False, iters=3):
