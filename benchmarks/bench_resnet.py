@@ -19,6 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     import jax
 
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     on_accel = jax.devices()[0].platform != "cpu"
 
@@ -60,6 +62,7 @@ def main():
 
         return batch / (time_step_ms(lambda: step(x, y), inner=n_iters) / 1e3)
 
+    amp_level = "O1"
     if on_accel:
         # batch sweep: the MXU wants large batches (the A100 reference point
         # runs B=256-class AMP batches); pick the best-throughput config
@@ -82,6 +85,31 @@ def main():
         B = best_b
         if images_per_sec == 0.0:
             images_per_sec = measure(B, iters)
+        # O2 arm: bf16 parameters + fp32 master weights — less cast traffic
+        # per step than O1's per-op casts (the A100 reference point is full
+        # AMP); keep whichever measures faster at the winning batch
+        try:
+            model2 = resnet50()
+            opt2 = paddle.optimizer.Momentum(0.1, parameters=model2.parameters())
+            model2, opt2 = paddle.amp.decorate(model2, opt2, level="O2")
+
+            def loss_fn2(m, x, y):
+                with paddle.amp.auto_cast(enable=True, level="O2"):
+                    return ce(m(x), y)
+
+            step2 = TrainStep(model2, opt2, loss_fn2)
+            x = paddle.to_tensor(rng.standard_normal((B, 3, H, H)).astype(np.float32))
+            y = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int32))
+            step2(x, y)
+            hard_sync(step2(x, y))
+            from paddle_tpu.device import time_step_ms
+
+            ips_o2 = B / (time_step_ms(lambda: step2(x, y), inner=iters) / 1e3)
+            if ips_o2 > images_per_sec:
+                images_per_sec, amp_level = ips_o2, "O2"
+        except Exception as e:  # O2 arm is additive: never sinks the bench
+            print(f"bench_resnet: O2 arm failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
     else:
         images_per_sec = measure(B, iters)
 
@@ -100,6 +128,7 @@ def main():
         "unit": "images/s",
         "vs_baseline": round(vs_baseline, 4),
         "batch": B,
+        "amp": amp_level,
     }))
 
 

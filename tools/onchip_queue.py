@@ -38,6 +38,7 @@ QUEUE = [
                   "--budget-seconds", "420"], 900),
     ("bench_llama", [sys.executable, "bench.py"], 1800),
     ("bench_resnet", [sys.executable, "benchmarks/bench_resnet.py"], 1800),
+    ("audit_resnet", [sys.executable, "benchmarks/audit_resnet.py"], 1800),
     ("bench_bert", [sys.executable, "benchmarks/bench_bert.py"], 1200),
     ("bench_moe", [sys.executable, "benchmarks/bench_moe.py"], 1200),
     ("bench_decode", [sys.executable, "benchmarks/bench_decode.py"], 1200),
