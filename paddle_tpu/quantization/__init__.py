@@ -20,7 +20,9 @@ from paddle_tpu.nn.layer.layers import Layer
 __all__ = [
     "QuantConfig", "BaseQuanter", "BaseObserver", "quanter", "QAT", "PTQ",
     "AbsMaxObserver", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
-    "QuantedConv2D",
+    "QuantedConv2D", "ChannelWiseAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMax", "PercentileObserver",
+    "Int8DeployedLinear", "Int8DeployedConv2D",
 ]
 
 
@@ -109,6 +111,103 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
 
     def scales(self):
         return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+
+class ChannelWiseAbsMaxObserver(BaseObserver):
+    """Per-output-channel abs-max (reference observers — the weight
+    observer for linear/conv: channel-wise scales quantize far tighter
+    than one tensor-wide scale)."""
+
+    def __init__(self, quant_bits=8, quant_axis=None):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._max = None
+
+    def quant_axis(self):
+        return self._axis if self._axis is not None else -1
+
+    def _reduce(self, xv):
+        # default axis: the OUT channel — last dim for [in, out] linear
+        # weights, dim 0 for [out, in, kh, kw] conv weights
+        ax = self._axis
+        if ax is None:
+            ax = 0 if xv.ndim == 4 else xv.ndim - 1
+        axes = tuple(i for i in range(xv.ndim) if i != ax)
+        return jnp.max(jnp.abs(xv), axis=axes)
+
+    def forward(self, x):
+        import numpy as np
+
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = np.asarray(self._reduce(xv), np.float32)  # host state
+        self._max = cur if self._max is None else np.maximum(self._max, cur)
+        return x
+
+    def scales(self):
+        return Tensor(jnp.maximum(jnp.asarray(self._max, jnp.float32), 1e-9))
+
+
+class FakeQuanterChannelWiseAbsMax(BaseQuanter):
+    """Channel-wise QAT fake quanter (reference
+    quanters/abs_max.py FakeQuanterChannelWiseAbsMaxObserver): per-output
+    -channel scale tracked as a running max during training; the fake
+    quant broadcasts the channel scales."""
+
+    def __init__(self, quant_bits=8, quant_axis=None, dtype="float32", name=None):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._scale = None
+
+    def quant_axis(self):
+        return self._axis if self._axis is not None else -1
+
+    def _axis_for(self, xv):
+        if self._axis is not None:
+            return self._axis
+        return 0 if xv.ndim == 4 else xv.ndim - 1
+
+    def forward(self, x):
+        import numpy as np
+
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        ax = self._axis_for(xv)
+        axes = tuple(i for i in range(xv.ndim) if i != ax)
+        if self.training:
+            # host-side running max (the module's eager-observer contract)
+            cur = np.asarray(jnp.max(jnp.abs(xv), axis=axes), np.float32)
+            self._scale = cur if self._scale is None else np.maximum(self._scale, cur)
+        scale = jnp.maximum(jnp.asarray(
+            self._scale if self._scale is not None else np.ones(xv.shape[ax]),
+            xv.dtype), 1e-9)
+        shape = [1] * xv.ndim
+        shape[ax] = xv.shape[ax]
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        return Tensor(_fake_quant(xv, scale.reshape(shape), qmax))
+
+    def scales(self):
+        return Tensor(jnp.maximum(jnp.asarray(self._scale, jnp.float32), 1e-9))
+
+
+class PercentileObserver(BaseObserver):
+    """Percentile activation observer (reference observers hist/percentile
+    family): running EMA of a high quantile of |x| — robust to outlier
+    activations that would blow an abs-max scale."""
+
+    def __init__(self, quant_bits=8, percentile=99.9, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self._p = float(percentile)
+        self._r = float(moving_rate)
+        self._scale = None
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.percentile(jnp.abs(xv.astype(jnp.float32)), self._p))
+        self._scale = cur if self._scale is None else (
+            self._r * self._scale + (1 - self._r) * cur)
+        return x
+
+    def scales(self):
+        return Tensor(jnp.asarray(max(self._scale or 0.0, 1e-9), jnp.float32))
 
 
 class _QuanterFactory:
@@ -216,9 +315,140 @@ def _swap_layers(model, config, factory_filter):
     return model
 
 
+def _funnel_fake_quant(x, scale, qmax):
+    """Frozen-scale fake quant through the op funnel (static-capturable)."""
+    from paddle_tpu._core.autograd import apply
+
+    def _fq(v):
+        s = jnp.maximum(jnp.asarray(scale, v.dtype), 1e-9)
+        return jnp.clip(jnp.round(v / s * qmax), -qmax - 1, qmax) * s / qmax
+
+    return apply("fake_quant", _fq, x)
+
+
+class Int8DeployedLinear(Layer):
+    """Deployed weight-only int8 linear: weight stored AS int8 with a per-
+    output-channel scale (the nn.quant weight_only serving contract); the
+    optional frozen activation fake-quant reproduces QAT eval numerics.
+    jit.save of a converted model bakes the int8 weights into the
+    artifact."""
+
+    def __init__(self, q, scale, bias=None, act_scale=None, act_bits=8,
+                 wt_bits=8):
+        super().__init__()
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q, jnp.int8)))
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.asarray(scale, jnp.float32)))
+        self._bias = bias
+        self._act_scale = None if act_scale is None else float(act_scale)
+        self._act_qmax = float(2 ** (act_bits - 1) - 1)
+        self._wt_qmax = float(2 ** (wt_bits - 1) - 1)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self._act_scale is not None:
+            x = _funnel_fake_quant(x, self._act_scale, self._act_qmax)
+        w = self.weight_int8.astype("float32") * (
+            self.weight_scale / self._wt_qmax)
+        out = paddle.matmul(x, w)
+        if self._bias is not None:
+            out = out + self._bias
+        return out
+
+
+class Int8DeployedConv2D(Layer):
+    """Deployed weight-only int8 conv2d (per-out-channel scales)."""
+
+    def __init__(self, q, scale, bias, conv_cfg, act_scale=None, act_bits=8,
+                 wt_bits=8):
+        super().__init__()
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q, jnp.int8)))
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.asarray(scale, jnp.float32)))
+        self._bias = bias
+        self._cfg = dict(conv_cfg)  # stride/padding/dilation/groups
+        self._act_scale = None if act_scale is None else float(act_scale)
+        self._act_qmax = float(2 ** (act_bits - 1) - 1)
+        self._wt_qmax = float(2 ** (wt_bits - 1) - 1)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        if self._act_scale is not None:
+            x = _funnel_fake_quant(x, self._act_scale, self._act_qmax)
+        scale = self.weight_scale / self._wt_qmax
+        w = self.weight_int8.astype("float32") * scale.reshape([-1, 1, 1, 1])
+        return F.conv2d(x, w, bias=self._bias, **self._cfg)
+
+
+def _lower_wrapper(wrapper):
+    """_QuantedWrapper -> deployed int8 layer using the TRAINED observer
+    scales (not recomputed from the weights: QAT learned them)."""
+    import numpy as np
+
+    from paddle_tpu import nn
+
+    inner = wrapper._inner
+    wq = wrapper.weight_quanter
+    aq = wrapper.activation_quanter
+    if wq is None or not hasattr(inner, "weight"):
+        return None
+    bits = wq.bit_length()
+    qmax = float(2 ** (bits - 1) - 1)
+    w = np.asarray(inner.weight._value, np.float32)
+    scales = np.asarray(wq.scales()._value, np.float32)
+    act_scale = None
+    if aq is not None:
+        act_scale = float(np.asarray(aq.scales()._value))
+
+    if isinstance(inner, nn.Linear):
+        ax = w.ndim - 1
+        if scales.ndim == 0:  # tensor-wide quanter
+            scales = np.full((w.shape[ax],), float(scales), np.float32)
+        shape = [1] * w.ndim
+        shape[ax] = w.shape[ax]
+        q = np.clip(np.round(w / np.maximum(scales.reshape(shape), 1e-9) * qmax),
+                    -qmax - 1, qmax).astype(np.int8)
+        return Int8DeployedLinear(
+            q, scales, bias=getattr(inner, "bias", None),
+            act_scale=act_scale,
+            act_bits=aq.bit_length() if aq is not None else 8, wt_bits=bits)
+    if hasattr(nn, "Conv2D") and isinstance(inner, nn.Conv2D):
+        if scales.ndim == 0:
+            scales = np.full((w.shape[0],), float(scales), np.float32)
+        q = np.clip(np.round(w / np.maximum(scales.reshape(-1, 1, 1, 1), 1e-9)
+                             * qmax), -qmax - 1, qmax).astype(np.int8)
+        cfg = {"stride": getattr(inner, "_stride", 1),
+               "padding": getattr(inner, "_padding", 0),
+               "dilation": getattr(inner, "_dilation", 1),
+               "groups": getattr(inner, "_groups", 1)}
+        return Int8DeployedConv2D(
+            q, scales, getattr(inner, "bias", None), cfg,
+            act_scale=act_scale,
+            act_bits=aq.bit_length() if aq is not None else 8, wt_bits=bits)
+    return None
+
+
+def _convert_tree(model):
+    n = 0
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, _QuantedWrapper):
+            lowered = _lower_wrapper(sub)
+            if lowered is not None:
+                model._sub_layers[name] = lowered
+                n += 1
+                continue
+        n += _convert_tree(sub)
+    return n
+
+
 class QAT:
     """Quantization-aware training driver (reference quantization/qat.py:24):
-    quantize() swaps quantable layers for fake-quant wrappers."""
+    quantize() swaps quantable layers for fake-quant wrappers; convert()
+    lowers the trained wrappers to DEPLOYED int8 layers (int8 weights +
+    trained per-channel scales + frozen activation quant) — jit.save of
+    the result is the deployable int8 artifact."""
 
     def __init__(self, config):
         self._config = config
@@ -231,18 +461,22 @@ class QAT:
         return _swap_layers(model, self._config, lambda f: f)
 
     def convert(self, model, inplace=False):
-        """Freeze observers into plain dequant-scale layers (keeps the fake
-        quant path; deployment lowering happens at jit.save)."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         for sub in model.sublayers(True) if hasattr(model, "sublayers") else []:
             if isinstance(sub, (BaseQuanter, BaseObserver)):
                 sub.eval()
+        _convert_tree(model)
+        model.eval()
         return model
 
 
 class PTQ:
     """Post-training quantization driver (reference quantization/ptq.py:22):
     quantize() installs observers; after calibration forwards, convert()
-    freezes scales."""
+    freezes scales and lowers to the same deployed int8 layers as QAT."""
 
     def __init__(self, config):
         self._config = config
@@ -255,7 +489,13 @@ class PTQ:
         return _swap_layers(model, self._config, lambda f: f)
 
     def convert(self, model, inplace=True):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         for sub in model.sublayers(True) if hasattr(model, "sublayers") else []:
             if isinstance(sub, (BaseQuanter, BaseObserver)):
                 sub.eval()
+        _convert_tree(model)
+        model.eval()
         return model

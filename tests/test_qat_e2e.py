@@ -1,0 +1,171 @@
+"""QAT end-to-end: fake-quant training -> convert() -> deployable int8
+artifact (VERDICT r4 missing #7).
+
+Reference: python/paddle/quantization/{qat,ptq}.py + imperative quant
+layers; observers per layer type via QuantConfig.add_type_config."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+from paddle_tpu.quantization import (
+    QAT, PTQ, ChannelWiseAbsMaxObserver, FakeQuanterChannelWiseAbsMax,
+    FakeQuanterWithAbsMaxObserver, Int8DeployedConv2D, Int8DeployedLinear,
+    PercentileObserver, QuantConfig, quanter,
+)
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(64, 256)
+        self.fc2 = nn.Linear(256, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+def _qat_config():
+    cfg = QuantConfig(
+        activation=quanter(FakeQuanterWithAbsMaxObserver, moving_rate=0.9),
+        weight=quanter(FakeQuanterChannelWiseAbsMax, quant_bits=8),
+    )
+    return cfg
+
+
+def test_qat_trains_converts_and_deploys(tmp_path):
+    paddle.seed(0)
+    model = _Net()
+    q = QAT(_qat_config())
+    qmodel = q.quantize(model, inplace=False)
+
+    # the wrapped layers really fake-quantize per-channel
+    from paddle_tpu.quantization import _QuantedWrapper
+
+    wrappers = [m for m in qmodel.sublayers(True) if isinstance(m, _QuantedWrapper)]
+    assert len(wrappers) == 2
+
+    opt = paddle.optimizer.Adam(5e-3, parameters=qmodel.parameters())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((32, 64)).astype(np.float32)
+    yv = rng.integers(0, 4, (32,)).astype(np.int64)
+    ce = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(60):
+        loss = ce(qmodel(paddle.to_tensor(xv)), paddle.to_tensor(yv))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._value))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    # frozen fake-quant eval output == converted int8 output (same math)
+    qmodel.eval()
+    with paddle.no_grad():
+        qat_eval = np.asarray(qmodel(paddle.to_tensor(xv))._value)
+    deployed = q.convert(qmodel, inplace=False)
+    linears = [m for m in deployed.sublayers(True)
+               if isinstance(m, Int8DeployedLinear)]
+    assert len(linears) == 2
+    for lin in linears:
+        assert str(lin.weight_int8._value.dtype) == "int8"
+        assert lin.weight_scale._value.ndim == 1  # per-channel
+    with paddle.no_grad():
+        deployed_out = np.asarray(deployed(paddle.to_tensor(xv))._value)
+    np.testing.assert_allclose(deployed_out, qat_eval, rtol=1e-4, atol=1e-4)
+
+    # predictions survive quantization (trained under fake quant)
+    float_acc = (qat_eval.argmax(-1) == yv).mean()
+    int8_acc = (deployed_out.argmax(-1) == yv).mean()
+    assert int8_acc >= float_acc - 0.05
+
+    # deployable artifact: jit.save bakes the int8 weights; Predictor serves
+    import paddle_tpu.jit as jit
+    from paddle_tpu import inference
+
+    path = str(tmp_path / "qat_int8")
+    jit.save(deployed, path,
+             input_spec=[static.InputSpec([32, 64], "float32", "x")])
+    pred = inference.Predictor(path)
+    (served,) = pred.run([xv])
+    np.testing.assert_allclose(served, deployed_out, rtol=1e-5, atol=1e-5)
+
+    # the artifact is visibly smaller than the float export
+    fpath = str(tmp_path / "float_net")
+    model.eval()
+    jit.save(model, fpath,
+             input_spec=[static.InputSpec([32, 64], "float32", "x")])
+    assert os.path.getsize(path + ".pdmodel") < os.path.getsize(
+        fpath + ".pdmodel") * 0.6
+
+
+def test_per_type_observer_config_conv_and_linear():
+    """Observers per layer TYPE (reference add_type_config): conv gets
+    channel-wise weight scales over dim 0, linear over the last dim."""
+    paddle.seed(1)
+
+    class ConvNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 8, 3, padding=1)
+            self.fc = nn.Linear(8 * 4 * 4, 4)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.conv(x))
+            return self.fc(h.reshape([x.shape[0], -1]))
+
+    cfg = QuantConfig()
+    cfg.add_type_config(
+        nn.Conv2D,
+        activation=quanter(FakeQuanterWithAbsMaxObserver),
+        weight=quanter(FakeQuanterChannelWiseAbsMax, quant_axis=0),
+    )
+    cfg.add_type_config(
+        nn.Linear,
+        activation=quanter(FakeQuanterWithAbsMaxObserver),
+        weight=quanter(FakeQuanterChannelWiseAbsMax),
+    )
+    q = QAT(cfg)
+    m = q.quantize(ConvNet(), inplace=False)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 4, 4)).astype(np.float32))
+    out = m(x)
+    (out.sum()).backward()  # STE gradients flow
+    m.eval()
+    with paddle.no_grad():
+        ref = np.asarray(m(x)._value)
+    d = q.convert(m, inplace=False)
+    convs = [s for s in d.sublayers(True) if isinstance(s, Int8DeployedConv2D)]
+    lins = [s for s in d.sublayers(True) if isinstance(s, Int8DeployedLinear)]
+    assert len(convs) == 1 and len(lins) == 1
+    assert convs[0].weight_scale._value.shape == (8,)  # per out-channel
+    with paddle.no_grad():
+        got = np.asarray(d(x)._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ptq_percentile_calibration_and_convert():
+    paddle.seed(2)
+    model = _Net()
+    cfg = QuantConfig(
+        activation=quanter(PercentileObserver, percentile=99.5),
+        weight=quanter(ChannelWiseAbsMaxObserver),
+    )
+    p = PTQ(cfg)
+    pm = p.quantize(model, inplace=False)
+    rng = np.random.default_rng(3)
+    for _ in range(8):  # calibration forwards
+        pm(paddle.to_tensor(rng.standard_normal((16, 64)).astype(np.float32)))
+    d = p.convert(pm, inplace=False)
+    lins = [s for s in d.sublayers(True) if isinstance(s, Int8DeployedLinear)]
+    assert len(lins) == 2
+    xv = rng.standard_normal((8, 64)).astype(np.float32)
+    with paddle.no_grad():
+        ref = np.asarray(model(paddle.to_tensor(xv))._value)
+        got = np.asarray(d(paddle.to_tensor(xv))._value)
+    # int8 PTQ stays close to the float model
+    assert np.abs(got - ref).max() < 0.1 * max(1.0, np.abs(ref).max())
