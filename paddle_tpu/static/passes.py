@@ -84,6 +84,12 @@ def _pallas_fusion_factory(**kwargs):
     return PallasFusionPass(**kwargs)
 
 
+def _generic_elementwise_factory(**kwargs):
+    from .rewrite import GenericElementwiseFusionPass
+
+    return GenericElementwiseFusionPass(**kwargs)
+
+
 def _fp16_rewrite_factory(**kwargs):
     from paddle_tpu.distributed.passes import Fp16ProgramRewrite
 
@@ -227,6 +233,7 @@ _REGISTRY = {
     "dead_code_elimination": DeadCodeEliminationPass,
     "weight_only_quant": WeightOnlyQuantPass,
     "pallas_fusion": _pallas_fusion_factory,
+    "generic_elementwise_fusion": _generic_elementwise_factory,
     "auto_parallel_fp16": _fp16_rewrite_factory,
     "auto_parallel_recompute": _dist_rewrite_factory("RecomputeProgramRewrite"),
     "auto_parallel_gradient_merge": _dist_rewrite_factory("GradientMergeProgramRewrite"),

@@ -31,6 +31,9 @@ __all__ = [
     "FlashAttentionPattern",
     "RMSNormPattern",
     "SwiGLUPattern",
+    "MatmulEpiloguePattern",
+    "AddNormPattern",
+    "GenericElementwiseFusionPass",
 ]
 
 
@@ -617,3 +620,195 @@ class PallasFusionPass(PatternRewritePass):
              MatmulEpiloguePattern(), AddNormPattern()],
             fetch_vids=fetch_vids,
         )
+
+
+# ---------------------------------------------------------------------------
+# generic elementwise-chain fusion (the CINN auto-discovery role)
+
+
+_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "pow",
+    "exp", "log", "tanh", "sigmoid", "relu", "gelu", "silu", "abs", "neg",
+    "sqrt", "rsqrt", "square", "floor", "ceil", "round", "clip", "cast",
+    "scale", "leaky_relu", "elu", "hardtanh", "softplus", "mish",
+    "hardswish", "hardsigmoid", "erf", "sin", "cos", "amp_cast",
+    "fake_quant",
+}
+
+
+class GenericElementwiseFusionPass:
+    """Discover maximal chains of same-shape elementwise ops and generate
+    ONE Pallas VPU kernel per chain (reference: CINN's fusible-subgraph
+    discovery + codegen, paddle/cinn/hlir/framework/op_lowering_impl.cc —
+    the mechanism, not a fixed pattern set).
+
+    A chain is a maximal straight line of whitelisted ops where every link
+    is single-use and every participating tensor has the output's shape
+    (scalar/python constants are already baked inside the recorded op fns).
+    The generated kernel replays the recorded op fns over VMEM blocks, so
+    an N-op bandwidth-bound chain makes one HBM round trip instead of N.
+    Opt-in (`apply_pass(prog, "generic_elementwise_fusion")` or the
+    save_inference_model passes= list): XLA fuses most of these itself —
+    this pass exists for tile control and for chains fusion boundaries
+    would otherwise split.
+    """
+
+    name = "generic_elementwise_fusion"
+
+    def __init__(self, fetch_vids=(), min_chain=3):
+        self._fetch_vids = tuple(fetch_vids)
+        self._min_chain = int(min_chain)
+
+    # ------------------------------------------------------------ discovery
+    def _eligible(self, op, graph, shape):
+        if _base_type(op.type) not in _ELEMENTWISE:
+            return False
+        if not op.out_vids or len(op.out_vids) != 1:
+            return False
+        if graph.shape(op.out_vids[0]) != shape:
+            return False
+        for s in op.arg_spec:
+            if s[0] == "var" and graph.shape(s[1]) not in (shape, None):
+                return False
+            if s[0] == "var" and graph.shape(s[1]) is None:
+                return False
+        return True
+
+    def _collect_chain(self, root, graph):
+        """Walk producers from `root` collecting the fusible upstream set
+        (a tree of single-use elementwise producers), returned in
+        execution order."""
+        shape = graph.shape(root.out_vids[0])
+        block_ops = graph.block.ops
+        chain = {id(root): root}
+        frontier = [root]
+        while frontier:
+            op = frontier.pop()
+            for s in op.arg_spec:
+                if s[0] != "var":
+                    continue
+                prod = graph.def_op(s[1])
+                if (prod is None or id(prod) in chain
+                        or not graph.single_use(s[1])
+                        or not self._eligible(prod, graph, shape)):
+                    continue
+                chain[id(prod)] = prod
+                frontier.append(prod)
+        ordered = [op for op in block_ops if id(op) in chain]
+        return ordered
+
+    # -------------------------------------------------------------- codegen
+    def _build_kernel(self, ordered, ext_vids, final_vid, shape, dtype):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        from paddle_tpu.ops._pl_utils import imap
+
+        def chain_body(*vals):
+            env = dict(zip(ext_vids, vals))
+            for op in ordered:
+                var_vals = [env[s[1]] for s in op.arg_spec if s[0] == "var"]
+                out = op.fn(*var_vals)
+                flat = jax.tree_util.tree_leaves(out)
+                for vid, v in zip(op.out_vids, flat):
+                    env[vid] = v
+            return env[final_vid]
+
+        n_in = len(ext_vids)
+
+        def fused(*vals):
+            flat = [v.reshape(-1, shape[-1]) if len(shape) > 1 else
+                    v.reshape(1, -1) for v in vals]
+            rows, cols = flat[0].shape
+            # tile like the swiglu kernel: bounded VMEM, 128-multiple lanes
+            from paddle_tpu.ops import autotune as _at
+
+            tuned = _at.lookup("vpu_chain", {
+                "rows": rows, "cols": cols, "n_ops": len(ordered),
+                "dtype": jnp.dtype(dtype).name})
+            br = int(tuned["rows_block"]) if tuned else min(256, rows)
+            bc = int(tuned["cols_block"]) if tuned else cols
+            if rows % br:
+                br = rows
+            if cols % bc:
+                bc = cols
+                for cand in (2048, 1024, 512, 256, 128):
+                    if cols % cand == 0:
+                        bc = cand
+                        break
+            # Pre-trace the chain at BLOCK shape and bake closure constants
+            # as numpy literals — Pallas kernels may not capture traced
+            # jax arrays (scalar consts recorded inside op fns are such).
+            block_avals = [jax.ShapeDtypeStruct((br, bc), f.dtype)
+                           for f in flat]
+            closed = jax.make_jaxpr(chain_body)(*block_avals)
+            np_consts = [np.asarray(c) for c in closed.consts]
+
+            def kernel(*refs):
+                ins, o_ref = refs[:n_in], refs[n_in]
+                out = jax.core.eval_jaxpr(
+                    closed.jaxpr, np_consts, *(r[:] for r in ins))[0]
+                o_ref[:] = out.astype(o_ref.dtype)
+
+            out = pl.pallas_call(
+                kernel,
+                grid=(rows // br, cols // bc),
+                in_specs=[pl.BlockSpec((br, bc), imap(lambda i, j: (i, j)))
+                          for _ in flat],
+                out_specs=pl.BlockSpec((br, bc), imap(lambda i, j: (i, j))),
+                out_shape=jax.ShapeDtypeStruct((rows, cols), dtype),
+                interpret=jax.default_backend() != "tpu",
+            )(*flat)
+            return out.reshape(shape)
+
+        return fused
+
+    # ----------------------------------------------------------------- apply
+    def apply(self, program) -> int:
+        import jax
+
+        n = 0
+        while True:
+            graph = ProgramGraph(program, self._fetch_vids)
+            block = graph.block
+            done = False
+            for root in reversed(list(block.ops)):
+                shape = graph.shape(root.out_vids[0]) if root.out_vids else None
+                if shape is None or len(shape) < 1:
+                    continue
+                if not self._eligible(root, graph, shape):
+                    continue
+                # root must be the DOWNSTREAM end: its single output is not
+                # consumed by another fusible op (that op would be the root)
+                out_vid = root.out_vids[0]
+                cons = graph.consumers.get(out_vid, [])
+                if (len(cons) == 1 and graph.single_use(out_vid)
+                        and self._eligible(cons[0], graph, shape)):
+                    continue
+                ordered = self._collect_chain(root, graph)
+                if len(ordered) < self._min_chain:
+                    continue
+                in_chain_out = {vid for op in ordered for vid in op.out_vids}
+                ext_vids = []
+                for op in ordered:
+                    for s in op.arg_spec:
+                        if s[0] == "var" and s[1] not in in_chain_out and s[1] not in ext_vids:
+                            ext_vids.append(s[1])
+                var = program._var_by_vid[out_vid]
+                dtype = var._value.dtype
+                fused = self._build_kernel(
+                    ordered, list(ext_vids), out_vid, shape, dtype)
+                new_op = _make_op(
+                    f"vpu_chain_{len(ordered)}", fused, ext_vids, root)
+                idx = block.ops.index(root)
+                block.ops[idx] = new_op
+                for op in ordered:
+                    if op is not root and op in block.ops:
+                        block.ops.remove(op)
+                program.version += 1
+                n += 1
+                done = True
+                break
+            if not done:
+                return n

@@ -419,3 +419,59 @@ def test_epilogue_patterns_fire_on_bert_program():
     assert "add_layer_norm" in types, set(types)
     (got,) = static.Executor().run(main, feed={"ids": ids_v}, fetch_list=[out])
     np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_generic_elementwise_chain_fusion():
+    """The CINN-discovery role: an arbitrary elementwise chain (not one of
+    the fixed patterns) collapses to ONE generated VPU kernel op with
+    numerics preserved (opt-in pass)."""
+    from paddle_tpu import static
+    from paddle_tpu.static.passes import apply_pass
+
+    def body(a, b):
+        t = paddle.tanh(a * b + a)
+        u = paddle.exp(t * 0.5)
+        return paddle.sqrt(u + 1.0) * b
+
+    main, feeds, out = _capture(body, (8, 128), (8, 128))
+    rng = np.random.default_rng(0)
+    av = rng.standard_normal((8, 128)).astype(np.float32)
+    bv = rng.standard_normal((8, 128)).astype(np.float32)
+    (ref,) = static.Executor().run(main, feed={"x0": av, "x1": bv},
+                                   fetch_list=[out])
+    before = len(main.global_block().ops)
+    n = apply_pass(main, "generic_elementwise_fusion",
+                   fetch_vids=[out._vid])
+    after = len(main.global_block().ops)
+    types = [op.type for op in main.global_block().ops]
+    assert n >= 1 and after < before, (n, types)
+    assert any(t.startswith("vpu_chain_") for t in types), types
+    (got,) = static.Executor().run(main, feed={"x0": av, "x1": bv},
+                                   fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_generic_fusion_respects_fetch_and_multi_use():
+    """Intermediates that are fetched or multiply-consumed stay
+    materialized (not swallowed into a chain)."""
+    from paddle_tpu import static
+    from paddle_tpu.static.passes import apply_pass
+
+    main = static.Program()
+    from paddle_tpu.static.program import program_guard
+
+    with program_guard(main):
+        a = static.data("a", [4, 32], "float32")
+        t = paddle.tanh(a * 2.0)      # fetched below: must survive
+        u = paddle.exp(t + 1.0)
+        v = paddle.sqrt(u * u + 1.0)
+    rng = np.random.default_rng(1)
+    av = rng.standard_normal((4, 32)).astype(np.float32)
+    ref_t, ref_v = static.Executor().run(main, feed={"a": av},
+                                         fetch_list=[t, v])
+    apply_pass(main, "generic_elementwise_fusion",
+               fetch_vids=[t._vid, v._vid])
+    got_t, got_v = static.Executor().run(main, feed={"a": av},
+                                         fetch_list=[t, v])
+    np.testing.assert_allclose(got_t, ref_t, rtol=1e-6)
+    np.testing.assert_allclose(got_v, ref_v, rtol=1e-5, atol=1e-6)
