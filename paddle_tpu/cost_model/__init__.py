@@ -84,6 +84,19 @@ class OpCostModel:
         t_mem = mem_bytes / (hbm_gbs * 1e9)
         return max(t_compute, t_mem)
 
+    def estimate_step(self, fn, *example_args):
+        """Roofline estimate for a whole jitted step WITHOUT running it:
+        flops/bytes come from XLA's cost analysis of the compiled
+        executable (profiler.cost_analysis), fed through the device
+        roofline — the per-config cost the auto-parallel planner ranks
+        with when no measurement exists."""
+        from paddle_tpu.profiler import cost_analysis
+
+        analyses = cost_analysis(fn, *example_args)
+        flops = float(analyses.get("flops", 0.0) or 0.0)
+        mem = float(analyses.get("bytes accessed", 0.0) or 0.0)
+        return self.flops_time(flops, mem)
+
     # ---------------------------------------------------------------- io
     def save(self, path):
         with open(path, "w") as f:
@@ -94,4 +107,23 @@ class OpCostModel:
         m = cls()
         with open(path) as f:
             m.table = json.load(f)
+        return m
+
+    @classmethod
+    def from_bench_ops(cls, path_or_dict):
+        """Build a table from tools/bench_ops.py results (the shipped
+        profiled-table role of the reference's
+        python/paddle/cost_model/static_op_benchmark.json: the on-chip
+        queue captures bench_ops_results.json per device kind)."""
+        m = cls()
+        if isinstance(path_or_dict, (str, bytes)):
+            with open(path_or_dict) as f:
+                data = json.load(f)
+        else:
+            data = dict(path_or_dict)
+        kind = data.get("device_kind", "unknown")
+        for name, entry in (data.get("ops") or {}).items():
+            if "ms" in entry:
+                m.table[name] = {"time_s": float(entry["ms"]) / 1e3,
+                                 "device": kind}
         return m

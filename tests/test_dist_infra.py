@@ -203,3 +203,46 @@ class TestAutoTuner:
             r2 = HistoryRecorder()
             r2.load_history(p)
             assert len(r2.history) == 3
+
+
+# --------------------------------------------------------- cost model depth
+
+def test_cost_model_from_bench_ops_table():
+    from paddle_tpu.cost_model import OpCostModel
+
+    data = {"device_kind": "TPU v5 lite",
+            "ops": {"matmul": {"ms": 1.5}, "softmax": {"ms": 0.2},
+                    "broken": {"error": "x"}}}
+    m = OpCostModel.from_bench_ops(data)
+    assert m.query("matmul") == 1.5e-3
+    assert m.query("softmax") == 2e-4
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        m.query("broken")  # error entries are not silently zero-cost
+
+
+def test_cost_model_estimate_step_ranks_configs():
+    """The planner's question: which config is cheaper?  estimate_step
+    (XLA cost analysis -> roofline) must rank a 4x-FLOPs step above the
+    small one without ever executing either."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.cost_model import OpCostModel
+
+    m = OpCostModel()
+
+    def small(a, b):
+        return (a @ b).sum()
+
+    def big(a, b):
+        return ((a @ b) @ b).sum()  # strictly more flops, same operands
+
+    a = jnp.ones((256, 256), jnp.float32)
+    b = jnp.ones((256, 256), jnp.float32)
+    t_small = m.estimate_step(small, a, b)
+    t_big = m.estimate_step(big, a, b)
+    assert 0 < t_small < t_big, (t_small, t_big)
+    # roofline monotonicity in both axes
+    assert m.flops_time(1e12, 0) < m.flops_time(2e12, 0)
+    assert m.flops_time(0, 1e9) < m.flops_time(0, 2e9)
