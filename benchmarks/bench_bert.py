@@ -13,8 +13,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    import os
+
     import jax
 
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     on_accel = jax.devices()[0].platform != "cpu"
 
@@ -44,6 +48,7 @@ def main():
 
     step = TrainStep(model, opt, loss_fn)
     rng = np.random.default_rng(0)
+    amp_level = "O1"
 
     from paddle_tpu.device import time_step_ms
 
@@ -73,6 +78,29 @@ def main():
                 tokens_per_sec, B = tps, batch
         if tokens_per_sec == 0.0:
             raise SystemExit("bench_bert: every sweep batch hit device OOM")
+        # O2 arm at the winning batch: bf16 params + fp32 masters cut the
+        # per-op cast traffic of O1 (the A100 point is full AMP)
+        try:
+            with (jax.default_device(cpu) if cpu else contextlib.nullcontext()):
+                model2 = BertForSequenceClassification(cfg)
+            opt2 = paddle.optimizer.AdamW(2e-5, parameters=model2.parameters())
+            model2, opt2 = paddle.amp.decorate(model2, opt2, level="O2")
+
+            def loss_fn2(m, i, y):
+                with paddle.amp.auto_cast(enable=True, level="O2"):
+                    return m(i, labels=y)[0]
+
+            step2 = TrainStep(model2, opt2, loss_fn2)
+            ids = paddle.to_tensor(rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32))
+            y = paddle.to_tensor(rng.integers(0, 2, (B,)).astype(np.int32))
+            step2(ids, y)
+            hard_sync(step2(ids, y))
+            tps_o2 = B * S / (time_step_ms(lambda: step2(ids, y), inner=iters) / 1e3)
+            if tps_o2 > tokens_per_sec:
+                tokens_per_sec, amp_level = tps_o2, "O2"
+        except Exception as e:  # additive arm: never sinks the bench
+            print(f"bench_bert: O2 arm failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
     else:
         tokens_per_sec = measure(B)
 
@@ -90,6 +118,7 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 4),
         "batch": B,
+        "amp": amp_level,
     }))
 
 
