@@ -129,13 +129,6 @@ def test_ten_million_rows_sparse_faster_than_replicated_dense():
     ids = rng.integers(0, V, n).astype(np.int64)
     g = rng.normal(size=(n, d)).astype(np.float32)
 
-    table.push(ids, g)  # compile
-    t0 = time.perf_counter()
-    for _ in range(5):
-        table.push(ids, g)
-    jax.block_until_ready(table.weight)
-    sparse_t = (time.perf_counter() - t0) / 5
-
     # replicated dense twin: full-table dense-gradient update each step
     w = jnp.zeros((V, d), jnp.float32)
 
@@ -144,13 +137,30 @@ def test_ten_million_rows_sparse_faster_than_replicated_dense():
         dense_g = jnp.zeros_like(w).at[ids].add(g)
         return w - 0.1 * dense_g
 
+    table.push(ids, g)  # compile
     w = dense_step(w, jnp.asarray(ids), jnp.asarray(g))  # compile
     jax.block_until_ready(w)
-    t0 = time.perf_counter()
-    for _ in range(3):
+
+    def time_best(fn, reps=3, iters=3):
+        # best-of-N: this is a PERF comparison on a shared CI core — the
+        # minimum is the least load-contaminated sample
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    sparse_t = time_best(lambda: (table.push(ids, g),
+                                  jax.block_until_ready(table.weight)))
+
+    def dense_once():
+        nonlocal w
         w = dense_step(w, jnp.asarray(ids), jnp.asarray(g))
-    jax.block_until_ready(w)
-    dense_t = (time.perf_counter() - t0) / 3
+        jax.block_until_ready(w)
+
+    dense_t = time_best(dense_once)
 
     assert sparse_t < dense_t, (sparse_t, dense_t)
     # rows really trained
