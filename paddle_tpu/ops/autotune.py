@@ -467,18 +467,22 @@ def tune_matmul_epilogue(m=4096, k=4096, n=4096, dtype="bfloat16", **kw):
 # CLI: bounded-time sweep over the standard shape set
 
 
+# Flagship-first ordering: bench.py's hidden-2048/S=1024 LLaMA uses
+# flash(seq=1024, hd=128), norm rows=B*1024 x 2048, swiglu rows x 5632 —
+# a short on-chip budget tunes exactly those before the generic shapes.
 _STANDARD_SHAPES = {
     "flash": [
         dict(seq=1024, head_dim=128), dict(seq=2048, head_dim=128),
         dict(seq=4096, head_dim=128), dict(seq=2048, head_dim=64),
     ],
     "norm": [
-        dict(rows=4096, hidden=2048), dict(rows=4096, hidden=4096),
+        dict(rows=4096, hidden=2048), dict(rows=8192, hidden=2048),
+        dict(rows=16384, hidden=2048), dict(rows=4096, hidden=4096),
         dict(rows=8192, hidden=4096),
     ],
     "swiglu": [
-        dict(rows=4096, cols=5504), dict(rows=8192, cols=5632),
-        dict(rows=4096, cols=11008),
+        dict(rows=4096, cols=5632), dict(rows=8192, cols=5632),
+        dict(rows=16384, cols=5632), dict(rows=4096, cols=11008),
     ],
     "matmul": [
         dict(m=4096, k=2048, n=8192), dict(m=4096, k=4096, n=4096),
