@@ -485,6 +485,10 @@ class MatmulEpiloguePattern(RewritePattern):
         mm = graph.def_op(pre_vid)
         if mm is None or _base_type(mm.type) not in ("linear", "matmul"):
             return False
+        if mm.type.startswith("wq::"):
+            # weight-only-quantized op: different arg contract (int8 q +
+            # scale appended) — fusing would add the scale as a bias
+            return False
         if len(mm.arg_spec) not in (2, 3):
             return False
         x_entry, w_entry = mm.arg_spec[0], mm.arg_spec[1]
@@ -495,6 +499,14 @@ class MatmulEpiloguePattern(RewritePattern):
         x_shape = graph.shape(x_entry[1])
         if not w_shape or not x_shape or len(w_shape) != 2 or x_shape[-1] != w_shape[0]:
             return False
+        # defense in depth: the weight must be a FLOAT tensor (an int8
+        # quantized weight means a dequant contract this kernel lacks)
+        if w_entry[0] == "var":
+            wvar = graph.program._var_by_vid.get(w_entry[1])
+            import jax.numpy as _jnp
+
+            if wvar is None or not _jnp.issubdtype(wvar._value.dtype, _jnp.inexact):
+                return False
         if b_entry is not None and _entry_shape(graph, b_entry) != (w_shape[1],):
             return False
         act = base

@@ -475,3 +475,31 @@ def test_generic_fusion_respects_fetch_and_multi_use():
                                          fetch_list=[t, v])
     np.testing.assert_allclose(got_t, ref_t, rtol=1e-6)
     np.testing.assert_allclose(got_v, ref_v, rtol=1e-5, atol=1e-6)
+
+
+def test_epilogue_pattern_skips_quantized_linear():
+    """A weight-only-quantized linear (wq:: namespace; int8 weight + scale
+    appended) must NOT be epilogue-fused — the pattern would read the
+    scale as a bias and produce garbage."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static.passes import apply_pass
+
+    paddle.seed(0)
+    lin = paddle.nn.Linear(64, 128, bias_attr=False)  # 3-arg wq form
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [8, 64], "float32")
+        out = F.gelu(lin(x))
+    xv = np.random.default_rng(0).standard_normal((8, 64)).astype(np.float32)
+    paddle.set_flags({"FLAGS_use_pallas_fusion": False})
+    try:
+        (ref,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[out])
+        apply_pass(main, "weight_only_quant")
+        n = PallasFusionPass([out._vid]).apply(main)
+        types = [op.type for op in main.global_block().ops]
+        assert "matmul_epilogue" not in types, (n, types)
+        (got,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[out])
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
+    # int8 weight quantization error only — no structural corruption
+    assert np.abs(got - ref).max() < 0.05 * max(1.0, np.abs(ref).max())
