@@ -516,16 +516,33 @@ class MatmulEpiloguePattern(RewritePattern):
         entries = [x_entry, w_entry] + ([b_entry] if b_entry is not None else [])
         var_vids, rebuild = _mixed(entries)
         has_bias = b_entry is not None
+        # keep the fp16 rewrite's low-dtype compute (see FlashAttentionPattern:
+        # replacing an fp16:: op with an fp32 kernel would silently revert
+        # the precision choice)
+        low = getattr(mm, "fp16_low", None)
 
-        def fused(*var_vals, act=act, has_bias=has_bias, rebuild=rebuild):
+        def fused(*var_vals, act=act, has_bias=has_bias, rebuild=rebuild, low=low):
+            import jax.numpy as _jnp
+
             from paddle_tpu.ops import matmul_bias_act
 
             full = rebuild(var_vals)
             x, w = full[0], full[1]
             b = full[2] if has_bias else None
-            return matmul_bias_act(x, w, b, act)
+            downcast = False
+            if low is not None and x.dtype == _jnp.float32:
+                x, downcast = x.astype(low), True
+                w = w.astype(low) if w.dtype == _jnp.float32 else w
+                if b is not None and b.dtype == _jnp.float32:
+                    b = b.astype(low)
+            out = matmul_bias_act(x, w, b, act)
+            return out.astype(_jnp.float32) if downcast else out
 
-        graph.replace_op(op, _make_op("matmul_epilogue", fused, var_vids, op))
+        new_type = ("fp16::" if low is not None else "") + "matmul_epilogue"
+        new_op = _make_op(new_type, fused, var_vids, op)
+        if low is not None:
+            new_op.fp16_low = low
+        graph.replace_op(op, new_op)
         return True
 
 

@@ -503,3 +503,30 @@ def test_epilogue_pattern_skips_quantized_linear():
         paddle.set_flags({"FLAGS_use_pallas_fusion": True})
     # int8 weight quantization error only — no structural corruption
     assert np.abs(got - ref).max() < 0.05 * max(1.0, np.abs(ref).max())
+
+
+def test_epilogue_fusion_keeps_fp16_compute():
+    """fp16-rewritten linear + gelu must fuse into an fp16:: epilogue op
+    that computes in the low dtype (not silently revert to fp32)."""
+    from paddle_tpu.static.passes import apply_pass
+
+    paddle.seed(4)
+    lin = paddle.nn.Linear(64, 128)
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [8, 64], "float32")
+        out = F.gelu(lin(x))
+    xv = np.random.default_rng(2).standard_normal((8, 64)).astype(np.float32)
+    paddle.set_flags({"FLAGS_use_pallas_fusion": False})
+    try:
+        (ref,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[out])
+        apply_pass(main, "auto_parallel_fp16", dtype="bfloat16")
+        PallasFusionPass([out._vid]).apply(main)
+        types = [op.type for op in main.global_block().ops]
+        assert "fp16::matmul_epilogue" in types, types
+        (got,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[out])
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)  # bf16
+    # bf16 compute really happened: outputs differ from exact fp32
+    assert np.abs(got - ref).max() > 0
