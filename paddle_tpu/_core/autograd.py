@@ -129,6 +129,7 @@ class GradNode:
         "out_refs",
         "released",
         "rebuild",
+        "taped_vjp",
         "__weakref__",
     )
 
@@ -149,6 +150,11 @@ class GradNode:
         # record-time values so in-place mutation between forward and the
         # create_graph walk is detected, not silently recomputed-over.
         self.rebuild = None
+        # create_graph path for CUSTOM-backward nodes (PyLayer): a callable
+        # (cot_tensors) -> input grads running the user backward WITH grad
+        # recording — autodiffing the forward would be wrong for e.g.
+        # straight-through estimators.
+        self.taped_vjp = None
 
     def release(self):
         self.vjp_fn = None
@@ -478,7 +484,10 @@ def _backward_impl(roots, grad_vals, retain_graph, leaf_targets, create_graph=Fa
                     v = _run_hooks(ref, v)
             full.append(v)
         if create_graph:
-            in_grads = _vjp_through_tape(node, full)
+            if node.taped_vjp is not None:
+                in_grads = node.taped_vjp(full)
+            else:
+                in_grads = _vjp_through_tape(node, full)
         else:
             cot_struct = jax.tree_util.tree_unflatten(node.out_tree, full)
             if node.released or node.vjp_fn is None:

@@ -16,6 +16,15 @@ from paddle_tpu._core.tensor import Tensor
 __all__ = ["PyLayer", "PyLayerContext"]
 
 
+class _SavedTuple(tuple):
+    """tuple that no-ops when called: supports both `ctx.saved_tensor`
+    (this package's historical property form) and the reference's
+    `ctx.saved_tensor()` method form."""
+
+    def __call__(self):
+        return self
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
@@ -36,13 +45,16 @@ class PyLayerContext:
     def _unpacked(self):
         unpack = getattr(self, "_saved_unpack", None)
         if unpack is not None:
-            return tuple(unpack(t) for t in self._saved)
-        return self._saved
+            return _SavedTuple(unpack(t) for t in self._saved)
+        return _SavedTuple(self._saved)
 
     @property
     def saved_tensor(self):
+        # reference API is `ctx.saved_tensor()` (a method); _SavedTuple is
+        # self-calling so both the property read and the call form work
         return self._unpacked()
 
+    @property
     def saved_tensors(self):
         return self._unpacked()
 
@@ -115,7 +127,27 @@ class PyLayer(metaclass=PyLayerMeta):
                 vals.append(None if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
             return tuple(vals)
 
+        def taped_vjp(cot_tensors):
+            """create_graph path: the user backward runs WITH grad recording
+            so its ops build the second-order graph (reference: double
+            backward through PyLayer differentiates the custom backward,
+            never the forward — straight-through estimators depend on it)."""
+            grads = backward_fn(ctx, *cot_tensors)
+            grads = grads if isinstance(grads, (list, tuple)) else (grads,)
+            tensor_args = [a for a in args if isinstance(a, Tensor)]
+            grads_full = list(grads) + [None] * (len(tensor_args) - len(grads))
+            per_tensor = dict(zip([id(t) for t in tensor_args], grads_full))
+            out = []
+            for d in diff_inputs:
+                g = per_tensor.get(id(d))
+                if g is None:
+                    out.append(None)
+                else:
+                    out.append(g if isinstance(g, Tensor) else Tensor(jnp.asarray(g)))
+            return tuple(out)
+
         node = core_ag.GradNode(f"PyLayer[{cls.__name__}]", vjp_fn, diff_inputs, out_avals, flat_tree)
+        node.taped_vjp = taped_vjp
         for i, o in enumerate(out_tensors):
             if jnp.issubdtype(o._value.dtype, jnp.inexact):
                 o.stop_gradient = False

@@ -192,3 +192,50 @@ def test_first_order_release_still_enforced():
         pass
     else:
         raise AssertionError("expected released-node RuntimeError")
+
+
+def test_create_graph_through_pylayer_differentiates_custom_backward():
+    """Double backward through PyLayer must differentiate the CUSTOM
+    backward, never re-autodiff the forward (straight-through semantics)."""
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * x  # DELIBERATELY not the true derivative (3x^2)
+
+    x = _param([2.0, 3.0])
+    y = Cube.apply(x)
+    (gx,) = paddle.grad(y.sum(), x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0, 3.0], rtol=1e-6)  # g*x = x
+    (ggx,) = paddle.grad(gx.sum(), x)
+    # d/dx of the CUSTOM backward's x is 1 — NOT forward's 6x
+    np.testing.assert_allclose(ggx.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_create_graph_pylayer_second_order_matches_true_derivative():
+    from paddle_tpu.autograd import PyLayer
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 2.0 * x  # the true vjp, written by hand
+
+    x = _param([1.5, -2.0])
+    y = Square.apply(x)
+    (gx,) = paddle.grad(y.sum(), x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [3.0, -4.0], rtol=1e-6)
+    (ggx,) = paddle.grad(gx.sum(), x)
+    np.testing.assert_allclose(ggx.numpy(), [2.0, 2.0], rtol=1e-6)
