@@ -239,3 +239,28 @@ def test_create_graph_pylayer_second_order_matches_true_derivative():
     np.testing.assert_allclose(gx.numpy(), [3.0, -4.0], rtol=1e-6)
     (ggx,) = paddle.grad(gx.sum(), x)
     np.testing.assert_allclose(ggx.numpy(), [2.0, 2.0], rtol=1e-6)
+
+
+def test_tape_double_grad_agrees_with_functional_hessian():
+    """Two independent higher-order mechanisms — the tape's create_graph
+    walk and the functional jax-transform hessian — must agree."""
+    from paddle_tpu.autograd.functional import hessian
+
+    rng = np.random.default_rng(5)
+    xv = rng.standard_normal(4).astype(np.float32)
+
+    def f(x):
+        return (paddle.tanh(x) * x).sum()
+
+    H = hessian(f, paddle.to_tensor(xv))
+    H = np.asarray(H._value if hasattr(H, "_value") else H)
+
+    # tape route: per-component second derivative via create_graph
+    x = _param(xv)
+    y = (paddle.tanh(x) * x).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    rows = []
+    for i in range(4):
+        (row,) = paddle.grad(gx[i], x, retain_graph=True, create_graph=True)
+        rows.append(np.asarray(row._value))
+    np.testing.assert_allclose(np.stack(rows), H.reshape(4, 4), rtol=1e-4, atol=1e-5)
