@@ -174,8 +174,25 @@ class Model:
 
     # --------------------------------------------------------------- state
     def save(self, path, training=True):
+        """training=True: checkpoint (params + optimizer state).
+        training=False: INFERENCE export via jit.save — the deployable
+        .pdmodel/.pdiparams artifact loadable by inference.Predictor
+        (reference hapi/model.py Model.save(training=False) contract);
+        requires the Model to have been constructed with inputs=
+        InputSpec list."""
+        if not training:
+            if not self._inputs:
+                raise ValueError(
+                    "Model.save(training=False) exports an inference "
+                    "artifact and needs the Model's inputs= InputSpec list")
+            import paddle_tpu.jit as jit
+
+            specs = self._inputs if isinstance(self._inputs, (list, tuple)) \
+                else [self._inputs]
+            jit.save(self.network, path, input_spec=list(specs))
+            return
         state = {"model": dict(self.network.state_dict())}
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             state["opt"] = self._optimizer.state_dict()
         paddle.save(state, path + ".pdparams")
 

@@ -190,3 +190,28 @@ def test_visualdl_callback_writes_scalars(tmp_path, monkeypatch):
     assert not any(t.startswith("eval/eval_") for t in tags), tags
     steps = [r["step"] for r in rows if r["tag"].startswith("train/loss")]
     assert steps == sorted(steps) and len(steps) >= 2
+
+
+def test_model_save_inference_export(tmp_path):
+    """Model.save(training=False) = deployable inference artifact served by
+    the Predictor (reference hapi Model.save contract)."""
+    import os
+
+    from paddle_tpu import inference, static
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+    m = paddle.Model(net, inputs=[static.InputSpec([4, 8], "float32", "x")])
+    path = str(tmp_path / "deploy")
+    m.save(path, training=False)
+    assert os.path.exists(path + ".pdmodel")
+    xv = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    net.eval()
+    with paddle.no_grad():
+        ref = np.asarray(net(paddle.to_tensor(xv))._value)
+    (got,) = inference.Predictor(path).run([xv])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    # training=True stays the checkpoint path
+    m.save(str(tmp_path / "ckpt"), training=True)
+    assert os.path.exists(str(tmp_path / "ckpt") + ".pdparams")
