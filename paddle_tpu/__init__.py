@@ -97,7 +97,7 @@ try:
 except ImportError:
     pass
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 
 def is_compiled_with_cuda() -> bool:
