@@ -427,7 +427,8 @@ def _vjp_through_tape(node, cot_tensors):
     return list(outs) if isinstance(outs, (tuple, list)) else [outs]
 
 
-def _backward_impl(roots, grad_vals, retain_graph, leaf_targets, create_graph=False):
+def _backward_impl(roots, grad_vals, retain_graph, leaf_targets,
+                   create_graph=False, boundary_ids=()):
     """If leaf_targets is not None: return grads for those tensors instead of
     writing .grad (used by paddle.grad).
 
@@ -505,6 +506,16 @@ def _backward_impl(roots, grad_vals, retain_graph, leaf_targets, create_graph=Fa
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue
+            if id(t) in boundary_ids:
+                # no_grad_set: this tensor receives no gradient and blocks
+                # propagation into its producers (reference
+                # python/paddle/base/dygraph/base.py grad no_grad_vars)
+                child = t._grad_node
+                if child is not None and child in indeg:
+                    indeg[child] -= 1
+                    if indeg[child] == 0:
+                        ready.append(child)
+                continue
             if getattr(g, "dtype", None) is not None and g.dtype == jax.dtypes.float0:
                 continue
             child = t._grad_node
@@ -546,6 +557,7 @@ def grad(
     create_graph: bool = False,
     only_inputs: bool = True,
     allow_unused: bool = False,
+    no_grad_vars=None,
 ):
     """paddle.grad equivalent (reference python/paddle/base/dygraph/base.py:615;
     create_graph=True builds the double-backward graph like the reference's
@@ -562,6 +574,7 @@ def grad(
         ]
     # Reference semantics: retain_graph defaults to create_graph.
     retain = bool(retain_graph) if retain_graph is not None else bool(create_graph)
+    boundary = {id(t) for t in (no_grad_vars or ())}
     if create_graph:
         # Cotangents must ride the tape: seed with Tensors (a grad_outputs
         # Tensor keeps its own grad node so grads can flow into it too) and
@@ -573,11 +586,14 @@ def grad(
             seeds.append(go if isinstance(go, Tensor) else Tensor(gv))
         with enable_grad():
             leaf_grads = _backward_impl(
-                outputs, seeds, retain, leaf_targets=inputs, create_graph=True
+                outputs, seeds, retain, leaf_targets=inputs, create_graph=True,
+                boundary_ids=boundary,
             )
     else:
         with no_grad():
-            leaf_grads = _backward_impl(outputs, grad_vals, retain, leaf_targets=inputs)
+            leaf_grads = _backward_impl(outputs, grad_vals, retain,
+                                        leaf_targets=inputs,
+                                        boundary_ids=boundary)
     results = []
     for t in inputs:
         g = leaf_grads.get(id(t))

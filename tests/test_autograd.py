@@ -208,3 +208,22 @@ def test_concat_grad():
     y.backward()
     np.testing.assert_allclose(a.grad.numpy(), [1, 2])
     np.testing.assert_allclose(b.grad.numpy(), [3, 4])
+
+
+def test_grad_no_grad_vars_blocks_propagation():
+    """no_grad_vars: those tensors get no gradient and block propagation
+    into their producers (reference base.py grad no_grad_vars)."""
+    a = _param([2.0, 3.0])
+    c = _param([4.0, 5.0])
+    b = c * 2.0  # producer of the boundary tensor
+    y = (a * b).sum()
+    (ga,) = paddle.grad(y, [a], no_grad_vars=[b], retain_graph=True)
+    np.testing.assert_allclose(ga.numpy(), [8.0, 10.0])  # normal path
+    # no gradient flows through the boundary into c (explicit zeros)
+    (gc,) = paddle.grad(y, [c], no_grad_vars=[b], allow_unused=True)
+    np.testing.assert_allclose(gc.numpy(), [0.0, 0.0])
+    # without the boundary, grads flow: d y/d c = 2a
+    a2, c2 = _param([2.0, 3.0]), _param([4.0, 5.0])
+    y2 = (a2 * (c2 * 2.0)).sum()
+    (gc2,) = paddle.grad(y2, [c2])
+    np.testing.assert_allclose(gc2.numpy(), [4.0, 6.0])
