@@ -210,3 +210,27 @@ def test_to_unavailable_backend_warns():
     assert moved_or_warned
     if w:
         assert "backend available" in str(w[-1].message) or "backend unavailable" in str(w[-1].message)
+
+
+def test_seeded_training_is_deterministic():
+    """paddle.seed -> init + 2 train steps reproduces losses bit-for-bit
+    (regression net for RNG-threading nondeterminism)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    def run():
+        paddle.seed(1234)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+        o = opt.Adam(1e-2, parameters=m.parameters())
+        x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(4, 8) / 32.0)
+        y = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        losses = []
+        for _ in range(2):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss._value))
+        return losses
+
+    assert run() == run()
