@@ -179,3 +179,21 @@ def test_sparse_embedding_over_ssd_table(tmp_path):
     # rows survived on disk
     re = SSDSparseTable(8, str(tmp_path / "emb"), optimizer="adagrad", lr=0.2)
     assert re.n_rows() == 16
+
+
+def test_push_delta_over_rpc():
+    """Geo-SGD's delta protocol round-trips through the rpc tier too."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PsServer
+
+    rpc.init_rpc("ps_geo0", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:29637")
+    try:
+        PsServer.register_table(SparseTable(dim=4, name="emb_geo_rpc"))
+        client = PsClient(server="ps_geo0", table_name="emb_geo_rpc")
+        before = client.pull([7]).copy()
+        client.push_delta([7], np.full((1, 4), 0.25, np.float32))
+        after = client.pull([7])
+        np.testing.assert_allclose(after, before - 0.25, atol=1e-6)
+    finally:
+        rpc.shutdown()
