@@ -197,3 +197,33 @@ def test_push_delta_over_rpc():
         np.testing.assert_allclose(after, before - 0.25, atol=1e-6)
     finally:
         rpc.shutdown()
+
+
+def test_async_client_surfaces_worker_errors(tmp_path):
+    class Boom:
+        def push(self, ids, grads):
+            raise RuntimeError("table exploded")
+
+        def pull(self, ids):
+            return np.zeros((len(np.atleast_1d(ids)), 4), np.float32)
+
+    a = AsyncPsClient(Boom(), max_staleness=8)
+    a.push([1], np.ones((1, 4), np.float32))
+    with pytest.raises(RuntimeError, match="table exploded"):
+        a.wait()
+    a.close()
+
+
+def test_ssd_state_dict_roundtrip(tmp_path):
+    src = SSDSparseTable(4, str(tmp_path / "a"), optimizer="adagrad", lr=0.1)
+    src.pull(np.arange(6))
+    src.push(np.arange(6), np.ones((6, 4), np.float32))
+    state = src.state_dict()
+    dst = SSDSparseTable(4, str(tmp_path / "b"), optimizer="adagrad", lr=0.1)
+    dst.set_state_dict(state)
+    np.testing.assert_allclose(dst.pull(np.arange(6)), src.pull(np.arange(6)),
+                               atol=1e-7)
+    # adagrad accumulators restored too: next identical push matches
+    src.push([0], np.ones((1, 4), np.float32))
+    dst.push([0], np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(dst.pull([0]), src.pull([0]), atol=1e-7)
