@@ -19,6 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     import jax
 
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
     on_accel = jax.devices()[0].platform != "cpu"
 
