@@ -264,3 +264,24 @@ def test_tape_double_grad_agrees_with_functional_hessian():
         (row,) = paddle.grad(gx[i], x, retain_graph=True, create_graph=True)
         rows.append(np.asarray(row._value))
     np.testing.assert_allclose(np.stack(rows), H.reshape(4, 4), rtol=1e-4, atol=1e-5)
+
+
+def test_create_graph_under_amp_autocast():
+    """Gradient penalty computed inside amp.auto_cast: the amp_cast tape
+    nodes must participate in the create_graph walk (bf16 tolerance vs the
+    fp32 oracle)."""
+    rng = np.random.default_rng(9)
+    xv = rng.standard_normal((4, 8)).astype(np.float32)
+    wv = (rng.standard_normal((8, 1)) * 0.5).astype(np.float32)
+
+    def penalty(amp_on):
+        x, w = _param(xv), _param(wv)
+        with paddle.amp.auto_cast(enable=amp_on):
+            d = paddle.matmul(paddle.tanh(x), w).sum()
+        (gx,) = paddle.grad(d, x, create_graph=True)
+        p = ((gx * gx).sum() - 1.0) ** 2
+        p.backward()
+        return np.asarray(w.grad._value, np.float32)
+
+    np.testing.assert_allclose(penalty(True), penalty(False),
+                               rtol=5e-2, atol=5e-2)
