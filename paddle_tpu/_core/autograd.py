@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .tensor import Tensor
+from . import dispatch
 from . import flags
 
 __all__ = [
@@ -253,6 +254,11 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
     instead of executing — the whole op surface is static-capturable for free
     (the reference gets the same dual-mode from its YAML codegen emitting
     both dygraph ad_funcs and PIR ops).
+
+    With FLAGS_eager_op_jit on, repeated calls with the same signature route
+    through the dispatch cache (_core.dispatch): the no-grad path runs a
+    cached jax.jit of fn, the grad path a cached jitted jax.vjp pair — the
+    per-op Python retrace cost is paid once per signature, not per call.
     """
     args = _maybe_amp_cast(name, args)
     tensors = [a for a in args if isinstance(a, Tensor)]
@@ -261,9 +267,18 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
         _state.touch_recorders[-1].inputs.extend(tensors)
     needs_grad = _state.enabled and any(not t.stop_gradient for t in tensors)
 
+    handle = (dispatch.lookup(name, fn, args, kwargs, needs_grad)
+              if flags.flag("FLAGS_eager_op_jit") else None)
+
     if not needs_grad:
-        vals = [a._value if isinstance(a, Tensor) else a for a in args]
-        out = fn(*vals, **kwargs)
+        out = dispatch.FALLBACK
+        if handle is not None and handle.hit:
+            out = handle.call_nograd()
+        if out is dispatch.FALLBACK:
+            vals = [a._value if isinstance(a, Tensor) else a for a in args]
+            out = fn(*vals, **kwargs)
+            if handle is not None and not handle.hit:
+                handle.record(out)
         if flags.flag("FLAGS_check_nan_inf"):
             _check_nan_inf(name, jax.tree_util.tree_leaves(out))
 
@@ -290,12 +305,20 @@ def _apply_impl(name, fn, *args, n_outputs=None, **kwargs):
     diff_set = set(diff_idx)
     fixed_vals = [None if i in diff_set else (a._value if isinstance(a, Tensor) else a) for i, a in enumerate(args)]
 
-    def g(*diff_vals):
-        it = iter(diff_vals)
-        full = [next(it) if i in diff_set else fixed_vals[i] for i in range(len(args))]
-        return fn(*full, **kwargs)
+    res = dispatch.FALLBACK
+    if handle is not None and handle.hit:
+        res = handle.call_grad(diff_idx)
+    if res is not dispatch.FALLBACK:
+        out, vjp_fn = res
+    else:
+        def g(*diff_vals):
+            it = iter(diff_vals)
+            full = [next(it) if i in diff_set else fixed_vals[i] for i in range(len(args))]
+            return fn(*full, **kwargs)
 
-    out, vjp_fn = jax.vjp(g, *(t._value for t in diff_tensors))
+        out, vjp_fn = jax.vjp(g, *(t._value for t in diff_tensors))
+        if handle is not None and not handle.hit:
+            handle.record(out)
     flat_out, out_tree = jax.tree_util.tree_flatten(out)
     if flags.flag("FLAGS_check_nan_inf"):
         _check_nan_inf(name, flat_out)

@@ -1,0 +1,559 @@
+"""Signature-cached eager dispatch fast path (FLAGS_eager_op_jit).
+
+Every eager op funnels through ``autograd._apply_impl``.  Without this module
+the grad path re-traces the op with jax.vjp on every call and the no-grad
+path re-dispatches primitive by primitive — the per-op Python tracing cost
+the reference avoids with its generated C++ hot path.  Here each signature
+
+    (op name, fn identity, static args/kwargs, input shape/dtype avals,
+     diff-mask, needs_grad)
+
+maps to an LRU cache entry holding jitted callables:
+
+- **no-grad**: ``jax.jit`` of the op body — repeated calls skip Python
+  tracing and run one compiled XLA computation;
+- **grad**: a jitted ``jax.vjp`` pair.  The pullback jax.vjp returns is a
+  ``jax.tree_util.Partial`` (a pytree of residual arrays over a static
+  function), so the jitted forward can return it and a shared jitted
+  backward can apply it — neither retraces after the first call.
+
+Fn identity is NOT ``id(fn)``: op wrappers build a fresh lambda per call
+(``lambda v: jnp.clip(v, lo, hi)``), and a recycled id must never serve
+another op's compiled trace.  Instead a Python function is keyed by its code
+object (one per call site, held strongly so its id is stable) plus a
+by-value fingerprint of its closure cells and defaults; C callables are
+keyed by identity with a strong reference pinned in the key.
+
+Transparency rules (cache on must be observationally identical to cache
+off):
+
+- tracer inputs (inside jax.jit / vmap / grad tracing) bypass;
+- closures over arrays/Tensors/tracers/arbitrary objects bypass — this
+  automatically excludes RNG-key captures (dropout) and the create_graph
+  rebuild closures of ``_vjp_through_tape``;
+- stateful RNG consumption (``random.next_key`` without a key_scope) inside
+  a cached trace aborts the trace and permanently bypasses the entry, so
+  randomness can never be frozen into a compiled call;
+- the miss call runs the op EAGERLY and records output dtypes; the first
+  hit verifies the jitted result against them, else the entry falls back to
+  eager forever;
+- any jit failure (data-dependent output shapes, numpy calls on tracers)
+  marks the entry eager-only and re-runs eagerly, so user-visible errors
+  stay the eager ones;
+- ``set_flags()`` clears the cache (op bodies may read flags at trace
+  time) and re-applies FLAGS_eager_op_cache_size.
+
+Counters (hits / misses / traces / evictions / bypasses) surface through
+``paddle_tpu.profiler.dispatch_cache_stats()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import flags
+from . import dtype as dtype_mod
+from .tensor import Tensor
+
+__all__ = ["cache", "lookup", "DispatchCache", "FALLBACK", "in_cached_trace"]
+
+# Sentinel: "run the eager slow path instead" (None is not used — an op fn
+# could in principle return None).
+FALLBACK = object()
+
+# dtype -> is-inexact memo (jnp.issubdtype is ~10us; this path runs per op
+# call per tensor arg)
+_INEXACT: dict = {}
+
+
+def _is_inexact(dt) -> bool:
+    r = _INEXACT.get(dt)
+    if r is None:
+        r = _INEXACT[dt] = bool(jnp.issubdtype(dt, jnp.inexact))
+    return r
+
+
+class _Uncacheable(Exception):
+    """The call signature contains something we refuse to key on."""
+
+
+class _TraceEscape(Exception):
+    """Raised (via random.next_key) when a cached trace touches host-side
+    mutable state that must advance per call."""
+
+
+class _TraceGuard(threading.local):
+    def __init__(self):
+        self.active = False
+
+
+_trace_guard = _TraceGuard()
+
+
+def in_cached_trace() -> bool:
+    """True while jax is tracing an op body for this cache (consulted by
+    _core.random.next_key: stateful RNG must abort the trace)."""
+    return _trace_guard.active
+
+
+def trace_escape(reason: str):
+    """Abort the in-flight cached trace; the caller falls back to eager."""
+    raise _TraceEscape(reason)
+
+
+# --------------------------------------------------------- key normalization
+
+_SIMPLE = (type(None), bool, int, float, complex, str, bytes)
+
+
+def _norm(v, depth=0):
+    """Normalize a static value into a hashable key component.
+
+    Equal-by-value statics must produce equal components (fresh lambdas per
+    call close over new-but-equal values).  Identity-keyed components embed
+    the object itself in the key so the LRU pins it alive and its id cannot
+    be recycled into a colliding entry.
+    """
+    if isinstance(v, jax.core.Tracer) or isinstance(v, (Tensor, jax.Array, np.ndarray)):
+        raise _Uncacheable
+    if isinstance(v, _SIMPLE):
+        return (type(v).__name__, v)
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return ("np", str(v.dtype), v.item())
+    if depth > 5:
+        raise _Uncacheable
+    if isinstance(v, (tuple, list)):
+        return ("seq", isinstance(v, tuple), tuple(_norm(x, depth + 1) for x in v))
+    if isinstance(v, dict):
+        try:
+            items = sorted(v.items())
+        except TypeError as e:
+            raise _Uncacheable from e
+        return ("dict", tuple((k, _norm(x, depth + 1)) for k, x in items))
+    if isinstance(v, (set, frozenset)):
+        return ("set", frozenset(_norm(x, depth + 1) for x in v))
+    if isinstance(v, slice):
+        return ("slice", _norm(v.start, depth + 1), _norm(v.stop, depth + 1),
+                _norm(v.step, depth + 1))
+    if isinstance(v, np.dtype):
+        return ("npdtype", str(v))
+    if isinstance(v, dtype_mod.DType):
+        return ("pdtype", str(v))
+    if isinstance(v, functools.partial):
+        return ("partial", _norm(v.func, depth + 1), _norm(tuple(v.args), depth + 1),
+                _norm(v.keywords or {}, depth + 1))
+    if isinstance(v, type) or callable(v):
+        return ("id", id(v), v)
+    raise _Uncacheable
+
+
+def _fn_key(fn):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # C function / builtin / jnp ufunc object: stable module-level
+        # singletons — identity with a pinned reference.
+        return ("cfn", id(fn), fn)
+    parts = [("code", id(code), code)]
+    self_obj = getattr(fn, "__self__", None)
+    if self_obj is not None:  # bound method: the instance is part of identity
+        parts.append(("self", id(self_obj), self_obj))
+    if getattr(fn, "__defaults__", None):
+        parts.append(_norm(fn.__defaults__))
+    if getattr(fn, "__kwdefaults__", None):
+        parts.append(_norm(fn.__kwdefaults__))
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        try:
+            parts.append(tuple(_norm(c.cell_contents) for c in closure))
+        except ValueError as e:  # empty cell
+            raise _Uncacheable from e
+    return ("fn", tuple(parts))
+
+
+# ------------------------------------------------------------------- entries
+
+
+# Hits served eagerly before a signature is considered hot enough to pay a
+# compile: after the miss, _HOT_CALLS repeats run eager, so the compile
+# lands on call _HOT_CALLS+2 (the 4th) of a signature.  Test-style
+# workloads touching a signature a few times never compile (a compile
+# would be pure loss there); loops cross the ramp immediately.
+_HOT_CALLS = 2
+
+
+class _Entry:
+    """Per-signature state.  Deliberately does NOT pin the recording call's
+    fn/args: the jit is built from the fn of the call that crosses the
+    hotness ramp — that fn's closure provably equals the key by value (the
+    key was just built from it), whereas the first call's closure cells may
+    have been mutated by the caller since recording."""
+
+    __slots__ = ("out_meta", "ngrad_jit", "fwd_jit", "bwd_jit", "bypass",
+                 "verified", "uses")
+
+    def __init__(self):
+        self.out_meta = None  # [(dtype, weak_type)] from the eager miss
+        self.ngrad_jit = None
+        self.fwd_jit = None
+        # per-entry (not module-global) so LRU eviction / clear() releases
+        # the compiled backward executable along with the forward
+        self.bwd_jit = None
+        self.bypass = False
+        self.verified = False
+        self.uses = 0  # hit count while still below _HOT_CALLS
+
+
+class DispatchCache:
+    """LRU over dispatch signatures with hit/miss/trace/eviction counters."""
+
+    def __init__(self, maxsize: int = 1024):
+        self._lock = threading.RLock()
+        self._entries: OrderedDict = OrderedDict()
+        self.maxsize = max(1, int(maxsize))
+        self.hits = 0
+        self.misses = 0
+        self.traces = 0
+        self.evictions = 0
+        self.bypasses = 0
+
+    def get(self, key):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+            return e
+
+    def put(self, key, entry):
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def resize(self, maxsize: int):
+        with self._lock:
+            self.maxsize = max(1, int(maxsize))
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def reset_stats(self):
+        self.hits = self.misses = self.traces = 0
+        self.evictions = self.bypasses = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "traces": self.traces,
+            "evictions": self.evictions,
+            "bypasses": self.bypasses,
+            "size": len(self._entries),
+            "capacity": self.maxsize,
+            "enabled": bool(flags.flag("FLAGS_eager_op_jit")),
+        }
+
+
+cache = DispatchCache(int(flags.flag("FLAGS_eager_op_cache_size")))
+
+
+@flags.on_change
+def _on_flags_change(_changed):
+    # Any flag may be read inside an op body at trace time
+    # (FLAGS_tpu_matmul_precision, FLAGS_default_dtype, ...): drop every
+    # cached trace rather than track per-flag dependencies.
+    cache.resize(int(flags.flag("FLAGS_eager_op_cache_size")))
+    cache.clear()
+
+
+# ------------------------------------------------------------ jit factories
+
+
+def _make_nograd_jit(handle):
+    fn, kwargs = handle.fn, dict(handle.kwargs)
+    statics, dyn_pos = handle.statics, handle.dyn_pos
+
+    def run(dyn_vals):
+        # Body executes only while jax traces (then the compiled call is
+        # served from jax's own cache) — the counter counts real traces.
+        cache.traces += 1
+        _trace_guard.active = True
+        try:
+            full = list(statics)
+            for p, v in zip(dyn_pos, dyn_vals):
+                full[p] = v
+            return fn(*full, **kwargs)
+        finally:
+            _trace_guard.active = False
+
+    return jax.jit(run)
+
+
+def _prefers_eager(handle, dyn_vals) -> bool:
+    """Trace the op once and count primitives: a 1-2 primitive body gains
+    nothing from a cached jit on the no-grad path (eager jax already serves
+    each primitive from its C++ cache; the Python jit-call overhead would
+    dominate), so such entries run eager.  Composites — where one fused
+    compiled call replaces N dispatches — keep the jit.  Grad-path entries
+    never come through here: uncached vjp pays a full retrace per call, so
+    caching always wins there."""
+    fn, kwargs = handle.fn, dict(handle.kwargs)
+    statics, dyn_pos = handle.statics, handle.dyn_pos
+
+    def run(dyn):
+        full = list(statics)
+        for p, v in zip(dyn_pos, dyn):
+            full[p] = v
+        return fn(*full, **kwargs)
+
+    cache.traces += 1
+    _trace_guard.active = True
+    try:
+        jaxpr = jax.make_jaxpr(run)(tuple(dyn_vals))
+    finally:
+        _trace_guard.active = False
+    return len(jaxpr.jaxpr.eqns) <= 2
+
+
+def _make_fwd_jit(handle):
+    fn, kwargs = handle.fn, dict(handle.kwargs)
+    statics, diff_pos = handle.statics, handle.diff_pos
+    diff_set = set(diff_pos)
+    nondiff_pos = [p for p in handle.dyn_pos if p not in diff_set]
+
+    def fwd(diff_vals, nondiff_vals):
+        cache.traces += 1
+        _trace_guard.active = True
+        try:
+            base = list(statics)
+            for p, v in zip(nondiff_pos, nondiff_vals):
+                base[p] = v
+
+            def g(*dv):
+                full = list(base)
+                for p, v in zip(diff_pos, dv):
+                    full[p] = v
+                return fn(*full, **kwargs)
+
+            # The pullback is a tree_util.Partial: residual arrays over a
+            # static function — a legal jit output.
+            return jax.vjp(g, *diff_vals)
+        finally:
+            _trace_guard.active = False
+
+    return jax.jit(fwd)
+
+
+def _bwd(vjp_partial, cot):
+    cache.traces += 1
+    return vjp_partial(cot)
+
+
+class _CachedVjp:
+    """GradNode.vjp_fn for cached nodes: applies the residual-carrying
+    Partial through the entry's jitted backward (compiled once per op
+    trace, since every hit of one entry returns Partials with the same
+    treedef)."""
+
+    __slots__ = ("partial", "bwd_jit")
+
+    def __init__(self, partial, bwd_jit):
+        self.partial = partial
+        self.bwd_jit = bwd_jit
+
+    def __call__(self, cot):
+        try:
+            return self.bwd_jit(self.partial, cot)
+        except Exception:
+            # Transparency: whatever the jitted application rejects, the
+            # plain pullback still handles.
+            return self.partial(cot)
+
+
+def _verify(entry, out) -> bool:
+    """First-hit check that the jitted result matches the eager miss call's
+    output leaf dtypes (guards weak-type / scalar-promotion drift)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    meta = entry.out_meta
+    if meta is None or len(leaves) != len(meta):
+        return False
+    for v, (dt, weak) in zip(leaves, meta):
+        if (not isinstance(v, jax.Array) or v.dtype != dt
+                or bool(getattr(v, "weak_type", False)) != weak):
+            return False
+    entry.verified = True
+    return True
+
+
+# ------------------------------------------------------------------- lookup
+
+
+class _Handle:
+    """One dispatch attempt: the built key plus the split arg values."""
+
+    __slots__ = ("key", "entry", "hit", "fn", "kwargs", "statics", "dyn_pos",
+                 "diff_pos", "dyn_vals")
+
+    def call_nograd(self):
+        e = self.entry
+        if e.ngrad_jit is None and e.uses < _HOT_CALLS:
+            # hotness ramp: served eager — reclassify the lookup's hit
+            # (locked: e.uses and the hit/bypass swap are read-modify-write)
+            with cache._lock:
+                e.uses += 1
+                cache.hits -= 1
+                cache.bypasses += 1
+            return FALLBACK
+        try:
+            if e.ngrad_jit is None:
+                # under the lock so concurrent threads share one jit wrapper
+                # (jax then dedupes the compile) instead of tracing twice
+                with cache._lock:
+                    if e.ngrad_jit is None:
+                        if _prefers_eager(self, self.dyn_vals):
+                            e.bypass = True
+                            cache.bypasses += 1
+                            return FALLBACK
+                        e.ngrad_jit = _make_nograd_jit(self)
+            out = e.ngrad_jit(tuple(self.dyn_vals))
+        except Exception:
+            e.bypass = True
+            cache.bypasses += 1
+            return FALLBACK
+        if not e.verified and not _verify(e, out):
+            e.bypass = True
+            cache.bypasses += 1
+            return FALLBACK
+        return out
+
+    def call_grad(self, diff_idx):
+        e = self.entry
+        if diff_idx != self.diff_pos:  # partition drift: never serve a stale trace
+            e.bypass = True
+            cache.bypasses += 1
+            return FALLBACK
+        if e.fwd_jit is None and e.uses < _HOT_CALLS:
+            # hotness ramp: served eager — reclassify the lookup's hit
+            # (locked: e.uses and the hit/bypass swap are read-modify-write)
+            with cache._lock:
+                e.uses += 1
+                cache.hits -= 1
+                cache.bypasses += 1
+            return FALLBACK
+        diff_set = set(self.diff_pos)
+        diff_vals, nondiff_vals = [], []
+        for p, v in zip(self.dyn_pos, self.dyn_vals):
+            (diff_vals if p in diff_set else nondiff_vals).append(v)
+        try:
+            if e.fwd_jit is None:
+                with cache._lock:
+                    if e.fwd_jit is None:
+                        e.bwd_jit = jax.jit(_bwd)
+                        e.fwd_jit = _make_fwd_jit(self)
+            out, partial = e.fwd_jit(tuple(diff_vals), tuple(nondiff_vals))
+        except Exception:
+            e.bypass = True
+            cache.bypasses += 1
+            return FALLBACK
+        if not e.verified and not _verify(e, out):
+            e.bypass = True
+            cache.bypasses += 1
+            return FALLBACK
+        return out, _CachedVjp(partial, e.bwd_jit)
+
+    def record(self, out):
+        """After the eager miss run: store the entry (jits build lazily,
+        from the fn of the call that crosses the hotness ramp).  Non-array
+        output leaves mark the op eager-only."""
+        entry = _Entry()
+        meta = []
+        for v in jax.tree_util.tree_leaves(out):
+            if isinstance(v, jax.core.Tracer) or not isinstance(v, jax.Array):
+                entry.bypass = True
+                break
+            meta.append((v.dtype, bool(getattr(v, "weak_type", False))))
+        else:
+            entry.out_meta = meta
+        cache.put(self.key, entry)
+
+
+def lookup(name, fn, args, kwargs, needs_grad):
+    """Build the signature for this call; return a _Handle, or None when the
+    call must take the eager slow path (uncacheable / tracers / bypassed)."""
+    try:
+        arg_key, statics, dyn_pos, dyn_vals, diff_pos = [], [], [], [], []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                v = a._value
+                if isinstance(v, jax.core.Tracer) or not isinstance(v, jax.Array):
+                    cache.bypasses += 1
+                    return None
+                diff = (needs_grad and not a.stop_gradient
+                        and _is_inexact(v.dtype))
+                arg_key.append(("T", v.shape, v.dtype,
+                                bool(getattr(v, "weak_type", False)), diff))
+                statics.append(None)
+                dyn_pos.append(i)
+                dyn_vals.append(v)
+                if diff:
+                    diff_pos.append(i)
+            elif isinstance(a, jax.core.Tracer):
+                cache.bypasses += 1
+                return None
+            elif isinstance(a, jax.Array):
+                arg_key.append(("A", a.shape, a.dtype,
+                                bool(getattr(a, "weak_type", False))))
+                statics.append(None)
+                dyn_pos.append(i)
+                dyn_vals.append(a)
+            elif isinstance(a, np.ndarray):
+                # numpy positional args keep numpy semantics inside fn; a
+                # traced call would hand fn a tracer instead — stay eager.
+                cache.bypasses += 1
+                return None
+            else:
+                arg_key.append(("S", _norm(a)))
+                # shallow-copy containers so a caller mutating its arg after
+                # the call cannot skew the baked statics
+                statics.append(list(a) if isinstance(a, list)
+                               else dict(a) if isinstance(a, dict) else a)
+        key = (name, bool(needs_grad), _fn_key(fn), tuple(arg_key),
+               _norm(kwargs) if kwargs else None)
+        entry = cache.get(key)  # in the try: an unhashable __hash__ bypasses
+    except (_Uncacheable, TypeError, ValueError):
+        cache.bypasses += 1
+        return None
+
+    h = _Handle()
+    h.key = key
+    h.fn = fn
+    h.kwargs = kwargs
+    h.statics = statics
+    h.dyn_pos = dyn_pos
+    h.diff_pos = diff_pos
+    h.dyn_vals = dyn_vals
+
+    if entry is None:
+        cache.misses += 1
+        h.entry, h.hit = None, False
+    elif entry.bypass:
+        cache.bypasses += 1
+        return None
+    else:
+        cache.hits += 1
+        h.entry, h.hit = entry, True
+    return h

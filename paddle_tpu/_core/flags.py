@@ -10,9 +10,20 @@ from __future__ import annotations
 import os
 from typing import Any
 
-__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "on_change"]
 
 _FLAGS: dict[str, dict[str, Any]] = {}
+
+# Callbacks fired after every set_flags() with the list of changed flag
+# names.  The eager dispatch cache registers one: op bodies may read flags
+# at trace time, so any flag change must invalidate cached traces.
+_listeners: list = []
+
+
+def on_change(callback):
+    """Register `callback(changed_names)` to run after each set_flags()."""
+    _listeners.append(callback)
+    return callback
 
 
 def _coerce(value, default):
@@ -55,12 +66,20 @@ def get_flags(flags=None) -> dict:
 
 
 def set_flags(flags: dict):
+    changed = []
     for name, value in flags.items():
         key = name if name.startswith("FLAGS_") else "FLAGS_" + name
         if key not in _FLAGS:
             define_flag(key, value)
         else:
-            _FLAGS[key]["value"] = _coerce(value, _FLAGS[key]["default"])
+            new = _coerce(value, _FLAGS[key]["default"])
+            if new == _FLAGS[key]["value"]:
+                continue  # no-op re-set: don't invalidate listeners' caches
+            _FLAGS[key]["value"] = new
+        changed.append(key)
+    if changed:
+        for cb in list(_listeners):
+            cb(changed)
 
 
 # Core flags (subset of the reference's 71 exported flags that are meaningful on TPU).
@@ -68,6 +87,11 @@ define_flag("FLAGS_check_nan_inf", False, "Scan op outputs for NaN/Inf in eager 
 define_flag("FLAGS_default_dtype", "float32", "Default floating dtype for creation ops")
 define_flag("FLAGS_tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
 define_flag("FLAGS_eager_op_jit", True, "Route eager composite ops through cached jax.jit")
+define_flag(
+    "FLAGS_eager_op_cache_size",
+    1024,
+    "Max entries in the eager dispatch fast-path cache (LRU; see _core.dispatch)",
+)
 define_flag(
     "FLAGS_use_pallas_fusion",
     True,

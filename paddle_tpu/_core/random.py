@@ -50,6 +50,17 @@ def next_key():
     Inside `key_scope(step_key)` (used by jitted training steps) the returned
     key derives from the scoped key, so it is a proper traced value.
     """
+    from . import dispatch
+
+    if dispatch.in_cached_trace():
+        # A cached jit would freeze the key AND the counter offset into the
+        # compiled op — abort the trace BEFORE consuming a counter tick; the
+        # dispatch cache marks the op eager-only and re-runs it eagerly, so
+        # the random stream matches cache-off exactly.  This covers both
+        # the global-seed path and an eagerly-installed key_scope (a
+        # concrete scoped key would bake just the same; a tracer scoped key
+        # can't appear here, since tracer op inputs bypass the cache).
+        dispatch.trace_escape("stateful next_key() inside a cached op trace")
     c = _rng.counter
     _rng.counter += 1
     if _rng.trace_key is not None:

@@ -388,6 +388,8 @@ def _unflatten(aux, children):
     t._out_index = 0
     t._hooks = []
     t.persistable = False
+    t.process_mesh = None
+    t.placements = None
     return t
 
 
@@ -406,6 +408,8 @@ def _unflatten_param(aux, children):
     p._grad_node = None
     p._out_index = 0
     p._hooks = []
+    p.process_mesh = None
+    p.placements = None
     p.trainable = not p.stop_gradient
     p.optimize_attr = {"learning_rate": 1.0}
     p.regularizer = None

@@ -120,7 +120,7 @@ class ProcessGroup:
         mesh = self._ring_mesh()
 
         def builder():
-            from jax import shard_map
+            from paddle_tpu.distributed.shard_map_compat import shard_map
 
             f = shard_map(
                 body, mesh=mesh, in_specs=PartitionSpec("ring"),

@@ -62,7 +62,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from paddle_tpu.distributed.shard_map_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
 from paddle_tpu._core.autograd import apply, no_grad

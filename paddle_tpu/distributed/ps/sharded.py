@@ -118,7 +118,9 @@ class MeshShardedEmbedding:
     def _pull_program(self, cap):
         import jax
         import jax.numpy as jnp
-        from jax import lax, shard_map
+        from jax import lax
+
+        from paddle_tpu.distributed.shard_map_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         axis, local_rows = self.axis, self.local_rows
@@ -143,7 +145,9 @@ class MeshShardedEmbedding:
     def _push_program(self, cap):
         import jax
         import jax.numpy as jnp
-        from jax import lax, shard_map
+        from jax import lax
+
+        from paddle_tpu.distributed.shard_map_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         axis, local_rows, lr = self.axis, self.local_rows, self.lr

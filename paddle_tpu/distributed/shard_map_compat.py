@@ -1,0 +1,41 @@
+"""shard_map across jax versions.
+
+jax>=0.6 exposes ``jax.shard_map(f, mesh=, in_specs=, out_specs=,
+axis_names=, check_vma=)``; older jax ships
+``jax.experimental.shard_map.shard_map`` which takes ``check_rep`` instead
+of ``check_vma`` and spells partial-manual as ``auto`` (the complement of
+the manual axes) instead of ``axis_names``.  This adapter translates the
+new-style kwargs the callers in this package use, so a jax<0.6 runtime
+runs them instead of failing at import or with an opaque TypeError.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map_new
+
+    _NEW_API = True
+except ImportError:  # jax<0.6
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    _NEW_API = False
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None, **kwargs):
+    if _NEW_API:
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _shard_map_new(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+    if axis_names is not None and set(axis_names) != set(mesh.axis_names):
+        # old API spells partial-manual as `auto` = the complement set
+        kwargs["auto"] = frozenset(set(mesh.axis_names) - set(axis_names))
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)

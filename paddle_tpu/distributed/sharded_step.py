@@ -51,13 +51,17 @@ def _as_process_mesh(mesh) -> ProcessMesh:
 def shard_batch(mesh: ProcessMesh, batch_vals, spec):
     """Place batch arrays with `spec` (a PartitionSpec or one per leaf)."""
     leaves, tree = jax.tree_util.tree_flatten(batch_vals)
-    if isinstance(spec, (list, tuple)) and len(spec) == len(leaves):
+    # On jax<0.6 PartitionSpec subclasses tuple: a single spec must not be
+    # mistaken for a per-leaf list (its entries would be char-splatted).
+    if (isinstance(spec, (list, tuple)) and not isinstance(spec, PartitionSpec)
+            and len(spec) == len(leaves)):
         specs = list(spec)
     else:
         specs = [spec] * len(leaves)
     placed = []
     for v, s in zip(leaves, specs):
-        s = s if isinstance(s, PartitionSpec) else PartitionSpec(*s)
+        if not isinstance(s, PartitionSpec):
+            s = PartitionSpec(s) if isinstance(s, str) else PartitionSpec(*s)
         # drop spec entries beyond the array rank
         entries = list(s)[: getattr(v, "ndim", 0)]
         placed.append(jax.device_put(v, NamedSharding(mesh.jax_mesh, PartitionSpec(*entries))))

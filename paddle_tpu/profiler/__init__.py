@@ -212,12 +212,17 @@ class Profiler:
                 time_unit="ms", views=None):
         """Aggregated statistics tables (reference profiler_statistic.py):
         Overview + per-category (Operator/Dataloader/UserDefined/...) tables
-        with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys."""
-        from .statistics import summary_text
+        with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys.
+        Ends with the eager dispatch-cache counters when the fast path has
+        seen traffic."""
+        from .statistics import dispatch_cache_line, summary_text
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
                            time_unit=time_unit, views=views)
+        cache_line = dispatch_cache_line(dispatch_cache_stats())
+        if cache_line:
+            out = out + "\n" + cache_line
         print(out)
         return out
 
@@ -287,6 +292,32 @@ def export_protobuf(path=None):
 
 
 __all__ += ["SortedKeys", "SummaryView", "export_protobuf"]
+
+
+def dispatch_cache_stats(reset: bool = False) -> dict:
+    """Counters of the eager dispatch fast path (FLAGS_eager_op_jit):
+    hits / misses / traces / evictions / bypasses plus size, capacity and
+    whether the path is enabled.  `reset=True` zeroes the counters (cached
+    entries stay).  A healthy steady-state training loop shows hits
+    dominating with traces flat; climbing traces mean shape/dtype churn is
+    defeating the cache."""
+    from paddle_tpu._core import dispatch
+
+    stats = dispatch.cache.stats()
+    if reset:
+        dispatch.cache.reset_stats()
+    return stats
+
+
+def reset_dispatch_cache():
+    """Drop every cached dispatch entry and zero the counters."""
+    from paddle_tpu._core import dispatch
+
+    dispatch.cache.clear()
+    dispatch.cache.reset_stats()
+
+
+__all__ += ["dispatch_cache_stats", "reset_dispatch_cache"]
 
 
 def _compile_and_analyze(fn, example_args):

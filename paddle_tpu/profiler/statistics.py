@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-__all__ = ["EventSummary", "StatisticData", "summary_text"]
+__all__ = ["EventSummary", "StatisticData", "summary_text", "dispatch_cache_line"]
 
 _UNITS = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
 
@@ -171,3 +171,19 @@ def summary_text(spans, step_spans=(), sorted_by=None, op_detail=True,
         lines.append(
             f"steps: {n}  avg step: {data.wall_ns / n / _UNITS[u]:.3f} {u}")
     return "\n".join(lines)
+
+
+def dispatch_cache_line(stats: dict) -> str:
+    """One-line rendering of the eager dispatch-cache counters for
+    Profiler.summary(); empty when the fast path has seen no traffic."""
+    if not (stats.get("hits") or stats.get("misses") or stats.get("bypasses")):
+        return ""
+    total = stats["hits"] + stats["misses"]
+    rate = 100.0 * stats["hits"] / total if total else 0.0
+    return (
+        "Eager dispatch cache [%s]: hits=%d misses=%d (%.1f%% hit) traces=%d "
+        "evictions=%d bypasses=%d entries=%d/%d"
+        % ("on" if stats.get("enabled") else "off", stats["hits"],
+           stats["misses"], rate, stats["traces"], stats["evictions"],
+           stats["bypasses"], stats["size"], stats["capacity"])
+    )

@@ -14,6 +14,30 @@ import pytest
 import paddle_tpu as paddle
 
 
+def test_no_dead_flags():
+    """Every define_flag() name must be read back via flag() somewhere in
+    the package.  FLAGS_eager_op_jit sat defined-but-unread for five rounds
+    before the dispatch cache wired it; this lint stops flags rotting
+    silently again."""
+    import pathlib
+
+    pkg = pathlib.Path(paddle.__file__).parent
+    sources = [p.read_text() for p in pkg.rglob("*.py")]
+    defined = set()
+    reads = set()
+    for src in sources:
+        for m in re.finditer(r"define_flag\(\s*['\"]([A-Za-z0-9_]+)['\"]", src):
+            name = m.group(1)
+            defined.add(name if name.startswith("FLAGS_") else "FLAGS_" + name)
+        # flag("...") reads, excluding define_flag/get_flags/set_flags
+        for m in re.finditer(r"(?<![_A-Za-z])flag\(\s*['\"]([A-Za-z0-9_]+)['\"]", src):
+            name = m.group(1)
+            reads.add(name if name.startswith("FLAGS_") else "FLAGS_" + name)
+    assert defined, "flag registry scan found nothing"
+    dead = sorted(defined - reads)
+    assert not dead, f"dead flags (defined but never read via flag()): {dead}"
+
+
 def test_reference_top_level_surface_complete():
     src = open("/root/reference/python/paddle/__init__.py").read()
     m = re.search(r"__all__ = \[(.*?)\]", src, re.S)

@@ -1,0 +1,35 @@
+"""Tier-1 smoke of benchmarks/bench_dispatch.py.
+
+Unlike the slow-marked bench.py harness test, this runs in every tier-1
+pass (tiny sizes): the dispatch-cache perf harness must keep emitting the
+one-line JSON payload the driver parses, and its built-in cache-on vs
+cache-off numerics gate must hold — so the perf path can't bitrot
+unexercised between measured rounds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_dispatch_smoke_emits_valid_json():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PADDLE_TPU_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "bench_dispatch.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert out.returncode == 0, (out.stderr or out.stdout)[-800:]
+    line = next(ln for ln in reversed(out.stdout.splitlines()) if ln.startswith("{"))
+    payload = json.loads(line)
+    assert payload["metric"] == "eager_dispatch_cached_train_speedup"
+    assert payload["unit"] == "x"
+    assert payload["value"] > 0
+    assert "vs_baseline" in payload
+    assert payload["numerics_identical"] is True
+    detail = payload["detail"]
+    for section in ("train", "grad_ops", "fwd_ops"):
+        assert detail[section]["on_per_sec"] > 0
+        assert detail[section]["off_per_sec"] > 0
+    # the cached runs actually exercised the cache
+    assert detail["train"]["cache_hits"] > 0
