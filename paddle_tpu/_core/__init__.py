@@ -10,4 +10,4 @@ parity (reference: python/paddle/framework/dtype.py) but map to their 32-bit
 widths at the jax boundary (_core/dtype.py:to_jax_dtype).
 """
 
-from . import autograd, dtype, flags, place, random, tensor  # noqa: F401
+from . import autograd, compile_cache, dtype, flags, place, random, tensor  # noqa: F401

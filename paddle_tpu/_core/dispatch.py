@@ -153,6 +153,31 @@ def _norm(v, depth=0):
     raise _Uncacheable
 
 
+class _WeakIdRef:
+    """Identity-keyed cache component holding its object WEAKLY: equal only
+    when both referents are alive and the same object (never referent
+    __eq__, which is elementwise for Tensor-likes).  A recycled id pairs
+    with a DEAD ref that equals nothing — the stale entry just misses and
+    ages out of the LRU instead of colliding or pinning the object."""
+
+    __slots__ = ("ref", "_id")
+
+    def __init__(self, obj):
+        import weakref as _weakref
+
+        self.ref = _weakref.ref(obj)
+        self._id = id(obj)
+
+    def __hash__(self):
+        return self._id
+
+    def __eq__(self, other):
+        if not isinstance(other, _WeakIdRef):
+            return NotImplemented
+        a = self.ref()
+        return a is not None and a is other.ref()
+
+
 def _fn_key(fn):
     code = getattr(fn, "__code__", None)
     if code is None:
@@ -161,8 +186,15 @@ def _fn_key(fn):
         return ("cfn", id(fn), fn)
     parts = [("code", id(code), code)]
     self_obj = getattr(fn, "__self__", None)
-    if self_obj is not None:  # bound method: the instance is part of identity
-        parts.append(("self", id(self_obj), self_obj))
+    if self_obj is not None:
+        # bound method: the instance is part of identity, but held WEAKLY —
+        # pinning it would keep e.g. a LayerStack's stacked weights alive in
+        # the LRU after the model is dropped (see _WeakIdRef for why id
+        # recycling cannot collide).
+        try:
+            parts.append(("self", id(self_obj), _WeakIdRef(self_obj)))
+        except TypeError:  # not weakref-able: pin strongly like before
+            parts.append(("self", id(self_obj), self_obj))
     if getattr(fn, "__defaults__", None):
         parts.append(_norm(fn.__defaults__))
     if getattr(fn, "__kwdefaults__", None):
@@ -283,22 +315,49 @@ def _on_flags_change(_changed):
 # ------------------------------------------------------------ jit factories
 
 
+def _weak_fn(fn):
+    """Return a zero-arg getter for `fn` that does not pin a bound method's
+    receiver: the entry's key already only weak-holds the receiver
+    (_WeakIdRef), so the stored jit closure must not re-pin it — else a
+    dropped LayerStack's stacked weights live on inside the LRU.  A dead
+    receiver is unreachable through lookup (its key never matches), so the
+    getter can only fire while the receiver is alive."""
+    self_obj = getattr(fn, "__self__", None)
+    if self_obj is None:
+        return lambda: fn
+    import weakref
+
+    func, ref = fn.__func__, weakref.ref(self_obj)
+
+    def get():
+        obj = ref()
+        if obj is None:  # unreachable via cache lookup; defensive only
+            raise ReferenceError("dispatch-cache receiver was collected")
+        return func.__get__(obj)
+
+    return get
+
+
 def _make_nograd_jit(handle):
-    fn, kwargs = handle.fn, dict(handle.kwargs)
+    get_fn, kwargs = _weak_fn(handle.fn), dict(handle.kwargs)
     statics, dyn_pos = handle.statics, handle.dyn_pos
 
     def run(dyn_vals):
         # Body executes only while jax traces (then the compiled call is
         # served from jax's own cache) — the counter counts real traces.
+        # Guard save/restore (not =False): a nested cached dispatch inside
+        # an outer cached trace must not clear the outer trace's guard —
+        # that would let a later next_key() in the outer body skip its
+        # freeze-escape and bake a concrete key into the compiled op.
         cache.traces += 1
-        _trace_guard.active = True
+        prev, _trace_guard.active = _trace_guard.active, True
         try:
             full = list(statics)
             for p, v in zip(dyn_pos, dyn_vals):
                 full[p] = v
-            return fn(*full, **kwargs)
+            return get_fn()(*full, **kwargs)
         finally:
-            _trace_guard.active = False
+            _trace_guard.active = prev
 
     return jax.jit(run)
 
@@ -321,24 +380,25 @@ def _prefers_eager(handle, dyn_vals) -> bool:
         return fn(*full, **kwargs)
 
     cache.traces += 1
-    _trace_guard.active = True
+    prev, _trace_guard.active = _trace_guard.active, True
     try:
         jaxpr = jax.make_jaxpr(run)(tuple(dyn_vals))
     finally:
-        _trace_guard.active = False
+        _trace_guard.active = prev
     return len(jaxpr.jaxpr.eqns) <= 2
 
 
 def _make_fwd_jit(handle):
-    fn, kwargs = handle.fn, dict(handle.kwargs)
+    get_fn, kwargs = _weak_fn(handle.fn), dict(handle.kwargs)
     statics, diff_pos = handle.statics, handle.diff_pos
     diff_set = set(diff_pos)
     nondiff_pos = [p for p in handle.dyn_pos if p not in diff_set]
 
     def fwd(diff_vals, nondiff_vals):
         cache.traces += 1
-        _trace_guard.active = True
+        prev, _trace_guard.active = _trace_guard.active, True
         try:
+            fn = get_fn()
             base = list(statics)
             for p, v in zip(nondiff_pos, nondiff_vals):
                 base[p] = v
@@ -353,7 +413,7 @@ def _make_fwd_jit(handle):
             # static function — a legal jit output.
             return jax.vjp(g, *diff_vals)
         finally:
-            _trace_guard.active = False
+            _trace_guard.active = prev
 
     return jax.jit(fwd)
 
@@ -420,15 +480,23 @@ class _Handle:
             return FALLBACK
         try:
             if e.ngrad_jit is None:
-                # under the lock so concurrent threads share one jit wrapper
-                # (jax then dedupes the compile) instead of tracing twice
+                # primitive-count probe OUTSIDE the lock: it traces the op
+                # body (seconds for a big composite), and cache._lock is the
+                # global lock every lookup takes — holding it would stall
+                # all other threads' dispatch.  A racing duplicate probe is
+                # harmless (deterministic outcome, jax dedupes compiles).
+                prefers = _prefers_eager(self, self.dyn_vals)
                 with cache._lock:
-                    if e.ngrad_jit is None:
-                        if _prefers_eager(self, self.dyn_vals):
+                    # under the lock so concurrent threads share one jit
+                    # wrapper (jax then dedupes the compile)
+                    if e.ngrad_jit is None and not e.bypass:
+                        if prefers:
                             e.bypass = True
-                            cache.bypasses += 1
-                            return FALLBACK
-                        e.ngrad_jit = _make_nograd_jit(self)
+                        else:
+                            e.ngrad_jit = _make_nograd_jit(self)
+                if e.ngrad_jit is None:  # bypassed (by us or a peer)
+                    cache.bypasses += 1
+                    return FALLBACK
             out = e.ngrad_jit(tuple(self.dyn_vals))
         except Exception:
             e.bypass = True

@@ -93,6 +93,18 @@ define_flag(
     "Max entries in the eager dispatch fast-path cache (LRU; see _core.dispatch)",
 )
 define_flag(
+    "FLAGS_scan_layers",
+    False,
+    "Force nn.LayerStack scan-over-layers for models with a fuse_layer_stack "
+    "config knob (depth-constant trace/compile; models/llama.py, models/gpt.py)",
+)
+define_flag(
+    "FLAGS_compilation_cache_dir",
+    "",
+    "Directory for JAX's persistent XLA compilation cache: warm process "
+    "starts reload compiled steps from disk (_core.compile_cache)",
+)
+define_flag(
     "FLAGS_use_pallas_fusion",
     True,
     "Substitute attention/rms-norm/swiglu subgraphs in captured Programs "

@@ -52,14 +52,17 @@ def next_key():
     """
     from . import dispatch
 
-    if dispatch.in_cached_trace():
+    if dispatch.in_cached_trace() and not isinstance(_rng.trace_key, jax.core.Tracer):
         # A cached jit would freeze the key AND the counter offset into the
         # compiled op — abort the trace BEFORE consuming a counter tick; the
         # dispatch cache marks the op eager-only and re-runs it eagerly, so
         # the random stream matches cache-off exactly.  This covers both
-        # the global-seed path and an eagerly-installed key_scope (a
-        # concrete scoped key would bake just the same; a tracer scoped key
-        # can't appear here, since tracer op inputs bypass the cache).
+        # the global-seed path and an eagerly-installed CONCRETE key_scope
+        # (a concrete scoped key would bake just the same).  A TRACER scoped
+        # key is safe to cache through: the key is a dynamic input of the
+        # trace (LayerStack threads a fresh key per call and key_scopes a
+        # split of it inside its scan body), and the counter offsets folded
+        # into it are the deterministic per-op sequence key_scope defines.
         dispatch.trace_escape("stateful next_key() inside a cached op trace")
     c = _rng.counter
     _rng.counter += 1

@@ -72,12 +72,17 @@ from paddle_tpu.nn import Layer
 
 def _pvary(x, axes):
     # jax>=0.9 renames pvary -> pcast(..., to='varying'); support both.
+    # jax<0.6 has neither AND no varying-manual-axes type system — there
+    # shard_map(check_rep=False) accepts replicated values directly, so the
+    # cast is correctly a no-op.
     # Idempotent: values already varying over the axes pass through — but
     # only that case; any other ValueError (bad axis name, bad to=) raises.
     try:
         if hasattr(lax, "pcast"):
             return lax.pcast(x, axes, to="varying")
-        return lax.pvary(x, axes)
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, axes)
+        return x
     except ValueError as e:
         if "from=varying" in str(e) or "already" in str(e):
             return x

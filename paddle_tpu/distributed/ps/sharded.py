@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from paddle_tpu.distributed.shard_map_compat import axis_size as _axis_size
+
 __all__ = ["MeshShardedEmbedding"]
 
 
@@ -41,7 +43,7 @@ def _routed_exchange(ids_local, axis, local_rows, cap):
     from jax import lax
 
     n = ids_local.shape[0]
-    w = lax.axis_size(axis)
+    w = _axis_size(axis)
     owner = jnp.clip(ids_local // local_rows, 0, w - 1)
     order = jnp.argsort(owner, stable=True)
     inv = jnp.argsort(order)
@@ -158,7 +160,7 @@ class MeshShardedEmbedding:
             recv_ids, recv_mask, order, so, pos, _inv, valid = _routed_exchange(
                 ids_local, axis, local_rows, cap)
             gs = g_local[order]  # the id-routing permutation routes payloads
-            gsend = jnp.zeros((lax.axis_size(axis), cap, g_local.shape[-1]),
+            gsend = jnp.zeros((_axis_size(axis), cap, g_local.shape[-1]),
                               g_local.dtype).at[so, pos].set(
                 gs * valid[:, None].astype(g_local.dtype), mode="drop")
             grecv = lax.all_to_all(gsend, axis, split_axis=0, concat_axis=0)

@@ -20,7 +20,17 @@ except ImportError:  # jax<0.6
 
     _NEW_API = False
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "axis_size"]
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` across jax versions: jax<0.6 has no axis_size;
+    ``psum(1, axis)`` constant-folds to the mapped axis size there."""
+    import jax.lax as _lax
+
+    if hasattr(_lax, "axis_size"):
+        return _lax.axis_size(axis_name)
+    return _lax.psum(1, axis_name)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
@@ -33,9 +43,15 @@ def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
         return _shard_map_new(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, **kwargs)
     if axis_names is not None and set(axis_names) != set(mesh.axis_names):
-        # old API spells partial-manual as `auto` = the complement set
-        kwargs["auto"] = frozenset(set(mesh.axis_names) - set(axis_names))
-    if check_vma is not None:
+        # The old API spells partial-manual as `auto` = the complement set,
+        # but its partial-auto tracing has no autodiff rules (jvp raises
+        # NotImplementedError), so callers that differentiate through the
+        # region (pipeline 1F1B) cannot use it.  Full-manual is semantically
+        # safe here instead: specs never mention the would-be-auto axes, so
+        # inputs replicate and outputs are per-rank identical over them —
+        # at worst duplicated compute on those axes, never wrong values.
+        kwargs["check_rep"] = False
+    elif check_vma is not None:
         kwargs["check_rep"] = check_vma
     return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, **kwargs)

@@ -266,6 +266,7 @@ class TrainStep:
         self.scaler = scaler
         self._compiled = None
         self._state = None
+        self._aot = {}  # batch signature -> AOT-compiled executable
 
     def _collect_state(self):
         tensors = list(self.model.state_dict().values())
@@ -285,7 +286,7 @@ class TrainStep:
         self.optimizer.clear_grad()
         return loss
 
-    def __call__(self, *batch):
+    def _ensure_built(self):
         if self._compiled is None:
             # Materialize optimizer accumulators WITHOUT an eager
             # forward/backward (which would dispatch hundreds of per-op XLA
@@ -300,15 +301,76 @@ class TrainStep:
                 self.optimizer._journaled_step(params)
             self._state = self._collect_state()
             self._build()
+
+    @staticmethod
+    def _batch_sig(batch_vals):
+        leaves, tree = jax.tree_util.tree_flatten(batch_vals)
+        sig = []
+        for v in leaves:
+            if not hasattr(v, "dtype"):
+                # python-scalar leaf: normalize through jnp so the signature
+                # matches warmup()'s aval-based one ('int32', not 'int')
+                v = jnp.asarray(v)
+            sig.append((tuple(v.shape), str(v.dtype)))
+        return (tree, tuple(sig))
+
+    def __call__(self, *batch):
+        self._ensure_built()
         batch_vals = jax.tree_util.tree_map(_unwrap, batch, is_leaf=lambda x: isinstance(x, Tensor))
         key = rng_mod.next_key()
         if self.optimizer._lr_scheduler is not None:
             self.optimizer._sync_lr()  # scheduler advanced eagerly between steps
         state_vals = [t._value for t in self._state]
-        new_state, loss_val = self._compiled(state_vals, batch_vals, key)
+        # signature lookup only when warmup() populated AOT executables —
+        # the plain path stays free of per-step flatten cost
+        step_fn = (self._aot.get(self._batch_sig(batch_vals), self._compiled)
+                   if self._aot else self._compiled)
+        new_state, loss_val = step_fn(state_vals, batch_vals, key)
         for t, v in zip(self._state, new_state):
             t._bind(v)
         return Tensor(loss_val)
+
+    def lower(self, *batch):
+        """AOT entry: trace the step for `batch` (Tensors, arrays, or
+        jax.ShapeDtypeStructs) and return the jax Lowered object without
+        running it — `.compile()` pays XLA compilation ahead of traffic."""
+        self._ensure_built()
+
+        def aval(x):
+            v = _unwrap(x)
+            if isinstance(v, jax.ShapeDtypeStruct):
+                return v
+            v = jnp.asarray(v)
+            return jax.ShapeDtypeStruct(v.shape, v.dtype)
+
+        batch_avals = jax.tree_util.tree_map(
+            aval, batch, is_leaf=lambda x: isinstance(x, Tensor))
+        state_avals = [jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
+                       for t in self._state]
+        # key aval derived WITHOUT consuming a global RNG tick: warmup must
+        # not shift the training random stream
+        key_aval = jax.eval_shape(lambda: jax.random.fold_in(
+            jax.random.key(0), 0))
+        return self._compiled.lower(state_avals, batch_avals, key_aval)
+
+    def warmup(self, *batch):
+        """Pay trace + XLA compile for `batch`'s signature before traffic
+        (values or ShapeDtypeStructs; no step is executed, no state or RNG
+        advances).  The executable is kept, so the first real step with
+        this signature runs it directly; with FLAGS_compilation_cache_dir
+        set the compile also persists across process restarts.  Returns
+        self for chaining: TrainStep(...).warmup(x, y)."""
+        lowered = self.lower(*batch)
+        compiled = lowered.compile()
+
+        def aval(x):
+            v = _unwrap(x)
+            return v if isinstance(v, jax.ShapeDtypeStruct) else jnp.asarray(v)
+
+        batch_avals = jax.tree_util.tree_map(
+            aval, batch, is_leaf=lambda x: isinstance(x, Tensor))
+        self._aot[self._batch_sig(batch_avals)] = compiled
+        return self
 
     def _build(self):
         model, optimizer, loss_fn, scaler = self.model, self.optimizer, self.loss_fn, self.scaler

@@ -28,6 +28,10 @@ class GPTConfig:
     max_position_embeddings: int = 1024
     layer_norm_eps: float = 1e-5
     dropout: float = 0.1
+    # run the uniform block stack as one jax.lax.scan over stacked weights
+    # (nn.LayerStack; FLAGS_scan_layers forces it on) — depth-constant
+    # trace/compile like models/llama.py
+    fuse_layer_stack: bool = False
 
 
 class GPTBlock(nn.Layer):
@@ -54,7 +58,15 @@ class GPTModel(nn.Layer):
         self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
-        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        from paddle_tpu._core import flags as _flags
+
+        blocks = [GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)]
+        if cfg.fuse_layer_stack or _flags.flag("FLAGS_scan_layers"):
+            # needs_rng only when dropout actually fires: a p=0 stack keeps
+            # the global RNG stream identical to the unrolled loop
+            self.h = nn.LayerStack(blocks, needs_rng=cfg.dropout > 0)
+        else:
+            self.h = nn.LayerList(blocks)
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def forward(self, input_ids):
@@ -69,7 +81,7 @@ class GPTModel(nn.Layer):
         h = self.drop(self.wte(input_ids) + self.wpe(pos))
         from paddle_tpu.distributed.fleet.meta_parallel import PipelineStack
 
-        if isinstance(self.h, PipelineStack):
+        if isinstance(self.h, (PipelineStack, nn.LayerStack)):
             h = self.h(h)
         else:
             for blk in self.h:
@@ -120,12 +132,22 @@ def shard_gpt(model: "GPTForCausalLM", mesh, mp_axis: str = "mp"):
             )
 
     shard_param(model.gpt.wte, "weight", Shard(0))
-    for blk in model.gpt.h:
-        for col in (blk.attn.q_proj, blk.attn.k_proj, blk.attn.v_proj, blk.fc_in):
-            shard_param(col, "weight", Shard(1))
-            shard_param(col, "bias", Shard(0))
-        for row in (blk.attn.out_proj, blk.fc_out):
-            shard_param(row, "weight", Shard(0))
+    if isinstance(model.gpt.h, nn.LayerStack):
+        # stacked layout (fuse_layer_stack): iterating views would shard
+        # template slots the scan never reads — place the stacked weights
+        from paddle_tpu.nn.layer.stack import shard_stacked_params
+
+        shard_stacked_params(
+            model.gpt.h, mesh, place,
+            col_keys=("attn.q_proj", "attn.k_proj", "attn.v_proj", "fc_in"),
+            row_keys=("attn.out_proj", "fc_out"))
+    else:
+        for blk in model.gpt.h:
+            for col in (blk.attn.q_proj, blk.attn.k_proj, blk.attn.v_proj, blk.fc_in):
+                shard_param(col, "weight", Shard(1))
+                shard_param(col, "bias", Shard(0))
+            for row in (blk.attn.out_proj, blk.fc_out):
+                shard_param(row, "weight", Shard(0))
     return model
 
 
@@ -155,6 +177,11 @@ def pipeline_gpt(model: "GPTForCausalLM", mesh, pp_axis: str = "pp",
 
     if pp_axis not in mesh.dim_names:
         return model
+    if isinstance(model.gpt.h, nn.LayerStack):
+        raise ValueError(
+            "pipeline_gpt: the block stack is a fused LayerStack "
+            "(fuse_layer_stack/FLAGS_scan_layers); build the model with "
+            "fuse_layer_stack=False to pipeline it")
     model.gpt.h = PipelineStack(
         list(model.gpt.h), mesh, pp_axis=pp_axis,
         num_microbatches=num_microbatches, use_recompute=use_recompute,

@@ -53,6 +53,13 @@ class LlamaConfig:
     tensor_parallel_degree: int = 1
     sequence_parallel: bool = False
     use_recompute: bool = False
+    # recompute tier inside each block (reference recompute_granularity):
+    # "full" | "full_attn" | "core_attn"
+    recompute_granularity: str = "full"
+    # run the decoder stack as ONE jax.lax.scan over stacked per-layer
+    # weights (nn.LayerStack): trace/compile cost becomes O(1) in depth.
+    # FLAGS_scan_layers forces this on for every model built afterwards.
+    fuse_layer_stack: bool = False
 
 
 def _rope_tables(head_dim: int, max_len: int, theta: float):
@@ -151,7 +158,9 @@ class LlamaAttention(nn.Layer):
             rope_len = int(rope_cos.shape[0])
 
             def _sep_off(z, ax=sep_ax, s=s, rope_len=rope_len):
-                w = _lax.axis_size(ax)
+                from paddle_tpu.distributed.shard_map_compat import axis_size
+
+                w = axis_size(ax)
                 if s * w > rope_len:
                     raise ValueError(
                         f"context parallelism: global sequence {s * w} "
@@ -242,7 +251,17 @@ class LlamaDecoderLayer(nn.Layer):
                 h, rope_cos, rope_sin, attn_mask, kv_cache=kv_cache, position_offset=position_offset
             )
         else:
-            h = self.self_attn(h, rope_cos, rope_sin, attn_mask)
+            from paddle_tpu.nn.layer.stack import current_recompute_tier
+
+            if current_recompute_tier() == "full_attn":
+                # recompute_granularity="full_attn": exactly the attention
+                # sublayer rematerializes in backward (nested jax.checkpoint
+                # via fleet.recompute); MLP/norm residuals stay saved
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                h = recompute(self.self_attn, h, rope_cos, rope_sin, attn_mask)
+            else:
+                h = self.self_attn(h, rope_cos, rope_sin, attn_mask)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
@@ -256,9 +275,22 @@ class LlamaDecoderLayer(nn.Layer):
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
+        from paddle_tpu._core import flags as _flags
+
         self.config = config
         self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
-        self.layers = nn.LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        blocks = [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        if config.fuse_layer_stack or _flags.flag("FLAGS_scan_layers"):
+            # one scanned block instead of N unrolled ones: trace + XLA
+            # compile cost is O(1) in depth (docs/SCAN_LAYERS.md)
+            self.layers = nn.LayerStack(
+                blocks,
+                recompute=(config.recompute_granularity
+                           if config.use_recompute else None),
+                needs_rng=False,  # no stochastic sublayers in the block
+            )
+        else:
+            self.layers = nn.LayerList(blocks)
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         head_dim = config.hidden_size // config.num_attention_heads
         cos, sin = _rope_tables(head_dim, config.max_position_embeddings, config.rope_theta)
@@ -279,14 +311,24 @@ class LlamaModel(nn.Layer):
             # the stack consumes token ids and emits logits
             return self.layers(input_ids, self.rope_cos, self.rope_sin, attn_mask)
         h = self.embed_tokens(input_ids)
-        if isinstance(self.layers, PipelineStack):
+        if isinstance(self.layers, (PipelineStack, nn.LayerStack)):
             h = self.layers(h, self.rope_cos, self.rope_sin, attn_mask)
         else:
+            gran = self.config.recompute_granularity
             for layer in self.layers:
                 if self.config.use_recompute and self.training:
-                    from paddle_tpu.distributed.fleet.recompute import recompute
+                    if gran == "full":
+                        from paddle_tpu.distributed.fleet.recompute import recompute
 
-                    h = recompute(layer, h, self.rope_cos, self.rope_sin, attn_mask)
+                        h = recompute(layer, h, self.rope_cos, self.rope_sin, attn_mask)
+                    else:
+                        # sub-layer tiers: the block itself remats its
+                        # attention (full_attn) or its attention core
+                        # (core_attn) under this scope
+                        from paddle_tpu.nn.layer.stack import recompute_tier_scope
+
+                        with recompute_tier_scope(gran):
+                            h = layer(h, self.rope_cos, self.rope_sin, attn_mask)
                 else:
                     h = layer(h, self.rope_cos, self.rope_sin, attn_mask)
         return self.norm(h)
@@ -787,12 +829,21 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):
         layer._parameters[name] = shard_tensor(p, mesh, place(placement), stop_gradient=p.stop_gradient)
 
     shard_param(model.model.embed_tokens, "weight", Shard(0))
-    for blk in model.model.layers:
-        for col in (blk.self_attn.q_proj, blk.self_attn.k_proj, blk.self_attn.v_proj, blk.mlp.gate_up_proj):
-            shard_param(col, "weight", Shard(1))
-            shard_param(col, "bias", Shard(0))
-        for row in (blk.self_attn.o_proj, blk.mlp.down_proj):
-            shard_param(row, "weight", Shard(0))
+    if isinstance(model.model.layers, nn.LayerStack):
+        from paddle_tpu.nn.layer.stack import shard_stacked_params
+
+        shard_stacked_params(
+            model.model.layers, mesh, place,
+            col_keys=("self_attn.q_proj", "self_attn.k_proj",
+                      "self_attn.v_proj", "mlp.gate_up_proj"),
+            row_keys=("self_attn.o_proj", "mlp.down_proj"))
+    else:
+        for blk in model.model.layers:
+            for col in (blk.self_attn.q_proj, blk.self_attn.k_proj, blk.self_attn.v_proj, blk.mlp.gate_up_proj):
+                shard_param(col, "weight", Shard(1))
+                shard_param(col, "bias", Shard(0))
+            for row in (blk.self_attn.o_proj, blk.mlp.down_proj):
+                shard_param(row, "weight", Shard(0))
     if model.lm_head is not None:
         shard_param(model.lm_head, "weight", Shard(1))
     return model
@@ -829,6 +880,12 @@ def pipeline_llama(model: "LlamaForCausalLM", mesh, pp_axis: str = "pp",
 
     if pp_axis not in mesh.dim_names:
         return model
+    if isinstance(model.model.layers, nn.LayerStack):
+        raise ValueError(
+            "pipeline_llama: the decoder stack is a fused LayerStack "
+            "(fuse_layer_stack/FLAGS_scan_layers); pipeline parallelism "
+            "partitions per-layer modules — build the model with "
+            "fuse_layer_stack=False to pipeline it")
     first = last = None
     if include_edges and model.lm_head is None:
         # tied embeddings would need the embedding weight on both edge

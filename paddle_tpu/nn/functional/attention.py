@@ -29,52 +29,64 @@ def scaled_dot_product_attention(
     """Inputs are [batch, seq, heads, head_dim] (paddle flash-attn layout)."""
     query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
 
-    def _sdpa(q, k, v, *rest):
+    def _sdpa(q, k, v, *rest, remat_core=False):
         # jax.nn.dot_product_attention expects BSNH as well.
-        mask = rest[0] if rest else None
-        if mask is None and _SDPBackendState.enable_flash:
-            from paddle_tpu import ops as _ops
+        mask0 = rest[0] if rest else None
 
-            if _ops.use_pallas():
-                return _ops.flash_attention(q, k, v, causal=bool(is_causal))
-        if not (_SDPBackendState.enable_math
-                or _SDPBackendState.enable_mem_efficient):
-            # the XLA einsum path plays both the math and mem-efficient
-            # roles; with both disabled there is no backend left for this
-            # call (masked, or flash unavailable) — raise like the
-            # reference's kernel-dispatch failure instead of silently
-            # running a disabled backend
-            raise RuntimeError(
-                "scaled_dot_product_attention: no enabled backend can "
-                "serve this call (flash cannot take an attn_mask / is "
-                "unavailable, and math+mem_efficient are disabled by "
-                "sdp_kernel)")
-        bias = None
-        if mask is not None and mask.dtype != jnp.bool_:
-            bias = mask
-            mask = None
-        causal = bool(is_causal)
-        if causal and q.shape[1] != k.shape[1]:
-            # jax.nn.dot_product_attention's is_causal is TOP-LEFT aligned;
-            # cross lengths (chunked prefill / speculative verify: query
-            # chunk against a longer cache) need the bottom-right
-            # convention — build it explicitly (matches the flash kernel)
-            tri = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool),
-                           k=k.shape[1] - q.shape[1])[None, None]
-            mask = tri if mask is None else jnp.logical_and(mask, tri)
-            causal = False
-        out = jax.nn.dot_product_attention(
-            q,
-            k,
-            v,
-            bias=bias,
-            mask=mask,
-            is_causal=causal,
-        )
-        return out
+        def _core(q, k, v, mask):
+            if mask is None and _SDPBackendState.enable_flash:
+                from paddle_tpu import ops as _ops
+
+                if _ops.use_pallas():
+                    return _ops.flash_attention(q, k, v, causal=bool(is_causal))
+            if not (_SDPBackendState.enable_math
+                    or _SDPBackendState.enable_mem_efficient):
+                # the XLA einsum path plays both the math and mem-efficient
+                # roles; with both disabled there is no backend left for this
+                # call (masked, or flash unavailable) — raise like the
+                # reference's kernel-dispatch failure instead of silently
+                # running a disabled backend
+                raise RuntimeError(
+                    "scaled_dot_product_attention: no enabled backend can "
+                    "serve this call (flash cannot take an attn_mask / is "
+                    "unavailable, and math+mem_efficient are disabled by "
+                    "sdp_kernel)")
+            bias = None
+            if mask is not None and mask.dtype != jnp.bool_:
+                bias = mask
+                mask = None
+            causal = bool(is_causal)
+            if causal and q.shape[1] != k.shape[1]:
+                # jax.nn.dot_product_attention's is_causal is TOP-LEFT aligned;
+                # cross lengths (chunked prefill / speculative verify: query
+                # chunk against a longer cache) need the bottom-right
+                # convention — build it explicitly (matches the flash kernel)
+                tri = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool),
+                               k=k.shape[1] - q.shape[1])[None, None]
+                mask = tri if mask is None else jnp.logical_and(mask, tri)
+                causal = False
+            return jax.nn.dot_product_attention(
+                q,
+                k,
+                v,
+                bias=bias,
+                mask=mask,
+                is_causal=causal,
+            )
+
+        # recompute_granularity="core_attn": the softmax(qk)v core runs
+        # under jax.checkpoint so its probabilities rematerialize in
+        # backward instead of being saved
+        run = jax.checkpoint(_core) if remat_core else _core
+        return run(q, k, v, mask0)
+
+    from paddle_tpu.nn.layer.stack import current_recompute_tier
 
     extra = [ensure_tensor(attn_mask)] if attn_mask is not None else []
-    out = apply("scaled_dot_product_attention", _sdpa, query, key, value, *extra)
+    # rides kwargs (static) so the dispatch cache / static capture key on it
+    remat_core = current_recompute_tier() == "core_attn"
+    out = apply("scaled_dot_product_attention", _sdpa, query, key, value,
+                *extra, remat_core=remat_core)
     if dropout_p > 0.0 and training:
         from .common import dropout
 

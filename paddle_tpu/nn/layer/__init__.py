@@ -7,4 +7,5 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
+from .stack import LayerStack  # noqa: F401
 from .transformer import *  # noqa: F401,F403

@@ -282,6 +282,13 @@ class Layer:
 
     def set_state_dict(self, state_dict: dict, use_structured_name: bool = True):
         own = self.state_dict()
+        if any(name not in state_dict for name in own):
+            # stacked (LayerStack) vs per-layer decoder layouts interconvert
+            # so checkpoints survive flipping fuse_layer_stack; skipped
+            # entirely on the common exact-match path
+            from .stack import adapt_state_dict
+
+            state_dict = adapt_state_dict(self, state_dict, own=own)
         missing, unexpected = [], []
         for name, t in own.items():
             if name in state_dict:

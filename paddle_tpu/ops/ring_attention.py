@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddle_tpu.distributed.shard_map_compat import axis_size as _axis_size
+
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -57,7 +59,7 @@ def ring_attention(q, k, v, axis_name, *, causal=True, scale=None):
     [r*S_loc, (r+1)*S_loc)).  W-1 ppermute hops rotate the K/V shard left;
     online-softmax merge keeps full-precision statistics.
     """
-    w = lax.axis_size(axis_name)
+    w = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -121,7 +123,7 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True, scale=None):
     """DeepSpeed-Ulysses: all-to-all seq<->heads, local full-seq flash
     attention, all-to-all back.  Heads must divide the axis size.
     q/k/v: [B, S_loc, N, H] -> returns same."""
-    w = lax.axis_size(axis_name)
+    w = _axis_size(axis_name)
     b, s_loc, n, h = q.shape
     assert n % w == 0, "num heads must be divisible by sep degree for ulysses"
 

@@ -215,7 +215,7 @@ class Profiler:
         with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys.
         Ends with the eager dispatch-cache counters when the fast path has
         seen traffic."""
-        from .statistics import dispatch_cache_line, summary_text
+        from .statistics import compile_cache_line, dispatch_cache_line, summary_text
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -223,6 +223,9 @@ class Profiler:
         cache_line = dispatch_cache_line(dispatch_cache_stats())
         if cache_line:
             out = out + "\n" + cache_line
+        comp_line = compile_cache_line(compile_stats())
+        if comp_line:
+            out = out + "\n" + comp_line
         print(out)
         return out
 
@@ -317,7 +320,23 @@ def reset_dispatch_cache():
     dispatch.cache.reset_stats()
 
 
-__all__ += ["dispatch_cache_stats", "reset_dispatch_cache"]
+def compile_stats(reset: bool = False) -> dict:
+    """Trace-time / XLA-compile-time / persistent-cache counters for this
+    process (fed by jax.monitoring; see _core.compile_cache): traces,
+    trace_seconds, compiles, compile_seconds, persistent_cache_hits /
+    _misses, compile_seconds_saved, cache_dir.  A warm start (TrainStep
+    .warmup + FLAGS_compilation_cache_dir) shows hits with near-zero
+    compile_seconds; climbing compiles in steady state mean signature
+    churn is defeating jax's executable cache."""
+    from paddle_tpu._core import compile_cache
+
+    stats = compile_cache.compile_stats()
+    if reset:
+        compile_cache.reset_compile_stats()
+    return stats
+
+
+__all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

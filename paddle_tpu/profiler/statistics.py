@@ -16,7 +16,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-__all__ = ["EventSummary", "StatisticData", "summary_text", "dispatch_cache_line"]
+__all__ = ["EventSummary", "StatisticData", "summary_text",
+           "dispatch_cache_line", "compile_cache_line"]
 
 _UNITS = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
 
@@ -187,3 +188,23 @@ def dispatch_cache_line(stats: dict) -> str:
            stats["misses"], rate, stats["traces"], stats["evictions"],
            stats["bypasses"], stats["size"], stats["capacity"])
     )
+
+
+def compile_cache_line(stats: dict) -> str:
+    """One-line rendering of the trace/compile + persistent-cache counters
+    for Profiler.summary(); empty when nothing compiled this process."""
+    if not (stats.get("compiles") or stats.get("traces")):
+        return ""
+    line = (
+        "XLA compile: traces=%d (%.2fs) compiles=%d (%.2fs)"
+        % (stats["traces"], stats["trace_seconds"], stats["compiles"],
+           stats["compile_seconds"])
+    )
+    if stats.get("cache_dir"):
+        line += (
+            "; persistent cache [%s]: hits=%d misses=%d saved=%.2fs"
+            % (stats["cache_dir"], stats["persistent_cache_hits"],
+               stats["persistent_cache_misses"],
+               stats["compile_seconds_saved"])
+        )
+    return line

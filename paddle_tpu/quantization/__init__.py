@@ -176,13 +176,18 @@ class FakeQuanterChannelWiseAbsMax(BaseQuanter):
             # host-side running max (the module's eager-observer contract)
             cur = np.asarray(jnp.max(jnp.abs(xv), axis=axes), np.float32)
             self._scale = cur if self._scale is None else np.maximum(self._scale, cur)
+        # the per-channel scale stays float32 and the fake-quant round/clip
+        # runs in float32: an activation-dtype (bf16) scale quantizes to a
+        # DIFFERENT grid than the deployed int8 kernel's f32 scale, so QAT
+        # would train against the wrong quantization error
         scale = jnp.maximum(jnp.asarray(
             self._scale if self._scale is not None else np.ones(xv.shape[ax]),
-            xv.dtype), 1e-9)
+            jnp.float32), 1e-9)
         shape = [1] * xv.ndim
         shape[ax] = xv.shape[ax]
         qmax = float(2 ** (self._quant_bits - 1) - 1)
-        return Tensor(_fake_quant(xv, scale.reshape(shape), qmax))
+        out = _fake_quant(xv.astype(jnp.float32), scale.reshape(shape), qmax)
+        return Tensor(out.astype(xv.dtype))
 
     def scales(self):
         return Tensor(jnp.maximum(jnp.asarray(self._scale, jnp.float32), 1e-9))

@@ -136,6 +136,12 @@ class Executor:
             for op in reversed(program.global_block().ops):
                 if any(v in live for v in op.out_vids):
                     pruned.append(op)
+                    # last-writer-wins: this op now defines its outputs, so
+                    # earlier (superseded) producers of the same vids are
+                    # dead — without this, append_backward's share_loss
+                    # re-bind keeps the original forward chain alive and the
+                    # compiled step traces the forward twice
+                    live.difference_update(op.out_vids)
                     live.update(op.input_vids())
             pruned.reverse()
             run_fn, feed_vids, state_vids = program.as_function(

@@ -207,6 +207,11 @@ class FlashAttentionPattern(RewritePattern):
         # root: out = matmul(probs, v)
         if len(op.arg_spec) != 2 or any(s[0] != "var" for s in op.arg_spec):
             return False
+        if op.kwargs.get("transpose_x") or op.kwargs.get("transpose_y"):
+            # probs is square [B,N,S,S], so a transposed root is shape-
+            # indistinguishable from the attention form but computes
+            # probs^T @ v — never fusable
+            return False
         probs_vid, v_vid = op.arg_spec[0][1], op.arg_spec[1][1]
         out_shape = graph.shape(op.out_vids[0]) if op.out_vids else None
         v_shape = graph.shape(v_vid)
@@ -270,6 +275,8 @@ class FlashAttentionPattern(RewritePattern):
             return False
         if len(qk.arg_spec) != 2 or any(s[0] != "var" for s in qk.arg_spec):
             return False
+        if qk.kwargs.get("transpose_x"):
+            return False  # q^T @ k is not the attention form
         q_vid, k_vid = qk.arg_spec[0][1], qk.arg_spec[1][1]
         q_shape, k_shape = graph.shape(q_vid), graph.shape(k_vid)
         if q_shape != (B, N, S, D):
@@ -279,6 +286,11 @@ class FlashAttentionPattern(RewritePattern):
         elif k_shape == (B, N, D, S):
             k_transposed = False
         else:
+            return False
+        # the recorded transpose_y must agree with the shape-inferred layout
+        # (with S != D they can only disagree on malformed programs — keep
+        # the cross-check so the kernel can never silently flip k)
+        if bool(qk.kwargs.get("transpose_y")) != k_transposed:
             return False
 
         if scale is None:
@@ -488,6 +500,11 @@ class MatmulEpiloguePattern(RewritePattern):
         if mm.type.startswith("wq::"):
             # weight-only-quantized op: different arg contract (int8 q +
             # scale appended) — fusing would add the scale as a bias
+            return False
+        if mm.kwargs.get("transpose_x") or mm.kwargs.get("transpose_y"):
+            # paddle.matmul(..., transpose_y=True) computes x @ w.T; the
+            # fused kernel has no transpose contract — for square weights
+            # the shape check below cannot catch it, so bail out
             return False
         if len(mm.arg_spec) not in (2, 3):
             return False

@@ -1,0 +1,42 @@
+"""Tier-1 smoke of benchmarks/bench_compile.py.
+
+Like test_bench_dispatch: the scan-over-layers compile benchmark must keep
+emitting the one-line JSON payload the driver parses, and its built-in
+loss-trajectory parity gate (scan vs unrolled over 5 train steps) must
+hold — so the depth-constant-compile path can't bitrot unexercised
+between measured rounds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_compile_smoke_emits_valid_json():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PADDLE_TPU_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "bench_compile.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+    assert out.returncode == 0, (out.stderr or out.stdout)[-800:]
+    line = next(ln for ln in reversed(out.stdout.splitlines()) if ln.startswith("{"))
+    payload = json.loads(line)
+    assert payload["metric"] == "scan_layers_ttfs_speedup"
+    assert payload["unit"] == "x"
+    assert payload["value"] > 0
+    assert "vs_baseline" in payload
+    assert payload["loss_trajectories_match"] is True
+    detail = payload["detail"]
+    for section in ("unrolled", "scan"):
+        assert detail[section]["ttfs_s"] > 0
+        assert detail[section]["steps_per_sec"] > 0
+        assert len(detail[section]["losses"]) >= 5
+    # the acceptance direction: scan must beat the unrolled loop on
+    # time-to-first-step even at smoke sizes (>= 12 layers)
+    assert payload["value"] > 1.5, payload
+    # warm start ran and the second process actually hit the disk cache
+    warm = detail["warm_start"]
+    assert "error" not in warm.get("cold", {}), warm
+    assert warm["warm"]["hits"] > 0
+    assert warm["warm"]["misses"] == 0

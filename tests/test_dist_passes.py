@@ -373,6 +373,12 @@ def test_recompute_after_sharding_keeps_grad_constraints():
     assert all(np.isfinite(losses))
 
 
+@pytest.mark.slow  # the zero-rewrite + pipeline + TP 3-way compose now RUNS
+# on jax 0.4.37 (shard_map_compat full-manual fallback) but XLA:CPU's
+# in-process 8-device communicator intermittently SIGSEGV/SIGABRTs under it —
+# a process-killing crash, not a failure, so it stays out of the tier-1 pass
+# (plain pipeline tests cover the fallback deterministically; this compose
+# runs on real meshes / the nightly slow lane)
 def test_zero_rewrite_composes_with_pipeline_mesh():
     """VERDICT r4 item 10: the ZeRO program-rewrite composed with pp — a
     dp2 x pp2 x mp2 captured train step (pipelined trunk, TP shardings)

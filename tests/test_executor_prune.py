@@ -1,0 +1,112 @@
+"""Executor fetch-frontier prune — last-writer-wins regression.
+
+append_backward re-binds the loss vid to the grad super-op's own loss
+output (static/autodiff.py share_loss alias) precisely so the compiled step
+can drop the original forward chain: the grad op's value_and_grad already
+runs the forward once.  A prune that never retires superseded producers
+keeps BOTH, so the compiled step traces the forward twice — wasted compute,
+and a collective-carrying forward duplicated that way can deadlock XLA:CPU
+(static/autodiff.py module docstring).  These tests count actual op-fn
+trace executions inside the compiled step.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+def _count_op_traces(program, op_type):
+    """Wrap every `op_type` op's fn with a Python-side trace counter (the fn
+    runs exactly once per inclusion in a compiled step's trace)."""
+    counter = {"n": 0}
+    for op in program.global_block().ops:
+        if op.type == op_type:
+            inner = op.fn
+
+            def fn(*a, _inner=inner, **kw):
+                counter["n"] += 1
+                return _inner(*a, **kw)
+
+            op.fn = fn
+    return counter
+
+
+def test_compiled_step_traces_forward_exactly_once():
+    paddle.seed(0)
+    main = static.Program()
+    layer = nn.Linear(4, 4)
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        y = layer(x)
+        loss = paddle.sum(y * y)
+        p_g = static.append_backward(loss, parameter_list=[layer.weight])
+
+    # the captured forward matmul/linear op must execute ONCE in the
+    # compiled step: the grad super-op re-runs the forward internally and
+    # share_loss re-binds the loss vid to its output, so the original
+    # forward producer is superseded
+    fwd_ops = [op.type for op in main.global_block().ops
+               if op.type not in ("grad", "share_loss")]
+    assert fwd_ops, "expected captured forward ops"
+    counter = _count_op_traces(main, fwd_ops[0])
+
+    exe = static.Executor()
+    xv = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    # fusion pass off: its pattern scan traces op fns too, which would
+    # count pass-time traces instead of compiled-step traces
+    paddle.set_flags({"FLAGS_use_pallas_fusion": False})
+    try:
+        fetches = exe.run(main, feed={"x": xv},
+                          fetch_list=[loss] + [g for _, g in p_g])
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
+    # exactly ONE trace: the grad super-op's internal value_and_grad
+    # forward.  The superseded original producer contributes the second
+    # trace when the prune is not last-writer-wins.
+    assert counter["n"] == 1, (
+        f"forward op traced {counter['n']} times inside the compiled step "
+        "— expected exactly one (the grad super-op's own forward); the "
+        "fetch-frontier prune kept the superseded chain")
+
+    # numerics unchanged by the prune
+    w = np.asarray(layer.weight._value)
+    b = np.asarray(layer.bias._value)
+    out = xv @ w + b
+    np.testing.assert_allclose(fetches[0], np.sum(out * out), rtol=1e-5)
+    np.testing.assert_allclose(fetches[1], xv.T @ (2 * out), rtol=1e-4)
+
+
+def test_forward_only_fetch_still_runs_forward():
+    """Last-writer-wins must not over-prune: with no grad op, the forward
+    producer IS the live chain."""
+    paddle.seed(0)
+    main = static.Program()
+    layer = nn.Linear(4, 4)
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        y = layer(x)
+    op_type = main.global_block().ops[-1].type
+    counter = _count_op_traces(main, op_type)
+    exe = static.Executor()
+    xv = np.random.default_rng(1).standard_normal((2, 4)).astype(np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert counter["n"] == 1
+    ref = xv @ np.asarray(layer.weight._value) + np.asarray(layer.bias._value)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_read_then_rebind_keeps_prior_producer():
+    """An op that READS a vid its successor re-binds must keep the original
+    producer alive (the rebinding op consumes the old value)."""
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+        a = paddle.tanh(x)      # producer of a
+        b = a + a               # reads a
+    exe = static.Executor()
+    xv = np.asarray([0.1, 0.2, 0.3], np.float32)
+    (bv,) = exe.run(main, feed={"x": xv}, fetch_list=[b])
+    np.testing.assert_allclose(bv, 2 * np.tanh(xv), rtol=1e-6)

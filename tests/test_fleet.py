@@ -10,7 +10,9 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from paddle_tpu.distributed.shard_map_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import paddle_tpu as paddle
