@@ -99,6 +99,14 @@ define_flag(
     "config knob (depth-constant trace/compile; models/llama.py, models/gpt.py)",
 )
 define_flag(
+    "FLAGS_decode_chunk",
+    8,
+    "Macro-step decode width D: paged decode advances D tokens per compiled "
+    "dispatch (lax.scan inside the jitted step; token streams bit-identical "
+    "for every D).  Consumed by LlamaForCausalLM.generate and "
+    "serving.GenerationEngine; 1 = per-token dispatch",
+)
+define_flag(
     "FLAGS_compilation_cache_dir",
     "",
     "Directory for JAX's persistent XLA compilation cache: warm process "

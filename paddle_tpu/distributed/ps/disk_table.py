@@ -336,22 +336,38 @@ class GeoPsClient:
 
     def _pull_into_local(self, orig_pull):
         def pull(ids):
-            ids_arr = np.asarray(ids, np.int64).reshape(-1)
-            missing = [int(i) for i in ids_arr if int(i) not in self._local._rows]
-            if missing:
-                rows = self._client.pull(np.asarray(missing, np.int64))
-                with self._local._lock:
-                    for rid, row in zip(missing, rows):
-                        self._local._rows[rid] = row.copy()
-                        self._base[rid] = row.copy()
+            self._ensure_rows(np.asarray(ids, np.int64).reshape(-1))
             return orig_pull(ids)
 
         return pull
+
+    def _ensure_rows(self, ids_arr):
+        """Seed _local._rows AND _base together from the global table for
+        any id not yet base-tracked — the ONE place the two registries are
+        populated, so they cannot drift apart (a local row without a base
+        snapshot would train but never sync).  An id already materialized
+        locally keeps its row; only its missing base is recorded."""
+        missing = [int(i) for i in ids_arr if int(i) not in self._base]
+        if not missing:
+            return
+        rows = self._client.pull(np.asarray(missing, np.int64))
+        with self._local._lock:
+            for rid, row in zip(missing, rows):
+                if rid not in self._local._rows:
+                    self._local._rows[rid] = row.copy()
+                self._base[rid] = row.copy()
 
     def pull(self, ids):
         return self._local.pull(ids)
 
     def push(self, ids, grads):
+        # Rows FIRST touched via push() would bypass the wrapped pull and
+        # never enter _base — sync() would skip them forever, silently
+        # losing their training (and the local SparseTable drops pushes to
+        # rows it never materialized).  Seed row + base snapshot from the
+        # global table first, so this push lands and the next sync()
+        # propagates it.
+        self._ensure_rows(np.asarray(ids, np.int64).reshape(-1))
         self._local.push(ids, grads)
         self._step += 1
         if self._step % self._geo == 0:

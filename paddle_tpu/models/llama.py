@@ -396,6 +396,63 @@ def _decode_layer_paged_chunk(layer, h, cos, sin, kc, vc, tables, lens):
     return residual + h2, kc, vc
 
 
+def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
+                         chunk=False):
+    """Run every decoder layer's paged decode step over per-layer pools.
+
+    ``layers`` is either a LayerList (unrolled view loop — the program
+    traces N layer bodies) or an ``nn.LayerStack`` (the pools stack on a
+    leading layer axis INSIDE this trace and thread through ONE
+    ``lax.scan`` as per-layer state — trace and XLA compile are O(1) in
+    depth, closing the decode half of docs/SCAN_LAYERS.md).
+
+    kpools/vpools: lists of per-layer pool arrays [num_blocks, Nkv, bs, H]
+    — or, on the LayerStack path, optionally ONE stacked [N, ...] array
+    each (see _pool_carry): macro-step inner loops pass the stacked form
+    so the N-pool concat is paid once per dispatch, not once per token.
+    ``chunk`` selects the T-token variant (speculative verify / macro-step
+    internals share it).  Returns (h, pools) in the layout given.
+    """
+    step = _decode_layer_paged_chunk if chunk else _decode_layer_paged
+    if isinstance(layers, nn.LayerStack):
+        stacked_in = not isinstance(kpools, (list, tuple))
+        k_state = kpools if stacked_in else jnp.stack(kpools)
+        v_state = vpools if stacked_in else jnp.stack(vpools)
+        h, k_state, v_state = layers.decode_scan(
+            lambda layer, hh, kc, vc: step(
+                layer, hh, cos, sin, kc, vc, tables, lens),
+            h, k_state, v_state)
+        if stacked_in:
+            return h, k_state, v_state
+        n = len(layers)
+        return h, [k_state[i] for i in range(n)], [v_state[i] for i in range(n)]
+    new_k, new_v = [], []
+    for li, layer in enumerate(layers):
+        h, kc, vc = step(layer, h, cos, sin, kpools[li], vpools[li],
+                         tables, lens)
+        new_k.append(kc)
+        new_v.append(vc)
+    return h, new_k, new_v
+
+
+def _pool_carry(layers, kpools, vpools):
+    """Per-layer pool lists -> the cheapest loop-carry form: ONE stacked
+    [N, ...] array each for a LayerStack (the macro-step scan then carries
+    2 buffers instead of 2N and the decode_scan consumes them directly —
+    no per-token stack/unstack), the lists unchanged for the view loop."""
+    if isinstance(layers, nn.LayerStack):
+        return jnp.stack(kpools), jnp.stack(vpools)
+    return list(kpools), list(vpools)
+
+
+def _pool_unpack(layers, kpools, vpools):
+    """Inverse of _pool_carry: back to per-layer lists for the host."""
+    if isinstance(layers, nn.LayerStack):
+        n = len(layers)
+        return [kpools[i] for i in range(n)], [vpools[i] for i in range(n)]
+    return list(kpools), list(vpools)
+
+
 def _empty_caches(config: "LlamaConfig", batch):
     """Per-layer empty naive KV caches (one constructor for generate /
     beam search / speculative decode)."""
@@ -626,7 +683,7 @@ class LlamaForCausalLM(nn.Layer):
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  seed=None, decode_strategy=None, num_beams: int = 1,
                  length_penalty: float = 0.0, draft_model=None,
-                 num_speculative_tokens: int = 4):
+                 num_speculative_tokens: int = 4, decode_chunk=None):
         """Incremental decode (serving path): greedy by default; sampling
         with temperature / top-k / top-p via do_sample=True (the reference
         generate()'s decode_strategy="sampling" surface,
@@ -638,6 +695,14 @@ class LlamaForCausalLM(nn.Layer):
         paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu):
         static shapes, so every decode step reuses ONE compiled program —
         sampling runs INSIDE it (jax.random.categorical, per-step fold_in).
+
+        decode_chunk (paged only; None -> FLAGS_decode_chunk): macro-step
+        decoding — D tokens advance per dispatch inside ONE compiled
+        program (a lax.scan over the single-token step with donated
+        pools), so the host round-trip and device sync amortize over D
+        tokens.  Token streams are BIT-IDENTICAL for every D (greedy and
+        sampled: each inner step folds the same per-step counter); the
+        max_new_tokens % D tail runs through a second cached chunk size.
         """
         import numpy as np
 
@@ -679,6 +744,12 @@ class LlamaForCausalLM(nn.Layer):
         # decode_strategy='beam_search' with num_beams=1 IS greedy search
         if do_sample and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # validated BEFORE the (expensive) prefill; an explicit bad value
+        # is loud everywhere, a bad FLAGS_decode_chunk clamps to 1 (the
+        # same rule GenerationEngine applies)
+        if decode_chunk is not None and int(decode_chunk) < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1, got {decode_chunk}")
         base_key = None
         if do_sample:
             # derive the key lazily: greedy decode must not advance the
@@ -763,35 +834,63 @@ class LlamaForCausalLM(nn.Layer):
 
         state = list(self.state_dict().values())
 
-        def step_fn(state_vals, pool_vals, tok, lens, step_i):
+        def run_chunk(state_vals, kpools, vpools, tok, lens, step0, d):
+            # step_once is defined INSIDE the traced function: lax.scan
+            # caches the traced body jaxpr by the body's identity, so a
+            # shared body object would serve one trace's closed-over bound
+            # weights (tracers) to the next trace (the tail chunk)
+            def step_once(carry, _):
+                """One decode token — the scan body shared by every chunk
+                size (bit-identical streams across D by construction)."""
+                tok, kps, vps, lens, step_i = carry
+                lens = lens + 1  # the new token occupies slot lens (0-based)
+                hh = self.model.embed_tokens(Tensor(tok))
+                hh, kps, vps = _decode_layers_paged(
+                    self.model.layers, hh, self.model.rope_cos._value,
+                    self.model.rope_sin._value, kps, vps, tables, lens)
+                hh = self.model.norm(hh)
+                logits = self._logits(hh)
+                nxt = (_select(logits._value[:, -1, :], step_i)
+                       .astype(tok.dtype)[:, None])
+                return (nxt, kps, vps, lens, step_i + 1), nxt[:, 0]
+
             originals = [t._value for t in state]
             try:
                 for t, v in zip(state, state_vals):
                     t._bind(v)
                 with paddle.no_grad():
-                    hh = self.model.embed_tokens(Tensor(tok))
-                    new_pools = []
-                    for layer, (kc, vc) in zip(self.model.layers, pool_vals):
-                        hh, kc, vc = _decode_layer_paged(
-                            layer, hh, self.model.rope_cos._value,
-                            self.model.rope_sin._value, kc, vc, tables, lens,
-                        )
-                        new_pools.append((kc, vc))
-                    hh = self.model.norm(hh)
-                    logits = self._logits(hh)
-                return _select(logits._value[:, -1, :], step_i).astype(tok.dtype)[:, None], new_pools
+                    (tok, kpools, vpools, lens, _), toks = jax.lax.scan(
+                        step_once, (tok, kpools, vpools, lens, step0),
+                        None, length=d)
             finally:
                 for t, v in zip(state, originals):
                     t._bind(v)
+            return toks, tok, kpools, vpools, lens
 
-        jit_step = jax.jit(step_fn, donate_argnums=(1,))
+        if decode_chunk is None:
+            from paddle_tpu._core import flags as _flags
+
+            D = max(1, int(_flags.flag("FLAGS_decode_chunk")))
+        else:
+            D = int(decode_chunk)
+        # one executable per chunk size: the main D plus (at most) one tail
+        jit_chunk = jax.jit(run_chunk, static_argnums=(6,),
+                            donate_argnums=(1, 2))
+        # carry form ONCE for the whole decode: a LayerStack's pools ride
+        # as one stacked [N, ...] buffer each across every dispatch (the
+        # per-layer lists never round-trip, so no per-dispatch restack)
+        kpools, vpools = _pool_carry(
+            self.model.layers, [k for k, _ in pools], [v for _, v in pools])
         lens = jnp.full((b,), s0, jnp.int32)
         tok = next_tok._value
         state_vals = [t._value for t in state]
-        for step in range(1, max_new_tokens):
-            lens = lens + 1  # the new token occupies slot lens (0-based)
-            tok, pools = jit_step(state_vals, pools, tok, lens, jnp.int32(step))
-            out_tokens.append(Tensor(tok))
+        step = 1
+        while step < max_new_tokens:
+            d = min(D, max_new_tokens - step)
+            toks, tok, kpools, vpools, lens = jit_chunk(
+                state_vals, kpools, vpools, tok, lens, jnp.int32(step), d)
+            out_tokens.append(Tensor(toks.T))  # [d, B] -> [B, d]
+            step += d
         return paddle.concat(out_tokens, axis=1)
 
 

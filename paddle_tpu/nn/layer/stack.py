@@ -327,6 +327,54 @@ class LayerStack(Layer):
             body, carry0, (tuple(state_vals), xs_keys))
         return carry
 
+    # ------------------------------------------------------- decode scan
+    def decode_scan(self, body, h, k_state, v_state):
+        """Scan the stack ONCE over stacked per-layer KV state (the paged
+        decode tier): ``body(layer, h, kc, vc) -> (h, kc, vc)`` is the
+        per-layer decode step (e.g. ``models.llama._decode_layer_paged``
+        with the broadcast args closed over); ``h`` is the Tensor carry;
+        ``k_state``/``v_state`` are raw arrays with a leading layer axis
+        ``[N, ...]`` riding the scan as xs/ys.  Returns
+        ``(h, new_k_state, new_v_state)`` in the same stacked layout.
+
+        This is the serving-side counterpart of :meth:`forward`: the paged
+        KV pools thread through the scan as per-layer state, so a decode
+        step program traces and XLA-compiles ONE layer body regardless of
+        depth.  Inference-only — it runs under ``no_grad`` inside the
+        caller's jitted step (decode never differentiates), so it skips
+        the ``apply`` funnel and recompute tiers entirely.
+        """
+        from paddle_tpu._core import autograd as core_ag
+
+        self._sync_template_mode()
+        template = self.__dict__["_template"]
+        slots = [self._slots[k] for k in self._stack_keys]
+        state_vals = [self._stacked_tensor(k)._value
+                      for k in self._stack_keys]
+        if not isinstance(h, Tensor):
+            h = Tensor(jnp.asarray(h))
+
+        def scan_body(carry, xs):
+            slices, kc, vc = xs
+            originals = [reg[short] for reg, short in slots]
+            try:
+                for (reg, short), v in zip(slots, slices):
+                    reg[short] = Tensor(v)
+                with core_ag.no_grad():
+                    out, kc, vc = body(template, Tensor(carry), kc, vc)
+                if not isinstance(out, Tensor):
+                    raise TypeError(
+                        "decode_scan body must return (Tensor, kc, vc); "
+                        f"got {type(out).__name__} carry")
+                return out._value, (kc, vc)
+            finally:
+                for (reg, short), v in zip(slots, originals):
+                    reg[short] = v
+
+        carry, (new_k, new_v) = jax.lax.scan(
+            scan_body, h._value, (tuple(state_vals), k_state, v_state))
+        return Tensor(carry), new_k, new_v
+
 
 def shard_stacked_params(stack: "LayerStack", mesh, place_fn, col_keys,
                          row_keys):

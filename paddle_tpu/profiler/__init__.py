@@ -215,7 +215,8 @@ class Profiler:
         with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys.
         Ends with the eager dispatch-cache counters when the fast path has
         seen traffic."""
-        from .statistics import compile_cache_line, dispatch_cache_line, summary_text
+        from .statistics import (compile_cache_line, decode_line,
+                                 dispatch_cache_line, summary_text)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -226,6 +227,9 @@ class Profiler:
         comp_line = compile_cache_line(compile_stats())
         if comp_line:
             out = out + "\n" + comp_line
+        dec_line = decode_line(decode_stats())
+        if dec_line:
+            out = out + "\n" + dec_line
         print(out)
         return out
 
@@ -320,6 +324,19 @@ def reset_dispatch_cache():
     dispatch.cache.reset_stats()
 
 
+def decode_stats(reset: bool = False) -> dict:
+    """Serving decode counters (paddle_tpu.serving): compiled-program
+    dispatches, emitted tokens, host sync seconds (time blocked
+    materializing device results), total step seconds and derived
+    tokens_per_sec.  Macro-step decoding (FLAGS_decode_chunk > 1) shows
+    tokens >> dispatches; tokens ~= dispatches means every token pays a
+    host round-trip (the per-token path).  Zeros when no engine ran.
+    Serving owns the counters — one schema, no drift."""
+    from paddle_tpu import serving
+
+    return serving.decode_stats(reset=reset)
+
+
 def compile_stats(reset: bool = False) -> dict:
     """Trace-time / XLA-compile-time / persistent-cache counters for this
     process (fed by jax.monitoring; see _core.compile_cache): traces,
@@ -336,7 +353,8 @@ def compile_stats(reset: bool = False) -> dict:
     return stats
 
 
-__all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats"]
+__all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
+            "decode_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -17,7 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 __all__ = ["EventSummary", "StatisticData", "summary_text",
-           "dispatch_cache_line", "compile_cache_line"]
+           "dispatch_cache_line", "compile_cache_line", "decode_line"]
 
 _UNITS = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
 
@@ -187,6 +187,22 @@ def dispatch_cache_line(stats: dict) -> str:
         % ("on" if stats.get("enabled") else "off", stats["hits"],
            stats["misses"], rate, stats["traces"], stats["evictions"],
            stats["bypasses"], stats["size"], stats["capacity"])
+    )
+
+
+def decode_line(stats: dict) -> str:
+    """One-line rendering of the serving decode counters for
+    Profiler.summary(); empty when no engine dispatched this process."""
+    if not stats.get("dispatches"):
+        return ""
+    toks = stats.get("tokens", 0)
+    disp = stats["dispatches"]
+    return (
+        "Serving decode: tokens=%d dispatches=%d (%.1f tok/dispatch, "
+        "last chunk D=%d) tokens/s=%.1f sync=%.3fs of %.3fs"
+        % (toks, disp, toks / disp if disp else 0.0,
+           stats.get("last_chunk", 0), stats.get("tokens_per_sec", 0.0),
+           stats.get("sync_seconds", 0.0), stats.get("step_seconds", 0.0))
     )
 
 

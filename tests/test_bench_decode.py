@@ -1,0 +1,43 @@
+"""Tier-1 smoke of benchmarks/bench_decode.py.
+
+Like test_bench_compile / test_bench_dispatch: the macro-step decode
+benchmark must keep emitting the one-line JSON payload the driver parses,
+and its built-in greedy-parity gate (chunked macro-step == per-token token
+streams, bit for bit) must hold — so the chunked decode path can't bitrot
+unexercised between measured rounds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_decode_smoke_emits_valid_json():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PADDLE_TPU_BENCH_SMOKE="1",
+               PADDLE_TPU_BENCH_CPU="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "bench_decode.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+    assert out.returncode == 0, (out.stderr or out.stdout)[-800:]
+    line = next(ln for ln in reversed(out.stdout.splitlines()) if ln.startswith("{"))
+    payload = json.loads(line)
+    assert payload["metric"] == "serving_decode_chunked_speedup"
+    assert payload["unit"] == "x"
+    assert payload["value"] > 0
+    assert "vs_baseline" in payload
+    # the acceptance direction: chunked streams must equal per-token ones
+    assert payload["tokens_match"] is True
+    detail = payload["detail"]
+    assert detail["chunk"] > 1
+    assert detail["per_token_tokens_per_sec"] > 0
+    assert detail["chunked_tokens_per_sec"] > 0
+    # depth sweep ran under the LayerStack scan and stayed depth-constant-ish
+    sweep = detail["depth_sweep"]
+    assert sweep["scan_layers"] is True
+    assert sweep["deep_layers"] > sweep["shallow_layers"]
+    assert sweep["shallow_first_step_s"] > 0 and sweep["deep_first_step_s"] > 0
+    # macro-stepping really amortized dispatches: tokens >> dispatches
+    st = detail["decode_stats"]
+    assert st["tokens"] > st["dispatches"]

@@ -78,6 +78,9 @@ def test_crnn_shapes_ctc_loss_and_decode():
     assert len(decoded) == B and all(isinstance(s, list) for s in decoded)
 
 
+@pytest.mark.slow  # 121s: 60 eager train iterations to convergence — the
+# heaviest single test in the fast tier (--durations); CRNN shape/CTC-loss/
+# decode coverage stays fast via the two sibling tests below
 def test_crnn_overfits_one_sample():
     """CTC training drives the greedy decode to the target sequence on a
     single fixed input — end-to-end recognition learning."""

@@ -203,6 +203,10 @@ def test_fleet_e2e_mp_dp():
         dist.set_mesh(None)
 
 
+@pytest.mark.slow  # ShardedTrainStep over the in-process 8-dev XLA:CPU
+# communicator SIGSEGVs intermittently on jax 0.4.37 (same class as the
+# slow-marked test_dist_passes zero+pp+tp compose and the MoE semi-auto
+# train) — a mid-suite segfault kills the whole tier-1 process
 def test_group_sharded_levels():
     from paddle_tpu.distributed.sharding import group_sharded_parallel
 

@@ -206,6 +206,49 @@ def test_generate_parity_scan_vs_loop():
         a = loop_model.generate(prompt, max_new_tokens=4, cache=cache)
         b = scan_model.generate(prompt, max_new_tokens=4, cache=cache)
         assert np.array_equal(np.asarray(a._value), np.asarray(b._value)), cache
+    # macro-step decode threads the paged pools THROUGH the scan body
+    # (decode_scan): chunked scan == per-token loop, bit for bit,
+    # including the max_new % D tail chunk
+    c = scan_model.generate(prompt, max_new_tokens=6, cache="paged",
+                            decode_chunk=4)
+    d = loop_model.generate(prompt, max_new_tokens=6, cache="paged",
+                            decode_chunk=1)
+    assert np.array_equal(np.asarray(c._value), np.asarray(d._value))
+
+
+def test_engine_on_layer_stack_matches_loop_engine():
+    """GenerationEngine over a fuse_layer_stack model: the macro-step
+    program scans ONE layer body with the paged pools as scan state, and
+    its tokens equal the unrolled-loop engine's exactly (greedy + a
+    sampled slot, request joining at a macro-step boundary)."""
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import GenerationEngine
+
+    def build(fuse):
+        paddle.seed(11)
+        cfg = llama_tiny(num_hidden_layers=2, hidden_size=32,
+                         intermediate_size=64, num_attention_heads=2,
+                         num_key_value_heads=2, vocab_size=64,
+                         max_position_embeddings=64, dtype="float32",
+                         fuse_layer_stack=fuse)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    def run(fuse, D):
+        eng = GenerationEngine(build(fuse), max_batch=2, block_size=8,
+                               num_blocks=16, decode_chunk=D)
+        eng.add_request("a", [5, 9, 17, 33, 2], max_new_tokens=8)
+        eng.step()
+        eng.add_request("b", [7, 11, 3], max_new_tokens=6,
+                        temperature=4.0, seed=9)
+        while eng.has_work():
+            eng.step()
+        return eng.result("a"), eng.result("b")
+
+    ref = run(False, 1)
+    assert run(True, 4) == ref
+    assert run(True, 1) == ref
 
 
 def test_flags_scan_layers_forces_stack():

@@ -342,6 +342,9 @@ def test_segment_layers_cuts():
         segment_layers([1, 2], 3)
 
 
+@pytest.mark.slow  # 50s: the interleaved-VPP variant of the 84s full-model
+# pipeline test right above — edge-stage coverage stays fast through that
+# test; the VPP schedule itself is also covered by the trunk VPP test
 def test_full_model_vpp_matches_single_device():
     """Interleaved VPP with edge stages (embedding + head inside the
     pipelined region): numerics match single-device."""

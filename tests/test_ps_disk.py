@@ -154,6 +154,28 @@ def test_geo_client_delta_push_converges(tmp_path):
     assert np.abs(final - target).mean() < 0.05, np.abs(final - target).mean()
 
 
+def test_geo_push_only_rows_propagate():
+    """Rows FIRST touched via push() — never pulled through the geo client
+    — must still reach the global table on sync().  They previously never
+    entered _base (only the wrapped pull seeded it), so sync() skipped
+    them forever and their training was silently lost (ADVICE.md)."""
+    glob = SparseTable(4, optimizer="sgd", lr=1.0)
+    ids = np.arange(5)
+    before = glob.pull(ids).copy()  # materialize + snapshot global rows
+    geo = GeoPsClient(PsClient(table=glob), dim=4, geo_steps=100, lr=0.5)
+    g = np.ones((5, 4), np.float32)
+    geo.push(ids, g)  # push-only: no prior geo.pull for these rows
+    geo.sync()
+    after = glob.pull(ids)
+    # local applied -lr*g to the pulled base; the delta push must land it
+    np.testing.assert_allclose(after, before - 0.5 * g, atol=1e-6)
+    # and the rows keep training through the normal pull/push cycle
+    cur = geo.pull(ids)
+    geo.push(ids, np.full((5, 4), -1.0, np.float32))
+    geo.sync()
+    np.testing.assert_allclose(glob.pull(ids), cur + 0.5, atol=1e-6)
+
+
 def test_sparse_embedding_over_ssd_table(tmp_path):
     """Integration: the lookup-table layer trains against the DISK tier."""
     import jax

@@ -85,6 +85,9 @@ def test_sep_attention_world1_fallback():
     np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # 45s (VERDICT #5 named it): the 4-dev shard_map compile
+# dominates regardless of shape; op-level ring/Ulysses parity stays in the
+# fast tier via the reference-matching tests below
 def test_context_parallel_llama_matches_replicated():
     """Model-level context parallelism: full LlamaForCausalLM with the
     sequence sharded over a 4-way 'sep' axis (ring attention + rank-offset

@@ -95,6 +95,139 @@ def test_fused_ec_moe():
     np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4, atol=2e-4)
 
 
+def _tiny_lm(fuse=False, n_layers=2, seed=11):
+    paddle.seed(seed)
+    from paddle_tpu.models.llama import llama_tiny
+
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=n_layers, num_attention_heads=4,
+                     num_key_value_heads=4, max_position_embeddings=64,
+                     dtype="float32", fuse_layer_stack=fuse)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_chunked_generate_parity_across_chunk_sizes():
+    """Macro-step decoding (decode_chunk=D) must emit BIT-IDENTICAL token
+    streams for every D — greedy and seeded sampling — on both the
+    unrolled loop and the LayerStack scan layout, including the
+    max_new_tokens % D tail chunk (max_new 10: D=4 -> 2 full + tail 2,
+    D=8 -> 1 full + tail 1)."""
+    loop_m, scan_m = _tiny_lm(False), _tiny_lm(True)
+    scan_m.set_state_dict(loop_m.state_dict())
+    prompt = paddle.to_tensor(
+        np.random.default_rng(6).integers(0, 64, (2, 7)).astype(np.int32))
+
+    with paddle.no_grad():
+        ref = np.asarray(loop_m.generate(
+            prompt, max_new_tokens=10, cache="paged", block_size=4,
+            decode_chunk=1)._value)
+        sref = np.asarray(loop_m.generate(
+            prompt, max_new_tokens=10, cache="paged", block_size=4,
+            do_sample=True, temperature=1.5, seed=3, decode_chunk=1)._value)
+        for m, name in ((loop_m, "loop"), (scan_m, "scan")):
+            for D in (4, 8):
+                got = np.asarray(m.generate(
+                    prompt, max_new_tokens=10, cache="paged", block_size=4,
+                    decode_chunk=D)._value)
+                np.testing.assert_array_equal(got, ref, err_msg=f"{name} D={D}")
+                sgot = np.asarray(m.generate(
+                    prompt, max_new_tokens=10, cache="paged", block_size=4,
+                    do_sample=True, temperature=1.5, seed=3,
+                    decode_chunk=D)._value)
+                np.testing.assert_array_equal(sgot, sref,
+                                              err_msg=f"{name} D={D} sampled")
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="decode_chunk"):
+        loop_m.generate(prompt, max_new_tokens=4, decode_chunk=0)
+
+
+def test_chunked_engine_parity_and_macro_boundaries():
+    """GenerationEngine macro-stepping: chunked token streams equal the
+    per-token engine's for greedy AND per-slot sampled requests; requests
+    admitted between step() calls join at macro-step boundaries; a request
+    hitting EOS mid-chunk retires with its surplus lanes dropped; the
+    step() return contract is {rid: tok} at D=1 and {rid: [toks]} at
+    D>1."""
+    from paddle_tpu.serving import GenerationEngine
+
+    p1, p2 = [5, 9, 17, 33, 2], [7, 11, 3]
+
+    def run(D, eos=None):
+        eng = GenerationEngine(_tiny_lm(), max_batch=2, block_size=8,
+                               num_blocks=16, eos_token_id=eos,
+                               decode_chunk=D)
+        eng.add_request("a", p1, max_new_tokens=9)
+        first = eng.step()  # "b" joins at the next macro-step boundary
+        eng.add_request("b", p2, max_new_tokens=6, temperature=5.0, seed=42)
+        while eng.has_work():
+            eng.step()
+        return first, eng.result("a"), eng.result("b")
+
+    f1, a1, b1 = run(1)
+    assert isinstance(f1["a"], int)  # D=1 keeps the scalar contract
+    for D in (4, 8):
+        fD, aD, bD = run(D)
+        assert isinstance(fD["a"], list) and len(fD["a"]) <= D
+        assert (aD, bD) == (a1, b1), f"D={D}"
+
+    # EOS discovered mid-chunk: same early stop as the per-token engine
+    eos = a1[1]
+    _, ae1, be1 = run(1, eos=eos)
+    assert ae1[-1] == eos and len(ae1) < len(a1)
+    for D in (4, 8):
+        _, aeD, beD = run(D, eos=eos)
+        assert (aeD, beD) == (ae1, be1), f"D={D} eos"
+
+
+def test_decode_scan_is_depth_constant_and_pool_safe():
+    """The LayerStack decode scan traces ONE layer body regardless of
+    depth (the loop path traces one per layer), and a chunked engine on a
+    scan model still recycles pool pages cleanly after mid-chunk
+    completion (no headroom blocks needed: masked lanes write scratch)."""
+    import paddle_tpu.models.llama as llama_mod
+    from paddle_tpu.serving import GenerationEngine
+
+    prompt = paddle.to_tensor(np.array([[5, 9, 1]], np.int32))
+    counts = {}
+    real = llama_mod._decode_layer_paged
+
+    def counting(*a, **kw):
+        counts["n"] = counts.get("n", 0) + 1
+        return real(*a, **kw)
+
+    def traced_body_runs(fuse, n_layers):
+        m = _tiny_lm(fuse, n_layers=n_layers)
+        counts["n"] = 0
+        llama_mod._decode_layer_paged = counting
+        try:
+            with paddle.no_grad():
+                m.generate(prompt, max_new_tokens=5, cache="paged",
+                           block_size=8, decode_chunk=4)
+        finally:
+            llama_mod._decode_layer_paged = real
+        return counts["n"]
+
+    scan2, scan4 = traced_body_runs(True, 2), traced_body_runs(True, 4)
+    loop4 = traced_body_runs(False, 4)
+    assert scan2 == scan4, (scan2, scan4)  # depth-constant trace
+    assert loop4 >= 4 * scan4 / 2, (loop4, scan4)  # loop pays per layer
+
+    # pool hygiene on the scan + chunk path: pages all return to the pool
+    m = _tiny_lm(True)
+    eng = GenerationEngine(m, max_batch=1, block_size=8, num_blocks=4,
+                           decode_chunk=4)
+    free0 = len(eng._free)
+    eng.add_request("one", [4, 8, 15], max_new_tokens=5)
+    while eng.has_work():
+        eng.step()
+    assert len(eng._free) == free0
+    assert len(eng.result("one")) == 5
+
+
 def test_generate_sampling_surface():
     """decode_strategy='sampling' (reference generate() surface):
     deterministic per seed, top_k=1 == greedy, naive == paged sampling

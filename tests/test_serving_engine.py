@@ -51,7 +51,11 @@ def test_continuous_batching_requests_join_mid_flight():
     ref1 = _ref_generate(model, p1, 8)
     ref2 = _ref_generate(model, p2, 6)
 
-    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=16)
+    # decode_chunk=2: at the flag default (8) request 'a' would finish
+    # inside the first macro-step and 'b' would decode alone — the
+    # co-resident mid-flight join this test exists for needs short chunks
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=16,
+                           decode_chunk=2)
     eng.add_request("a", p1, max_new_tokens=8)
     eng.step()
     eng.step()
