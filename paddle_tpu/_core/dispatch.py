@@ -51,6 +51,8 @@ from __future__ import annotations
 
 import functools
 import threading
+import warnings
+import weakref
 from collections import OrderedDict
 
 import numpy as np
@@ -61,7 +63,8 @@ from . import flags
 from . import dtype as dtype_mod
 from .tensor import Tensor
 
-__all__ = ["cache", "lookup", "DispatchCache", "FALLBACK", "in_cached_trace"]
+__all__ = ["cache", "lookup", "DispatchCache", "FALLBACK", "in_cached_trace",
+           "ScanBodyReuseWarning"]
 
 # Sentinel: "run the eager slow path instead" (None is not used — an op fn
 # could in principle return None).
@@ -310,6 +313,100 @@ def _on_flags_change(_changed):
     # cached trace rather than track per-flag dependencies.
     cache.resize(int(flags.flag("FLAGS_eager_op_cache_size")))
     cache.clear()
+    if flags.flag("FLAGS_scan_body_guard"):
+        _install_scan_guard()
+
+
+# ------------------------------------------------- scan-body identity guard
+#
+# jax's lax.scan caches the traced body jaxpr keyed by the body FUNCTION'S
+# IDENTITY (+ avals).  A body function object shared across two distinct
+# jit traces hands the second trace the FIRST trace's cached jaxpr, whose
+# consts are that trace's closed-over tracers (bound model weights) →
+# UnexpectedTracerError, or silently stale constants.  PR 3 hit exactly
+# this in the macro-step decode path; the fix is structural (define scan
+# bodies INSIDE the traced function — docs/SCAN_LAYERS.md), and this
+# dev-mode guard (FLAGS_scan_body_guard) makes regressions loud: it wraps
+# jax.lax.scan and warns when the same body object is traced under two
+# distinct jit entries.
+
+
+class ScanBodyReuseWarning(UserWarning):
+    """Same lax.scan body function object traced under two jit entries."""
+
+
+_orig_lax_scan = None
+# id(body fn) -> (weakref(body) | None, weakref(trace) | None, label);
+# a collected body removes its own entry, so a recycled id cannot collide.
+_scan_seen: dict = {}
+
+
+def _current_jit_trace():
+    """The innermost DynamicJaxprTrace when jax is jit-tracing, else None
+    (the hazard needs closed-over consts to be tracers of an enclosing
+    trace; eager scans are safe)."""
+    try:
+        from jax._src import core as _src_core
+
+        t = _src_core.trace_ctx.trace
+    except Exception:
+        return None
+    return t if type(t).__name__ == "DynamicJaxprTrace" else None
+
+
+def _guarded_scan(f, *args, **kwargs):
+    if flags.flag("FLAGS_scan_body_guard") and callable(f):
+        trace = _current_jit_trace()
+        if trace is not None:
+            key = id(f)
+            rec = _scan_seen.get(key)
+            if rec is not None and rec[0]() is not None:
+                prev = rec[1]() if rec[1] is not None else None
+                if prev is not trace:
+                    # previous trace is a different live trace, or already
+                    # dead — either way jax's scan-jaxpr cache may serve
+                    # that trace's consts to this one
+                    warnings.warn(
+                        f"lax.scan body {rec[2]} is shared across two "
+                        "distinct jit traces: jax caches the scan jaxpr by "
+                        "body-function identity, so the second trace can "
+                        "receive the first trace's closed-over tracer "
+                        "consts (UnexpectedTracerError / stale constants). "
+                        "Define the scan body inside the jit-traced "
+                        "function so each trace gets a fresh body object "
+                        "(docs/SCAN_LAYERS.md).",
+                        ScanBodyReuseWarning, stacklevel=2)
+            label = getattr(f, "__qualname__", None) or repr(f)
+            try:
+                fref = weakref.ref(f, lambda _r, _k=key: _scan_seen.pop(_k, None))
+            except TypeError:
+                # not weakref-able (e.g. a __slots__ callable): pin it so
+                # id(f) can never be recycled onto a different body while
+                # the record exists — the entry leaks, but only under this
+                # dev-mode flag and only for such bodies
+                fref = (lambda _f=f: _f)
+            try:
+                tref = weakref.ref(trace)
+            except TypeError:
+                tref = None
+            _scan_seen[key] = (fref, tref, label)
+    return _orig_lax_scan(f, *args, **kwargs)
+
+
+def _install_scan_guard():
+    """Idempotently wrap the public jax.lax.scan alias (the wrapper is a
+    no-op passthrough while the flag is off, so it is never uninstalled)."""
+    global _orig_lax_scan
+    if _orig_lax_scan is not None:
+        return
+    import jax.lax as _lax
+
+    _orig_lax_scan = _lax.scan
+    _lax.scan = functools.wraps(_orig_lax_scan)(_guarded_scan)
+
+
+if flags.flag("FLAGS_scan_body_guard"):  # env-enabled at import
+    _install_scan_guard()
 
 
 # ------------------------------------------------------------ jit factories

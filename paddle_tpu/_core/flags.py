@@ -118,3 +118,19 @@ define_flag(
     "Substitute attention/rms-norm/swiglu subgraphs in captured Programs "
     "with Pallas kernels before lowering (static.rewrite.PallasFusionPass)",
 )
+define_flag(
+    "FLAGS_verify_programs",
+    False,
+    "Verify-mode for the static IR (static/verify.py): ProgramVerifier runs "
+    "around every program pass and on the Executor's compile path, and "
+    "rewritten programs are differentially replayed against the original "
+    "on the live feed (docs/VERIFIER.md)",
+)
+define_flag(
+    "FLAGS_scan_body_guard",
+    False,
+    "Dev-mode guard: warn when the same lax.scan body function object is "
+    "traced under two distinct jit entries — jax's scan-jaxpr cache would "
+    "serve the first trace's closed-over tracers to the second "
+    "(docs/SCAN_LAYERS.md; _core/dispatch.py)",
+)

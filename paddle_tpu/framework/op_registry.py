@@ -36,6 +36,11 @@ __all__ = [
     "all_ops",
     "generate_inplace_variants",
     "generate_markdown",
+    "applied_op_names",
+    "known_op_types",
+    "resolve_op_type",
+    "register_op_type",
+    "side_effect_op_types",
 ]
 
 
@@ -136,6 +141,165 @@ def all_ops() -> list[str]:
     return sorted(build_registry())
 
 
+# ------------------------------------------------------- op-type resolution
+#
+# A captured Program's Operator.type space is wider than the public-op
+# registry: the apply() funnel records nn-functional / loss / sequence ops
+# under their own names, passes append namespaced super-ops
+# ("fp16::matmul", "wq::linear", "gradient_merge::optimizer_update"), and
+# decomposition emits raw jax primitive names.  The verifier
+# (static/verify.py) resolves every recorded type through here so an op
+# rename — which would silently stop rewrite patterns from matching — is a
+# mechanical error instead.
+
+# Structural op types emitted by the IR machinery itself, not the funnel.
+_STRUCTURAL_OP_TYPES = {
+    "grad",              # static/autodiff.py value_and_grad super-op
+    "share_loss",        # loss-vid re-bind alias (autodiff)
+    "optimizer_update",  # optimizer/optimizer.py static step super-op
+    "segment",           # recompute::segment (distributed program rewrite)
+    "accumulate",        # gradient_merge::accumulate
+}
+
+# Types produced by the Pallas substitution passes (static/rewrite.py).
+_PASS_OP_TYPES = {
+    "flash_attention",
+    "fused_rms_norm",
+    "fused_layer_norm",
+    "swiglu",
+    "matmul_epilogue",
+    "add_rms_norm",
+    "add_layer_norm",
+}
+
+_EXTRA_OP_TYPES: set[str] = set()
+
+_applied_names: frozenset[str] | None = None
+_primitive_names: frozenset[str] | None = None
+
+
+def register_op_type(name: str):
+    """Declare an extension op type as resolvable (plugins / custom passes)."""
+    global _known_types_cache
+    _EXTRA_OP_TYPES.add(str(name))
+    _known_types_cache = None
+    return name
+
+
+def applied_op_names() -> frozenset[str]:
+    """Every op name the package passes to the apply()/record() funnel as a
+    string literal — scanned from source once and cached.  This is the full
+    legitimate Operator.type surface beyond the public-op registry; an op
+    rename changes this set, so pattern references to the old name become
+    detectable (tests/test_api_surface.py lint)."""
+    global _applied_names
+    if _applied_names is None:
+        import pathlib
+        import re
+
+        import paddle_tpu
+
+        # apply()/record() direct literals plus the unary()/binary() op
+        # factories (tensor/_ops_common.py), whose first arg IS the op id
+        pat = re.compile(
+            r"""\b(?:apply|record|unary|binary)\(\s*['"]([A-Za-z0-9_]+)['"]""")
+        names: set[str] = set()
+        pkg = pathlib.Path(paddle_tpu.__file__).parent
+        for p in pkg.rglob("*.py"):
+            try:
+                names.update(pat.findall(p.read_text()))
+            except OSError:
+                continue
+        _applied_names = frozenset(names)
+    return _applied_names
+
+
+def _jax_primitive_names() -> frozenset[str]:
+    """jax primitive names (decomposition emits one Operator per eqn)."""
+    global _primitive_names
+    if _primitive_names is None:
+        names: set[str] = set()
+        try:
+            from jax.extend import core as _xcore
+
+            prims = _xcore.primitives
+            for attr in dir(prims):
+                if attr.endswith("_p"):
+                    prim = getattr(prims, attr)
+                    name = getattr(prim, "name", None)
+                    if isinstance(name, str):
+                        names.add(name)
+        except Exception:
+            pass
+        _primitive_names = frozenset(names)
+    return _primitive_names
+
+
+_known_types_cache: frozenset[str] | None = None
+
+
+def known_op_types() -> frozenset[str]:
+    """Union of every resolvable base op type (no namespaces); cached —
+    the verifier resolves every op of every swept program through this."""
+    global _known_types_cache
+    if _known_types_cache is None:
+        _known_types_cache = frozenset(build_registry()) | applied_op_names() \
+            | _STRUCTURAL_OP_TYPES | _PASS_OP_TYPES | _EXTRA_OP_TYPES \
+            | _jax_primitive_names()
+    return _known_types_cache
+
+
+def base_op_type(type_: str) -> str:
+    """Strip pass-inserted namespaces ("wq::fp16::matmul" -> "matmul").
+
+    The single definition of the namespace convention — the rewrite
+    patterns, DCE's side-effect check, and the verifier all anchor on it
+    and must agree."""
+    return type_.rsplit("::", 1)[-1]
+
+
+def resolve_op_type(type_: str) -> bool:
+    """True when a recorded Operator.type resolves to a known op.
+
+    Strips pass namespaces ("wq::fp16::matmul" -> "matmul"), accepts the
+    generated vpu_chain_<N> kernels and eager "<op>_grad" tape nodes."""
+    base = base_op_type(type_)
+    if base in known_op_types():
+        return True
+    if base.startswith("vpu_chain_") and base[len("vpu_chain_"):].isdigit():
+        return True
+    if base.endswith("_grad") and base[: -len("_grad")] in known_op_types():
+        return True
+    return False
+
+
+# Op types with host- or peer-visible effects: eliminating them changes
+# behavior beyond their data outputs (RNG stream consumption, printing,
+# user callbacks, a rank's collective participation), so DCE must keep
+# them even when no fetch reaches their outputs.
+_SIDE_EFFECT_EXTRA = {
+    "seed", "print", "py_func", "ps_pull_sparse",
+    "dropout", "alpha_dropout", "rrelu", "gumbel_softmax",
+    "all_reduce", "all_gather", "send", "recv", "barrier",
+}
+
+_side_effect_cache: frozenset[str] | None = None
+
+
+def side_effect_op_types() -> frozenset[str]:
+    """Base op types DeadCodeEliminationPass must never eliminate: the
+    generated in-place tier (`op_` names), the RNG tier (registry category
+    "random"), and the explicit host/collective-effect set."""
+    global _side_effect_cache
+    if _side_effect_cache is None:
+        reg = build_registry()
+        names = {n for n in reg if n.endswith("_")}
+        names.update(n for n, i in reg.items() if i.category == "random")
+        names.update(_SIDE_EFFECT_EXTRA)
+        _side_effect_cache = frozenset(names)
+    return _side_effect_cache
+
+
 # ------------------------------------------------------------------ codegen
 
 # The in-place tier (reference: inplace ad_funcs generated from the
@@ -200,8 +364,10 @@ def generate_inplace_variants() -> list[str]:
             generated.append(name)
         if not hasattr(Tensor, name):
             setattr(Tensor, name, getattr(target, name))
-    global _registry
+    global _registry, _side_effect_cache, _known_types_cache
     _registry = None  # registry reflects the new surface on next build
+    _side_effect_cache = None
+    _known_types_cache = None
     return generated
 
 

@@ -216,7 +216,8 @@ class Profiler:
         Ends with the eager dispatch-cache counters when the fast path has
         seen traffic."""
         from .statistics import (compile_cache_line, decode_line,
-                                 dispatch_cache_line, summary_text)
+                                 dispatch_cache_line, summary_text,
+                                 verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -230,6 +231,9 @@ class Profiler:
         dec_line = decode_line(decode_stats())
         if dec_line:
             out = out + "\n" + dec_line
+        ver_line = verify_line(verify_stats())
+        if ver_line:
+            out = out + "\n" + ver_line
         print(out)
         return out
 
@@ -353,8 +357,20 @@ def compile_stats(reset: bool = False) -> dict:
     return stats
 
 
+def verify_stats(reset: bool = False) -> dict:
+    """Static-IR verify-mode counters (FLAGS_verify_programs; see
+    static/verify.py and docs/VERIFIER.md): programs verified/failed,
+    violations found, abstract-eval skips, differential checks run/failed,
+    and pattern rewrites the use-def guard refused.  A healthy verified run
+    shows failures and violations at zero; non-zero rewrites_refused means
+    a fusion pattern tried to consume a value the program still needs."""
+    from paddle_tpu.static import verify as _verify
+
+    return _verify.verify_stats(reset=reset)
+
+
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
-            "decode_stats"]
+            "decode_stats", "verify_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

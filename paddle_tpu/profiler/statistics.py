@@ -206,6 +206,25 @@ def decode_line(stats: dict) -> str:
     )
 
 
+def verify_line(stats: dict) -> str:
+    """One-line rendering of the IR verify-mode counters for
+    Profiler.summary(); empty when FLAGS_verify_programs never ran.
+    A nonzero rewrites_refused alone still renders the line: the rewrite
+    driver rolls fusions back flag-independently, and a refusal is exactly
+    the red flag verify_stats() tells users to watch for."""
+    if not (stats.get("programs_verified") or stats.get("differential_checks")
+            or stats.get("rewrites_refused")):
+        return ""
+    return (
+        "IR verify: programs=%d failed=%d violations=%d abstract_skips=%d; "
+        "differential checks=%d failed=%d; rewrites refused=%d"
+        % (stats["programs_verified"], stats["programs_failed"],
+           stats["violations"], stats["abstract_eval_skips"],
+           stats["differential_checks"], stats["differential_failures"],
+           stats["rewrites_refused"])
+    )
+
+
 def compile_cache_line(stats: dict) -> str:
     """One-line rendering of the trace/compile + persistent-cache counters
     for Profiler.summary(); empty when nothing compiled this process."""

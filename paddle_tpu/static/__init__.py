@@ -33,6 +33,12 @@ from .io import (  # noqa: F401
     serialize_program,
     deserialize_program,
 )
+from .verify import (  # noqa: F401
+    ProgramVerifier,
+    VerificationError,
+    differential_check,
+    verify_program,
+)
 from . import nn  # noqa: F401
 from .compat import *  # noqa: F401,F403
 from .compat import __all__ as _compat_all
@@ -57,6 +63,10 @@ __all__ = _compat_all + [
     "nn",
     "cpu_places",
     "device_guard",
+    "ProgramVerifier",
+    "VerificationError",
+    "verify_program",
+    "differential_check",
 ]
 
 

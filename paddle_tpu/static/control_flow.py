@@ -71,6 +71,9 @@ def _discover_captures(fns, prog):
     from paddle_tpu.static.program import Program, program_guard
 
     temp = Program()
+    # sacrificial: records ops against the OUTER program's vids and is then
+    # discarded — verifier sweeps (static.verify.track_programs) skip it
+    temp._discovery = True
     rec = TouchRecorder()
     with record_touched_tensors(rec), program_guard(temp):
         for fn in fns:

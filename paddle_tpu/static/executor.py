@@ -105,6 +105,7 @@ class Executor:
 
         from paddle_tpu._core import flags
 
+        verify_mode = flags.flag("FLAGS_verify_programs")
         if flags.flag("FLAGS_use_pallas_fusion"):
             # default pass pipeline: substitute Pallas kernels for the
             # attention/rms-norm/swiglu subgraphs XLA cannot re-derive
@@ -120,12 +121,37 @@ class Executor:
             if stamp not in seen:
                 from .rewrite import PallasFusionPass
 
-                PallasFusionPass(fetch_vids).apply(program)
+                # verify mode: keep the unrewritten program so any fusion
+                # this stamp performs can be differentially replayed on the
+                # LIVE feed (static/verify.py; docs/VERIFIER.md)
+                reference = program.clone() if verify_mode else None
+                fused = PallasFusionPass(fetch_vids).apply(program)
+                if verify_mode and fused:
+                    from .verify import DifferentialError, differential_check
+
+                    try:
+                        differential_check(reference, program, fetch_vids,
+                                           feeds=feed_vals)
+                    except DifferentialError:
+                        # sticky failure: un-fuse and don't stamp, so a
+                        # caller that catches and retries re-runs the pass
+                        # and the check instead of silently serving the
+                        # mis-fused program
+                        program.global_block().ops[:] = \
+                            reference.global_block().ops
+                        program.version = reference.version
+                        raise
                 seen.add((program.version, fetch_vids))
 
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
         key = (id(program), program.version, sig, fetch_vids)
         if key not in self._cache:
+            if verify_mode:
+                # compile path, verify mode: the program about to be traced
+                # must be structurally valid for THIS fetch set
+                from .verify import verify_program
+
+                verify_program(program, fetch_vids)
             # Prune to the fetch/write frontier (non-mutating): ops whose
             # outputs no fetch or state write needs don't execute.  Beyond
             # wasted compute, a dead duplicate of a collective-carrying

@@ -162,9 +162,11 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
         for name in passes or []:
             opts = dict(name) if isinstance(name, dict) else {}
             pname = opts.pop("name", name) if isinstance(name, dict) else name
-            if pname == "dead_code_elimination":
-                # DCE without a fetch frontier is a documented no-op:
-                # forward the export's fetch set
+            if pname in ("dead_code_elimination", "pallas_fusion",
+                         "generic_elementwise_fusion"):
+                # these passes compute use-def against the fetch frontier:
+                # forward the export's fetch set so a fusion cannot swallow
+                # a fetched intermediate (and DCE isn't a documented no-op)
                 opts.setdefault("fetch_vids", [v._vid for v in fetch_vars])
             apply_pass(prog, pname, **opts)
             if record:
@@ -175,6 +177,24 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
         _apply_passes(program, record=True)
         if precision:
             applied.append(_apply_precision(program, precision))
+
+    from paddle_tpu._core import flags as _flags
+
+    if _flags.flag("FLAGS_verify_programs"):
+        # export verify mode: the artifact bakes the optimized program, so
+        # check it structurally for the export's fetch frontier, and — when
+        # only numerics-preserving passes ran — differentially against the
+        # unrewritten program (precision rewrites change numerics by
+        # design and are excluded; docs/VERIFIER.md)
+        from .verify import differential_check, verify_program
+
+        fetch_vids = [v._vid for v in fetch_vars]
+        verify_program(program, fetch_vids)
+        numerics_preserving = {"dead_code_elimination", "pallas_fusion",
+                               "generic_elementwise_fusion"}
+        if (program is not base_program and not precision
+                and set(applied) <= numerics_preserving):
+            differential_check(base_program, program, fetch_vids)
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
 
     # Additional precision variants of the SAME program — each gets the SAME

@@ -28,7 +28,13 @@ class ProgramPass:
 
 class DeadCodeEliminationPass(ProgramPass):
     """Remove ops whose outputs no fetch/write/op-input can reach
-    (reference paddle/fluid/pir/transforms/dead_code_elimination_pass.cc)."""
+    (reference paddle/fluid/pir/transforms/dead_code_elimination_pass.cc).
+
+    Ops with side effects beyond their data outputs — the in-place tier,
+    RNG/seed ops (eliminating one shifts every later op's key sequence),
+    print/py_func, collectives (a dropped rank deadlocks its peers) — are
+    never eliminated, fetch-reachable or not
+    (framework.op_registry.side_effect_op_types)."""
 
     name = "dead_code_elimination"
 
@@ -36,6 +42,9 @@ class DeadCodeEliminationPass(ProgramPass):
         self._fetch_vids = set(fetch_vids or ())
 
     def apply(self, program) -> int:
+        from paddle_tpu.framework.op_registry import (
+            base_op_type, side_effect_op_types)
+
         block = program.global_block()
         live = set(self._fetch_vids)
         live.update(program.writes.keys())
@@ -45,11 +54,13 @@ class DeadCodeEliminationPass(ProgramPass):
             # ops feeding writes are provably removable; keep all. (The
             # executor applies this pass with the actual fetch list.)
             return 0
+        effectful = side_effect_op_types()
         removed = 0
         # reverse liveness walk over the op list
         keep = []
         for op in reversed(block.ops):
-            if any(v in live for v in op.out_vids):
+            if (any(v in live for v in op.out_vids)
+                    or base_op_type(op.type) in effectful):
                 keep.append(op)
                 live.update(op.input_vids())
             else:
@@ -68,13 +79,40 @@ def dead_code_elimination(program, fetch_vars=()):
 
 
 class ProgramPassManager:
-    def __init__(self, passes):
+    """Runs passes in order; under FLAGS_verify_programs every pass runs
+    between verifier invocations (the reference PassManager's
+    EnableIRPrinting/verify hooks) so the pass that breaks an invariant is
+    named in the error, not discovered downstream."""
+
+    def __init__(self, passes, fetch_vids=()):
         self._passes = list(passes)
+        self._fetch_vids = tuple(fetch_vids)
 
     def run(self, program):
+        from paddle_tpu._core import flags
+
+        verify = flags.flag("FLAGS_verify_programs")
+        if verify:
+            from .verify import VerificationError, verify_program
+
+            try:
+                verify_program(program, self._fetch_vids)
+            except VerificationError as e:
+                raise VerificationError(
+                    e.violations,
+                    header="Program invalid BEFORE pass pipeline") from None
         total = 0
         for p in self._passes:
             total += p.apply(program)
+            if verify:
+                try:
+                    verify_program(program, self._fetch_vids)
+                except VerificationError as e:
+                    raise VerificationError(
+                        e.violations,
+                        header=f"Program invalid after pass "
+                               f"{getattr(p, 'name', type(p).__name__)!r}",
+                    ) from None
         return total
 
 
@@ -244,4 +282,10 @@ _REGISTRY = {
 def apply_pass(program, name, **kwargs):
     if name not in _REGISTRY:
         raise ValueError(f"unknown program pass {name!r}; have {sorted(_REGISTRY)}")
-    return _REGISTRY[name](**kwargs).apply(program)
+    from paddle_tpu._core import flags
+
+    pass_ = _REGISTRY[name](**kwargs)
+    if flags.flag("FLAGS_verify_programs"):
+        fetch = kwargs.get("fetch_vids") or ()
+        return ProgramPassManager([pass_], fetch_vids=fetch).run(program)
+    return pass_.apply(program)

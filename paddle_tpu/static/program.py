@@ -48,6 +48,10 @@ __all__ = [
 
 _vid_counter = itertools.count()
 
+# Observers called with every new Program (construction AND clone) — the
+# verifier's track_programs() sweep hook (static/verify.py, tools/lint_ir.py).
+_creation_hooks: list = []
+
 
 class Variable(Tensor):
     """Symbolic tensor in a Program: `_value` is a jax.ShapeDtypeStruct.
@@ -134,6 +138,8 @@ class Program:
         self.version = 0
         self._var_by_vid: dict[int, Variable] = {}
         self.random_seed = None
+        for cb in _creation_hooks:
+            cb(self)
 
     # ------------------------------------------------------------- structure
     def global_block(self) -> Block:
@@ -295,6 +301,8 @@ class Program:
         p.version = self.version
         p._var_by_vid = dict(self._var_by_vid)
         p.random_seed = self.random_seed
+        for cb in _creation_hooks:
+            cb(p)
         return p
 
     def to_string(self, throw_on_error=False, with_details=False):

@@ -37,12 +37,11 @@ __all__ = [
 ]
 
 
-def _base_type(t):
-    """Strip pass-inserted namespaces ('fp16::matmul' -> 'matmul') so
-    patterns still anchor after the fp16 program rewrite has run — the
-    rewrite order (user-applied fp16 pass, then the Executor's default
-    fusion pass) would otherwise silently defeat every substitution."""
-    return t.rsplit("::", 1)[-1]
+# Strip pass-inserted namespaces ('fp16::matmul' -> 'matmul') so patterns
+# still anchor after the fp16 program rewrite has run — the rewrite order
+# (user-applied fp16 pass, then the Executor's default fusion pass) would
+# otherwise silently defeat every substitution.
+from ..framework.op_registry import base_op_type as _base_type
 
 
 def _const_scalar(spec):
@@ -142,7 +141,14 @@ class RewritePattern:
 
 class PatternRewritePass:
     """Greedy driver: apply patterns to fixpoint (bounded), reference
-    ApplyPatternsGreedily."""
+    ApplyPatternsGreedily.
+
+    Every successful rewrite is use-def verified against the program's
+    fetch frontier before it is accepted: a pattern that consumes an
+    interior var whose producer other ops (or the fetch list) still need is
+    ROLLED BACK and counted in `self.refused` — patterns cannot break
+    def-before-use no matter what they match.  Under FLAGS_verify_programs
+    the whole pass additionally runs between full verifier invocations."""
 
     name = "pattern_rewrite"
 
@@ -150,9 +156,30 @@ class PatternRewritePass:
         self._patterns = list(patterns)
         self._fetch_vids = tuple(fetch_vids)
         self._max_iterations = max_iterations
+        self.refused = 0
+
+    def _rewrite_ok(self, program) -> bool:
+        """Structural use-def + live-producer check of the post-rewrite
+        program (registry/abstract tiers skipped: a rewrite cannot
+        introduce those violation classes cheaply checkable here)."""
+        from .verify import ProgramVerifier
+
+        v = ProgramVerifier(check_registry=False, check_kwargs=False,
+                            abstract_eval=False)
+        bad = v._check_structure(program, self._fetch_vids)
+        bad += v._check_live_producers(program, self._fetch_vids)
+        return not bad
 
     def apply(self, program) -> int:
+        from paddle_tpu._core import flags
+
+        verify = flags.flag("FLAGS_verify_programs")
+        if verify:
+            from .verify import verify_program
+
+            verify_program(program, self._fetch_vids)
         total = 0
+        refused_sites: set = set()  # (pattern, op) identities already rolled back
         for _ in range(self._max_iterations):
             graph = ProgramGraph(program, self._fetch_vids)
             changed = 0
@@ -162,13 +189,41 @@ class PatternRewritePass:
                         continue
                     if op not in graph.block.ops:
                         break  # already replaced this round
+                    if (id(pat), id(op)) in refused_sites:
+                        continue  # rolled back while the program was in
+                        # this state; re-attempted only after another
+                        # rewrite changes it (the op object survives
+                        # rollbacks verbatim, so the identity is stable)
+                    ops_before = list(graph.block.ops)
+                    version_before = program.version
                     if pat.match_and_rewrite(op, graph):
+                        if not self._rewrite_ok(program):
+                            # refuse to fuse: restore the pre-rewrite op
+                            # list; an interior matched var had consumers
+                            # outside the matched set or sat in the fetch
+                            # list
+                            graph.block.ops[:] = ops_before
+                            program.version = version_before
+                            refused_sites.add((id(pat), id(op)))
+                            self.refused += 1
+                            from .verify import _COUNTERS
+
+                            _COUNTERS["rewrites_refused"] += 1
+                            graph = ProgramGraph(program, self._fetch_vids)
+                            continue
                         changed += 1
                         graph = ProgramGraph(program, self._fetch_vids)
                         break
             total += changed
             if not changed:
                 break
+            # progress made: a refused site's outside consumers may have
+            # been fused away, so it gets one fresh attempt per change round
+            refused_sites.clear()
+        if verify:
+            from .verify import verify_program
+
+            verify_program(program, self._fetch_vids)
         return total
 
 

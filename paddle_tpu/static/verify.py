@@ -1,0 +1,436 @@
+"""Static-IR verifier + pass-differential checker.
+
+Reference role: the IR verifier every serious compiler stack runs between
+passes (TVM's structural verifier, arxiv 1802.04799; XLA's HloVerifier and
+fusion-correctness analysis, arxiv 2301.13062).  The survey's PIR layer has
+`paddle/pir/core/verify.h` for the same reason: a pattern rewrite that
+mis-reads an attribute (the transpose-blind MatmulEpilogue fusion), retires
+the wrong producer (the fetch-frontier prune keeping two producers of the
+loss vid), or emits a malformed op must fail mechanically, not survive
+until a reviewer spot-reads the graph.
+
+Two layers:
+
+- **ProgramVerifier** — structural checks over any Program: def-before-use
+  (no dangling vids), every op type resolvable through the op registry
+  (framework/op_registry.py resolve_op_type), kwargs completeness for the
+  attributes rewrite patterns gate on (matmul transpose flags, gelu
+  approximate, norm epsilon), at most one live producer per vid on the
+  fetch frontier (the executor-prune invariant), and per-op shape/dtype
+  consistency via abstract eval (`jax.eval_shape` over the recorded op fn)
+  so a rewrite that changes an intermediate's shape or dtype is an error.
+
+- **differential_check(reference, candidate, fetch_vids)** — replays both
+  programs eagerly on the same feed (caller-supplied or synthetic) from
+  identical RNG state and asserts the fetch set matches to tolerance; the
+  mechanical answer to "did this pass change numerics".
+
+Wiring (all gated on ``FLAGS_verify_programs``): ProgramPassManager and
+PatternRewritePass verify pre/post, the Executor verifies on every compile
+and differentially checks the fusion pass against the unrewritten program
+on the live feed, save_inference_model checks its optimized clone, and
+``tools/lint_ir.py`` sweeps every Program a test run builds.  Counters
+surface through ``paddle_tpu.profiler.verify_stats()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Violation",
+    "VerificationError",
+    "DifferentialError",
+    "ProgramVerifier",
+    "verify_program",
+    "differential_check",
+    "track_programs",
+    "verify_stats",
+    "reset_verify_stats",
+]
+
+
+_COUNTERS = {
+    "programs_verified": 0,
+    "programs_failed": 0,
+    "violations": 0,
+    "abstract_eval_skips": 0,
+    "differential_checks": 0,
+    "differential_failures": 0,
+    "differential_skips": 0,  # reference program not eagerly replayable
+    "rewrites_refused": 0,  # PatternRewritePass use-def rollbacks
+}
+
+
+def verify_stats(reset: bool = False) -> dict:
+    out = dict(_COUNTERS)
+    if reset:
+        reset_verify_stats()
+    return out
+
+
+def reset_verify_stats():
+    for k in _COUNTERS:
+        _COUNTERS[k] = 0
+
+
+@dataclass
+class Violation:
+    code: str       # dangling-vid | unknown-op-type | missing-kwargs | ...
+    message: str
+    op_index: int = -1
+    op_type: str = ""
+
+    def __str__(self):
+        loc = f" [op#{self.op_index} {self.op_type}]" if self.op_index >= 0 else ""
+        return f"{self.code}{loc}: {self.message}"
+
+
+class VerificationError(RuntimeError):
+    def __init__(self, violations, header="Program verification failed"):
+        self.violations = list(violations)
+        lines = [f"{header} ({len(self.violations)} violation(s)):"]
+        lines += [f"  - {v}" for v in self.violations]
+        super().__init__("\n".join(lines))
+
+
+class DifferentialError(VerificationError):
+    """Fetch-set numerics differ between the original and rewritten program."""
+
+
+# Kwargs the rewrite patterns gate on (static/rewrite.py): a recording path
+# that drops one of these makes the corresponding pattern blind — the exact
+# shape of the transpose-blind MatmulEpilogue bug.
+_REQUIRED_KWARGS = {
+    "matmul": ("transpose_x", "transpose_y"),
+    "gelu": ("approximate",),
+    "softmax": ("axis",),
+    "layer_norm": ("epsilon",),
+    "rms_norm": ("epsilon",),
+    "fused_rms_norm": ("epsilon",),
+    "add_rms_norm": ("epsilon",),
+    "add_layer_norm": ("epsilon",),
+}
+
+
+from ..framework.op_registry import base_op_type as _base_type
+
+
+class ProgramVerifier:
+    """Structural + abstract-eval checks over a Program.
+
+    check_registry / check_kwargs / abstract_eval toggle the check tiers;
+    ``strict_abstract`` escalates an op fn that cannot be abstractly
+    evaluated (e.g. a collective outside its mesh) from a counted skip to a
+    violation."""
+
+    def __init__(self, check_registry=True, check_kwargs=True,
+                 abstract_eval=True, strict_abstract=False):
+        self.check_registry = check_registry
+        self.check_kwargs = check_kwargs
+        self.abstract_eval = abstract_eval
+        self.strict_abstract = strict_abstract
+
+    # ------------------------------------------------------------------ api
+    def verify(self, program, fetch_vids=(), raise_on_error=False):
+        violations = []
+        violations += self._check_structure(program, fetch_vids)
+        violations += self._check_live_producers(program, fetch_vids)
+        _COUNTERS["programs_verified"] += 1
+        if violations:
+            _COUNTERS["programs_failed"] += 1
+            _COUNTERS["violations"] += len(violations)
+            if raise_on_error:
+                raise VerificationError(violations)
+        return violations
+
+    # ------------------------------------------------------------ structure
+    def _check_structure(self, program, fetch_vids):
+        from paddle_tpu.framework.op_registry import resolve_op_type
+
+        v = []
+        ops = program.global_block().ops
+        defined = set(program.param_inits)
+        for var in program.feed_vars:
+            if var._vid not in program._var_by_vid:
+                v.append(Violation("unregistered-feed",
+                                   f"feed '{var.name}' (vid {var._vid}) is not "
+                                   "registered in the program"))
+            defined.add(var._vid)
+
+        for i, op in enumerate(ops):
+            base = _base_type(op.type)
+            if self.check_registry and not resolve_op_type(op.type):
+                v.append(Violation(
+                    "unknown-op-type",
+                    f"op type {op.type!r} does not resolve in the op registry "
+                    "(renamed op? unregistered extension? see "
+                    "framework.op_registry.register_op_type)", i, op.type))
+            if self.check_kwargs:
+                for k in _REQUIRED_KWARGS.get(base, ()):
+                    if k not in op.kwargs:
+                        v.append(Violation(
+                            "missing-kwargs",
+                            f"op records no {k!r} kwarg; rewrite patterns gate "
+                            "on it and would mis-match this op", i, op.type))
+
+            in_avals, inputs_ok = [], True
+            for spec in op.arg_spec:
+                if spec[0] != "var":
+                    continue
+                vid = spec[1]
+                var = program._var_by_vid.get(vid)
+                if var is None:
+                    v.append(Violation(
+                        "unregistered-vid",
+                        f"input vid {vid} has no Variable", i, op.type))
+                    inputs_ok = False
+                    continue
+                if vid not in defined:
+                    v.append(Violation(
+                        "dangling-vid",
+                        f"input vid {vid} ('{var.name}') is read before any "
+                        "feed/state/op defines it", i, op.type))
+                    inputs_ok = False
+                in_avals.append(jax.ShapeDtypeStruct(var._value.shape,
+                                                     var._value.dtype))
+
+            out_vars = []
+            for vid in op.out_vids:
+                var = program._var_by_vid.get(vid)
+                if var is None:
+                    v.append(Violation(
+                        "unregistered-vid",
+                        f"output vid {vid} has no Variable", i, op.type))
+                out_vars.append(var)
+
+            if (self.abstract_eval and inputs_ok
+                    and all(o is not None for o in out_vars)):
+                v += self._abstract_eval_op(i, op, in_avals, out_vars)
+
+            defined.update(op.out_vids)
+
+        for tgt, src in program.writes.items():
+            if tgt not in program._var_by_vid:
+                v.append(Violation("bad-write",
+                                   f"write target vid {tgt} has no Variable"))
+            if src not in defined:
+                v.append(Violation("bad-write",
+                                   f"write source vid {src} is never defined"))
+        for vid in fetch_vids:
+            if vid not in defined:
+                v.append(Violation(
+                    "dangling-fetch",
+                    f"fetch vid {vid} is never defined by a feed, state var "
+                    "or op (a rewrite consumed its producer?)"))
+        return v
+
+    def _abstract_eval_op(self, i, op, in_avals, out_vars):
+        try:
+            out = jax.eval_shape(op.fn, *in_avals)
+            flat = jax.tree_util.tree_leaves(out)
+        except Exception as e:  # collective outside mesh, host-only fn, ...
+            _COUNTERS["abstract_eval_skips"] += 1
+            if self.strict_abstract:
+                return [Violation("abstract-eval-error",
+                                  f"op fn failed abstract eval: {e!r}",
+                                  i, op.type)]
+            return []
+        if len(flat) != len(op.out_vids):
+            return [Violation(
+                "arity-mismatch",
+                f"op fn produces {len(flat)} outputs but records "
+                f"{len(op.out_vids)} out vids", i, op.type)]
+        v = []
+        for var, o in zip(out_vars, flat):
+            want = (tuple(var._value.shape), jnp.dtype(var._value.dtype))
+            got = (tuple(o.shape), jnp.dtype(o.dtype))
+            if want[0] != got[0]:
+                v.append(Violation(
+                    "shape-mismatch",
+                    f"'{var.name}' recorded as {want[0]} but op fn produces "
+                    f"{got[0]}", i, op.type))
+            elif want[1] != got[1]:
+                v.append(Violation(
+                    "dtype-mismatch",
+                    f"'{var.name}' recorded as {want[1]} but op fn produces "
+                    f"{got[1]}", i, op.type))
+        return v
+
+    # --------------------------------------------------- live-producer check
+    def _check_live_producers(self, program, fetch_vids):
+        """Replicate the executor's last-writer-wins fetch-frontier prune,
+        then require that no vid in the kept set is redefined while its
+        previous definition went unread — i.e. at most one live producer
+        per vid reaches the frontier (the PR-2 invariant: share_loss
+        re-binds the loss vid precisely so the original forward chain can
+        drop; keeping both means the compiled step traces the forward
+        twice, and a duplicated collective-carrying chain can deadlock)."""
+        ops = program.global_block().ops
+        live = set(fetch_vids) | set(program.writes) | set(program.writes.values())
+        kept = []
+        for op in reversed(ops):
+            if any(vid in live for vid in op.out_vids):
+                kept.append(op)
+                live.difference_update(op.out_vids)
+                live.update(op.input_vids())
+        kept.reverse()
+
+        v = []
+        unread: dict[int, bool] = {}  # vid -> latest def not yet read
+        for i, op in enumerate(kept):
+            for vid in set(op.input_vids()):
+                unread[vid] = False
+            for vid in op.out_vids:
+                if unread.get(vid, False):
+                    var = program._var_by_vid.get(vid)
+                    name = var.name if var is not None else vid
+                    v.append(Violation(
+                        "duplicate-producer",
+                        f"two live producers of '{name}' (vid {vid}) reach "
+                        "the fetch frontier: the earlier definition is never "
+                        "read before this op redefines it (superseded chain "
+                        "not retired)", i, op.type))
+                unread[vid] = True
+        return v
+
+
+def verify_program(program, fetch_vids=(), raise_on_error=True, **kwargs):
+    """One-shot convenience: ProgramVerifier(**kwargs).verify(...)."""
+    return ProgramVerifier(**kwargs).verify(
+        program, fetch_vids=fetch_vids, raise_on_error=raise_on_error)
+
+
+# ---------------------------------------------------------------------------
+# pass-differential checker
+
+
+def _synthetic_feeds(feed_vars, seed):
+    rng = np.random.default_rng(seed)
+    feeds = []
+    for var in feed_vars:
+        shape = tuple(var._value.shape)
+        dt = np.dtype(var._value.dtype)
+        if np.issubdtype(dt, np.floating):
+            feeds.append(jnp.asarray(rng.standard_normal(shape), dt))
+        elif dt == np.bool_:
+            feeds.append(jnp.asarray(rng.integers(0, 2, shape).astype(bool)))
+        else:
+            # small non-negative ints: valid for ids/indices in tiny vocab
+            feeds.append(jnp.asarray(rng.integers(0, 2, shape), dt))
+    return feeds
+
+
+def _replay(program, fetch_vids, feed_vals):
+    """Execute the program eagerly (no jit, no capture) on feed_vals with
+    param_inits as state; restores the RNG state it consumed."""
+    from paddle_tpu._core import random as _rnd
+
+    from .program import _st as _static_state
+
+    run_fn, feed_vids, state_vids = program.as_function(list(fetch_vids))
+    state_vals = [program.param_inits[vid] for vid in state_vids]
+    coerced = [jnp.asarray(v, program._var_by_vid[vid]._value.dtype)
+               for vid, v in zip(feed_vids, feed_vals)]
+    rng_state = _rnd.get_rng_state()
+    prev = _static_state.main_program
+    _static_state.main_program = None
+    try:
+        fetches, _ = run_fn(coerced, state_vals)
+    finally:
+        _static_state.main_program = prev
+        _rnd.set_rng_state(rng_state)
+    return [np.asarray(f) for f in fetches]
+
+
+def differential_check(reference, candidate, fetch_vids, feeds=None,
+                       rtol=2e-3, atol=2e-3, seed=0, raise_on_error=True):
+    """Replay `reference` and `candidate` on the same feed from identical
+    RNG state and compare the fetch set.  Returns the list of mismatch
+    Violations (empty when the programs agree); raises DifferentialError
+    when raise_on_error and they do not.
+
+    feeds: positional feed values matching reference.feed_vars (the live
+    executor feed, when available) — synthesized from the feed avals
+    otherwise.  Default tolerance matches the Pallas-kernel parity bar of
+    tests/test_pallas_fusion.py (interpret-mode kernels on CPU)."""
+    _COUNTERS["differential_checks"] += 1
+    fetch_vids = list(fetch_vids)
+    if feeds is None:
+        feeds = _synthetic_feeds(reference.feed_vars, seed)
+    else:
+        feeds = [v._value if hasattr(v, "_value") else v for v in feeds]
+
+    violations = []
+    try:
+        ref_out = _replay(reference, fetch_vids, feeds)
+    except Exception:
+        # the REFERENCE cannot execute eagerly (collective outside its
+        # mesh, host-only op, ...): there is no oracle to compare against —
+        # counted skip, mirroring the verifier's abstract_eval_skips
+        _COUNTERS["differential_skips"] += 1
+        return []
+    try:
+        cand_out = _replay(candidate, fetch_vids, feeds)
+    except Exception as e:
+        violations.append(Violation(
+            "differential-crash",
+            f"rewritten program failed to execute on the differential "
+            f"feed: {e!r}"))
+        cand_out = None
+    if cand_out is not None:
+        for vid, a, b in zip(fetch_vids, ref_out, cand_out):
+            var = reference._var_by_vid.get(vid)
+            name = var.name if var is not None else vid
+            if a.shape != b.shape or a.dtype != b.dtype:
+                violations.append(Violation(
+                    "differential-mismatch",
+                    f"fetch '{name}': aval changed "
+                    f"{a.shape}/{a.dtype} -> {b.shape}/{b.dtype}"))
+                continue
+            if not np.issubdtype(a.dtype, np.inexact):
+                if not np.array_equal(a, b):
+                    violations.append(Violation(
+                        "differential-mismatch",
+                        f"fetch '{name}': integer fetch values differ"))
+                continue
+            if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+                err = float(np.max(np.abs(a.astype(np.float64)
+                                          - b.astype(np.float64))))
+                violations.append(Violation(
+                    "differential-mismatch",
+                    f"fetch '{name}': numerics differ (max abs err "
+                    f"{err:.3e} at rtol={rtol} atol={atol}) — the rewrite "
+                    "changed the computation"))
+    if violations:
+        _COUNTERS["differential_failures"] += 1
+        if raise_on_error:
+            raise DifferentialError(
+                violations, header="Pass-differential check failed")
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# program tracking (tools/lint_ir.py + the tier-1 property test)
+
+
+@contextlib.contextmanager
+def track_programs():
+    """Collect every Program constructed while active (creation + clone),
+    so a sweep can verify everything a test run traced."""
+    from . import program as _prog_mod
+
+    seen: list = []
+    _prog_mod._creation_hooks.append(seen.append)
+    try:
+        yield seen
+    finally:
+        _prog_mod._creation_hooks.remove(seen.append)
+        # drop sacrificial discovery programs (control_flow capture replay):
+        # they record ops against the OUTER program's vids and are discarded
+        seen[:] = [p for p in seen if not getattr(p, "_discovery", False)]

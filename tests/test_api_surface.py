@@ -38,6 +38,38 @@ def test_no_dead_flags():
     assert not dead, f"dead flags (defined but never read via flag()): {dead}"
 
 
+def test_rewrite_pattern_op_types_resolve_in_registry():
+    """Every op type the static rewrite patterns reference must resolve in
+    the op registry (framework.op_registry.resolve_op_type): rename an op
+    and a pattern silently stops matching — this lint (plus the IR
+    verifier's unknown-op-type check) turns that into a failure."""
+    import inspect
+
+    import paddle_tpu.static.rewrite as rewrite
+    from paddle_tpu.framework.op_registry import resolve_op_type
+    from paddle_tpu.static.rewrite import RewritePattern
+
+    referenced = set(rewrite._ELEMENTWISE)
+    for obj in vars(rewrite).values():
+        if (isinstance(obj, type) and issubclass(obj, RewritePattern)
+                and obj is not RewritePattern):
+            if obj.root_type:
+                referenced.add(obj.root_type)
+            referenced.update(getattr(obj, "_ROOTS", ()))
+    src = inspect.getsource(rewrite)
+    # anchor/producer literals: graph.def_op(vid, "type") and
+    # _base_type(x) == "type" / in ("a", "b") comparisons
+    referenced.update(re.findall(r"def_op\([^,()]+,\s*['\"](\w+)['\"]", src))
+    referenced.update(re.findall(r"_base_type\([^)]*\)\s*==\s*['\"](\w+)['\"]", src))
+    for m in re.finditer(r"_base_type\([^)]*\)\s*(?:not\s+)?in\s*\(([^)]*)\)", src):
+        referenced.update(re.findall(r"['\"](\w+)['\"]", m.group(1)))
+    assert len(referenced) > 10, "pattern scan found implausibly few op types"
+    unresolved = sorted(t for t in referenced if not resolve_op_type(t))
+    assert not unresolved, (
+        f"rewrite patterns reference op types missing from the registry "
+        f"(renamed op?): {unresolved}")
+
+
 def test_reference_top_level_surface_complete():
     src = open("/root/reference/python/paddle/__init__.py").read()
     m = re.search(r"__all__ = \[(.*?)\]", src, re.S)

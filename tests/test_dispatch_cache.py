@@ -359,3 +359,73 @@ def test_pytree_roundtrip_restores_dist_slots():
 
     t3 = ident(t)
     assert t3.is_dist() is False
+
+
+# ---------------------------------------------------- scan-body identity guard
+
+
+def test_scan_body_guard_warns_on_body_shared_across_jit_traces():
+    """FLAGS_scan_body_guard: the same lax.scan body function object traced
+    under two distinct jit entries poisons jax's scan-jaxpr cache (PR 3,
+    docs/SCAN_LAYERS.md) — the dev-mode guard must warn."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    paddle.set_flags({"FLAGS_scan_body_guard": True})
+    try:
+        def shared_body(c, x):  # ONE body object, reused across traces
+            return c + x, c
+
+        def run(xs):
+            return jax.lax.scan(shared_body, jnp.zeros(()), xs)[0]
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            jax.jit(run)(jnp.ones(4))  # first trace: no warning
+            assert not any(isinstance(w.message, dispatch.ScanBodyReuseWarning)
+                           for w in caught)
+            jax.jit(lambda xs: run(xs) * 2)(jnp.ones(4))  # second trace
+        assert any(isinstance(w.message, dispatch.ScanBodyReuseWarning)
+                   for w in caught), "shared scan body not flagged"
+    finally:
+        paddle.set_flags({"FLAGS_scan_body_guard": False})
+
+
+def test_scan_body_guard_quiet_for_fresh_bodies_and_when_off():
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    paddle.set_flags({"FLAGS_scan_body_guard": True})
+    try:
+        def run(xs):
+            def body(c, x):  # defined INSIDE the traced fn — the fix
+                return c + x, c
+
+            return jax.lax.scan(body, jnp.zeros(()), xs)[0]
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            jax.jit(run)(jnp.ones(4))
+            jax.jit(lambda xs: run(xs) * 2)(jnp.ones(4))
+        assert not any(isinstance(w.message, dispatch.ScanBodyReuseWarning)
+                       for w in caught)
+    finally:
+        paddle.set_flags({"FLAGS_scan_body_guard": False})
+
+    # flag off: a shared body stays silent (guard is dev-mode only)
+    def shared(c, x):
+        return c + x, c
+
+    def run2(xs):
+        return jax.lax.scan(shared, jnp.zeros(()), xs)[0]
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        jax.jit(run2)(jnp.ones(4))
+        jax.jit(lambda xs: run2(xs) * 3)(jnp.ones(4))
+    assert not any(isinstance(w.message, dispatch.ScanBodyReuseWarning)
+                   for w in caught)

@@ -17,6 +17,26 @@ import paddle_tpu.nn as nn
 import paddle_tpu.static as static
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _trace_counting_flags():
+    """Disable every flag that executes op fns outside the compiled step —
+    the fusion pattern scan and verify mode's abstract eval + differential
+    replay would otherwise inflate the trace counters."""
+    from paddle_tpu._core import flags
+
+    prev = {"FLAGS_use_pallas_fusion": flags.flag("FLAGS_use_pallas_fusion"),
+            "FLAGS_verify_programs": flags.flag("FLAGS_verify_programs")}
+    paddle.set_flags({"FLAGS_use_pallas_fusion": False,
+                      "FLAGS_verify_programs": False})
+    try:
+        yield
+    finally:
+        paddle.set_flags(prev)
+
+
 def _count_op_traces(program, op_type):
     """Wrap every `op_type` op's fn with a Python-side trace counter (the fn
     runs exactly once per inclusion in a compiled step's trace)."""
@@ -55,13 +75,11 @@ def test_compiled_step_traces_forward_exactly_once():
     exe = static.Executor()
     xv = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
     # fusion pass off: its pattern scan traces op fns too, which would
-    # count pass-time traces instead of compiled-step traces
-    paddle.set_flags({"FLAGS_use_pallas_fusion": False})
-    try:
+    # count pass-time traces instead of compiled-step traces; verify mode
+    # likewise executes op fns (abstract eval + differential replay)
+    with _trace_counting_flags():
         fetches = exe.run(main, feed={"x": xv},
                           fetch_list=[loss] + [g for _, g in p_g])
-    finally:
-        paddle.set_flags({"FLAGS_use_pallas_fusion": True})
     # exactly ONE trace: the grad super-op's internal value_and_grad
     # forward.  The superseded original producer contributes the second
     # trace when the prune is not last-writer-wins.
@@ -91,7 +109,8 @@ def test_forward_only_fetch_still_runs_forward():
     counter = _count_op_traces(main, op_type)
     exe = static.Executor()
     xv = np.random.default_rng(1).standard_normal((2, 4)).astype(np.float32)
-    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    with _trace_counting_flags():
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
     assert counter["n"] == 1
     ref = xv @ np.asarray(layer.weight._value) + np.asarray(layer.bias._value)
     np.testing.assert_allclose(out, ref, atol=1e-5)

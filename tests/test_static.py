@@ -251,6 +251,31 @@ def test_program_dce_pass():
         paddle.disable_static()
 
 
+def test_static_programs_pass_ir_verification():
+    """Property: every Program this module's canonical paths build —
+    capture, layer capture, append_backward, minimize-train, cond/while,
+    DCE — passes the IR verifier with the fusion pipeline on
+    (static/verify.py; sweep the full suite with tools/lint_ir.py)."""
+    from paddle_tpu.static.verify import ProgramVerifier, track_programs
+
+    paddle.seed(0)
+    with track_programs() as programs:
+        test_program_capture_and_run()
+        test_layer_capture_registers_params()
+        test_append_backward_grads()
+        test_static_training_minimize_loss_decreases()
+        test_static_program_cond_and_while()
+        test_program_dce_pass()
+
+    assert len(programs) >= 6
+    verifier = ProgramVerifier()
+    for prog in programs:
+        violations = verifier.verify(prog)
+        assert not violations, (
+            f"program {[op.type for op in prog.global_block().ops]}: "
+            f"{[str(v) for v in violations]}")
+
+
 def test_bert_jit_save_predictor_roundtrip(tmp_path):
     """Serving integration: jit.save a BERT classifier -> inference
     Predictor reproduces eager logits (reference save_inference_model +

@@ -260,3 +260,38 @@ def test_conv_transpose_output_padding_strictly_below_stride():
     assert F.conv1d_transpose(x, w, stride=2, output_size=[18]).shape[-1] == 18
     with pytest.raises(ValueError, match=r"outside \[0, stride\)"):
         F.conv1d_transpose(x, w, stride=2, output_size=[19])
+
+
+# ------------------------------------------------------------- IR property
+
+
+def test_wide_static_programs_pass_ir_verification():
+    """Property: every Program this module's static paths build — the
+    data_norm running-stats programs plus a dense snn capture run through
+    the executor with the fusion pipeline on — passes the IR verifier
+    (static/verify.py; sweep the whole suite with tools/lint_ir.py)."""
+    from paddle_tpu import static
+    from paddle_tpu.static.verify import ProgramVerifier, track_programs
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    with track_programs() as programs:
+        test_data_norm_accumulates_running_stats()
+        test_data_norm_honors_data_layout()
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("xw", [2, 3, 8, 8], "float32")
+            h = snn.group_norm(x, groups=3)
+            out = snn.layer_norm(h, begin_norm_axis=1).mean()
+        static.Executor().run(
+            main, feed={"xw": rng.standard_normal((2, 3, 8, 8)).astype("float32")},
+            fetch_list=[out])
+
+    assert len(programs) >= 3
+    verifier = ProgramVerifier()
+    for prog in programs:
+        violations = verifier.verify(prog)
+        assert violations == [], (
+            f"program with ops {[op.type for op in prog.global_block().ops]} "
+            f"failed verification: {[str(v) for v in violations]}")

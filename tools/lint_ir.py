@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Standalone static-IR lint: sweep Programs through the ProgramVerifier.
+
+Two modes (docs/VERIFIER.md):
+
+  python tools/lint_ir.py
+      Battery mode — builds the canonical capture paths (arith capture,
+      layer capture, append_backward + optimizer step, cond/while, vanilla
+      attention/rms-norm/swiglu with the Pallas fusion pipeline applied,
+      weight-only quant export) and verifies every resulting Program,
+      including a pass-differential replay of the fused attention program.
+
+  python tools/lint_ir.py --pytest tests/test_static.py [more node ids...]
+      Sweep mode — runs pytest in-process with the program-creation hook
+      installed (static.verify.track_programs) and verifies EVERY Program
+      those tests trace.
+
+Exit status 0 = no violations; 1 = violations found (report on stdout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _verify_all(programs, labels=None):
+    from paddle_tpu.static.verify import ProgramVerifier
+
+    verifier = ProgramVerifier()
+    failures = 0
+    for i, prog in enumerate(programs):
+        label = labels[i] if labels else f"program#{i}"
+        if isinstance(prog, list):  # pre-computed violations (differential)
+            n_ops, violations = None, prog
+        else:
+            n_ops, violations = len(prog.global_block().ops), verifier.verify(prog)
+        ops = f" ({n_ops} ops)" if n_ops is not None else ""
+        if violations:
+            failures += 1
+            print(f"FAIL {label}{ops}:")
+            for v in violations:
+                print(f"    {v}")
+        else:
+            print(f"ok   {label}{ops}")
+    return failures
+
+
+def _battery() -> int:
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.static as static
+    from paddle_tpu.static.rewrite import PallasFusionPass
+    from paddle_tpu.static.verify import differential_check, verify_stats
+
+    paddle.seed(0)
+    programs, labels = [], []
+
+    # arithmetic capture
+    p = static.Program()
+    with static.program_guard(p):
+        x = static.data("x", [2, 3], "float32")
+        y = static.data("y", [2, 3], "float32")
+        z = paddle.sum(paddle.add(x, y) * 2.0)
+    programs.append(p), labels.append("arith")
+
+    # layer capture + backward + optimizer step
+    layer = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    p = static.Program()
+    with static.program_guard(p):
+        x = static.data("xt", [8, 4], "float32")
+        yt = static.data("yt", [8, 2], "float32")
+        loss = paddle.mean((layer(x) - yt) ** 2)
+        opt.minimize(loss)
+    programs.append(p), labels.append("train-step")
+
+    # control flow
+    p = static.Program()
+    with static.program_guard(p):
+        x = static.data("cf", [4], "float32")
+        c = static.nn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+        i0 = paddle.zeros([], dtype="int32")
+        s0 = paddle.ones([])
+        _, sv = static.nn.while_loop(lambda i, s: s < 16.0,
+                                     lambda i, s: (i + 1, s * 2.0), [i0, s0])
+    programs.append(p), labels.append("control-flow")
+
+    # vanilla attention/rms-norm/swiglu -> Pallas fusion + differential
+    B, N, S, D, H, F_ = 2, 2, 64, 8, 16, 32
+    p = static.Program()
+    with static.program_guard(p):
+        q = static.data("q", [B, N, S, D], "float32")
+        k = static.data("k", [B, N, S, D], "float32")
+        v = static.data("v", [B, N, S, D], "float32")
+        xh = static.data("xh", [B, S, H], "float32")
+        w = static.data("w", [H], "float32")
+        g = static.data("g", [B, S, F_], "float32")
+        u = static.data("u", [B, S, F_], "float32")
+        probs = F.softmax(paddle.matmul(q, k, transpose_y=True) / (D ** 0.5),
+                          axis=-1)
+        attn = paddle.matmul(probs, v)
+        normed = xh * paddle.rsqrt((xh * xh).mean(axis=-1, keepdim=True)
+                                   + 1e-6) * w
+        sw = F.silu(g) * u
+    fetch = [attn._vid, normed._vid, sw._vid]
+    reference = p.clone()
+    n = PallasFusionPass(fetch).apply(p)
+    print(f"fusion pass substituted {n} subgraphs")
+    diff = differential_check(reference, p, fetch, raise_on_error=False)
+    programs.append(p), labels.append("pallas-fused")
+    if diff:
+        programs.append(diff), labels.append("pallas-fused-differential")
+
+    # weight-only quant
+    layer2 = nn.Linear(8, 8)
+    p = static.Program()
+    with static.program_guard(p):
+        x = static.data("xq", [2, 8], "float32")
+        out = paddle.tanh(layer2(x))
+    from paddle_tpu.static.passes import apply_pass
+
+    apply_pass(p, "weight_only_quant", algo="weight_only_int8")
+    programs.append(p), labels.append("weight-only-quant")
+
+    failures = _verify_all(programs, labels)
+    print()
+    print("verify counters:", verify_stats())
+    return failures
+
+
+def _pytest_sweep(node_ids) -> int:
+    import pytest
+
+    from paddle_tpu.static.verify import track_programs, verify_stats
+
+    with track_programs() as programs:
+        rc = pytest.main(list(node_ids) + ["-q", "-p", "no:cacheprovider"])
+    print(f"\npytest exit={rc}; {len(programs)} Program(s) traced — verifying")
+    failures = _verify_all(programs)
+    print()
+    print("verify counters:", verify_stats())
+    return failures + (1 if rc not in (0, 5) else 0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pytest", nargs="+", metavar="NODE",
+                    help="run these pytest node ids and verify every "
+                         "Program they trace")
+    args = ap.parse_args(argv)
+    failures = _pytest_sweep(args.pytest) if args.pytest else _battery()
+    if failures:
+        print(f"\nlint_ir: {failures} failing program(s)")
+        return 1
+    print("\nlint_ir: all programs verified clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
