@@ -127,6 +127,23 @@ define_flag(
     "on the live feed (docs/VERIFIER.md)",
 )
 define_flag(
+    "FLAGS_checkpoint_kill_point",
+    "",
+    "Dev-mode fault injection for the checkpoint commit protocol: the "
+    "process SIGKILLs itself when CheckpointManager reaches this named "
+    "point (after-shard-write | before-manifest | mid-manifest | "
+    "after-commit) — crash consistency is tested mechanically "
+    "(distributed/checkpoint/manager.py, docs/CHECKPOINT.md)",
+)
+define_flag(
+    "FLAGS_checkpoint_verify_on_save",
+    False,
+    "Belt-and-braces: re-read and checksum-verify a checkpoint directory "
+    "immediately after its atomic commit (CheckpointManager; the write "
+    "thread raises on mismatch instead of letting a bad checkpoint be "
+    "discovered at restore time)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

@@ -87,5 +87,6 @@ from .communication.ops import (  # noqa: F401,E402
 )
 from . import io  # noqa: F401,E402
 from .auto_parallel.engine import Strategy  # noqa: F401,E402
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+from .checkpoint import (CheckpointManager, load_state_dict,  # noqa: F401,E402
+                         save_state_dict)
 from paddle_tpu.io import InMemoryDataset, QueueDataset  # noqa: F401,E402

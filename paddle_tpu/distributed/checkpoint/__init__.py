@@ -17,16 +17,24 @@ background thread after device→host transfer.
 from __future__ import annotations
 
 import os
-import threading
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.framework.io_utils import (atomic_write, spawn_async_write,
+                                           wait_async_save)
 from .metadata import Metadata, ShardRecord, TensorMetadata
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata"]
+__all__ = [
+    "save_state_dict",
+    "load_state_dict",
+    "Metadata",
+    "CheckpointManager",
+    "checkpoint_stats",
+    "wait_async_save",
+]
 
 _META_FILE = "metadata.json"
 
@@ -67,13 +75,17 @@ def _unique_shards(arr: jax.Array):
         yield offset, np.asarray(sh.data)
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, async_save=False, unique_id=None):
-    """Write `path/data_rank{R}.npz` + `path/metadata.json`."""
-    os.makedirs(path, exist_ok=True)
+def build_shard_snapshot(state_dict, fname=None):
+    """Device→host snapshot of a (possibly nested) state dict: returns
+    (arrays, metadata) where `arrays` maps npz keys to host numpy copies of
+    every locally-addressable unique shard and `metadata` records their
+    global placement.  This is the synchronous half of a save — once it
+    returns, training may mutate the live tensors; writing the snapshot to
+    disk can happen on a background thread (CheckpointManager does exactly
+    that)."""
+    if fname is None:
+        fname = f"data_rank{_proc_index()}.npz"
     flat = _flatten_state(state_dict)
-    rank = _proc_index()
-    fname = f"data_rank{rank}.npz"
-
     md = Metadata()
     arrays = {}
     for name, t in flat.items():
@@ -81,26 +93,43 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, as
         if not hasattr(val, "addressable_shards"):
             val = jnp.asarray(val)
         tm = TensorMetadata(name, list(val.shape), str(np.dtype(val.dtype)))
-        for i, (offset, data) in enumerate(_unique_shards(val)):
+        for offset, data in _unique_shards(val):
             key = f"{name}@{'_'.join(map(str, offset))}"
             arrays[key] = data
             tm.shards.append(
                 ShardRecord(fname, key, list(offset), list(data.shape))
             )
         md.tensors[name] = tm
+    return arrays, md, fname
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, async_save=False, unique_id=None):
+    """Write `path/data_rank{R}.npz` + `path/metadata.json`.
+
+    Each file is written atomically (temp file + os.replace, the
+    framework.io_utils.save protocol), so neither can be individually torn
+    by a crash.  NOTE the npz/metadata PAIR is not transactional: a crash
+    between the two replaces of an overwrite-in-place re-save can leave new
+    shards with old metadata — whole-checkpoint atomicity (fresh dir +
+    manifest + single rename) is CheckpointManager's job.  The async path
+    runs on a SUPERVISED thread: join it via the returned Thread or
+    `wait_async_save()`, which re-raises any background failure instead of
+    losing the checkpoint silently."""
+    os.makedirs(path, exist_ok=True)
+    rank = _proc_index()
+    arrays, md, fname = build_shard_snapshot(state_dict)
 
     def _write():
-        np.savez(os.path.join(path, fname), **arrays)
+        with atomic_write(os.path.join(path, fname)) as f:
+            np.savez(f, **arrays)
         if rank == coordinator_rank:
             # NOTE multi-host: ranks would first all-gather shard records;
             # single-controller JAX already addresses every shard here.
-            with open(os.path.join(path, _META_FILE), "w") as f:
+            with atomic_write(os.path.join(path, _META_FILE), "w") as f:
                 f.write(md.to_json())
 
     if async_save:
-        th = threading.Thread(target=_write, daemon=True)
-        th.start()
-        return th
+        return spawn_async_write(_write, path)
     _write()
     return None
 
@@ -181,3 +210,6 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         else:
             container[key] = new_val
     return state_dict
+
+
+from .manager import CheckpointManager, checkpoint_stats  # noqa: E402

@@ -1,0 +1,643 @@
+"""CheckpointManager: fault-tolerant training checkpoints.
+
+Reference blueprint: python/paddle/distributed/checkpoint/ (sharded save +
+reshard-on-load) plus the fleet elastic/recovery stack.  TVM-style
+mechanism/policy separation (PAPERS.md): save_state_dict/load_state_dict in
+this package are the MECHANISM (shard snapshot, reshard-on-load); this
+manager is the POLICY layer — retention, atomic commits, corruption
+detection, auto-resume, preemption — composed on top without growing the
+primitives.
+
+Commit protocol (docs/CHECKPOINT.md):
+  1. snapshot device→host synchronously (training may mutate live state the
+     moment save() returns);
+  2. write shards + metadata + extras into a hidden temp directory;
+  3. write MANIFEST.json (per-file sha256 + size) last, fsync it;
+  4. one atomic os.rename(temp, step_XXXXXXXX).
+A crash at ANY point leaves every previously committed step intact; an
+uncommitted temp dir is invisible to latest_step() and swept by GC; a
+committed dir damaged after the fact (bit rot, manual truncation) fails
+checksum verification and is skipped by auto-resume.
+
+Fault injection: FLAGS_checkpoint_kill_point names a protocol point
+("after-shard-write" | "before-manifest" | "mid-manifest" | "after-commit")
+at which the process hard-kills itself (SIGKILL) — crash consistency is
+tested mechanically (tests/test_checkpoint_crash.py), not argued.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import queue
+import re
+import shutil
+import signal
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu._core.flags import flag
+from paddle_tpu._core.random import get_rng_state, set_rng_state
+from paddle_tpu._core.tensor import Tensor
+
+__all__ = ["CheckpointManager", "checkpoint_stats", "KILL_POINTS"]
+
+_MANIFEST = "MANIFEST.json"
+_EXTRAS = "extras.pkl"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+_TMP_RE = re.compile(r"^_(?:tmp|old)_step_\d{8}\.(\d+)$")
+
+KILL_POINTS = ("after-shard-write", "before-manifest", "mid-manifest", "after-commit")
+
+
+# ---------------------------------------------------------------- counters
+# Module-owned so profiler.checkpoint_stats() reads one schema with no
+# manager handle (same contract as serving.decode_stats).
+_STATS_LOCK = threading.Lock()
+
+
+def _zero_stats():
+    return {
+        "saves": 0,
+        "async_saves": 0,
+        "commits": 0,
+        "bytes_written": 0,
+        "snapshot_seconds": 0.0,
+        "write_seconds": 0.0,
+        "backpressure_seconds": 0.0,
+        "gc_deleted": 0,
+        "restores": 0,
+        "corrupt_skipped": 0,
+        "errors": 0,
+    }
+
+
+_STATS = _zero_stats()
+
+
+def _bump(**kw):
+    with _STATS_LOCK:
+        for k, v in kw.items():
+            _STATS[k] += v
+
+
+def checkpoint_stats(reset: bool = False) -> dict:
+    """CheckpointManager counters: saves (async_saves of them backgrounded),
+    committed step dirs, bytes/seconds split into snapshot (synchronous
+    device→host) vs write (disk), backpressure_seconds save() spent blocked
+    on an in-flight write, GC deletions, restores, and checkpoints skipped
+    as corrupt/torn during auto-resume."""
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        if reset:
+            _STATS.update(_zero_stats())
+    return out
+
+
+# ----------------------------------------------------------- fault injection
+def _maybe_kill(point: str):
+    """Dev-mode crash injection: if FLAGS_checkpoint_kill_point names this
+    protocol point, hard-kill the process (SIGKILL — no atexit, no flushes,
+    exactly what preemption looks like)."""
+    if flag("FLAGS_checkpoint_kill_point") == point:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_file(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _split_tensors(tree):
+    """Split a nested state dict into (tensor_tree, extra_tree): Tensor
+    leaves go through the sharded reshard-on-load store, everything else
+    (scheduler scalars, step counts, LBFGS history arrays) rides the pickled
+    extras file."""
+    tensors, extras = {}, {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            t, e = _split_tensors(v)
+            if t:
+                tensors[k] = t
+            if e:
+                extras[k] = e
+        elif isinstance(v, Tensor):
+            tensors[k] = v
+        else:
+            extras[k] = v
+    return tensors, extras
+
+
+class _CommitJob:
+    __slots__ = ("step", "arrays", "metadata", "fname", "extras_blob")
+
+    def __init__(self, step, arrays, metadata, fname, extras_blob):
+        self.step = step
+        self.arrays = arrays
+        self.metadata = metadata
+        self.fname = fname
+        self.extras_blob = extras_blob
+
+
+class CheckpointManager:
+    """Owns step-tagged checkpoint directories under `dir` and the full
+    save/restore lifecycle of a training job.
+
+        mgr = CheckpointManager("ckpts", save_interval_steps=100,
+                                max_to_keep=3, async_save=True)
+        start = mgr.restore(model=m, optimizer=opt, dataloader=dl) or 0
+        for step in range(start + 1, total + 1):
+            ...train...
+            mgr.maybe_save(step, model=m, optimizer=opt, dataloader=dl)
+        mgr.wait()
+
+    Restores route tensor state through load_state_dict's reshard-on-load,
+    so resuming under a DIFFERENT parallel topology works through this same
+    API.  Restored state covers model params, optimizer accumulators +
+    LR scheduler + step count, the global RNG (seed, counter), and the
+    DataLoader/sampler position — a killed-and-resumed run reproduces the
+    uninterrupted run's per-step losses bit-for-bit.
+    """
+
+    def __init__(self, dir, save_interval_steps=1000, max_to_keep=5,
+                 async_save=True, max_pending=1):
+        if max_to_keep is not None and max_to_keep < 1:
+            raise ValueError("max_to_keep must be >= 1 (or None for unlimited)")
+        self.dir = str(dir)
+        self.save_interval_steps = int(save_interval_steps)
+        self.max_to_keep = max_to_keep
+        self.async_save = bool(async_save)
+        os.makedirs(self.dir, exist_ok=True)
+
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(max_pending)))
+        self._worker = None
+        self._worker_lock = threading.Lock()
+        self._error = None  # first background failure, re-raised on next call
+        self._valid_cache: dict = {}  # step dir -> (manifest mtime, bool)
+        self._skip_counted: set = set()  # torn dirs already counted in stats
+
+        self._preempt_requested = False
+        self._preempt_saved = False
+        self._prev_handlers: dict = {}
+
+        self.restored_extra_state = None
+
+    # ------------------------------------------------------------- layout
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{int(step):08d}")
+
+    def all_steps(self) -> list:
+        """Committed step numbers, ascending (validity not checked)."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.dir, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self):
+        """Newest step whose checkpoint passes checksum verification, or
+        None.  Torn/corrupt directories are skipped (and counted in
+        checkpoint_stats()['corrupt_skipped']), so auto-resume always lands
+        on the newest LOADABLE state."""
+        self._raise_pending()
+        for step in reversed(self.all_steps()):
+            if self._verify_dir(self._step_dir(step)):
+                return step
+            path = self._step_dir(step)
+            if path not in self._skip_counted:  # count each torn dir once
+                self._skip_counted.add(path)
+                _bump(corrupt_skipped=1)
+        return None
+
+    # ------------------------------------------------------------- verify
+    def _verify_dir(self, path: str) -> bool:
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            mtime = os.stat(mpath).st_mtime_ns
+        except OSError:
+            return False
+        cached = self._valid_cache.get(path)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        ok = self._verify_manifest(path, mpath)
+        self._valid_cache[path] = (mtime, ok)
+        return ok
+
+    @staticmethod
+    def _verify_manifest(path: str, mpath: str) -> bool:
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError):
+            return False  # torn or unparsable manifest
+        for name, rec in files.items():
+            fpath = os.path.join(path, name)
+            try:
+                if os.path.getsize(fpath) != rec["size"]:
+                    return False
+                if _sha256_file(fpath) != rec["sha256"]:
+                    return False
+            except (OSError, KeyError):
+                return False
+        return True
+
+    # --------------------------------------------------------------- save
+    def save(self, step, model=None, optimizer=None, lr_scheduler=None,
+             dataloader=None, extra_state=None):
+        """Checkpoint `step` unconditionally.  Snapshots device→host NOW
+        (synchronously); with async_save the disk write + atomic commit run
+        on the supervised background thread — save() blocks only when a
+        previous write is still in flight (backpressure), and any background
+        failure re-raises on the next manager call."""
+        self._raise_pending()
+        step = int(step)
+        t0 = time.perf_counter()
+
+        tensors = {}
+        extras = {"step": step, "rng": list(get_rng_state())}
+        if model is not None:
+            sd = model.state_dict() if hasattr(model, "state_dict") else dict(model)
+            t, e = _split_tensors(sd)
+            tensors["model"] = t
+            if e:
+                extras["model"] = e
+        if optimizer is not None:
+            t, e = _split_tensors(optimizer.state_dict())
+            if t:
+                tensors["optimizer"] = t
+            if e:
+                extras["optimizer"] = e
+        if lr_scheduler is not None:
+            extras["lr_scheduler"] = lr_scheduler.state_dict()
+        if dataloader is not None:
+            extras["dataloader"] = dataloader.state_dict()
+        if extra_state is not None:
+            extras["extra_state"] = extra_state
+
+        from . import build_shard_snapshot
+
+        arrays, md, fname = build_shard_snapshot(tensors)
+        extras_blob = pickle.dumps(extras, protocol=4)
+        _bump(saves=1, snapshot_seconds=time.perf_counter() - t0)
+
+        job = _CommitJob(step, arrays, md, fname, extras_blob)
+        if not self.async_save:
+            self._commit(job)
+            self._raise_pending()
+            return
+
+        self._ensure_worker()
+        tq = time.perf_counter()
+        self._queue.put(job)  # blocks when a write is in flight: backpressure
+        _bump(async_saves=1, backpressure_seconds=time.perf_counter() - tq)
+
+    def maybe_save(self, step, **components) -> bool:
+        """Save when `step` hits the save interval or a preemption signal
+        arrived (install_preemption_handler) — the step-boundary final
+        checkpoint.  Returns True when a save was issued."""
+        step = int(step)
+        due = self._preempt_requested or (
+            self.save_interval_steps > 0 and step % self.save_interval_steps == 0
+        )
+        if not due:
+            return False
+        self.save(step, **components)
+        if self._preempt_requested:
+            self._preempt_saved = True
+        return True
+
+    # ------------------------------------------------------ background IO
+    def _ensure_worker(self):
+        with self._worker_lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            # Daemon + atexit drain: a normal exit flushes pending writes
+            # (wait() re-raises failures); a hard kill abandons at most the
+            # in-flight TEMP dir — committed steps are untouchable by design.
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="CheckpointManager", daemon=True
+            )
+            self._worker.start()
+            import atexit
+
+            atexit.register(self.wait)
+
+    def _worker_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            try:
+                self._commit(job)
+            except BaseException as e:
+                if self._error is None:
+                    self._error = e
+                _bump(errors=1)
+            finally:
+                self._queue.task_done()
+
+    def wait(self):
+        """Join all outstanding async writes; re-raise the first background
+        failure.  Safe to call any time (idle manager: no-op)."""
+        if self._worker is not None:
+            self._queue.join()
+        self._raise_pending()
+
+    def _raise_pending(self):
+        err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                f"checkpoint background write failed in {self.dir!r}"
+            ) from err
+
+    # --------------------------------------------------------- commit core
+    def _commit(self, job: _CommitJob):
+        t0 = time.perf_counter()
+        tmp = os.path.join(self.dir, f"_tmp_step_{job.step:08d}.{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+
+        written = 0
+        shard_path = os.path.join(tmp, job.fname)
+        with open(shard_path, "wb") as f:
+            np.savez(f, **job.arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        written += os.path.getsize(shard_path)
+        _maybe_kill("after-shard-write")
+
+        from . import _META_FILE
+
+        meta_path = os.path.join(tmp, _META_FILE)
+        with open(meta_path, "w") as f:
+            f.write(job.metadata.to_json())
+            f.flush()
+            os.fsync(f.fileno())
+        extras_path = os.path.join(tmp, _EXTRAS)
+        with open(extras_path, "wb") as f:
+            f.write(job.extras_blob)
+            f.flush()
+            os.fsync(f.fileno())
+        written += os.path.getsize(meta_path) + os.path.getsize(extras_path)
+        _maybe_kill("before-manifest")
+
+        manifest = {
+            "format": 1,
+            "step": job.step,
+            "files": {
+                name: {
+                    "sha256": _sha256_file(os.path.join(tmp, name)),
+                    "size": os.path.getsize(os.path.join(tmp, name)),
+                }
+                for name in sorted(os.listdir(tmp))
+            },
+        }
+        data = json.dumps(manifest, indent=1, sort_keys=True)
+        mpath = os.path.join(tmp, _MANIFEST)
+        with open(mpath, "w") as f:
+            if flag("FLAGS_checkpoint_kill_point") == "mid-manifest":
+                f.write(data[: len(data) // 2])
+                f.flush()
+                os.fsync(f.fileno())
+                _maybe_kill("mid-manifest")
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        written += os.path.getsize(mpath)
+
+        final = self._step_dir(job.step)
+        displaced = None
+        if os.path.exists(final):  # re-save of the same step
+            # rename aside, commit, THEN delete: the new data is fully on
+            # disk before the old dir moves, so the unprotected window is
+            # two renames, not an rmtree-then-write
+            displaced = os.path.join(
+                self.dir, f"_old_step_{job.step:08d}.{os.getpid()}")
+            shutil.rmtree(displaced, ignore_errors=True)
+            os.rename(final, displaced)
+            self._valid_cache.pop(final, None)
+        os.rename(tmp, final)  # THE commit point: atomic within one fs
+        _fsync_dir(self.dir)
+        if displaced is not None:
+            shutil.rmtree(displaced, ignore_errors=True)
+        _maybe_kill("after-commit")
+        _bump(commits=1, bytes_written=written,
+              write_seconds=time.perf_counter() - t0)
+
+        if flag("FLAGS_checkpoint_verify_on_save"):
+            if not self._verify_dir(final):
+                raise RuntimeError(f"post-commit verification failed for {final}")
+        else:
+            # every byte was hashed moments ago while writing the manifest —
+            # seed the verify cache so _gc/latest_step don't read it all back
+            mpath = os.path.join(final, _MANIFEST)
+            self._valid_cache[final] = (os.stat(mpath).st_mtime_ns, True)
+        self._gc()
+
+    # ----------------------------------------------------------------- gc
+    def _gc(self):
+        """Retention: keep the newest `max_to_keep` VALID steps.  Invalid
+        (torn/corrupt) committed dirs are deleted only when a newer valid
+        checkpoint exists, and the last valid checkpoint is never deleted.
+        Stale temp dirs from dead processes are swept too."""
+        steps = self.all_steps()
+        valid = [s for s in steps if self._verify_dir(self._step_dir(s))]
+        keep = set(valid if self.max_to_keep is None else valid[-self.max_to_keep:])
+        newest_valid = valid[-1] if valid else None
+        for s in steps:
+            if s in keep:
+                continue
+            if s not in valid and (newest_valid is None or s > newest_valid):
+                # torn dir newer than every valid checkpoint: keep for
+                # post-mortem (it is skipped by latest_step anyway)
+                continue
+            path = self._step_dir(s)
+            shutil.rmtree(path, ignore_errors=True)
+            self._valid_cache.pop(path, None)
+            _bump(gc_deleted=1)
+
+        for name in os.listdir(self.dir):
+            m = _TMP_RE.match(name)
+            if not m:
+                continue
+            pid = int(m.group(1))
+            if pid == os.getpid():
+                continue  # possibly our own in-flight write
+            try:
+                os.kill(pid, 0)
+                continue  # owner still alive
+            except ProcessLookupError:
+                pass  # dead: safe to sweep
+            except OSError:
+                continue  # e.g. EPERM — owner alive under another uid
+            shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)
+            _bump(gc_deleted=1)
+
+    # -------------------------------------------------------------- restore
+    def restore(self, model=None, optimizer=None, lr_scheduler=None,
+                dataloader=None, step=None):
+        """Restore training state from `step` (default: latest valid).
+        Returns the restored step number, or None when no valid checkpoint
+        exists (fresh start).  Tensor state loads through load_state_dict's
+        reshard-on-load, so the CURRENT sharding of every tensor — possibly
+        a different mesh/topology than at save time — is honored."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        path = self._step_dir(step)
+        if not self._verify_dir(path):
+            raise RuntimeError(f"checkpoint {path} is missing or corrupt")
+
+        with open(os.path.join(path, _EXTRAS), "rb") as f:
+            extras = pickle.load(f)
+
+        request = {}
+        if model is not None:
+            sd = model.state_dict() if hasattr(model, "state_dict") else dict(model)
+            t, _ = _split_tensors(sd)
+            request["model"] = t
+        if optimizer is not None:
+            self._materialize_accumulators(optimizer)
+            t, _ = _split_tensors(optimizer.state_dict())
+            if t:
+                request["optimizer"] = t
+        if request:
+            from . import _META_FILE, load_state_dict
+            from .metadata import Metadata
+
+            with open(os.path.join(path, _META_FILE)) as f:
+                saved = set(Metadata.from_json(f.read()).tensors)
+            request = _prune_to_saved(request, saved)
+            load_state_dict(request, path)
+
+        if "rng" in extras:
+            set_rng_state(tuple(extras["rng"]))
+        if optimizer is not None and "optimizer" in extras:
+            optimizer.set_state_dict(extras["optimizer"])
+        if lr_scheduler is not None and "lr_scheduler" in extras:
+            lr_scheduler.set_state_dict(extras["lr_scheduler"])
+        if dataloader is not None and "dataloader" in extras:
+            dataloader.set_state_dict(extras["dataloader"])
+        self.restored_extra_state = extras.get("extra_state")
+        _bump(restores=1)
+        return step
+
+    @staticmethod
+    def _materialize_accumulators(optimizer):
+        """A fresh optimizer creates its accumulators lazily on the first
+        step(); restore needs them to exist NOW so the sharded loader can
+        fill them in place.  The rolled-back dry step the static path uses
+        for accumulator discovery does exactly this (no-op for LBFGS, whose
+        step needs a closure and whose history rides the extras file)."""
+        if optimizer._accumulators:
+            return
+        params = [p for p in optimizer._parameter_list if not p.stop_gradient]
+        if not params:
+            return
+        try:
+            optimizer._journaled_step(params)
+        except TypeError:
+            pass  # closure-based step (LBFGS): no per-param accumulators
+
+    # ----------------------------------------------------------- preemption
+    def install_preemption_handler(self, signals=(signal.SIGTERM,)):
+        """SIGTERM-style preemption: the handler only flips a flag; the next
+        maybe_save() at a step boundary writes the final checkpoint (async
+        signal context is no place for disk IO).  Check `preemption_saved`
+        in the training loop to exit cleanly."""
+
+        def _handler(signum, frame):
+            self._preempt_requested = True
+
+        for s in signals:
+            self._prev_handlers[s] = signal.signal(s, _handler)
+
+    def uninstall_preemption_handler(self):
+        for s, prev in self._prev_handlers.items():
+            signal.signal(s, prev)
+        self._prev_handlers.clear()
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self._preempt_requested
+
+    @property
+    def preemption_saved(self) -> bool:
+        """True once a preemption-triggered checkpoint has been issued."""
+        return self._preempt_saved
+
+    # -------------------------------------------------------------- cleanup
+    def close(self):
+        """Drain pending writes and stop the background worker."""
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.join()
+            self._queue.put(None)
+            self._worker.join(timeout=60)
+        self.uninstall_preemption_handler()
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _prune_to_saved(request, saved_names, prefix=""):
+    """Drop requested tensors the checkpoint does not contain (e.g. restoring
+    an optimizer into a run saved without one) instead of KeyError-ing the
+    whole restore; warn so silent drift is visible."""
+    import warnings
+
+    out = {}
+    for k, v in request.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            sub = _prune_to_saved(v, saved_names, name + ".")
+            if sub:
+                out[k] = sub
+        elif name in saved_names:
+            out[k] = v
+        else:
+            warnings.warn(
+                f"checkpoint has no tensor {name!r}; leaving current value",
+                stacklevel=3,
+            )
+    return out

@@ -7,16 +7,43 @@ as the reference's state_dict files, without the protobuf program baggage.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
+import threading
 
 import numpy as np
 
 from paddle_tpu._core.tensor import Tensor
 
-__all__ = ["save", "load", "wait_async_save"]
+__all__ = ["save", "load", "wait_async_save", "atomic_write", "spawn_async_write"]
 
 _MAGIC = b"PDTPU1\x00"
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode: str = "wb"):
+    """Write `path` atomically: yields a file handle onto a same-directory
+    temp file, fsyncs and `os.replace`s it over `path` on success, unlinks
+    the temp on failure.  A crash at ANY point leaves either the previous
+    file contents or the new ones — never a torn file.  Shared by
+    `framework.io_utils.save` and `distributed/checkpoint` (the checkpoint
+    commit protocol is built out of this primitive)."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _to_portable(obj):
@@ -47,17 +74,31 @@ def _from_portable(obj, return_numpy=False):
 
 _async_saves: list = []  # (thread, path, error_box)
 _path_locks: dict = {}
-_path_locks_guard = None
+_path_locks_guard = threading.Lock()
 
 
 def _lock_for(path):
-    global _path_locks_guard
-    import threading
-
-    if _path_locks_guard is None:
-        _path_locks_guard = threading.Lock()
     with _path_locks_guard:
         return _path_locks.setdefault(os.path.abspath(path), threading.Lock())
+
+
+def spawn_async_write(write_fn, path):
+    """Run `write_fn` on a supervised background thread.  The thread is
+    registered so `wait_async_save()` joins it and re-raises its failure —
+    the fire-and-forget daemon-thread pattern loses checkpoints silently.
+    Returns the Thread (callers may also join it directly)."""
+    err: list = []
+
+    def _guarded():
+        try:
+            write_fn()
+        except BaseException as e:  # re-raised by wait_async_save
+            err.append(e)
+
+    t = threading.Thread(target=_guarded, name=f"paddle_tpu_save:{os.path.basename(path)}")
+    t.start()
+    _async_saves.append((t, path, err))
+    return t
 
 
 def save(obj, path, protocol=4, async_save=False, **configs):
@@ -77,30 +118,16 @@ def save(obj, path, protocol=4, async_save=False, **configs):
     portable = _to_portable(obj)  # snapshot: host copies of device arrays
 
     def _write():
-        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with _lock_for(path):
-            with open(tmp, "wb") as f:
+            with atomic_write(path) as f:
                 f.write(_MAGIC)
                 pickle.dump(portable, f, protocol=protocol)
-            os.replace(tmp, path)
-
-    import threading
 
     if not async_save:
         _write()
         return
 
-    err: list = []
-
-    def _guarded():
-        try:
-            _write()
-        except BaseException as e:  # re-raised by wait_async_save
-            err.append(e)
-
-    t = threading.Thread(target=_guarded, name=f"paddle_tpu_save:{os.path.basename(path)}")
-    t.start()
-    _async_saves.append((t, path, err))
+    spawn_async_write(_write, path)
 
 
 def wait_async_save():

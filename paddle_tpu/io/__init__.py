@@ -238,9 +238,14 @@ class BatchSampler(Sampler):
 
 class DistributedBatchSampler(BatchSampler):
     """Shards the index space across data-parallel ranks (reference:
-    python/paddle/io/dataloader/batch_sampler.py DistributedBatchSampler)."""
+    python/paddle/io/dataloader/batch_sampler.py DistributedBatchSampler).
 
-    def __init__(self, dataset, batch_size, num_replicas=None, rank=None, shuffle=False, drop_last=False):
+    The shuffle stream is derived from (seed, epoch): per-epoch
+    deterministic — every rank of a job agrees on the permutation — while
+    two jobs with different base seeds see different shuffles (seeding from
+    the epoch alone made every job shuffle identically)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None, shuffle=False, drop_last=False, seed=0):
         from paddle_tpu import distributed as dist
 
         self.dataset = dataset
@@ -250,6 +255,7 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        self.seed = int(seed)
         self.num_samples = int(math.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
 
@@ -257,7 +263,11 @@ class DistributedBatchSampler(BatchSampler):
         n = len(self.dataset)
         indices = np.arange(n)
         if self.shuffle:
-            rng = np.random.RandomState(self.epoch)
+            # array seed: RandomState hashes both words, so (seed, epoch)
+            # pairs never collide the way seed+epoch addition would
+            rng = np.random.RandomState(
+                np.array([self.seed, self.epoch], dtype=np.uint32)
+            )
             rng.shuffle(indices)
         indices = np.concatenate([indices, indices[: self.total_size - n]])
         indices = indices[self.local_rank : self.total_size : self.nranks]
@@ -277,6 +287,16 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    def state_dict(self):
+        """Position-independent shuffle state: (seed, epoch) fully determine
+        the permutation, so a resumed job rebuilds this epoch's index stream
+        exactly (the DataLoader records how far into it the run got)."""
+        return {"epoch": self.epoch, "seed": self.seed}
+
+    def set_state_dict(self, state):
+        self.epoch = int(state.get("epoch", self.epoch))
+        self.seed = int(state.get("seed", self.seed))
 
 
 class _WorkerInfo:
@@ -396,6 +416,11 @@ class DataLoader:
         # use_buffer_reader's DoubleBuffer layer; 0 disables.
         self.prefetch_to_device = int(prefetch_to_device)
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        # checkpoint/resume position (docs/CHECKPOINT.md): batches handed to
+        # the caller this epoch, and how many to fast-forward past on the
+        # next __iter__ after set_state_dict
+        self._batches_yielded = 0
+        self._resume_skip = 0
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -412,16 +437,57 @@ class DataLoader:
             raise TypeError("IterableDataset has no deterministic length")
         return len(self.batch_sampler)
 
+    # ------------------------------------------------------ resume position
+    def state_dict(self):
+        """Mid-epoch position for exact resume: batches already handed out
+        this epoch plus the sampler's (seed, epoch) when it exposes state
+        (DistributedBatchSampler).  CheckpointManager persists this so a
+        resumed run continues the SAME epoch stream where it stopped."""
+        out = {"batches_yielded": self._batches_yielded}
+        if self.batch_sampler is not None and hasattr(self.batch_sampler, "state_dict"):
+            out["sampler"] = self.batch_sampler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._resume_skip = int(state.get("batches_yielded", 0))
+        self._batches_yielded = self._resume_skip
+        sampler_state = state.get("sampler")
+        if sampler_state is not None and self.batch_sampler is not None \
+                and hasattr(self.batch_sampler, "set_state_dict"):
+            self.batch_sampler.set_state_dict(sampler_state)
+
+    def _consume_resume_skip(self) -> int:
+        skip, self._resume_skip = self._resume_skip, 0
+        return skip
+
+    def _index_batches(self):
+        """Batch-sampler index stream, fast-forwarded past the resume skip.
+        Skipping happens at the INDEX level — no sample is fetched or
+        collated for skipped batches."""
+        it = iter(self.batch_sampler)
+        for _ in range(self._consume_resume_skip()):
+            if next(it, None) is None:
+                return
+        yield from it
+
     def _iter_batches(self):
         if self._iterable_mode:
+            # iterable datasets have no index space: fast-forward by
+            # consuming raw samples (fetch cost paid, collate skipped)
+            skip = self._consume_resume_skip()
+            done = 0
             batch = []
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    if done < skip:
+                        done += 1
+                    else:
+                        yield self.collate_fn(batch)
                     batch = []
             if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+                if done >= skip:
+                    yield self.collate_fn(batch)
             return
         if self.num_workers > 0:
             if self._use_shared_memory:
@@ -435,7 +501,7 @@ class DataLoader:
             try:
                 futures = (
                     pool.submit(lambda idxs=idxs: self.collate_fn([self.dataset[i] for i in idxs]))
-                    for idxs in self.batch_sampler
+                    for idxs in self._index_batches()
                 )
                 window: list = []
                 depth = self.num_workers * self.prefetch_factor
@@ -448,7 +514,7 @@ class DataLoader:
             finally:
                 pool.shutdown(wait=False)
         else:
-            for idxs in self.batch_sampler:
+            for idxs in self._index_batches():
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
     def _iter_mp_shm(self):
@@ -477,7 +543,7 @@ class DataLoader:
 
         from paddle_tpu import _native
 
-        batches = list(self.batch_sampler)
+        batches = list(self._index_batches())
         n = len(batches)
         if n == 0:
             return
@@ -571,8 +637,18 @@ class DataLoader:
 
     def __iter__(self):
         if self.prefetch_to_device > 0:
-            return iter(self._iter_device_prefetch())
-        return iter(self._iter_batches())
+            return self._count_yields(self._iter_device_prefetch())
+        return self._count_yields(self._iter_batches())
+
+    def _count_yields(self, inner):
+        """Track the resume position: `_batches_yielded` counts batches the
+        CALLER has received this epoch (bumped before the yield hands the
+        batch out, so a checkpoint taken after the train step records the
+        batch as consumed)."""
+        self._batches_yielded = self._resume_skip
+        for batch in inner:
+            self._batches_yielded += 1
+            yield batch
 
     def _iter_device_prefetch(self):
         import collections

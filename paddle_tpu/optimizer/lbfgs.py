@@ -220,6 +220,16 @@ class LBFGS(Optimizer):
         }
         return sd
 
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        hist = state.get("lbfgs")
+        if hist is None:
+            return
+        self._s_hist = [jnp.asarray(s) for s in hist["s"]]
+        self._y_hist = [jnp.asarray(y) for y in hist["y"]]
+        self._rho_hist = [float(r) for r in hist["rho"]]
+        self._H_diag = hist["H_diag"]
+
 
 def np_array(x):
     import numpy as np

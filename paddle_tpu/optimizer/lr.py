@@ -133,6 +133,17 @@ class LinearWarmup(LRScheduler):
             return self.lr_after.get_lr()
         return float(self.lr_after)
 
+    def state_dict(self):
+        state = super().state_dict()
+        if isinstance(self.lr_after, LRScheduler):
+            state["lr_after"] = self.lr_after.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        if "lr_after" in state and isinstance(self.lr_after, LRScheduler):
+            self.lr_after.set_state_dict(state["lr_after"])
+
 
 class PiecewiseDecay(LRScheduler):
     def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
@@ -215,6 +226,18 @@ class MultiplicativeDecay(LRScheduler):
             self._cur = self._cur * self.lr_lambda(self.last_epoch)
         return self._cur
 
+    def state_dict(self):
+        # _cur is RUNNING multiplicative state, not derivable from
+        # last_epoch alone — without it a restored scheduler restarts the
+        # product from base_lr
+        state = super().state_dict()
+        state["_cur"] = self._cur
+        return state
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        self._cur = state.get("_cur", self._cur)
+
 
 class ReduceOnPlateau(LRScheduler):
     def __init__(self, learning_rate, mode="min", factor=0.1, patience=10, threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0, epsilon=1e-8, verbose=False):
@@ -259,6 +282,21 @@ class ReduceOnPlateau(LRScheduler):
             return a < th
         th = b * (1 + self.threshold) if self.threshold_mode == "rel" else b + self.threshold
         return a > th
+
+    def state_dict(self):
+        # the plateau detector is all mutable state: the running best
+        # metric, bad-epoch and cooldown counters, and the decayed lr itself
+        state = super().state_dict()
+        state.update(best=self.best, num_bad=self.num_bad,
+                     cooldown_counter=self.cooldown_counter, _lr=self._lr)
+        return state
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        self.best = state.get("best", self.best)
+        self.num_bad = state.get("num_bad", self.num_bad)
+        self.cooldown_counter = state.get("cooldown_counter", self.cooldown_counter)
+        self._lr = state.get("_lr", self._lr)
 
 
 class OneCycleLR(LRScheduler):

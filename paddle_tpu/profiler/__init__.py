@@ -215,9 +215,9 @@ class Profiler:
         with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys.
         Ends with the eager dispatch-cache counters when the fast path has
         seen traffic."""
-        from .statistics import (compile_cache_line, decode_line,
-                                 dispatch_cache_line, summary_text,
-                                 verify_line)
+        from .statistics import (checkpoint_line, compile_cache_line,
+                                 decode_line, dispatch_cache_line,
+                                 summary_text, verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -234,6 +234,9 @@ class Profiler:
         ver_line = verify_line(verify_stats())
         if ver_line:
             out = out + "\n" + ver_line
+        ckpt_line = checkpoint_line(checkpoint_stats())
+        if ckpt_line:
+            out = out + "\n" + ckpt_line
         print(out)
         return out
 
@@ -369,8 +372,23 @@ def verify_stats(reset: bool = False) -> dict:
     return _verify.verify_stats(reset=reset)
 
 
+def checkpoint_stats(reset: bool = False) -> dict:
+    """CheckpointManager counters (distributed/checkpoint/manager.py):
+    saves issued (async_saves of them backgrounded), atomic commits,
+    bytes written, seconds split into snapshot (synchronous device→host)
+    vs write (background disk IO) vs backpressure (save() blocked on an
+    in-flight write), GC deletions, restores, and checkpoints skipped as
+    corrupt/torn during auto-resume.  Healthy: corrupt_skipped and errors
+    at zero, backpressure near zero (writes finish inside the save
+    interval).  The checkpoint module owns the counters — one schema, no
+    drift."""
+    from paddle_tpu.distributed.checkpoint import manager as _ckpt_manager
+
+    return _ckpt_manager.checkpoint_stats(reset=reset)
+
+
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
-            "decode_stats", "verify_stats"]
+            "decode_stats", "verify_stats", "checkpoint_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

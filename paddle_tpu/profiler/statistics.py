@@ -225,6 +225,26 @@ def verify_line(stats: dict) -> str:
     )
 
 
+def checkpoint_line(stats: dict) -> str:
+    """One-line rendering of the CheckpointManager counters for
+    Profiler.summary(); empty when no checkpoint activity this process.
+    corrupt_skipped or errors nonzero is the red flag: auto-resume passed
+    over a torn checkpoint, or a background write failed."""
+    if not (stats.get("saves") or stats.get("restores")
+            or stats.get("corrupt_skipped")):
+        return ""
+    return (
+        "Checkpoint: saves=%d (async=%d) commits=%d bytes=%d "
+        "snapshot=%.3fs write=%.3fs backpressure=%.3fs gc_deleted=%d; "
+        "restores=%d corrupt_skipped=%d errors=%d"
+        % (stats["saves"], stats["async_saves"], stats["commits"],
+           stats["bytes_written"], stats["snapshot_seconds"],
+           stats["write_seconds"], stats["backpressure_seconds"],
+           stats["gc_deleted"], stats["restores"], stats["corrupt_skipped"],
+           stats["errors"])
+    )
+
+
 def compile_cache_line(stats: dict) -> str:
     """One-line rendering of the trace/compile + persistent-cache counters
     for Profiler.summary(); empty when nothing compiled this process."""

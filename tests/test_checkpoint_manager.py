@@ -1,0 +1,466 @@
+"""CheckpointManager: atomic commits, retention, auto-resume exactness,
+corruption detection, supervised async IO (distributed/checkpoint/manager.py,
+docs/CHECKPOINT.md).  The subprocess SIGKILL matrix lives in
+test_checkpoint_crash.py; everything here is in-process."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.checkpoint import CheckpointManager, checkpoint_stats
+from paddle_tpu.distributed.checkpoint import manager as manager_mod
+from paddle_tpu.io import DataLoader, Dataset, DistributedBatchSampler
+
+
+class _ArrayDataset(Dataset):
+    def __init__(self, n=16, dim=4, seed=0):
+        self.data = np.random.RandomState(seed).randn(n, dim).astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+def _make_trainer(seed=7):
+    paddle.seed(seed)
+    m = nn.Linear(4, 4)
+    sched = opt.lr.CosineAnnealingDecay(learning_rate=0.1, T_max=10)
+    o = opt.Adam(learning_rate=sched, parameters=m.parameters())
+    ds = _ArrayDataset()
+    sampler = DistributedBatchSampler(ds, batch_size=4, shuffle=True, seed=11)
+    dl = DataLoader(ds, batch_sampler=sampler)
+    return m, o, sched, dl, sampler
+
+
+def _train(m, o, sched, dl, sampler, start_step, total_steps, on_step=None):
+    """Deterministic loop exercising every restored component: shuffled
+    sampler feeds the batches, eager RNG noise folds into the loss, Adam
+    moments + cosine LR evolve per step."""
+    losses = []
+    step = start_step
+    epoch = sampler.epoch
+    while step < total_steps:
+        sampler.set_epoch(epoch)
+        for batch in dl:
+            step += 1
+            x = paddle.to_tensor(np.asarray(batch))
+            noise = paddle.rand([1])  # advances the global RNG counter
+            loss = (m(x) ** 2).mean() * (1.0 + 0.01 * noise.mean())
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            sched.step()
+            losses.append(float(loss))
+            if on_step is not None:
+                on_step(step)
+            if step >= total_steps:
+                break
+        epoch += 1
+    return losses
+
+
+def test_commit_layout_and_manifest(tmp_path):
+    m, o, sched, dl, sampler = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, async_save=False)
+    mgr.save(3, model=m, optimizer=o, lr_scheduler=sched, dataloader=dl)
+
+    assert mgr.all_steps() == [3]
+    step_dir = tmp_path / "step_00000003"
+    names = sorted(os.listdir(step_dir))
+    assert "MANIFEST.json" in names and "extras.pkl" in names and "metadata.json" in names
+    # no temp dirs survive a successful commit
+    assert not [n for n in os.listdir(tmp_path) if n.startswith("_tmp_")]
+    manifest = json.loads((step_dir / "MANIFEST.json").read_text())
+    assert manifest["step"] == 3
+    # every payload file is checksummed (the manifest itself is not listed)
+    assert sorted(manifest["files"]) == [n for n in names if n != "MANIFEST.json"]
+    for rec in manifest["files"].values():
+        assert set(rec) == {"sha256", "size"}
+    assert mgr.latest_step() == 3
+
+
+def test_kill_and_resume_bit_identical(tmp_path):
+    """Resume from a mid-run checkpoint into FRESH objects and finish: the
+    per-step losses must match the uninterrupted run bit-for-bit — model,
+    optimizer moments, LR schedule, global RNG, and the mid-epoch sampler
+    position all restored."""
+    m, o, sched, dl, sampler = _make_trainer(seed=7)
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=3, async_save=True)
+    full = _train(m, o, sched, dl, sampler, 0, 10,
+                  on_step=lambda s: mgr.maybe_save(
+                      s, model=m, optimizer=o, lr_scheduler=sched, dataloader=dl))
+    mgr.wait()
+    assert mgr.latest_step() == 9
+
+    # "crash": throw everything away, rebuild with a DIFFERENT seed so any
+    # component the restore misses changes the losses
+    m2, o2, sched2, dl2, sampler2 = _make_trainer(seed=999)
+    mgr2 = CheckpointManager(str(tmp_path), save_interval_steps=3)
+    start = mgr2.restore(model=m2, optimizer=o2, lr_scheduler=sched2, dataloader=dl2, step=6)
+    assert start == 6
+    resumed = _train(m2, o2, sched2, dl2, sampler2, 6, 10)
+    assert resumed == full[6:], "resumed losses diverge from uninterrupted run"
+    mgr.close()
+    mgr2.close()
+
+
+def test_latest_step_skips_torn_checkpoints(tmp_path):
+    m, o, _, _, _ = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, async_save=False)
+    for s in (1, 2, 3):
+        mgr.save(s, model=m, optimizer=o)
+    base = checkpoint_stats()["corrupt_skipped"]
+
+    # bit-rot the newest shard file; garble the next one's manifest
+    shard = next(p for p in (tmp_path / "step_00000003").iterdir() if p.suffix == ".npz")
+    shard.write_bytes(shard.read_bytes()[:-7])
+    (tmp_path / "step_00000002" / "MANIFEST.json").write_text("{ torn")
+
+    fresh = CheckpointManager(str(tmp_path))  # no _verify_dir cache
+    assert fresh.latest_step() == 1
+    assert checkpoint_stats()["corrupt_skipped"] - base == 2
+    with pytest.raises(RuntimeError, match="corrupt"):
+        fresh.restore(model=m, step=3)
+
+
+def test_gc_retention_and_last_valid_survival(tmp_path):
+    m, _, _, _, _ = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, max_to_keep=2,
+                            async_save=False)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, model=m)
+    assert mgr.all_steps() == [3, 4]  # retention
+
+    # an invalid dir OLDER than the newest valid checkpoint is GC'd; a torn
+    # dir NEWER than every valid one is kept for post-mortem (and skipped)
+    os.makedirs(tmp_path / "step_00000002")
+    (tmp_path / "step_00000002" / "junk").write_text("x")
+    os.makedirs(tmp_path / "step_00000009")
+    (tmp_path / "step_00000009" / "junk").write_text("x")
+    mgr.save(5, model=m)
+    steps = mgr.all_steps()
+    assert 2 not in steps
+    assert 9 in steps
+    assert mgr.latest_step() == 5
+
+
+def test_async_failure_reraises_and_backpressure(tmp_path, monkeypatch):
+    m, _, _, _, _ = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, async_save=True)
+
+    real_savez = manager_mod.np.savez
+
+    def slow_savez(*a, **kw):
+        time.sleep(0.15)
+        return real_savez(*a, **kw)
+
+    monkeypatch.setattr(manager_mod.np, "savez", slow_savez)
+    base = checkpoint_stats()["backpressure_seconds"]
+    for s in (1, 2, 3):  # 3rd save must block on the bounded queue
+        mgr.save(s, model=m)
+    mgr.wait()
+    assert checkpoint_stats()["backpressure_seconds"] > base
+    assert mgr.latest_step() == 3
+
+    def broken_savez(*a, **kw):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(manager_mod.np, "savez", broken_savez)
+    mgr.save(4, model=m)
+    with pytest.raises(RuntimeError, match="background write failed"):
+        mgr.wait()
+    # the error is consumed; the manager keeps working afterwards
+    monkeypatch.setattr(manager_mod.np, "savez", real_savez)
+    mgr.save(5, model=m)
+    mgr.wait()
+    assert mgr.latest_step() == 5
+    mgr.close()
+
+
+def test_resave_same_step_and_verify_on_save(tmp_path):
+    m, _, _, _, _ = _make_trainer()
+    paddle.set_flags({"FLAGS_checkpoint_verify_on_save": True})
+    try:
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1, async_save=False)
+        mgr.save(1, model=m)
+        mgr.save(1, model=m)  # overwrite, not error
+        assert mgr.all_steps() == [1]
+        assert mgr.latest_step() == 1
+    finally:
+        paddle.set_flags({"FLAGS_checkpoint_verify_on_save": False})
+
+
+def test_resharded_resume_through_manager(tmp_path):
+    """Save under one sharding, restore under another — the manager routes
+    tensor state through load_state_dict's reshard-on-load."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    mesh_a = Mesh(np.array(jax.devices()[:4]), ("x",))
+    arr_a = jax.device_put(jnp.asarray(full), NamedSharding(mesh_a, P("x", None)))
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, model={"w": paddle.Tensor(arr_a)})
+
+    mesh_b = Mesh(np.array(jax.devices()[:2]), ("y",))
+    target = jax.device_put(jnp.zeros((8, 8), jnp.float32), NamedSharding(mesh_b, P(None, "y")))
+    state = {"w": paddle.Tensor(target)}
+    assert mgr.restore(model=state) == 1
+    out = state["w"]._value
+    assert len(out.sharding.device_set) == 2
+    np.testing.assert_array_equal(np.asarray(out), full)
+
+
+def test_preemption_handler(tmp_path):
+    import signal
+
+    m, _, _, _, _ = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100, async_save=False)
+    mgr.install_preemption_handler()
+    try:
+        assert not mgr.maybe_save(7, model=m)  # off-interval: no save
+        os.kill(os.getpid(), signal.SIGTERM)  # "preemption notice"
+        assert mgr.preemption_requested
+        assert mgr.maybe_save(8, model=m)  # next step boundary: final save
+        assert mgr.preemption_saved
+        assert mgr.latest_step() == 8
+    finally:
+        mgr.close()
+
+
+def test_restore_extra_state_and_missing_tensor_warns(tmp_path):
+    m, _, _, _, _ = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, model=m, extra_state={"best_acc": 0.91})
+    m2, _, _, _, _ = _make_trainer(seed=3)
+    m2.extra_p = paddle.create_parameter([2], "float32")
+    with pytest.warns(UserWarning, match="no tensor"):
+        assert mgr.restore(model=m2) == 1
+    assert mgr.restored_extra_state == {"best_acc": 0.91}
+    np.testing.assert_array_equal(
+        np.asarray(m2.weight._value), np.asarray(m.weight._value))
+
+
+# --------------------------------------------------------------- schedulers
+
+_SCHED_FACTORIES = [
+    ("NoamDecay", lambda: opt.lr.NoamDecay(d_model=64, warmup_steps=4)),
+    ("ExponentialDecay", lambda: opt.lr.ExponentialDecay(0.5, gamma=0.9)),
+    ("NaturalExpDecay", lambda: opt.lr.NaturalExpDecay(0.5, 0.1)),
+    ("InverseTimeDecay", lambda: opt.lr.InverseTimeDecay(0.5, 0.1)),
+    ("PolynomialDecay", lambda: opt.lr.PolynomialDecay(0.5, decay_steps=6, cycle=True)),
+    ("LinearWarmup", lambda: opt.lr.LinearWarmup(0.5, warmup_steps=3, start_lr=0.0, end_lr=0.5)),
+    ("LinearWarmup_nested", lambda: opt.lr.LinearWarmup(
+        opt.lr.MultiplicativeDecay(0.5, lr_lambda=lambda e: 0.9),
+        warmup_steps=2, start_lr=0.0, end_lr=0.5)),
+    ("PiecewiseDecay", lambda: opt.lr.PiecewiseDecay(boundaries=[2, 4], values=[0.5, 0.2, 0.1])),
+    ("CosineAnnealingDecay", lambda: opt.lr.CosineAnnealingDecay(0.5, T_max=6)),
+    ("CosineAnnealingWarmRestarts", lambda: opt.lr.CosineAnnealingWarmRestarts(0.5, T_0=3)),
+    ("StepDecay", lambda: opt.lr.StepDecay(0.5, step_size=2)),
+    ("MultiStepDecay", lambda: opt.lr.MultiStepDecay(0.5, milestones=[2, 4])),
+    ("LambdaDecay", lambda: opt.lr.LambdaDecay(0.5, lr_lambda=lambda e: 0.95 ** e)),
+    ("MultiplicativeDecay", lambda: opt.lr.MultiplicativeDecay(0.5, lr_lambda=lambda e: 0.9)),
+    ("ReduceOnPlateau", lambda: opt.lr.ReduceOnPlateau(0.5, patience=1, cooldown=1)),
+    ("OneCycleLR", lambda: opt.lr.OneCycleLR(max_learning_rate=0.5, total_steps=10)),
+    ("CyclicLR", lambda: opt.lr.CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5, step_size_up=3)),
+    ("LinearLR", lambda: opt.lr.LinearLR(0.5, total_steps=6)),
+    ("ConstantLR", lambda: opt.lr.ConstantLR(0.5)),
+]
+
+_PLATEAU_METRICS = [1.0, 0.9, 0.95, 0.96, 0.97, 0.98, 0.99, 1.0]
+
+
+def _step_sched(s, i):
+    if isinstance(s, opt.lr.ReduceOnPlateau):
+        s.step(metrics=_PLATEAU_METRICS[i])
+    else:
+        s.step()
+
+
+@pytest.mark.parametrize("name,factory", _SCHED_FACTORIES, ids=[n for n, _ in _SCHED_FACTORIES])
+def test_lr_scheduler_round_trip_via_manager(tmp_path, name, factory):
+    """Every scheduler survives CheckpointManager.save/restore (not just an
+    in-memory dict copy): after restore, the next 4 LR values match a never-
+    interrupted twin exactly — including the stateful ones (ReduceOnPlateau
+    counters, MultiplicativeDecay running product, LinearWarmup's wrapped
+    scheduler)."""
+    ref, live = factory(), factory()
+    for i in range(4):
+        _step_sched(ref, i)
+        _step_sched(live, i)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(4, lr_scheduler=live)
+
+    restored = factory()
+    assert mgr.restore(lr_scheduler=restored) == 4
+    for i in range(4, 8):
+        _step_sched(ref, i)
+        _step_sched(restored, i)
+        assert restored.get_lr() == ref.get_lr(), f"{name} diverged at step {i}"
+
+
+def test_lbfgs_round_trip_via_manager(tmp_path):
+    """LBFGS curvature history (s/y/rho/H_diag) rides the extras file and is
+    restored by the new set_state_dict: the resumed trajectory matches the
+    uninterrupted one bit-for-bit."""
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 3).astype(np.float32))
+    w_true = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    y = paddle.to_tensor(np.asarray(x._value) @ w_true)
+
+    def make():
+        paddle.seed(42)
+        m = nn.Linear(3, 1)
+        o = opt.LBFGS(learning_rate=0.9, max_iter=3, parameters=m.parameters())
+        return m, o
+
+    def closure_for(m, o):
+        def closure():
+            o.clear_grad()
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            return loss
+        return closure
+
+    m1, o1 = make()
+    losses = [float(o1.step(closure_for(m1, o1))) for _ in range(4)]
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+
+    m2, o2 = make()
+    for _ in range(2):
+        o2.step(closure_for(m2, o2))
+    mgr.save(2, model=m2, optimizer=o2)
+    m3, o3 = make()
+    assert mgr.restore(model=m3, optimizer=o3) == 2
+    assert o3._rho_hist == o2._rho_hist and o3._H_diag == o2._H_diag
+    resumed = [float(o3.step(closure_for(m3, o3))) for _ in range(2)]
+    assert resumed == losses[2:], "LBFGS resume diverged (history not restored?)"
+
+
+# ------------------------------------------------------- sampler / dataloader
+
+def test_sampler_seed_regression():
+    """Two differently-seeded jobs must NOT shuffle identically (the old
+    RandomState(epoch) ignored the seed), while (seed, epoch) stays fully
+    deterministic and epochs still reshuffle."""
+    ds = _ArrayDataset(n=32)
+
+    def order(seed, epoch):
+        s = DistributedBatchSampler(ds, batch_size=4, shuffle=True, seed=seed)
+        s.set_epoch(epoch)
+        return [i for b in s for i in b]
+
+    assert order(0, 0) != order(1, 0)  # seed matters
+    assert order(0, 0) == order(0, 0)  # deterministic
+    assert order(0, 0) != order(0, 1)  # epochs reshuffle
+    assert order(5, 3) == order(5, 3)
+
+
+def test_dataloader_map_style_resume():
+    ds = _ArrayDataset(n=24)
+    sampler = DistributedBatchSampler(ds, batch_size=4, shuffle=True, seed=3)
+    dl = DataLoader(ds, batch_sampler=sampler)
+    full = [np.asarray(b) for b in dl]
+
+    it = iter(dl)
+    for _ in range(2):
+        next(it)
+    state = dl.state_dict()
+    assert state["batches_yielded"] == 2
+    assert state["sampler"] == {"epoch": 0, "seed": 3}
+
+    sampler2 = DistributedBatchSampler(ds, batch_size=4, shuffle=True, seed=999)
+    dl2 = DataLoader(ds, batch_sampler=sampler2)
+    dl2.set_state_dict(state)
+    rest = [np.asarray(b) for b in dl2]
+    assert len(rest) == len(full) - 2
+    for a, b in zip(rest, full[2:]):
+        np.testing.assert_array_equal(a, b)
+    # the NEXT epoch starts from the top again (skip is one-shot)
+    assert len(list(dl2)) == len(full)
+
+
+def test_dataloader_iterable_resume():
+    from paddle_tpu.io import IterableDataset
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            yield from (np.full(2, i, np.float32) for i in range(10))
+
+    dl = DataLoader(Stream(), batch_size=2)
+    full = [np.asarray(b) for b in dl]
+    dl2 = DataLoader(Stream(), batch_size=2)
+    dl2.set_state_dict({"batches_yielded": 3})
+    rest = [np.asarray(b) for b in dl2]
+    for a, b in zip(rest, full[3:]):
+        np.testing.assert_array_equal(a, b)
+    assert len(rest) == len(full) - 3
+
+
+# ------------------------------------------------------------ stats plumbing
+
+def test_checkpoint_stats_and_summary_footer(tmp_path):
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.profiler.statistics import checkpoint_line
+
+    assert checkpoint_line(manager_mod._zero_stats()) == ""
+
+    m, _, _, _, _ = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, model=m)
+    mgr.restore(model=m)
+    stats = profiler.checkpoint_stats()
+    assert stats["saves"] >= 1 and stats["commits"] >= 1 and stats["restores"] >= 1
+    assert stats["bytes_written"] > 0
+    line = checkpoint_line(stats)
+    assert line.startswith("Checkpoint:") and "restores=" in line
+
+    prof = profiler.Profiler()
+    prof.start()
+    prof.stop()
+    assert "Checkpoint:" in prof.summary()
+
+
+# ------------------------------------------- save_state_dict async (satellite)
+
+def test_save_state_dict_async_reraises(tmp_path, monkeypatch):
+    """The old async path was a fire-and-forget daemon thread: failures
+    vanished.  Now wait_async_save() re-raises them."""
+    import paddle_tpu.distributed.checkpoint as ckpt
+    from paddle_tpu.framework import io_utils
+
+    sd = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+
+    def boom(*a, **kw):
+        raise OSError("shard write failed")
+
+    monkeypatch.setattr(ckpt.np, "savez", boom)
+    th = ckpt.save_state_dict(sd, str(tmp_path), async_save=True)
+    th.join(timeout=30)
+    with pytest.raises(RuntimeError, match="async checkpoint write") as exc:
+        io_utils.wait_async_save()
+    assert "shard write failed" in str(exc.value.__cause__)
+
+
+def test_save_state_dict_atomic_metadata(tmp_path, monkeypatch):
+    """A failed re-save can never tear an existing metadata.json: the write
+    goes to a temp file that is os.replace'd only on success."""
+    import paddle_tpu.distributed.checkpoint as ckpt
+
+    sd = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+    ckpt.save_state_dict(sd, str(tmp_path))
+    good = (tmp_path / "metadata.json").read_text()
+
+    monkeypatch.setattr(ckpt.Metadata, "to_json", lambda self: (_ for _ in ()).throw(OSError("meta boom")))
+    with pytest.raises(OSError, match="meta boom"):
+        ckpt.save_state_dict(sd, str(tmp_path))
+    assert (tmp_path / "metadata.json").read_text() == good
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
