@@ -12,6 +12,14 @@ Two claims measured:
   pools thread through the LayerStack scan body as per-layer state, so the
   first macro-step's trace+compile no longer scales ~linearly in layer
   count (16-layer vs 4-layer first-step wall within ~1.5x).
+- **Prefix-cache KV reuse**: N requests sharing one long system prompt —
+  with `prefix_cache=True` admission matches the cached prefix at page
+  granularity and prefills only the suffix.  Reports end-to-end tokens/s
+  on vs off (admission + decode in the wall), prefill-avoided tokens, and
+  per-token latency percentiles (p50/p95), with a greedy-parity gate.
+- **int8 KV capacity**: at IDENTICAL pool-block bytes, how many requests
+  an int8-quantized pool admits before queueing vs a bf16 pool —
+  allocator arithmetic, so the ratio is deterministic and timing-free.
 
 Prints ONE JSON line like the other benches.  vs_baseline is 0.0 until a
 reference serving point is recorded (none published in-repo).
@@ -170,6 +178,111 @@ def main():
             "ratio": round(t_deep / t_shallow, 3) if t_shallow else 0.0,
         }
 
+    # ---- shared-prefix workload: prefix cache on vs off -----------------
+    # N requests over ONE long system prompt (+ a small distinct user
+    # tail): cache-on prefills the shared prefix once and every later
+    # admission references its pages — end-to-end wall includes admission,
+    # which is exactly where the win lives.
+    from paddle_tpu.serving import GenerationEngine as _GE
+
+    n_req = 4 if smoke else 8
+    pre_len = 32 if smoke else 192
+    tail_len, sp_new = 4, 4 if smoke else 16
+    sp_s0 = pre_len + tail_len
+    sp_rng = np.random.default_rng(7)
+    shared = list(sp_rng.integers(0, cfg.vocab_size, pre_len))
+    sp_prompts = {f"s{i}": shared + list(sp_rng.integers(0, cfg.vocab_size,
+                                                         tail_len))
+                  for i in range(n_req)}
+    sp_blocks = n_req * (-(-(sp_s0 + sp_new) // 16) + 1)
+
+    def run_shared(prefix_on):
+        reset_decode_stats()
+        eng = _GE(model, max_batch=n_req, block_size=16,
+                  num_blocks=sp_blocks, decode_chunk=chunk,
+                  prefix_cache=prefix_on)
+        lat_ms = []
+        t0 = time.perf_counter()
+        for rid, p in sp_prompts.items():
+            eng.add_request(rid, p, max_new_tokens=sp_new)
+        while eng.has_work():
+            ts = time.perf_counter()
+            emitted = sum(len(v) if isinstance(v, list) else 1
+                          for v in eng.step().values())
+            if emitted:
+                lat_ms += [1e3 * (time.perf_counter() - ts) / emitted] * emitted
+        wall = time.perf_counter() - t0
+        toks = sum(len(eng.result(r)) for r in sp_prompts)
+        return {"tokens_per_sec": toks / wall,
+                "results": {r: eng.result(r) for r in sp_prompts},
+                "prefill_avoided_tokens": decode_stats()["prefix_hit_tokens"],
+                "latency_p50_ms": float(np.percentile(lat_ms, 50)),
+                "latency_p95_ms": float(np.percentile(lat_ms, 95))}
+
+    sp_off = run_shared(False)
+    sp_on = run_shared(True)
+    prefix_match = sp_off["results"] == sp_on["results"]
+    if not prefix_match:
+        print("bench_decode: PREFIX PARITY FAILURE", file=sys.stderr)
+    shared_prefix = {
+        "requests": n_req,
+        "prefix_tokens": pre_len,
+        "prefix_speedup": round(
+            sp_on["tokens_per_sec"] / sp_off["tokens_per_sec"], 2)
+        if sp_off["tokens_per_sec"] else 0.0,
+        "prefill_avoided_tokens": sp_on["prefill_avoided_tokens"],
+        "tokens_match": prefix_match,
+        "off": {k: round(v, 3) for k, v in sp_off.items()
+                if k not in ("results",)},
+        "on": {k: round(v, 3) for k, v in sp_on.items()
+               if k not in ("results",)},
+    }
+
+    # ---- int8 KV capacity: resident requests at identical pool bytes ----
+    # bf16 pools on a bf16 model vs int8 pools sized to the SAME block-pool
+    # byte budget; admit identical-shape requests until one queues.  Pure
+    # allocator arithmetic — deterministic, no timing.
+    paddle.seed(2)
+    from paddle_tpu.models.llama import llama_tiny as _tiny
+
+    qcfg = _tiny(vocab_size=256, hidden_size=64, intermediate_size=176,
+                 num_attention_heads=4, num_key_value_heads=4,
+                 max_position_embeddings=8192, dtype="bfloat16")
+    qmodel = LlamaForCausalLM(qcfg)
+    qmodel.eval()
+    q_nkv = qcfg.num_key_value_heads
+    q_hd = qcfg.hidden_size // qcfg.num_attention_heads
+    q_layers = qcfg.num_hidden_layers
+    elems = q_nkv * 16 * q_hd
+    per_block_bf16 = q_layers * 2 * elems * 2            # K+V, 2B/elem
+    per_block_int8 = q_layers * 2 * (elems + q_nkv * 4)  # + f32 scales
+    nb_bf16 = 10 if smoke else 16
+    budget = nb_bf16 * per_block_bf16
+    nb_int8 = budget // per_block_int8
+    cap_prompt_len, cap_new = 28, 4  # 2 blocks per request at bs=16
+
+    def admitted(kv_dtype, nb):
+        eng = _GE(qmodel, max_batch=nb, block_size=16, num_blocks=nb,
+                  kv_cache_dtype=kv_dtype)
+        count = 0
+        crng = np.random.default_rng(3)
+        while True:
+            p = list(crng.integers(0, qcfg.vocab_size, cap_prompt_len))
+            if eng.add_request(f"c{count}", p, max_new_tokens=cap_new) is None:
+                return count
+            count += 1
+
+    res_bf16 = admitted("bf16", nb_bf16)
+    res_int8 = admitted("int8", int(nb_int8))
+    capacity = {
+        "pool_block_bytes": budget,
+        "bf16_blocks": nb_bf16,
+        "int8_blocks": int(nb_int8),
+        "bf16_resident_requests": res_bf16,
+        "int8_resident_requests": res_int8,
+        "capacity_ratio": round(res_int8 / res_bf16, 2) if res_bf16 else 0.0,
+    }
+
     print(json.dumps({
         "metric": "serving_decode_chunked_speedup",
         "value": round(speedup, 2),
@@ -182,6 +295,8 @@ def main():
             "per_token_tokens_per_sec": round(per_token_tps, 2),
             "chunked_tokens_per_sec": round(chunked_tps, 2),
             "depth_sweep": depth_sweep,
+            "shared_prefix": shared_prefix,
+            "int8_kv_capacity": capacity,
             "decode_stats": {
                 "dispatches": st["dispatches"],
                 "tokens": st["tokens"],
@@ -189,7 +304,7 @@ def main():
             },
         },
     }))
-    return 0 if tokens_match else 1
+    return 0 if (tokens_match and prefix_match) else 1
 
 
 if __name__ == "__main__":

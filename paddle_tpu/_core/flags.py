@@ -144,6 +144,26 @@ define_flag(
     "discovered at restore time)",
 )
 define_flag(
+    "FLAGS_prefix_cache",
+    False,
+    "Radix/prefix KV reuse in serving.GenerationEngine: admission matches "
+    "the longest cached token-id prefix at page granularity and takes "
+    "references to those pool pages instead of re-prefilling them; full "
+    "prompt blocks written by prefill are inserted back into the tree and "
+    "refcount-zero leaves are evicted LRU under pool pressure "
+    "(docs/DECODE.md)",
+)
+define_flag(
+    "FLAGS_kv_cache_dtype",
+    "bf16",
+    "Paged-KV pool storage dtype for serving.GenerationEngine: 'bf16' "
+    "(default) keeps full-precision pools in the model's serving dtype; "
+    "'int8' stores quantized values with per-block-per-head scales carried "
+    "alongside the pool and dequantized on gather inside the jitted decode "
+    "step — roughly double the resident requests at fixed pool bytes "
+    "(ops/paged_attention.QuantPool, docs/DECODE.md)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

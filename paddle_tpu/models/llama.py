@@ -413,11 +413,15 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
     ``chunk`` selects the T-token variant (speculative verify / macro-step
     internals share it).  Returns (h, pools) in the layout given.
     """
+    from paddle_tpu.ops import paged_attention as pa
+
     step = _decode_layer_paged_chunk if chunk else _decode_layer_paged
     if isinstance(layers, nn.LayerStack):
+        # per-layer form is a list/tuple; anything else (a raw stacked
+        # array or a stacked QuantPool pytree) is the carry form
         stacked_in = not isinstance(kpools, (list, tuple))
-        k_state = kpools if stacked_in else jnp.stack(kpools)
-        v_state = vpools if stacked_in else jnp.stack(vpools)
+        k_state = kpools if stacked_in else pa.pool_stack(kpools)
+        v_state = vpools if stacked_in else pa.pool_stack(vpools)
         h, k_state, v_state = layers.decode_scan(
             lambda layer, hh, kc, vc: step(
                 layer, hh, cos, sin, kc, vc, tables, lens),
@@ -425,7 +429,8 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
         if stacked_in:
             return h, k_state, v_state
         n = len(layers)
-        return h, [k_state[i] for i in range(n)], [v_state[i] for i in range(n)]
+        return (h, [pa.pool_index(k_state, i) for i in range(n)],
+                [pa.pool_index(v_state, i) for i in range(n)])
     new_k, new_v = [], []
     for li, layer in enumerate(layers):
         h, kc, vc = step(layer, h, cos, sin, kpools[li], vpools[li],
@@ -437,19 +442,26 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
 
 def _pool_carry(layers, kpools, vpools):
     """Per-layer pool lists -> the cheapest loop-carry form: ONE stacked
-    [N, ...] array each for a LayerStack (the macro-step scan then carries
+    [N, ...] pool each for a LayerStack (the macro-step scan then carries
     2 buffers instead of 2N and the decode_scan consumes them directly —
-    no per-token stack/unstack), the lists unchanged for the view loop."""
+    no per-token stack/unstack), the lists unchanged for the view loop.
+    Stacking is leaf-wise so quantized pools (QuantPool payload + scales)
+    ride the same path."""
+    from paddle_tpu.ops import paged_attention as pa
+
     if isinstance(layers, nn.LayerStack):
-        return jnp.stack(kpools), jnp.stack(vpools)
+        return pa.pool_stack(kpools), pa.pool_stack(vpools)
     return list(kpools), list(vpools)
 
 
 def _pool_unpack(layers, kpools, vpools):
     """Inverse of _pool_carry: back to per-layer lists for the host."""
+    from paddle_tpu.ops import paged_attention as pa
+
     if isinstance(layers, nn.LayerStack):
         n = len(layers)
-        return [kpools[i] for i in range(n)], [vpools[i] for i in range(n)]
+        return ([pa.pool_index(kpools, i) for i in range(n)],
+                [pa.pool_index(vpools, i) for i in range(n)])
     return list(kpools), list(vpools)
 
 

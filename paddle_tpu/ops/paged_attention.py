@@ -27,10 +27,77 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "QuantPool",
     "alloc_paged_cache",
     "paged_write",
+    "paged_write_chunk",
+    "paged_pour_blocks",
+    "paged_gather",
     "paged_decode_attention",
+    "paged_chunk_attention",
+    "pool_num_kv_heads",
+    "pool_nbytes",
+    "pool_stack",
+    "pool_index",
 ]
+
+_QMAX = 127.0  # symmetric int8 range; -128 is never produced
+_EPS = 1e-12
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantPool:
+    """Int8-quantized paged pool: `data` int8 [num_blocks, Nkv, bs, H] plus
+    per-block-per-head `scale` float32 [num_blocks, Nkv].
+
+    A stored element decodes as ``data * scale`` (symmetric, zero-point
+    free).  Scales are running maxima per (block, head): a decode write
+    whose amax exceeds the block's current scale grows the scale and
+    RESCALES the block's existing payload against it (one small gather +
+    scatter over just the touched blocks, inside the jitted step), so every
+    resident token stays decodable with the single per-block scale.  A
+    deliberate pytree (NOT a tuple subclass): per-layer pool LISTS keep
+    meaning "unstacked" in _decode_layers_paged, and jit / donate_argnums /
+    lax.scan thread the (data, scale) pair as ordinary leaves.
+    """
+
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data, scale):
+        self.data = data
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def nbytes(self):
+        return self.data.nbytes + self.scale.nbytes
+
+
+def pool_num_kv_heads(cache):
+    """Nkv of a paged pool, quantized or plain."""
+    return (cache.data if isinstance(cache, QuantPool) else cache).shape[1]
+
+
+def pool_nbytes(cache):
+    """Resident bytes of a paged pool (payload + scales for QuantPool)."""
+    return cache.nbytes
+
+
+def pool_stack(pools):
+    """Per-layer pool list -> ONE stacked [N, ...] pool (leaf-wise, so a
+    list of QuantPools stacks into a QuantPool of stacked leaves)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *pools)
+
+
+def pool_index(pool, i):
+    """Layer i's pool out of a stacked [N, ...] pool (leaf-wise)."""
+    return jax.tree_util.tree_map(lambda x: x[i], pool)
 
 
 def rope_rotate_by_position(t, cos, sin, positions):
@@ -47,8 +114,18 @@ def rope_rotate_by_position(t, cos, sin, positions):
 
 
 def alloc_paged_cache(num_blocks, num_kv_heads, block_size, head_dim, dtype=jnp.bfloat16):
-    """One K and one V pool: [num_blocks, Nkv, block_size, H]."""
+    """One K and one V pool: [num_blocks, Nkv, block_size, H].
+
+    dtype 'int8' (or jnp.int8) allocates QuantPool pairs instead — int8
+    payload plus per-block-per-head float32 scales (FLAGS_kv_cache_dtype).
+    """
     shape = (num_blocks, num_kv_heads, block_size, head_dim)
+    if jnp.dtype(dtype) == jnp.int8:
+        def _one():
+            return QuantPool(jnp.zeros(shape, jnp.int8),
+                             jnp.zeros((num_blocks, num_kv_heads), jnp.float32))
+
+        return _one(), _one()
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -67,10 +144,18 @@ def paged_write(cache, new, block_tables, positions):
 def paged_gather(cache, block_tables):
     """Materialize each sequence's logical cache view.
 
-    cache: [num_blocks, Nkv, bs, H]; block_tables: [B, max_blocks] ->
-    [B, Nkv, max_blocks*bs, H].
+    cache: [num_blocks, Nkv, bs, H] (or QuantPool); block_tables:
+    [B, max_blocks] -> [B, Nkv, max_blocks*bs, H].  Quantized pools
+    DEQUANTIZE on gather (float32 out): the decode step reads int8 pages +
+    scales from HBM and rescales in registers — the capacity win is in the
+    resident bytes, not the gathered view.
     """
-    pages = jnp.take(cache, block_tables, axis=0)  # [B, max_blocks, Nkv, bs, H]
+    if isinstance(cache, QuantPool):
+        pages = jnp.take(cache.data, block_tables, axis=0)  # [B,mb,Nkv,bs,H]
+        scales = jnp.take(cache.scale, block_tables, axis=0)  # [B,mb,Nkv]
+        pages = pages.astype(jnp.float32) * scales[..., None, None]
+    else:
+        pages = jnp.take(cache, block_tables, axis=0)  # [B, mb, Nkv, bs, H]
     b, mb, nkv, bs, h = pages.shape
     return jnp.moveaxis(pages, 2, 1).reshape(b, nkv, mb * bs, h)
 
@@ -104,16 +189,65 @@ def rope_rotate_chunk(t, cos, sin, positions):
 def paged_write_chunk(cache, new, block_tables, positions):
     """Write T tokens per sequence into their pages.
 
-    cache: [num_blocks, Nkv, bs, H]; new: [B, T, Nkv, H]; positions:
-    [B, T] int32 (token index within each sequence).  The [B, T] scatter
-    is one advanced-indexing update — speculative verify writes its whole
-    chunk in one shot."""
+    cache: [num_blocks, Nkv, bs, H] (or QuantPool); new: [B, T, Nkv, H];
+    positions: [B, T] int32 (token index within each sequence).  The [B, T]
+    scatter is one advanced-indexing update — speculative verify writes its
+    whole chunk in one shot."""
+    if isinstance(cache, QuantPool):
+        return _quant_write_chunk(cache, new, block_tables, positions)
     bs = cache.shape[2]
     block_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # [B,T]
     slot = positions % bs
     # advanced indexing on dims 0 and 2 with [B, T] index arrays puts the
     # broadcast [B, T] in front: value shape [B, T, Nkv, H] == new
     return cache.at[block_idx, :, slot, :].set(new)
+
+
+def _quant_write_chunk(pool, new, block_tables, positions):
+    """Quantized paged_write_chunk: per-block-per-head running-max scales.
+
+    The incoming tokens' per-head amax grows each touched block's scale
+    via scatter-max; blocks whose scale grew get their EXISTING int8
+    payload rescaled against the new scale (gather + scatter over just the
+    touched blocks — every gather below predates the scatters, so chunk
+    rows landing in the same block compute identical rescale values and
+    duplicate-index writes stay deterministic); the new tokens then
+    quantize against the final scales and scatter into their slots."""
+    bs = pool.data.shape[2]
+    block_idx = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # [B,T]
+    slot = positions % bs
+    af = new.astype(jnp.float32)                                 # [B,T,Nkv,H]
+    tok_scale = jnp.max(jnp.abs(af), axis=-1) / _QMAX            # [B,T,Nkv]
+    old_scale = pool.scale[block_idx]                            # [B,T,Nkv]
+    scale = pool.scale.at[block_idx].max(tok_scale)
+    new_scale = scale[block_idx]                                 # final per block
+    safe = jnp.maximum(new_scale, _EPS)
+    old_blocks = pool.data[block_idx].astype(jnp.float32)        # [B,T,Nkv,bs,H]
+    ratio = jnp.where(new_scale > old_scale, old_scale / safe, 1.0)
+    resc = jnp.clip(jnp.round(old_blocks * ratio[..., None, None]),
+                    -_QMAX, _QMAX).astype(jnp.int8)
+    data = pool.data.at[block_idx].set(resc)
+    q = jnp.clip(jnp.round(af / safe[..., None]), -_QMAX, _QMAX).astype(jnp.int8)
+    data = data.at[block_idx, :, slot, :].set(q)
+    return QuantPool(data, scale)
+
+
+def paged_pour_blocks(cache, kv, block_ids):
+    """Pour whole blocks (prefill) into the pool at `block_ids`.
+
+    kv: [n_blocks, Nkv, bs, H] float values.  Quantized pools compute
+    fresh per-block-per-head scales over the poured content (SET, not
+    running-max — a recycled block's stale scale dies here)."""
+    idx = jnp.asarray(block_ids, jnp.int32)
+    if isinstance(cache, QuantPool):
+        af = kv.astype(jnp.float32)
+        s = jnp.max(jnp.abs(af), axis=(2, 3)) / _QMAX            # [n, Nkv]
+        safe = jnp.maximum(s, _EPS)
+        q = jnp.clip(jnp.round(af / safe[:, :, None, None]),
+                     -_QMAX, _QMAX).astype(jnp.int8)
+        return QuantPool(cache.data.at[idx].set(q),
+                         cache.scale.at[idx].set(s))
+    return cache.at[idx].set(kv.astype(cache.dtype))
 
 
 def paged_chunk_attention(q, key_cache, value_cache, block_tables, seq_lens,
@@ -124,7 +258,7 @@ def paged_chunk_attention(q, key_cache, value_cache, block_tables, seq_lens,
     seq_lens - T + j and attends keys <= that position (bottom-right
     causal within the chunk).  Returns [B, T, N, H]."""
     b, t, n, h = q.shape
-    nkv = key_cache.shape[1]
+    nkv = pool_num_kv_heads(key_cache)
     if scale is None:
         scale = 1.0 / math.sqrt(h)
     keys = paged_gather(key_cache, block_tables)  # [B, Nkv, S, H]

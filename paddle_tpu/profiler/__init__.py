@@ -337,8 +337,15 @@ def decode_stats(reset: bool = False) -> dict:
     materializing device results), total step seconds and derived
     tokens_per_sec.  Macro-step decoding (FLAGS_decode_chunk > 1) shows
     tokens >> dispatches; tokens ~= dispatches means every token pays a
-    host round-trip (the per-token path).  Zeros when no engine ran.
-    Serving owns the counters — one schema, no drift."""
+    host round-trip (the per-token path).  Also the prefix-cache tier
+    (FLAGS_prefix_cache): prefix_hits/_misses per admission,
+    prefix_hit_tokens (prompt tokens whose prefill was avoided by page
+    reuse), prefix_evictions (LRU reclaims under pool pressure); and the
+    capacity tier: pool_bytes of the most recent engine, resident_peak
+    concurrently-active requests, and derived pool_bytes_per_resident —
+    the number int8 KV pools (FLAGS_kv_cache_dtype) roughly halve.
+    Zeros when no engine ran.  Serving owns the counters — one schema,
+    no drift."""
     from paddle_tpu import serving
 
     return serving.decode_stats(reset=reset)

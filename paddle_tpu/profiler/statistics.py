@@ -192,18 +192,33 @@ def dispatch_cache_line(stats: dict) -> str:
 
 def decode_line(stats: dict) -> str:
     """One-line rendering of the serving decode counters for
-    Profiler.summary(); empty when no engine dispatched this process."""
+    Profiler.summary(); empty when no engine dispatched this process.
+    With the prefix cache or capacity counters active, a second line
+    reports hits/misses/avoided-prefill-tokens/evictions and pool bytes
+    per resident request (the int8-KV capacity metric)."""
     if not stats.get("dispatches"):
         return ""
     toks = stats.get("tokens", 0)
     disp = stats["dispatches"]
-    return (
+    line = (
         "Serving decode: tokens=%d dispatches=%d (%.1f tok/dispatch, "
         "last chunk D=%d) tokens/s=%.1f sync=%.3fs of %.3fs"
         % (toks, disp, toks / disp if disp else 0.0,
            stats.get("last_chunk", 0), stats.get("tokens_per_sec", 0.0),
            stats.get("sync_seconds", 0.0), stats.get("step_seconds", 0.0))
     )
+    lookups = stats.get("prefix_hits", 0) + stats.get("prefix_misses", 0)
+    if lookups or stats.get("resident_peak"):
+        line += (
+            "\nPrefix cache: hits=%d misses=%d prefill_avoided_tokens=%d "
+            "evictions=%d; pool bytes/resident=%.0f (peak %d resident)"
+            % (stats.get("prefix_hits", 0), stats.get("prefix_misses", 0),
+               stats.get("prefix_hit_tokens", 0),
+               stats.get("prefix_evictions", 0),
+               stats.get("pool_bytes_per_resident", 0.0),
+               stats.get("resident_peak", 0))
+        )
+    return line
 
 
 def verify_line(stats: dict) -> str:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -28,7 +29,8 @@ import jax.numpy as jnp
 
 from paddle_tpu._core import flags as _flags
 
-__all__ = ["GenerationEngine", "decode_stats", "reset_decode_stats"]
+__all__ = ["GenerationEngine", "RadixPrefixCache", "decode_stats",
+           "reset_decode_stats"]
 
 
 # --------------------------------------------------------- decode telemetry
@@ -43,6 +45,19 @@ _DECODE_STATS = {
     "step_seconds": 0.0,
     "macro_steps": 0,
     "last_chunk": 0,
+    # prefix-cache tier (FLAGS_prefix_cache): admissions that reused at
+    # least one cached page / that found nothing, prompt tokens whose
+    # prefill was AVOIDED by page reuse, and LRU evictions of reclaimable
+    # (refcount-zero) cached pages under pool pressure
+    "prefix_hits": 0,
+    "prefix_misses": 0,
+    "prefix_hit_tokens": 0,
+    "prefix_evictions": 0,
+    # capacity tier: resident bytes of the most recent engine's pools
+    # (payload + scales for int8) and the peak concurrently-active
+    # requests observed — bytes/resident is the int8-KV capacity metric
+    "pool_bytes": 0,
+    "resident_peak": 0,
 }
 
 
@@ -50,10 +65,15 @@ def decode_stats(reset: bool = False) -> dict:
     """Serving decode counters: dispatches, emitted tokens, host sync
     seconds, total step() seconds, and derived tokens_per_sec.  A healthy
     macro-stepping engine shows tokens >> dispatches; tokens ~= dispatches
-    means the per-token path (FLAGS_decode_chunk=1) is active."""
+    means the per-token path (FLAGS_decode_chunk=1) is active.  Also the
+    prefix-cache hit/miss/avoided-token/eviction counters and the derived
+    pool_bytes_per_resident capacity metric (docs/DECODE.md)."""
     out = dict(_DECODE_STATS)
     out["tokens_per_sec"] = (
         out["tokens"] / out["step_seconds"] if out["step_seconds"] else 0.0)
+    out["pool_bytes_per_resident"] = (
+        out["pool_bytes"] / out["resident_peak"] if out["resident_peak"]
+        else 0.0)
     if reset:
         reset_decode_stats()
     return out
@@ -92,6 +112,127 @@ class _Slot:
     d_seq_len: int = 0        # draft-pool coverage (speculative tier)
 
 
+class _PoolExhausted(RuntimeError):
+    """Transient admission failure: not enough free (or reclaimable) pool
+    blocks right now.  The engine queues the request for retry at the next
+    macro-step boundary instead of surfacing this."""
+
+
+class _RadixNode:
+    __slots__ = ("chunk", "block", "children", "parent", "last_used")
+
+    def __init__(self, chunk=None, block=-1, parent=None):
+        self.chunk = chunk          # tuple of block_size token ids
+        self.block = block          # pool block holding this chunk's K/V
+        self.children = {}
+        self.parent = parent
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Host-side radix tree over token-id prefixes at PAGE granularity.
+
+    Each node maps one FULL block's token chunk (a `block_size`-tuple of
+    ids) to the pool block holding its K/V — for every layer at once, since
+    a block id indexes all layers' pools at the same position.  `match`
+    walks the prompt chunk-by-chunk and returns the longest cached run of
+    blocks; `insert` adopts full prompt blocks freshly written by prefill.
+    Reference-counting lives in the engine's allocator: the tree itself
+    never pins a block, so a cached block with refcount zero is
+    RECLAIMABLE, and `evict` frees such blocks leaf-first in LRU order
+    (interior nodes only become evictable once their children are gone —
+    a cached prefix is never torn out from under a longer cached one).
+    Partial tail blocks are never inserted: the tail is re-prefilled
+    per-request into an exclusively-owned page, which is the copy-on-write
+    rule — shared pages are immutable, the mutable tail is always a
+    private copy.
+    """
+
+    def __init__(self, block_size):
+        self.block_size = int(block_size)
+        self._root = _RadixNode()
+        self._by_block: dict[int, _RadixNode] = {}
+        self._clock = 0
+
+    def __len__(self):
+        return len(self._by_block)
+
+    def holds(self, block) -> bool:
+        """Is this pool block owned by a tree node (i.e. cached)?"""
+        return block in self._by_block
+
+    def _tick(self):
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens, max_blocks=None):
+        """Longest cached full-block prefix of `tokens` -> pool block list.
+
+        Every matched node is LRU-touched.  `max_blocks` caps the walk
+        (admission caps at (len-1)//block_size so at least one suffix
+        token always prefills — the forward that produces the first
+        logits)."""
+        bs = self.block_size
+        limit = len(tokens) // bs
+        if max_blocks is not None:
+            limit = min(limit, max_blocks)
+        t = self._tick()
+        node, out = self._root, []
+        for bi in range(limit):
+            child = node.children.get(tuple(tokens[bi * bs:(bi + 1) * bs]))
+            if child is None:
+                break
+            child.last_used = t
+            out.append(child.block)
+            node = child
+        return out
+
+    def insert(self, tokens, blocks):
+        """Adopt `blocks[i]` as the cached page for tokens' i-th full
+        chunk.  Existing nodes keep their block (first writer wins — the
+        duplicate page stays request-private and recycles normally);
+        returns the newly adopted blocks."""
+        bs = self.block_size
+        t = self._tick()
+        node, adopted = self._root, []
+        for bi in range(min(len(blocks), len(tokens) // bs)):
+            chunk = tuple(tokens[bi * bs:(bi + 1) * bs])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode(chunk, blocks[bi], node)
+                node.children[chunk] = child
+                self._by_block[blocks[bi]] = child
+                adopted.append(blocks[bi])
+            child.last_used = t
+            node = child
+        return adopted
+
+    def evict(self, n, refcount):
+        """Free up to `n` RECLAIMABLE blocks: leaves whose refcount is
+        zero, oldest-LRU first.  Refcounted blocks are untouchable — a
+        request is still reading those pages.  Returns the freed blocks
+        (the caller returns them to its free list).  One scan + a heap:
+        an interior node enters the heap the moment its last child frees,
+        so the whole reclaim is O(cached log cached), not O(n * cached)."""
+        import heapq
+
+        heap = [(nd.last_used, nd.block) for nd in self._by_block.values()
+                if not nd.children and refcount[nd.block] == 0]
+        heapq.heapify(heap)
+        freed = []
+        while heap and len(freed) < n:
+            _, block = heapq.heappop(heap)
+            victim = self._by_block[block]
+            parent = victim.parent
+            del parent.children[victim.chunk]
+            del self._by_block[victim.block]
+            freed.append(victim.block)
+            if (parent is not self._root and not parent.children
+                    and refcount[parent.block] == 0):
+                heapq.heappush(heap, (parent.last_used, parent.block))
+        return freed
+
+
 class GenerationEngine:
     """Greedy continuous-batching decode over a shared paged-KV pool.
 
@@ -114,7 +255,8 @@ class GenerationEngine:
     def __init__(self, model, max_batch=4, block_size=16, num_blocks=128,
                  eos_token_id=None, mesh=None, mp_axis="mp",
                  prefill_chunk=None, draft_model=None,
-                 num_speculative_tokens=4, decode_chunk=None):
+                 num_speculative_tokens=4, decode_chunk=None,
+                 prefix_cache=None, kv_cache_dtype=None):
         """mesh: optional ProcessMesh/jax Mesh with an `mp_axis` dimension —
         the engine then serves TENSOR-PARALLEL: weights get Megatron
         placements (models.llama.shard_llama), the paged-KV pool is sharded
@@ -131,7 +273,20 @@ class GenerationEngine:
         are dropped on the host.  Token streams are bit-identical for
         every D.  step() returns {rid: token} when D == 1 (back-compat)
         and {rid: [tokens...]} when D > 1.  Ignored by speculative engines
-        (their tick is already multi-token)."""
+        (their tick is already multi-token).
+
+        prefix_cache (None -> FLAGS_prefix_cache): radix/prefix KV reuse —
+        admission matches the longest cached token-id prefix at page
+        granularity, takes REFERENCES to those pool pages instead of
+        re-prefilling them, and prefills only the suffix; full prompt
+        blocks written by prefill are inserted back into the tree, and
+        refcount-zero leaves are evicted LRU under pool pressure.
+
+        kv_cache_dtype (None -> FLAGS_kv_cache_dtype): 'bf16' keeps
+        full-precision pools in the model's serving dtype (today's exact
+        behavior); 'int8' stores quantized pools with per-block-per-head
+        scales, dequantized on gather inside the jitted step — roughly
+        double the resident requests at fixed pool bytes."""
         cfg = model.config
         self.model = model
         if prefill_chunk is not None and int(prefill_chunk) < 1:
@@ -173,20 +328,38 @@ class GenerationEngine:
                     mesh.jax_mesh, PartitionSpec())
         self.mesh = mesh
 
+        from paddle_tpu.ops import paged_attention as pa
+
         # pool pages [num_blocks, Nkv, bs, H] per layer, plus one dedicated
         # scratch page per slot (masked lanes write there, never the pool)
         self._num_blocks = int(num_blocks)
         total = self._num_blocks + self.max_batch
-        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        self._kpools = [
-            jnp.zeros((total, self._nkv, self.block_size, self._head_dim), dt)
-            for _ in range(self._n_layers)
-        ]
-        self._vpools = [jnp.zeros_like(k) for k in self._kpools]
+        kv_dt = (kv_cache_dtype if kv_cache_dtype is not None
+                 else _flags.flag("FLAGS_kv_cache_dtype"))
+        if kv_dt not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'bf16' or 'int8', got {kv_dt!r}")
+        if kv_dt == "int8" and mesh is not None:
+            raise ValueError(
+                "int8 KV pools are not combined with the tensor-parallel "
+                "mesh engine yet; use kv_cache_dtype='bf16'")
+        self._kv_dtype = kv_dt  # resolved ONCE: pools are allocated now
+        dt = (jnp.int8 if kv_dt == "int8"
+              else jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32)
+        pools = [pa.alloc_paged_cache(total, self._nkv, self.block_size,
+                                      self._head_dim, dt)
+                 for _ in range(self._n_layers)]
+        self._kpools = [k for k, _ in pools]
+        self._vpools = [v for _, v in pools]
         if self._pool_sharding is not None:
             self._kpools = [jax.device_put(k, self._pool_sharding) for k in self._kpools]
             self._vpools = [jax.device_put(v, self._pool_sharding) for v in self._vpools]
         self._free = list(range(self._num_blocks))
+        self._ref = [0] * total  # per-block request refcounts (allocator)
+        pc = (bool(prefix_cache) if prefix_cache is not None
+              else bool(_flags.flag("FLAGS_prefix_cache")))
+        self._prefix = RadixPrefixCache(self.block_size) if pc else None
+        self._pending: deque = deque()  # admission retries (pool pressure)
         self._scratch = [self._num_blocks + i for i in range(self.max_batch)]
         self._slots = [_Slot() for _ in range(self.max_batch)]
         self._results: dict = {}
@@ -222,33 +395,63 @@ class GenerationEngine:
             self._d_layers = dc.num_hidden_layers
             self._d_nkv = dc.num_key_value_heads
             self._d_hd = dc.hidden_size // dc.num_attention_heads
-            ddt = jnp.bfloat16 if dc.dtype == "bfloat16" else jnp.float32
-            self._d_kpools = [
-                jnp.zeros((total, self._d_nkv, self.block_size, self._d_hd), ddt)
-                for _ in range(self._d_layers)
-            ]
-            self._d_vpools = [jnp.zeros_like(k) for k in self._d_kpools]
+            ddt = (jnp.int8 if kv_dt == "int8"
+                   else jnp.bfloat16 if dc.dtype == "bfloat16" else jnp.float32)
+            d_pools = [pa.alloc_paged_cache(total, self._d_nkv,
+                                            self.block_size, self._d_hd, ddt)
+                       for _ in range(self._d_layers)]
+            self._d_kpools = [k for k, _ in d_pools]
+            self._d_vpools = [v for _, v in d_pools]
             self._d_state = list(draft_model.state_dict().values())
             self._spec_stats = {"ticks": 0, "proposed": 0, "accepted": 0,
                                 "emitted": 0}
+        _DECODE_STATS["pool_bytes"] = sum(
+            pa.pool_nbytes(p) for p in
+            self._kpools + self._vpools
+            + getattr(self, "_d_kpools", []) + getattr(self, "_d_vpools", []))
 
     # ------------------------------------------------------------ requests
     def has_work(self):
-        return any(s.active for s in self._slots)
+        return any(s.active for s in self._slots) or bool(self._pending)
+
+    def pending_requests(self):
+        """Request ids queued for admission (pool pressure); they retry at
+        the next macro-step boundary."""
+        return [req["rid"] for req in self._pending]
 
     def result(self, rid):
         return self._results.get(rid)
 
     def _alloc(self, n):
+        """Pop n blocks (refcount 1 each).  Under pressure, reclaimable
+        prefix-cache pages (refcount-zero LRU leaves) are evicted first;
+        a genuine shortfall raises _PoolExhausted — admission backs out
+        and queues, it never surfaces to the caller mid-submit."""
+        if len(self._free) < n and self._prefix is not None:
+            freed = self._prefix.evict(n - len(self._free), self._ref)
+            self._free.extend(freed)
+            _DECODE_STATS["prefix_evictions"] += len(freed)
         if len(self._free) < n:
-            raise RuntimeError(
+            raise _PoolExhausted(
                 f"paged pool exhausted: need {n} blocks, {len(self._free)} free"
             )
         out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
         return out
 
+    def _unref(self, blocks):
+        """Drop one reference per block; blocks reaching refcount zero
+        return to the free list UNLESS the prefix tree caches them — those
+        stay resident as reclaimable pages until LRU eviction."""
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] <= 0 and (
+                    self._prefix is None or not self._prefix.holds(b)):
+                self._free.append(b)
+
     def _release(self, slot):
-        self._free.extend(slot.blocks)
+        self._unref(slot.blocks)
         slot.blocks = []
         slot.active = False
         slot.rid = None
@@ -257,6 +460,18 @@ class GenerationEngine:
                     temperature=None, seed=0):
         """Prefill the prompt, pour K/V into pool pages, occupy a slot.
 
+        With the prefix cache on, the longest cached token-id prefix is
+        matched at page granularity first: those pages are REFERENCED (not
+        re-prefilled) and only the suffix runs through the model.
+
+        Under pool pressure (or with no free slot) the request is QUEUED
+        instead of raising: admission retries at the next macro-step
+        boundary, and `add_request` returns None (the first generated
+        token otherwise).  Its PRNG nonce is reserved at submit time, so a
+        queued-then-admitted sampled request draws the same stream an
+        immediately-admitted one would.  Requests that can NEVER fit
+        (wider than the per-seq block table) still raise.
+
         temperature: None/0 -> greedy decode for this request;
         > 0 -> per-request temperature sampling, deterministic per
         (seed, join order) — the seed is folded with a per-request nonce so
@@ -264,21 +479,14 @@ class GenerationEngine:
         folds its OWN generated-token counter per step.  Requests with
         different decode configs share the ONE compiled decode program
         (the config rides in as per-slot arrays)."""
-        import paddle_tpu as paddle
-        from paddle_tpu.models.llama import _model_forward_cached
-
         if self.draft_model is not None and float(temperature or 0.0) > 0.0:
             # checked BEFORE any allocation/prefill: a rejected request
             # must not leak pool blocks or burn two prefills
             raise ValueError(
                 "speculative decoding slots are greedy-only (sampled "
                 "acceptance needs rejection sampling); drop temperature")
-        slot = next((s for s in self._slots if not s.active), None)
-        if slot is None:
-            raise RuntimeError("no free decode slot; call step() until one drains")
         prompt = np.asarray(prompt_ids, np.int32).reshape(1, -1)
-        s0 = prompt.shape[1]
-        max_len = s0 + int(max_new_tokens)
+        max_len = prompt.shape[1] + int(max_new_tokens)
         # speculative verify overshoots by up to K+1 positions past the
         # budget before lens bookkeeping rolls back — those writes must
         # land in pages the request OWNS, never in the table-padding block
@@ -289,65 +497,140 @@ class GenerationEngine:
                 f"request needs {n_blocks} blocks > per-seq table width "
                 f"{self._max_blocks_per_seq}"
             )
-        blocks = self._alloc(n_blocks)
-
-        model = self.model
-        empty = [
-            (
-                paddle.zeros([1, 0, self._nkv, self._head_dim], dtype=model.config.dtype),
-                paddle.zeros([1, 0, self._nkv, self._head_dim], dtype=model.config.dtype),
-            )
-            for _ in range(self._n_layers)
-        ]
-        with paddle.no_grad():
-            if self.prefill_chunk is None or s0 <= self.prefill_chunk:
-                h, caches = _model_forward_cached(
-                    model.model, paddle.to_tensor(prompt), empty, 0)
-            else:
-                # chunked prefill: fixed-size chunks through the cached
-                # forward (bottom-right-aligned cross-length attention)
-                # cap the peak activation footprint for long prompts
-                caches, off = empty, 0
-                while off < s0:
-                    chunk = prompt[:, off:off + self.prefill_chunk]
-                    h, caches = _model_forward_cached(
-                        model.model, paddle.to_tensor(chunk), caches, off)
-                    off += chunk.shape[1]
-            logits_last = model._logits(h[:, -1:, :])._value[0, -1, :]
-            first = int(np.asarray(jnp.argmax(logits_last)))
-
-        # pour prefill K/V into this request's pages
-        self._pour(self._kpools, self._vpools, caches, blocks, s0,
-                   self._nkv, self._head_dim, sharded=True)
-        if self.draft_model is not None:
-            # draft prefill over the same prompt into the draft pools
-            d_empty = [
-                (paddle.zeros([1, 0, self._d_nkv, self._d_hd],
-                              dtype=self.draft_model.config.dtype),
-                 paddle.zeros([1, 0, self._d_nkv, self._d_hd],
-                              dtype=self.draft_model.config.dtype))
-                for _ in range(self._d_layers)
-            ]
-            with paddle.no_grad():
-                _, d_caches = _model_forward_cached(
-                    self.draft_model.model, paddle.to_tensor(prompt),
-                    d_empty, 0)
-            self._pour(self._d_kpools, self._d_vpools, d_caches, blocks,
-                       s0, self._d_nkv, self._d_hd)
-            slot.d_seq_len = s0
-
-        slot.rid = rid
-        slot.active = True
-        slot.seq_len = s0
-        slot.max_len = max_len
-        slot.blocks = blocks
-        slot.temperature = float(temperature or 0.0)
-        # seed folded with a request nonce: same-seed requests get distinct
-        # streams; computed ONCE here, not per decode tick
+        # nonce reserved at SUBMIT time: retry timing can't shift the
+        # request's sampling stream
         nonce = self._req_counter
         self._req_counter += 1
+        req = {"rid": rid, "prompt": prompt, "max_len": max_len,
+               "n_blocks": n_blocks,
+               "temperature": float(temperature or 0.0),
+               "seed": int(seed), "nonce": nonce}
+        # FIFO fairness: while older requests wait, newcomers queue behind
+        if self._pending or not self._try_admit(req):
+            self._pending.append(req)
+            return None
+        return self._results[rid][0]
+
+    def _admit_pending(self):
+        """Retry queued admissions — called at macro-step boundaries.
+        Returns the admitted request ids: their prefill-produced FIRST
+        token (which add_request returned None for) is surfaced through
+        this step()'s output, so streaming callers never lose token #1."""
+        admitted = []
+        while self._pending:
+            if not self._try_admit(self._pending[0]):
+                if not any(s.active for s in self._slots):
+                    # nothing resident to drain and still no room: the
+                    # engine can never make progress — be loud
+                    raise RuntimeError(
+                        "queued request "
+                        f"{self._pending[0]['rid']!r} cannot be admitted "
+                        "with an idle engine (pool too small?)")
+                break
+            admitted.append(self._pending.popleft()["rid"])
+        return admitted
+
+    def _try_admit(self, req):
+        """One admission attempt: prefix-match, allocate, prefill the
+        suffix, pour, occupy a slot.  Returns False (with ALL state backed
+        out — no leaked blocks, no occupied slot, no stolen references) on
+        transient shortage; real errors back out and re-raise."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import _model_forward_cached
+
+        slot = next((s for s in self._slots if not s.active), None)
+        if slot is None:
+            return False
+        prompt = req["prompt"]
+        s0 = prompt.shape[1]
+        bs = self.block_size
+        # ---- prefix match: reference cached pages instead of prefilling.
+        # Capped at (s0-1)//bs full blocks so at least one suffix token
+        # always prefills — that forward produces the first-token logits.
+        toks = matched = None
+        if self._prefix is not None:
+            # token list cached across retries (the prompt is immutable);
+            # the match itself re-walks each attempt on purpose — the
+            # LRU touch keeps a waiting request's pages warm for its
+            # retry instead of letting pressure evict them
+            toks = req.setdefault("toks", [int(t) for t in prompt[0]])
+            matched = self._prefix.match(toks, max_blocks=(s0 - 1) // bs)
+            for b in matched:
+                self._ref[b] += 1
+        matched = matched or []
+        try:
+            fresh = self._alloc(req["n_blocks"] - len(matched))
+        except _PoolExhausted:
+            self._unref(matched)
+            return False
+        blocks = matched + fresh
+        m_len = len(matched) * bs
+
+        model = self.model
+        try:
+            caches = self._prefix_or_empty(
+                self._kpools, self._vpools, matched, m_len, self._n_layers,
+                self._nkv, self._head_dim, model.config.dtype)
+            with paddle.no_grad():
+                if (self.prefill_chunk is None
+                        or s0 - m_len <= self.prefill_chunk):
+                    h, caches = _model_forward_cached(
+                        model.model, paddle.to_tensor(prompt[:, m_len:]),
+                        caches, m_len)
+                else:
+                    # chunked prefill: fixed-size chunks through the cached
+                    # forward (bottom-right-aligned cross-length attention)
+                    # cap the peak activation footprint for long prompts
+                    off = m_len
+                    while off < s0:
+                        chunk = prompt[:, off:off + self.prefill_chunk]
+                        h, caches = _model_forward_cached(
+                            model.model, paddle.to_tensor(chunk), caches, off)
+                        off += chunk.shape[1]
+                logits_last = model._logits(h[:, -1:, :])._value[0, -1, :]
+                first = int(np.asarray(jnp.argmax(logits_last)))
+
+            # pour the suffix K/V into this request's exclusive pages
+            # (matched prefix pages are shared and immutable)
+            self._pour(self._kpools, self._vpools, caches, blocks, s0,
+                       self._nkv, self._head_dim, sharded=True,
+                       start_tok=m_len)
+            if self.draft_model is not None:
+                # draft prefill over the same suffix into the draft pools
+                # (cached pages were poured to BOTH pool sets at insert
+                # time, so a matched prefix covers the draft too)
+                d_caches = self._prefix_or_empty(
+                    self._d_kpools, self._d_vpools, matched, m_len,
+                    self._d_layers, self._d_nkv, self._d_hd,
+                    self.draft_model.config.dtype)
+                with paddle.no_grad():
+                    _, d_caches = _model_forward_cached(
+                        self.draft_model.model,
+                        paddle.to_tensor(prompt[:, m_len:]), d_caches, m_len)
+                self._pour(self._d_kpools, self._d_vpools, d_caches, blocks,
+                           s0, self._d_nkv, self._d_hd, start_tok=m_len)
+                slot.d_seq_len = s0
+        except BaseException:
+            # back out cleanly: pour only ever wrote the fresh pages, so
+            # returning them (and the prefix references) restores the
+            # allocator exactly
+            for b in fresh:
+                self._ref[b] = 0
+                self._free.append(b)
+            self._unref(matched)
+            raise
+
+        slot.rid = req["rid"]
+        slot.active = True
+        slot.seq_len = s0
+        slot.max_len = req["max_len"]
+        slot.blocks = blocks
+        slot.temperature = req["temperature"]
+        # seed folded with the submit-time nonce: same-seed requests get
+        # distinct streams and retries reproduce them
         slot.key = np.asarray(
-            jax.random.fold_in(jax.random.PRNGKey(int(seed)), nonce))
+            jax.random.fold_in(jax.random.PRNGKey(req["seed"]),
+                               req["nonce"]))
         if slot.temperature > 0.0:
             # re-pick the FIRST token by sampling (prefill used argmax);
             # fold index 0 = this request's first generated token
@@ -356,31 +639,94 @@ class GenerationEngine:
             first = int(np.asarray(jax.random.categorical(key, lg)))
         slot.last_token = first
         slot.generated = [first]
-        self._results[rid] = slot.generated
+        self._results[slot.rid] = slot.generated
+        if self._prefix is not None:
+            # full prompt blocks become shared pages for future requests
+            # (matched nodes just get LRU-touched); the partial tail block
+            # stays request-private — the copy-on-write rule
+            self._prefix.insert(toks, blocks[:s0 // bs])
+            # hit/miss telemetry counts COMMITTED admissions only: a
+            # queued-then-retried or prefill-errored attempt must not
+            # inflate the avoided-prefill tokens
+            if matched:
+                _DECODE_STATS["prefix_hits"] += 1
+                _DECODE_STATS["prefix_hit_tokens"] += m_len
+            else:
+                _DECODE_STATS["prefix_misses"] += 1
+        _DECODE_STATS["resident_peak"] = max(
+            _DECODE_STATS["resident_peak"],
+            sum(1 for s in self._slots if s.active))
         if self.eos_token_id is not None and first == self.eos_token_id:
             self._finish(slot)
         elif slot.seq_len + 1 >= slot.max_len:
             self._finish(slot)
-        return first
+        return True
+
+    def _prefix_or_empty(self, kpools, vpools, matched, m_len, n_layers,
+                         nkv, head_dim, dtype):
+        """Naive-cache seed for a suffix prefill: the matched prefix
+        gathered out of `kpools`/`vpools`, or length-0 empties.  One
+        builder for the main and draft pools so their prefix-gather
+        contracts cannot drift apart."""
+        import paddle_tpu as paddle
+
+        if m_len:
+            return self._gather_prefix(kpools, vpools, matched, m_len,
+                                       nkv, head_dim, dtype)
+        return [
+            (paddle.zeros([1, 0, nkv, head_dim], dtype=dtype),
+             paddle.zeros([1, 0, nkv, head_dim], dtype=dtype))
+            for _ in range(n_layers)
+        ]
+
+    def _gather_prefix(self, kpools, vpools, blocks, length, nkv, head_dim,
+                       dtype):
+        """Materialize a matched prefix's K/V as naive-cache Tensors
+        ([1, L, Nkv, H] per layer): the suffix prefill attends these
+        through the same cross-length path chunked prefill uses.
+        Quantized pools dequantize here — gather-side dequant, exactly as
+        the decode step does."""
+        from paddle_tpu._core.tensor import Tensor
+        from paddle_tpu.ops import paged_attention as pa
+
+        tables = jnp.asarray(np.asarray(blocks, np.int32)[None])
+        dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        out = []
+        for kc, vc in zip(kpools, vpools):
+            kv = pa.paged_gather(kc, tables)[:, :, :length]  # [1,Nkv,L,H]
+            vv = pa.paged_gather(vc, tables)[:, :, :length]
+            out.append((Tensor(jnp.moveaxis(kv, 1, 2).astype(dt)),
+                        Tensor(jnp.moveaxis(vv, 1, 2).astype(dt))))
+        return out
 
     def _pour(self, kpools, vpools, caches, blocks, s0, nkv, head_dim,
-              sharded=False):
-        """Scatter naive prefill caches into a request's pool pages."""
+              sharded=False, start_tok=0):
+        """Scatter naive prefill caches into a request's pool pages.
+
+        start_tok (always block-aligned) skips the prefix-matched region:
+        `caches` hold the FULL logical sequence (gathered prefix +
+        computed suffix) but only blocks[start_tok//bs:] — the request's
+        exclusively owned pages — are written.  Quantized pools get fresh
+        per-block-per-head scales here (paged_pour_blocks)."""
+        from paddle_tpu.ops import paged_attention as pa
+
         bs = self.block_size
-        n_blocks = len(blocks)
-        pad = n_blocks * bs - s0
+        b0 = start_tok // bs
+        tgt = blocks[b0:]
+        n_t = len(tgt)
+        pad = b0 * bs + n_t * bs - s0
+        idx = jnp.asarray(tgt, jnp.int32)
         for li, (k, v) in enumerate(caches):
-            kv = jnp.moveaxis(k._value, 1, 2)  # [1, Nkv, S, H]
-            vv = jnp.moveaxis(v._value, 1, 2)
+            kv = jnp.moveaxis(k._value, 1, 2)[:, :, start_tok:]  # [1,Nkv,S',H]
+            vv = jnp.moveaxis(v._value, 1, 2)[:, :, start_tok:]
             if pad:
                 kv = jnp.pad(kv, ((0, 0), (0, 0), (0, pad), (0, 0)))
                 vv = jnp.pad(vv, ((0, 0), (0, 0), (0, pad), (0, 0)))
-            # [1, Nkv, n_blocks*bs, H] -> n_blocks x [Nkv, bs, H]
-            kv = kv.reshape(nkv, n_blocks, bs, head_dim).swapaxes(0, 1)
-            vv = vv.reshape(nkv, n_blocks, bs, head_dim).swapaxes(0, 1)
-            idx = jnp.asarray(blocks, jnp.int32)
-            kpools[li] = kpools[li].at[idx].set(kv.astype(kpools[li].dtype))
-            vpools[li] = vpools[li].at[idx].set(vv.astype(vpools[li].dtype))
+            # [1, Nkv, n_t*bs, H] -> n_t x [Nkv, bs, H]
+            kv = kv.reshape(nkv, n_t, bs, head_dim).swapaxes(0, 1)
+            vv = vv.reshape(nkv, n_t, bs, head_dim).swapaxes(0, 1)
+            kpools[li] = pa.paged_pour_blocks(kpools[li], kv, idx)
+            vpools[li] = pa.paged_pour_blocks(vpools[li], vv, idx)
             if sharded and self._pool_sharding is not None:
                 # keep the pool committed to its head-sharded layout so the
                 # decode executable's input shardings stay stable
@@ -662,15 +1008,29 @@ class GenerationEngine:
         Plain engines return {rid: token} when D == 1 and
         {rid: [tok, ...]} when D > 1; SPECULATIVE engines always emit a
         LIST of tokens per request per tick — one accepted run plus the
-        target's correction/bonus token."""
+        target's correction/bonus token.  A request admitted from the
+        PENDING QUEUE this step always maps to a list, led by its
+        prefill-produced first token (the one add_request returned None
+        instead of)."""
         if not self.has_work():
             return {}
+        # macro-step boundary: queued admissions (pool pressure at
+        # add_request time) retry before this dispatch; their prefill
+        # first tokens (add_request returned None) surface in THIS
+        # step's output — always as a list for those rids, even at D=1
+        admitted = self._admit_pending()
+        if not any(s.active for s in self._slots):
+            # an admitted request may have finished AT admission
+            # (EOS / max_new_tokens=1): its first token still surfaces
+            return {rid: list(self._results[rid]) for rid in admitted}
         t_start = time.perf_counter()
         if self.draft_model is not None:
             out = self._spec_step()
             _DECODE_STATS["tokens"] += sum(len(v) for v in out.values())
             _DECODE_STATS["macro_steps"] += 1
             _DECODE_STATS["step_seconds"] += time.perf_counter() - t_start
+            # prepend AFTER the stats: prefill firsts aren't decode tokens
+            self._merge_admitted(out, admitted)
             return out
         D = self._effective_chunk()
         step_fn = self._step_fns.get(D)
@@ -738,4 +1098,20 @@ class GenerationEngine:
             out[rid] = emitted if D > 1 else emitted[0]
             _DECODE_STATS["tokens"] += len(emitted)
         _DECODE_STATS["step_seconds"] += time.perf_counter() - t_start
+        self._merge_admitted(out, admitted)
         return out
+
+    def _merge_admitted(self, out, admitted):
+        """Prepend queue-admitted requests' prefill first tokens to this
+        step's output.  Those rids always map to a LIST (even at D=1):
+        the queued-admission case is new surface, so no existing caller
+        sees the shape change."""
+        for rid in admitted:
+            first = self._results[rid][0]
+            got = out.get(rid)
+            if got is None:
+                out[rid] = [first]
+            elif isinstance(got, list):
+                out[rid] = [first] + got
+            else:
+                out[rid] = [first, got]

@@ -41,3 +41,15 @@ def test_bench_decode_smoke_emits_valid_json():
     # macro-stepping really amortized dispatches: tokens >> dispatches
     st = detail["decode_stats"]
     assert st["tokens"] > st["dispatches"]
+    # shared-prefix workload: cache-on streams equal cache-off streams,
+    # prefill really was avoided, and the latency percentiles are sane
+    sp = detail["shared_prefix"]
+    assert sp["tokens_match"] is True
+    assert sp["prefill_avoided_tokens"] > 0
+    assert sp["prefix_speedup"] > 0
+    for side in ("off", "on"):
+        assert sp[side]["latency_p95_ms"] >= sp[side]["latency_p50_ms"] > 0
+    # int8 capacity: at identical pool-block bytes the quantized pool
+    # admits >= 1.8x the resident requests (allocator arithmetic)
+    cap = detail["int8_kv_capacity"]
+    assert cap["int8_resident_requests"] >= 1.8 * cap["bf16_resident_requests"]

@@ -1,0 +1,280 @@
+"""Radix/prefix KV reuse in the serving tier (FLAGS_prefix_cache):
+page-granularity prefix matching over the paged pool, per-block refcounts
+in the allocator, LRU eviction of reclaimable leaves, and graceful
+pool-exhaustion queueing (docs/DECODE.md)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (GenerationEngine, RadixPrefixCache,
+                                decode_stats, reset_decode_stats)
+
+
+def _model(seed=41, **kw):
+    paddle.seed(seed)
+    kw.setdefault("num_hidden_layers", 2)
+    cfg = llama_tiny(vocab_size=128, hidden_size=32, intermediate_size=64,
+                     num_attention_heads=4,
+                     num_key_value_heads=4, max_position_embeddings=128,
+                     dtype="float32", **kw)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _drain(eng, reqs, **kw):
+    for rid, p in reqs:
+        eng.add_request(rid, p, **kw)
+    while eng.has_work():
+        eng.step()
+    return {rid: eng.result(rid) for rid, _ in reqs}
+
+
+# ---------------------------------------------------- radix tree unit tier
+def test_radix_match_insert_page_granularity():
+    t = RadixPrefixCache(block_size=4)
+    toks = list(range(12))
+    assert t.insert(toks, [10, 11, 12]) == [10, 11, 12]
+    # full match, longest-prefix semantics at page granularity
+    assert t.match(toks) == [10, 11, 12]
+    assert t.match(toks[:8]) == [10, 11]
+    assert t.match(toks[:7]) == [10]          # partial 2nd block: no match
+    assert t.match([9] + toks[1:]) == []      # diverges in block 0
+    # max_blocks caps the walk (the (s0-1)//bs admission cap)
+    assert t.match(toks, max_blocks=1) == [10]
+    # a diverging SUFFIX forks the tree without disturbing the shared run
+    fork = toks[:8] + [99, 98, 97, 96]
+    assert t.insert(fork, [10, 11, 20]) == [20]  # first 2 nodes exist
+    assert t.match(fork) == [10, 11, 20]
+    assert t.match(toks) == [10, 11, 12]
+    # first writer wins: re-inserting an existing chunk keeps its block
+    assert t.insert(toks[:4], [33]) == []
+    assert t.match(toks[:4]) == [10]
+
+
+def test_radix_eviction_refcount_and_lru():
+    t = RadixPrefixCache(block_size=2)
+    ref = {b: 0 for b in range(100)}
+    t.insert([1, 2, 3, 4], [5, 6])    # chain 5 -> 6
+    t.insert([7, 8], [9])
+    ref[5] = 1                        # a live request still reads block 5
+
+    # refcounted blocks are impossible to evict; interior nodes are
+    # untouchable while a child exists — so only 6 and 9 are reclaimable
+    freed = t.evict(10, ref)
+    assert 5 not in freed and set(freed) == {6, 9}
+    assert t.evict(10, ref) == []     # 5 is a leaf now but refcounted
+    ref[5] = 0
+    assert t.evict(10, ref) == [5]
+    assert len(t) == 0
+
+    # LRU order: the least-recently matched chain goes first
+    t.insert([1, 2], [70])
+    t.insert([3, 4], [71])
+    t.match([1, 2])                   # touch 70: 71 becomes the LRU leaf
+    assert t.evict(1, ref) == [71]
+
+
+# ---------------------------------------------------- engine parity tier
+def test_prefix_cache_streams_bit_identical():
+    """Token streams with the prefix cache on equal the cache-off streams
+    bit for bit: greedy and seeded sampling, unchunked and chunked
+    prefill.  The second request shares a 16-token prefix (2 pages at
+    bs=8) with the first."""
+    m = _model()
+    shared = list(np.random.default_rng(0).integers(0, 128, 16))
+    reqs = [("a", shared + [3, 7, 11]), ("b", shared + [9, 1])]
+
+    for chunk in (None, 5):
+        ref = _drain(GenerationEngine(m, max_batch=2, block_size=8,
+                                      num_blocks=32, prefill_chunk=chunk),
+                     reqs, max_new_tokens=6)
+        got = _drain(GenerationEngine(m, max_batch=2, block_size=8,
+                                      num_blocks=32, prefill_chunk=chunk,
+                                      prefix_cache=True),
+                     reqs, max_new_tokens=6)
+        assert got == ref, f"prefill_chunk={chunk}"
+
+    sref = _drain(GenerationEngine(m, max_batch=2, block_size=8,
+                                   num_blocks=32),
+                  reqs, max_new_tokens=6, temperature=2.0, seed=5)
+    sgot = _drain(GenerationEngine(m, max_batch=2, block_size=8,
+                                   num_blocks=32, prefix_cache=True),
+                  reqs, max_new_tokens=6, temperature=2.0, seed=5)
+    assert sgot == sref  # sampled streams ride the same (seed, nonce) keys
+
+
+def test_prefix_cache_reuses_pages_and_counts():
+    """The second same-prefix admission takes REFERENCES to cached pages
+    (fewer fresh allocations) and the telemetry records the avoided
+    prefill; a hot block is shared — both slots' tables point at it."""
+    m = _model()
+    shared = list(np.random.default_rng(1).integers(0, 128, 24))
+    reset_decode_stats()
+    eng = GenerationEngine(m, max_batch=2, block_size=8, num_blocks=32,
+                           prefix_cache=True)
+    eng.add_request("a", shared + [3], max_new_tokens=4)
+    free_after_a = len(eng._free)
+    eng.add_request("b", shared + [9], max_new_tokens=4)
+    # b matched 3 full pages: it allocated 3 fewer fresh blocks than a
+    # (a: 4 prompt-ish blocks + headroom; b: same minus 3 shared)
+    used_by_a = 32 - free_after_a
+    used_by_b = free_after_a - len(eng._free)
+    assert used_by_b == used_by_a - 3
+    shared_block = eng._slots[0].blocks[0]
+    assert eng._slots[1].blocks[0] == shared_block
+    assert eng._ref[shared_block] == 2
+    st = decode_stats()
+    assert st["prefix_hits"] == 1 and st["prefix_misses"] == 1
+    assert st["prefix_hit_tokens"] == 24
+    assert st["resident_peak"] == 2 and st["pool_bytes"] > 0
+    while eng.has_work():
+        eng.step()
+    # drained: refs drop to zero but cached pages stay resident
+    # (reclaimable), NOT on the free list
+    assert eng._ref[shared_block] == 0
+    assert eng._prefix.holds(shared_block)
+    assert shared_block not in eng._free
+
+
+def test_pool_pressure_evicts_lru_then_queues():
+    """Admission under pressure evicts reclaimable (refcount-zero) cached
+    pages LRU-first; when live requests pin everything, the request
+    queues and retries at the next macro-step boundary."""
+    m = _model()
+    rng = np.random.default_rng(2)
+    pa_ = list(rng.integers(0, 128, 16))
+    pb = list(rng.integers(0, 128, 16))
+    pc = list(rng.integers(0, 128, 16))
+    # pool of 6: one request needs 3 blocks (16 prompt + 4 new @ bs=8)
+    eng = GenerationEngine(m, max_batch=2, block_size=8, num_blocks=6,
+                           prefix_cache=True)
+    _drain(eng, [("a", pa_)], max_new_tokens=4)   # 2 cached pages, ref 0
+    _drain(eng, [("b", pb)], max_new_tokens=4)    # 2 more; 2 blocks free
+    assert sum(eng._prefix.holds(x) for x in range(6)) == 4
+    reset_decode_stats()
+    _drain(eng, [("c", pc)], max_new_tokens=4)    # needs 3: evicts the LRU
+    assert decode_stats()["prefix_evictions"] >= 1
+    assert len(eng._prefix.match(pb)) == 2        # recently used survives
+    assert len(eng._prefix.match(pa_)) < 2        # a's chain lost its leaf
+
+    # live requests pin every block -> the newcomer queues (free slot,
+    # no free/reclaimable pages), then admits once the others drain
+    eng2 = GenerationEngine(m, max_batch=3, block_size=8, num_blocks=4,
+                            prefix_cache=True)
+    r1 = list(rng.integers(0, 128, 8))
+    r2 = list(rng.integers(0, 128, 8))
+    r3 = list(rng.integers(0, 128, 8))
+    assert eng2.add_request("x", r1, max_new_tokens=4) is not None
+    assert eng2.add_request("y", r2, max_new_tokens=4) is not None
+    assert eng2.add_request("z", r3, max_new_tokens=4) is None  # queued
+    assert eng2.pending_requests() == ["z"]
+    while eng2.has_work():
+        eng2.step()
+    assert len(eng2.result("z")) == 4
+
+
+def test_queued_request_matches_immediate_admission():
+    """Satellite regression: a rejected-then-retried request produces the
+    SAME tokens as an immediately-admitted one — greedy and sampled (the
+    PRNG nonce is reserved at submit time, so retry timing can't shift
+    the stream)."""
+    m = _model()
+    p1 = list(np.random.default_rng(3).integers(0, 128, 8))
+    p2 = list(np.random.default_rng(4).integers(0, 128, 8))
+
+    def run(num_blocks):
+        eng = GenerationEngine(m, max_batch=2, block_size=8,
+                               num_blocks=num_blocks)
+        eng.add_request("a", p1, max_new_tokens=6)  # 2 blocks (14 tokens)
+        eng.add_request("b", p2, max_new_tokens=6, temperature=2.0, seed=9)
+        while eng.has_work():
+            eng.step()
+        return eng.result("a"), eng.result("b")
+
+    roomy = run(num_blocks=16)        # both admitted immediately
+    tight_eng = GenerationEngine(m, max_batch=2, block_size=8, num_blocks=2)
+    tight_eng.add_request("a", p1, max_new_tokens=6)
+    assert tight_eng.add_request("b", p2, max_new_tokens=6,
+                                 temperature=2.0, seed=9) is None
+    while tight_eng.has_work():
+        tight_eng.step()
+    assert (tight_eng.result("a"), tight_eng.result("b")) == roomy
+
+
+def test_queued_first_token_surfaces_in_step_output():
+    """Code-review regression: a queue-admitted request's prefill first
+    token (add_request returned None for it) must surface through step()
+    — as a LIST for that rid, led by the first token — not only via
+    result() polling.  Streaming callers lose token #1 otherwise."""
+    m = _model()
+    rng = np.random.default_rng(7)
+    p1 = list(rng.integers(0, 128, 8))
+    p2 = list(rng.integers(0, 128, 8))
+    eng = GenerationEngine(m, max_batch=2, block_size=8, num_blocks=2)
+    assert eng.add_request("a", p1, max_new_tokens=3) is not None
+    assert eng.add_request("b", p2, max_new_tokens=3) is None  # queued
+    streamed = {}
+    while eng.has_work():
+        for rid, v in eng.step().items():
+            streamed.setdefault(rid, []).extend(
+                v if isinstance(v, list) else [v])
+    assert streamed["b"] == eng.result("b")  # token #1 included
+    assert streamed["a"] == eng.result("a")[1:]  # a's came via add_request
+
+
+def test_prefix_cache_covers_speculative_draft_pools():
+    """Draft-pool sharing: cached pages index the draft pools at the same
+    block ids, so a matched prefix skips BOTH prefills and speculative
+    streams stay bit-identical to the cache-off engine."""
+    target = _model(seed=41, num_hidden_layers=2)
+    draft = _model(seed=42, num_hidden_layers=1)
+    shared = list(np.random.default_rng(6).integers(0, 128, 16))
+    reqs = [("a", shared + [3]), ("b", shared + [9, 4])]
+
+    ref = _drain(GenerationEngine(target, max_batch=2, block_size=8,
+                                  num_blocks=32, draft_model=draft),
+                 reqs, max_new_tokens=6)
+    reset_decode_stats()
+    eng = GenerationEngine(target, max_batch=2, block_size=8, num_blocks=32,
+                           draft_model=draft, prefix_cache=True)
+    got = _drain(eng, reqs, max_new_tokens=6)
+    assert got == ref
+    assert decode_stats()["prefix_hits"] == 1  # b reused a's pages
+
+
+def test_flags_wire_prefix_cache_and_invalidate_steps():
+    """FLAGS_prefix_cache drives the constructor default, and set_flags
+    on either new flag drops live engines' compiled macro-steps (the
+    standard invalidation contract)."""
+    m = _model()
+    try:
+        paddle.set_flags({"FLAGS_prefix_cache": True})
+        eng = GenerationEngine(m, max_batch=1, block_size=8, num_blocks=8)
+        assert eng._prefix is not None
+    finally:
+        paddle.set_flags({"FLAGS_prefix_cache": False})
+    eng = GenerationEngine(m, max_batch=1, block_size=8, num_blocks=8,
+                           decode_chunk=2)
+    assert eng._prefix is None  # flag restored -> default off
+
+    eng.add_request("r", [5, 9, 2], max_new_tokens=40)
+    eng.step()
+    assert eng._step_fns
+    paddle.set_flags({"FLAGS_prefix_cache": True})
+    assert not eng._step_fns  # invalidated
+    paddle.set_flags({"FLAGS_prefix_cache": False})
+    eng.step()
+    assert eng._step_fns
+    paddle.set_flags({"FLAGS_kv_cache_dtype": "int8"})
+    try:
+        assert not eng._step_fns  # invalidated (pools keep their dtype)
+    finally:
+        paddle.set_flags({"FLAGS_kv_cache_dtype": "bf16"})
+    while eng.has_work():
+        eng.step()
+
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        GenerationEngine(m, num_blocks=8, kv_cache_dtype="fp4")

@@ -87,12 +87,25 @@ def test_block_recycling_and_slot_reuse():
     assert eng.result("two") == ref2
 
 
-def test_pool_exhaustion_raises():
+def test_pool_exhaustion_queues_for_retry():
+    """A request the pool can't hold RIGHT NOW is queued (add_request ->
+    None) and admitted at a later macro-step boundary once blocks drain —
+    with the same tokens an immediately-admitted run produces.  Requests
+    that can NEVER fit (wider than the per-seq table) still raise."""
     model = _model()
+    p = list(range(1, 9))
+    ref = _ref_generate(model, p, 7)
     eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=2)
-    eng.add_request("a", list(range(1, 9)), max_new_tokens=7)  # 2 blocks
-    with pytest.raises(RuntimeError, match="pool exhausted|table width"):
-        eng.add_request("b", list(range(1, 9)), max_new_tokens=7)
+    assert eng.add_request("a", p, max_new_tokens=7) is not None  # 2 blocks
+    assert eng.add_request("b", p, max_new_tokens=7) is None      # queued
+    assert eng.pending_requests() == ["b"]
+    while eng.has_work():
+        eng.step()
+    assert eng.result("a") == ref
+    assert eng.result("b") == ref  # retried request decodes identically
+
+    with pytest.raises(RuntimeError, match="table width"):
+        eng.add_request("w", list(range(40)), max_new_tokens=40)
 
 
 def test_eos_stops_early():
