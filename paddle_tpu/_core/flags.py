@@ -164,6 +164,30 @@ define_flag(
     "(ops/paged_attention.QuantPool, docs/DECODE.md)",
 )
 define_flag(
+    "FLAGS_schedule_search",
+    False,
+    "Cost-model-driven Pallas schedule search over discovered reduction-/"
+    "matmul-rooted subgraphs (static/schedule_search.py): enumerate "
+    "candidate tilings, prune by roofline + VMEM budget, measure the "
+    "survivors, and substitute only schedules that beat XLA by the "
+    "measured-win margin — losing subgraphs persist as disabled in the "
+    "per-device autotune cache (docs/SCHEDULE_SEARCH.md)",
+)
+define_flag(
+    "FLAGS_schedule_search_budget",
+    6,
+    "Max schedule candidates measured on device per discovered subgraph "
+    "(the top-K survivors of the roofline + VMEM prunes); tests pin this "
+    "low to bound tier-1 wall time",
+)
+define_flag(
+    "FLAGS_schedule_search_min_win",
+    1.05,
+    "Measured-win gate margin: a searched Pallas schedule must beat the "
+    "XLA-only twin by at least this ratio or the subgraph is recorded as "
+    "disabled for this device kind and never re-measured",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

@@ -44,10 +44,32 @@ def device_peaks():
 
 
 class OpCostModel:
-    """Profiled per-op latency table (static_op_benchmark.json analog)."""
+    """Profiled per-op latency table (static_op_benchmark.json analog).
+
+    Table entries are keyed by (name, shape-key) — like
+    ops.autotune.AutotuneCache._key_str — so two shapes of the same op
+    never overwrite each other; `save()`/`load()` round-trip the full
+    per-shape table.  `query(name)` resolves a bare name when it was
+    measured at exactly one shape signature; a name measured at several
+    shapes must be queried by its full table key (`table_key`)."""
 
     def __init__(self):
         self.table: dict[str, dict] = {}
+
+    @staticmethod
+    def shape_key(args) -> str:
+        """Signature of example args: 'a0=16x32:float32|a1=...'."""
+        parts = []
+        for i, a in enumerate(args):
+            shape = tuple(getattr(a, "shape", ()) or ())
+            dt = getattr(a, "dtype", None)
+            dt = str(dt) if dt is not None else type(a).__name__
+            parts.append(f"a{i}={'x'.join(map(str, shape)) or 'scalar'}:{dt}")
+        return "|".join(parts)
+
+    def table_key(self, name, args) -> str:
+        sk = self.shape_key(args)
+        return f"{name}|{sk}" if sk else name
 
     def measure(self, name, fn, *args, iters=10, warmup=2):
         """Profile a jax-jittable callable; records and returns seconds/call."""
@@ -66,16 +88,38 @@ class OpCostModel:
             out = jfn(*args)
         hard_sync(out)
         dt = (time.perf_counter() - t0) / iters
-        self.table[name] = {"time_s": dt, "device": str(jax.devices()[0].device_kind)}
+        self.table[self.table_key(name, args)] = {
+            "time_s": dt,
+            "device": str(jax.devices()[0].device_kind),
+            "op": name,
+        }
         return dt
 
     def query(self, name, default=None):
-        entry = self.table.get(name)
-        if entry is None:
+        exact = self.table.get(name)
+        prefix = name + "|"
+        matches = [k for k, v in self.table.items()
+                   if k != name and (k.startswith(prefix)
+                                     or v.get("op") == name)]
+        if exact is not None and not matches:
+            return exact["time_s"]  # full table key, or sole bare entry
+        if exact is None and len(matches) == 1:
+            return self.table[matches[0]]["time_s"]
+        if exact is not None or matches:
+            # several shape signatures — or a bare legacy entry (e.g. from
+            # from_bench_ops) ALONGSIDE fresh per-shape measurements: never
+            # silently pick one (the stale bare entry used to shadow the
+            # fresh measurement)
             if default is not None:
                 return default
-            raise KeyError(f"no profile for op {name!r}")
-        return entry["time_s"]
+            example = matches[0] if matches else name
+            raise KeyError(
+                f"op {name!r} recorded at {len(matches) + (exact is not None)} "
+                f"shape signatures/entries; query the full table key (e.g. "
+                f"{example!r})")
+        if default is not None:
+            return default
+        raise KeyError(f"no profile for op {name!r}")
 
     def flops_time(self, flops, mem_bytes=0):
         """Roofline estimate: max(compute-bound, bandwidth-bound) seconds."""

@@ -268,6 +268,8 @@ def resolve_op_type(type_: str) -> bool:
         return True
     if base.startswith("vpu_chain_") and base[len("vpu_chain_"):].isdigit():
         return True
+    if base.startswith("sched_chain_") and base[len("sched_chain_"):].isdigit():
+        return True  # schedule-searched subgraph kernels (static/schedule_search.py)
     if base.endswith("_grad") and base[: -len("_grad")] in known_op_types():
         return True
     return False

@@ -40,10 +40,16 @@ __all__ = [
     "tune_swiglu",
     "device_kind_slug",
     "flash_vmem_bytes",
+    "validate_tile",
     "validate_flash_tile",
 ]
 
 _VMEM_BUDGET = 16 << 20  # ~16 MB/core on every current TPU generation
+
+# Format marker written into runtime cache files so loads can tell a
+# post-fix runtime delta (runtime-wins contract applies) from a pre-fix
+# seed-merged dump (healed at load: seeded keys dropped).
+_RUNTIME_MARKER = "__paddle_tpu_runtime__"
 
 
 def device_kind_slug(device=None):
@@ -91,18 +97,44 @@ class AutotuneCache:
     def _load(self):
         # priority (last wins): seed < user fallback < explicitly configured
         # dir; when no dir is configured _save_path() IS the seed path —
-        # dedupe so the seed cannot re-apply over newer user entries
-        paths = [self.seed_path, self.user_path]
+        # dedupe so the seed cannot re-apply over newer user entries.
+        # Seed-originated and runtime entries are tracked separately: the
+        # runtime save must NOT fossilize a copy of the seed into the
+        # configured dir, or a later package seed update for a key the
+        # runtime never tuned would be silently shadowed by the stale copy.
+        self._runtime: dict = {}
+        paths = [(self.seed_path, False), (self.user_path, True)]
         sp = self._save_path()
-        if sp not in paths:
-            paths.append(sp)
-        for path in paths:
+        if sp not in (self.seed_path, self.user_path):
+            paths.append((sp, True))
+        seed: dict = {}
+        for path, is_runtime in paths:
             try:
                 with open(path) as f:
                     loaded = json.load(f)
             except (OSError, ValueError):
                 continue
+            marked = bool(loaded.pop(_RUNTIME_MARKER, None))
             for kernel, entries in loaded.items():
+                if is_runtime and not marked:
+                    # heal dumps written by the pre-marker save() (it
+                    # copied the whole seed-merged table): a stale copy of
+                    # a seed entry is value-indistinguishable from a
+                    # genuine retune once the seed updates, so an UNMARKED
+                    # runtime file keeps only keys the seed doesn't have —
+                    # seeded keys re-tune once, stale copies can never
+                    # shadow a seed update again
+                    entries = {k: v for k, v in entries.items()
+                               if k not in seed.get(kernel, {})}
+                elif is_runtime:
+                    # marked (post-fix) file: runtime wins per contract;
+                    # entries identical to the seed carry no information
+                    entries = {k: v for k, v in entries.items()
+                               if seed.get(kernel, {}).get(k) != v}
+                if is_runtime:
+                    self._runtime.setdefault(kernel, {}).update(entries)
+                else:
+                    seed.setdefault(kernel, {}).update(entries)
                 self._data.setdefault(kernel, {}).update(entries)
 
     def save(self):
@@ -110,10 +142,18 @@ class AutotuneCache:
             return None
         path = self._save_path()
         for candidate in (path, self.user_path):
+            # writing INTO the seed file keeps its seed entries (merged
+            # payload); any runtime location gets runtime entries only,
+            # tagged with the format marker so reloads trust them
+            if candidate == self.seed_path:
+                payload = self._data
+            else:
+                payload = dict(self._runtime)
+                payload[_RUNTIME_MARKER] = 1
             try:
                 os.makedirs(os.path.dirname(candidate), exist_ok=True)
                 with open(candidate, "w") as f:
-                    json.dump(self._data, f, indent=1, sort_keys=True)
+                    json.dump(payload, f, indent=1, sort_keys=True)
                 self._dirty = False
                 return candidate
             except OSError:
@@ -126,11 +166,13 @@ class AutotuneCache:
         return dict(entry["config"]) if entry else None
 
     def put(self, kernel: str, key: dict, config: dict, ms: float, meta=None):
-        self._data.setdefault(kernel, {})[_key_str(key)] = {
+        entry = {
             "config": dict(config),
             "ms": round(float(ms), 6),
             **({"meta": meta} if meta else {}),
         }
+        self._data.setdefault(kernel, {})[_key_str(key)] = entry
+        self._runtime.setdefault(kernel, {})[_key_str(key)] = dict(entry)
         self._dirty = True
 
 
@@ -298,6 +340,20 @@ def flash_vmem_bytes(block_q, block_k, seq_k, head_dim):
     return per * 4 * 2
 
 
+def validate_tile(vmem_bytes, budget=None):
+    """Generic VMEM-budget check for any candidate tiling: None when a
+    working-set estimate fits the per-core budget, else a human-readable
+    reason.  The kernel-specific validators (validate_flash_tile) and the
+    schedule searcher's candidate prune (static/schedule_search.py) share
+    this single budget definition."""
+    b = _VMEM_BUDGET if budget is None else int(budget)
+    need = int(vmem_bytes)
+    if need > b:
+        return (f"working set ~{max(need >> 20, 1)} MiB VMEM "
+                f"> {b >> 20} MiB budget")
+    return None
+
+
 def validate_flash_tile(block_q, block_k, seq_q, seq_k, head_dim):
     """None when valid; else a human-readable reason (kernels warn with it
     rather than silently falling back — VERDICT r3 #10)."""
@@ -309,10 +365,9 @@ def validate_flash_tile(block_q, block_k, seq_q, seq_k, head_dim):
         return f"block_q={block_q} does not divide seq_q={seq_q}"
     if seq_k % block_k:
         return f"block_k={block_k} does not divide seq_k={seq_k}"
-    need = flash_vmem_bytes(block_q, block_k, seq_k, head_dim)
-    if need > _VMEM_BUDGET:
-        return (f"tile ({block_q},{block_k}) needs ~{need >> 20} MiB VMEM "
-                f"> {_VMEM_BUDGET >> 20} MiB budget")
+    reason = validate_tile(flash_vmem_bytes(block_q, block_k, seq_k, head_dim))
+    if reason:
+        return f"tile ({block_q},{block_k}): {reason}"
     return None
 
 

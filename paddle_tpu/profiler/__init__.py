@@ -217,7 +217,7 @@ class Profiler:
         seen traffic."""
         from .statistics import (checkpoint_line, compile_cache_line,
                                  decode_line, dispatch_cache_line,
-                                 summary_text, verify_line)
+                                 schedule_line, summary_text, verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -234,6 +234,9 @@ class Profiler:
         ver_line = verify_line(verify_stats())
         if ver_line:
             out = out + "\n" + ver_line
+        sched_line = schedule_line(schedule_search_stats())
+        if sched_line:
+            out = out + "\n" + sched_line
         ckpt_line = checkpoint_line(checkpoint_stats())
         if ckpt_line:
             out = out + "\n" + ckpt_line
@@ -379,6 +382,22 @@ def verify_stats(reset: bool = False) -> dict:
     return _verify.verify_stats(reset=reset)
 
 
+def schedule_search_stats(reset: bool = False) -> dict:
+    """Pallas schedule-search counters (FLAGS_schedule_search; see
+    static/schedule_search.py and docs/SCHEDULE_SEARCH.md): subgraphs
+    discovered and searched, candidate tilings enumerated, candidates
+    pruned by the roofline model vs the VMEM budget, candidates measured
+    on device, subgraphs accepted (schedule beat XLA by the win margin)
+    vs disabled, and cache service (accepted configs / disabled skips
+    reloaded from the per-device autotune cache).  Steady state shows
+    cache hits with measured flat — climbing measured means shape churn
+    is defeating the schedule cache.  The schedule_search module owns the
+    counters — one schema, no drift."""
+    from paddle_tpu.static import schedule_search as _ss
+
+    return _ss.schedule_search_stats(reset=reset)
+
+
 def checkpoint_stats(reset: bool = False) -> dict:
     """CheckpointManager counters (distributed/checkpoint/manager.py):
     saves issued (async_saves of them backgrounded), atomic commits,
@@ -395,7 +414,8 @@ def checkpoint_stats(reset: bool = False) -> dict:
 
 
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
-            "decode_stats", "verify_stats", "checkpoint_stats"]
+            "decode_stats", "verify_stats", "schedule_search_stats",
+            "checkpoint_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -240,6 +240,26 @@ def verify_line(stats: dict) -> str:
     )
 
 
+def schedule_line(stats: dict) -> str:
+    """One-line rendering of the Pallas schedule-search counters for
+    Profiler.summary(); empty when the search tier never ran this process.
+    `disabled` nonzero is healthy honesty (the measured-win gate found XLA
+    faster and said so); `measured` climbing in steady state means shape
+    churn is defeating the per-device schedule cache."""
+    if not (stats.get("subgraphs_found") or stats.get("cache_hits")
+            or stats.get("disabled_hits")):
+        return ""
+    return (
+        "Schedule search: subgraphs=%d candidates=%d pruned_roofline=%d "
+        "pruned_vmem=%d measured=%d accepted=%d disabled=%d; "
+        "cache hits=%d disabled_hits=%d"
+        % (stats["subgraphs_found"], stats["candidates"],
+           stats["pruned_roofline"], stats["pruned_vmem"],
+           stats["measured"], stats["accepted"], stats["disabled"],
+           stats["cache_hits"], stats["disabled_hits"])
+    )
+
+
 def checkpoint_line(stats: dict) -> str:
     """One-line rendering of the CheckpointManager counters for
     Profiler.summary(); empty when no checkpoint activity this process.

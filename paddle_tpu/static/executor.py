@@ -74,6 +74,40 @@ class Executor:
                 # the init value may still back a live dygraph Parameter
                 scope.set_var(vid, jnp.array(init, copy=True))
 
+    @staticmethod
+    def _rewrite_stage(program, fetch_vids, feed_vals, verify_mode,
+                       stamp_attr, pass_cls):
+        """One memoized fusion stage.  Memoized per (version, fetch set) —
+        a SET, so alternating fetch lists don't ping-pong the stamp and
+        re-pay the scan on the per-step hot path.  Verify mode keeps the
+        unrewritten program so any fusion this stamp performs can be
+        differentially replayed on the LIVE feed (static/verify.py;
+        docs/VERIFIER.md)."""
+        seen = getattr(program, stamp_attr, None)
+        if seen is None:
+            seen = set()
+            setattr(program, stamp_attr, seen)
+        stamp = (program.version, fetch_vids)
+        if stamp in seen:
+            return
+        reference = program.clone() if verify_mode else None
+        fused = pass_cls(fetch_vids).apply(program)
+        if verify_mode and fused:
+            from .verify import DifferentialError, differential_check
+
+            try:
+                differential_check(reference, program, fetch_vids,
+                                   feeds=feed_vals)
+            except DifferentialError:
+                # sticky failure: un-fuse and don't stamp, so a caller that
+                # catches and retries re-runs the pass and the check
+                # instead of silently serving the mis-fused program
+                program.global_block().ops[:] = \
+                    reference.global_block().ops
+                program.version = reference.version
+                raise
+        seen.add((program.version, fetch_vids))
+
     def run(self, program=None, feed=None, fetch_list=None, scope=None, return_numpy=True):
         program = program or default_main_program()
         scope = scope or global_scope()
@@ -111,37 +145,20 @@ class Executor:
             # attention/rms-norm/swiglu subgraphs XLA cannot re-derive
             # (SURVEY §7's CINN role).  Idempotent — fused ops don't match
             # again; a change bumps program.version → fresh cache entry.
-            # Memoized per (version, fetch set) — a SET, so alternating
-            # fetch lists don't ping-pong the stamp and re-pay the scan on
-            # the per-step hot path.
-            seen = getattr(program, "_pallas_fused_at", None)
-            if seen is None:
-                seen = program._pallas_fused_at = set()
-            stamp = (program.version, fetch_vids)
-            if stamp not in seen:
-                from .rewrite import PallasFusionPass
+            from .rewrite import PallasFusionPass
 
-                # verify mode: keep the unrewritten program so any fusion
-                # this stamp performs can be differentially replayed on the
-                # LIVE feed (static/verify.py; docs/VERIFIER.md)
-                reference = program.clone() if verify_mode else None
-                fused = PallasFusionPass(fetch_vids).apply(program)
-                if verify_mode and fused:
-                    from .verify import DifferentialError, differential_check
+            self._rewrite_stage(program, fetch_vids, feed_vals, verify_mode,
+                                "_pallas_fused_at", PallasFusionPass)
+        if flags.flag("FLAGS_schedule_search"):
+            # schedule-searched fusion over discovered reduction-/matmul-
+            # rooted subgraphs (docs/SCHEDULE_SEARCH.md).  Runs AFTER the
+            # named patterns so those keep their hand-written kernels;
+            # accepted schedules come from the per-device autotune cache,
+            # so steady-state runs pay a lookup, not a search.
+            from .rewrite import ScheduleSearchPass
 
-                    try:
-                        differential_check(reference, program, fetch_vids,
-                                           feeds=feed_vals)
-                    except DifferentialError:
-                        # sticky failure: un-fuse and don't stamp, so a
-                        # caller that catches and retries re-runs the pass
-                        # and the check instead of silently serving the
-                        # mis-fused program
-                        program.global_block().ops[:] = \
-                            reference.global_block().ops
-                        program.version = reference.version
-                        raise
-                seen.add((program.version, fetch_vids))
+            self._rewrite_stage(program, fetch_vids, feed_vals, verify_mode,
+                                "_sched_searched_at", ScheduleSearchPass)
 
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
         key = (id(program), program.version, sig, fetch_vids)

@@ -128,6 +128,12 @@ def _generic_elementwise_factory(**kwargs):
     return GenericElementwiseFusionPass(**kwargs)
 
 
+def _schedule_search_factory(**kwargs):
+    from .rewrite import ScheduleSearchPass
+
+    return ScheduleSearchPass(**kwargs)
+
+
 def _fp16_rewrite_factory(**kwargs):
     from paddle_tpu.distributed.passes import Fp16ProgramRewrite
 
@@ -272,6 +278,7 @@ _REGISTRY = {
     "weight_only_quant": WeightOnlyQuantPass,
     "pallas_fusion": _pallas_fusion_factory,
     "generic_elementwise_fusion": _generic_elementwise_factory,
+    "schedule_search": _schedule_search_factory,
     "auto_parallel_fp16": _fp16_rewrite_factory,
     "auto_parallel_recompute": _dist_rewrite_factory("RecomputeProgramRewrite"),
     "auto_parallel_gradient_merge": _dist_rewrite_factory("GradientMergeProgramRewrite"),

@@ -34,6 +34,8 @@ __all__ = [
     "MatmulEpiloguePattern",
     "AddNormPattern",
     "GenericElementwiseFusionPass",
+    "ScheduleSearchPattern",
+    "ScheduleSearchPass",
 ]
 
 
@@ -913,3 +915,74 @@ class GenericElementwiseFusionPass:
                 break
             if not done:
                 return n
+
+
+# ---------------------------------------------------------------------------
+# schedule-searched fusion (the discovery tier beyond elementwise chains)
+
+
+class ScheduleSearchPattern(RewritePattern):
+    """Discover a reduction-/matmul-rooted subgraph anchored at `op` (the
+    downstream end), hand it to the ScheduleSearcher (static/
+    schedule_search.py: enumerate tilings → roofline prune → VMEM prune →
+    measure → measured-win gate → per-device cache), and substitute ONE
+    generated Pallas kernel when the searched schedule beat XLA.
+
+    The classes hunted are the fusion misses named patterns skip: matmul→
+    bias→act→reduce tails, softmax-adjacent reduction chains (discovery is
+    DAG-shaped — manual softmax's exp feeding both sum and divide fuses as
+    one subgraph).  Fetch-frontier/write-visible interior values are
+    refused by the PatternRewritePass use-def rollback (PR 4's machinery,
+    counted in `.refused`); side-effect ops and collectives are never
+    crossed (op_registry.side_effect_op_types)."""
+
+    name = "schedule_search"
+    root_type = None
+
+    def __init__(self, searcher=None):
+        self._searcher = searcher
+        self._seen: set = set()  # (sig, root identity) already searched
+
+    def match_and_rewrite(self, op, graph):
+        from . import schedule_search as ss
+
+        spec = ss.match_subgraph(op, graph)
+        if spec is None:
+            return False
+        tag = (spec.sig, id(spec.root))
+        if tag in self._seen:
+            return False  # searched this site already (disabled/rolled back)
+        self._seen.add(tag)
+        searcher = self._searcher
+        if searcher is None:
+            searcher = self._searcher = ss.ScheduleSearcher()
+        decision = searcher.search(spec)
+        if not decision.accepted:
+            return False
+        try:
+            fused = ss.build_kernel(spec, decision.config)
+        except Exception:
+            return False  # cached config no longer buildable here
+        new_op = _make_op(
+            f"sched_chain_{len(spec.ops)}", fused,
+            [e.vid for e in spec.ext], spec.root,
+            kwargs={"kind": spec.kind, "schedule": dict(decision.config)})
+        graph.replace_op(spec.root, new_op)
+        block = graph.block
+        for o in spec.ops:
+            if o is not spec.root and o in block.ops:
+                block.ops.remove(o)
+        return True
+
+
+class ScheduleSearchPass(PatternRewritePass):
+    """Schedule-searched Pallas substitution over discovered subgraphs
+    (ROADMAP item 2; docs/SCHEDULE_SEARCH.md).  Runs after PallasFusionPass
+    in the Executor pipeline (FLAGS_schedule_search) so the named patterns
+    take their subgraphs first and fused ops act as chain breakers here."""
+
+    name = "schedule_search"
+
+    def __init__(self, fetch_vids=(), searcher=None):
+        super().__init__([ScheduleSearchPattern(searcher)],
+                         fetch_vids=fetch_vids)

@@ -215,3 +215,187 @@ def test_tune_drivers_execute_real_kernels(tmp_cache):
     cfg, _ = at.tune_swiglu(rows=64, cols=128, dtype="float32",
                             slug="testdev", iters=1, inner=1)
     assert 64 % cfg["rows_block"] == 0 and 128 % cfg["cols_block"] == 0
+
+
+@pytest.fixture()
+def fake_seed_dir(tmp_path, monkeypatch):
+    """Redirect AutotuneCache.seed_path into a tmp dir so precedence tests
+    never touch the installed package's ops/tuned/ (read-only on a
+    site-packages install)."""
+    d = tmp_path / "fake_seed"
+    d.mkdir()
+    monkeypatch.setattr(
+        at.AutotuneCache, "seed_path",
+        property(lambda self: str(d / f"{self.slug}.json")))
+    at._CACHES.clear()
+    yield d
+    at._CACHES.clear()
+
+
+def _write_seed(seed_dir, slug, data):
+    """Plant a synthetic checked-in seed cache for `slug` in the fake dir."""
+    path = os.path.join(str(seed_dir), f"{slug}.json")
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path
+
+
+def test_seed_vs_runtime_precedence_on_reload(tmp_cache, fake_seed_dir):
+    """A runtime-tuned entry for a key PRESENT in the seed must win on a
+    cold reload, while seed keys the runtime never touched must keep
+    following the (possibly updated) seed — the runtime file may not
+    fossilize a copy of the seed (regression: save() used to dump the
+    whole seed-merged table into FLAGS_autotune_cache_dir, so a later
+    seed update was silently shadowed by the stale copy)."""
+    slug = "seeddev"
+    k1, k2 = {"s": 1}, {"s": 2}
+    seed_path = _write_seed(fake_seed_dir, slug, {"k": {
+        at._key_str(k1): {"config": {"b": 1}, "ms": 1.0},
+        at._key_str(k2): {"config": {"b": 2}, "ms": 1.0},
+    }})
+    try:
+        at._CACHES.clear()
+        assert at.lookup("k", k1, slug=slug) == {"b": 1}  # seed serves
+        at.record("k", k1, {"b": 99}, 0.5, slug=slug)     # runtime retune
+        at._CACHES.clear()
+        assert at.lookup("k", k1, slug=slug) == {"b": 99}  # runtime wins
+        assert at.lookup("k", k2, slug=slug) == {"b": 2}
+        # the runtime file holds ONLY the runtime delta
+        runtime = json.load(open(os.path.join(str(tmp_cache), f"{slug}.json")))
+        assert at._key_str(k2) not in runtime.get("k", {})
+        # simulate a package seed update for the untouched key
+        _write_seed(fake_seed_dir, slug, {"k": {
+            at._key_str(k1): {"config": {"b": 1}, "ms": 1.0},
+            at._key_str(k2): {"config": {"b": 22}, "ms": 1.0},
+        }})
+        at._CACHES.clear()
+        assert at.lookup("k", k2, slug=slug) == {"b": 22}  # update visible
+        assert at.lookup("k", k1, slug=slug) == {"b": 99}  # runtime still wins
+    finally:
+        at._CACHES.clear()
+
+
+def test_unwritable_cache_dir_falls_back_to_user_cache(tmp_cache, monkeypatch):
+    """FLAGS_autotune_cache_dir pointing somewhere uncreatable (parent is a
+    regular file — even root cannot mkdir through it) must fall back to
+    the ~/.cache user path, and the entry must survive a cold reload while
+    the flag still points at the bad dir.  user_path is monkeypatched into
+    the pytest tmp dir so the test never touches the real home."""
+    slug = "fallbackdev"
+    blocker = os.path.join(str(tmp_cache), "blocker")
+    with open(blocker, "w") as f:
+        f.write("x")
+    fake_home = tmp_cache / "fake_home_cache"
+    monkeypatch.setattr(
+        at.AutotuneCache, "user_path",
+        property(lambda self: str(fake_home / f"{self.slug}.json")))
+    user_path = str(fake_home / f"{slug}.json")
+    paddle.set_flags(
+        {"FLAGS_autotune_cache_dir": os.path.join(blocker, "sub")})
+    at._CACHES.clear()
+    try:
+        c = at.cache(slug)
+        c.put("k", {"s": 1}, {"b": 7}, 0.1)
+        assert c.save() == user_path
+        assert os.path.exists(user_path)
+        at._CACHES.clear()
+        assert at.lookup("k", {"s": 1}, slug=slug) == {"b": 7}
+    finally:
+        at._CACHES.clear()
+
+
+def test_cost_model_table_keys_by_name_and_shape():
+    """OpCostModel.load()/save() round-trips per-shape entries: two shapes
+    of one op must not overwrite each other (regression: the table was
+    keyed by bare name, so the docstring's round-trip contract silently
+    kept only the last-measured shape)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.cost_model import OpCostModel
+
+    m = OpCostModel()
+    small = jnp.ones((8, 8), jnp.float32)
+    big = jnp.ones((32, 32), jnp.float32)
+    t_small = m.measure("mm", lambda a: a @ a, small, iters=1, warmup=0)
+    t_big = m.measure("mm", lambda a: a @ a, big, iters=1, warmup=0)
+    assert len(m.table) == 2  # both shapes present
+    k_small = m.table_key("mm", (small,))
+    k_big = m.table_key("mm", (big,))
+    assert m.query(k_small) == t_small and m.query(k_big) == t_big
+    # bare-name query on an ambiguous op is loud, not arbitrary
+    with pytest.raises(KeyError, match="shape"):
+        m.query("mm")
+    assert m.query("mm", default=0.5) == 0.5
+    # single-shape ops keep resolving by bare name (back-compat)
+    t1 = m.measure("tanh", jnp.tanh, small, iters=1, warmup=0)
+    assert m.query("tanh") == t1
+
+
+def test_cost_model_round_trip_preserves_shape_entries(tmp_path):
+    import jax.numpy as jnp
+
+    from paddle_tpu.cost_model import OpCostModel
+
+    m = OpCostModel()
+    a = jnp.ones((8, 4), jnp.float32)
+    b = jnp.ones((16, 4), jnp.float32)
+    m.measure("sum", lambda v: v.sum(), a, iters=1, warmup=0)
+    m.measure("sum", lambda v: v.sum(), b, iters=1, warmup=0)
+    p = tmp_path / "table.json"
+    m.save(str(p))
+    m2 = OpCostModel.load(str(p))
+    assert m2.table == m.table and len(m2.table) == 2
+
+
+def test_validate_tile_generic_budget():
+    """The generalized VMEM check shared by the kernel validators and the
+    schedule searcher's candidate prune."""
+    assert at.validate_tile(1024) is None
+    reason = at.validate_tile(at._VMEM_BUDGET + 1)
+    assert reason is not None and "VMEM" in reason
+    assert at.validate_tile(2048, budget=1024) is not None
+    # flash validator routes its VMEM tier through the shared check
+    r = at.validate_flash_tile(1024, 1024, 8192, 8192, 256)
+    assert r is not None and "VMEM" in r
+
+
+def test_prefix_era_runtime_dump_is_healed_on_load(tmp_cache, fake_seed_dir):
+    """A runtime cache file written by the PRE-fix save() (an UNMARKED full
+    copy of the seed-merged table) must never shadow a later seed update:
+    once the seed changes, a stale copy is value-indistinguishable from a
+    genuine retune, so unmarked files keep only keys the seed lacks
+    (seeded keys re-tune once).  Post-fix files carry the runtime marker
+    and keep the runtime-wins contract."""
+    slug = "healdev"
+    k1, k2, k3 = {"s": 1}, {"s": 2}, {"s": 3}
+    seed_entries = {
+        at._key_str(k1): {"config": {"b": 1}, "ms": 1.0},
+        at._key_str(k2): {"config": {"b": 2}, "ms": 1.0},
+    }
+    seed_path = _write_seed(fake_seed_dir, slug, {"k": dict(seed_entries)})
+    try:
+        # pre-fix era dump: whole seed copied + a retune of k1 + a key the
+        # seed never had (k3) — NO runtime marker
+        stale = {"k": dict(seed_entries)}
+        stale["k"][at._key_str(k1)] = {"config": {"b": 99}, "ms": 0.5}
+        stale["k"][at._key_str(k3)] = {"config": {"b": 3}, "ms": 0.5}
+        with open(os.path.join(str(tmp_cache), f"{slug}.json"), "w") as f:
+            json.dump(stale, f)
+        # seed update for the never-retuned key
+        _write_seed(fake_seed_dir, slug, {"k": {
+            at._key_str(k1): {"config": {"b": 1}, "ms": 1.0},
+            at._key_str(k2): {"config": {"b": 22}, "ms": 1.0},
+        }})
+        at._CACHES.clear()
+        assert at.lookup("k", k2, slug=slug) == {"b": 22}  # update visible
+        assert at.lookup("k", k3, slug=slug) == {"b": 3}   # unseeded key kept
+        assert at.lookup("k", k1, slug=slug) == {"b": 1}   # one-time retune cost
+        # a fresh retune writes a MARKED file whose entries win on reload
+        at.record("k", k1, {"b": 100}, 0.4, slug=slug)
+        raw = json.load(open(os.path.join(str(tmp_cache), f"{slug}.json")))
+        assert raw.get(at._RUNTIME_MARKER) == 1
+        assert at._key_str(k2) not in raw["k"]  # runtime delta only
+        at._CACHES.clear()
+        assert at.lookup("k", k1, slug=slug) == {"b": 100}
+    finally:
+        at._CACHES.clear()

@@ -121,6 +121,45 @@ def _battery() -> int:
     if diff:
         programs.append(diff), labels.append("pallas-fused-differential")
 
+    # schedule-searched fusion: matmul→bias→act→reduce tail (no named
+    # pattern matches it) through ScheduleSearchPass with a deterministic
+    # injected measure + scratch cache dir, then verify + differential
+    import shutil
+    import tempfile
+
+    from paddle_tpu.ops import autotune as _at
+    from paddle_tpu.static import schedule_search as _ss
+    from paddle_tpu.static.rewrite import ScheduleSearchPass
+
+    prev_cache_dir = paddle.get_flags("FLAGS_autotune_cache_dir")[
+        "FLAGS_autotune_cache_dir"]
+    scratch_dir = tempfile.mkdtemp(prefix="lint_ir_sched_")
+    paddle.set_flags({"FLAGS_autotune_cache_dir": scratch_dir})
+    _at._CACHES.clear()
+    try:
+        p = static.Program()
+        with static.program_guard(p):
+            xs = static.data("xs", [32, 16], "float32")
+            ws = static.data("ws", [16, 64], "float32")
+            bs = static.data("bs", [64], "float32")
+            hid = F.relu(paddle.matmul(xs, ws) + bs)
+            red = paddle.mean(hid, axis=-1, keepdim=True)
+        fetch = [red._vid]
+        reference = p.clone()
+        with _ss.measure_override(
+                lambda fn, args, label, config: 1.0 if config is None else 0.5):
+            n = ScheduleSearchPass(
+                fetch, searcher=_ss.ScheduleSearcher(budget=2)).apply(p)
+        print(f"schedule search substituted {n} subgraphs")
+        diff = differential_check(reference, p, fetch, raise_on_error=False)
+        programs.append(p), labels.append("schedule-searched")
+        if diff:
+            programs.append(diff), labels.append("schedule-searched-differential")
+    finally:
+        paddle.set_flags({"FLAGS_autotune_cache_dir": prev_cache_dir})
+        _at._CACHES.clear()
+        shutil.rmtree(scratch_dir, ignore_errors=True)
+
     # weight-only quant
     layer2 = nn.Linear(8, 8)
     p = static.Program()
