@@ -188,6 +188,33 @@ define_flag(
     "disabled for this device kind and never re-measured",
 )
 define_flag(
+    "FLAGS_verify_sharding",
+    False,
+    "Mesh lint for the distributed tier (static/mesh_lint.py): statically "
+    "analyze sharded computations — placement/axis congruence, collective "
+    "participation (incl. data-dependent-predicate collectives, the "
+    "deadlock/SIGSEGV class), use-after-donation, per-device HBM "
+    "estimates — around program passes, on the Executor's compile path, "
+    "and when TrainStep/ShardedTrainStep/GenerationEngine build "
+    "(docs/MESH_LINT.md).  Same contract as FLAGS_verify_programs: no "
+    "device collective is ever launched by the analysis",
+)
+define_flag(
+    "FLAGS_mesh_lint_replicated_mb",
+    8.0,
+    "Mesh-lint threshold (MiB): a tensor at least this large that ends up "
+    "fully replicated on a multi-device mesh is flagged as "
+    "replicated-giant with its per-device byte cost (static/mesh_lint.py)",
+)
+define_flag(
+    "FLAGS_mesh_lint_hbm_budget_gb",
+    0.0,
+    "Mesh-lint per-device HBM budget (GiB; 0 disables): the estimated "
+    "sharding-divided bytes per device (params + optimizer state + KV "
+    "pools) above this raises an over-budget violation "
+    "(static/mesh_lint.py, docs/MESH_LINT.md)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

@@ -129,6 +129,27 @@ class ProcessGroup:
             return jax.jit(f)
 
         fn = self._compiled(op_name, builder, value)
+        from paddle_tpu._core import flags as _flags
+
+        if _flags.flag("FLAGS_verify_sharding"):
+            # mesh lint the collective executable ABSTRACTLY before its
+            # first execution on this ring (per compiled signature): a bad
+            # pair permutation or mis-axised body is a named error here,
+            # not a rendezvous that strands the peer processes
+            key = ("linted", op_name, tuple(jnp.shape(value)),
+                   str(jnp.result_type(value)), tuple(self.ranks))
+            if key not in self._cache:
+                from paddle_tpu.static.mesh_lint import MeshLinter, _finish
+
+                aval = jax.ShapeDtypeStruct(
+                    (self.nranks,) + tuple(jnp.shape(value)),
+                    jnp.result_type(value))
+                linter = MeshLinter(mesh={"ring": self.nranks})
+                _finish(linter.lint_callable(
+                            fn, aval, site=f"ProcessGroup.{op_name}"),
+                        f"Mesh lint failed (ProcessGroup.{op_name})",
+                        raise_on_error=True)
+                self._cache[key] = True
         garr = self._global(value)
         # the execute blocks on peers joining: watchdog-guard it so a dead
         # rank produces a loud timeout (+ creation stack) instead of a

@@ -284,16 +284,41 @@ class PipelineStack(Layer):
         self._h_struct, self._out_struct = h_struct, out_struct
 
         x = h.reshape([M, B // M] + list(h.shape[1:]))
-        out = apply(
-            "pipeline_stack",
-            self._make_fn(M),
-            *self.stacked_parameters(),
-            *self._first_tensors,
-            *self._last_tensors,
-            x,
-            *bcast_t,
-        )
+        args = (*self.stacked_parameters(), *self._first_tensors,
+                *self._last_tensors, x, *bcast_t)
+        self._maybe_mesh_lint(M, args)
+        out = apply("pipeline_stack", self._make_fn(M), *args)
         return out.reshape([B] + list(out_struct.shape[1:]))
+
+    def _maybe_mesh_lint(self, M, args):
+        """FLAGS_verify_sharding hook: abstractly walk the assembled
+        pipeline program (ring ppermutes, the stage-0/last-stage conds,
+        the final psum) against the mesh BEFORE the first dispatch — a
+        ring built for the wrong stage count or a mis-axised hop is a
+        named error here, not an 8-device rendezvous hang.  Once per
+        (stack, microbatch count); the trace is abstract only."""
+        from paddle_tpu._core import flags as _flags
+
+        if not _flags.flag("FLAGS_verify_sharding"):
+            return
+        if getattr(self, "_mesh_linted_at", None) == M:
+            return
+        from paddle_tpu.static.mesh_lint import MeshLinter, _finish
+
+        avals = [jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
+                 for t in args]
+        linter = MeshLinter(mesh=self._mesh)
+        # Every built-in schedule lints clean as-is: the edge layers' VJP
+        # transpose-psums are hoisted OUT of the stage-predicated conds by
+        # construction (see pipe()'s pp-varying casts), so any
+        # conditional-collective that DOES surface here is a user block's
+        # own data-dependent collective — the real deadlock class.
+        violations = linter.lint_callable(
+            self._make_fn(M), *avals,
+            site=f"pipeline_stack[{self._schedule}]")
+        _finish(violations, "Mesh lint failed (PipelineStack)",
+                raise_on_error=True)
+        self._mesh_linted_at = M
 
     def _make_fn(self, M):
         S = self._n_stages

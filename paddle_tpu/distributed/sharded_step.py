@@ -161,6 +161,11 @@ class ShardedTrainStep(TrainStep):
                 self._state = self._collect_state()
                 self._place_state()
                 self._build()
+                # mesh lint BEFORE the first sharded dispatch: placements,
+                # collective congruence, donation, per-device HBM estimate
+                # — all abstract, so a dead-axis collective is a named
+                # error here, never an 8-device rendezvous hang
+                self._maybe_mesh_lint(batch)
             return loss
         with self.mesh.jax_mesh:
             return super().__call__(*batch)

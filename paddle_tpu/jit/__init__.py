@@ -314,8 +314,26 @@ class TrainStep:
             sig.append((tuple(v.shape), str(v.dtype)))
         return (tree, tuple(sig))
 
+    def _maybe_mesh_lint(self, batch):
+        """FLAGS_verify_sharding hook: statically lint the freshly built
+        step (placements, collective congruence, donation contract,
+        per-device memory estimate) before the first dispatch — the
+        abstract analysis never launches a collective, so a placement bug
+        fails HERE with a named site instead of hanging the mesh
+        (static/mesh_lint.py, docs/MESH_LINT.md)."""
+        from paddle_tpu._core import flags as _flags
+
+        if not _flags.flag("FLAGS_verify_sharding"):
+            return
+        from paddle_tpu.static.mesh_lint import lint_train_step
+
+        lint_train_step(self, *batch, raise_on_error=True)
+
     def __call__(self, *batch):
+        first_build = self._compiled is None
         self._ensure_built()
+        if first_build:
+            self._maybe_mesh_lint(batch)
         batch_vals = jax.tree_util.tree_map(_unwrap, batch, is_leaf=lambda x: isinstance(x, Tensor))
         key = rng_mod.next_key()
         if self.optimizer._lr_scheduler is not None:

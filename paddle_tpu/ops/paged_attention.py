@@ -37,6 +37,7 @@ __all__ = [
     "paged_chunk_attention",
     "pool_num_kv_heads",
     "pool_nbytes",
+    "pool_parts",
     "pool_stack",
     "pool_index",
 ]
@@ -87,6 +88,17 @@ def pool_num_kv_heads(cache):
 def pool_nbytes(cache):
     """Resident bytes of a paged pool (payload + scales for QuantPool)."""
     return cache.nbytes
+
+
+def pool_parts(cache):
+    """[(part_name, array)] leaves of a paged pool — ('payload', data) for
+    a plain pool, plus ('scale', scales) for a QuantPool.  The ONE place
+    that knows QuantPool's structure for per-leaf consumers (the mesh
+    lint's placement/byte accounting walks pools through this, so an
+    added QuantPool field is automatically covered there)."""
+    if isinstance(cache, QuantPool):
+        return [("payload", cache.data), ("scale", cache.scale)]
+    return [("payload", cache)]
 
 
 def pool_stack(pools):

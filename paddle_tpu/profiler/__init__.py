@@ -217,7 +217,8 @@ class Profiler:
         seen traffic."""
         from .statistics import (checkpoint_line, compile_cache_line,
                                  decode_line, dispatch_cache_line,
-                                 schedule_line, summary_text, verify_line)
+                                 mesh_line, schedule_line, summary_text,
+                                 verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -234,6 +235,9 @@ class Profiler:
         ver_line = verify_line(verify_stats())
         if ver_line:
             out = out + "\n" + ver_line
+        ml_line = mesh_line(mesh_lint_stats())
+        if ml_line:
+            out = out + "\n" + ml_line
         sched_line = schedule_line(schedule_search_stats())
         if sched_line:
             out = out + "\n" + sched_line
@@ -382,6 +386,22 @@ def verify_stats(reset: bool = False) -> dict:
     return _verify.verify_stats(reset=reset)
 
 
+def mesh_lint_stats(reset: bool = False) -> dict:
+    """Mesh-lint counters (FLAGS_verify_sharding; see static/mesh_lint.py
+    and docs/MESH_LINT.md): entries linted (programs + train steps +
+    serving engines) and failed, violations found, collectives and
+    sharding constraints congruence-checked, tensor placements validated,
+    donation-contract checks, per-device memory estimates computed, and
+    op fns the abstract tracer had to skip.  A healthy verified run shows
+    failed and violations at zero; nonzero means a placement/collective/
+    donation hazard reached a build path — the raised MeshLintError names
+    the site.  The mesh_lint module owns the counters — one schema, no
+    drift."""
+    from paddle_tpu.static import mesh_lint as _ml
+
+    return _ml.mesh_lint_stats(reset=reset)
+
+
 def schedule_search_stats(reset: bool = False) -> dict:
     """Pallas schedule-search counters (FLAGS_schedule_search; see
     static/schedule_search.py and docs/SCHEDULE_SEARCH.md): subgraphs
@@ -414,8 +434,8 @@ def checkpoint_stats(reset: bool = False) -> dict:
 
 
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
-            "decode_stats", "verify_stats", "schedule_search_stats",
-            "checkpoint_stats"]
+            "decode_stats", "verify_stats", "mesh_lint_stats",
+            "schedule_search_stats", "checkpoint_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -240,6 +240,26 @@ def verify_line(stats: dict) -> str:
     )
 
 
+def mesh_line(stats: dict) -> str:
+    """One-line rendering of the mesh-lint counters for Profiler.summary();
+    empty when FLAGS_verify_sharding never ran this process.  entries_failed
+    or violations nonzero is the red flag: a placement/collective/donation
+    hazard reached a build path (the error names the site)."""
+    if not (stats.get("entries_linted") or stats.get("collectives_checked")
+            or stats.get("placements_checked")):
+        return ""
+    return (
+        "Mesh lint: entries=%d failed=%d violations=%d; collectives=%d "
+        "constraints=%d placements=%d donation_checks=%d mem_estimates=%d "
+        "trace_skips=%d"
+        % (stats["entries_linted"], stats["entries_failed"],
+           stats["violations"], stats["collectives_checked"],
+           stats["constraints_checked"], stats["placements_checked"],
+           stats["donation_checks"], stats["memory_estimates"],
+           stats["trace_skips"])
+    )
+
+
 def schedule_line(stats: dict) -> str:
     """One-line rendering of the Pallas schedule-search counters for
     Profiler.summary(); empty when the search tier never ran this process.

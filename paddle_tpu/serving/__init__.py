@@ -340,9 +340,13 @@ class GenerationEngine:
             raise ValueError(
                 f"kv_cache_dtype must be 'bf16' or 'int8', got {kv_dt!r}")
         if kv_dt == "int8" and mesh is not None:
-            raise ValueError(
-                "int8 KV pools are not combined with the tensor-parallel "
-                "mesh engine yet; use kv_cache_dtype='bf16'")
+            raise NotImplementedError(
+                "kv_cache_dtype='int8' (FLAGS_kv_cache_dtype) does not "
+                "compose with the tensor-parallel mesh engine (mesh=) "
+                "yet: QuantPool's per-block-per-head scales would need "
+                "the same KV-head sharding as the pool payload.  Drop one "
+                "knob — kv_cache_dtype='bf16' with mesh=, or int8 pools "
+                "on a single device (mesh=None)")
         self._kv_dtype = kv_dt  # resolved ONCE: pools are allocated now
         dt = (jnp.int8 if kv_dt == "int8"
               else jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32)
@@ -409,6 +413,14 @@ class GenerationEngine:
             pa.pool_nbytes(p) for p in
             self._kpools + self._vpools
             + getattr(self, "_d_kpools", []) + getattr(self, "_d_vpools", []))
+        if _flags.flag("FLAGS_verify_sharding"):
+            # mesh lint at construction: param/pool placements, pool
+            # donation aliasing, per-device HBM estimate — abstract, so a
+            # replicated-pool blowup or a double-donated pool buffer fails
+            # loudly here, before the first decode dispatch
+            from paddle_tpu.static.mesh_lint import lint_engine
+
+            lint_engine(self, raise_on_error=True)
 
     # ------------------------------------------------------------ requests
     def has_work(self):

@@ -39,6 +39,13 @@ from .verify import (  # noqa: F401
     differential_check,
     verify_program,
 )
+from .mesh_lint import (  # noqa: F401
+    MeshLinter,
+    MeshLintError,
+    lint_engine,
+    lint_program,
+    lint_train_step,
+)
 from . import nn  # noqa: F401
 from .compat import *  # noqa: F401,F403
 from .compat import __all__ as _compat_all
@@ -67,6 +74,11 @@ __all__ = _compat_all + [
     "VerificationError",
     "verify_program",
     "differential_check",
+    "MeshLinter",
+    "MeshLintError",
+    "lint_program",
+    "lint_train_step",
+    "lint_engine",
 ]
 
 

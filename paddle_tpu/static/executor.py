@@ -169,6 +169,14 @@ class Executor:
                 from .verify import verify_program
 
                 verify_program(program, fetch_vids)
+            if flags.flag("FLAGS_verify_sharding"):
+                # mesh lint on the compile path: collective congruence of
+                # every recorded op + use-after-donation on the fetch set,
+                # abstractly — before XLA (or a dead-axis rendezvous) can
+                # turn a placement bug into a hang (docs/MESH_LINT.md)
+                from .mesh_lint import lint_program as _mesh_lint
+
+                _mesh_lint(program, fetch_vids, raise_on_error=True)
             # Prune to the fetch/write frontier (non-mutating): ops whose
             # outputs no fetch or state write needs don't execute.  Beyond
             # wasted compute, a dead duplicate of a collective-carrying

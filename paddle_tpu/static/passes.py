@@ -92,6 +92,7 @@ class ProgramPassManager:
         from paddle_tpu._core import flags
 
         verify = flags.flag("FLAGS_verify_programs")
+        mesh_lint = flags.flag("FLAGS_verify_sharding")
         if verify:
             from .verify import VerificationError, verify_program
 
@@ -101,19 +102,36 @@ class ProgramPassManager:
                 raise VerificationError(
                     e.violations,
                     header="Program invalid BEFORE pass pipeline") from None
+        if mesh_lint:
+            self._mesh_lint(program, "BEFORE pass pipeline")
         total = 0
         for p in self._passes:
             total += p.apply(program)
+            name = getattr(p, "name", type(p).__name__)
             if verify:
                 try:
                     verify_program(program, self._fetch_vids)
                 except VerificationError as e:
                     raise VerificationError(
                         e.violations,
-                        header=f"Program invalid after pass "
-                               f"{getattr(p, 'name', type(p).__name__)!r}",
+                        header=f"Program invalid after pass {name!r}",
                     ) from None
+            if mesh_lint:
+                self._mesh_lint(program, f"after pass {name!r}")
         return total
+
+    def _mesh_lint(self, program, where):
+        """Pass-boundary mesh lint (FLAGS_verify_sharding): the pass that
+        introduces a mis-axised collective or a stale-donation fetch is
+        named in the error, not discovered at dispatch."""
+        from .mesh_lint import MeshLintError, lint_program
+
+        try:
+            lint_program(program, self._fetch_vids, raise_on_error=True)
+        except MeshLintError as e:
+            raise MeshLintError(
+                e.violations,
+                header=f"Mesh lint failed {where}") from None
 
 
 def _pallas_fusion_factory(**kwargs):
@@ -292,7 +310,8 @@ def apply_pass(program, name, **kwargs):
     from paddle_tpu._core import flags
 
     pass_ = _REGISTRY[name](**kwargs)
-    if flags.flag("FLAGS_verify_programs"):
+    if (flags.flag("FLAGS_verify_programs")
+            or flags.flag("FLAGS_verify_sharding")):
         fetch = kwargs.get("fetch_vids") or ()
         return ProgramPassManager([pass_], fetch_vids=fetch).run(program)
     return pass_.apply(program)

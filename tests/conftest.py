@@ -22,7 +22,10 @@ jax.config.update("jax_platforms", "cpu")
 # float32 means float32 in numeric tests; TPU runs keep the fast MXU default.
 jax.config.update("jax_default_matmul_precision", "highest")
 
-# Single-core VM: persist XLA compilations across test runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+# Persist XLA compilations across test runs AND across the sharded
+# tier-1 runner's subprocesses (tools/run_tier1.py exports
+# PADDLE_TPU_TEST_CACHE_DIR so every shard warms the same cache).
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

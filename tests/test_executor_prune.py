@@ -28,9 +28,13 @@ def _trace_counting_flags():
     from paddle_tpu._core import flags
 
     prev = {"FLAGS_use_pallas_fusion": flags.flag("FLAGS_use_pallas_fusion"),
-            "FLAGS_verify_programs": flags.flag("FLAGS_verify_programs")}
+            "FLAGS_verify_programs": flags.flag("FLAGS_verify_programs"),
+            "FLAGS_verify_sharding": flags.flag("FLAGS_verify_sharding")}
     paddle.set_flags({"FLAGS_use_pallas_fusion": False,
-                      "FLAGS_verify_programs": False})
+                      "FLAGS_verify_programs": False,
+                      # mesh lint abstractly traces op fns on the compile
+                      # path too (static/mesh_lint.py)
+                      "FLAGS_verify_sharding": False})
     try:
         yield
     finally:

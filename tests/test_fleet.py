@@ -203,11 +203,12 @@ def test_fleet_e2e_mp_dp():
         dist.set_mesh(None)
 
 
-@pytest.mark.slow  # ShardedTrainStep over the in-process 8-dev XLA:CPU
-# communicator SIGSEGVs intermittently on jax 0.4.37 (same class as the
-# slow-marked test_dist_passes zero+pp+tp compose and the MoE semi-auto
-# train) — a mid-suite segfault kills the whole tier-1 process
-def test_group_sharded_levels():
+def _group_sharded_levels_body():
+    """Payload of test_group_sharded_levels, run in a crash-isolated
+    subprocess: ShardedTrainStep over the in-process 8-dev XLA:CPU
+    communicator SIGSEGVs intermittently on jax 0.4.37 (same class as the
+    slow-marked test_dist_passes zero+pp+tp compose and the MoE semi-auto
+    train).  As a module function it is importable by the worker."""
     from paddle_tpu.distributed.sharding import group_sharded_parallel
 
     mesh = ProcessMesh(np.arange(8).reshape(8), ["dp"])
@@ -233,6 +234,18 @@ def test_group_sharded_levels():
         assert losses[-1] < losses[0]
     finally:
         dist.set_mesh(None)
+
+
+def test_group_sharded_levels():
+    """Previously slow-marked: a mid-suite segfault killed the whole
+    tier-1 process.  The payload now runs in tools/run_tier1.py's
+    crash-isolated worker — a SIGSEGV is a contained retry (intermittent
+    infra), an assertion failure still fails immediately — so the ZeRO
+    stage-3 coverage is back in tier-1."""
+    from tools.run_tier1 import run_isolated_test
+
+    run_isolated_test("tests.test_fleet", "_group_sharded_levels_body",
+                      retries=2, timeout=300)
 
 
 def test_all_reduce_world_in_multi_axis_scope():

@@ -275,3 +275,26 @@ def test_int8_rejects_mesh_and_bad_dtype():
     m = _model()
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         GenerationEngine(m, num_blocks=8, kv_cache_dtype="fp8")
+
+
+def test_int8_plus_mesh_raises_typed_not_implemented():
+    """PR 6 caveat, made a CONTRACT: int8 pools + the TP mesh engine is a
+    typed NotImplementedError naming BOTH knobs and the workaround — not a
+    bare ValueError a caller can't distinguish from a typo'd dtype."""
+    from paddle_tpu.distributed import ProcessMesh
+
+    m = _model(seed=13)
+    mesh = ProcessMesh(np.arange(2).reshape(2), ["mp"])
+    with pytest.raises(NotImplementedError) as ei:
+        GenerationEngine(m, num_blocks=8, kv_cache_dtype="int8", mesh=mesh)
+    msg = str(ei.value)
+    # both knobs named, workaround stated
+    assert "kv_cache_dtype='int8'" in msg
+    assert "mesh=" in msg
+    assert "bf16" in msg
+    # NotImplementedError, not ValueError: the dtype itself is VALID
+    assert not isinstance(ei.value, ValueError)
+    # and each knob alone still works
+    GenerationEngine(m, num_blocks=8, kv_cache_dtype="int8")
+    GenerationEngine(_model(seed=13), num_blocks=8, kv_cache_dtype="bf16",
+                     mesh=mesh)
