@@ -1,0 +1,211 @@
+"""Multi-tenant LoRA serving: mixed-adapter batched decode vs per-adapter
+serial serving (docs/LORA.md; ROADMAP item 4).
+
+The claim measured: with adapter A/B matrices stacked on a slot axis and
+gathered per batch row INSIDE the jitted decode step, requests using
+DIFFERENT adapters (plus base-model requests at slot 0) share one
+macro-step — so a mixed-tenant workload decodes at batched throughput
+instead of paying one engine drain per adapter.
+
+- **batched**: ONE engine, every tenant's requests resident together;
+  each dispatch advances all of them.
+- **serial**: the same requests grouped by adapter and drained one group
+  at a time on an engine of the SAME max_batch capacity — the shape a
+  pack-less server is forced into (swap weights, serve one tenant's
+  traffic, swap again).  Engine capacity is the provisioned constant;
+  without cross-tenant batching most of each macro-step's lanes ride
+  masked, so the serial side pays the same per-dispatch cost for a
+  fraction of the tokens.
+
+Both sides are warmed (compile excluded — the contrast is steady-state
+serving), greedy streams must match bit-for-bit across the two shapes,
+and the reported value is batched/serial tokens-per-second.
+
+Prints ONE JSON line like the other benches.  vs_baseline is 0.0 until a
+reference point is recorded.  `--smoke` / PADDLE_TPU_BENCH_SMOKE shrinks
+sizes for CI (tests/test_bench_lora.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mk_adapter(model, cfg_kw, key_seed, rank, alpha):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.nn.lora import apply_lora, lora_state_dict
+
+    ft = LlamaForCausalLM(llama_tiny(**cfg_kw))
+    ft.set_state_dict(model.state_dict())
+    ft.eval()
+    apply_lora(ft, rank=rank, alpha=alpha)
+    key = jax.random.PRNGKey(key_seed)
+    for name, p in ft.named_parameters():
+        if name.endswith(("lora_A", "lora_B")):
+            key, sk = jax.random.split(key)
+            scale = 0.1 if name.endswith("lora_B") else 0.05
+            p._bind(jax.random.normal(sk, p._value.shape,
+                                      jnp.float32) * scale)
+    return lora_state_dict(ft)
+
+
+def _drain(eng):
+    while eng.has_work():
+        eng.step()
+
+
+def _serve(eng, requests, max_new):
+    """Admit `requests` ({rid: (prompt, adapter)}), drain, return streams
+    and emitted-token count."""
+    for rid, (prompt, adapter) in requests.items():
+        eng.add_request(rid, prompt, max_new_tokens=max_new, adapter=adapter)
+    _drain(eng)
+    out = {rid: eng.result(rid) for rid in requests}
+    return out, sum(len(v) for v in out.values())
+
+
+def main():
+    import jax
+
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    smoke = os.environ.get("PADDLE_TPU_BENCH_SMOKE") or "--smoke" in sys.argv
+    on_accel = jax.devices()[0].platform != "cpu"
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.seed(0)
+    if on_accel:
+        cfg_kw = dict(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=4096, dtype="bfloat16")
+        n_adapters, per_tenant, max_new, rank = 4, 2, 64, 8
+    elif smoke:
+        cfg_kw = dict(vocab_size=256, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=256,
+                      dtype="float32")
+        n_adapters, per_tenant, max_new, rank = 3, 1, 8, 4
+    else:
+        # CPU proxy: thin model so the measured contrast is the
+        # per-dispatch overhead batching amortizes (the TPU-relevant
+        # quantity), not raw matmul width
+        cfg_kw = dict(vocab_size=256, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=256,
+                      dtype="float32")
+        # decode-heavy workload: the contrast under measure is macro-step
+        # lane occupancy, and prefill (identical on both sides) dilutes it
+        n_adapters, per_tenant, max_new, rank = 3, 2, 128, 4
+    model = LlamaForCausalLM(llama_tiny(**cfg_kw))
+    model.eval()
+
+    adapters = {f"t{i}": _mk_adapter(model, cfg_kw, 10 + i, rank, 2 * rank)
+                for i in range(n_adapters)}
+    rng = np.random.default_rng(0)
+    V = cfg_kw["vocab_size"]
+    groups = {name: {} for name in [*adapters, "base"]}
+    for name, reqs in groups.items():
+        for j in range(per_tenant):
+            prompt = rng.integers(1, V, 8 + 2 * j).tolist()
+            reqs[f"{name}.{j}"] = (prompt,
+                                   None if name == "base" else name)
+    all_reqs = {rid: spec for reqs in groups.values()
+                for rid, spec in reqs.items()}
+    n_req = len(all_reqs)
+    eng_kw = dict(block_size=16, num_blocks=16 * n_req,
+                  adapters={"rank": rank, "max_adapters": n_adapters})
+
+    # every prompt length the workload uses — warmup must cover them all
+    # so neither side pays first-signature prefill compiles in its timed
+    # window (the eager dispatch cache is process-global: whoever runs a
+    # fresh shape first would foot the bill for everyone after)
+    prompt_lens = sorted({8 + 2 * j for j in range(per_tenant)})
+
+    def build(max_batch):
+        eng = GenerationEngine(model, max_batch=max_batch, **eng_kw)
+        for name, sd in adapters.items():
+            eng.register_adapter(name, sd, alpha=2 * rank)
+        # warmup: compile the macro-step + settle the eager prefill
+        # ramp so both sides time steady-state serving only
+        tenants = [None, *adapters]
+        warm = {}
+        k = 0
+        for _rep in range(2):
+            for ln in prompt_lens:
+                for t in tenants:
+                    warm[f"w{k}"] = (rng.integers(1, V, ln).tolist(), t)
+                    k += 1
+        # warm at the WORKLOAD's max_new: the per-request block-table
+        # geometry (pour/gather shapes) must match or the first timed
+        # side pays the fresh-shape compiles for both
+        _serve(eng, warm, max_new)
+        return eng
+
+    # ---- batched: every tenant in one continuous batch ------------------
+    eng = build(max_batch=n_req)
+    t0 = time.perf_counter()
+    batched_streams, batched_tokens = _serve(eng, all_reqs, max_new)
+    batched_s = time.perf_counter() - t0
+
+    # ---- serial: one adapter group at a time (the pack-less shape) ------
+    # same provisioned capacity, lanes beyond the group ride masked
+    serial_eng = build(max_batch=n_req)
+    serial_streams = {}
+    serial_tokens = 0
+    serial_s = 0.0
+    for name, reqs in groups.items():
+        t0 = time.perf_counter()
+        out, toks = _serve(serial_eng, reqs, max_new)
+        serial_s += time.perf_counter() - t0
+        serial_streams.update(out)
+        serial_tokens += toks
+
+    tokens_match = all(batched_streams[r] == serial_streams[r]
+                       for r in all_reqs)
+    batched_tps = batched_tokens / batched_s if batched_s else 0.0
+    serial_tps = serial_tokens / serial_s if serial_s else 0.0
+    speedup = batched_tps / serial_tps if serial_tps else 0.0
+
+    from paddle_tpu import profiler
+
+    lora = profiler.lora_stats()
+    print(json.dumps({
+        "metric": "serving_lora_mixed_batch_speedup",
+        "unit": "x",
+        "value": round(speedup, 3),
+        "vs_baseline": 0.0,
+        "tokens_match": tokens_match,
+        "detail": {
+            "adapters": n_adapters,
+            "requests": n_req,
+            "rank": rank,
+            "max_new_tokens": max_new,
+            "batched_tokens_per_sec": round(batched_tps, 2),
+            "serial_tokens_per_sec": round(serial_tps, 2),
+            "batched_wall_s": round(batched_s, 4),
+            "serial_wall_s": round(serial_s, 4),
+            "lora_stats": {k: lora[k] for k in
+                           ("swaps", "gather_dispatches", "slots_total")},
+            "device": str(jax.devices()[0].device_kind),
+            "smoke": bool(smoke),
+        },
+    }))
+    return 0 if tokens_match else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

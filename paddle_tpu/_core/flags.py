@@ -215,6 +215,16 @@ define_flag(
     "(static/mesh_lint.py, docs/MESH_LINT.md)",
 )
 define_flag(
+    "FLAGS_lora_max_adapters",
+    8,
+    "Usable adapter slots in a serving AdapterPack (nn/lora.py): a "
+    "GenerationEngine built with adapters= pre-allocates this many "
+    "hot-swappable LoRA slots PLUS the reserved slot 0 (the zero-adapter "
+    "base-model identity).  Geometry is fixed at engine construction — "
+    "register_adapter/evict_adapter mutate slot contents only, so "
+    "compiled decode steps never recompile on a swap (docs/LORA.md)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

@@ -334,12 +334,52 @@ class LlamaModel(nn.Layer):
         return self.norm(h)
 
 
-def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens):
+def _proj_lora(proj, x, ad, name, slots, scaling):
+    """A target projection's raw output, plus its gathered per-row LoRA
+    delta when the adapter pack covers it (nn/lora.py lora_delta).  x is
+    the projection's input Tensor; returns a raw [B, T, out] array."""
+    out = proj(x)._value
+    if ad is not None and name in ad:
+        from paddle_tpu.nn.lora import lora_delta
+
+        out = out + lora_delta(x._value, *ad[name], slots, scaling)
+    return out
+
+
+def _mlp_paged(mlp, x, ad, slots, scaling):
+    """layer.mlp(x) with optional LoRA deltas on gate_up/down — mirrors
+    LlamaMLP.forward so the no-adapter decode program is unchanged."""
+    if ad is None or ("mlp.gate_up_proj" not in ad
+                      and "mlp.down_proj" not in ad):
+        return mlp(x)
+    gate_up = Tensor(_proj_lora(mlp.gate_up_proj, x, ad, "mlp.gate_up_proj",
+                                slots, scaling))
+    gate, up = paddle.split(gate_up, 2, axis=-1)
+    from paddle_tpu import ops as _ops
+
+    if _ops.use_pallas():
+        import paddle_tpu.incubate.nn.functional as _FF
+
+        act = _FF.swiglu(gate, up)
+    else:
+        act = F.silu(gate) * up
+    return Tensor(_proj_lora(mlp.down_proj, act, ad, "mlp.down_proj",
+                             slots, scaling))
+
+
+def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens,
+                        ad=None, slots=None, scaling=None):
     """One decoder layer on one new token against the paged KV pools.
 
     h: Tensor [B, 1, D]; kc/vc: [num_blocks, Nkv, bs, H] pools (raw arrays);
     tables: [B, max_blocks]; lens: [B] lengths INCLUDING this token.
     Returns (Tensor h', kc', vc').
+
+    ad/slots/scaling: optional multi-tenant LoRA state — ad maps target
+    paths to THIS layer's slot-stacked (A [S, in, r], B [S, r, out]);
+    slots [B] picks each batch row's adapter slot and scaling [B] its
+    alpha/rank, so mixed-adapter batches decode in this ONE program
+    (slot 0 gathers zeros — the exact base-model identity; nn/lora.py).
     """
     from paddle_tpu.ops import paged_attention as pa
 
@@ -348,30 +388,35 @@ def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens):
     x = layer.input_layernorm(h)
     b = int(x.shape[0])
     n, nkv, hd = attn.num_heads, attn.num_kv_heads, attn.head_dim
-    qv = attn.q_proj(x)._value.reshape(b, n, hd)
-    kv_ = attn.k_proj(x)._value.reshape(b, nkv, hd)
-    vv = attn.v_proj(x)._value.reshape(b, nkv, hd)
+    qv = _proj_lora(attn.q_proj, x, ad, "self_attn.q_proj", slots,
+                    scaling).reshape(b, n, hd)
+    kv_ = _proj_lora(attn.k_proj, x, ad, "self_attn.k_proj", slots,
+                     scaling).reshape(b, nkv, hd)
+    vv = _proj_lora(attn.v_proj, x, ad, "self_attn.v_proj", slots,
+                    scaling).reshape(b, nkv, hd)
     pos = lens - 1
     qv = pa.rope_rotate_by_position(qv, cos, sin, pos)
     kv_ = pa.rope_rotate_by_position(kv_, cos, sin, pos)
     kc = pa.paged_write(kc, kv_, tables, pos)
     vc = pa.paged_write(vc, vv, tables, pos)
     o = pa.paged_decode_attention(qv, kc, vc, tables, lens)
-    out = attn.o_proj(Tensor(o.reshape(b, 1, n * hd)))
+    out = Tensor(_proj_lora(attn.o_proj, Tensor(o.reshape(b, 1, n * hd)),
+                            ad, "self_attn.o_proj", slots, scaling))
     h = residual + out
     residual = h
     h2 = layer.post_attention_layernorm(h)
-    h2 = layer.mlp(h2)
+    h2 = _mlp_paged(layer.mlp, h2, ad, slots, scaling)
     return residual + h2, kc, vc
 
 
-def _decode_layer_paged_chunk(layer, h, cos, sin, kc, vc, tables, lens):
+def _decode_layer_paged_chunk(layer, h, cos, sin, kc, vc, tables, lens,
+                              ad=None, slots=None, scaling=None):
     """One decoder layer on a T-token chunk against the paged KV pools
     (speculative verify / chunked paged decode).
 
     h: Tensor [B, T, D]; lens: [B] lengths INCLUDING all T chunk tokens.
     Chunk token j sits at global position lens - T + j.  Returns
-    (Tensor h', kc', vc')."""
+    (Tensor h', kc', vc').  ad/slots/scaling as in _decode_layer_paged."""
     from paddle_tpu.ops import paged_attention as pa
 
     attn = layer.self_attn
@@ -379,25 +424,30 @@ def _decode_layer_paged_chunk(layer, h, cos, sin, kc, vc, tables, lens):
     x = layer.input_layernorm(h)
     b, t = int(x.shape[0]), int(x.shape[1])
     n, nkv, hd = attn.num_heads, attn.num_kv_heads, attn.head_dim
-    qv = attn.q_proj(x)._value.reshape(b, t, n, hd)
-    kv_ = attn.k_proj(x)._value.reshape(b, t, nkv, hd)
-    vv = attn.v_proj(x)._value.reshape(b, t, nkv, hd)
+    qv = _proj_lora(attn.q_proj, x, ad, "self_attn.q_proj", slots,
+                    scaling).reshape(b, t, n, hd)
+    kv_ = _proj_lora(attn.k_proj, x, ad, "self_attn.k_proj", slots,
+                     scaling).reshape(b, t, nkv, hd)
+    vv = _proj_lora(attn.v_proj, x, ad, "self_attn.v_proj", slots,
+                    scaling).reshape(b, t, nkv, hd)
     pos = lens[:, None] - t + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
     qv = pa.rope_rotate_chunk(qv, cos, sin, pos)
     kv_ = pa.rope_rotate_chunk(kv_, cos, sin, pos)
     kc = pa.paged_write_chunk(kc, kv_, tables, pos)
     vc = pa.paged_write_chunk(vc, vv, tables, pos)
     o = pa.paged_chunk_attention(qv, kc, vc, tables, lens)
-    out = attn.o_proj(Tensor(o.reshape(b, t, n * hd)))
+    out = Tensor(_proj_lora(attn.o_proj, Tensor(o.reshape(b, t, n * hd)),
+                            ad, "self_attn.o_proj", slots, scaling))
     h = residual + out
     residual = h
     h2 = layer.post_attention_layernorm(h)
-    h2 = layer.mlp(h2)
+    h2 = _mlp_paged(layer.mlp, h2, ad, slots, scaling)
     return residual + h2, kc, vc
 
 
 def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
-                         chunk=False):
+                         chunk=False, adapters=None, slots=None,
+                         scaling=None):
     """Run every decoder layer's paged decode step over per-layer pools.
 
     ``layers`` is either a LayerList (unrolled view loop — the program
@@ -412,6 +462,12 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
     so the N-pool concat is paid once per dispatch, not once per token.
     ``chunk`` selects the T-token variant (speculative verify / macro-step
     internals share it).  Returns (h, pools) in the layout given.
+
+    adapters/slots/scaling: multi-tenant LoRA — ``adapters`` maps target
+    paths to slot-stacked (A [L, S, in, r], B [L, S, r, out]) with a
+    LEADING LAYER AXIS; on the LayerStack path the pack rides the decode
+    scan as extra per-layer xs, on the view loop each layer indexes its
+    slice.  slots [B] / scaling [B] are per-batch-row (nn/lora.py).
     """
     from paddle_tpu.ops import paged_attention as pa
 
@@ -422,19 +478,30 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
         stacked_in = not isinstance(kpools, (list, tuple))
         k_state = kpools if stacked_in else pa.pool_stack(kpools)
         v_state = vpools if stacked_in else pa.pool_stack(vpools)
-        h, k_state, v_state = layers.decode_scan(
-            lambda layer, hh, kc, vc: step(
-                layer, hh, cos, sin, kc, vc, tables, lens),
-            h, k_state, v_state)
+        if adapters is None:
+            h, k_state, v_state = layers.decode_scan(
+                lambda layer, hh, kc, vc: step(
+                    layer, hh, cos, sin, kc, vc, tables, lens),
+                h, k_state, v_state)
+        else:
+            h, k_state, v_state = layers.decode_scan(
+                lambda layer, hh, kc, vc, ad: step(
+                    layer, hh, cos, sin, kc, vc, tables, lens,
+                    ad=ad, slots=slots, scaling=scaling),
+                h, k_state, v_state, extra=adapters)
         if stacked_in:
             return h, k_state, v_state
         n = len(layers)
         return (h, [pa.pool_index(k_state, i) for i in range(n)],
                 [pa.pool_index(v_state, i) for i in range(n)])
+    import jax
+
     new_k, new_v = [], []
     for li, layer in enumerate(layers):
+        ad_l = (None if adapters is None else
+                jax.tree_util.tree_map(lambda a: a[li], adapters))
         h, kc, vc = step(layer, h, cos, sin, kpools[li], vpools[li],
-                         tables, lens)
+                         tables, lens, ad=ad_l, slots=slots, scaling=scaling)
         new_k.append(kc)
         new_v.append(vc)
     return h, new_k, new_v

@@ -5,5 +5,7 @@ from . import initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer, ParamAttr  # noqa: F401
+from . import lora  # noqa: F401,E402
+from .lora import AdapterPack, LoRALinear, apply_lora, lora_state_dict  # noqa: F401,E402
 
 from . import quant  # noqa: F401,E402

@@ -280,7 +280,19 @@ class Layer:
                 return None
         return layer
 
-    def set_state_dict(self, state_dict: dict, use_structured_name: bool = True):
+    def set_state_dict(self, state_dict: dict, use_structured_name: bool = True,
+                       allow_partial: bool = False):
+        """Load ``state_dict`` into this layer; returns
+        ``(missing, unexpected)`` key lists.
+
+        ``allow_partial=True`` is the documented PARTIAL-load path for
+        subset checkpoints — e.g. an adapter-only LoRA state dict
+        (``nn.lora.lora_state_dict``) loading into a full model: missing
+        own keys are expected and tolerated silently, but UNEXPECTED
+        checkpoint keys still raise — a key this model cannot place is a
+        wrong checkpoint, not a smaller one.  The default (False) keeps
+        the exact historical contract: nothing raises, callers inspect
+        the returned lists."""
         own = self.state_dict()
         if any(name not in state_dict for name in own):
             # stacked (LayerStack) vs per-layer decoder layouts interconvert
@@ -289,7 +301,16 @@ class Layer:
             from .stack import adapt_state_dict
 
             state_dict = adapt_state_dict(self, state_dict, own=own)
-        missing, unexpected = [], []
+        unexpected = [name for name in state_dict if name not in own]
+        if allow_partial and unexpected:
+            # checked BEFORE any load: a wrong checkpoint must not leave
+            # the model half-mutated
+            raise ValueError(
+                "set_state_dict(allow_partial=True): checkpoint holds "
+                f"{len(unexpected)} key(s) this layer cannot place, e.g. "
+                f"{unexpected[:3]} — partial load tolerates MISSING keys, "
+                "never unknown ones")
+        missing = []
         for name, t in own.items():
             if name in state_dict:
                 src = state_dict[name]
@@ -300,9 +321,6 @@ class Layer:
                 t.set_value(jnp.copy(arr))
             else:
                 missing.append(name)
-        for name in state_dict:
-            if name not in own:
-                unexpected.append(name)
         return missing, unexpected
 
     load_dict = set_state_dict

@@ -328,7 +328,7 @@ class LayerStack(Layer):
         return carry
 
     # ------------------------------------------------------- decode scan
-    def decode_scan(self, body, h, k_state, v_state):
+    def decode_scan(self, body, h, k_state, v_state, extra=None):
         """Scan the stack ONCE over stacked per-layer KV state (the paged
         decode tier): ``body(layer, h, kc, vc) -> (h, kc, vc)`` is the
         per-layer decode step (e.g. ``models.llama._decode_layer_paged``
@@ -336,6 +336,14 @@ class LayerStack(Layer):
         ``k_state``/``v_state`` are raw arrays with a leading layer axis
         ``[N, ...]`` riding the scan as xs/ys.  Returns
         ``(h, new_k_state, new_v_state)`` in the same stacked layout.
+
+        ``extra``: an optional READ-ONLY pytree of per-layer state — every
+        leaf carries the same leading ``[N, ...]`` layer axis and rides
+        the scan as additional xs (sliced per layer, never returned as
+        ys).  When given, the body takes a fourth argument:
+        ``body(layer, h, kc, vc, extra_slice)``.  The multi-tenant LoRA
+        AdapterPack threads its slot-stacked A/B matrices through here
+        (nn/lora.py, docs/LORA.md).
 
         This is the serving-side counterpart of :meth:`forward`: the paged
         KV pools thread through the scan as per-layer state, so a decode
@@ -353,15 +361,22 @@ class LayerStack(Layer):
                       for k in self._stack_keys]
         if not isinstance(h, Tensor):
             h = Tensor(jnp.asarray(h))
+        has_extra = extra is not None
 
         def scan_body(carry, xs):
-            slices, kc, vc = xs
+            if has_extra:
+                slices, kc, vc, ex = xs
+            else:
+                slices, kc, vc = xs
             originals = [reg[short] for reg, short in slots]
             try:
                 for (reg, short), v in zip(slots, slices):
                     reg[short] = Tensor(v)
                 with core_ag.no_grad():
-                    out, kc, vc = body(template, Tensor(carry), kc, vc)
+                    if has_extra:
+                        out, kc, vc = body(template, Tensor(carry), kc, vc, ex)
+                    else:
+                        out, kc, vc = body(template, Tensor(carry), kc, vc)
                 if not isinstance(out, Tensor):
                     raise TypeError(
                         "decode_scan body must return (Tensor, kc, vc); "
@@ -371,8 +386,9 @@ class LayerStack(Layer):
                 for (reg, short), v in zip(slots, originals):
                     reg[short] = v
 
-        carry, (new_k, new_v) = jax.lax.scan(
-            scan_body, h._value, (tuple(state_vals), k_state, v_state))
+        xs = ((tuple(state_vals), k_state, v_state, extra) if has_extra
+              else (tuple(state_vals), k_state, v_state))
+        carry, (new_k, new_v) = jax.lax.scan(scan_body, h._value, xs)
         return Tensor(carry), new_k, new_v
 
 

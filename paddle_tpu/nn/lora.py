@@ -1,0 +1,475 @@
+"""LoRA: low-rank adapters for fine-tuning and multi-tenant serving.
+
+Reference lineage: PaddleNLP's LoRA/PEFT tier over ``paddle.nn`` — the
+headline parameter-efficient scenario beyond a single base model is ONE
+base model serving thousands of tenants, each with its own low-rank
+adapter (Hu et al., "LoRA: Low-Rank Adaptation of Large Language Models").
+
+Two faces, one math (``h += (x @ A) @ B * alpha/rank``):
+
+- **Training** (:class:`LoRALinear`, :func:`apply_lora`): surgery replaces
+  target ``nn.Linear`` layers in place, keeping their state-dict keys
+  (``q_proj.weight`` stays ``q_proj.weight``; the adapter adds
+  ``q_proj.lora_A`` / ``q_proj.lora_B``), freezes everything but the
+  adapters, and fine-tunes through the ordinary TrainStep.  ``merge()`` /
+  ``unmerge()`` fold the adapter into the base weight for adapter-free
+  inference; :func:`lora_state_dict` extracts the adapter-only checkpoint
+  that CheckpointManager saves/restores (restore prunes the request to
+  saved keys, so an adapter-only checkpoint loads into a full model).
+
+- **Serving** (:class:`AdapterPack`): up to ``FLAGS_lora_max_adapters``
+  adapters' A/B matrices stacked on a leading SLOT axis, per decoder layer
+  — exactly the ``nn.LayerStack`` stacked-leading-axis trick applied to
+  adapters.  The pack threads through ``LayerStack.decode_scan`` as
+  per-layer xs, the jitted decode step gathers each batch row's A/B by a
+  slot-index vector, and a macro-step full of DIFFERENT tenants decodes in
+  ONE compiled dispatch.  Slot 0 is reserved as the zero adapter (A = B =
+  scaling = 0): base-model requests ride the same program as an exact
+  identity.  Hot-swapping mutates pack *contents* (device scatter into a
+  pre-allocated slot); the pack *geometry* (slot count, rank, targets)
+  never changes, so compiled decode steps are reused across swaps — zero
+  recompiles (serving.GenerationEngine, docs/LORA.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu._core import flags as _flags
+from paddle_tpu._core.tensor import Parameter, Tensor
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.common import Linear
+from paddle_tpu.nn.layer.stack import LayerStack
+
+__all__ = [
+    "LoRALinear",
+    "AdapterPack",
+    "apply_lora",
+    "lora_state_dict",
+    "parse_adapter_state_dict",
+    "adapter_prefill_scope",
+    "lora_delta",
+    "LLAMA_TARGETS",
+]
+
+# Leaf layer names apply_lora targets by default: the attention q/k/v/out
+# and MLP projections of models/llama.py and models/gpt.py.
+DEFAULT_TARGET_NAMES = (
+    "q_proj", "k_proj", "v_proj", "o_proj", "out_proj",
+    "gate_up_proj", "down_proj", "fc_in", "fc_out",
+)
+
+# Per-decoder-layer projection paths the serving AdapterPack covers (the
+# engine's decode step knows exactly these injection points —
+# models/llama._decode_layer_paged).
+LLAMA_TARGETS = (
+    "self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj",
+    "self_attn.o_proj", "mlp.gate_up_proj", "mlp.down_proj",
+)
+
+
+class LoRALinear(Linear):
+    """``nn.Linear`` plus a rank-``r`` adapter: ``y = xW + b + (x A) B s``
+    with ``s = alpha / rank``.
+
+    Subclasses Linear ON PURPOSE: the base weight keeps its registry name
+    (``weight``/``bias``), so swapping a Linear for a LoRALinear changes
+    NO existing state-dict keys — base checkpoints keep loading, TP
+    placement walks keep finding ``weight`` — and only adds
+    ``lora_A``/``lora_B``.  ``lora_B`` initializes to zero (the adapted
+    model starts exactly at the base model); ``lora_A`` draws a small
+    normal so gradients flow from step one.
+    """
+
+    def __init__(self, in_features, out_features, rank, alpha=None,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         bias_attr=bias_attr, name=name)
+        rank = int(rank)
+        if rank < 1:
+            raise ValueError(f"LoRA rank must be >= 1, got {rank}")
+        self.rank = rank
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self.scaling = self.alpha / self.rank
+        self.merged = False
+        self.lora_A = self.create_parameter(
+            [in_features, rank], default_initializer=I.Normal(0.0, 0.02))
+        self.lora_B = self.create_parameter(
+            [rank, out_features], default_initializer=I.Constant(0.0))
+
+    @classmethod
+    def from_linear(cls, linear: Linear, rank, alpha=None) -> "LoRALinear":
+        """Wrap an existing Linear: the base ``weight``/``bias`` Parameter
+        OBJECTS are adopted (no copy — optimizer identity and shardings
+        survive) and the adapter params are created in the weight's
+        dtype."""
+        m = cls(linear.in_features, linear.out_features, rank, alpha=alpha,
+                bias_attr=False if linear.bias is None else None)
+        m._parameters["weight"] = linear.weight
+        if linear.bias is not None:
+            m._parameters["bias"] = linear.bias
+        dt = linear.weight._value.dtype
+        for key in ("lora_A", "lora_B"):
+            p = m._parameters[key]
+            p._bind(p._value.astype(dt))
+        m.training = linear.training
+        return m
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.merged:
+            return out
+        return out + F.linear(F.linear(x, self.lora_A), self.lora_B) * self.scaling
+
+    def _delta_weight(self):
+        return (self.lora_A._value @ self.lora_B._value) * jnp.asarray(
+            self.scaling, self.lora_A._value.dtype)
+
+    def merge(self):
+        """Fold ``A @ B * s`` into the base weight (adapter-free serving of
+        the adapted function).  Idempotent."""
+        if not self.merged:
+            self.weight._bind(
+                self.weight._value
+                + self._delta_weight().astype(self.weight._value.dtype))
+            self.merged = True
+        return self
+
+    def unmerge(self):
+        """Inverse of :meth:`merge`."""
+        if self.merged:
+            self.weight._bind(
+                self.weight._value
+                - self._delta_weight().astype(self.weight._value.dtype))
+            self.merged = False
+        return self
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, rank={self.rank}, "
+                f"alpha={self.alpha}")
+
+
+def apply_lora(model, rank, alpha=None, targets=None, freeze_base=True):
+    """Replace every target ``nn.Linear`` in ``model`` with a
+    :class:`LoRALinear` (in place) and freeze the base parameters.
+
+    ``targets``: leaf layer names to adapt (default: the llama/gpt
+    attention q/k/v/out + MLP projections).  ``freeze_base=True`` sets
+    ``stop_gradient`` on every pre-existing parameter so a TrainStep over
+    the model fine-tunes ONLY the adapters (frozen-base contract).
+    Returns the model.
+
+    Raises on ``nn.LayerStack`` decoder stacks: the stack's parameters are
+    already stacked/fused, so per-layer surgery cannot reach them — build
+    the fine-tuning model with ``fuse_layer_stack=False`` (serving a
+    LayerStack engine with adapters goes through :class:`AdapterPack`
+    instead, which IS the stacked form).
+    """
+    targets = tuple(targets) if targets is not None else DEFAULT_TARGET_NAMES
+    for path, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, LayerStack):
+            raise ValueError(
+                f"apply_lora: {path or 'model'!r} is an nn.LayerStack "
+                "(fuse_layer_stack/FLAGS_scan_layers); per-layer adapter "
+                "surgery needs unstacked layers — build the fine-tune "
+                "model with fuse_layer_stack=False (serving uses "
+                "AdapterPack, the stacked form)")
+    if freeze_base:
+        for p in model.parameters():
+            p.stop_gradient = True
+    replaced = 0
+    for _path, sub in model.named_sublayers(include_self=True):
+        for name, child in list(sub._sub_layers.items()):
+            if (name in targets and isinstance(child, Linear)
+                    and not isinstance(child, LoRALinear)):
+                sub._sub_layers[name] = LoRALinear.from_linear(
+                    child, rank, alpha=alpha)
+                replaced += 1
+    if not replaced:
+        raise ValueError(
+            f"apply_lora: no Linear layer named any of {targets} found")
+    return model
+
+
+def lora_state_dict(model) -> dict:
+    """The adapter-only state dict: every ``*.lora_A`` / ``*.lora_B``
+    entry of ``model.state_dict()`` — the checkpoint a fine-tune saves
+    (CheckpointManager accepts a plain dict) and a fresh serving engine
+    registers via ``GenerationEngine.register_adapter``."""
+    out = {k: v for k, v in model.state_dict().items()
+           if k.rsplit(".", 1)[-1] in ("lora_A", "lora_B")}
+    if not out:
+        raise ValueError("lora_state_dict: model has no LoRA parameters "
+                         "(run apply_lora first)")
+    return out
+
+
+_LAYER_KEY = re.compile(r"(?:^|\.)layers\.(\d+)\.(.+)\.lora_([AB])$")
+
+
+def parse_adapter_state_dict(state_dict, num_layers, targets, rank):
+    """Adapter checkpoint -> per-target stacked arrays for an AdapterPack.
+
+    Keys like ``model.layers.{i}.self_attn.q_proj.lora_A`` group into
+    ``{target: (A [L, in, r], B [L, r, out])}``.  Targets absent from the
+    checkpoint (an adapter trained on a subset of projections) come back
+    as zeros; keys naming a projection OUTSIDE ``targets`` are loud — the
+    pack has no injection point for them.
+    """
+    per = {}
+    for key, val in state_dict.items():
+        m = _LAYER_KEY.search(key)
+        if m is None:
+            if key.rsplit(".", 1)[-1] in ("lora_A", "lora_B"):
+                raise ValueError(
+                    f"adapter key {key!r} does not name a decoder layer "
+                    "(expected ...layers.<i>.<proj>.lora_A/B)")
+            continue
+        li, target, which = int(m.group(1)), m.group(2), m.group(3)
+        if target not in targets:
+            raise ValueError(
+                f"adapter key {key!r} targets {target!r}, which this "
+                f"pack's geometry does not cover (targets={targets})")
+        if li >= num_layers:
+            raise ValueError(
+                f"adapter key {key!r}: layer {li} >= num_layers {num_layers}")
+        # normalize through HOST numpy: source tensors arrive with varying
+        # jax placement/commitment (freshly trained = uncommitted device,
+        # checkpoint-restored = committed unpinned_host, ...) and a
+        # committed operand is a DIFFERENT executable signature — the
+        # install scatter would recompile per source kind where a warm
+        # hot-swap must not.  Registration is a rare control-plane op;
+        # one host round-trip here buys one stable signature forever.
+        arr = np.asarray(val._value if isinstance(val, Tensor) else val)
+        r = arr.shape[-1] if which == "A" else arr.shape[0]
+        if r != rank:
+            raise ValueError(
+                f"adapter rank {r} (key {key!r}) != pack rank {rank} — "
+                "pack geometry is fixed at engine construction")
+        per.setdefault(target, {})[(li, which)] = arr
+    out = {}
+    for target, entries in per.items():
+        # A and B must pair up PER LAYER: a layer holding only one half
+        # (truncated/corrupt checkpoint) would otherwise zero-fill the
+        # other and silently serve a crippled delta
+        layers_a = {i for (i, w) in entries if w == "A"}
+        layers_b = {i for (i, w) in entries if w == "B"}
+        if layers_a != layers_b:
+            odd = sorted(layers_a ^ layers_b)
+            raise ValueError(
+                f"adapter state dict for {target!r} is lopsided: layers "
+                f"{odd} hold only one of lora_A/lora_B — every layer "
+                "must carry both (or neither)")
+        a0 = next(v for (_, w), v in entries.items() if w == "A")
+        b0 = next(v for (_, w), v in entries.items() if w == "B")
+        # stacked in numpy, converted once: uncommitted default-placement
+        # arrays, identical signature for every adapter source
+        A = jnp.asarray(np.stack([entries.get((i, "A"), np.zeros_like(a0))
+                                  for i in range(num_layers)]))
+        B = jnp.asarray(np.stack([entries.get((i, "B"), np.zeros_like(b0))
+                                  for i in range(num_layers)]))
+        out[target] = (A, B)
+    if not out:
+        raise ValueError("adapter state dict holds no lora_A/lora_B keys")
+    return out
+
+
+def _resolve_sublayer(layer, path):
+    out = layer
+    for part in path.split("."):
+        out = out._sub_layers[part]
+    return out
+
+
+class AdapterPack:
+    """Stacked multi-tenant adapter storage for the serving decode step.
+
+    Per target projection ``t``: ``A[t]`` of shape ``[L, S, in, r]`` and
+    ``B[t]`` of shape ``[L, S, r, out]`` (L decoder layers, S slots), plus
+    ``scaling`` ``[S]`` float32 (``alpha/rank`` per slot).  Slot 0 is the
+    reserved zero adapter — base-model identity.  ``S - 1`` usable slots
+    come from ``max_adapters`` (default ``FLAGS_lora_max_adapters``).
+
+    The GEOMETRY (L, S, rank, targets, dtype) is frozen at construction;
+    :meth:`set_slot` / :meth:`clear_slot` mutate CONTENTS only (device
+    scatter at a slot index), so every array keeps its shape and a jitted
+    step taking the pack as arguments never recompiles on a swap.
+    """
+
+    def __init__(self, model, rank, alpha=None, max_adapters=None,
+                 targets=None):
+        layers = model.model.layers
+        self.num_layers = len(layers)
+        self.rank = int(rank)
+        if self.rank < 1:
+            raise ValueError(f"AdapterPack rank must be >= 1, got {rank}")
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        n_ad = (int(max_adapters) if max_adapters is not None
+                else int(_flags.flag("FLAGS_lora_max_adapters")))
+        if n_ad < 1:
+            raise ValueError(
+                f"max_adapters (FLAGS_lora_max_adapters) must be >= 1, "
+                f"got {n_ad}")
+        self.num_slots = n_ad + 1  # slot 0 = reserved zero adapter
+        self.targets = tuple(targets) if targets is not None else LLAMA_TARGETS
+        blk = layers[0]
+        self.ab = {}
+        # one zero slot template per target, built NOW: set_slot (omitted
+        # targets) and clear_slot scatter these instead of minting fresh
+        # jnp.zeros at swap time — hot-swap stays compile-free
+        self._zero_slot = {}
+        L, S, r = self.num_layers, self.num_slots, self.rank
+        for t in self.targets:
+            lin = _resolve_sublayer(blk, t)
+            if not isinstance(lin, Linear):
+                raise TypeError(
+                    f"AdapterPack target {t!r} is {type(lin).__name__}, "
+                    "expected nn.Linear")
+            dt = lin.weight._value.dtype
+            self.ab[t] = (jnp.zeros((L, S, lin.in_features, r), dt),
+                          jnp.zeros((L, S, r, lin.out_features), dt))
+            self._zero_slot[t] = (jnp.zeros((L, lin.in_features, r), dt),
+                                  jnp.zeros((L, r, lin.out_features), dt))
+        self.scaling = jnp.zeros((S,), jnp.float32)
+
+    @property
+    def nbytes(self) -> int:
+        return (sum(a.nbytes + b.nbytes for a, b in self.ab.values())
+                + self.scaling.nbytes)
+
+    def parts(self):
+        """[(name, array)] leaves — the mesh lint's per-leaf walk (same
+        contract as ops.paged_attention.pool_parts)."""
+        out = [(f"adapter.{t}.{w}", arr)
+               for t, (a, b) in sorted(self.ab.items())
+               for w, arr in (("A", a), ("B", b))]
+        out.append(("adapter.scaling", self.scaling))
+        return out
+
+    def set_slot(self, slot, arrays, alpha=None):
+        """Install an adapter's stacked arrays into ``slot`` (pure device
+        scatter — shapes unchanged).  ``arrays`` is
+        ``parse_adapter_state_dict`` output; targets it omits are zeroed
+        (the adapter genuinely has no delta there)."""
+        slot = int(slot)
+        if not 1 <= slot < self.num_slots:
+            raise IndexError(
+                f"slot {slot} out of range [1, {self.num_slots}) "
+                "(slot 0 is the reserved base-model identity)")
+        # EVERY target's A and B validated BEFORE any scatter: a shape
+        # mismatch surfacing mid-loop would leave the slot half-mutated
+        # (old and new weights mixed under one name, epoch already spent)
+        for t, (A, B) in self.ab.items():
+            if t not in arrays:
+                continue
+            na, nb = arrays[t]
+            want_a = A.shape[0:1] + A.shape[2:]
+            want_b = B.shape[0:1] + B.shape[2:]
+            if na.shape != want_a or nb.shape != want_b:
+                raise ValueError(
+                    f"adapter for {t!r} has shapes A{tuple(na.shape)}/"
+                    f"B{tuple(nb.shape)}, pack slot expects "
+                    f"A{want_a}/B{want_b}")
+        for t, (A, B) in self.ab.items():
+            if t in arrays:
+                na, nb = arrays[t]
+                na, nb = na.astype(A.dtype), nb.astype(B.dtype)
+            else:
+                na, nb = self._zero_slot[t]
+            self.ab[t] = (A.at[:, slot].set(na), B.at[:, slot].set(nb))
+        a = float(alpha) if alpha is not None else self.alpha
+        self.scaling = self.scaling.at[slot].set(a / self.rank)
+        return self
+
+    def clear_slot(self, slot):
+        """Zero ``slot`` back to the identity adapter.  Scatters zero
+        ARRAYS (not a scalar fill) so the XLA programs are the very ones
+        :meth:`set_slot` already compiled — an evict after any install
+        costs no fresh compile."""
+        slot = int(slot)
+        if not 1 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range [1, {self.num_slots})")
+        for t, (A, B) in self.ab.items():
+            za, zb = self._zero_slot[t]
+            self.ab[t] = (A.at[:, slot].set(za), B.at[:, slot].set(zb))
+        self.scaling = self.scaling.at[slot].set(0.0)
+        return self
+
+
+def lora_delta(x, A, B, slots, scaling):
+    """The jitted decode step's per-row adapter delta.
+
+    x: ``[B, T, in]`` raw array; A: ``[S, in, r]``; B: ``[S, r, out]``
+    (ONE layer's slot-stacked matrices); slots: ``[B]`` int32 slot per
+    batch row; scaling: ``[B]`` float32 per-row ``alpha/rank``.  Gathers
+    each row's A/B by its slot and returns ``(x @ A_s) @ B_s * s`` in
+    ``x``'s dtype.  Slot 0 rows gather zeros — an exact additive identity.
+    """
+    Ag = jnp.take(A, slots, axis=0)            # [B, in, r]
+    Bg = jnp.take(B, slots, axis=0)            # [B, r, out]
+    xa = jnp.einsum("bti,bir->btr", x.astype(A.dtype), Ag)
+    d = jnp.einsum("btr,bro->bto", xa, Bg)
+    return (d * scaling[:, None, None].astype(d.dtype)).astype(x.dtype)
+
+
+def _make_prefill_hook(pack, target, slot, layer_index):
+    A, B = pack.ab[target]
+    scale = pack.scaling[slot]
+
+    def hook(_layer, inputs, out):
+        li = layer_index()
+        x = inputs[0]._value
+        d = (x.astype(A.dtype) @ A[li, slot]) @ B[li, slot]
+        return Tensor(out._value
+                      + (d * scale.astype(d.dtype)).astype(out._value.dtype))
+
+    return hook
+
+
+@contextlib.contextmanager
+def adapter_prefill_scope(layers, pack: AdapterPack, slot: int):
+    """Apply ``slot``'s adapter during an EAGER prefill forward.
+
+    Installs forward-post-hooks on every pack target of every decoder
+    layer: ``out += (x @ A[l, slot]) @ B[l, slot] * s``.  Works for both
+    layer layouts — a LayerList gets per-layer hooks with fixed indices;
+    a LayerStack's views all alias ONE template, so its hooks derive the
+    layer index from a per-projection call counter (each target fires
+    exactly once per layer, in layer order, per forward pass — chunked
+    prefill restarts the walk at layer 0 each chunk, which ``% L``
+    preserves).  Slot 0 needs no hooks (exact base-model prefill).
+    """
+    handles = []
+    if slot == 0:
+        yield
+        return
+    n = len(layers)
+    try:
+        if isinstance(layers, LayerStack):
+            tpl = layers.__dict__["_template"]
+            for t in pack.targets:
+                counter = itertools.count()
+                handles.append(_resolve_sublayer(tpl, t)
+                               .register_forward_post_hook(_make_prefill_hook(
+                                   pack, t, slot,
+                                   lambda c=counter: next(c) % n)))
+        else:
+            for li, blk in enumerate(layers):
+                for t in pack.targets:
+                    handles.append(
+                        _resolve_sublayer(blk, t).register_forward_post_hook(
+                            _make_prefill_hook(pack, t, slot,
+                                               lambda i=li: i)))
+        yield
+    finally:
+        for h in handles:
+            h.remove()

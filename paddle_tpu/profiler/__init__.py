@@ -217,8 +217,8 @@ class Profiler:
         seen traffic."""
         from .statistics import (checkpoint_line, compile_cache_line,
                                  decode_line, dispatch_cache_line,
-                                 mesh_line, schedule_line, summary_text,
-                                 verify_line)
+                                 lora_line, mesh_line, schedule_line,
+                                 summary_text, verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -232,6 +232,9 @@ class Profiler:
         dec_line = decode_line(decode_stats())
         if dec_line:
             out = out + "\n" + dec_line
+        lr_line = lora_line(lora_stats())
+        if lr_line:
+            out = out + "\n" + lr_line
         ver_line = verify_line(verify_stats())
         if ver_line:
             out = out + "\n" + ver_line
@@ -358,6 +361,19 @@ def decode_stats(reset: bool = False) -> dict:
     return serving.decode_stats(reset=reset)
 
 
+def lora_stats(reset: bool = False) -> dict:
+    """Multi-tenant LoRA serving counters (paddle_tpu.serving + nn/lora.py,
+    docs/LORA.md): adapter slots resident/total on the most recent pack
+    engine, hot swaps (adapter installs into a slot) and evictions, decode
+    dispatches that gathered per-row adapter A/B from the pack, and
+    prefix-cache slot-epoch bumps (each invalidates exactly one slot's
+    cached subtree).  Zeros when no adapter engine ran.  The serving
+    module owns the counters — one schema, no drift."""
+    from paddle_tpu import serving
+
+    return serving.lora_stats(reset=reset)
+
+
 def compile_stats(reset: bool = False) -> dict:
     """Trace-time / XLA-compile-time / persistent-cache counters for this
     process (fed by jax.monitoring; see _core.compile_cache): traces,
@@ -434,7 +450,7 @@ def checkpoint_stats(reset: bool = False) -> dict:
 
 
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
-            "decode_stats", "verify_stats", "mesh_lint_stats",
+            "decode_stats", "lora_stats", "verify_stats", "mesh_lint_stats",
             "schedule_search_stats", "checkpoint_stats"]
 
 

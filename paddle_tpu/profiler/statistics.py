@@ -17,7 +17,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 __all__ = ["EventSummary", "StatisticData", "summary_text",
-           "dispatch_cache_line", "compile_cache_line", "decode_line"]
+           "dispatch_cache_line", "compile_cache_line", "decode_line",
+           "lora_line"]
 
 _UNITS = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
 
@@ -219,6 +220,22 @@ def decode_line(stats: dict) -> str:
                stats.get("resident_peak", 0))
         )
     return line
+
+
+def lora_line(stats: dict) -> str:
+    """One-line rendering of the multi-tenant LoRA serving counters for
+    Profiler.summary(); empty when no adapter-pack engine ran this
+    process (docs/LORA.md)."""
+    if not (stats.get("swaps") or stats.get("gather_dispatches")
+            or stats.get("slots_resident")):
+        return ""
+    return (
+        "LoRA serving: slots=%d/%d resident, swaps=%d evictions=%d "
+        "gather_dispatches=%d cache_epochs=%d"
+        % (stats.get("slots_resident", 0), stats.get("slots_total", 0),
+           stats.get("swaps", 0), stats.get("evictions", 0),
+           stats.get("gather_dispatches", 0), stats.get("cache_epochs", 0))
+    )
 
 
 def verify_line(stats: dict) -> str:

@@ -18,6 +18,7 @@ pool pages; decode then advances all live slots together.
 
 from __future__ import annotations
 
+import contextlib
 import time
 import weakref
 from collections import deque
@@ -30,7 +31,7 @@ import jax.numpy as jnp
 from paddle_tpu._core import flags as _flags
 
 __all__ = ["GenerationEngine", "RadixPrefixCache", "decode_stats",
-           "reset_decode_stats"]
+           "reset_decode_stats", "lora_stats", "reset_lora_stats"]
 
 
 # --------------------------------------------------------- decode telemetry
@@ -84,6 +85,42 @@ def reset_decode_stats():
         _DECODE_STATS[k] = 0.0 if isinstance(_DECODE_STATS[k], float) else 0
 
 
+# Multi-tenant LoRA serving counters (profiler.lora_stats reads them):
+# slots_resident = installed adapters on the most recent pack mutation;
+# swaps = adapter installs into a slot (register_adapter, incl. LRU
+# re-installs); evictions = slots vacated (explicit or LRU); gather
+# dispatches = compiled decode dispatches that gathered per-row A/B from a
+# pack; cache_epochs = slot-epoch bumps (each invalidates that slot's
+# prefix-cache subtree).
+_LORA_STATS = {
+    "slots_resident": 0,
+    "slots_total": 0,
+    "swaps": 0,
+    "evictions": 0,
+    "gather_dispatches": 0,
+    "cache_epochs": 0,
+}
+
+
+def lora_stats(reset: bool = False) -> dict:
+    """Multi-tenant LoRA serving counters (docs/LORA.md): adapter slots
+    resident / total on the most recent pack engine, hot swaps and
+    evictions, decode dispatches that gathered adapter rows, and
+    prefix-cache epoch bumps.  Zeros when no adapter engine ran."""
+    out = dict(_LORA_STATS)
+    if reset:
+        reset_lora_stats()
+    return out
+
+
+def reset_lora_stats():
+    # slots_resident/slots_total are GAUGES of live engine state, not
+    # windowed traffic — a counter reset must not misreport the pack
+    for k in _LORA_STATS:
+        if k not in ("slots_resident", "slots_total"):
+            _LORA_STATS[k] = 0
+
+
 # Live engines hold compiled decode executables; any flag change may alter
 # what those programs traced (FLAGS_decode_chunk, matmul precision, ...), so
 # set_flags drops them — the same contract as the eager dispatch cache.
@@ -110,6 +147,7 @@ class _Slot:
     temperature: float = 0.0
     key: object = None        # precomputed PRNG key (seed + request nonce)
     d_seq_len: int = 0        # draft-pool coverage (speculative tier)
+    adapter_slot: int = 0     # AdapterPack slot (0 = base-model identity)
 
 
 class _PoolExhausted(RuntimeError):
@@ -146,6 +184,14 @@ class RadixPrefixCache:
     per-request into an exclusively-owned page, which is the copy-on-write
     rule — shared pages are immutable, the mutable tail is always a
     private copy.
+
+    Chunk keys are opaque: an adapter-aware engine namespaces the FIRST
+    level with ``ns=(adapter_slot, slot_epoch)`` — root children key as
+    ``(ns, chunk)`` — so tenants sharing a system prompt under the same
+    adapter share pages while different adapters (whose K/V genuinely
+    differ: adapted projections feed the cache) never cross-match, and a
+    hot-swapped slot's bumped epoch strands exactly that slot's subtree
+    (``drop_subtree`` reclaims it; docs/LORA.md).
     """
 
     def __init__(self, block_size):
@@ -165,13 +211,18 @@ class RadixPrefixCache:
         self._clock += 1
         return self._clock
 
-    def match(self, tokens, max_blocks=None):
+    @staticmethod
+    def _key(node_is_root, ns, chunk):
+        return (ns, chunk) if (ns is not None and node_is_root) else chunk
+
+    def match(self, tokens, max_blocks=None, ns=None):
         """Longest cached full-block prefix of `tokens` -> pool block list.
 
         Every matched node is LRU-touched.  `max_blocks` caps the walk
         (admission caps at (len-1)//block_size so at least one suffix
         token always prefills — the forward that produces the first
-        logits)."""
+        logits).  `ns` namespaces the first chunk (adapter-aware engines
+        pass (slot, epoch)); distinct namespaces never share nodes."""
         bs = self.block_size
         limit = len(tokens) // bs
         if max_blocks is not None:
@@ -179,7 +230,9 @@ class RadixPrefixCache:
         t = self._tick()
         node, out = self._root, []
         for bi in range(limit):
-            child = node.children.get(tuple(tokens[bi * bs:(bi + 1) * bs]))
+            chunk = tuple(tokens[bi * bs:(bi + 1) * bs])
+            child = node.children.get(
+                self._key(node is self._root, ns, chunk))
             if child is None:
                 break
             child.last_used = t
@@ -187,7 +240,7 @@ class RadixPrefixCache:
             node = child
         return out
 
-    def insert(self, tokens, blocks):
+    def insert(self, tokens, blocks, ns=None):
         """Adopt `blocks[i]` as the cached page for tokens' i-th full
         chunk.  Existing nodes keep their block (first writer wins — the
         duplicate page stays request-private and recycles normally);
@@ -197,15 +250,36 @@ class RadixPrefixCache:
         node, adopted = self._root, []
         for bi in range(min(len(blocks), len(tokens) // bs)):
             chunk = tuple(tokens[bi * bs:(bi + 1) * bs])
-            child = node.children.get(chunk)
+            key = self._key(node is self._root, ns, chunk)
+            child = node.children.get(key)
             if child is None:
-                child = _RadixNode(chunk, blocks[bi], node)
-                node.children[chunk] = child
+                child = _RadixNode(key, blocks[bi], node)
+                node.children[key] = child
                 self._by_block[blocks[bi]] = child
                 adopted.append(blocks[bi])
             child.last_used = t
             node = child
         return adopted
+
+    def drop_subtree(self, ns, refcount):
+        """Invalidate EXACTLY namespace `ns`'s subtree (a hot-swapped
+        adapter slot): every node under first-level children keyed
+        ``(ns, ...)`` leaves the tree.  Returns the refcount-zero blocks
+        (immediately reclaimable — the caller frees them); blocks a live
+        request still references merely stop being cached and recycle
+        normally once that request drops them."""
+        freed = []
+        for key in [k for k in self._root.children
+                    if isinstance(k, tuple) and len(k) == 2
+                    and k[0] == ns]:
+            stack = [self._root.children.pop(key)]
+            while stack:
+                nd = stack.pop()
+                stack.extend(nd.children.values())
+                del self._by_block[nd.block]
+                if refcount[nd.block] == 0:
+                    freed.append(nd.block)
+        return freed
 
     def evict(self, n, refcount):
         """Free up to `n` RECLAIMABLE blocks: leaves whose refcount is
@@ -256,7 +330,7 @@ class GenerationEngine:
                  eos_token_id=None, mesh=None, mp_axis="mp",
                  prefill_chunk=None, draft_model=None,
                  num_speculative_tokens=4, decode_chunk=None,
-                 prefix_cache=None, kv_cache_dtype=None):
+                 prefix_cache=None, kv_cache_dtype=None, adapters=None):
         """mesh: optional ProcessMesh/jax Mesh with an `mp_axis` dimension —
         the engine then serves TENSOR-PARALLEL: weights get Megatron
         placements (models.llama.shard_llama), the paged-KV pool is sharded
@@ -286,7 +360,18 @@ class GenerationEngine:
         full-precision pools in the model's serving dtype (today's exact
         behavior); 'int8' stores quantized pools with per-block-per-head
         scales, dequantized on gather inside the jitted step — roughly
-        double the resident requests at fixed pool bytes."""
+        double the resident requests at fixed pool bytes.
+
+        adapters: multi-tenant LoRA serving (nn/lora.py, docs/LORA.md) —
+        an int rank, a config dict ({"rank", "alpha", "max_adapters",
+        "targets"}), or a prebuilt nn.AdapterPack.  Pre-allocates
+        FLAGS_lora_max_adapters hot-swappable slots (plus reserved slot 0
+        = the exact base-model identity); register_adapter/evict_adapter
+        mutate slot CONTENTS only, at macro-step boundaries, so the
+        compiled decode step — which gathers each batch row's A/B by its
+        slot index — is reused across swaps with zero recompiles.
+        Requests pick an adapter via add_request(..., adapter=name);
+        mixed-adapter batches decode in ONE dispatch."""
         cfg = model.config
         self.model = model
         if prefill_chunk is not None and int(prefill_chunk) < 1:
@@ -409,6 +494,45 @@ class GenerationEngine:
             self._d_state = list(draft_model.state_dict().values())
             self._spec_stats = {"ticks": 0, "proposed": 0, "accepted": 0,
                                 "emitted": 0}
+
+        # ---- multi-tenant LoRA tier: slot-stacked adapter pack ----------
+        self._pack = None
+        if adapters is not None:
+            from paddle_tpu.nn.lora import AdapterPack
+
+            if draft_model is not None:
+                raise ValueError(
+                    "adapters= (multi-tenant LoRA) is not combined with "
+                    "speculative decoding yet: the draft model would need "
+                    "its own per-tenant pack for acceptance to stay "
+                    "meaningful — drop one knob")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "adapters= (multi-tenant LoRA) does not compose with "
+                    "the tensor-parallel mesh engine (mesh=) yet: the "
+                    "pack's column/row adapter factors would need the "
+                    "same Megatron placements as their base projections.  "
+                    "Drop one knob — adapters= on a single device, or "
+                    "mesh= without adapters")
+            if isinstance(adapters, AdapterPack):
+                self._pack = adapters
+            elif isinstance(adapters, int):
+                self._pack = AdapterPack(model, rank=adapters)
+            elif isinstance(adapters, dict):
+                self._pack = AdapterPack(model, **adapters)
+            else:
+                raise TypeError(
+                    "adapters must be an int rank, a config dict, or an "
+                    f"nn.AdapterPack; got {type(adapters).__name__}")
+            S = self._pack.num_slots
+            self._adapter_registry: dict = {}   # name -> (arrays, alpha)
+            self._slot_names = [None] * S       # slot -> installed name
+            self._slot_epochs = [0] * S         # bumped per content change
+            self._slot_refs = [0] * S           # in-flight request counts
+            self._slot_used = [0] * S           # LRU clock marks
+            self._slot_clock = 0
+            _LORA_STATS["slots_total"] = S - 1
+            _LORA_STATS["slots_resident"] = 0
         _DECODE_STATS["pool_bytes"] = sum(
             pa.pool_nbytes(p) for p in
             self._kpools + self._vpools
@@ -433,6 +557,138 @@ class GenerationEngine:
 
     def result(self, rid):
         return self._results.get(rid)
+
+    # ---------------------------------------------------- adapter registry
+    def _require_pack(self):
+        if self._pack is None:
+            raise RuntimeError(
+                "this engine was built without adapters=; pass "
+                "GenerationEngine(adapters=rank_or_config) to serve "
+                "multi-tenant LoRA (docs/LORA.md)")
+        return self._pack
+
+    def _slot_of(self, name):
+        return next((s for s, n in enumerate(self._slot_names) if n == name),
+                    None)
+
+    def _touch_slot(self, slot):
+        self._slot_clock += 1
+        self._slot_used[slot] = self._slot_clock
+
+    def _bump_epoch(self, slot):
+        """Invalidate exactly `slot`'s prefix-cache subtree: the old
+        (slot, epoch) namespace becomes unreachable and its refcount-zero
+        pages return to the free list NOW."""
+        if self._prefix is not None:
+            freed = self._prefix.drop_subtree(
+                (slot, self._slot_epochs[slot]), self._ref)
+            self._free.extend(freed)
+        self._slot_epochs[slot] += 1
+        _LORA_STATS["cache_epochs"] += 1
+
+    def _resident_count(self):
+        return sum(1 for n in self._slot_names[1:] if n is not None)
+
+    def register_adapter(self, name, state_dict, alpha=None):
+        """Register a LoRA adapter (an adapter-only state dict — see
+        nn.lora.lora_state_dict) and install it into a pack slot if one is
+        free or LRU-reclaimable.  Returns the slot index, or None when
+        every slot currently serves in-flight requests — the adapter stays
+        registered and installs lazily when one of its requests is
+        admitted at a macro-step boundary (requests never raise on slot
+        exhaustion; they QUEUE, same FIFO contract as pool exhaustion).
+
+        Installation is a pure device scatter into pre-allocated arrays:
+        pack geometry (rank, slot count, targets) never changes, so the
+        compiled decode step is reused — zero recompiles per swap.
+        `alpha` defaults to the pack's alpha (scaling = alpha/rank is
+        per-slot, so tenants may differ).
+
+        Re-registering a RESIDENT name updates its slot in place (new
+        weights scattered, epoch bumped so stale cached prefixes die) —
+        refused while the adapter has in-flight ACTIVE requests, whose
+        streams must not change weights mid-flight (queued requests are
+        fine: they haven't started and will serve the new version)."""
+        pack = self._require_pack()
+        from paddle_tpu.nn.lora import parse_adapter_state_dict
+
+        arrays = parse_adapter_state_dict(
+            state_dict, pack.num_layers, pack.targets, pack.rank)
+        slot = self._slot_of(name)
+        if slot is not None:
+            if self._slot_refs[slot] > 0:
+                raise RuntimeError(
+                    f"adapter {name!r} has in-flight requests; "
+                    "re-registering would change their weights "
+                    "mid-stream — drain them first")
+            self._adapter_registry[name] = (arrays, alpha)
+            self._bump_epoch(slot)
+            self._pack.set_slot(slot, arrays, alpha)
+            self._touch_slot(slot)
+            _LORA_STATS["swaps"] += 1
+            return slot
+        self._adapter_registry[name] = (arrays, alpha)
+        return self._try_install(name)
+
+    def _try_install(self, name):
+        """Make `name` resident: reuse its slot, take a free one, or evict
+        the LRU idle slot (never one with in-flight requests).  Returns
+        the slot index or None (transient exhaustion — every slot busy)."""
+        slot = self._slot_of(name)
+        if slot is not None:
+            self._touch_slot(slot)
+            return slot
+        arrays, alpha = self._adapter_registry[name]
+        S = self._pack.num_slots
+        free = next((s for s in range(1, S) if self._slot_names[s] is None),
+                    None)
+        if free is None:
+            idle = [s for s in range(1, S) if self._slot_refs[s] == 0]
+            if not idle:
+                return None
+            free = min(idle, key=lambda s: self._slot_used[s])
+            self._slot_names[free] = None
+            _LORA_STATS["evictions"] += 1
+        # the install overwrites EVERY target (omitted ones zero), so no
+        # separate clear; the epoch bump strands the old contents' cached
+        # prefix subtree before the new tenant can be matched against it
+        self._bump_epoch(free)
+        self._pack.set_slot(free, arrays, alpha)
+        self._slot_names[free] = name
+        self._touch_slot(free)
+        _LORA_STATS["swaps"] += 1
+        _LORA_STATS["slots_resident"] = self._resident_count()
+        return free
+
+    def evict_adapter(self, name):
+        """Unregister `name` and vacate its slot.  REFUSES (raises) while
+        the adapter has in-flight requests — active slots or queued
+        admissions; retire or drain them first.  The slot's prefix-cache
+        subtree is invalidated and its contents zeroed."""
+        self._require_pack()
+        if name not in self._adapter_registry:
+            raise KeyError(f"adapter {name!r} is not registered")
+        slot = self._slot_of(name)
+        in_flight = (slot is not None and self._slot_refs[slot] > 0)
+        if in_flight or any(r.get("adapter") == name for r in self._pending):
+            raise RuntimeError(
+                f"adapter {name!r} has in-flight requests "
+                f"({'active' if in_flight else 'queued'}); drain them "
+                "before evicting")
+        del self._adapter_registry[name]
+        if slot is not None:
+            self._slot_names[slot] = None
+            self._bump_epoch(slot)
+            self._pack.clear_slot(slot)
+            _LORA_STATS["evictions"] += 1
+            _LORA_STATS["slots_resident"] = self._resident_count()
+
+    def adapter_slots(self):
+        """{adapter name: slot index} for currently RESIDENT adapters
+        (registered-but-swapped-out adapters are absent)."""
+        self._require_pack()
+        return {n: s for s, n in enumerate(self._slot_names)
+                if n is not None}
 
     def _alloc(self, n):
         """Pop n blocks (refcount 1 each).  Under pressure, reclaimable
@@ -464,12 +720,15 @@ class GenerationEngine:
 
     def _release(self, slot):
         self._unref(slot.blocks)
+        if self._pack is not None:
+            self._slot_refs[slot.adapter_slot] -= 1
+            slot.adapter_slot = 0
         slot.blocks = []
         slot.active = False
         slot.rid = None
 
     def add_request(self, rid, prompt_ids, max_new_tokens=16,
-                    temperature=None, seed=0):
+                    temperature=None, seed=0, adapter=None):
         """Prefill the prompt, pour K/V into pool pages, occupy a slot.
 
         With the prefix cache on, the longest cached token-id prefix is
@@ -490,7 +749,16 @@ class GenerationEngine:
         same-seed requests still draw distinct streams, and each request
         folds its OWN generated-token counter per step.  Requests with
         different decode configs share the ONE compiled decode program
-        (the config rides in as per-slot arrays)."""
+        (the config rides in as per-slot arrays).
+
+        adapter: name of a REGISTERED LoRA adapter (register_adapter) to
+        serve this request with; None = the base model (pack slot 0).
+        A request whose adapter cannot be made resident right now (every
+        slot busy with in-flight requests) QUEUES exactly like pool
+        exhaustion — FIFO retry at the next macro-step boundary, with the
+        PRNG nonce reserved at submit so a queued-then-admitted stream
+        matches immediate admission bit-for-bit.  An UNREGISTERED adapter
+        name raises KeyError (nothing to wait for)."""
         if self.draft_model is not None and float(temperature or 0.0) > 0.0:
             # checked BEFORE any allocation/prefill: a rejected request
             # must not leak pool blocks or burn two prefills
@@ -509,6 +777,12 @@ class GenerationEngine:
                 f"request needs {n_blocks} blocks > per-seq table width "
                 f"{self._max_blocks_per_seq}"
             )
+        if adapter is not None:
+            self._require_pack()
+            if adapter not in self._adapter_registry:
+                raise KeyError(
+                    f"adapter {adapter!r} is not registered on this "
+                    "engine; call register_adapter first")
         # nonce reserved at SUBMIT time: retry timing can't shift the
         # request's sampling stream
         nonce = self._req_counter
@@ -516,7 +790,7 @@ class GenerationEngine:
         req = {"rid": rid, "prompt": prompt, "max_len": max_len,
                "n_blocks": n_blocks,
                "temperature": float(temperature or 0.0),
-               "seed": int(seed), "nonce": nonce}
+               "seed": int(seed), "nonce": nonce, "adapter": adapter}
         # FIFO fairness: while older requests wait, newcomers queue behind
         if self._pending or not self._try_admit(req):
             self._pending.append(req)
@@ -553,12 +827,27 @@ class GenerationEngine:
         slot = next((s for s in self._slots if not s.active), None)
         if slot is None:
             return False
+        # ---- adapter residency: the request's adapter must hold a pack
+        # slot before prefill (adapted projections feed the K/V it pours).
+        # Transient slot exhaustion — every slot serving in-flight
+        # requests — queues exactly like pool exhaustion.
+        ad_slot = 0
+        if self._pack is not None and req.get("adapter") is not None:
+            ad_slot = self._try_install(req["adapter"])
+            if ad_slot is None:
+                return False
         prompt = req["prompt"]
         s0 = prompt.shape[1]
         bs = self.block_size
         # ---- prefix match: reference cached pages instead of prefilling.
         # Capped at (s0-1)//bs full blocks so at least one suffix token
         # always prefills — that forward produces the first-token logits.
+        # Adapter engines namespace the walk by (slot, epoch): tenants
+        # sharing a prompt under one adapter share pages, other adapters
+        # (different K/V!) never cross-match, and a swapped slot's bumped
+        # epoch makes its old subtree unmatchable.
+        ns = ((ad_slot, self._slot_epochs[ad_slot])
+              if self._pack is not None else None)
         toks = matched = None
         if self._prefix is not None:
             # token list cached across retries (the prompt is immutable);
@@ -566,7 +855,8 @@ class GenerationEngine:
             # LRU touch keeps a waiting request's pages warm for its
             # retry instead of letting pressure evict them
             toks = req.setdefault("toks", [int(t) for t in prompt[0]])
-            matched = self._prefix.match(toks, max_blocks=(s0 - 1) // bs)
+            matched = self._prefix.match(toks, max_blocks=(s0 - 1) // bs,
+                                         ns=ns)
             for b in matched:
                 self._ref[b] += 1
         matched = matched or []
@@ -583,7 +873,18 @@ class GenerationEngine:
             caches = self._prefix_or_empty(
                 self._kpools, self._vpools, matched, m_len, self._n_layers,
                 self._nkv, self._head_dim, model.config.dtype)
-            with paddle.no_grad():
+            # adapter requests prefill THROUGH their adapter: forward-post
+            # hooks add each target projection's (x A)(B) s delta, so the
+            # poured K/V matches what the adapted model would cache
+            # (slot 0 installs no hooks — exact base-model prefill)
+            if self._pack is not None and ad_slot:
+                from paddle_tpu.nn.lora import adapter_prefill_scope
+
+                prefill_ctx = adapter_prefill_scope(
+                    model.model.layers, self._pack, ad_slot)
+            else:
+                prefill_ctx = contextlib.nullcontext()
+            with prefill_ctx, paddle.no_grad():
                 if (self.prefill_chunk is None
                         or s0 - m_len <= self.prefill_chunk):
                     h, caches = _model_forward_cached(
@@ -637,6 +938,12 @@ class GenerationEngine:
         slot.seq_len = s0
         slot.max_len = req["max_len"]
         slot.blocks = blocks
+        slot.adapter_slot = ad_slot
+        if self._pack is not None:
+            # in-flight reference pins the adapter slot: LRU install and
+            # evict_adapter both refuse referenced slots
+            self._slot_refs[ad_slot] += 1
+            self._touch_slot(ad_slot)
         slot.temperature = req["temperature"]
         # seed folded with the submit-time nonce: same-seed requests get
         # distinct streams and retries reproduce them
@@ -656,7 +963,7 @@ class GenerationEngine:
             # full prompt blocks become shared pages for future requests
             # (matched nodes just get LRU-touched); the partial tail block
             # stays request-private — the copy-on-write rule
-            self._prefix.insert(toks, blocks[:s0 // bs])
+            self._prefix.insert(toks, blocks[:s0 // bs], ns=ns)
             # hit/miss telemetry counts COMMITTED admissions only: a
             # queued-then-retried or prefill-errored attempt must not
             # inflate the avoided-prefill tokens
@@ -765,7 +1072,14 @@ class GenerationEngine:
         remaining writes land on their scratch page (never the shared
         pool) and their lens/fold counters freeze, so the live rows'
         streams stay bit-identical to the per-token path while the host
-        discards the masked tail after the dispatch."""
+        discards the masked tail after the dispatch.
+
+        On adapter engines the step takes three extra arguments — the
+        per-row slot vector and the pack's A/B + scaling arrays — and
+        every decoder layer adds the gathered per-row LoRA delta, so a
+        batch mixing tenants (and base rows at slot 0) decodes in this
+        one program; swaps change argument VALUES only, never shapes, so
+        the executable is reused across them."""
         from paddle_tpu._core.autograd import no_grad
         from paddle_tpu._core.tensor import Tensor
         from paddle_tpu.models.llama import (_decode_layers_paged,
@@ -774,9 +1088,15 @@ class GenerationEngine:
         model = self.model
         state = self._state
         eos = self.eos_token_id
+        has_pack = self._pack is not None
 
         def step(state_vals, kpools, vpools, tokens, tables, scratch_tables,
-                 lens, max_lens, done0, temps, keys, steps):
+                 lens, max_lens, done0, temps, keys, steps, *lora_args):
+            if has_pack:
+                ad_slots, pack_ab, pack_scaling = lora_args
+                row_scale = jnp.take(pack_scaling, ad_slots)  # [B]
+            else:
+                ad_slots = pack_ab = row_scale = None
             originals = [t._value for t in state]
             try:
                 for t, v in zip(state, state_vals):
@@ -805,7 +1125,8 @@ class GenerationEngine:
                         sin = model.model.rope_sin._value
                         h, kps, vps = _decode_layers_paged(
                             model.model.layers, h, cos, sin, kps, vps,
-                            tables_eff, lens_eff)
+                            tables_eff, lens_eff, adapters=pack_ab,
+                            slots=ad_slots, scaling=row_scale)
                         h = model.model.norm(h)
                         logits = model._logits(h)
                     lg = logits._value[:, -1, :]
@@ -1058,6 +1379,7 @@ class GenerationEngine:
         temps = np.zeros((B,), np.float32)
         keys = np.zeros((B, 2), np.uint32)
         steps = np.zeros((B,), np.uint32)
+        ad_slots = np.zeros((B,), np.int32)
         for i, s in enumerate(self._slots):
             if s.active:
                 tokens[i, 0] = s.last_token
@@ -1069,10 +1391,20 @@ class GenerationEngine:
                 temps[i] = s.temperature
                 keys[i] = s.key
                 steps[i] = len(s.generated)  # fold index for this request
+                ad_slots[i] = s.adapter_slot
             else:
                 tables[i] = self._scratch[i]  # park masked lanes off-pool
                 lens[i] = 1
 
+        lora_args = ()
+        if self._pack is not None:
+            # pack contents ride as ARGUMENTS (not closed-over constants):
+            # register_adapter's scatter produces new arrays of identical
+            # shape, so a swap changes values only and this same compiled
+            # step serves every tenant mix
+            lora_args = (jnp.asarray(ad_slots), self._pack.ab,
+                         self._pack.scaling)
+            _LORA_STATS["gather_dispatches"] += 1
         nxt, new_k, new_v = step_fn(
             [t._value for t in self._state],
             list(self._kpools), list(self._vpools),
@@ -1080,6 +1412,7 @@ class GenerationEngine:
             self._scratch_tables, jnp.asarray(lens),
             jnp.asarray(max_lens), jnp.asarray(done0),
             jnp.asarray(temps), jnp.asarray(keys), jnp.asarray(steps),
+            *lora_args,
         )
         self._kpools = list(new_k)
         self._vpools = list(new_v)

@@ -691,8 +691,19 @@ class MeshLinter:
                     dest = pool_named if part == "payload" else scale_named
                     dest.append((f"{tag}pool[{i}].{part}", arr,
                                  engine._pool_sharding))
+        # multi-tenant LoRA: the adapter pack's slot-stacked A/B + scaling
+        # arrays are engine state too — placements and per-device bytes go
+        # through the same path as params (nn/lora.py AdapterPack.parts)
+        pack = getattr(engine, "_pack", None)
+        pack_named = []
+        if pack is not None:
+            pack_named = [(name, arr, getattr(arr, "sharding", None))
+                          for name, arr in pack.parts()]
+
         v += self.lint_placements(named, site="engine.params")
         v += self.lint_placements(pool_named, site="engine.pools")
+        if pack_named:
+            v += self.lint_placements(pack_named, site="engine.adapter_pack")
 
         _COUNTERS["donation_checks"] += 1
         seen: dict = {}
@@ -709,6 +720,8 @@ class MeshLinter:
         groups = {"params": named, "kv_pools": pool_named}
         if scale_named:  # QuantPool scales ride alongside the int8 payload
             groups["kv_scales"] = scale_named
+        if pack_named:  # adapter bytes count against the HBM budget too
+            groups["adapter_pack"] = pack_named
         mv, est = self.estimate_device_bytes(groups, site="engine")
         v += mv
         return v, est

@@ -1,0 +1,60 @@
+"""Tier-1 smoke of benchmarks/bench_lora.py.
+
+Like test_bench_decode / test_bench_compile: the multi-tenant LoRA bench
+must keep emitting the one-line JSON payload the driver parses, its
+built-in greedy-parity gate (mixed-adapter batched streams == per-adapter
+serial streams, bit for bit) must hold, and the payload must flow through
+tools/check_bench_regression.py (the CI regression gate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_smoke():
+    env = dict(os.environ, PADDLE_TPU_BENCH_SMOKE="1",
+               PADDLE_TPU_BENCH_CPU="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benchmarks", "bench_lora.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    assert out.returncode == 0, (out.stderr or out.stdout)[-800:]
+    line = next(ln for ln in reversed(out.stdout.splitlines())
+                if ln.startswith("{"))
+    return json.loads(line)
+
+
+def test_bench_lora_smoke_emits_valid_json_and_parity():
+    payload = _run_smoke()
+    assert payload["metric"] == "serving_lora_mixed_batch_speedup"
+    assert payload["unit"] == "x"
+    assert payload["value"] > 0
+    assert "vs_baseline" in payload
+    # the acceptance direction: mixed-adapter batched streams must equal
+    # the per-adapter serial ones bit-for-bit
+    assert payload["tokens_match"] is True
+    detail = payload["detail"]
+    assert detail["adapters"] >= 3
+    assert detail["batched_tokens_per_sec"] > 0
+    assert detail["serial_tokens_per_sec"] > 0
+    # the pack really swapped and really gathered inside the decode step
+    assert detail["lora_stats"]["swaps"] >= detail["adapters"]
+    assert detail["lora_stats"]["gather_dispatches"] > 0
+
+    # regression-gate wiring: the payload round-trips through
+    # tools/check_bench_regression.py (same-value comparison = ok, rc 0)
+    sys.path.insert(0, _REPO)
+    from tools.check_bench_regression import load_payload, main
+
+    path = os.path.join(_REPO, "_bench_lora_smoke.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        got, err = load_payload(path)
+        assert err is None and got == (payload["metric"], payload["value"])
+        assert main([path, path]) == 0
+    finally:
+        os.remove(path)

@@ -1,0 +1,376 @@
+"""LoRA training tier (nn/lora.py): LoRALinear surgery, frozen-base
+fine-tuning through TrainStep, merge/unmerge, adapter-only checkpoints
+through CheckpointManager, and the fine-tune -> save adapter ->
+fresh-engine serve round trip (docs/LORA.md).
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu import optimizer as opt
+from paddle_tpu.nn.lora import (AdapterPack, LoRALinear, apply_lora,
+                                lora_state_dict, parse_adapter_state_dict)
+
+import jax
+import jax.numpy as jnp
+
+
+def _tiny_cfg(**kw):
+    from paddle_tpu.models.llama import llama_tiny
+
+    base = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=4, max_position_embeddings=64,
+                dtype="float32")
+    base.update(kw)
+    return llama_tiny(**base)
+
+
+def _base_model(seed=41, **kw):
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    paddle.seed(seed)
+    m = LlamaForCausalLM(_tiny_cfg(**kw))
+    m.eval()
+    return m
+
+
+def _lora_clone(base, rank=4, alpha=8, b_scale=0.05, key_seed=7):
+    """A LoRA-adapted copy of `base` with nonzero lora_B (a freshly
+    initialized adapter is the identity — B starts at zero — so tests
+    that need the adapter to DO something perturb B)."""
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    ft = LlamaForCausalLM(_tiny_cfg())
+    ft.set_state_dict(base.state_dict())
+    ft.eval()
+    apply_lora(ft, rank=rank, alpha=alpha)
+    key = jax.random.PRNGKey(key_seed)
+    for name, p in ft.named_parameters():
+        if name.endswith("lora_B"):
+            key, sk = jax.random.split(key)
+            p._bind(jax.random.normal(sk, p._value.shape,
+                                      jnp.float32) * b_scale)
+    return ft
+
+
+# ---------------------------------------------------------------- LoRALinear
+
+
+def test_lora_linear_starts_at_base_and_matches_manual():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    lin = nn.Linear(8, 6)
+    lora = LoRALinear.from_linear(lin, rank=2, alpha=4)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((3, 8), np.float32))
+    # lora_B starts at zero: the adapted layer IS the base layer
+    np.testing.assert_array_equal(np.asarray(lora(x)._value),
+                                  np.asarray(lin(x)._value))
+    # nonzero B: forward == base + (x A) B * alpha/rank
+    lora.lora_B._bind(jnp.ones((2, 6), jnp.float32) * 0.1)
+    want = (np.asarray(lin(x)._value)
+            + (np.asarray(x._value) @ np.asarray(lora.lora_A._value)
+               @ np.asarray(lora.lora_B._value)) * 2.0)
+    np.testing.assert_allclose(np.asarray(lora(x)._value), want, rtol=1e-5)
+
+
+def test_lora_linear_merge_unmerge_round_trip():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(1)
+    lin = nn.Linear(8, 6)
+    lora = LoRALinear.from_linear(lin, rank=2, alpha=4)
+    lora.lora_B._bind(jnp.asarray(np.random.default_rng(1)
+                                  .standard_normal((2, 6), np.float32)))
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((3, 8), np.float32))
+    want = np.asarray(lora(x)._value)
+    w0 = np.asarray(lora.weight._value).copy()
+    lora.merge()
+    assert lora.merged
+    # merged: the plain xW+b path computes the adapted function
+    np.testing.assert_allclose(np.asarray(lora(x)._value), want, rtol=1e-5)
+    lora.merge()  # idempotent
+    lora.unmerge()
+    np.testing.assert_allclose(np.asarray(lora.weight._value), w0,
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(lora(x)._value), want, rtol=1e-5)
+
+
+def test_lora_linear_rejects_bad_rank():
+    with pytest.raises(ValueError, match="rank"):
+        LoRALinear(4, 4, rank=0)
+
+
+# ----------------------------------------------------------------- apply_lora
+
+
+def test_apply_lora_surgery_keeps_keys_and_freezes_base():
+    m = _base_model()
+    keys_before = set(m.state_dict())
+    apply_lora(m, rank=4, alpha=8)
+    keys_after = set(m.state_dict())
+    # base keys unchanged (q_proj.weight stays q_proj.weight), only
+    # lora_A/lora_B added — existing checkpoints keep loading
+    assert keys_before <= keys_after
+    added = keys_after - keys_before
+    assert added and all(k.rsplit(".", 1)[-1] in ("lora_A", "lora_B")
+                         for k in added)
+    # frozen-base contract: only adapter params are trainable
+    trainable = [n for n, p in m.named_parameters() if not p.stop_gradient]
+    assert trainable
+    assert all(n.endswith(("lora_A", "lora_B")) for n in trainable)
+    # surgery hit exactly the q/k/v/o + MLP projections per layer
+    n_layers = m.config.num_hidden_layers
+    assert len(added) == 2 * 6 * n_layers
+
+
+def test_apply_lora_raises_on_layer_stack_and_missing_targets():
+    m = _base_model(fuse_layer_stack=True)
+    with pytest.raises(ValueError, match="LayerStack"):
+        apply_lora(m, rank=4)
+    m2 = _base_model()
+    with pytest.raises(ValueError, match="no Linear layer"):
+        apply_lora(m2, rank=4, targets=("does_not_exist",))
+
+
+def test_frozen_base_finetune_through_train_step():
+    base = _base_model()
+    base_vals = {k: np.asarray(v._value).copy()
+                 for k, v in base.state_dict().items()}
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    ft = LlamaForCausalLM(_tiny_cfg())
+    ft.set_state_dict(base.state_dict())
+    apply_lora(ft, rank=4, alpha=8)
+    o = opt.AdamW(learning_rate=3e-2, parameters=ft.parameters())
+    step = jit.TrainStep(ft, o, lambda mm, x, y: mm(x, y)[0])
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.integers(0, 128, (2, 8)).astype(np.int32))
+    y = paddle.to_tensor(rng.integers(0, 128, (2, 8)).astype(np.int32))
+    losses = [float(step(x, y)._value) for _ in range(12)]
+    assert losses[-1] < losses[0]  # the adapters learn
+    moved = False
+    for k, v in ft.state_dict().items():
+        leaf = k.rsplit(".", 1)[-1]
+        if leaf in ("lora_A", "lora_B"):
+            moved = True
+            continue
+        # every base tensor is BIT-identical to before training
+        np.testing.assert_array_equal(np.asarray(v._value), base_vals[k],
+                                      err_msg=k)
+    assert moved
+
+
+# ----------------------------------------- adapter state dicts + AdapterPack
+
+
+def test_lora_state_dict_and_parse():
+    base = _base_model()
+    ft = _lora_clone(base)
+    sd = lora_state_dict(ft)
+    n_layers = base.config.num_hidden_layers
+    assert len(sd) == 2 * 6 * n_layers
+    from paddle_tpu.nn.lora import LLAMA_TARGETS
+
+    arrays = parse_adapter_state_dict(sd, n_layers, LLAMA_TARGETS, rank=4)
+    assert set(arrays) == set(LLAMA_TARGETS)
+    A, B = arrays["self_attn.q_proj"]
+    assert A.shape == (n_layers, 32, 4) and B.shape == (n_layers, 4, 32)
+    # rank mismatch is loud — pack geometry is fixed
+    with pytest.raises(ValueError, match="rank"):
+        parse_adapter_state_dict(sd, n_layers, LLAMA_TARGETS, rank=8)
+    # a key targeting a projection outside the pack's geometry is loud
+    with pytest.raises(ValueError, match="does not cover"):
+        parse_adapter_state_dict(sd, n_layers, ("self_attn.q_proj",), rank=4)
+    with pytest.raises(ValueError, match="no LoRA parameters"):
+        lora_state_dict(base)
+
+
+def test_adapter_pack_geometry_and_slot_protocol():
+    base = _base_model()
+    pack = AdapterPack(base, rank=4, alpha=8, max_adapters=3)
+    assert pack.num_slots == 4  # 3 usable + reserved slot 0
+    assert pack.rank == 4
+    A, B = pack.ab["self_attn.q_proj"]
+    assert A.shape == (2, 4, 32, 4) and B.shape == (2, 4, 4, 32)
+    assert float(pack.scaling[0]) == 0.0  # slot 0 = zero adapter
+    ft = _lora_clone(base)
+    arrays = parse_adapter_state_dict(lora_state_dict(ft), 2, pack.targets, 4)
+    pack.set_slot(1, arrays, alpha=8)
+    assert float(pack.scaling[1]) == 2.0
+    assert np.abs(np.asarray(pack.ab["self_attn.q_proj"][0][:, 1])).sum() > 0
+    pack.clear_slot(1)
+    assert float(pack.scaling[1]) == 0.0
+    assert np.abs(np.asarray(pack.ab["self_attn.q_proj"][0][:, 1])).sum() == 0
+    # slot 0 is untouchable
+    with pytest.raises(IndexError, match="slot 0"):
+        pack.set_slot(0, arrays)
+    with pytest.raises(IndexError):
+        pack.clear_slot(0)
+    # FLAGS_lora_max_adapters is the default slot budget
+    paddle.set_flags({"FLAGS_lora_max_adapters": 2})
+    try:
+        assert AdapterPack(base, rank=4).num_slots == 3
+    finally:
+        paddle.set_flags({"FLAGS_lora_max_adapters": 8})
+    # pack bytes are visible (mesh lint accounts them via parts())
+    assert pack.nbytes == sum(a.nbytes for _n, a in pack.parts())
+
+
+# ------------------------------------------- satellite: partial state loads
+
+
+def test_set_state_dict_allow_partial_loads_adapter_only():
+    base = _base_model()
+    ft = _lora_clone(base, b_scale=0.1)
+    sd = lora_state_dict(ft)
+    # a fresh adapted model (zero B) partial-loads the trained adapter
+    fresh = _lora_clone(base, b_scale=0.0)
+    missing, unexpected = fresh.set_state_dict(sd, allow_partial=True)
+    assert missing and not unexpected  # base keys missing BY DESIGN
+    for k, v in lora_state_dict(fresh).items():
+        np.testing.assert_array_equal(np.asarray(v._value),
+                                      np.asarray(sd[k]._value), err_msg=k)
+    # base weights untouched by the partial load
+    np.testing.assert_array_equal(
+        np.asarray(fresh.model.embed_tokens.weight._value),
+        np.asarray(base.model.embed_tokens.weight._value))
+
+
+def test_set_state_dict_allow_partial_unexpected_keys_still_loud():
+    base = _base_model()
+    ft = _lora_clone(base)
+    sd = dict(lora_state_dict(ft))
+    sd["not.a.real.key"] = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    before = {k: np.asarray(v._value).copy()
+              for k, v in lora_state_dict(ft).items()}
+    fresh = _lora_clone(base, b_scale=0.0)
+    with pytest.raises(ValueError, match="cannot place"):
+        fresh.set_state_dict(sd, allow_partial=True)
+    # the refused load mutated NOTHING (checked before any set_value)
+    for k, v in lora_state_dict(fresh).items():
+        if k.endswith("lora_B"):
+            assert np.abs(np.asarray(v._value)).sum() == 0.0
+    del before
+
+
+def test_set_state_dict_default_contract_unchanged():
+    base = _base_model()
+    ft = _lora_clone(base)
+    sd = dict(lora_state_dict(ft))
+    sd["bogus"] = paddle.to_tensor(np.zeros((1,), np.float32))
+    fresh = _lora_clone(base, b_scale=0.0)
+    # default path: nothing raises, the lists report
+    missing, unexpected = fresh.set_state_dict(sd)
+    assert "bogus" in unexpected
+    assert any(k.endswith("embed_tokens.weight") for k in missing)
+
+
+# --------------------------------------------------- checkpoint round trips
+
+
+def test_finetune_checkpoint_fresh_engine_round_trip():
+    """The acceptance round trip: fine-tune (frozen base) -> adapter-only
+    checkpoint through CheckpointManager -> restore into a fresh process'
+    model -> serve from a FRESH engine over the pristine base model, and
+    the served stream matches the fine-tuned model's own generate()."""
+    from paddle_tpu.distributed import CheckpointManager
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+
+    base = _base_model()
+    ft = LlamaForCausalLM(_tiny_cfg())
+    ft.set_state_dict(base.state_dict())
+    apply_lora(ft, rank=4, alpha=8)
+    o = opt.AdamW(learning_rate=3e-2, parameters=ft.parameters())
+    step = jit.TrainStep(ft, o, lambda mm, x, y: mm(x, y)[0])
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.integers(0, 128, (2, 8)).astype(np.int32))
+    y = paddle.to_tensor(rng.integers(0, 128, (2, 8)).astype(np.int32))
+    for _ in range(8):
+        step(x, y)
+
+    with tempfile.TemporaryDirectory() as d:
+        CheckpointManager(d, async_save=False).save(
+            1, model=lora_state_dict(ft))
+        # "fresh process": a new adapted model restores ONLY the adapter
+        fresh = LlamaForCausalLM(_tiny_cfg())
+        fresh.set_state_dict(base.state_dict())
+        apply_lora(fresh, rank=4, alpha=8)
+        assert CheckpointManager(d, async_save=False).restore(
+            model=lora_state_dict(fresh)) == 1
+
+    ft.eval()
+    prompt = [5, 9, 17, 33, 2]
+    ref = ft.generate(paddle.to_tensor(np.asarray(prompt, np.int32)[None]),
+                      max_new_tokens=6, cache="paged", block_size=8)
+    ref = np.asarray(ref._value).reshape(-1).tolist()
+
+    eng = GenerationEngine(base, max_batch=2, block_size=8, num_blocks=16,
+                           adapters={"rank": 4})
+    eng.register_adapter("ft", lora_state_dict(fresh), alpha=8)
+    eng.add_request("r", prompt, max_new_tokens=6, adapter="ft")
+    while eng.has_work():
+        eng.step()
+    assert eng.result("r") == ref
+
+
+def test_apply_lora_gpt_finetunes_frozen_base():
+    """The surgery helper covers GPT's projection names (q/k/v, out_proj,
+    fc_in/fc_out) too — adapters train, base stays frozen."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(3)
+    m = GPTForCausalLM(gpt_tiny(vocab_size=128, hidden_size=32,
+                                num_hidden_layers=2))
+    apply_lora(m, rank=4, alpha=8)
+    trainable = [n for n, p in m.named_parameters() if not p.stop_gradient]
+    assert trainable and all(n.endswith(("lora_A", "lora_B"))
+                             for n in trainable)
+    # every gpt block projection got an adapter: attn q/k/v + out_proj +
+    # fc_in + fc_out, per layer
+    assert len(trainable) == 2 * 6 * 2
+    o = opt.AdamW(learning_rate=3e-2, parameters=m.parameters())
+    step = jit.TrainStep(m, o, lambda mm, x, y: mm(x, labels=y)[0])
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.integers(0, 128, (2, 8)).astype(np.int32))
+    y = paddle.to_tensor(rng.integers(0, 128, (2, 8)).astype(np.int32))
+    losses = [float(step(x, y)._value) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_parse_rejects_lopsided_layers_and_set_slot_validates_before_mutation():
+    """Robustness twins: (a) a layer carrying only one of lora_A/lora_B
+    (truncated checkpoint) is rejected instead of silently zero-filled;
+    (b) set_slot validates EVERY target's A and B shapes before any
+    scatter — a mismatch never leaves the slot half-mutated."""
+    from paddle_tpu.nn.lora import LLAMA_TARGETS
+
+    base = _base_model()
+    ft = _lora_clone(base)
+    sd = dict(lora_state_dict(ft))
+    # (a) drop one half of one layer's pair
+    del sd["model.layers.1.self_attn.q_proj.lora_B"]
+    with pytest.raises(ValueError, match="lopsided"):
+        parse_adapter_state_dict(sd, 2, LLAMA_TARGETS, rank=4)
+
+    # (b) arrays with a wrong-shaped B for a LATE target (gate_up sorts
+    # after the attention projections): nothing may be scattered
+    pack = AdapterPack(base, rank=4, alpha=8, max_adapters=2)
+    good = parse_adapter_state_dict(lora_state_dict(ft), 2, pack.targets, 4)
+    bad = dict(good)
+    A_gu, B_gu = bad["mlp.gate_up_proj"]
+    bad["mlp.gate_up_proj"] = (A_gu, B_gu[:, :, :-1])  # truncated out dim
+    before = {t: np.asarray(a).copy() for t, (a, _b) in pack.ab.items()}
+    with pytest.raises(ValueError, match="pack slot expects"):
+        pack.set_slot(1, bad)
+    for t, (a, _b) in pack.ab.items():
+        np.testing.assert_array_equal(np.asarray(a), before[t],
+                                      err_msg=f"{t} mutated by failed set")

@@ -504,3 +504,45 @@ def test_green_distributed_serving_paths_zero_violations():
         assert stats["violations"] == 0
     finally:
         paddle.set_flags(prev)
+
+
+def test_engine_adapter_pack_covered_with_twin():
+    """Multi-tenant LoRA satellite: an adapter-pack engine's per-device
+    estimate includes the pack bytes (via the params-style placements
+    path), a tight HBM budget flags them (failing fixture), and the same
+    engine constructs clean under FLAGS_verify_sharding at the default
+    budget (passing twin)."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64,
+                      dtype="float32")
+    prev = _set_flags(FLAGS_verify_sharding=True)
+    try:
+        # passing twin: adapter engine lints clean at construction and
+        # the estimate carries the pack's exact bytes as its own group
+        eng = GenerationEngine(LlamaForCausalLM(cfg), num_blocks=8,
+                               adapters={"rank": 4, "max_adapters": 2})
+        violations, est = lint_engine(eng)
+        assert violations == []
+        assert est["adapter_pack"] == eng._pack.nbytes > 0
+        # the pack-less twin has no adapter_pack group at all
+        eng2 = GenerationEngine(LlamaForCausalLM(cfg), num_blocks=8)
+        _ok, est2 = lint_engine(eng2)
+        assert "adapter_pack" not in est2
+    finally:
+        paddle.set_flags(prev)
+
+    # failing fixture: a budget below the pack-inclusive estimate names
+    # the over-budget site at engine construction
+    prev = _set_flags(FLAGS_verify_sharding=True,
+                      FLAGS_mesh_lint_hbm_budget_gb=1e-6)
+    try:
+        with pytest.raises(MeshLintError, match="over-budget"):
+            GenerationEngine(LlamaForCausalLM(cfg), num_blocks=8,
+                             adapters={"rank": 4, "max_adapters": 2})
+    finally:
+        paddle.set_flags(prev)

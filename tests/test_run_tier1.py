@@ -61,6 +61,11 @@ def test_build_plan_isolates_collective_modules():
     plan2 = build_plan(_REPO_TESTS, shards=4)
     assert [(s.name, s.files) for s in plan] == \
         [(s.name, s.files) for s in plan2]
+    # the multi-tenant LoRA modules ride ordinary round-robin shards —
+    # no 8-device collectives, so no dedicated isolated worker
+    for mod in ("test_lora.py", "test_serving_lora.py",
+                "test_bench_lora.py"):
+        assert mod in rest_files, mod
 
 
 # -------------------------------------------------------- crash isolation

@@ -1,0 +1,401 @@
+"""Multi-tenant LoRA serving (docs/LORA.md): stacked adapter packs in the
+jitted decode scan, hot-swap at macro-step boundaries with zero
+recompiles, and the adapter-aware prefix cache.
+
+Parity contract under test: a macro-step batching requests of DIFFERENT
+adapters (plus base-model rows at slot 0) emits token streams
+bit-identical to per-adapter serial runs — greedy and seeded sampling,
+chunked and per-token dispatch, loop and LayerStack decoder layouts.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.nn.lora import apply_lora, lora_state_dict
+from paddle_tpu.serving import GenerationEngine
+
+import jax
+import jax.numpy as jnp
+
+_KW = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=4, max_position_embeddings=64,
+           dtype="float32")
+
+
+def _cfg(**kw):
+    from paddle_tpu.models.llama import llama_tiny
+
+    base = dict(_KW)
+    base.update(kw)
+    return llama_tiny(**base)
+
+
+def _model(seed=41, **kw):
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    paddle.seed(seed)
+    m = LlamaForCausalLM(_cfg(**kw))
+    m.eval()
+    return m
+
+
+def _adapter_sd(base, key_seed, b_scale=0.2, rank=4, alpha=8):
+    """An adapter-only state dict whose deltas are large enough to shift
+    greedy argmax (a zero-B adapter is the base model)."""
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    ft = LlamaForCausalLM(_cfg())
+    ft.set_state_dict(base.state_dict())
+    ft.eval()
+    apply_lora(ft, rank=rank, alpha=alpha)
+    key = jax.random.PRNGKey(key_seed)
+    for name, p in ft.named_parameters():
+        if name.endswith(("lora_A", "lora_B")):
+            key, sk = jax.random.split(key)
+            scale = b_scale if name.endswith("lora_B") else 0.05
+            p._bind(jax.random.normal(sk, p._value.shape,
+                                      jnp.float32) * scale)
+    return lora_state_dict(ft)
+
+
+def _drain(eng):
+    out = {}
+    while eng.has_work():
+        for rid, toks in eng.step().items():
+            out.setdefault(rid, []).extend(
+                toks if isinstance(toks, list) else [toks])
+    return out
+
+
+_PROMPTS = {
+    "a0": [5, 9, 17, 33, 2],
+    "a1": [7, 11, 3, 20],
+    "a2": [15, 4, 40, 8, 22, 1],
+    "base": [5, 9, 17, 33, 2],
+}
+_REQ_ADAPTERS = {"a0": "t0", "a1": "t1", "a2": "t2", "base": None}
+
+
+def _register_all(eng, sds):
+    for name, sd in sds.items():
+        eng.register_adapter(name, sd, alpha=8)
+
+
+@pytest.mark.parametrize("decode_chunk", [1, 4])
+@pytest.mark.parametrize("fuse", [False, True])
+def test_mixed_adapter_batch_bit_identical_to_serial(decode_chunk, fuse):
+    """≥3 distinct adapters + a base-slot row in ONE macro-step: streams
+    equal per-adapter serial runs bit-for-bit (greedy), on both decoder
+    layouts and both dispatch widths."""
+    model = _model(fuse_layer_stack=fuse)
+    sds = {f"t{i}": _adapter_sd(model, key_seed=10 + i) for i in range(3)}
+
+    serial = {}
+    for rid, prompt in _PROMPTS.items():
+        eng = GenerationEngine(model, max_batch=1, block_size=8,
+                               num_blocks=16, decode_chunk=decode_chunk,
+                               adapters={"rank": 4, "max_adapters": 3})
+        _register_all(eng, sds)
+        eng.add_request(rid, prompt, max_new_tokens=6,
+                        adapter=_REQ_ADAPTERS[rid])
+        _drain(eng)
+        serial[rid] = eng.result(rid)
+    # the three tenants genuinely decode differently
+    assert len({tuple(v) for v in serial.values()}) >= 3
+
+    mixed = GenerationEngine(model, max_batch=4, block_size=8, num_blocks=32,
+                             decode_chunk=decode_chunk,
+                             adapters={"rank": 4, "max_adapters": 3})
+    _register_all(mixed, sds)
+    for rid, prompt in _PROMPTS.items():
+        mixed.add_request(rid, prompt, max_new_tokens=6,
+                          adapter=_REQ_ADAPTERS[rid])
+    _drain(mixed)
+    for rid in _PROMPTS:
+        assert mixed.result(rid) == serial[rid], rid
+
+
+def test_mixed_adapter_sampled_streams_bit_identical():
+    """Seeded per-request sampling across a mixed-adapter batch: each
+    request's stream matches its serial run.  The PRNG key folds the
+    SUBMIT-order nonce, so the serial engines pin their request counter
+    to the mixed run's nonce — the same (seed, join order) contract the
+    plain engine documents."""
+    model = _model()
+    sds = {f"t{i}": _adapter_sd(model, key_seed=20 + i) for i in range(3)}
+    order = list(_PROMPTS)
+
+    mixed = GenerationEngine(model, max_batch=4, block_size=8, num_blocks=32,
+                             adapters={"rank": 4, "max_adapters": 3})
+    _register_all(mixed, sds)
+    for rid in order:
+        mixed.add_request(rid, _PROMPTS[rid], max_new_tokens=6,
+                          adapter=_REQ_ADAPTERS[rid],
+                          temperature=0.9, seed=5)
+    _drain(mixed)
+
+    for nonce, rid in enumerate(order):
+        eng = GenerationEngine(model, max_batch=1, block_size=8,
+                               num_blocks=16,
+                               adapters={"rank": 4, "max_adapters": 3})
+        _register_all(eng, sds)
+        eng._req_counter = nonce  # align the submit-order nonce
+        eng.add_request(rid, _PROMPTS[rid], max_new_tokens=6,
+                        adapter=_REQ_ADAPTERS[rid], temperature=0.9, seed=5)
+        _drain(eng)
+        assert eng.result(rid) == mixed.result(rid), rid
+
+
+def test_slot0_base_parity_with_lora_free_engine():
+    """Base-model requests on an adapter engine (slot 0, zero gathers)
+    stream identically to a LoRA-free engine — even sharing a macro-step
+    with adapted tenants."""
+    model = _model()
+    plain = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=16)
+    plain.add_request("b", _PROMPTS["base"], max_new_tokens=8)
+    _drain(plain)
+
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=32,
+                           adapters={"rank": 4, "max_adapters": 2})
+    eng.register_adapter("t", _adapter_sd(model, key_seed=30), alpha=8)
+    eng.add_request("b", _PROMPTS["base"], max_new_tokens=8)
+    eng.add_request("l", _PROMPTS["a1"], max_new_tokens=8, adapter="t")
+    _drain(eng)
+    assert eng.result("b") == plain.result("b")
+
+
+def test_hot_swap_zero_recompiles_and_subtree_invalidation():
+    """Swapping an adapter on a live engine: (a) compile_stats shows ZERO
+    new XLA compiles for the swap + the swapped tenant's serve, and
+    (b) exactly the swapped slot's prefix-cache subtree is invalidated."""
+    model = _model()
+    sd_a = _adapter_sd(model, key_seed=40)
+    sd_b = _adapter_sd(model, key_seed=41)
+    sd_w = _adapter_sd(model, key_seed=42)
+    sys_prompt = list(range(1, 25))  # 3 full blocks at block_size 8
+
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=32,
+                           adapters={"rank": 4, "max_adapters": 1},
+                           prefix_cache=True)
+    eng.register_adapter("a", sd_a, alpha=8)
+    eng.add_request("r1", sys_prompt, max_new_tokens=4, adapter="a")
+    _drain(eng)
+    # second tenant under the same adapter shares the cached prefix
+    eng.add_request("r2", sys_prompt, max_new_tokens=4, adapter="a")
+    _drain(eng)
+    st = profiler.decode_stats()
+    assert st["prefix_hits"] >= 1 and st["prefix_hit_tokens"] >= 16
+    assert eng.result("r2") == eng.result("r1")
+
+    # one full warm swap cycle first: the swap machinery's scatter shapes
+    # AND the eager dispatch cache's hotness ramp (prefill op signatures
+    # jit-compile on their 4th call) both settle before the measured
+    # window — what must be zero afterwards is ALL of it
+    eng.register_adapter("w", sd_w, alpha=8)  # evicts idle 'a': a swap
+    eng.add_request("rw", sys_prompt, max_new_tokens=4, adapter="w")
+    _drain(eng)
+
+    cached = len(eng._prefix)
+    free0 = len(eng._free)
+    c0 = profiler.compile_stats()["compiles"]
+    eng.register_adapter("b", sd_b, alpha=8)     # swap again: evicts 'w'
+    eng.add_request("r3", sys_prompt, max_new_tokens=4, adapter="b")
+    _drain(eng)
+    # the swap + the swapped tenant's full serve: ZERO new XLA compiles
+    assert profiler.compile_stats()["compiles"] - c0 == 0
+    assert eng.result("r3")  # the swapped tenant actually served
+
+    # exactly the swapped slot's subtree (3 full prompt blocks) was
+    # dropped at swap time and its reclaimable pages freed; r3 re-cached
+    # 3 blocks under the NEW epoch afterwards, so the totals balance
+    assert len(eng._prefix) == cached  # -3 dropped, +3 re-cached by r3
+    assert len(eng._free) >= free0 - 3
+    # the new tenant got a MISS (no cross-adapter/cross-epoch match) ...
+    st = profiler.decode_stats()
+    assert st["prefix_misses"] >= 2
+    # ... and the new epoch's subtree serves hits again
+    eng.add_request("r4", sys_prompt, max_new_tokens=4, adapter="b")
+    _drain(eng)
+    assert eng.result("r4") == eng.result("r3")
+    assert profiler.decode_stats()["prefix_hits"] > st["prefix_hits"]
+
+
+def test_adapter_prefix_namespaces_never_cross_match():
+    """Same prompt under adapter A, adapter B, and the base slot: three
+    distinct namespaces — each first admission misses, each second one
+    hits its own namespace only."""
+    from paddle_tpu.serving import decode_stats, reset_decode_stats
+
+    model = _model()
+    reset_decode_stats()
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=64,
+                           adapters={"rank": 4, "max_adapters": 2},
+                           prefix_cache=True)
+    eng.register_adapter("A", _adapter_sd(model, key_seed=50), alpha=8)
+    eng.register_adapter("B", _adapter_sd(model, key_seed=51), alpha=8)
+    prompt = list(range(1, 25))
+    for i, ad in enumerate([None, "A", "B"]):
+        eng.add_request(f"m{i}", prompt, max_new_tokens=3, adapter=ad)
+        _drain(eng)
+    assert decode_stats()["prefix_hits"] == 0
+    assert decode_stats()["prefix_misses"] == 3
+    for i, ad in enumerate([None, "A", "B"]):
+        eng.add_request(f"h{i}", prompt, max_new_tokens=3, adapter=ad)
+        _drain(eng)
+        assert eng.result(f"h{i}") == eng.result(f"m{i}")
+    assert decode_stats()["prefix_hits"] == 3
+
+
+def test_slot_exhaustion_queues_and_matches_immediate_bit_for_bit():
+    """An adapter request that cannot get a pack slot RIGHT NOW (every
+    slot pinned by in-flight requests) queues — same FIFO retry contract
+    as pool exhaustion — and its retried stream (seeded sampling) matches
+    an immediate admission bit-for-bit."""
+    model = _model()
+    sd_a = _adapter_sd(model, key_seed=60)
+    sd_b = _adapter_sd(model, key_seed=61)
+    prompt = _PROMPTS["a0"]
+
+    def run(max_adapters):
+        eng = GenerationEngine(model, max_batch=2, block_size=8,
+                               num_blocks=32,
+                               adapters={"rank": 4,
+                                         "max_adapters": max_adapters})
+        eng.register_adapter("a", sd_a, alpha=8)
+        first_long = eng.add_request("long", prompt, max_new_tokens=10,
+                                     adapter="a", temperature=0.5, seed=11)
+        slot_b = eng.register_adapter("b", sd_b, alpha=8)
+        first_x = eng.add_request("x", prompt, max_new_tokens=6, adapter="b",
+                                  temperature=0.8, seed=3)
+        assert first_long is not None
+        streams = _drain(eng)
+        return eng, slot_b, first_x, streams
+
+    ref, slot_imm, first_imm, _ = run(max_adapters=2)
+    assert slot_imm is not None and first_imm is not None
+
+    eng, slot_q, first_q, streams = run(max_adapters=1)
+    # register while the only slot is in flight: registered, NOT raised,
+    # install deferred; the request queues (add_request -> None)
+    assert slot_q is None and first_q is None
+    assert eng.result("x") == ref.result("x")
+    assert eng.result("long") == ref.result("long")
+    # step() surfaced the queued request's prefill first token: the full
+    # per-step stream equals the result list (typing contract)
+    assert streams["x"] == eng.result("x")
+
+
+def test_evict_adapter_contract():
+    model = _model()
+    sd = _adapter_sd(model, key_seed=70)
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=32,
+                           adapters={"rank": 4, "max_adapters": 2})
+    eng.register_adapter("t", sd, alpha=8)
+    assert eng.adapter_slots() == {"t": 1}
+    eng.add_request("r", _PROMPTS["a0"], max_new_tokens=8, adapter="t")
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.evict_adapter("t")  # active request pins the slot
+    _drain(eng)
+    eng.evict_adapter("t")
+    assert eng.adapter_slots() == {}
+    with pytest.raises(KeyError):
+        eng.evict_adapter("t")  # no longer registered
+    with pytest.raises(KeyError, match="not registered"):
+        eng.add_request("r2", _PROMPTS["a0"], max_new_tokens=4, adapter="t")
+
+
+def test_adapterless_engine_and_bad_combos_are_loud():
+    model = _model()
+    eng = GenerationEngine(model, max_batch=1, block_size=8, num_blocks=16)
+    with pytest.raises(RuntimeError, match="without adapters="):
+        eng.register_adapter("t", {})
+    with pytest.raises(RuntimeError, match="without adapters="):
+        eng.add_request("r", [1, 2], max_new_tokens=2, adapter="t")
+    draft = _model(seed=5)
+    with pytest.raises(ValueError, match="speculative"):
+        GenerationEngine(model, max_batch=1, block_size=8, num_blocks=16,
+                         draft_model=draft, adapters={"rank": 4})
+    with pytest.raises(TypeError, match="adapters"):
+        GenerationEngine(model, max_batch=1, block_size=8, num_blocks=16,
+                         adapters="rank4")
+
+
+def test_lora_stats_and_summary_footer(capsys):
+    from paddle_tpu.serving import reset_lora_stats
+
+    model = _model()
+    reset_lora_stats()
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=32,
+                           adapters={"rank": 4, "max_adapters": 2})
+    eng.register_adapter("t", _adapter_sd(model, key_seed=80), alpha=8)
+    eng.add_request("r", _PROMPTS["a0"], max_new_tokens=4, adapter="t")
+    _drain(eng)
+    st = profiler.lora_stats()
+    assert st["slots_total"] == 2
+    assert st["slots_resident"] == 1
+    assert st["swaps"] == 1
+    assert st["gather_dispatches"] >= 1
+    assert st["cache_epochs"] == 1
+    prof = profiler.Profiler(timer_only=True)
+    with prof:
+        pass
+    out = prof.summary()
+    assert "LoRA serving:" in out
+    assert "slots=1/2" in out
+
+
+def test_reregister_resident_adapter_updates_in_place():
+    """Re-registering a RESIDENT name must serve the NEW weights (and
+    invalidate the slot's cached prefixes) — not silently keep v1; with
+    in-flight requests it refuses (mid-stream weight changes are never
+    right).  Regression: _try_install used to short-circuit on the
+    resident slot and return it without re-scattering."""
+    model = _model()
+    sd_v1 = _adapter_sd(model, key_seed=90)
+    sd_v2 = _adapter_sd(model, key_seed=91)
+    prompt = _PROMPTS["a0"]
+
+    # oracle: v2 served on a fresh engine
+    ref = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=32,
+                           adapters={"rank": 4, "max_adapters": 2})
+    ref.register_adapter("t", sd_v2, alpha=8)
+    ref.add_request("x", prompt, max_new_tokens=6, adapter="t")
+    _drain(ref)
+
+    eng = GenerationEngine(model, max_batch=2, block_size=8, num_blocks=32,
+                           adapters={"rank": 4, "max_adapters": 2},
+                           prefix_cache=True)
+    slot1 = eng.register_adapter("t", sd_v1, alpha=8)
+    eng.add_request("r1", prompt, max_new_tokens=10, adapter="t")
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.register_adapter("t", sd_v2, alpha=8)  # active request
+    _drain(eng)
+    epoch0 = eng._slot_epochs[slot1]
+    assert eng.register_adapter("t", sd_v2, alpha=8) == slot1
+    assert eng._slot_epochs[slot1] == epoch0 + 1  # stale prefixes die
+    eng.add_request("r2", prompt, max_new_tokens=6, adapter="t")
+    _drain(eng)
+    assert eng.result("r2") == ref.result("x")  # v2, not stale v1
+    assert eng.result("r2") != eng.result("r1")[:6]
+
+
+def test_reset_lora_stats_preserves_gauges():
+    """slots_resident/slots_total describe LIVE engine state; a counter
+    reset must not zero them (the summary footer would vanish or render
+    slots=1/0 after the next swap)."""
+    from paddle_tpu.serving import reset_lora_stats
+
+    model = _model()
+    eng = GenerationEngine(model, max_batch=1, block_size=8, num_blocks=16,
+                           adapters={"rank": 4, "max_adapters": 3})
+    reset_lora_stats()  # drop counters accumulated by earlier tests
+    eng.register_adapter("t", _adapter_sd(model, key_seed=95), alpha=8)
+    st = profiler.lora_stats(reset=True)
+    assert st["swaps"] == 1
+    after = profiler.lora_stats()
+    assert after["swaps"] == 0  # counter cleared
+    assert after["slots_resident"] == 1  # gauges survive
+    assert after["slots_total"] == 3
