@@ -29,6 +29,8 @@ __all__ = [
     "LlamaModel",
     "LlamaDecoderLayer",
     "shard_llama",
+    "LLAMA_TP_COL_TARGETS",
+    "LLAMA_TP_ROW_TARGETS",
     "pipeline_llama",
     "context_parallel_llama",
     "llama_tiny",
@@ -973,6 +975,16 @@ class LlamaForCausalLM(nn.Layer):
         return paddle.concat(out_tokens, axis=1)
 
 
+# Megatron TP kinds of the per-layer target projections — the ONE
+# classification shared by shard_llama's placement walk and
+# nn.lora.AdapterPack.place_over_mesh, so a serving adapter's low-rank
+# factors always ride the same axis split as their base projection
+# (column-parallel output dims vs row-parallel input dims).
+LLAMA_TP_COL_TARGETS = ("self_attn.q_proj", "self_attn.k_proj",
+                        "self_attn.v_proj", "mlp.gate_up_proj")
+LLAMA_TP_ROW_TARGETS = ("self_attn.o_proj", "mlp.down_proj")
+
+
 def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):
     """Apply Megatron-style tensor-parallel placements to a LlamaForCausalLM.
 
@@ -1012,9 +1024,7 @@ def shard_llama(model: "LlamaForCausalLM", mesh, mp_axis: str = "mp"):
 
         shard_stacked_params(
             model.model.layers, mesh, place,
-            col_keys=("self_attn.q_proj", "self_attn.k_proj",
-                      "self_attn.v_proj", "mlp.gate_up_proj"),
-            row_keys=("self_attn.o_proj", "mlp.down_proj"))
+            col_keys=LLAMA_TP_COL_TARGETS, row_keys=LLAMA_TP_ROW_TARGETS)
     else:
         for blk in model.model.layers:
             for col in (blk.self_attn.q_proj, blk.self_attn.k_proj, blk.self_attn.v_proj, blk.mlp.gate_up_proj):

@@ -340,6 +340,73 @@ class AdapterPack:
             self._zero_slot[t] = (jnp.zeros((L, lin.in_features, r), dt),
                                   jnp.zeros((L, r, lin.out_features), dt))
         self.scaling = jnp.zeros((S,), jnp.float32)
+        # tensor-parallel placements (place_over_mesh): {target: (A, B)}
+        # NamedShardings plus one for scaling — None on single-device packs
+        self._shardings = None
+        self._scaling_sharding = None
+
+    def place_over_mesh(self, mesh, mp_axis="mp", col_targets=None,
+                        row_targets=None):
+        """Place the pack's slot-stacked factors over a tensor-parallel
+        mesh so adapter serving composes with a TP-sharded engine.
+
+        The factors ride the SAME axis split as their base projections
+        (models.llama.shard_llama): a COLUMN-parallel target (q/k/v,
+        gate_up — output dim sharded) shards ``B [L, S, r, out]`` on its
+        out dim and keeps ``A`` replicated, so the delta ``(x A) B`` lands
+        sharded exactly like the base projection's output; a ROW-parallel
+        target (o_proj, down_proj — input dim sharded) shards
+        ``A [L, S, in, r]`` on its in dim and keeps ``B`` replicated, so
+        the ``x A`` contraction produces the partial sums GSPMD psums
+        where the base row-parallel matmul already does.  ``scaling``
+        stays replicated.  Dims the mp axis does not divide fall back to
+        replication (adapter factors are small; the mesh lint's
+        replicated-giant threshold still applies).
+
+        The shardings are RECORDED and re-applied after every
+        ``set_slot`` / ``clear_slot`` scatter, so the swap executables
+        and the decode step see ONE argument-sharding signature across
+        hot swaps — the zero-recompile contract survives the mesh.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if col_targets is None or row_targets is None:
+            from paddle_tpu.models.llama import (LLAMA_TP_COL_TARGETS,
+                                                 LLAMA_TP_ROW_TARGETS)
+
+            col_targets = (LLAMA_TP_COL_TARGETS if col_targets is None
+                           else col_targets)
+            row_targets = (LLAMA_TP_ROW_TARGETS if row_targets is None
+                           else row_targets)
+        mesh = getattr(mesh, "jax_mesh", mesh)  # ProcessMesh or jax Mesh
+        mp = int(mesh.shape[mp_axis])
+        replicated = NamedSharding(mesh, PartitionSpec())
+        self._shardings = {}
+        for t, (A, B) in self.ab.items():
+            a_sh = b_sh = replicated
+            if t in row_targets and A.shape[2] % mp == 0:
+                a_sh = NamedSharding(
+                    mesh, PartitionSpec(None, None, mp_axis, None))
+            elif t in col_targets and B.shape[3] % mp == 0:
+                b_sh = NamedSharding(
+                    mesh, PartitionSpec(None, None, None, mp_axis))
+            self._shardings[t] = (a_sh, b_sh)
+        self._scaling_sharding = replicated
+        self._replace()
+        return self
+
+    def _replace(self):
+        """Re-commit every pack array to its recorded placement (no-op on
+        single-device packs).  Called after construction placement and
+        after each slot scatter: the scatter's output sharding is XLA's
+        to propagate, and the decode step's zero-recompile contract needs
+        the argument shardings bit-stable across swaps."""
+        if self._shardings is None:
+            return
+        for t, (a_sh, b_sh) in self._shardings.items():
+            A, B = self.ab[t]
+            self.ab[t] = (jax.device_put(A, a_sh), jax.device_put(B, b_sh))
+        self.scaling = jax.device_put(self.scaling, self._scaling_sharding)
 
     @property
     def nbytes(self) -> int:
@@ -388,6 +455,7 @@ class AdapterPack:
             self.ab[t] = (A.at[:, slot].set(na), B.at[:, slot].set(nb))
         a = float(alpha) if alpha is not None else self.alpha
         self.scaling = self.scaling.at[slot].set(a / self.rank)
+        self._replace()
         return self
 
     def clear_slot(self, slot):
@@ -402,6 +470,7 @@ class AdapterPack:
             za, zb = self._zero_slot[t]
             self.ab[t] = (A.at[:, slot].set(za), B.at[:, slot].set(zb))
         self.scaling = self.scaling.at[slot].set(0.0)
+        self._replace()
         return self
 
 

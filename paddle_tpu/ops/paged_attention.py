@@ -37,6 +37,7 @@ __all__ = [
     "paged_chunk_attention",
     "pool_num_kv_heads",
     "pool_nbytes",
+    "pool_device_nbytes",
     "pool_parts",
     "pool_stack",
     "pool_index",
@@ -88,6 +89,27 @@ def pool_num_kv_heads(cache):
 def pool_nbytes(cache):
     """Resident bytes of a paged pool (payload + scales for QuantPool)."""
     return cache.nbytes
+
+
+def pool_device_nbytes(cache):
+    """PER-DEVICE resident bytes of a paged pool: each leaf's committed
+    sharding divides its global bytes (``shard_shape``); uncommitted or
+    single-device leaves count whole.  The serving telemetry's
+    ``pool_bytes_per_device`` (and the mesh lint's per-device HBM
+    estimate) see the TP-sharded engine's true per-chip footprint through
+    this — a KV-head-sharded pool on an mp=4 mesh reports a quarter of
+    ``pool_nbytes`` here."""
+    total = 0
+    for _name, arr in pool_parts(cache):
+        shape = arr.shape
+        sharding = getattr(arr, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(arr.shape)
+            except (TypeError, ValueError):
+                pass  # abstract/placeholder leaf: count it whole
+        total += math.prod(shape) * arr.dtype.itemsize
+    return total
 
 
 def pool_parts(cache):

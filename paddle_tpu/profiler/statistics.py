@@ -219,6 +219,13 @@ def decode_line(stats: dict) -> str:
                stats.get("pool_bytes_per_resident", 0.0),
                stats.get("resident_peak", 0))
         )
+    if stats.get("mesh_shape"):
+        # TP-sharded engine: per-device pool footprint vs the global total
+        line += (
+            "\nSharded serving: mesh=%s pool_bytes/device=%d (global %d)"
+            % (stats["mesh_shape"], stats.get("pool_bytes_per_device", 0),
+               stats.get("pool_bytes", 0))
+        )
     return line
 
 

@@ -59,6 +59,13 @@ _DECODE_STATS = {
     # requests observed — bytes/resident is the int8-KV capacity metric
     "pool_bytes": 0,
     "resident_peak": 0,
+    # sharded-serving tier: the most recent engine's PER-DEVICE pool
+    # bytes (each pool leaf's committed sharding divides its global
+    # bytes — ops.paged_attention.pool_device_nbytes over pool_parts)
+    # and the mesh shape string ("" on single-device engines); the
+    # Profiler.summary() serving footer prints both when sharded
+    "pool_bytes_per_device": 0,
+    "mesh_shape": "",
 }
 
 
@@ -67,8 +74,10 @@ def decode_stats(reset: bool = False) -> dict:
     seconds, total step() seconds, and derived tokens_per_sec.  A healthy
     macro-stepping engine shows tokens >> dispatches; tokens ~= dispatches
     means the per-token path (FLAGS_decode_chunk=1) is active.  Also the
-    prefix-cache hit/miss/avoided-token/eviction counters and the derived
-    pool_bytes_per_resident capacity metric (docs/DECODE.md)."""
+    prefix-cache hit/miss/avoided-token/eviction counters, the derived
+    pool_bytes_per_resident capacity metric, and — for TP-sharded
+    engines — pool_bytes_per_device (sharding-divided pool bytes) plus
+    the mesh_shape string (docs/DECODE.md)."""
     out = dict(_DECODE_STATS)
     out["tokens_per_sec"] = (
         out["tokens"] / out["step_seconds"] if out["step_seconds"] else 0.0)
@@ -82,7 +91,9 @@ def decode_stats(reset: bool = False) -> dict:
 
 def reset_decode_stats():
     for k in _DECODE_STATS:
-        _DECODE_STATS[k] = 0.0 if isinstance(_DECODE_STATS[k], float) else 0
+        v = _DECODE_STATS[k]
+        _DECODE_STATS[k] = "" if isinstance(v, str) else (
+            0.0 if isinstance(v, float) else 0)
 
 
 # Multi-tenant LoRA serving counters (profiler.lora_stats reads them):
@@ -336,7 +347,14 @@ class GenerationEngine:
         placements (models.llama.shard_llama), the paged-KV pool is sharded
         over the KV-head dim, and the ONE compiled decode program runs
         GSPMD-partitioned over the mesh (VERDICT r3 #6; reference capability:
-        analysis_predictor multi-device serving).
+        analysis_predictor multi-device serving).  The WHOLE feature set
+        composes with the mesh: int8 pools shard payload + quant scales
+        leaf-wise on the same KV-head spec, adapter packs place their A/B
+        factors on their base projections' Megatron split
+        (nn.AdapterPack.place_over_mesh), speculative engines shard the
+        draft model and its pools too, and token streams stay
+        bit-identical to the single-device engine (docs/DECODE.md
+        sharded-serving section).
 
         decode_chunk (None -> FLAGS_decode_chunk): macro-step width D —
         step() advances D tokens per compiled dispatch (a lax.scan over the
@@ -371,7 +389,11 @@ class GenerationEngine:
         compiled decode step — which gathers each batch row's A/B by its
         slot index — is reused across swaps with zero recompiles.
         Requests pick an adapter via add_request(..., adapter=name);
-        mixed-adapter batches decode in ONE dispatch."""
+        mixed-adapter batches decode in ONE dispatch.  With draft_model=
+        the DRAFT proposes with the base model (no per-tenant draft
+        packs) while the target verifies through each row's adapter —
+        emitted streams equal the plain adapter engine's; a
+        heavily-shifted tenant just pays a lower acceptance rate."""
         cfg = model.config
         self.model = model
         if prefill_chunk is not None and int(prefill_chunk) < 1:
@@ -384,7 +406,8 @@ class GenerationEngine:
         self._nkv = cfg.num_key_value_heads
         self._head_dim = cfg.hidden_size // cfg.num_attention_heads
 
-        self._pool_sharding = None
+        self._pool_sharding = self._d_pool_sharding = None
+        self._mp_axis = mp_axis
         if mesh is not None:
             from paddle_tpu.distributed.auto_parallel import ProcessMesh
             from paddle_tpu.models.llama import shard_llama
@@ -395,22 +418,10 @@ class GenerationEngine:
                 raise ValueError(
                     f"mesh has no {mp_axis!r} axis: {mesh.dim_names}")
             shard_llama(model, mesh, mp_axis=mp_axis)
-            mp = mesh.get_dim_size(mp_axis)
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            if self._nkv % mp == 0:
-                # pool pages sharded over KV heads: each mp rank holds its
-                # heads' pages; the paged-attention gather stays local
-                self._pool_sharding = NamedSharding(
-                    mesh.jax_mesh, PartitionSpec(None, mp_axis))
-            else:
-                import warnings
-
-                warnings.warn(
-                    f"num_key_value_heads={self._nkv} not divisible by "
-                    f"mp={mp}; KV pool replicated", stacklevel=2)
-                self._pool_sharding = NamedSharding(
-                    mesh.jax_mesh, PartitionSpec())
+            # pool pages sharded over KV heads: each mp rank holds its
+            # heads' pages; the paged-attention gather stays local
+            self._pool_sharding = self._kv_pool_sharding(
+                mesh, mp_axis, self._nkv, "")
         self.mesh = mesh
 
         from paddle_tpu.ops import paged_attention as pa
@@ -424,25 +435,20 @@ class GenerationEngine:
         if kv_dt not in ("bf16", "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be 'bf16' or 'int8', got {kv_dt!r}")
-        if kv_dt == "int8" and mesh is not None:
-            raise NotImplementedError(
-                "kv_cache_dtype='int8' (FLAGS_kv_cache_dtype) does not "
-                "compose with the tensor-parallel mesh engine (mesh=) "
-                "yet: QuantPool's per-block-per-head scales would need "
-                "the same KV-head sharding as the pool payload.  Drop one "
-                "knob — kv_cache_dtype='bf16' with mesh=, or int8 pools "
-                "on a single device (mesh=None)")
         self._kv_dtype = kv_dt  # resolved ONCE: pools are allocated now
         dt = (jnp.int8 if kv_dt == "int8"
               else jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32)
         pools = [pa.alloc_paged_cache(total, self._nkv, self.block_size,
                                       self._head_dim, dt)
                  for _ in range(self._n_layers)]
-        self._kpools = [k for k, _ in pools]
-        self._vpools = [v for _, v in pools]
-        if self._pool_sharding is not None:
-            self._kpools = [jax.device_put(k, self._pool_sharding) for k in self._kpools]
-            self._vpools = [jax.device_put(v, self._pool_sharding) for v in self._vpools]
+        # leaf-wise placement: a QuantPool's int8 payload [blocks,Nkv,bs,H]
+        # and its f32 scales [blocks,Nkv] both shard on the KV-head dim
+        # (the same PartitionSpec(None, mp) covers both ranks — trailing
+        # dims replicate), so int8 pools compose with the mesh engine
+        self._kpools = [self._place_pool(k, self._pool_sharding)
+                        for k, _ in pools]
+        self._vpools = [self._place_pool(v, self._pool_sharding)
+                        for _, v in pools]
         self._free = list(range(self._num_blocks))
         self._ref = [0] * total  # per-block request refcounts (allocator)
         pc = (bool(prefix_cache) if prefix_cache is not None
@@ -477,20 +483,28 @@ class GenerationEngine:
             dc = draft_model.config
             if dc.vocab_size != cfg.vocab_size:
                 raise ValueError("draft and target must share a vocabulary")
-            if mesh is not None:
-                raise ValueError(
-                    "speculative decoding is not combined with the "
-                    "tensor-parallel mesh engine yet")
+            if mesh is not None and draft_model is not model:
+                # the draft serves the same mesh: Megatron placements on
+                # its weights, its pools sharded over ITS KV-head count
+                # (which may differ from the target's)
+                from paddle_tpu.models.llama import shard_llama
+
+                shard_llama(draft_model, mesh, mp_axis=mp_axis)
             self._d_layers = dc.num_hidden_layers
             self._d_nkv = dc.num_key_value_heads
             self._d_hd = dc.hidden_size // dc.num_attention_heads
+            if mesh is not None:
+                self._d_pool_sharding = self._kv_pool_sharding(
+                    mesh, mp_axis, self._d_nkv, "draft ")
             ddt = (jnp.int8 if kv_dt == "int8"
                    else jnp.bfloat16 if dc.dtype == "bfloat16" else jnp.float32)
             d_pools = [pa.alloc_paged_cache(total, self._d_nkv,
                                             self.block_size, self._d_hd, ddt)
                        for _ in range(self._d_layers)]
-            self._d_kpools = [k for k, _ in d_pools]
-            self._d_vpools = [v for _, v in d_pools]
+            self._d_kpools = [self._place_pool(k, self._d_pool_sharding)
+                              for k, _ in d_pools]
+            self._d_vpools = [self._place_pool(v, self._d_pool_sharding)
+                              for _, v in d_pools]
             self._d_state = list(draft_model.state_dict().values())
             self._spec_stats = {"ticks": 0, "proposed": 0, "accepted": 0,
                                 "emitted": 0}
@@ -500,20 +514,12 @@ class GenerationEngine:
         if adapters is not None:
             from paddle_tpu.nn.lora import AdapterPack
 
-            if draft_model is not None:
-                raise ValueError(
-                    "adapters= (multi-tenant LoRA) is not combined with "
-                    "speculative decoding yet: the draft model would need "
-                    "its own per-tenant pack for acceptance to stay "
-                    "meaningful — drop one knob")
-            if mesh is not None:
-                raise NotImplementedError(
-                    "adapters= (multi-tenant LoRA) does not compose with "
-                    "the tensor-parallel mesh engine (mesh=) yet: the "
-                    "pack's column/row adapter factors would need the "
-                    "same Megatron placements as their base projections.  "
-                    "Drop one knob — adapters= on a single device, or "
-                    "mesh= without adapters")
+            # speculative + adapters composes with a BASE-MODEL draft:
+            # the draft proposes adapter-free tokens and the target
+            # verifies through each row's adapter, so the emitted stream
+            # is exactly the plain adapter engine's (greedy acceptance
+            # only ever keeps tokens the adapted target would decode) —
+            # a heavily-shifted tenant just pays a lower acceptance rate
             if isinstance(adapters, AdapterPack):
                 self._pack = adapters
             elif isinstance(adapters, int):
@@ -524,6 +530,12 @@ class GenerationEngine:
                 raise TypeError(
                     "adapters must be an int rank, a config dict, or an "
                     f"nn.AdapterPack; got {type(adapters).__name__}")
+            if mesh is not None:
+                # A/B factors ride the base projections' Megatron split
+                # (col targets shard B's out dim, row targets shard A's
+                # in dim); recorded shardings are re-applied after every
+                # slot scatter so hot swaps keep one compiled signature
+                self._pack.place_over_mesh(mesh.jax_mesh, mp_axis=mp_axis)
             S = self._pack.num_slots
             self._adapter_registry: dict = {}   # name -> (arrays, alpha)
             self._slot_names = [None] * S       # slot -> installed name
@@ -533,10 +545,17 @@ class GenerationEngine:
             self._slot_clock = 0
             _LORA_STATS["slots_total"] = S - 1
             _LORA_STATS["slots_resident"] = 0
-        _DECODE_STATS["pool_bytes"] = sum(
-            pa.pool_nbytes(p) for p in
-            self._kpools + self._vpools
-            + getattr(self, "_d_kpools", []) + getattr(self, "_d_vpools", []))
+        all_pools = (self._kpools + self._vpools
+                     + getattr(self, "_d_kpools", [])
+                     + getattr(self, "_d_vpools", []))
+        _DECODE_STATS["pool_bytes"] = sum(pa.pool_nbytes(p)
+                                          for p in all_pools)
+        # per-device footprint: each pool leaf's committed sharding
+        # divides its bytes (== pool_bytes on single-device engines)
+        _DECODE_STATS["pool_bytes_per_device"] = sum(
+            pa.pool_device_nbytes(p) for p in all_pools)
+        _DECODE_STATS["mesh_shape"] = "" if mesh is None else "x".join(
+            f"{n}{s}" for n, s in zip(mesh.dim_names, mesh.shape))
         if _flags.flag("FLAGS_verify_sharding"):
             # mesh lint at construction: param/pool placements, pool
             # donation aliasing, per-device HBM estimate — abstract, so a
@@ -545,6 +564,36 @@ class GenerationEngine:
             from paddle_tpu.static.mesh_lint import lint_engine
 
             lint_engine(self, raise_on_error=True)
+
+    # ------------------------------------------------------ pool placement
+    @staticmethod
+    def _kv_pool_sharding(mesh, mp_axis, nkv, who):
+        """NamedSharding for a paged pool on the TP mesh: pages shard
+        over the KV-head dim (axis 1) when the axis divides the head
+        count; otherwise replicated with a warning.  The SAME spec covers
+        a QuantPool's rank-2 scales [blocks, Nkv] — trailing dims
+        replicate — so int8 pools place leaf-wise through it."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mp = mesh.get_dim_size(mp_axis)
+        if nkv % mp == 0:
+            return NamedSharding(mesh.jax_mesh,
+                                 PartitionSpec(None, mp_axis))
+        import warnings
+
+        warnings.warn(
+            f"num_key_value_heads={nkv} not divisible by mp={mp}; "
+            f"{who}KV pool replicated", stacklevel=3)
+        return NamedSharding(mesh.jax_mesh, PartitionSpec())
+
+    @staticmethod
+    def _place_pool(pool, sharding):
+        """Commit a pool (plain array or QuantPool pytree) to `sharding`
+        leaf-wise; identity when sharding is None (single device)."""
+        if sharding is None:
+            return pool
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), pool)
 
     # ------------------------------------------------------------ requests
     def has_work(self):
@@ -906,8 +955,8 @@ class GenerationEngine:
             # pour the suffix K/V into this request's exclusive pages
             # (matched prefix pages are shared and immutable)
             self._pour(self._kpools, self._vpools, caches, blocks, s0,
-                       self._nkv, self._head_dim, sharded=True,
-                       start_tok=m_len)
+                       self._nkv, self._head_dim,
+                       sharding=self._pool_sharding, start_tok=m_len)
             if self.draft_model is not None:
                 # draft prefill over the same suffix into the draft pools
                 # (cached pages were poured to BOTH pool sets at insert
@@ -921,7 +970,8 @@ class GenerationEngine:
                         self.draft_model.model,
                         paddle.to_tensor(prompt[:, m_len:]), d_caches, m_len)
                 self._pour(self._d_kpools, self._d_vpools, d_caches, blocks,
-                           s0, self._d_nkv, self._d_hd, start_tok=m_len)
+                           s0, self._d_nkv, self._d_hd,
+                           sharding=self._d_pool_sharding, start_tok=m_len)
                 slot.d_seq_len = s0
         except BaseException:
             # back out cleanly: pour only ever wrote the fresh pages, so
@@ -1019,7 +1069,7 @@ class GenerationEngine:
         return out
 
     def _pour(self, kpools, vpools, caches, blocks, s0, nkv, head_dim,
-              sharded=False, start_tok=0):
+              sharding=None, start_tok=0):
         """Scatter naive prefill caches into a request's pool pages.
 
         start_tok (always block-aligned) skips the prefix-matched region:
@@ -1046,11 +1096,11 @@ class GenerationEngine:
             vv = vv.reshape(nkv, n_t, bs, head_dim).swapaxes(0, 1)
             kpools[li] = pa.paged_pour_blocks(kpools[li], kv, idx)
             vpools[li] = pa.paged_pour_blocks(vpools[li], vv, idx)
-            if sharded and self._pool_sharding is not None:
+            if sharding is not None:
                 # keep the pool committed to its head-sharded layout so the
                 # decode executable's input shardings stay stable
-                kpools[li] = jax.device_put(kpools[li], self._pool_sharding)
-                vpools[li] = jax.device_put(vpools[li], self._pool_sharding)
+                kpools[li] = self._place_pool(kpools[li], sharding)
+                vpools[li] = self._place_pool(vpools[li], sharding)
 
     def _finish(self, slot):
         self._results[slot.rid] = list(slot.generated)
@@ -1200,11 +1250,23 @@ class GenerationEngine:
 
         model = self.model
         state = self._state
+        has_pack = self._pack is not None
 
-        def verify(state_vals, kpools, vpools, tokens, tables, lens):
+        def verify(state_vals, kpools, vpools, tokens, tables, lens,
+                   *lora_args):
             """tokens [B, K+1]; lens INCLUDING the whole chunk; returns
             preds [B, K+1] (greedy next token after each chunk position)
-            plus the written pools."""
+            plus the written pools.  On adapter engines the extra args
+            are the per-row slot vector + the pack's A/B and scaling
+            (same contract as the plain macro-step): the TARGET verifies
+            through each row's adapter even though the draft proposed
+            with the base model, so acceptance only ever keeps tokens
+            the adapted model would decode."""
+            if has_pack:
+                ad_slots, pack_ab, pack_scaling = lora_args
+                row_scale = jnp.take(pack_scaling, ad_slots)  # [B]
+            else:
+                ad_slots = pack_ab = row_scale = None
             originals = [t._value for t in state]
             try:
                 for t, v in zip(state, state_vals):
@@ -1215,7 +1277,8 @@ class GenerationEngine:
                     sin = model.model.rope_sin._value
                     h, new_k, new_v = _decode_layers_paged(
                         model.model.layers, h, cos, sin, kpools, vpools,
-                        tables, lens, chunk=True)
+                        tables, lens, chunk=True, adapters=pack_ab,
+                        slots=ad_slots, scaling=row_scale)
                     h = model.model.norm(h)
                     logits = model._logits(h)
                 return (jnp.argmax(logits._value, axis=-1).astype(jnp.int32),
@@ -1242,6 +1305,7 @@ class GenerationEngine:
         last = np.zeros((B, 1), np.int32)
         seq0 = np.zeros((B,), np.int32)
         d0 = np.zeros((B,), np.int32)
+        ad_slots = np.zeros((B,), np.int32)
         for i, sl in enumerate(self._slots):
             if sl.active:
                 row = list(sl.blocks) + [sl.blocks[-1]] * (W - len(sl.blocks))
@@ -1249,6 +1313,7 @@ class GenerationEngine:
                 last[i, 0] = sl.last_token
                 seq0[i] = sl.seq_len
                 d0[i] = sl.d_seq_len
+                ad_slots[i] = sl.adapter_slot
             else:
                 tables[i] = self._scratch[i]
         tables_j = jnp.asarray(tables)
@@ -1277,10 +1342,18 @@ class GenerationEngine:
         # ---- target verifies the whole chunk in one step ---------------
         chunk = np.concatenate([last, proposals], axis=1)  # [B, K+1]
         lens_v = jnp.asarray(seq0 + K + 1)
+        lora_args = ()
+        if self._pack is not None:
+            # the draft proposed base-model tokens; the target verifies
+            # through each row's adapter (pack as ARGUMENTS — hot swaps
+            # change values, never shapes, like the plain macro-step)
+            lora_args = (jnp.asarray(ad_slots), self._pack.ab,
+                         self._pack.scaling)
+            _LORA_STATS["gather_dispatches"] += 1
         preds, nk, nv = self._verify_fn(
             [t._value for t in self._state],
             list(self._kpools), list(self._vpools),
-            jnp.asarray(chunk), tables_j, lens_v)
+            jnp.asarray(chunk), tables_j, lens_v, *lora_args)
         self._kpools, self._vpools = list(nk), list(nv)
         _DECODE_STATS["dispatches"] += 1
         t_sync = time.perf_counter()

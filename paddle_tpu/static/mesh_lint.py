@@ -681,16 +681,19 @@ class MeshLinter:
             named.append((n, val, getattr(val, "sharding", None)))
         from paddle_tpu.ops.paged_attention import pool_parts
 
-        pool_lists = [("k", engine._kpools), ("v", engine._vpools),
-                      ("draft_k", getattr(engine, "_d_kpools", None) or []),
-                      ("draft_v", getattr(engine, "_d_vpools", None) or [])]
+        d_sharding = getattr(engine, "_d_pool_sharding", None)
+        pool_lists = [
+            ("k", engine._kpools, engine._pool_sharding),
+            ("v", engine._vpools, engine._pool_sharding),
+            ("draft_k", getattr(engine, "_d_kpools", None) or [], d_sharding),
+            ("draft_v", getattr(engine, "_d_vpools", None) or [], d_sharding),
+        ]
         pool_named, scale_named = [], []
-        for tag, pools in pool_lists:
+        for tag, pools, sharding in pool_lists:
             for i, pool in enumerate(pools):
                 for part, arr in pool_parts(pool):
                     dest = pool_named if part == "payload" else scale_named
-                    dest.append((f"{tag}pool[{i}].{part}", arr,
-                                 engine._pool_sharding))
+                    dest.append((f"{tag}pool[{i}].{part}", arr, sharding))
         # multi-tenant LoRA: the adapter pack's slot-stacked A/B + scaling
         # arrays are engine state too — placements and per-device bytes go
         # through the same path as params (nn/lora.py AdapterPack.parts)

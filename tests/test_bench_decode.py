@@ -53,3 +53,15 @@ def test_bench_decode_smoke_emits_valid_json():
     # admits >= 1.8x the resident requests (allocator arithmetic)
     cap = detail["int8_kv_capacity"]
     assert cap["int8_resident_requests"] >= 1.8 * cap["bf16_resident_requests"]
+    # SLO load section: percentile keys exist and are ORDERED
+    # (p50 <= p95 <= p99) for TTFT and inter-token latency, and the
+    # TP-sharded twin (2 virtual CPU devices) emitted bit-identical
+    # greedy streams
+    slo = detail["slo"]
+    assert slo["requests"] == 2 * slo["max_batch"]  # oversubscribed
+    assert slo["tp_tokens_match"] is True
+    assert slo["tp"] is not None
+    for side in ("single", "tp"):
+        for section in ("ttft_ms", "itl_ms"):
+            pcts = slo[side][section]
+            assert 0 < pcts["p50"] <= pcts["p95"] <= pcts["p99"]

@@ -46,3 +46,42 @@ def test_mismatched_metrics_skip(tmp_path):
     a = _w(tmp_path, "a.json", {"metric": "a", "value": 10.0})
     b = _w(tmp_path, "b.json", {"metric": "b", "value": 10.0})
     assert main([a, b]) == 0
+
+
+def _slo_payload(ttft_p95=20.0, itl_p95=2.0):
+    return {
+        "metric": "serving_decode_chunked_speedup", "value": 5.0,
+        "unit": "x", "detail": {"slo": {
+            "tp_tokens_match": True,
+            "single": {
+                "ttft_ms": {"p50": 10.0, "p95": ttft_p95, "p99": 30.0},
+                "itl_ms": {"p50": 1.0, "p95": itl_p95, "p99": 3.0},
+            },
+            "tp": None,
+        }},
+    }
+
+
+def test_slo_percentile_gate(tmp_path):
+    """Serving SLO wiring: latency percentiles gate with the direction
+    INVERTED (growth is the regression) at the wider --slo-threshold;
+    payloads without the section — every pre-SLO round — skip silently;
+    the throughput metric keeps gating independently."""
+    old = _w(tmp_path, "old.json", _slo_payload())
+    same = _w(tmp_path, "same.json", _slo_payload())
+    worse = _w(tmp_path, "worse.json", _slo_payload(ttft_p95=40.0))
+    assert main([old, same]) == 0          # unchanged latencies pass
+    assert main([old, worse]) == 1         # p95 TTFT doubled: regression
+    assert main([old, worse, "--slo-threshold", "1.5"]) == 0  # within 150%
+    assert main([worse, old]) == 0         # latency IMPROVED: never gates
+    # inter-token latency gates too, independently of TTFT
+    worse_itl = _w(tmp_path, "worse_itl.json", _slo_payload(itl_p95=4.0))
+    assert main([old, worse_itl]) == 1
+    # a pre-SLO payload on either side skips the latency gate
+    pre = _w(tmp_path, "pre.json",
+             {"metric": "serving_decode_chunked_speedup", "value": 5.0})
+    assert main([pre, worse]) == 0
+    assert main([worse, pre]) == 0
+    # and a throughput regression still gates even with clean latencies
+    slow = _w(tmp_path, "slow.json", dict(_slo_payload(), value=2.0))
+    assert main([old, slow]) == 1

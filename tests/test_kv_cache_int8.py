@@ -277,23 +277,29 @@ def test_int8_rejects_mesh_and_bad_dtype():
         GenerationEngine(m, num_blocks=8, kv_cache_dtype="fp8")
 
 
-def test_int8_plus_mesh_raises_typed_not_implemented():
-    """PR 6 caveat, made a CONTRACT: int8 pools + the TP mesh engine is a
-    typed NotImplementedError naming BOTH knobs and the workaround — not a
-    bare ValueError a caller can't distinguish from a typo'd dtype."""
+def test_int8_plus_mesh_constructs_sharded():
+    """The PR-6/PR-9 NotImplementedError is GONE: int8 pools compose with
+    the TP mesh engine — QuantPool payload AND its per-block-per-head
+    scales both come back committed to the KV-head sharding (the same
+    PartitionSpec covers the rank-4 payload and the rank-2 scales), and
+    the per-device telemetry reports the sharding-divided bytes.  Stream
+    parity mesh-vs-single-device lives in tests/test_serving_mesh.py
+    (isolated worker — this module rides a round-robin shard, so no
+    multi-device decode dispatch happens here)."""
     from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.serving import decode_stats
 
     m = _model(seed=13)
     mesh = ProcessMesh(np.arange(2).reshape(2), ["mp"])
-    with pytest.raises(NotImplementedError) as ei:
-        GenerationEngine(m, num_blocks=8, kv_cache_dtype="int8", mesh=mesh)
-    msg = str(ei.value)
-    # both knobs named, workaround stated
-    assert "kv_cache_dtype='int8'" in msg
-    assert "mesh=" in msg
-    assert "bf16" in msg
-    # NotImplementedError, not ValueError: the dtype itself is VALID
-    assert not isinstance(ei.value, ValueError)
+    eng = GenerationEngine(m, num_blocks=8, kv_cache_dtype="int8",
+                           mesh=mesh)
+    kp = eng._kpools[0]
+    assert isinstance(kp, pa.QuantPool)
+    assert "mp" in str(kp.data.sharding.spec)
+    assert "mp" in str(kp.scale.sharding.spec)
+    st = decode_stats()
+    assert st["mesh_shape"] == "mp2"
+    assert st["pool_bytes_per_device"] * 2 == st["pool_bytes"]
     # and each knob alone still works
     GenerationEngine(m, num_blocks=8, kv_cache_dtype="int8")
     GenerationEngine(_model(seed=13), num_blocks=8, kv_cache_dtype="bf16",

@@ -546,3 +546,58 @@ def test_engine_adapter_pack_covered_with_twin():
                              adapters={"rank": 4, "max_adapters": 2})
     finally:
         paddle.set_flags(prev)
+
+
+def test_sharded_engine_budget_uses_per_device_estimate():
+    """Sharded-serving satellite: FLAGS_mesh_lint_hbm_budget_gb is a
+    PER-DEVICE budget, judged against the sharding-divided estimate.
+    Passing twin: a budget between the sharded per-device estimate and
+    the single-device estimate constructs CLEAN on a 2-device mesh —
+    the same engine on one device blows the identical budget (the pool
+    'fits' only because the mesh divides it).  Failing fixture: a budget
+    below even the per-device estimate flags the sharded engine at
+    construction, with the sharded (divided) bytes in the message."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      dtype="float32")
+    mesh = ProcessMesh(np.arange(2).reshape(2), ["mp"])
+
+    def build(mesh_arg):
+        paddle.seed(4)
+        return GenerationEngine(LlamaForCausalLM(cfg), num_blocks=16,
+                                kv_cache_dtype="int8", mesh=mesh_arg)
+
+    _ok, est_single = lint_engine(build(None))
+    eng = build(mesh)
+    violations, est_tp = lint_engine(eng)
+    assert violations == []
+    # the estimate really is per-device: pools AND int8 scales divided
+    assert est_tp["kv_pools"] * 2 == est_single["kv_pools"]
+    assert est_tp["kv_scales"] * 2 == est_single["kv_scales"]
+    assert est_tp["total"] < est_single["total"]
+
+    mid_gb = (est_tp["total"] + est_single["total"]) / 2 / 2 ** 30
+    prev = _set_flags(FLAGS_verify_sharding=True,
+                      FLAGS_mesh_lint_hbm_budget_gb=mid_gb)
+    try:
+        build(mesh)  # passing twin: per-device fits the budget
+        with pytest.raises(MeshLintError, match="over-budget"):
+            build(None)  # one device holds everything: same budget blows
+    finally:
+        paddle.set_flags(prev)
+
+    # failing fixture: below the per-device estimate, the SHARDED engine
+    # is flagged too — and with the divided estimate, not the global one
+    low_gb = est_tp["total"] / 2 / 2 ** 30
+    prev = _set_flags(FLAGS_verify_sharding=True,
+                      FLAGS_mesh_lint_hbm_budget_gb=low_gb)
+    try:
+        with pytest.raises(MeshLintError, match="over-budget") as ei:
+            build(mesh)
+    finally:
+        paddle.set_flags(prev)
+    assert "per device" in str(ei.value)

@@ -66,6 +66,11 @@ def test_build_plan_isolates_collective_modules():
     for mod in ("test_lora.py", "test_serving_lora.py",
                 "test_bench_lora.py"):
         assert mod in rest_files, mod
+    # the TP-sharded serving modules dispatch GSPMD decode programs over
+    # the in-process multi-device communicator every test: DEDICATED
+    # isolated workers, never round-robin (and never slow-marked)
+    for mod in ("test_serving_mesh.py", "test_serving_mesh_spec.py"):
+        assert mod in iso_names, mod
 
 
 # -------------------------------------------------------- crash isolation

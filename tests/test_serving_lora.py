@@ -314,13 +314,36 @@ def test_adapterless_engine_and_bad_combos_are_loud():
         eng.register_adapter("t", {})
     with pytest.raises(RuntimeError, match="without adapters="):
         eng.add_request("r", [1, 2], max_new_tokens=2, adapter="t")
-    draft = _model(seed=5)
-    with pytest.raises(ValueError, match="speculative"):
-        GenerationEngine(model, max_batch=1, block_size=8, num_blocks=16,
-                         draft_model=draft, adapters={"rank": 4})
     with pytest.raises(TypeError, match="adapters"):
         GenerationEngine(model, max_batch=1, block_size=8, num_blocks=16,
                          adapters="rank4")
+
+
+def test_speculative_adapter_engine_matches_plain_adapter_engine():
+    """adapters= now composes with draft_model= (the PR-10 ValueError is
+    gone): the draft proposes with the BASE model, the target verifies
+    through each row's adapter, and greedy acceptance emits EXACTLY the
+    plain adapter engine's streams — mixed tenants plus a base row."""
+    model = _model()
+    sds = {f"t{i}": _adapter_sd(model, key_seed=10 + i) for i in range(2)}
+    reqs = {"a0": ("t0", _PROMPTS["a0"]), "a1": ("t1", _PROMPTS["a1"]),
+            "base": (None, _PROMPTS["base"])}
+
+    def run(draft):
+        eng = GenerationEngine(model, max_batch=3, block_size=8,
+                               num_blocks=32, draft_model=draft,
+                               num_speculative_tokens=3,
+                               adapters={"rank": 4, "max_adapters": 2})
+        _register_all(eng, sds)
+        for rid, (ad, prompt) in reqs.items():
+            eng.add_request(rid, prompt, max_new_tokens=6, adapter=ad)
+        _drain(eng)
+        return {rid: eng.result(rid) for rid in reqs}
+
+    ref = run(None)
+    assert len({tuple(v) for v in ref.values()}) == 3  # tenants differ
+    got = run(_model(seed=5))
+    assert got == ref
 
 
 def test_lora_stats_and_summary_footer(capsys):

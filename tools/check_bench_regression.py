@@ -14,6 +14,13 @@ stdout/rc fields.  Exit 1 (loud) when the new value regresses more than
 `threshold` relative to the old on the same metric; missing/failed runs
 (rc != 0 or value 0) are reported but never counted as regressions — an
 unhealthy tunnel must not mask or fabricate a perf signal.
+
+Serving payloads carrying the SLO-percentile section (bench_decode.py
+detail.slo.single: p50/p95/p99 time-to-first-token + inter-token latency)
+are ALSO gated, with the direction inverted (latency growing beyond
+--slo-threshold is the regression) and a wider default threshold — tail
+percentiles jitter more than throughput means.  Payloads lacking the
+section on either side skip the latency gate silently.
 """
 
 from __future__ import annotations
@@ -23,8 +30,10 @@ import json
 import sys
 
 
-def load_payload(path):
-    """-> (metric, value) or (None, reason)."""
+def _payload_dict(path):
+    """The bench payload dict for a driver-recorded file, unwrapping the
+    {"rc", "stdout"/"tail"} driver envelope -> (dict, None) or
+    (None, reason)."""
     try:
         with open(path) as f:
             data = json.load(f)
@@ -45,7 +54,17 @@ def load_payload(path):
                 break
         else:
             return None, "no metric line in stdout"
-    if not isinstance(data, dict) or "metric" not in data:
+    if not isinstance(data, dict):
+        return None, "no metric field"
+    return data, None
+
+
+def load_payload(path):
+    """-> (metric, value) or (None, reason)."""
+    data, err = _payload_dict(path)
+    if data is None:
+        return None, err
+    if "metric" not in data:
         return None, "no metric field"
     try:
         value = float(data.get("value", 0.0))
@@ -56,12 +75,32 @@ def load_payload(path):
     return (data["metric"], value), None
 
 
+def load_slo(path):
+    """The SLO-percentile section of a serving bench payload
+    (bench_decode.py detail.slo.single: {"ttft_ms": {p50, p95, p99},
+    "itl_ms": {...}}), or None when the payload has no such section —
+    pre-SLO rounds and non-serving benches simply skip the latency
+    gate."""
+    data, _err = _payload_dict(path)
+    if not isinstance(data, dict):
+        return None
+    slo = (data.get("detail") or {}).get("slo")
+    if not isinstance(slo, dict):
+        return None
+    return slo.get("single")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("old")
     p.add_argument("new")
     p.add_argument("--threshold", type=float, default=0.05,
                    help="max allowed relative regression (default 5%%)")
+    p.add_argument("--slo-threshold", type=float, default=0.5,
+                   help="max allowed relative latency-percentile growth "
+                        "for the serving SLO section (default 50%% — "
+                        "CPU-measured tail percentiles jitter far more "
+                        "than throughput means)")
     args = p.parse_args(argv)
 
     old, old_err = load_payload(args.old)
@@ -78,7 +117,28 @@ def main(argv=None):
     rel = (nv - ov) / ov
     status = "REGRESSION" if rel < -args.threshold else "ok"
     print(f"bench gate [{om}]: {ov:.2f} -> {nv:.2f} ({rel:+.2%}) {status}")
-    return 1 if status == "REGRESSION" else 0
+    rc = 1 if status == "REGRESSION" else 0
+
+    # SLO-percentile gate (serving benches): latencies are LOWER-is-
+    # better, so the regression direction inverts.  Percentiles present
+    # on only one side (pre-SLO rounds) skip silently — an added metric
+    # must not fail the round that adds it.
+    old_slo, new_slo = load_slo(args.old), load_slo(args.new)
+    if old_slo and new_slo:
+        for section in ("ttft_ms", "itl_ms"):
+            o, n = old_slo.get(section), new_slo.get(section)
+            if not (isinstance(o, dict) and isinstance(n, dict)):
+                continue
+            for pk in ("p50", "p95", "p99"):
+                if pk not in o or pk not in n or not o[pk] > 0:
+                    continue
+                rel = (float(n[pk]) - float(o[pk])) / float(o[pk])
+                stat = ("REGRESSION" if rel > args.slo_threshold else "ok")
+                print(f"bench gate [slo {section} {pk}]: {o[pk]:.2f} -> "
+                      f"{n[pk]:.2f} ms ({rel:+.2%}) {stat}")
+                if stat == "REGRESSION":
+                    rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -47,10 +47,13 @@ from dataclasses import dataclass, field
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Modules that drive the in-process 8-device XLA:CPU communicator hard
-# enough to hit the intermittent jax-0.4.37 SIGSEGV/SIGABRT class
+# Modules that drive the in-process multi-device XLA:CPU communicator
+# hard enough to hit the intermittent jax-0.4.37 SIGSEGV/SIGABRT class
 # (CHANGES.md PR 2/3 timing notes): each runs in its OWN worker shard so
 # a crash never takes sibling results down, and signal-deaths retry once.
+# The TP-sharded serving modules dispatch GSPMD-partitioned decode
+# programs over 2- and 4-device meshes every test — same crash class,
+# same containment.
 ISOLATED_DEFAULT = (
     "test_fleet.py",
     "test_dist_passes.py",
@@ -59,6 +62,8 @@ ISOLATED_DEFAULT = (
     "test_ring_attention.py",
     "test_multiprocess_collective.py",
     "test_sharded_embedding.py",
+    "test_serving_mesh.py",
+    "test_serving_mesh_spec.py",
 )
 
 DEFAULT_CACHE_DIR = "/tmp/jax_cache"
