@@ -1,30 +1,43 @@
 """Benchmark: cost-model-driven Pallas schedule search + measured-win gate.
 
-Exercises the full ROADMAP-item-2 loop on two discovered subgraphs no named
-pattern matches (the XLA fusion-miss classes of arXiv 2301.13062):
+Exercises the full ROADMAP-item-2/item-4 loop on four searched subjects no
+named pattern covers (the XLA fusion-miss classes of arXiv 2301.13062):
 
 - **matmul chain** — matmul→bias-add→relu→mean tail (matmul-rooted with a
   reduction tail): searched, gated, and — when the schedule wins —
   substituted, with fused-vs-XLA numerics asserted either way.
+- **K-tiled matmul chain** (phase 2) — the same class at a contraction dim
+  large enough that ``block_k`` splits enter the candidate space; smoke
+  mode pins a genuinely K-tiled config as the winner so the accumulator
+  kernel path is the one asserted.
 - **softmax chain** — a manually decomposed softmax (reduction-rooted DAG):
-  same loop; in smoke mode its schedule deliberately LOSES so the gate's
-  disable path is exercised: the decision persists as a disabled entry in
-  the per-device autotune cache and a cold reload must skip the subgraph
+  in smoke mode its schedule deliberately LOSES so the gate's disable path
+  is exercised: the decision persists as a disabled entry in the
+  per-device autotune cache and a cold reload must skip the subgraph
   without a single re-measurement.
+- **decode hot chain** (phase 2) — the serving macro-step's paged gather →
+  dequant → sdpa core → quant-write sequence (ops/decode_chain.py), bf16
+  AND int8 variants, searched through the same enumerate→prune→parity→
+  measure→gate loop; every candidate must pass the numerics parity gate
+  vs the unfused twin BEFORE it may be measured, and the disabled int8
+  verdict must serve a cold reload with zero re-measures.
 
 Timing: in full mode candidates are measured for real through
 cost_model.OpCostModel.measure (hard_sync device barrier — meaningful on
 TPU; on CPU the kernels run in Pallas interpret mode, where XLA-only
-usually wins and the gate honestly disables).  Smoke mode (--smoke or
+usually wins and the gate honestly disables — a win-or-disabled verdict is
+recorded either way, never a faked value).  Smoke mode (--smoke or
 PADDLE_TPU_BENCH_SMOKE=1) injects a deterministic roofline-shaped cost
 model via schedule_search.measure_override so CI asserts the DECISION
 LOGIC — accept vs disable vs never-refire — bit-stably offline, with
 numerics always checked for real.
 
 Prints ONE JSON line shaped like bench.py: {"metric", "value", ...}.
-value = the accepted schedule's measured win ratio over XLA (0.0 when the
-gate disabled everything — an honest loss is not a regression signal;
-tools/check_bench_regression.py skips zero values).
+value = the best accepted schedule's measured win ratio over XLA (0.0 when
+the gate disabled everything — an honest loss is not a regression signal;
+tools/check_bench_regression.py skips zero values).  detail.decode_chain
+carries the per-variant decode verdicts the regression gate compares
+win-to-win, skipping disabled sides honestly.
 """
 
 from __future__ import annotations
@@ -52,6 +65,7 @@ def main() -> int:
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.ops import autotune as at
+    from paddle_tpu.ops import decode_chain as dc
     from paddle_tpu.static import schedule_search as ss
     from paddle_tpu.static.program import Program, program_guard
     from paddle_tpu.static.rewrite import ScheduleSearchPass
@@ -66,14 +80,23 @@ def main() -> int:
     if smoke:
         M, K, N = 32, 16, 64
         B, S, H = 2, 8, 32
+        MT, KT, NT = 32, 256, 64
+        DEC = dict(batch=2, num_heads=4, num_kv_heads=2, head_dim=8,
+                   block_size=4, max_blocks=2, num_blocks=8)
     elif jax.default_backend() == "tpu":
         M, K, N = 1024, 512, 512
         B, S, H = 8, 128, 512
+        MT, KT, NT = 1024, 2048, 1024
+        DEC = dict(batch=8, num_heads=16, num_kv_heads=8, head_dim=128,
+                   block_size=16, max_blocks=16, num_blocks=136)
     else:
         # full mode off-chip: real timing of interpret-mode kernels — keep
         # shapes small enough that an honest all-disabled outcome is cheap
         M, K, N = 128, 64, 128
         B, S, H = 4, 32, 64
+        MT, KT, NT = 64, 512, 128
+        DEC = dict(batch=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                   block_size=8, max_blocks=4, num_blocks=16)
 
     def _feed(prog, name, shape):
         return prog.add_feed(
@@ -91,6 +114,15 @@ def main() -> int:
             out = paddle.mean(h, axis=-1, keepdim=True)
         return prog, out
 
+    def capture_ktiled_chain():
+        prog = Program()
+        with program_guard(prog):
+            x = _feed(prog, "x", (MT, KT))
+            w = _feed(prog, "w", (KT, NT))
+            b = _feed(prog, "b", (NT,))
+            out = F.relu(paddle.matmul(x, w) + b)
+        return prog, out
+
     def capture_softmax_chain():
         prog = Program()
         with program_guard(prog):
@@ -104,20 +136,42 @@ def main() -> int:
     measured_labels = []
 
     def smoke_measure(fn, args, *, label, config):
-        """Deterministic roofline-shaped cost model: the matmul chain's
-        schedules win (grid overhead mildly penalizes tiny blocks), the
-        softmax chain's schedules deliberately LOSE to XLA."""
+        """Deterministic roofline-shaped cost model: the matmul chains'
+        schedules win (the large-K twin only through a genuinely K-tiled
+        config; grid overhead mildly penalizes tiny blocks), the softmax
+        chain's and the int8 decode chain's schedules deliberately LOSE
+        to XLA, the bf16 decode chain wins."""
         measured_labels.append(label)
         if config is None:
             return 1.0
         if label.startswith("schedule/reduce"):
             return 4.0  # the deliberately-bad schedule family
+        if label.startswith("schedule/decode_int8"):
+            return 4.0  # exercise the decode disable path
+        if label.startswith("schedule/decode_bf16"):
+            return 0.4
+        if f"k={KT}" in label:
+            # the K-tiled twin: only a contraction split beats XLA here
+            return 0.3 if config.get("block_k", KT) < KT else 4.0
         steps = (M // config["block_rows"]) * (N // config["block_cols"])
         return 0.4 + 0.002 * steps
 
-    def run_case(name, capture, budget=3):
-        """Search one subgraph; return its decision record with REAL
-        fused-vs-XLA numerics parity."""
+    def cache_entries(kernel):
+        slug_file = os.path.join(cache_dir, at.device_kind_slug() + ".json")
+        if not os.path.exists(slug_file):
+            return {}
+        raw = json.load(open(slug_file))
+        return raw.get(kernel, {})
+
+    def cache_entry(kernel, key_sub=""):
+        for k, v in cache_entries(kernel).items():
+            if key_sub in k:
+                return v
+        return None
+
+    def run_case(name, capture, kernel, key_sub="", budget=3):
+        """Search one Program subgraph; return its decision record with
+        REAL fused-vs-XLA numerics parity."""
         prog, out = capture()
         reference = prog.clone()
         searcher = ss.ScheduleSearcher(budget=budget, iters=1, warmup=1)
@@ -129,26 +183,44 @@ def main() -> int:
         if n:
             numerics_ok = differential_check(
                 reference, prog, [out._vid], raise_on_error=False) == []
-        kernel = ("schedule/matmul" if name == "matmul_chain"
-                  else "schedule/reduce")
-        slug_file = os.path.join(cache_dir, at.device_kind_slug() + ".json")
-        entry = None
-        if os.path.exists(slug_file):
-            raw = json.load(open(slug_file))
-            entries = list(raw.get(kernel, {}).values())
-            entry = entries[0] if entries else None
         return {
             "substituted": n,
             "fused_op": fused_type,
             "numerics_identical": bool(numerics_ok),
-            "cache_entry": entry,
+            "cache_entry": cache_entry(kernel, key_sub),
+        }
+
+    def run_decode_case(kv, budget=3):
+        """Search the decode hot chain at the bench geometry.  Numerics
+        ride the searcher's parity gate: a candidate that fails the
+        bit-exact (bf16) / drift-bounded (int8) check vs the unfused twin
+        is rejected before it may be measured."""
+        spec = dc.DecodeChainSpec(kv=kv, dtype=np.float32, **DEC)
+        decision = dc.ensure_decision(
+            spec, ss.ScheduleSearcher(budget=budget, iters=1, warmup=1))
+        entry = cache_entry(spec.kernel_name()) or {}
+        meta = entry.get("meta") or {}
+        return {
+            "status": decision.status,
+            "accepted": bool(decision.accepted),
+            "config": dict(decision.config) if decision.config else None,
+            "win": float(meta.get("win", 0.0) or 0.0)
+            if not entry.get("config", {}).get("disabled") else 0.0,
+            "disabled_persisted": bool(entry.get("config", {})
+                                       .get("disabled")),
         }
 
     ctx = (ss.measure_override(smoke_measure) if smoke
            else contextlib.nullcontext())
     with ctx:
-        matmul_case = run_case("matmul_chain", capture_matmul_chain)
-        softmax_case = run_case("softmax_chain", capture_softmax_chain)
+        matmul_case = run_case("matmul_chain", capture_matmul_chain,
+                               "schedule/matmul", key_sub=f"k={K}|")
+        ktiled_case = run_case("ktiled_matmul", capture_ktiled_chain,
+                               "schedule/matmul", key_sub=f"k={KT}|")
+        softmax_case = run_case("softmax_chain", capture_softmax_chain,
+                                "schedule/reduce")
+        decode_bf16 = run_decode_case("bf16")
+        decode_int8 = run_decode_case("int8")
 
         # never-refire: cold cache reload, a disabled subgraph must be
         # skipped without a single new measurement
@@ -160,21 +232,32 @@ def main() -> int:
             [out2._vid],
             searcher=ss.ScheduleSearcher(budget=3, iters=1, warmup=1)
         ).apply(prog2)
+        # ... and the decode verdicts serve a cold reload with zero
+        # re-measures too (accepted bf16 config AND disabled int8)
+        dc.ensure_decision(
+            dc.DecodeChainSpec(kv="bf16", dtype=np.float32, **DEC),
+            ss.ScheduleSearcher(budget=3, iters=1, warmup=1))
+        dc.ensure_decision(
+            dc.DecodeChainSpec(kv="int8", dtype=np.float32, **DEC),
+            ss.ScheduleSearcher(budget=3, iters=1, warmup=1))
         after = len(measured_labels) if smoke else \
             ss.schedule_search_stats()["measured"]
         never_refired = (after == before)
 
     stats = ss.schedule_search_stats()
-    # headline value: the accepted schedule's measured win over XLA (either
-    # case may win or lose under real timing; smoke pins matmul=win)
+    # headline value: the best accepted schedule's measured win over XLA
+    # (any case may win or lose under real timing; smoke pins the set)
     win = 0.0
-    for case in (matmul_case, softmax_case):
+    for case in (matmul_case, ktiled_case, softmax_case):
         entry = case["cache_entry"] or {}
         if case["substituted"] and not entry.get("config", {}).get("disabled"):
             win = max(win, float((entry.get("meta") or {}).get("win", 0.0)
                                  or 0.0))
+    for case in (decode_bf16, decode_int8):
+        win = max(win, case["win"])
     disabled_entry = softmax_case["cache_entry"] or {}
     numerics_ok = (matmul_case["numerics_identical"]
+                   and ktiled_case["numerics_identical"]
                    and softmax_case["numerics_identical"])
     min_win = float(paddle.get_flags("FLAGS_schedule_search_min_win")[
         "FLAGS_schedule_search_min_win"])
@@ -193,14 +276,18 @@ def main() -> int:
                 "numerics_identical": bool(numerics_ok),
                 "detail": {
                     "matmul_chain": matmul_case,
+                    "ktiled_matmul": ktiled_case,
                     "softmax_chain": softmax_case,
+                    "decode_chain": {"bf16": decode_bf16,
+                                     "int8": decode_int8},
                     "disabled_persisted": bool(disabled_entry.get(
                         "config", {}).get("disabled")),
                     "never_refired": bool(never_refired),
                     "counters": stats,
                 },
                 "config": ("smoke" if smoke
-                           else f"mm{M}x{K}x{N}_sm{B}x{S}x{H}"),
+                           else f"mm{M}x{K}x{N}_kt{MT}x{KT}x{NT}"
+                                f"_sm{B}x{S}x{H}"),
             }
         ),
         flush=True,
@@ -208,9 +295,15 @@ def main() -> int:
     ok = numerics_ok and never_refired
     if smoke:
         # the deterministic cost model must produce exactly these decisions
+        ktc = (ktiled_case["cache_entry"] or {}).get("config", {})
         ok = ok and matmul_case["substituted"] == 1 and win > 1.0 \
             and softmax_case["substituted"] == 0 \
-            and bool(disabled_entry.get("config", {}).get("disabled"))
+            and bool(disabled_entry.get("config", {}).get("disabled")) \
+            and ktiled_case["substituted"] == 1 \
+            and 0 < ktc.get("block_k", 0) < KT \
+            and decode_bf16["accepted"] and decode_bf16["win"] > 1.0 \
+            and decode_int8["status"] in ("disabled", "cache_disabled") \
+            and decode_int8["disabled_persisted"]
     return 0 if ok else 4
 
 

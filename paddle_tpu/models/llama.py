@@ -370,7 +370,7 @@ def _mlp_paged(mlp, x, ad, slots, scaling):
 
 
 def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens,
-                        ad=None, slots=None, scaling=None):
+                        ad=None, slots=None, scaling=None, chain_cfg=None):
     """One decoder layer on one new token against the paged KV pools.
 
     h: Tensor [B, 1, D]; kc/vc: [num_blocks, Nkv, bs, H] pools (raw arrays);
@@ -382,6 +382,12 @@ def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens,
     slots [B] picks each batch row's adapter slot and scaling [B] its
     alpha/rank, so mixed-adapter batches decode in this ONE program
     (slot 0 gathers zeros — the exact base-model identity; nn/lora.py).
+
+    chain_cfg: an ACCEPTED decode-chain schedule (ops/decode_chain.py;
+    docs/SCHEDULE_SEARCH.md phase 2) — the write→write→attend sequence
+    below runs as one fused Pallas dispatch instead of separate XLA ops.
+    Only the serving engine passes this, and only after the measured-win
+    gate and the stream parity gate said yes.
     """
     from paddle_tpu.ops import paged_attention as pa
 
@@ -399,9 +405,15 @@ def _decode_layer_paged(layer, h, cos, sin, kc, vc, tables, lens,
     pos = lens - 1
     qv = pa.rope_rotate_by_position(qv, cos, sin, pos)
     kv_ = pa.rope_rotate_by_position(kv_, cos, sin, pos)
-    kc = pa.paged_write(kc, kv_, tables, pos)
-    vc = pa.paged_write(vc, vv, tables, pos)
-    o = pa.paged_decode_attention(qv, kc, vc, tables, lens)
+    if chain_cfg is not None:
+        from paddle_tpu.ops import decode_chain as _dc
+
+        o, kc, vc = _dc.fused_decode_step(kc, vc, qv, kv_, vv, tables,
+                                          lens, config=chain_cfg)
+    else:
+        kc = pa.paged_write(kc, kv_, tables, pos)
+        vc = pa.paged_write(vc, vv, tables, pos)
+        o = pa.paged_decode_attention(qv, kc, vc, tables, lens)
     out = Tensor(_proj_lora(attn.o_proj, Tensor(o.reshape(b, 1, n * hd)),
                             ad, "self_attn.o_proj", slots, scaling))
     h = residual + out
@@ -449,7 +461,7 @@ def _decode_layer_paged_chunk(layer, h, cos, sin, kc, vc, tables, lens,
 
 def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
                          chunk=False, adapters=None, slots=None,
-                         scaling=None):
+                         scaling=None, chain_cfg=None):
     """Run every decoder layer's paged decode step over per-layer pools.
 
     ``layers`` is either a LayerList (unrolled view loop — the program
@@ -470,10 +482,21 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
     LEADING LAYER AXIS; on the LayerStack path the pack rides the decode
     scan as extra per-layer xs, on the view loop each layer indexes its
     slice.  slots [B] / scaling [B] are per-batch-row (nn/lora.py).
+
+    chain_cfg: accepted fused decode-chain schedule for the SINGLE-TOKEN
+    step (ops/decode_chain.py) — invalid with chunk=True, whose T-token
+    chain the searcher does not cover.
     """
     from paddle_tpu.ops import paged_attention as pa
 
     step = _decode_layer_paged_chunk if chunk else _decode_layer_paged
+    extra_kw = {}
+    if chain_cfg is not None:
+        if chunk:
+            raise ValueError(
+                "decode-chain fusion covers the single-token step only; "
+                "chunked/verify paths must not pass chain_cfg")
+        extra_kw = {"chain_cfg": chain_cfg}
     if isinstance(layers, nn.LayerStack):
         # per-layer form is a list/tuple; anything else (a raw stacked
         # array or a stacked QuantPool pytree) is the carry form
@@ -483,13 +506,13 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
         if adapters is None:
             h, k_state, v_state = layers.decode_scan(
                 lambda layer, hh, kc, vc: step(
-                    layer, hh, cos, sin, kc, vc, tables, lens),
+                    layer, hh, cos, sin, kc, vc, tables, lens, **extra_kw),
                 h, k_state, v_state)
         else:
             h, k_state, v_state = layers.decode_scan(
                 lambda layer, hh, kc, vc, ad: step(
                     layer, hh, cos, sin, kc, vc, tables, lens,
-                    ad=ad, slots=slots, scaling=scaling),
+                    ad=ad, slots=slots, scaling=scaling, **extra_kw),
                 h, k_state, v_state, extra=adapters)
         if stacked_in:
             return h, k_state, v_state
@@ -503,7 +526,8 @@ def _decode_layers_paged(layers, h, cos, sin, kpools, vpools, tables, lens,
         ad_l = (None if adapters is None else
                 jax.tree_util.tree_map(lambda a: a[li], adapters))
         h, kc, vc = step(layer, h, cos, sin, kpools[li], vpools[li],
-                         tables, lens, ad=ad_l, slots=slots, scaling=scaling)
+                         tables, lens, ad=ad_l, slots=slots, scaling=scaling,
+                         **extra_kw)
         new_k.append(kc)
         new_v.append(vc)
     return h, new_k, new_v

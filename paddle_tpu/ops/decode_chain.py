@@ -1,0 +1,606 @@
+"""Searchable fused decode hot chain: paged gather → dequant → sdpa core →
+(running-max) quant-write as ONE Pallas dispatch per layer per token.
+
+Schedule search, phase 2 (ROADMAP item 4; docs/SCHEDULE_SEARCH.md).  The
+decode macro-step's per-token chain runs today as separate XLA ops inside
+the jitted scan body — exactly the memory-bound fusion-miss class
+"Operator Fusion in XLA" (arXiv 2301.13062) catalogs.  This module makes
+that chain a SEARCHABLE subgraph for static/schedule_search.py's
+ScheduleSearcher: `DecodeChainSpec` describes the chain at one engine
+geometry and implements the same searcher protocol Program subgraphs use
+(enumerate → roofline → VMEM → parity → measure → measured-win gate), so
+winners and losers persist per device kind under the `schedule/decode_*`
+AutotuneCache namespaces and the engine's compiled macro-step consumes an
+accepted config with zero re-measurement (serving._resolve_decode_chain).
+
+Semantics are NEVER trusted to the gate: every candidate must pass a
+numerics parity check against the XLA twin BEFORE it may be measured
+(`check_parity`), with the same contract the engine's stream tests
+enforce — full-precision ('bf16') pools bit-exact, int8 pools bit-exact
+on the quantized payload/scales with the attention output inside the
+PR-6 drift budget.  That is why the default `batch` layout replays the
+EXACT unfused ops (paged_write / paged_gather / gathered_attention — one
+definition each, imported from ops.paged_attention) inside one
+pallas_call: fusion changes the number of HBM round trips, never the
+math.  The int8-only `rows` layout grids over batch rows (smaller VMEM
+working set, whole-pool re-staging per row in the traffic model) and is
+tolerance-gated on the attention output.
+
+Mixed-dtype roofline honesty: a QuantPool chain moves int8 payload bytes
+AND float32 scale bytes — `traffic_bytes` costs every pool leaf at its
+OWN itemsize instead of assuming one dtype for the whole subgraph (the
+bf16-pool chain at identical geometry models ~2x the gather traffic,
+which is the int8 capacity story told by the cost model).
+
+CPU/on-chip honesty: kernels run in Pallas interpret mode off-TPU, where
+XLA usually wins and the gate (correctly) disables — tests and the bench
+--smoke twin decide through schedule_search.measure_override.  On TPU the
+whole-pool VMEM residency of these layouts is validated by
+ops.autotune.validate_tile, so geometries whose pools exceed the budget
+are pruned honestly rather than faked; a DMA-pipelined variant can join
+the candidate space later without changing the search contract.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "DecodeChainSpec",
+    "spec_from_arrays",
+    "ensure_decision",
+    "fused_decode_step",
+]
+
+# per-copy-step turnaround for the analytic ranking (the scale of one DMA
+# issue): breaks ties between gather granularities whose traffic is
+# identical, the same role schedule_search._GRID_STEP_OVERHEAD_S plays
+# for 1-D grids
+_COPY_STEP_OVERHEAD_S = 1e-7
+
+
+@dataclass
+class DecodeChainSpec:
+    """One engine geometry's decode hot chain, ready to schedule.
+
+    kv: 'bf16' (full-precision pools in `dtype`) | 'int8' (QuantPool —
+    int8 payload + per-block-per-head f32 scales, running-max writes).
+    num_blocks counts the WHOLE pool incl. scratch pages; max_blocks is
+    the per-sequence block-table width."""
+
+    batch: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    block_size: int
+    max_blocks: int
+    num_blocks: int
+    kv: str = "bf16"
+    dtype: object = np.float32
+
+    check_parity = True  # searcher protocol: candidates numerics-gate
+
+    def __post_init__(self):
+        if self.kv not in ("bf16", "int8"):
+            raise ValueError(f"kv must be 'bf16' or 'int8', got {self.kv!r}")
+
+    # ------------------------------------------------------------ identity
+    @property
+    def seq(self) -> int:
+        return self.max_blocks * self.block_size
+
+    def kernel_name(self) -> str:
+        return f"schedule/decode_{self.kv}"
+
+    def key(self) -> dict:
+        return {
+            "b": self.batch,
+            "n": self.num_heads,
+            "nkv": self.num_kv_heads,
+            "h": self.head_dim,
+            "bs": self.block_size,
+            "w": self.max_blocks,
+            "nb": self.num_blocks,
+            "dtype": np.dtype(self.dtype).name,
+        }
+
+    def label(self) -> str:
+        from paddle_tpu.ops.autotune import _key_str
+
+        return f"{self.kernel_name()}|{_key_str(self.key())}"
+
+    def config_label(self, config) -> str:
+        lbl = f"#{config.get('layout', 'batch')}-{config.get('gather', 'take')}"
+        if config.get("gather") == "loop":
+            lbl += f"u{config.get('unroll', 1)}"
+        return lbl
+
+    # ------------------------------------------------------ candidate space
+    def enumerate_configs(self):
+        """Schedule space: `layout` — 'batch' replays the whole batch in
+        one grid step (bit-exact by construction; the only layout a
+        'bf16' chain may use), 'rows' (int8 only) grids over batch rows;
+        `gather` — 'take' stages pages in one bulk gather, 'loop' copies
+        `unroll` pages per step (the DMA granularity knob; values are
+        bit-identical either way — gathering is pure data movement)."""
+        unrolls = [u for u in (1, 2, 4)
+                   if u <= self.max_blocks and self.max_blocks % u == 0]
+        layouts = ["batch"] + (["rows"] if self.kv == "int8" else [])
+        out = []
+        for layout in layouts:
+            out.append({"layout": layout, "gather": "take"})
+            for u in unrolls:
+                out.append({"layout": layout, "gather": "loop", "unroll": u})
+        return out
+
+    # ------------------------------------------------------------ cost model
+    def _leaf_bytes(self):
+        """[(name, nbytes)] per pool LEAF at its OWN dtype — one pool's
+        int8 payload and f32 scales are costed separately (the mixed-dtype
+        fix: a QuantPool chain is not 'one dtype' to the roofline)."""
+        nb, nkv, bs, h = (self.num_blocks, self.num_kv_heads,
+                          self.block_size, self.head_dim)
+        if self.kv == "int8":
+            return [("payload", nb * nkv * bs * h * 1),
+                    ("scale", nb * nkv * 4)]
+        return [("payload", nb * nkv * bs * h
+                 * np.dtype(self.dtype).itemsize)]
+
+    def _write_bytes(self):
+        """HBM bytes the chain's write phase touches, per pool: bf16
+        writes one token slot per row; int8 rewrites each touched block
+        (running-max rescale) plus its f32 scales."""
+        b, nkv, bs, h = (self.batch, self.num_kv_heads, self.block_size,
+                         self.head_dim)
+        if self.kv == "int8":
+            return b * nkv * bs * h * 1 + b * nkv * 4
+        return b * nkv * h * np.dtype(self.dtype).itemsize
+
+    def traffic_bytes(self, config) -> int:
+        """Modeled HBM traffic: every pool leaf read at its own itemsize
+        (once for the 'batch' layout; re-staged per row — x batch — for
+        'rows'), the write phase's touched bytes, and the q/k/v/token
+        tensors + output once."""
+        it = np.dtype(self.dtype).itemsize
+        b, n, nkv, h = (self.batch, self.num_heads, self.num_kv_heads,
+                        self.head_dim)
+        read_factor = b if config.get("layout") == "rows" else 1
+        pool_reads = 2 * sum(sz for _name, sz in self._leaf_bytes())
+        traffic = pool_reads * read_factor
+        traffic += 2 * self._write_bytes()
+        traffic += b * n * h * it            # q
+        traffic += 2 * b * nkv * h * it      # k_new, v_new
+        traffic += b * self.max_blocks * 4 + b * 4  # tables, lens
+        traffic += b * n * h * it            # attention output
+        return int(traffic)
+
+    def flops(self) -> float:
+        b, n, h, s = self.batch, self.num_heads, self.head_dim, self.seq
+        return 4.0 * b * n * s * h + 5.0 * b * n * s
+
+    def roofline_ms(self, config, cost_model=None) -> float:
+        if cost_model is None:
+            from paddle_tpu.cost_model import OpCostModel
+
+            cost_model = OpCostModel()
+        if config.get("gather") == "loop":
+            u = int(config.get("unroll", 1) or 1)
+            # one copy per page group per row per pool
+            copies = 2 * self.batch * (self.max_blocks // u)
+        else:
+            copies = 2  # one bulk gather per pool
+        return (cost_model.flops_time(self.flops(),
+                                      self.traffic_bytes(config))
+                + copies * _COPY_STEP_OVERHEAD_S) * 1e3
+
+    def vmem_bytes(self, config) -> int:
+        """f32-staged working set per grid step (double-buffered, the
+        validate_tile convention): the resident pool leaves plus the
+        per-step gathered views, logits tile, and token blocks.  The
+        'rows' layout holds one row's views; both layouts keep the whole
+        pool resident — on-chip geometries whose pools exceed VMEM are
+        pruned honestly here."""
+        it = np.dtype(self.dtype).itemsize
+        rows = 1 if config.get("layout") == "rows" else self.batch
+        n, nkv, h, s = (self.num_heads, self.num_kv_heads, self.head_dim,
+                        self.seq)
+        total = 2 * sum(sz for _name, sz in self._leaf_bytes())  # pools
+        total += 2 * rows * nkv * s * h * 4        # gathered k/v (f32)
+        total += rows * n * s * 4                  # logits tile
+        total += rows * (n + 2 * nkv) * h * it     # q, k_new, v_new
+        total += rows * n * h * it                 # output block
+        return int(total) * 2
+
+    # ------------------------------------------------------------- numerics
+    def reference(self):
+        """The XLA twin: EXACTLY the unfused macro-step sequence
+        (models/llama._decode_layer_paged lines write→write→attend)."""
+        from paddle_tpu.ops import paged_attention as pa
+
+        def ref(kc, vc, q, kn, vn, tables, lens):
+            pos = lens - 1
+            kc = pa.paged_write(kc, kn, tables, pos)
+            vc = pa.paged_write(vc, vn, tables, pos)
+            o = pa.paged_decode_attention(q, kc, vc, tables, lens)
+            return o, kc, vc
+
+        return ref
+
+    def synthetic_args(self):
+        """Deterministic engine-shaped args: every row owns DISJOINT
+        pool blocks (the engine's allocator invariant the 'rows' layout
+        relies on) poured with random content, lengths spread over the
+        table span."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import paged_attention as pa
+
+        b, n, nkv, h = (self.batch, self.num_heads, self.num_kv_heads,
+                        self.head_dim)
+        bs, w = self.block_size, self.max_blocks
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(self.dtype)
+        kc, vc = pa.alloc_paged_cache(
+            self.num_blocks, nkv, bs, h,
+            jnp.int8 if self.kv == "int8" else dt)
+        ids = np.arange(b * w, dtype=np.int32).reshape(b, w)
+        kv = jnp.asarray(rng.standard_normal((b * w, nkv, bs, h)),
+                         jnp.float32)
+        vv = jnp.asarray(rng.standard_normal((b * w, nkv, bs, h)),
+                         jnp.float32)
+        kc = pa.paged_pour_blocks(kc, kv, ids.reshape(-1))
+        vc = pa.paged_pour_blocks(vc, vv, ids.reshape(-1))
+        s = self.seq
+        lens = np.clip(np.linspace(2, s, b).astype(np.int32), 2, s)
+        return (kc, vc,
+                jnp.asarray(rng.standard_normal((b, n, h)), dt),
+                jnp.asarray(rng.standard_normal((b, nkv, h)), dt),
+                jnp.asarray(rng.standard_normal((b, nkv, h)), dt),
+                jnp.asarray(ids), jnp.asarray(lens))
+
+    def parity_ok(self, fn, args, reference_out) -> bool:
+        """The parity gate: pools must match the twin BIT-EXACTLY for
+        both kv kinds (quantized writes are deterministic integer math);
+        the attention output must be bit-exact for 'bf16' and inside the
+        documented PR-6 drift budget for 'int8' (the 'rows' layout
+        re-associates the per-row einsum)."""
+        import jax
+
+        try:
+            got = fn(*args)
+        except Exception:
+            return False
+        r_leaves = jax.tree_util.tree_leaves(reference_out)
+        g_leaves = jax.tree_util.tree_leaves(got)
+        if len(r_leaves) != len(g_leaves):
+            return False
+        for i, (r, g) in enumerate(zip(r_leaves, g_leaves)):
+            if r.shape != g.shape or r.dtype != g.dtype:
+                return False
+            if i == 0 and self.kv == "int8":  # attention output leaf
+                if not np.allclose(np.asarray(r, np.float32),
+                                   np.asarray(g, np.float32),
+                                   rtol=1e-3, atol=1e-4):
+                    return False
+            elif not bool((r == g).all()):
+                return False
+        return True
+
+    # --------------------------------------------------------------- build
+    def build(self, config):
+        if config.get("layout") == "rows":
+            if self.kv != "int8":
+                raise ValueError(
+                    "the per-row layout re-associates the attention "
+                    "einsum: bf16 chains are bit-exact-only ('batch')")
+            return _build_rows(self, config)
+        return _build_batch(self, config)
+
+
+def _loop_gather(pool, tables, unroll):
+    """paged_gather's values, one page group at a time: a lax.fori_loop
+    copies `unroll` pages per step into the assembly buffer — pure data
+    movement, so the result is BIT-IDENTICAL to the bulk take; only the
+    copy granularity (the knob a DMA pipeline tunes) differs."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import paged_attention as pa
+
+    quant = isinstance(pool, pa.QuantPool)
+    data = pool.data if quant else pool
+    b, w = tables.shape
+    _nb, nkv, bs, h = data.shape
+    buf = jnp.zeros((b, w, nkv, bs, h),
+                    jnp.float32 if quant else data.dtype)
+
+    def step(i, buf):
+        for t in range(unroll):
+            wi = i * unroll + t
+            for bi in range(b):
+                idx = tables[bi, wi]
+                blk = jax.lax.dynamic_index_in_dim(data, idx, 0,
+                                                   keepdims=False)
+                if quant:
+                    sc = jax.lax.dynamic_index_in_dim(pool.scale, idx, 0,
+                                                      keepdims=False)
+                    blk = blk.astype(jnp.float32) * sc[:, None, None]
+                buf = jax.lax.dynamic_update_slice(
+                    buf, blk[None, None], (bi, wi, 0, 0, 0))
+        return buf
+
+    buf = jax.lax.fori_loop(0, w // unroll, step, buf)
+    return jnp.moveaxis(buf, 2, 1).reshape(b, nkv, w * bs, h)
+
+
+def _pool_specs(spec, whole):
+    """(in_specs head, out_specs tail, out_shapes tail, n_leaves) for the
+    k/v pool leaves — payload(+scales) per pool, whole-array blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    pool_shape = (spec.num_blocks, spec.num_kv_heads, spec.block_size,
+                  spec.head_dim)
+    pool_dt = jnp.int8 if spec.kv == "int8" else jnp.dtype(spec.dtype)
+    if spec.kv == "int8":
+        scale_shape = (spec.num_blocks, spec.num_kv_heads)
+        per_pool = [(pool_shape, pool_dt), (scale_shape, jnp.float32)]
+    else:
+        per_pool = [(pool_shape, pool_dt)]
+    leaves = per_pool + per_pool  # k then v
+    in_specs = [whole(shape) for shape, _dt in leaves]
+    out_specs = [whole(shape) for shape, _dt in leaves]
+    out_shapes = [jax.ShapeDtypeStruct(shape, dt) for shape, dt in leaves]
+    return in_specs, out_specs, out_shapes, len(per_pool)
+
+
+def _build_batch(spec, config):
+    """The whole-batch layout: ONE grid step replays the exact unfused op
+    sequence (paged_write x2 → paged_gather/loop-gather →
+    gathered_attention) over VMEM-resident pools — bit-exact vs the twin
+    by construction, fused into a single HBM round trip."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.ops import paged_attention as pa
+    from paddle_tpu.ops._pl_utils import imap
+
+    int8 = spec.kv == "int8"
+    gather = config.get("gather", "take")
+    unroll = int(config.get("unroll", 1) or 1)
+    b, n, nkv, h = (spec.batch, spec.num_heads, spec.num_kv_heads,
+                    spec.head_dim)
+    w = spec.max_blocks
+    dt = jnp.dtype(spec.dtype)
+    n_pool_in = 4 if int8 else 2
+
+    def whole(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, imap(lambda i: (0,) * nd))
+
+    def kernel(*refs):
+        pool_ins = refs[:n_pool_in]
+        q_r, kn_r, vn_r, tbl_r, ln_r = refs[n_pool_in:n_pool_in + 5]
+        o_r = refs[n_pool_in + 5]
+        pool_outs = refs[n_pool_in + 6:]
+        tables = tbl_r[...]
+        lens = ln_r[...]
+        pos = lens - 1
+        if int8:
+            kpool = pa.QuantPool(pool_ins[0][...], pool_ins[1][...])
+            vpool = pa.QuantPool(pool_ins[2][...], pool_ins[3][...])
+        else:
+            kpool, vpool = pool_ins[0][...], pool_ins[1][...]
+        kpool = pa.paged_write(kpool, kn_r[...], tables, pos)
+        vpool = pa.paged_write(vpool, vn_r[...], tables, pos)
+        if int8:
+            pool_outs[0][...] = kpool.data
+            pool_outs[1][...] = kpool.scale
+            pool_outs[2][...] = vpool.data
+            pool_outs[3][...] = vpool.scale
+        else:
+            pool_outs[0][...] = kpool
+            pool_outs[1][...] = vpool
+        if gather == "take":
+            keys = pa.paged_gather(kpool, tables)
+            vals = pa.paged_gather(vpool, tables)
+        else:
+            keys = _loop_gather(kpool, tables, unroll)
+            vals = _loop_gather(vpool, tables, unroll)
+        o = pa.gathered_attention(q_r[...][:, None], keys, vals, lens)
+        o_r[...] = o[:, 0].astype(o_r.dtype)
+
+    pool_in_specs, pool_out_specs, pool_out_shapes, _ = _pool_specs(
+        spec, whole)
+    in_specs = pool_in_specs + [
+        whole((b, n, h)), whole((b, nkv, h)), whole((b, nkv, h)),
+        whole((b, w)), whole((b,))]
+    out_specs = [whole((b, n, h))] + pool_out_specs
+    out_shape = [jax.ShapeDtypeStruct((b, n, h), dt)] + pool_out_shapes
+    aliases = {i: i + 1 for i in range(n_pool_in)}  # pools donate in place
+
+    return _wrap_call(spec, kernel, (1,), in_specs, out_specs, out_shape,
+                      aliases)
+
+
+def _build_rows(spec, config):
+    """The per-row layout (int8 only): grid over batch rows, each step
+    writing its row's token into its OWN pool block (the engine's
+    disjoint-ownership invariant) and gathering just that row's pages.
+    Pools stay bit-exact (the running-max rescale replays
+    _quant_write_chunk's math per row); the attention output re-associates
+    the einsum and rides the int8 drift budget."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.ops import paged_attention as pa
+    from paddle_tpu.ops._pl_utils import imap
+
+    gather = config.get("gather", "take")
+    unroll = int(config.get("unroll", 1) or 1)
+    b, n, nkv, h = (spec.batch, spec.num_heads, spec.num_kv_heads,
+                    spec.head_dim)
+    bs, w = spec.block_size, spec.max_blocks
+    dt = jnp.dtype(spec.dtype)
+    qmax, eps = 127.0, 1e-12
+
+    def whole(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, imap(lambda i: (0,) * nd))
+
+    def row(shape):
+        nd = len(shape)
+        return pl.BlockSpec((1,) + shape[1:],
+                            imap(lambda i: (i,) + (0,) * (nd - 1)))
+
+    def kernel(*refs):
+        kd, ks, vd, vs = refs[:4]
+        q_r, kn_r, vn_r, tbl_r, ln_r, o_r = refs[4:10]
+        okd, oks, ovd, ovs = refs[10:]
+        ln = ln_r[0]
+        pos = ln - 1
+        bidx = tbl_r[0, pos // bs]
+        slot = pos % bs
+
+        def write(d_ref, s_ref, od_ref, os_ref, new):
+            # _quant_write_chunk's math for ONE row's token: running-max
+            # scale growth + in-place rescale of the touched block
+            af = new.astype(jnp.float32)                    # [1, Nkv, H]
+            tok = jnp.max(jnp.abs(af), axis=-1) / qmax      # [1, Nkv]
+            old_s = pl.load(s_ref, (pl.ds(bidx, 1),))       # [1, Nkv]
+            new_s = jnp.maximum(old_s, tok)
+            safe = jnp.maximum(new_s, eps)
+            old_b = pl.load(d_ref, (pl.ds(bidx, 1),)).astype(jnp.float32)
+            ratio = jnp.where(new_s > old_s, old_s / safe, 1.0)
+            resc = jnp.clip(jnp.round(old_b * ratio[..., None, None]),
+                            -qmax, qmax).astype(jnp.int8)
+            qv = jnp.clip(jnp.round(af / safe[..., None]),
+                          -qmax, qmax).astype(jnp.int8)
+            resc = jax.lax.dynamic_update_slice(
+                resc, qv[:, :, None, :], (0, 0, slot, 0))
+            pl.store(od_ref, (pl.ds(bidx, 1),), resc)
+            pl.store(os_ref, (pl.ds(bidx, 1),), new_s)
+
+        write(kd, ks, okd, oks, kn_r[...])
+        write(vd, vs, ovd, ovs, vn_r[...])
+
+        def gather_row(od_ref, os_ref):
+            # this row's pages out of the WRITTEN pool; take and loop are
+            # pure data movement over the same values (one definition of
+            # the loop path: _loop_gather)
+            pool = pa.QuantPool(od_ref[...], os_ref[...])
+            if gather == "take":
+                return pa.paged_gather(pool, tbl_r[...])
+            return _loop_gather(pool, tbl_r[...], unroll)
+
+        keys = gather_row(okd, oks)
+        vals = gather_row(ovd, ovs)
+        o = pa.gathered_attention(q_r[...][:, None], keys, vals, ln_r[...])
+        o_r[...] = o[:, 0].astype(o_r.dtype)
+
+    pool_in_specs, pool_out_specs, pool_out_shapes, _ = _pool_specs(
+        spec, whole)
+    in_specs = pool_in_specs + [
+        row((b, n, h)), row((b, nkv, h)), row((b, nkv, h)),
+        row((b, w)), row((b,))]
+    out_specs = [row((b, n, h))] + pool_out_specs
+    out_shape = [jax.ShapeDtypeStruct((b, n, h), dt)] + pool_out_shapes
+    aliases = {i: i + 1 for i in range(4)}
+
+    return _wrap_call(spec, kernel, (b,), in_specs, out_specs, out_shape,
+                      aliases)
+
+
+def _wrap_call(spec, kernel, grid, in_specs, out_specs, out_shape, aliases):
+    """pallas_call wrapper taking the canonical (kc, vc, q, kn, vn,
+    tables, lens) signature and returning (o, kc', vc') with QuantPools
+    re-assembled leaf-wise."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.ops import paged_attention as pa
+
+    int8 = spec.kv == "int8"
+
+    def fused(kc, vc, q, kn, vn, tables, lens):
+        if int8:
+            pool_leaves = (kc.data, kc.scale, vc.data, vc.scale)
+        else:
+            pool_leaves = (kc, vc)
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            input_output_aliases=aliases,
+            interpret=jax.default_backend() != "tpu",
+        )(*pool_leaves, q, kn, vn, tables, lens)
+        if int8:
+            o, kd, ks, vd, vs = outs
+            return o, pa.QuantPool(kd, ks), pa.QuantPool(vd, vs)
+        o, kd, vd = outs
+        return o, kd, vd
+
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# engine-facing plumbing
+
+
+def spec_from_arrays(kc, q, tables):
+    """Geometry spec for the chain the traced step is about to run —
+    derived from the live pool/query/table shapes, so the fused kernel
+    and the arrays it consumes can never disagree."""
+    from paddle_tpu.ops import paged_attention as pa
+
+    quant = isinstance(kc, pa.QuantPool)
+    data = kc.data if quant else kc
+    nb, nkv, bs, h = data.shape
+    b, n, _h = q.shape
+    return DecodeChainSpec(
+        batch=int(b), num_heads=int(n), num_kv_heads=int(nkv),
+        head_dim=int(h), block_size=int(bs),
+        max_blocks=int(tables.shape[1]), num_blocks=int(nb),
+        kv="int8" if quant else "bf16",
+        dtype=np.dtype(q.dtype))
+
+
+def ensure_decision(spec, searcher=None):
+    """Search-or-serve for one decode-chain geometry: cache verdicts are
+    final (accepted configs serve with ZERO re-measurement; disabled
+    geometries never re-fire), fresh geometries run the full
+    enumerate→prune→parity→measure→gate loop and persist.  A
+    cache-served config is parity-gated once per consumer anyway — a
+    cache file is trusted about SPEED, never about numerics."""
+    import jax
+
+    from paddle_tpu.static.schedule_search import Decision, ScheduleSearcher
+
+    if searcher is None:
+        searcher = ScheduleSearcher()
+    decision = searcher.search(spec)
+    if decision.status == "cache":
+        try:
+            args = spec.synthetic_args()
+            ref_out = jax.jit(spec.reference())(*args)
+            if not spec.parity_ok(jax.jit(spec.build(decision.config)),
+                                  args, ref_out):
+                return Decision("disabled")
+        except Exception:
+            return Decision("disabled")
+    return decision
+
+
+def fused_decode_step(kc, vc, q, kn, vn, tables, lens, *, config):
+    """The macro-step scan body's fused seam: one accepted-config Pallas
+    dispatch replacing the write→write→attend op sequence of
+    models/llama._decode_layer_paged.  Returns (o, kc', vc')."""
+    spec = spec_from_arrays(kc, q, tables)
+    return spec.build(config)(kc, vc, q, kn, vn, tables, lens)

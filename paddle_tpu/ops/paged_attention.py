@@ -33,6 +33,7 @@ __all__ = [
     "paged_write_chunk",
     "paged_pour_blocks",
     "paged_gather",
+    "gathered_attention",
     "paged_decode_attention",
     "paged_chunk_attention",
     "pool_num_kv_heads",
@@ -284,19 +285,17 @@ def paged_pour_blocks(cache, kv, block_ids):
     return cache.at[idx].set(kv.astype(cache.dtype))
 
 
-def paged_chunk_attention(q, key_cache, value_cache, block_tables, seq_lens,
-                          *, scale=None):
-    """Multi-token decode attention over the paged cache (speculative
-    verify / chunked decode): q [B, T, N, H]; seq_lens [B] INCLUDING all
-    T chunk tokens.  Chunk position j sits at global position
-    seq_lens - T + j and attends keys <= that position (bottom-right
-    causal within the chunk).  Returns [B, T, N, H]."""
+def gathered_attention(q, keys, vals, seq_lens, *, scale=None):
+    """The sdpa core of the decode tier over ALREADY-GATHERED views:
+    q [B, T, N, H]; keys/vals [B, Nkv, S, H] (dequantized); seq_lens [B]
+    INCLUDING all T chunk tokens.  The ONE masked-softmax definition —
+    paged_chunk_attention feeds it the paged_gather views and the fused
+    decode-chain kernel (ops/decode_chain.py) feeds it VMEM-gathered
+    pages, so the two paths cannot drift numerically."""
     b, t, n, h = q.shape
-    nkv = pool_num_kv_heads(key_cache)
+    nkv = keys.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(h)
-    keys = paged_gather(key_cache, block_tables)  # [B, Nkv, S, H]
-    vals = paged_gather(value_cache, block_tables)
     if n != nkv:
         group = n // nkv
         keys = jnp.repeat(keys, group, axis=1)
@@ -311,3 +310,15 @@ def paged_chunk_attention(q, key_cache, value_cache, block_tables, seq_lens,
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bnts,bnsh->btnh", probs, vals.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def paged_chunk_attention(q, key_cache, value_cache, block_tables, seq_lens,
+                          *, scale=None):
+    """Multi-token decode attention over the paged cache (speculative
+    verify / chunked decode): q [B, T, N, H]; seq_lens [B] INCLUDING all
+    T chunk tokens.  Chunk position j sits at global position
+    seq_lens - T + j and attends keys <= that position (bottom-right
+    causal within the chunk).  Returns [B, T, N, H]."""
+    keys = paged_gather(key_cache, block_tables)  # [B, Nkv, S, H]
+    vals = paged_gather(value_cache, block_tables)
+    return gathered_attention(q, keys, vals, seq_lens, scale=scale)

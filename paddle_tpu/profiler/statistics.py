@@ -289,19 +289,36 @@ def schedule_line(stats: dict) -> str:
     Profiler.summary(); empty when the search tier never ran this process.
     `disabled` nonzero is healthy honesty (the measured-win gate found XLA
     faster and said so); `measured` climbing in steady state means shape
-    churn is defeating the per-device schedule cache."""
+    churn is defeating the per-device schedule cache.  A second line
+    reports the serving decode-chain verdicts (phase 2) when any engine
+    consulted the searcher — mesh_skipped counts TP-sharded engines that
+    kept the unfused scan body by design."""
+    decode = any(stats.get(k) for k in (
+        "decode_chains_found", "decode_chains_accepted",
+        "decode_chains_disabled", "decode_chains_mesh_skipped"))
     if not (stats.get("subgraphs_found") or stats.get("cache_hits")
-            or stats.get("disabled_hits")):
+            or stats.get("disabled_hits") or decode):
         return ""
-    return (
+    line = (
         "Schedule search: subgraphs=%d candidates=%d pruned_roofline=%d "
-        "pruned_vmem=%d measured=%d accepted=%d disabled=%d; "
-        "cache hits=%d disabled_hits=%d"
+        "pruned_vmem=%d pruned_parity=%d measured=%d accepted=%d "
+        "disabled=%d; cache hits=%d disabled_hits=%d"
         % (stats["subgraphs_found"], stats["candidates"],
            stats["pruned_roofline"], stats["pruned_vmem"],
+           stats.get("pruned_parity", 0),
            stats["measured"], stats["accepted"], stats["disabled"],
            stats["cache_hits"], stats["disabled_hits"])
     )
+    if decode:
+        line += (
+            "\nDecode chains: found=%d accepted=%d disabled=%d "
+            "mesh_skipped=%d"
+            % (stats.get("decode_chains_found", 0),
+               stats.get("decode_chains_accepted", 0),
+               stats.get("decode_chains_disabled", 0),
+               stats.get("decode_chains_mesh_skipped", 0))
+        )
+    return line
 
 
 def checkpoint_line(stats: dict) -> str:

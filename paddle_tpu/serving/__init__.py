@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from paddle_tpu._core import flags as _flags
 
 __all__ = ["GenerationEngine", "RadixPrefixCache", "decode_stats",
-           "reset_decode_stats", "lora_stats", "reset_lora_stats"]
+           "reset_decode_stats", "lora_stats", "reset_lora_stats",
+           "schedule_decode_stats", "reset_schedule_decode_stats"]
 
 
 # --------------------------------------------------------- decode telemetry
@@ -132,10 +133,46 @@ def reset_lora_stats():
             _LORA_STATS[k] = 0
 
 
+# Decode-chain schedule-search counters (schedule search, phase 2 —
+# docs/SCHEDULE_SEARCH.md; profiler.schedule_search_stats merges these into
+# the search-tier schema).  The SERVING module owns them because the engine
+# is where decode-chain discovery/adoption happens: found = eligible
+# engines that consulted the searcher for their macro-step geometry;
+# accepted = engines whose compiled macro-step adopted a fused config;
+# disabled = engines that kept the unfused ops (measured loss, cache
+# verdict, or a failed cache-config parity re-gate); mesh_skipped =
+# TP-sharded engines that skipped in-scan substitution (the fused kernel
+# is a single-device program — a counted skip, never a crash).
+_SCHED_DECODE_STATS = {
+    "decode_chains_found": 0,
+    "decode_chains_accepted": 0,
+    "decode_chains_disabled": 0,
+    "decode_chains_mesh_skipped": 0,
+}
+
+
+def schedule_decode_stats(reset: bool = False) -> dict:
+    """Decode-chain counters for the schedule-search telemetry (see
+    _SCHED_DECODE_STATS above; docs/SCHEDULE_SEARCH.md phase 2)."""
+    out = dict(_SCHED_DECODE_STATS)
+    if reset:
+        reset_schedule_decode_stats()
+    return out
+
+
+def reset_schedule_decode_stats():
+    for k in _SCHED_DECODE_STATS:
+        _SCHED_DECODE_STATS[k] = 0
+
+
 # Live engines hold compiled decode executables; any flag change may alter
 # what those programs traced (FLAGS_decode_chunk, matmul precision, ...), so
 # set_flags drops them — the same contract as the eager dispatch cache.
 _ENGINES: "weakref.WeakSet[GenerationEngine]" = weakref.WeakSet()
+
+# sentinel: the engine's decode-chain verdict is resolved lazily at the
+# first _build_step and re-resolved after any flag change
+_CHAIN_UNSET = object()
 
 
 @_flags.on_change
@@ -143,6 +180,9 @@ def _invalidate_decode_steps(_changed):
     for eng in list(_ENGINES):
         eng._step_fns.clear()
         eng._draft_fn = eng._verify_fn = None
+        # flags govern whether (and which) fused decode-chain schedule the
+        # rebuilt steps may consume — re-resolve with the steps
+        eng._decode_chain_cfg = _CHAIN_UNSET
 
 
 @dataclass
@@ -463,6 +503,7 @@ class GenerationEngine:
             raise ValueError("decode_chunk must be >= 1")
         self._decode_chunk = None if decode_chunk is None else int(decode_chunk)
         self._step_fns: dict = {}  # macro-step executables, keyed by D
+        self._decode_chain_cfg = _CHAIN_UNSET  # lazy (_resolve_decode_chain)
         # masked lanes' block tables (every page is the slot's scratch
         # page): constant, so committed to the device ONCE here — not
         # re-transferred on every dispatch
@@ -1112,6 +1153,53 @@ class GenerationEngine:
             return self._decode_chunk
         return max(1, int(_flags.flag("FLAGS_decode_chunk")))
 
+    def _resolve_decode_chain(self):
+        """Consult the schedule searcher for this engine's decode hot
+        chain (paged gather → dequant → sdpa core → quant-write; schedule
+        search phase 2, docs/SCHEDULE_SEARCH.md) and cache the verdict:
+        an ACCEPTED config — served from the per-device-kind AutotuneCache
+        with zero re-measurement, or freshly searched (enumerate → prune
+        → parity → measure → measured-win gate) on a never-seen geometry
+        — makes the compiled macro-step run the chain as ONE fused Pallas
+        dispatch per layer per token; anything else keeps the unfused XLA
+        ops.  TP-sharded engines skip in-scan substitution with a counted
+        telemetry skip (the fused kernel is a single-device program), and
+        a flag change re-resolves alongside the invalidated step
+        executables."""
+        if self._decode_chain_cfg is not _CHAIN_UNSET:
+            return self._decode_chain_cfg
+        cfg = None
+        if (_flags.flag("FLAGS_schedule_search")
+                and _flags.flag("FLAGS_schedule_search_decode")):
+            if self.mesh is not None:
+                _SCHED_DECODE_STATS["decode_chains_mesh_skipped"] += 1
+            else:
+                from paddle_tpu.ops import decode_chain as _dc
+
+                _SCHED_DECODE_STATS["decode_chains_found"] += 1
+                spec = _dc.DecodeChainSpec(
+                    batch=self.max_batch,
+                    num_heads=self.model.config.num_attention_heads,
+                    num_kv_heads=self._nkv,
+                    head_dim=self._head_dim,
+                    block_size=self.block_size,
+                    max_blocks=self._max_blocks_per_seq,
+                    num_blocks=self._num_blocks + self.max_batch,
+                    kv=self._kv_dtype,
+                    dtype=jnp.dtype(
+                        jnp.bfloat16
+                        if self.model.config.dtype == "bfloat16"
+                        else jnp.float32),
+                )
+                decision = _dc.ensure_decision(spec)
+                if decision.accepted:
+                    cfg = dict(decision.config)
+                    _SCHED_DECODE_STATS["decode_chains_accepted"] += 1
+                else:
+                    _SCHED_DECODE_STATS["decode_chains_disabled"] += 1
+        self._decode_chain_cfg = cfg
+        return cfg
+
     def _build_step(self, chunk: int):
         """One macro-step executable: `chunk` decode tokens per dispatch.
 
@@ -1139,6 +1227,11 @@ class GenerationEngine:
         state = self._state
         eos = self.eos_token_id
         has_pack = self._pack is not None
+        # accepted decode-chain schedule (or None): resolved OUTSIDE the
+        # trace, so the compiled program bakes one fixed fused/unfused
+        # shape — adoption never changes mid-stream (schedule search
+        # phase 2; docs/SCHEDULE_SEARCH.md)
+        chain_cfg = self._resolve_decode_chain()
 
         def step(state_vals, kpools, vpools, tokens, tables, scratch_tables,
                  lens, max_lens, done0, temps, keys, steps, *lora_args):
@@ -1176,7 +1269,8 @@ class GenerationEngine:
                         h, kps, vps = _decode_layers_paged(
                             model.model.layers, h, cos, sin, kps, vps,
                             tables_eff, lens_eff, adapters=pack_ab,
-                            slots=ad_slots, scaling=row_scale)
+                            slots=ad_slots, scaling=row_scale,
+                            chain_cfg=chain_cfg)
                         h = model.model.norm(h)
                         logits = model._logits(h)
                     lg = logits._value[:, -1, :]

@@ -79,6 +79,8 @@ _COUNTERS = {
     "pruned_roofline": 0,     # dropped by the analytic roofline ranking
     "pruned_vmem": 0,         # dropped by the VMEM working-set budget
     "measured": 0,            # candidates actually timed on device
+    "pruned_parity": 0,       # candidates whose numerics failed the spec's
+                              # parity gate vs the XLA twin (never measured)
     "accepted": 0,            # subgraphs whose best schedule beat XLA
     "disabled": 0,            # subgraphs recorded as losing (or unbuildable)
     "cache_hits": 0,          # accepted schedules served from the cache
@@ -148,6 +150,8 @@ class SubgraphSpec:
     k_dims: tuple           # matmul inner dims, in chain order
     has_reduce: bool
     col_tilable: bool       # the reduced axis may be tiled (no reduce/rowwise)
+    k_tilable: bool = False  # the contraction dim may be tiled (single
+                             # matmul whose x/w feed no other chain op)
     sig: str = ""
 
     def __post_init__(self):
@@ -175,6 +179,44 @@ class SubgraphSpec:
         from paddle_tpu.ops.autotune import _key_str
 
         return f"{self.kernel_name()}|{_key_str(self.key())}"
+
+    # ---- searcher protocol (shared with ops.decode_chain.DecodeChainSpec:
+    # the ScheduleSearcher drives any spec through these six hooks) -------
+    check_parity = False  # Program subgraphs rely on differential_check
+
+    def enumerate_configs(self):
+        return enumerate_candidates(self)
+
+    def roofline_ms(self, config, cost_model=None):
+        return candidate_roofline_ms(self, config, cost_model)
+
+    def vmem_bytes(self, config):
+        return candidate_vmem_bytes(self, config)
+
+    def build(self, config):
+        return build_kernel(self, config)
+
+    def reference(self):
+        return build_reference(self)
+
+    def synthetic_args(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        return tuple(
+            jnp.asarray(rng.standard_normal(e.shape), e.dtype)
+            for e in self.ext)
+
+    def parity_ok(self, fn, args, reference_out):  # noqa: ARG002
+        return True
+
+    def config_label(self, config):
+        lbl = (f"#{config['block_rows']}x{config['block_cols']}"
+               f"@{config['grid_order']}")
+        bk = config.get("block_k")
+        if bk and self.k_dims and bk < self.k_dims[0]:
+            lbl += f"k{bk}"
+        return lbl
 
 
 # ---------------------------------------------------------------------------
@@ -467,6 +509,20 @@ def match_subgraph(root, graph, min_ops=2):
     col_tilable = (n_mm > 0 and n_red == 0 and n_row == 0 and not wide_consts
                    and not xrow_in_elem
                    and all(e.role != "weight" or e.cols == cols for e in ext))
+    # K-loop tiling (phase 2): a SINGLE matmul whose x AND w are chain-
+    # external vars feeding nothing but the matmul itself — the kernel
+    # then carries an f32 accumulator across contraction grid steps and
+    # replays the epilogue on the last one.  On K == M / K == N aliasing
+    # shapes a weight or activation consumed by an elementwise op would
+    # mix k-sliced blocks with row blocks, so those chains stay untiled.
+    mm_vids = xrow_vids | {e.vid for e in ext
+                           if mm_slots.get(e.vid) == "weight"}
+    mm_ext_in_elem = any(
+        s[0] == "var" and s[1] in mm_vids
+        for op in ordered if kinds[id(op)] != "matmul"
+        for s in op.arg_spec)
+    k_tilable = (n_mm == 1 and not mm_ext_in_elem
+                 and any(e.role == "weight" for e in ext))
 
     out_cols = cols if out_shape == row_shape else 1
     return SubgraphSpec(
@@ -483,6 +539,7 @@ def match_subgraph(root, graph, min_ops=2):
         k_dims=tuple(k_dims),
         has_reduce=n_red > 0 or n_row > 0,
         col_tilable=col_tilable,
+        k_tilable=k_tilable,
     )
 
 
@@ -491,13 +548,18 @@ def match_subgraph(root, graph, min_ops=2):
 
 
 def enumerate_candidates(spec: SubgraphSpec):
-    """Candidate tilings: block shapes, grid layouts, dimension orders.
+    """Candidate tilings: block shapes, grid layouts, dimension orders —
+    and, for K-tilable matmul chains, contraction-dim splits.
 
     Row blocks are multiples of 8 (f32 sublane).  The reduced axis is tiled
     only for reduction-free matmul chains (a per-block partial reduction
     would be wrong; a rowwise op needs its whole row).  Dimension order
     (which grid axis sweeps innermost) matters whenever the grid is 2-D:
-    it decides whether weight tiles or activation tiles get re-fetched."""
+    it decides whether weight tiles or activation tiles get re-fetched.
+    K-tiled candidates carry ``block_k`` and always place the contraction
+    axis INNERMOST (the f32 accumulator block then stays VMEM-resident
+    across its revisits; with K innermost both operands re-stream the same
+    under either outer order, so only one order is enumerated)."""
     rows, cols = spec.rows, spec.cols
     brs = [b for b in (8, 16, 32, 64, 128, 256, 512)
            if b <= rows and rows % b == 0] or [rows]
@@ -506,15 +568,26 @@ def enumerate_candidates(spec: SubgraphSpec):
         bcs.append(cols)
     else:
         bcs = [cols]
+    K = spec.k_dims[0] if spec.k_dims else 0
+    if spec.k_tilable and K:
+        bks = [b for b in (128, 256, 512) if b < K and K % b == 0]
+        bks.append(K)
+    else:
+        bks = [None]
     out = []
     for br in brs:
         for bc in bcs:
-            orders = ["rows_first"]
-            if bc != cols and rows // br > 1:
-                orders.append("cols_first")
-            for od in orders:
-                out.append({"block_rows": br, "block_cols": bc,
-                            "grid_order": od})
+            for bk in bks:
+                orders = ["rows_first"]
+                split = bk is not None and bk < K  # K innermost: no 2nd order
+                if not split and bc != cols and rows // br > 1:
+                    orders.append("cols_first")
+                for od in orders:
+                    cfg = {"block_rows": br, "block_cols": bc,
+                           "grid_order": od}
+                    if bk is not None:
+                        cfg["block_k"] = bk
+                    out.append(cfg)
     return out
 
 
@@ -523,23 +596,41 @@ def _grid_dims(spec, config):
     return br, bc, spec.rows // br, spec.cols // bc
 
 
+def _k_split(spec, config):
+    """(block_k, grid_k) — (K, 1) when the candidate keeps the contraction
+    resident (incl. legacy cached configs with no block_k entry)."""
+    K = spec.k_dims[0] if spec.k_dims else 0
+    bk = int(config.get("block_k") or 0)
+    if spec.k_tilable and K and bk and bk < K:
+        return bk, K // bk
+    return K, 1
+
+
 def candidate_vmem_bytes(spec: SubgraphSpec, config: dict) -> int:
     """f32 working-set estimate for one grid step (double-buffered): all
-    input blocks + the output block + one block-sized temp per chain op."""
+    input blocks + the output block + one block-sized temp per chain op.
+    A K-tiled candidate holds (br, bk) activation and (bk, bc) weight
+    slices plus the f32 accumulator block instead of whole-K operands —
+    the split that lets large-K matmul chains fit the budget at all."""
     br, bc, _, _ = _grid_dims(spec, config)
+    bk, gk = _k_split(spec, config)
     tiled = bc != spec.cols
     elems = br * (bc if (tiled and spec.out_cols == spec.cols) else spec.out_cols)
     widest = spec.out_cols
     for e in spec.ext:
         ec = bc if (tiled and e.cols == spec.cols
                     and e.role != "xrow") else e.cols
-        if e.role in ("row", "xrow"):
+        if e.role == "xrow":
+            elems += br * (bk if gk > 1 else ec)
+        elif e.role == "row":
             elems += br * ec
         elif e.role == "bcast":
             elems += ec
-        else:  # weight resident per step
-            elems += e.shape[0] * ec
+        else:  # weight: whole-K resident per step unless K is tiled
+            elems += (bk if gk > 1 else e.shape[0]) * ec
         widest = max(widest, ec)
+    if gk > 1:
+        elems += br * (bc if tiled else spec.cols)  # f32 accumulator block
     elems += len(spec.ops) * br * max(widest, bc if tiled else spec.cols)
     return int(elems) * 4 * 2
 
@@ -566,6 +657,7 @@ def candidate_roofline_ms(spec: SubgraphSpec, config: dict,
 
         cost_model = OpCostModel()
     br, bc, gm, gn = _grid_dims(spec, config)
+    bk, gk = _k_split(spec, config)
     rows, cols = spec.rows, spec.cols
     order = config.get("grid_order", "rows_first")
     tiled = gn > 1
@@ -576,8 +668,21 @@ def candidate_roofline_ms(spec: SubgraphSpec, config: dict,
     flops += (len(spec.ops) - len(spec.k_dims)) * rows * cols
 
     traffic = float(np.prod(spec.out_shape)) * np.dtype(spec.out_dtype).itemsize
+    if gk > 1:
+        # the f32 accumulator rides an extra HBM-backed output (written
+        # once per (i, j) tile) — K-tiling is not free and must rank so
+        traffic += float(rows * cols) * 4
     for e in spec.ext:
         sz = float(np.prod(e.shape)) * np.dtype(e.dtype).itemsize
+        if gk > 1 and e.role == "xrow":
+            # with K innermost the activation's (i, k) slices re-stream
+            # once per column block — the whole-K residency that made x
+            # fetch-once is exactly what the split gives up
+            traffic += sz * gn
+            continue
+        if gk > 1 and e.role == "weight":
+            traffic += sz * gm  # (k, j) slices re-stream per row block
+            continue
         j_indexed = tiled and e.cols == cols and e.role in ("bcast", "weight")
         i_only = (e.role == "xrow"
                   or (e.role == "row" and not (tiled and e.cols == cols)))
@@ -588,7 +693,7 @@ def candidate_roofline_ms(spec: SubgraphSpec, config: dict,
         else:
             traffic += sz  # each block visited exactly once
     return (cost_model.flops_time(flops, traffic)
-            + gm * gn * _GRID_STEP_OVERHEAD_S) * 1e3
+            + gm * gn * gk * _GRID_STEP_OVERHEAD_S) * 1e3
 
 
 # ---------------------------------------------------------------------------
@@ -631,16 +736,165 @@ def _chain_body(spec):
     return body
 
 
+def _epilogue_body(spec, mm_op, mm_dtype):
+    """The chain replay with the matmul's output SUBSTITUTED: the K-tiled
+    kernel accumulates x@w across contraction grid steps and feeds the
+    finished accumulator here on the last one.  A 3-arg matmul/linear adds
+    its bias now (the partial products must sum before the epilogue)."""
+    import jax
+    import jax.numpy as jnp
+
+    ext_vids = [e.vid for e in spec.ext]
+
+    def body(mm_out, *vals):
+        env = dict(zip(ext_vids, vals))
+        for op in spec.ops:
+            if op is mm_op:
+                r = mm_out
+                if len(op.arg_spec) == 3:
+                    s = op.arg_spec[2]
+                    bv = env[s[1]] if s[0] == "var" else jnp.asarray(s[1])
+                    r = r + bv
+                env[op.out_vids[0]] = r.astype(mm_dtype)
+            else:
+                var_vals = [env[s[1]] for s in op.arg_spec if s[0] == "var"]
+                out = op.fn(*var_vals)
+                for vid, v in zip(op.out_vids,
+                                  jax.tree_util.tree_leaves(out)):
+                    env[vid] = v
+        r = env[spec.out_vid]
+        if r.ndim == 1:
+            r = r.reshape(r.shape[0], 1)
+        return r
+
+    return body
+
+
+def _build_kernel_ktiled(spec: SubgraphSpec, config: dict):
+    """K-tiled variant of build_kernel: grid (gm, gn, gk) with the
+    contraction axis INNERMOST, an f32 accumulator carried across the k
+    revisits as an extra (i, j)-indexed output, and the epilogue (every
+    chain op beyond the matmul) replayed once on the final k step.  Only
+    (br, bk) activation and (bk, bc) weight slices are VMEM-resident per
+    step — large-K matmul chains fit the budget instead of being
+    auto-disabled."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.ops._pl_utils import imap
+
+    br, bc, gm, gn = _grid_dims(spec, config)
+    bk, gk = _k_split(spec, config)
+    rows, cols = spec.rows, spec.cols
+    tiled = gn > 1
+    mm_op = next(op for op in spec.ops
+                 if _base_type(op.type) in _MATMUL_OPS)
+    xrow_i = next(i for i, e in enumerate(spec.ext) if e.role == "xrow")
+    w_i = next(i for i, e in enumerate(spec.ext) if e.role == "weight")
+    mm_dtype = jnp.result_type(spec.ext[xrow_i].dtype, spec.ext[w_i].dtype)
+
+    def view2d(e, v):
+        if e.role in ("row", "xrow"):
+            return v.reshape(rows, e.cols)
+        if e.role == "bcast":
+            return v.reshape(1, e.cols)
+        return v  # weight: already 2-D
+
+    def block_shape(e):
+        if e.role == "xrow":
+            return (br, bk)
+        if e.role == "row":
+            return (br, bc) if (tiled and e.cols == cols) else (br, e.cols)
+        if e.role == "bcast":
+            return (1, bc) if (tiled and e.cols == cols) else (1, e.cols)
+        return (bk, bc) if tiled else (bk, e.cols)  # weight
+
+    def index_fn(e):
+        if e.role == "xrow":
+            return lambda i, j, k: (i, k)
+        if e.role == "weight":
+            return lambda i, j, k: (k, j)  # j fixed 0 when untiled cols
+        if e.role == "row":
+            if tiled and e.cols == cols:
+                return lambda i, j, k: (i, j)
+            return lambda i, j, k: (i, 0)
+        if tiled and e.cols == cols:  # bcast sliced along cols
+            return lambda i, j, k: (0, j)
+        return lambda i, j, k: (0, 0)
+
+    acc_block = (br, bc if tiled else cols)
+    out_block = (br, bc if (tiled and spec.out_cols == cols)
+                 else spec.out_cols)
+    ij = imap(lambda i, j, k: (i, j))
+
+    block_avals = [jax.ShapeDtypeStruct(block_shape(e), e.dtype)
+                   for e in spec.ext]
+    acc_aval = jax.ShapeDtypeStruct(acc_block, mm_dtype)
+    closed = jax.make_jaxpr(_epilogue_body(spec, mm_op, mm_dtype))(
+        acc_aval, *block_avals)
+    np_consts = [np.asarray(c) for c in closed.consts]
+    n_in = len(spec.ext)
+
+    def kernel(*refs):
+        ins, o_ref, acc_ref = refs[:n_in], refs[n_in], refs[n_in + 1]
+        k = pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _zero():
+            acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+        acc_ref[...] += jnp.dot(
+            ins[xrow_i][...].astype(jnp.float32),
+            ins[w_i][...].astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(k == gk - 1)
+        def _epilogue():
+            out = jax.core.eval_jaxpr(
+                closed.jaxpr, np_consts,
+                acc_ref[...].astype(mm_dtype),
+                *(r[...] for r in ins))[0]
+            o_ref[...] = out.astype(o_ref.dtype)
+
+    in_specs = [pl.BlockSpec(block_shape(e), imap(index_fn(e)))
+                for e in spec.ext]
+    out_specs = [pl.BlockSpec(out_block, ij), pl.BlockSpec(acc_block, ij)]
+    out_shape = [
+        jax.ShapeDtypeStruct((rows, spec.out_cols), spec.out_dtype),
+        jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+    ]
+
+    def fused(*vals):
+        flat = [view2d(e, v) for e, v in zip(spec.ext, vals)]
+        out, _acc = pl.pallas_call(
+            kernel,
+            grid=(gm, gn, gk),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=jax.default_backend() != "tpu",
+        )(*flat)
+        return out.reshape(spec.out_shape)
+
+    return fused
+
+
 def build_kernel(spec: SubgraphSpec, config: dict):
     """One Pallas kernel for the whole subgraph at `config`'s tiling: the
     recorded op fns are pre-traced at block shape (jax.make_jaxpr, closure
     constants baked as numpy — Pallas kernels may not capture traced
     arrays) and replayed over VMEM blocks, so an N-op chain makes one HBM
-    round trip.  Returns a callable over ORIGINAL-shaped external inputs."""
+    round trip.  Returns a callable over ORIGINAL-shaped external inputs.
+    Candidates carrying a genuine ``block_k`` split route to the K-tiled
+    accumulator variant (_build_kernel_ktiled)."""
     import jax
     from jax.experimental import pallas as pl
 
     from paddle_tpu.ops._pl_utils import imap
+
+    if _k_split(spec, config)[1] > 1:
+        return _build_kernel_ktiled(spec, config)
 
     br, bc, gm, gn = _grid_dims(spec, config)
     rows, cols = spec.rows, spec.cols
@@ -793,12 +1047,7 @@ class ScheduleSearcher:
 
     @staticmethod
     def _synthetic_args(spec):
-        import jax.numpy as jnp
-
-        rng = np.random.default_rng(0)
-        return tuple(
-            jnp.asarray(rng.standard_normal(e.shape), e.dtype)
-            for e in spec.ext)
+        return spec.synthetic_args()
 
     @staticmethod
     def _cached(spec):
@@ -817,7 +1066,15 @@ class ScheduleSearcher:
         c.save()
 
     # -------------------------------------------------------------- search
-    def search(self, spec: SubgraphSpec) -> Decision:
+    def search(self, spec) -> Decision:
+        """Drive any spec implementing the searcher protocol — a Program
+        SubgraphSpec or an ops.decode_chain.DecodeChainSpec — through
+        enumerate → roofline → VMEM → (parity) → measure → gate →
+        persist.  Specs with ``check_parity`` have every candidate's
+        numerics compared against the XLA twin BEFORE it may be measured:
+        a candidate that fails parity can never be accepted, however fast
+        (Program specs instead rely on the differential replay under
+        FLAGS_verify_programs)."""
         cached = self._cached(spec)
         if cached is not None:
             if cached.get("disabled"):
@@ -829,11 +1086,11 @@ class ScheduleSearcher:
         import jax
 
         _COUNTERS["subgraphs_found"] += 1
-        args = self._synthetic_args(spec)
-        candidates = enumerate_candidates(spec)
+        args = spec.synthetic_args()
+        candidates = spec.enumerate_configs()
         _COUNTERS["candidates"] += len(candidates)
 
-        ranked = [(candidate_roofline_ms(spec, c, self.cost_model), c)
+        ranked = [(spec.roofline_ms(c, self.cost_model), c)
                   for c in candidates]
         best_roof = min(r for r, _ in ranked)
         kept = [(r, c) for r, c in ranked
@@ -843,21 +1100,30 @@ class ScheduleSearcher:
         from paddle_tpu.ops.autotune import validate_tile
 
         fit = [(r, c) for r, c in kept
-               if validate_tile(candidate_vmem_bytes(spec, c)) is None]
+               if validate_tile(spec.vmem_bytes(c)) is None]
         _COUNTERS["pruned_vmem"] += len(kept) - len(fit)
 
         fit.sort(key=lambda rc: rc[0])
 
+        ref_fn = jax.jit(spec.reference())
+        ref_out = None
         best_cfg, best_ms = None, float("inf")
         budget_left = max(1, self.budget)
         for _, cfg in fit:
             if budget_left <= 0:
                 break
             try:
-                fn = jax.jit(build_kernel(spec, cfg))
+                fn = jax.jit(spec.build(cfg))
+                if spec.check_parity:
+                    if ref_out is None:
+                        ref_out = ref_fn(*args)
+                    if not spec.parity_ok(fn, args, ref_out):
+                        # wrong numerics beat nothing: rejected before any
+                        # timing, without burning a measure-budget slot
+                        _COUNTERS["pruned_parity"] += 1
+                        continue
                 ms = self._measure_ms(
-                    f"{spec.label()}#{cfg['block_rows']}x{cfg['block_cols']}"
-                    f"@{cfg['grid_order']}", fn, args, cfg)
+                    spec.label() + spec.config_label(cfg), fn, args, cfg)
             except Exception:
                 # unbuildable/unrunnable on this backend: does NOT burn a
                 # budget slot — a later buildable candidate still gets
@@ -876,7 +1142,7 @@ class ScheduleSearcher:
             return Decision("disabled")
 
         xla_ms = float(self._measure_ms(
-            f"{spec.label()}#xla", jax.jit(build_reference(spec)), args, None))
+            f"{spec.label()}#xla", ref_fn, args, None))
         win = xla_ms / best_ms if best_ms > 0 else 0.0
         meta = {"win": round(win, 4), "xla_ms": round(xla_ms, 6)}
         if win >= self.min_win:

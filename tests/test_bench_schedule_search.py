@@ -3,11 +3,15 @@ wiring.
 
 The --smoke twin must keep emitting the one-line JSON payload the driver
 parses, with the deterministic decision set intact: the matmul chain's
-searched schedule accepted with a >1x recorded win, the softmax chain's
-schedule disabled by the measured-win gate, the disabled entry persisted
-in the per-device cache and never re-measured on a cold reload, and the
-fused path matching XLA-only numerics.  Plus: the payload must flow
-through tools/check_bench_regression.py (the CI bench gate).
+searched schedule accepted with a >1x recorded win, the K-tiled twin
+accepted through a genuinely contraction-split config (phase 2), the
+softmax chain's schedule disabled by the measured-win gate, the decode
+hot chain accepted for bf16 and disabled-persisted for int8, the disabled
+entries never re-measured on a cold reload, and the fused paths matching
+XLA-only numerics.  Plus: the payload must flow through
+tools/check_bench_regression.py (the CI bench gate), including the new
+decode-chain section's win-to-win gate with disabled sides skipped
+honestly.
 """
 
 import json
@@ -34,7 +38,7 @@ def test_bench_schedule_search_smoke_decisions():
     payload = _run_smoke()
     assert payload["metric"] == "schedule_search_measured_win"
     assert payload["unit"] == "x"
-    assert payload["value"] > 1.0  # accepted schedule's recorded win
+    assert payload["value"] > 1.0  # best accepted schedule's recorded win
     assert payload["numerics_identical"] is True
     detail = payload["detail"]
     # the gate accepted a known-good tiling...
@@ -42,15 +46,27 @@ def test_bench_schedule_search_smoke_decisions():
     assert mm["substituted"] == 1 and mm["fused_op"] == "sched_chain_4"
     assert mm["cache_entry"]["meta"]["win"] > 1.0
     assert "block_rows" in mm["cache_entry"]["config"]
+    # ...the large-K twin only through a genuinely K-tiled schedule...
+    kt = detail["ktiled_matmul"]
+    assert kt["substituted"] == 1 and kt["fused_op"] == "sched_chain_3"
+    assert 0 < kt["cache_entry"]["config"]["block_k"] < 256
+    assert kt["cache_entry"]["meta"]["win"] > 1.0
     # ...and disabled the deliberately-bad one, persistently
     sm = detail["softmax_chain"]
     assert sm["substituted"] == 0
     assert sm["cache_entry"]["config"] == {"disabled": True}
     assert detail["disabled_persisted"] is True
     assert detail["never_refired"] is True
+    # decode hot chain (phase 2): bf16 accepted, int8 disabled-persisted
+    dec = detail["decode_chain"]
+    assert dec["bf16"]["accepted"] and dec["bf16"]["win"] > 1.0
+    assert dec["bf16"]["config"]["layout"] == "batch"
+    assert not dec["int8"]["accepted"]
+    assert dec["int8"]["disabled_persisted"] is True
     counters = detail["counters"]
-    assert counters["accepted"] == 1 and counters["disabled"] == 1
-    assert counters["measured"] > 0 and counters["disabled_hits"] >= 1
+    assert counters["accepted"] == 3 and counters["disabled"] == 2
+    assert counters["measured"] > 0 and counters["disabled_hits"] >= 2
+    assert counters["cache_hits"] >= 1  # accepted decode config re-served
 
 
 def test_bench_payload_flows_through_regression_gate(tmp_path):
@@ -74,4 +90,46 @@ def test_bench_payload_flows_through_regression_gate(tmp_path):
     # an all-disabled run (value 0 — honest loss, e.g. CPU interpret mode)
     # is never counted as a regression
     new.write_text(json.dumps(dict(payload, value=0.0)))
+    assert gate.main([str(old), str(new)]) == 0
+
+
+def test_decode_chain_payload_gated(tmp_path):
+    """The decode-chain section gates win-to-win per kv variant; a
+    disabled side (win 0) skips that variant honestly instead of being
+    recorded — or compared — as value=0."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_bench_regression as gate
+    finally:
+        sys.path.pop(0)
+
+    def payload(bf16_win, int8_win):
+        return json.dumps({
+            "metric": "schedule_search_measured_win", "value": 2.5,
+            "unit": "x",
+            "detail": {"decode_chain": {
+                "bf16": {"win": bf16_win,
+                         "disabled_persisted": bf16_win == 0.0},
+                "int8": {"win": int8_win,
+                         "disabled_persisted": int8_win == 0.0},
+            }},
+        })
+
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    # same wins -> ok
+    old.write_text(payload(1.8, 1.4))
+    new.write_text(payload(1.8, 1.4))
+    assert gate.main([str(old), str(new)]) == 0
+    # one variant's win collapses beyond the threshold -> regression
+    new.write_text(payload(1.8, 1.0))
+    assert gate.main([str(old), str(new)]) == 1
+    # the variant going DISABLED (honest measured loss) skips, not fails
+    new.write_text(payload(1.8, 0.0))
+    assert gate.main([str(old), str(new)]) == 0
+    # both sides pre-phase-2 (no section) skip silently
+    base = json.dumps({"metric": "schedule_search_measured_win",
+                       "value": 2.5, "unit": "x"})
+    old.write_text(base)
+    new.write_text(base)
     assert gate.main([str(old), str(new)]) == 0

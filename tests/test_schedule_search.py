@@ -371,6 +371,142 @@ def test_accepted_schedule_served_from_cache_without_remeasure(tmp_cache):
                               raise_on_error=False) == []
 
 
+# ------------------------------------------------------ K-tiling (phase 2)
+
+
+def _capture_epilogue_chain(M, K, N):
+    """matmul→bias-add→relu (col-tilable, no reduce tail): the class whose
+    large-K shapes used to be auto-disabled when no whole-K candidate fit
+    VMEM."""
+    prog = Program()
+    with program_guard(prog):
+        x = _feed(prog, "x", (M, K))
+        w = _feed(prog, "w", (K, N))
+        b = _feed(prog, "b", (N,))
+        out = F.relu(paddle.matmul(x, w) + b)
+    return prog, out
+
+
+def _spec_of(prog, out):
+    graph = ProgramGraph(prog, (out._vid,))
+    return next(s for s in (ss.match_subgraph(op, graph)
+                            for op in prog.global_block().ops) if s)
+
+
+@pytest.mark.parametrize("M,K,N", [
+    (32, 256, 64),    # non-square M/N/K
+    (32, 256, 256),   # K == N: the xrow-aliasing twin (PR-8 class)
+    (256, 256, 64),   # K == M: the weight-shape-aliasing twin
+])
+def test_ktiled_all_candidates_numerics_sweep(tmp_cache, M, K, N):
+    """Every enumerated candidate — K-tiled ones included — must match
+    the XLA twin numerically, across non-square M/N/K and both PR-8
+    square-dim aliasing twins."""
+    prog, out = _capture_epilogue_chain(M, K, N)
+    spec = _spec_of(prog, out)
+    assert spec.k_tilable
+    cands = ss.enumerate_candidates(spec)
+    ktiled = [c for c in cands if c.get("block_k", K) < K]
+    assert ktiled, "large K must enumerate contraction splits"
+    assert all(K % c["block_k"] == 0 for c in ktiled)
+    # K-tiled candidates pin the contraction innermost: one outer order
+    assert all(c["grid_order"] == "rows_first" for c in ktiled)
+    rng = np.random.default_rng(0)
+    vals = [jax.numpy.asarray(rng.standard_normal(e.shape), e.dtype)
+            for e in spec.ext]
+    ref = np.asarray(ss.build_reference(spec)(*vals))
+    for c in cands:
+        got = np.asarray(ss.build_kernel(spec, c)(*vals))
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3,
+                                   err_msg=str(c))
+
+
+def test_ktiled_reduce_tail_chain_numerics(tmp_cache):
+    """The matmul→bias→act→reduce class K-tiles too: the accumulator
+    finishes before the epilogue's reduction replays."""
+    prog, out = _capture_matmul_chain(M=32, K=256, N=64)
+    spec = _spec_of(prog, out)
+    assert spec.k_tilable and spec.has_reduce and not spec.col_tilable
+    cands = [c for c in ss.enumerate_candidates(spec)
+             if c.get("block_k", 256) < 256]
+    assert cands
+    rng = np.random.default_rng(0)
+    vals = [jax.numpy.asarray(rng.standard_normal(e.shape), e.dtype)
+            for e in spec.ext]
+    ref = np.asarray(ss.build_reference(spec)(*vals))
+    for c in cands:
+        got = np.asarray(ss.build_kernel(spec, c)(*vals))
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3,
+                                   err_msg=str(c))
+
+
+def test_ktile_rescues_vmem_bound_chain(tmp_cache):
+    """A contraction dim too large for any whole-K candidate used to
+    auto-disable the chain (every candidate VMEM-pruned).  With block_k
+    in the space the search accepts a schedule — and the roofline still
+    ranks the split honestly (re-streaming both operands costs more
+    traffic than a whole-K candidate of the same block shape)."""
+    prog, out = _capture_epilogue_chain(8, 16384, 128)
+    spec = _spec_of(prog, out)
+    cands = ss.enumerate_candidates(spec)
+    whole_k = [c for c in cands if c.get("block_k", 0) == 16384]
+    ktiled = [c for c in cands if c.get("block_k", 16384) < 16384]
+    assert whole_k and ktiled
+    # the whole-K working set busts the budget; the split fits
+    assert all(at.validate_tile(ss.candidate_vmem_bytes(spec, c))
+               is not None for c in whole_k)
+    assert any(at.validate_tile(ss.candidate_vmem_bytes(spec, c)) is None
+               for c in ktiled)
+    reference = prog.clone()
+    n = ScheduleSearchPass(
+        [out._vid],
+        searcher=ss.ScheduleSearcher(measure=_win_measure, budget=2)
+    ).apply(prog)
+    assert n == 1, ss.schedule_search_stats()
+    assert differential_check(reference, prog, [out._vid],
+                              raise_on_error=False) == []
+    # the accepted (and persisted) config is a genuine contraction split
+    raw = json.load(open(os.path.join(
+        str(tmp_cache), at.device_kind_slug() + ".json")))
+    entry = next(v for k, v in raw["schedule/matmul"].items()
+                 if "k=16384" in k)
+    assert 0 < entry["config"]["block_k"] < 16384
+
+
+def test_ktiled_roofline_costs_restreaming(tmp_cache):
+    """K-order honesty: at identical block shape a K-tiled candidate
+    models MORE traffic (activation re-streams per column block, weight
+    per row block, plus the accumulator write) — the split only ranks
+    ahead when VMEM or overhead says so, never for free."""
+    prog, out = _capture_epilogue_chain(64, 512, 256)
+    spec = _spec_of(prog, out)
+    base = {"block_rows": 32, "block_cols": 128, "grid_order": "rows_first"}
+    untiled = dict(base, block_k=512)
+    split = dict(base, block_k=128)
+    assert (ss.candidate_roofline_ms(spec, split)
+            > ss.candidate_roofline_ms(spec, untiled))
+    # and the split's working set is genuinely smaller
+    assert (ss.candidate_vmem_bytes(spec, split)
+            < ss.candidate_vmem_bytes(spec, untiled))
+
+
+def test_ktile_never_offered_when_mm_operand_feeds_elem(tmp_cache):
+    """K == N aliasing twin where the matmul ACTIVATION also feeds an
+    elementwise op: slicing the contraction dim would hand that op a
+    (br, bk) block where it needs (br, K) — discovery must refuse the
+    split (and col tiling, per PR 8)."""
+    prog = Program()
+    with program_guard(prog):
+        x = _feed(prog, "x", (32, 256))
+        w = _feed(prog, "w", (256, 256))
+        h = paddle.matmul(x, w)
+        out = F.relu(h + x)  # x re-enters the chain at row shape
+    spec = _spec_of(prog, out)
+    assert not spec.k_tilable and not spec.col_tilable
+    assert all(c.get("block_k") is None
+               for c in ss.enumerate_candidates(spec))
+
+
 # --------------------------------------------------------- e2e + telemetry
 
 

@@ -236,3 +236,31 @@ def test_sharded_engine_lints_clean_and_reports_per_device():
     out = prof.summary()
     assert "Sharded serving: mesh=mp2" in out
     assert "pool_bytes/device=%d" % st["pool_bytes_per_device"] in out
+
+
+def test_sharded_engine_skips_decode_chain_with_counted_telemetry():
+    """Schedule search phase 2 mesh rule (docs/SCHEDULE_SEARCH.md): a
+    TP-sharded engine under FLAGS_schedule_search SKIPS in-scan
+    decode-chain substitution — the fused kernel is a single-device
+    program — incrementing the mesh_skipped counter instead of erroring,
+    and its streams stay bit-identical to the search-off sharded engine
+    (the skip IS the unfused path)."""
+    from paddle_tpu.serving import (reset_schedule_decode_stats,
+                                    schedule_decode_stats)
+
+    ref = _run_workload(GenerationEngine(
+        _model(), max_batch=2, block_size=8, num_blocks=16, mesh=_mesh(2)))
+    reset_schedule_decode_stats()
+    paddle.set_flags({"FLAGS_schedule_search": True})
+    try:
+        eng = GenerationEngine(_model(), max_batch=2, block_size=8,
+                               num_blocks=16, mesh=_mesh(2))
+        got = _run_workload(eng)
+    finally:
+        paddle.set_flags({"FLAGS_schedule_search": False})
+    assert got == ref
+    stats = schedule_decode_stats()
+    assert stats["decode_chains_mesh_skipped"] >= 1
+    assert stats["decode_chains_found"] == 0  # never consulted a searcher
+    assert stats["decode_chains_accepted"] == 0
+    assert profiler.schedule_search_stats()["decode_chains_mesh_skipped"] >= 1

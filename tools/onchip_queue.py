@@ -36,6 +36,12 @@ WATCH_SLEEP = 180  # between probe attempts while the tunnel is down
 QUEUE = [
     ("autotune", [sys.executable, "-m", "paddle_tpu.ops.autotune",
                   "--budget-seconds", "420"], 900),
+    # full-mode schedule search right after the tile sweep: the moment a
+    # TPU appears the first REAL measured Pallas-beats-XLA table (Program
+    # chains + decode hot chain, win-or-disabled verdicts) records itself
+    # into the per-device-kind autotune cache without a human in the loop
+    ("bench_schedule_search",
+     [sys.executable, "benchmarks/bench_schedule_search.py"], 1200),
     ("bench_llama", [sys.executable, "bench.py"], 1800),
     ("bench_resnet", [sys.executable, "benchmarks/bench_resnet.py"], 1800),
     ("audit_resnet", [sys.executable, "benchmarks/audit_resnet.py"], 1800),
