@@ -25,6 +25,12 @@ Two claims measured:
   and inter-token latency, replayed on a TP-sharded twin over 2 (virtual
   when on CPU) devices with a greedy stream-parity gate
   (tools/check_bench_regression.py gates the percentiles too).
+- **Snapshot/restore**: save a LIVE mid-flight engine through the atomic
+  commit protocol and restore it (serving/snapshot.py) — save_ms /
+  restore_ms / committed bytes, with a resume-parity gate (the restored
+  engine's continued streams must equal an uninterrupted run's).  The
+  timings feed check_bench_regression's snapshot gate (growth beyond the
+  SLO threshold is the regression — the preemption budget this buys).
 
 Prints ONE JSON line like the other benches.  vs_baseline is 0.0 until a
 reference serving point is recorded (none published in-repo).
@@ -381,6 +387,54 @@ def main():
                else {k: v for k, v in slo_tp.items() if k != "results"}),
     }
 
+    # ---- snapshot/restore: live-engine fault tolerance timing ----------
+    # One mid-flight engine (resident greedy requests) snapshots through
+    # the atomic commit protocol and restores onto a fresh engine; the
+    # restored engine must finish every stream exactly as an
+    # uninterrupted twin — the bit-exact-resume contract, timed.  Wall
+    # numbers are the preemption budget: what a SIGTERM costs to honor.
+    import shutil as _shutil
+
+    from paddle_tpu.serving import restore_engine, snapshot_stats
+
+    def run_snap(snap_dir):
+        eng = GenerationEngine(model, max_batch=B, block_size=16,
+                               num_blocks=par_blocks, decode_chunk=chunk)
+        for rid, p in prompts.items():
+            eng.add_request(rid, p, max_new_tokens=par_new)
+        eng.step()  # mid-flight: pools poured, streams open
+        if snap_dir is None:
+            while eng.has_work():
+                eng.step()
+            return {r: eng.result(r) for r in prompts}, None
+        t0 = time.perf_counter()
+        eng.snapshot(snap_dir)
+        save_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        eng2 = restore_engine(model, snap_dir)
+        restore_s = time.perf_counter() - t0
+        while eng2.has_work():
+            eng2.step()
+        return ({r: eng2.result(r) for r in prompts},
+                {"save_ms": round(save_s * 1e3, 3),
+                 "restore_ms": round(restore_s * 1e3, 3)})
+
+    snap_ref, _ = run_snap(None)
+    snap_stats0 = snapshot_stats()
+    snap_dir = tempfile.mkdtemp(prefix="bench_decode_snap_")
+    try:
+        snap_got, snap_timing = run_snap(snap_dir)
+    finally:
+        _shutil.rmtree(snap_dir, ignore_errors=True)
+    snap_match = snap_got == snap_ref
+    if not snap_match:
+        print("bench_decode: SNAPSHOT RESUME PARITY FAILURE", file=sys.stderr)
+    snapshot = dict(
+        snap_timing,
+        bytes=snapshot_stats()["bytes"] - snap_stats0["bytes"],
+        resume_tokens_match=snap_match,
+    )
+
     print(json.dumps({
         "metric": "serving_decode_chunked_speedup",
         "value": round(speedup, 2),
@@ -396,6 +450,7 @@ def main():
             "shared_prefix": shared_prefix,
             "int8_kv_capacity": capacity,
             "slo": slo,
+            "snapshot": snapshot,
             "decode_stats": {
                 "dispatches": st["dispatches"],
                 "tokens": st["tokens"],
@@ -403,7 +458,8 @@ def main():
             },
         },
     }))
-    return 0 if (tokens_match and prefix_match and tp_match) else 1
+    return 0 if (tokens_match and prefix_match and tp_match
+                 and snap_match) else 1
 
 
 if __name__ == "__main__":

@@ -235,6 +235,27 @@ define_flag(
     "compiled decode steps never recompile on a swap (docs/LORA.md)",
 )
 define_flag(
+    "FLAGS_engine_snapshot_dir",
+    "",
+    "Serving fault tolerance (serving/snapshot.py, docs/CHECKPOINT.md): "
+    "directory for live GenerationEngine snapshots.  When set, "
+    "engine.step() calls maybe_snapshot() at every macro-step boundary — "
+    "a pending SIGTERM preemption flag (install_preemption_handler) or "
+    "the FLAGS_engine_snapshot_interval period then commits a restorable "
+    "snapshot through the SAME atomic rename protocol as "
+    "CheckpointManager.  Empty disables the automatic path (explicit "
+    "engine.snapshot(dir)/drain(dir) calls still work)",
+)
+define_flag(
+    "FLAGS_engine_snapshot_interval",
+    0,
+    "Macro-steps between periodic live-engine snapshots "
+    "(FLAGS_engine_snapshot_dir must be set; 0 = preemption-triggered "
+    "only).  Snapshots are written at macro-step boundaries, never "
+    "mid-dispatch — the serving mirror of CheckpointManager's "
+    "save_interval_steps (serving/snapshot.py)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

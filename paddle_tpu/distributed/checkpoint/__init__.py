@@ -161,6 +161,10 @@ def _assemble_region(tm: TensorMetadata, files: _LazyFiles, region):
         if any(a >= b for a, b in zip(inter_start, inter_stop)):
             continue
         src = files.get(rec.file, rec.key)
+        if src.dtype.kind == "V" and src.dtype.itemsize == out.dtype.itemsize:
+            # npz round-trips extension dtypes (ml_dtypes bfloat16) as raw
+            # void records; the bytes are exact — view them back
+            src = src.view(out.dtype)
         src_slices = tuple(
             slice(a - ro, b - ro) for a, b, ro in zip(inter_start, inter_stop, r_starts)
         )

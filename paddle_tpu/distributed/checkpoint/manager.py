@@ -23,6 +23,12 @@ Fault injection: FLAGS_checkpoint_kill_point names a protocol point
 ("after-shard-write" | "before-manifest" | "mid-manifest" | "after-commit")
 at which the process hard-kills itself (SIGKILL) — crash consistency is
 tested mechanically (tests/test_checkpoint_crash.py), not argued.
+
+The protocol itself (temp dir -> fsynced payload -> checksummed manifest ->
+one atomic rename, kill points included) is factored out as `commit_dir` so
+OTHER step-directory stores ride the exact same mechanism — the serving
+tier's live-engine snapshots (serving/snapshot.py, docs/CHECKPOINT.md) are
+the second user: one protocol, one kill-point matrix, one sweep rule.
 """
 
 from __future__ import annotations
@@ -44,7 +50,8 @@ from paddle_tpu._core.flags import flag
 from paddle_tpu._core.random import get_rng_state, set_rng_state
 from paddle_tpu._core.tensor import Tensor
 
-__all__ = ["CheckpointManager", "checkpoint_stats", "KILL_POINTS"]
+__all__ = ["CheckpointManager", "checkpoint_stats", "KILL_POINTS",
+           "commit_dir", "write_payload", "sweep_stale_tmp"]
 
 _MANIFEST = "MANIFEST.json"
 _EXTRAS = "extras.pkl"
@@ -154,6 +161,130 @@ def _split_tensors(tree):
         else:
             extras[k] = v
     return tensors, extras
+
+
+def commit_dir(base_dir, final_name, writer, manifest_extra=None):
+    """The shared atomic commit protocol (docs/CHECKPOINT.md):
+
+      1. create a hidden ``_tmp_{final_name}.{pid}`` directory;
+      2. ``writer(tmp)`` writes + fsyncs the payload files, returning the
+         bytes it wrote (it injects its own "after-shard-write" /
+         "before-manifest" kill points via `_maybe_kill`);
+      3. MANIFEST.json (per-file sha256 + size) written LAST and fsynced,
+         with the "mid-manifest" kill point inside;
+      4. an existing ``final_name`` is renamed aside (re-save of the same
+         step: new data is fully on disk before the old dir moves);
+      5. ONE atomic ``os.rename(tmp, final)`` — THE commit point — then the
+         parent directory is fsynced, the displaced dir deleted, and the
+         "after-commit" kill point fires.
+
+    Returns ``(final_path, total_bytes_written)``.  Both CheckpointManager
+    and the serving tier's EngineSnapshot commit through this one function,
+    so the SIGKILL matrix proves them together."""
+    if not _STEP_RE.match(final_name):
+        # the crash-abandoned temp/displaced dirs are swept by pattern
+        # (_TMP_RE); an unmatchable final_name would leak them forever
+        raise ValueError(
+            f"commit_dir final_name must be step-tagged (step_XXXXXXXX, "
+            f"sweepable after a crash): got {final_name!r}")
+    tmp = os.path.join(base_dir, f"_tmp_{final_name}.{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    written = writer(tmp)
+
+    manifest = {
+        "format": 1,
+        "files": {
+            name: {
+                "sha256": _sha256_file(os.path.join(tmp, name)),
+                "size": os.path.getsize(os.path.join(tmp, name)),
+            }
+            for name in sorted(os.listdir(tmp))
+        },
+    }
+    if manifest_extra:
+        manifest.update(manifest_extra)
+    data = json.dumps(manifest, indent=1, sort_keys=True)
+    mpath = os.path.join(tmp, _MANIFEST)
+    with open(mpath, "w") as f:
+        if flag("FLAGS_checkpoint_kill_point") == "mid-manifest":
+            f.write(data[: len(data) // 2])
+            f.flush()
+            os.fsync(f.fileno())
+            _maybe_kill("mid-manifest")
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    written += os.path.getsize(mpath)
+
+    final = os.path.join(base_dir, final_name)
+    displaced = None
+    if os.path.exists(final):  # re-save of the same step
+        displaced = os.path.join(base_dir, f"_old_{final_name}.{os.getpid()}")
+        shutil.rmtree(displaced, ignore_errors=True)
+        os.rename(final, displaced)
+    os.rename(tmp, final)  # THE commit point: atomic within one fs
+    _fsync_dir(base_dir)
+    if displaced is not None:
+        shutil.rmtree(displaced, ignore_errors=True)
+    _maybe_kill("after-commit")
+    return final, written
+
+
+def write_payload(tmp, arrays, fname, metadata_json, extras_blob):
+    """The shared `commit_dir` payload writer: npz shards (fsynced, then
+    the "after-shard-write" kill point), metadata.json + extras.pkl
+    (fsynced, then "before-manifest").  Returns bytes written.  ONE body
+    for CheckpointManager._commit and EngineSnapshot.save — a new kill
+    point or fsync fix lands in both tiers at once."""
+    written = 0
+    shard_path = os.path.join(tmp, fname)
+    with open(shard_path, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    written += os.path.getsize(shard_path)
+    _maybe_kill("after-shard-write")
+
+    from . import _META_FILE
+
+    meta_path = os.path.join(tmp, _META_FILE)
+    with open(meta_path, "w") as f:
+        f.write(metadata_json)
+        f.flush()
+        os.fsync(f.fileno())
+    extras_path = os.path.join(tmp, _EXTRAS)
+    with open(extras_path, "wb") as f:
+        f.write(extras_blob)
+        f.flush()
+        os.fsync(f.fileno())
+    written += os.path.getsize(meta_path) + os.path.getsize(extras_path)
+    _maybe_kill("before-manifest")
+    return written
+
+
+def sweep_stale_tmp(base_dir):
+    """Delete ``_tmp_*``/``_old_*`` working directories whose owning pid is
+    dead (a hard-killed process abandons at most its in-flight temp dir —
+    committed steps are untouchable by design).  Returns the sweep count."""
+    swept = 0
+    for name in os.listdir(base_dir):
+        m = _TMP_RE.match(name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue  # possibly our own in-flight write
+        try:
+            os.kill(pid, 0)
+            continue  # owner still alive
+        except ProcessLookupError:
+            pass  # dead: safe to sweep
+        except OSError:
+            continue  # e.g. EPERM — owner alive under another uid
+        shutil.rmtree(os.path.join(base_dir, name), ignore_errors=True)
+        swept += 1
+    return swept
 
 
 class _CommitJob:
@@ -388,74 +519,14 @@ class CheckpointManager:
     # --------------------------------------------------------- commit core
     def _commit(self, job: _CommitJob):
         t0 = time.perf_counter()
-        tmp = os.path.join(self.dir, f"_tmp_step_{job.step:08d}.{os.getpid()}")
-        shutil.rmtree(tmp, ignore_errors=True)
-        os.makedirs(tmp)
 
-        written = 0
-        shard_path = os.path.join(tmp, job.fname)
-        with open(shard_path, "wb") as f:
-            np.savez(f, **job.arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        written += os.path.getsize(shard_path)
-        _maybe_kill("after-shard-write")
+        def writer(tmp):
+            return write_payload(tmp, job.arrays, job.fname,
+                                 job.metadata.to_json(), job.extras_blob)
 
-        from . import _META_FILE
-
-        meta_path = os.path.join(tmp, _META_FILE)
-        with open(meta_path, "w") as f:
-            f.write(job.metadata.to_json())
-            f.flush()
-            os.fsync(f.fileno())
-        extras_path = os.path.join(tmp, _EXTRAS)
-        with open(extras_path, "wb") as f:
-            f.write(job.extras_blob)
-            f.flush()
-            os.fsync(f.fileno())
-        written += os.path.getsize(meta_path) + os.path.getsize(extras_path)
-        _maybe_kill("before-manifest")
-
-        manifest = {
-            "format": 1,
-            "step": job.step,
-            "files": {
-                name: {
-                    "sha256": _sha256_file(os.path.join(tmp, name)),
-                    "size": os.path.getsize(os.path.join(tmp, name)),
-                }
-                for name in sorted(os.listdir(tmp))
-            },
-        }
-        data = json.dumps(manifest, indent=1, sort_keys=True)
-        mpath = os.path.join(tmp, _MANIFEST)
-        with open(mpath, "w") as f:
-            if flag("FLAGS_checkpoint_kill_point") == "mid-manifest":
-                f.write(data[: len(data) // 2])
-                f.flush()
-                os.fsync(f.fileno())
-                _maybe_kill("mid-manifest")
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        written += os.path.getsize(mpath)
-
-        final = self._step_dir(job.step)
-        displaced = None
-        if os.path.exists(final):  # re-save of the same step
-            # rename aside, commit, THEN delete: the new data is fully on
-            # disk before the old dir moves, so the unprotected window is
-            # two renames, not an rmtree-then-write
-            displaced = os.path.join(
-                self.dir, f"_old_step_{job.step:08d}.{os.getpid()}")
-            shutil.rmtree(displaced, ignore_errors=True)
-            os.rename(final, displaced)
-            self._valid_cache.pop(final, None)
-        os.rename(tmp, final)  # THE commit point: atomic within one fs
-        _fsync_dir(self.dir)
-        if displaced is not None:
-            shutil.rmtree(displaced, ignore_errors=True)
-        _maybe_kill("after-commit")
+        self._valid_cache.pop(self._step_dir(job.step), None)
+        final, written = commit_dir(self.dir, f"step_{job.step:08d}", writer,
+                                    manifest_extra={"step": job.step})
         _bump(commits=1, bytes_written=written,
               write_seconds=time.perf_counter() - t0)
 
@@ -491,22 +562,9 @@ class CheckpointManager:
             self._valid_cache.pop(path, None)
             _bump(gc_deleted=1)
 
-        for name in os.listdir(self.dir):
-            m = _TMP_RE.match(name)
-            if not m:
-                continue
-            pid = int(m.group(1))
-            if pid == os.getpid():
-                continue  # possibly our own in-flight write
-            try:
-                os.kill(pid, 0)
-                continue  # owner still alive
-            except ProcessLookupError:
-                pass  # dead: safe to sweep
-            except OSError:
-                continue  # e.g. EPERM — owner alive under another uid
-            shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)
-            _bump(gc_deleted=1)
+        swept = sweep_stale_tmp(self.dir)
+        if swept:
+            _bump(gc_deleted=swept)
 
     # -------------------------------------------------------------- restore
     def restore(self, model=None, optimizer=None, lr_scheduler=None,

@@ -40,6 +40,8 @@ __all__ = [
     "pool_nbytes",
     "pool_device_nbytes",
     "pool_parts",
+    "pool_state_dict",
+    "pool_from_state",
     "pool_stack",
     "pool_index",
 ]
@@ -122,6 +124,28 @@ def pool_parts(cache):
     if isinstance(cache, QuantPool):
         return [("payload", cache.data), ("scale", cache.scale)]
     return [("payload", cache)]
+
+
+def pool_state_dict(prefix, cache):
+    """Flat ``{f"{prefix}.{part}": array}`` view of a paged pool's leaves —
+    the serialization face of `pool_parts` (engine snapshots feed these
+    names to the sharded checkpoint store; serving/snapshot.py).  A
+    QuantPool contributes its payload AND scales, so a serialized int8
+    pool round-trips bit-exactly."""
+    return {f"{prefix}.{name}": arr for name, arr in pool_parts(cache)}
+
+
+def pool_from_state(template, fetch, prefix=""):
+    """Rebuild a pool shaped like `template` by calling
+    ``fetch(f"{prefix}.{part}", template_leaf)`` per leaf — the inverse of
+    `pool_state_dict`.  `fetch` returns the restored array for that leaf
+    (the caller owns assembly/resharding/placement); the ONE other place
+    that knows QuantPool's structure, so an added field breaks both
+    directions loudly together."""
+    if isinstance(template, QuantPool):
+        return QuantPool(fetch(f"{prefix}.payload", template.data),
+                         fetch(f"{prefix}.scale", template.scale))
+    return fetch(f"{prefix}.payload", template)
 
 
 def pool_stack(pools):

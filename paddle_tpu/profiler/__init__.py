@@ -218,7 +218,7 @@ class Profiler:
         from .statistics import (checkpoint_line, compile_cache_line,
                                  decode_line, dispatch_cache_line,
                                  lora_line, mesh_line, schedule_line,
-                                 summary_text, verify_line)
+                                 snapshot_line, summary_text, verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -247,6 +247,9 @@ class Profiler:
         ckpt_line = checkpoint_line(checkpoint_stats())
         if ckpt_line:
             out = out + "\n" + ckpt_line
+        snap_line = snapshot_line(snapshot_stats())
+        if snap_line:
+            out = out + "\n" + snap_line
         print(out)
         return out
 
@@ -441,6 +444,21 @@ def schedule_search_stats(reset: bool = False) -> dict:
     return out
 
 
+def snapshot_stats(reset: bool = False) -> dict:
+    """Live-engine snapshot counters (serving/snapshot.py,
+    docs/CHECKPOINT.md serving section): engine snapshots saved and
+    restored, bytes committed through the atomic protocol, seconds spent
+    capturing+committing, torn snapshots skipped while resolving the
+    newest restorable state, and drain() migrations.  Healthy:
+    corrupt_skipped at zero (nonzero means a kill landed mid-commit and
+    auto-restore passed over the torn dir — by design, but worth
+    knowing).  The serving module owns the counters — one schema, no
+    drift."""
+    from paddle_tpu import serving
+
+    return serving.snapshot_stats(reset=reset)
+
+
 def checkpoint_stats(reset: bool = False) -> dict:
     """CheckpointManager counters (distributed/checkpoint/manager.py):
     saves issued (async_saves of them backgrounded), atomic commits,
@@ -458,7 +476,7 @@ def checkpoint_stats(reset: bool = False) -> dict:
 
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
             "decode_stats", "lora_stats", "verify_stats", "mesh_lint_stats",
-            "schedule_search_stats", "checkpoint_stats"]
+            "schedule_search_stats", "checkpoint_stats", "snapshot_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -341,6 +341,25 @@ def checkpoint_line(stats: dict) -> str:
     )
 
 
+def snapshot_line(stats: dict) -> str:
+    """One-line rendering of the live-engine snapshot counters for
+    Profiler.summary(); empty when no engine snapshot activity this
+    process (serving/snapshot.py).  corrupt_skipped nonzero means a kill
+    landed mid-commit and restore passed over the torn dir — the
+    protocol working as designed, surfaced so nobody wonders where a
+    snapshot went."""
+    if not (stats.get("saves") or stats.get("restores")
+            or stats.get("corrupt_skipped")):
+        return ""
+    return (
+        "Engine snapshot: saves=%d restores=%d bytes=%d snapshot=%.3fs "
+        "corrupt_skipped=%d drains=%d"
+        % (stats["saves"], stats["restores"], stats["bytes"],
+           stats["snapshot_seconds"], stats["corrupt_skipped"],
+           stats["drains"])
+    )
+
+
 def compile_cache_line(stats: dict) -> str:
     """One-line rendering of the trace/compile + persistent-cache counters
     for Profiler.summary(); empty when nothing compiled this process."""

@@ -32,7 +32,9 @@ from paddle_tpu._core import flags as _flags
 
 __all__ = ["GenerationEngine", "RadixPrefixCache", "decode_stats",
            "reset_decode_stats", "lora_stats", "reset_lora_stats",
-           "schedule_decode_stats", "reset_schedule_decode_stats"]
+           "schedule_decode_stats", "reset_schedule_decode_stats",
+           "EngineSnapshot", "restore_engine", "snapshot_stats",
+           "reset_snapshot_stats"]
 
 
 # --------------------------------------------------------- decode telemetry
@@ -512,6 +514,14 @@ class GenerationEngine:
             (1, self._max_blocks_per_seq)))
         self._req_counter = 0
         self._state = list(model.state_dict().values())
+        # ---- fault-tolerance tier (serving/snapshot.py) -----------------
+        self._macro_steps = 0          # boundary count; snapshot step tags
+        self._last_auto_snapshot = 0   # boundary of the last periodic save
+        self._snapshot_store = None    # cached EngineSnapshot (valid-cache)
+        self._draining = False         # drain(): admissions closed
+        self._preempt_requested = False
+        self._preempt_saved = False
+        self._prev_handlers: dict = {}
         _ENGINES.add(self)
 
         # ---- speculative tier: draft model + its own paged pools --------
@@ -638,7 +648,12 @@ class GenerationEngine:
 
     # ------------------------------------------------------------ requests
     def has_work(self):
-        return any(s.active for s in self._slots) or bool(self._pending)
+        # a DRAINING engine's queued requests are not its work: they rode
+        # the drain snapshot and belong to the restore target (serving
+        # them here too would double-serve; counting them here would make
+        # the lame-duck `while has_work(): step()` loop spin forever)
+        return any(s.active for s in self._slots) or (
+            bool(self._pending) and not self._draining)
 
     def pending_requests(self):
         """Request ids queued for admission (pool pressure); they retry at
@@ -849,6 +864,11 @@ class GenerationEngine:
         PRNG nonce reserved at submit so a queued-then-admitted stream
         matches immediate admission bit-for-bit.  An UNREGISTERED adapter
         name raises KeyError (nothing to wait for)."""
+        if self._draining:
+            raise RuntimeError(
+                "engine is draining (drain(): migration snapshot taken, "
+                "admissions closed) — submit to the restored engine "
+                "instead (docs/CHECKPOINT.md serving section)")
         if self.draft_model is not None and float(temperature or 0.0) > 0.0:
             # checked BEFORE any allocation/prefill: a rejected request
             # must not leak pool blocks or burn two prefills
@@ -1146,6 +1166,116 @@ class GenerationEngine:
     def _finish(self, slot):
         self._results[slot.rid] = list(slot.generated)
         self._release(slot)
+
+    # ------------------------------------------------- fault tolerance
+    def snapshot(self, dir, step=None) -> int:
+        """Commit a restorable snapshot of this LIVE engine under `dir`
+        through the CheckpointManager commit protocol (atomic rename,
+        checksummed manifest, SIGKILL matrix — serving/snapshot.py,
+        docs/CHECKPOINT.md serving section).  Call between step()s; the
+        automatic path (maybe_snapshot) runs at macro-step boundaries
+        only.  Returns the committed step tag."""
+        from paddle_tpu.serving.snapshot import EngineSnapshot
+
+        store = self._snapshot_store
+        if store is None or store.dir != str(dir):
+            # one store per engine+dir: its manifest-validity cache makes
+            # the per-save retention sweep mtime-cheap instead of
+            # re-hashing every retained snapshot's pool bytes
+            store = self._snapshot_store = EngineSnapshot(dir)
+        return store.save(self, step=step)
+
+    def install_preemption_handler(self, signals=None):
+        """SIGTERM-style preemption for serving: the handler only flips a
+        flag (async signal context is no place for device syncs or disk
+        IO); the next maybe_snapshot() at a macro-step boundary writes
+        the final snapshot — the CheckpointManager flag-flip design on
+        the serving loop.  Check `preemption_saved` to exit cleanly."""
+        import signal as _signal
+
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+
+        def _handler(signum, frame):
+            self._preempt_requested = True
+
+        for s in signals:
+            prev = _signal.signal(s, _handler)
+            # re-install keeps the ORIGINAL disposition: recording our
+            # own handler as "previous" would make uninstall a no-op and
+            # strand SIGTERM on a detached engine forever
+            self._prev_handlers.setdefault(s, prev)
+
+    def uninstall_preemption_handler(self):
+        import signal as _signal
+
+        for s, prev in self._prev_handlers.items():
+            _signal.signal(s, prev)
+        self._prev_handlers.clear()
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self._preempt_requested
+
+    @property
+    def preemption_saved(self) -> bool:
+        """True once a preemption-triggered snapshot has been committed."""
+        return self._preempt_saved
+
+    def maybe_snapshot(self, dir=None, step=None):
+        """Snapshot when due — a pending preemption flag, or the periodic
+        FLAGS_engine_snapshot_interval macro-step boundary.  step() calls
+        this at the END of every macro-step when FLAGS_engine_snapshot_dir
+        is set, so snapshots land at boundaries and never mid-dispatch.
+        Returns the committed step tag, or None when nothing was due."""
+        if self._draining:
+            # the drain snapshot IS the handoff state: lame-duck stepping
+            # after drain() must not overwrite it (or worse, push it out
+            # of retention) with post-handoff boundaries
+            return None
+        d = dir if dir is not None else _flags.flag("FLAGS_engine_snapshot_dir")
+        if not d:
+            return None
+        due = self._preempt_requested and not self._preempt_saved
+        if not due:
+            # N boundaries since the last periodic save (not a modulo of
+            # the counter: idle boundaries call in without advancing it,
+            # and must not re-save the same state every call)
+            interval = int(_flags.flag("FLAGS_engine_snapshot_interval"))
+            due = (interval > 0 and self._macro_steps > 0
+                   and self._macro_steps - self._last_auto_snapshot
+                   >= interval)
+        if not due:
+            return None
+        st = self.snapshot(d, step=step)
+        self._last_auto_snapshot = self._macro_steps
+        if self._preempt_requested:
+            self._preempt_saved = True
+        return st
+
+    def drain(self, dir=None, step=None) -> int:
+        """The migration / elastic-scale-down primitive: commit a final
+        snapshot (resident requests, queued admissions, caches, adapter
+        state — everything) and CLOSE admissions on this engine.  Returns
+        the snapshot step to hand off; `restore_engine` rebuilds a fully
+        open engine from it on another process/host/topology.  The
+        drained engine may keep stepping its RESIDENTS to completion —
+        it never admits again (add_request raises, and the queued
+        requests in the snapshot are the restore target's to serve, so
+        the lame duck neither admits nor counts them as work; automatic
+        maybe_snapshot is disarmed too, so post-handoff boundaries can
+        never overwrite or age out the handoff snapshot)."""
+        d = dir if dir is not None else _flags.flag("FLAGS_engine_snapshot_dir")
+        if not d:
+            raise ValueError(
+                "drain() needs a snapshot directory: pass dir= or set "
+                "FLAGS_engine_snapshot_dir")
+        self._draining = True
+        st = self.snapshot(d, step=step)
+        from paddle_tpu.serving.snapshot import _SNAPSHOT_STATS
+
+        _SNAPSHOT_STATS["drains"] += 1
+        return st
 
     # -------------------------------------------------------------- decode
     def _effective_chunk(self) -> int:
@@ -1513,16 +1643,29 @@ class GenerationEngine:
         prefill-produced first token (the one add_request returned None
         instead of)."""
         if not self.has_work():
+            # an idle engine is still at a boundary: a pending SIGTERM
+            # preemption (or an overdue interval) must commit its final
+            # snapshot HERE, or a drained-empty serving loop would spin
+            # until the orchestrator escalates to SIGKILL
+            self.maybe_snapshot()
             return {}
         # macro-step boundary: queued admissions (pool pressure at
         # add_request time) retry before this dispatch; their prefill
         # first tokens (add_request returned None) surface in THIS
-        # step's output — always as a list for those rids, even at D=1
-        admitted = self._admit_pending()
+        # step's output — always as a list for those rids, even at D=1.
+        # A draining engine admits NOTHING: its queue was handed off in
+        # the drain snapshot and will be served by the restore target.
+        admitted = [] if self._draining else self._admit_pending()
         if not any(s.active for s in self._slots):
             # an admitted request may have finished AT admission
-            # (EOS / max_new_tokens=1): its first token still surfaces
-            return {rid: list(self._results[rid]) for rid in admitted}
+            # (EOS / max_new_tokens=1): its first token still surfaces.
+            # This IS a macro-step boundary — allocator/results/pending
+            # all mutated — so the counter advances and the periodic
+            # snapshot interval keeps accruing across such steps
+            out = {rid: list(self._results[rid]) for rid in admitted}
+            self._macro_steps += 1
+            self.maybe_snapshot()
+            return out
         t_start = time.perf_counter()
         if self.draft_model is not None:
             out = self._spec_step()
@@ -1531,6 +1674,8 @@ class GenerationEngine:
             _DECODE_STATS["step_seconds"] += time.perf_counter() - t_start
             # prepend AFTER the stats: prefill firsts aren't decode tokens
             self._merge_admitted(out, admitted)
+            self._macro_steps += 1
+            self.maybe_snapshot()  # boundary: no-op without a snapshot dir
             return out
         D = self._effective_chunk()
         step_fn = self._step_fns.get(D)
@@ -1611,6 +1756,8 @@ class GenerationEngine:
             _DECODE_STATS["tokens"] += len(emitted)
         _DECODE_STATS["step_seconds"] += time.perf_counter() - t_start
         self._merge_admitted(out, admitted)
+        self._macro_steps += 1
+        self.maybe_snapshot()  # boundary: no-op without a snapshot dir
         return out
 
     def _merge_admitted(self, out, admitted):
@@ -1627,3 +1774,7 @@ class GenerationEngine:
                 out[rid] = [first] + got
             else:
                 out[rid] = [first, got]
+
+
+from .snapshot import (EngineSnapshot, restore_engine,  # noqa: E402
+                       reset_snapshot_stats, snapshot_stats)

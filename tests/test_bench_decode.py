@@ -65,3 +65,10 @@ def test_bench_decode_smoke_emits_valid_json():
         for section in ("ttft_ms", "itl_ms"):
             pcts = slo[side][section]
             assert 0 < pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+    # snapshot/restore section: a live mid-flight engine snapshotted and
+    # restored with bit-identical continued streams, timings positive
+    # (check_bench_regression's snapshot gate consumes these)
+    snap = detail["snapshot"]
+    assert snap["resume_tokens_match"] is True
+    assert snap["save_ms"] > 0 and snap["restore_ms"] > 0
+    assert snap["bytes"] > 0

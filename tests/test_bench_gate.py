@@ -85,3 +85,33 @@ def test_slo_percentile_gate(tmp_path):
     # and a throughput regression still gates even with clean latencies
     slow = _w(tmp_path, "slow.json", dict(_slo_payload(), value=2.0))
     assert main([old, slow]) == 1
+
+
+def _snap_payload(save_ms=30.0, restore_ms=60.0):
+    return {
+        "metric": "serving_decode_chunked_speedup", "value": 5.0,
+        "unit": "x", "detail": {"snapshot": {
+            "save_ms": save_ms, "restore_ms": restore_ms,
+            "bytes": 123456, "resume_tokens_match": True,
+        }},
+    }
+
+
+def test_snapshot_timing_gate(tmp_path):
+    """Engine-snapshot wiring (serving fault tolerance): save/restore
+    wall gates lower-is-better at the SLO threshold; pre-snapshot
+    payloads skip silently; save and restore gate independently."""
+    old = _w(tmp_path, "s_old.json", _snap_payload())
+    same = _w(tmp_path, "s_same.json", _snap_payload())
+    worse = _w(tmp_path, "s_worse.json", _snap_payload(save_ms=90.0))
+    assert main([old, same]) == 0            # unchanged timings pass
+    assert main([old, worse]) == 1           # save wall tripled: regression
+    assert main([old, worse, "--slo-threshold", "3.0"]) == 0  # within 300%
+    assert main([worse, old]) == 0           # IMPROVED: never gates
+    worse_restore = _w(tmp_path, "s_wr.json", _snap_payload(restore_ms=200.0))
+    assert main([old, worse_restore]) == 1   # restore gates independently
+    # a pre-snapshot payload on either side skips the gate
+    pre = _w(tmp_path, "s_pre.json",
+             {"metric": "serving_decode_chunked_speedup", "value": 5.0})
+    assert main([pre, worse]) == 0
+    assert main([worse, pre]) == 0

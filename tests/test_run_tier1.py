@@ -71,9 +71,17 @@ def test_build_plan_isolates_collective_modules():
     assert "test_decode_chain.py" in rest_files
     # the TP-sharded serving modules dispatch GSPMD decode programs over
     # the in-process multi-device communicator every test: DEDICATED
-    # isolated workers, never round-robin (and never slow-marked)
-    for mod in ("test_serving_mesh.py", "test_serving_mesh_spec.py"):
+    # isolated workers, never round-robin (and never slow-marked).  The
+    # snapshot topology-migration module restores engines ONTO meshes —
+    # same crash class, same containment.
+    for mod in ("test_serving_mesh.py", "test_serving_mesh_spec.py",
+                "test_engine_snapshot_mesh.py"):
         assert mod in iso_names, mod
+    # the engine-snapshot core + subprocess SIGKILL-matrix modules are
+    # single-device (kills land in SUBPROCESS serving loops): ordinary
+    # round-robin shards
+    for mod in ("test_engine_snapshot.py", "test_engine_snapshot_crash.py"):
+        assert mod in rest_files, mod
 
 
 # -------------------------------------------------------- crash isolation

@@ -22,6 +22,12 @@ are ALSO gated, with the direction inverted (latency growing beyond
 percentiles jitter more than throughput means.  Payloads lacking the
 section on either side skip the latency gate silently.
 
+Serving payloads carrying the snapshot section (bench_decode.py
+detail.snapshot: save_ms/restore_ms of a live mid-flight engine snapshot,
+serving/snapshot.py) gate like the SLO percentiles — lower is better, so
+growth beyond --slo-threshold is the regression (the wall cost of
+honoring a preemption) — and skip silently on pre-snapshot payloads.
+
 Schedule-search payloads carrying the decode-chain section
 (bench_schedule_search.py detail.decode_chain: per-kv-variant
 win-or-disabled verdicts) gate each variant's measured win like the
@@ -97,6 +103,18 @@ def load_slo(path):
     return slo.get("single")
 
 
+def load_snapshot(path):
+    """The snapshot-timing section of a serving bench payload
+    (bench_decode.py detail.snapshot: {"save_ms", "restore_ms", "bytes",
+    "resume_tokens_match"}), or None when absent — pre-snapshot rounds
+    and non-serving benches skip the gate."""
+    data, _err = _payload_dict(path)
+    if not isinstance(data, dict):
+        return None
+    snap = (data.get("detail") or {}).get("snapshot")
+    return snap if isinstance(snap, dict) else None
+
+
 def load_decode_chain(path):
     """The decode-chain section of a schedule-search bench payload
     (bench_schedule_search.py detail.decode_chain: {"bf16": {"win": ...,
@@ -157,6 +175,26 @@ def main(argv=None):
                       f"{n[pk]:.2f} ms ({rel:+.2%}) {stat}")
                 if stat == "REGRESSION":
                     rc = 1
+
+    # snapshot-timing gate (serving fault tolerance): save/restore wall
+    # of a live-engine snapshot, lower-is-better like the SLO section
+    # and sharing its wider threshold (single-shot wall timings jitter).
+    # Sides missing the section (pre-snapshot rounds) skip silently.
+    old_snap, new_snap = load_snapshot(args.old), load_snapshot(args.new)
+    if old_snap and new_snap:
+        for sk in ("save_ms", "restore_ms"):
+            try:
+                o, n = float(old_snap.get(sk, 0)), float(new_snap.get(sk, 0))
+            except (TypeError, ValueError):
+                continue
+            if not o > 0 or not n > 0:
+                continue
+            rel = (n - o) / o
+            stat = "REGRESSION" if rel > args.slo_threshold else "ok"
+            print(f"bench gate [snapshot {sk}]: {o:.2f} -> {n:.2f} ms "
+                  f"({rel:+.2%}) {stat}")
+            if stat == "REGRESSION":
+                rc = 1
 
     # decode-chain gate (schedule search phase 2): per-variant measured
     # wins, higher-is-better like the headline.  A disabled side (win 0)

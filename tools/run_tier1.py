@@ -64,6 +64,7 @@ ISOLATED_DEFAULT = (
     "test_sharded_embedding.py",
     "test_serving_mesh.py",
     "test_serving_mesh_spec.py",
+    "test_engine_snapshot_mesh.py",
 )
 
 DEFAULT_CACHE_DIR = "/tmp/jax_cache"
