@@ -1,0 +1,171 @@
+"""Disaggregated serving cluster: throughput + fail-over latency, with a
+zero-loss / bit-exact-fail-over parity gate (serving/cluster.py,
+docs/SERVING_CLUSTER.md; ROADMAP item 2).
+
+Two phases, both over REAL OS processes (router + N decode replicas + a
+prefill worker on TCPStore/ShmRing):
+
+- **Baseline**: an unkilled cluster serves the workload; the headline
+  metric is end-to-end cluster tokens/s (submit -> last completion wall),
+  with KV pages shipped prefill->decode counted (int8-halved wire bytes
+  when the pool is int8).
+- **Fail-over**: the same workload; once every stream is in flight, the
+  busiest replica is SIGKILLed.  Reported: detect_ms (kill -> the router's
+  failure detection, observed as the first re-dispatch) and recover_ms
+  (kill -> every stream complete), plus lost (accepted requests that never
+  completed — MUST be 0) and streams_match (killed-run streams equal the
+  unkilled run's bit for bit — the fail-over contract).
+
+rc is 0 only when lost == 0 AND streams_match — the latency numbers are
+never reported off a run that dropped or corrupted a request.  Prints ONE
+JSON line like the other benches; tools/check_bench_regression.py gates
+the failover latencies (lower is better, SLO threshold).  `--smoke` /
+PADDLE_TPU_BENCH_SMOKE shrinks sizes for CI (tests/test_bench_cluster.py).
+This bench forks and kills processes: CPU-runnable by construction, no
+accelerator required (the axon-tunnel-down standing constraint)."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_bench_model():
+    """Deterministic tiny llama built identically in EVERY cluster
+    process (the worker imports this file by path)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(7)
+    cfg = llama_tiny(vocab_size=256, hidden_size=64, intermediate_size=176,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=4, max_position_embeddings=256,
+                     dtype="float32")
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _workload(n_req, max_new):
+    shared = [5, 9, 17, 33, 2, 8, 7, 4, 11, 29, 3, 31, 6, 12, 20, 17]
+    out = []
+    for i in range(n_req):
+        out.append((f"r{i}", shared + [i + 1, (i * 7) % 200 + 1],
+                    max_new))
+    return out
+
+
+def _run_cluster(workdir, spec, ekw, work, kill_busiest=False):
+    from paddle_tpu.serving.cluster import EngineCluster, cluster_stats
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    c = EngineCluster(spec, num_replicas=2, num_prefill=1,
+                      engine_kwargs=ekw, workdir=workdir,
+                      heartbeat_ms=100, miss_threshold=10)
+    out = {}
+    try:
+        t0 = time.monotonic()
+        for rid, prompt, max_new in work:
+            c.submit(rid, prompt, max_new_tokens=max_new)
+        detect_ms = recover_ms = 0.0
+        if kill_busiest:
+            # wait until every stream is genuinely in flight
+            deadline = time.monotonic() + 240
+            while any(not c.router.request(rid).tokens
+                      for rid, _p, _m in work):
+                c.poll()
+                if time.monotonic() > deadline:
+                    raise TimeoutError("streams never all started")
+                time.sleep(0.002)
+            victim = max(c.router.replicas(), key=c.router.load)
+            w = c._workers[("decode", victim)]
+            before = cluster_stats()
+            t_kill = time.monotonic()
+            os.kill(w.proc.pid, 9)  # SIGKILL: no goodbye, no flush
+            # detection is visible as either a re-dispatch (replay
+            # fail-over) or the replacement spawn (restore/claim path)
+            while (cluster_stats()["redispatches"]
+                   == before["redispatches"]
+                   and cluster_stats()["respawns"] == before["respawns"]):
+                c.poll()
+                if time.monotonic() > deadline:
+                    raise TimeoutError("death never detected")
+                time.sleep(0.001)
+            detect_ms = (time.monotonic() - t_kill) * 1000
+            c.serve(timeout_s=240)
+            recover_ms = (time.monotonic() - t_kill) * 1000
+        else:
+            c.serve(timeout_s=240)
+        wall = time.monotonic() - t0
+        results = {rid: c.result(rid) for rid, _p, _m in work}
+        stats = cluster_stats(reset=True)
+        return results, wall, stats, detect_ms, recover_ms
+    finally:
+        c.shutdown()
+
+
+def main():
+    import jax
+
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    smoke = os.environ.get("PADDLE_TPU_BENCH_SMOKE") or "--smoke" in sys.argv
+    # workers share the tier-1 persistent compile cache when present
+    os.environ.setdefault("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
+
+    spec = os.path.abspath(__file__) + ":make_bench_model"
+    ekw = dict(max_batch=2, block_size=8, num_blocks=48, decode_chunk=4)
+    # streams must OUTLIVE the kill: short smoke streams complete before
+    # the SIGKILL lands and leave nothing to fail over
+    n_req, max_new = (3, 32) if smoke else (6, 48)
+    work = _workload(n_req, max_new)
+    base = tempfile.mkdtemp(prefix="bench_cluster_")
+    try:
+        ref, wall, base_stats, _d, _r = _run_cluster(
+            os.path.join(base, "ref"), spec, ekw, work)
+        total_tokens = sum(len(v) for v in ref.values() if v)
+        tps = total_tokens / wall if wall else 0.0
+
+        got, _wall2, fo_stats, detect_ms, recover_ms = _run_cluster(
+            os.path.join(base, "kill"), spec, ekw, work, kill_busiest=True)
+        lost = sum(1 for rid, _p, _m in work if not got.get(rid))
+        streams_match = got == ref
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "cluster_tokens_per_sec",
+        "value": round(tps, 2),
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "tokens_match": streams_match,
+        "detail": {
+            "replicas": 2,
+            "prefill_workers": 1,
+            "requests": n_req,
+            "total_tokens": total_tokens,
+            "failover": {
+                "detect_ms": round(detect_ms, 1),
+                "recover_ms": round(recover_ms, 1),
+                "lost": lost,
+                "streams_match": streams_match,
+                "redispatches": fo_stats["redispatches"],
+            },
+            "ship": {
+                "pages": base_stats["pages_shipped"],
+                "bytes": base_stats["ship_bytes"],
+                "retries": base_stats["ship_retries"],
+            },
+        },
+    }))
+    return 0 if (lost == 0 and streams_match) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -256,6 +256,24 @@ define_flag(
     "save_interval_steps (serving/snapshot.py)",
 )
 define_flag(
+    "FLAGS_cluster_heartbeat_ms",
+    100,
+    "Disaggregated serving cluster (serving/cluster.py, "
+    "docs/SERVING_CLUSTER.md): heartbeat period — every worker bumps its "
+    "TCPStore counter twice per period from a background thread, and the "
+    "router's failure detector counts elapsed periods without an advance "
+    "as misses",
+)
+define_flag(
+    "FLAGS_cluster_heartbeat_misses",
+    30,
+    "Miss threshold of the cluster failure detector: a replica whose "
+    "heartbeat counter has not advanced for this many consecutive "
+    "FLAGS_cluster_heartbeat_ms periods is declared dead — its prefix "
+    "pages leave the cluster index and its accepted-but-unfinished "
+    "requests re-dispatch (serving/cluster.py)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

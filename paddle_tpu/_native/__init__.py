@@ -19,8 +19,10 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import random
 import subprocess
 import threading
+import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src")
@@ -113,6 +115,26 @@ def _load():
 _load()
 
 
+def _retry_until(deadline, attempt_fn, fail_msg, base_s=0.02, cap_s=0.5):
+    """Run `attempt_fn` until it returns a truthy handle or `deadline`
+    (time.monotonic seconds) passes, sleeping capped-exponential-backoff
+    with jitter between attempts.  Startup races — a worker outracing the
+    server's bind, or a ring consumer attaching before the producer's
+    shm_open — are ordinary under load, so first-refusal failure is the
+    wrong contract for constructors; a deadline is."""
+    delay = base_s
+    while True:
+        h = attempt_fn()
+        if h:
+            return h
+        if time.monotonic() >= deadline:
+            raise ConnectionError(fail_msg)
+        # full jitter: concurrent workers spread their retries instead of
+        # stampeding the just-started server in lockstep
+        time.sleep(random.uniform(0, min(delay, cap_s)))
+        delay *= 2
+
+
 class TCPStoreServer:
     def __init__(self, port=0):
         p = ctypes.c_int(0)
@@ -137,9 +159,18 @@ class TCPStoreClient:
     """Reference TCPStore client API: set/get/add/wait (tcp_store.h:121)."""
 
     def __init__(self, host="127.0.0.1", port=0, timeout_ms=30000):
-        self._h = _lib.pts_client_connect(host.encode(), port, timeout_ms)
-        if not self._h:
-            raise ConnectionError(f"cannot reach TCPStore at {host}:{port}")
+        # Retry with backoff until timeout_ms instead of failing on the
+        # first refusal: each attempt uses a FRESH socket (a connect() that
+        # failed can leave the fd in an unusable state, so retrying inside
+        # one pts_client_connect call is weaker than reconnecting), with a
+        # short per-attempt timeout so the deadline stays shared.
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        attempt_ms = max(1, min(200, int(timeout_ms)))
+        self._h = _retry_until(
+            deadline,
+            lambda: _lib.pts_client_connect(host.encode(), port, attempt_ms),
+            f"cannot reach TCPStore at {host}:{port} "
+            f"within {timeout_ms}ms")
         self._lock = threading.Lock()
 
     def set(self, key: str, value: bytes):
@@ -166,8 +197,20 @@ class TCPStoreClient:
         return int(v)
 
     def wait(self, keys, timeout_ms=30000):
+        """Block until EVERY key exists, under ONE shared deadline.
+
+        `timeout_ms` bounds the whole call, not each key: each get() is
+        given only the remaining budget, and an exhausted budget raises
+        TimeoutError immediately (the server treats a non-positive
+        timeout as wait-forever, so it must never be forwarded)."""
+        deadline = time.monotonic() + timeout_ms / 1000.0
         for k in keys if isinstance(keys, (list, tuple)) else [keys]:
-            self.get(k, timeout_ms)
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            if remaining_ms <= 0:
+                raise TimeoutError(
+                    f"TCPStore wait timed out after {timeout_ms}ms with "
+                    f"key '{k}' (and possibly later ones) still unset")
+            self.get(k, remaining_ms)
 
     def close(self):
         if self._h:
@@ -176,10 +219,22 @@ class TCPStoreClient:
 
 
 class ShmRing:
-    def __init__(self, name: str, capacity: int = 64 << 20, create=True):
+    def __init__(self, name: str, capacity: int = 64 << 20, create=True,
+                 attach_timeout_ms: int = 0):
+        """attach_timeout_ms (attach side only): retry a failed attach
+        with capped exponential backoff until the deadline — a consumer
+        process routinely outraces the producer's shm_open under load.
+        0 keeps the historical fail-on-first-refusal behavior."""
         self.name = name
         if create:
             self._h = _lib.ptr_ring_create(name.encode(), capacity)
+        elif attach_timeout_ms > 0:
+            deadline = time.monotonic() + attach_timeout_ms / 1000.0
+            self._h = _retry_until(
+                deadline,
+                lambda: _lib.ptr_ring_attach(name.encode()),
+                f"shm ring attach failed: {name} "
+                f"(not created within {attach_timeout_ms}ms)")
         else:
             self._h = _lib.ptr_ring_attach(name.encode())
         if not self._h:

@@ -22,7 +22,7 @@ import sys
 import time
 import uuid
 
-__all__ = ["launch", "main"]
+__all__ = ["launch", "main", "terminate_procs"]
 
 
 def _parse_args(argv=None):
@@ -148,17 +148,25 @@ def _spawn(args, node_rank, world_size, master_host):
     return procs
 
 
-def _kill(procs):
+def terminate_procs(procs, grace_s=10):
+    """SIGTERM every live worker, wait up to `grace_s` total, SIGKILL the
+    stragglers, close their log files.  `procs` is [(Popen, logfile)].
+    Shared by the launcher's watch loop and the serving cluster's
+    shutdown/elastic paths (serving/cluster.py) — one definition of
+    'stop these workers cleanly, then forcefully'."""
     for p, _ in procs:
         if p.poll() is None:
             p.send_signal(signal.SIGTERM)
-    deadline = time.time() + 10
+    deadline = time.time() + grace_s
     for p, logf in procs:
         try:
             p.wait(max(0.1, deadline - time.time()))
         except subprocess.TimeoutExpired:
             p.kill()
         logf.close()
+
+
+_kill = terminate_procs
 
 
 def launch(argv=None):

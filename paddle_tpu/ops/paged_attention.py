@@ -42,6 +42,8 @@ __all__ = [
     "pool_parts",
     "pool_state_dict",
     "pool_from_state",
+    "pool_get_blocks",
+    "pool_set_blocks",
     "pool_stack",
     "pool_index",
 ]
@@ -146,6 +148,35 @@ def pool_from_state(template, fetch, prefix=""):
         return QuantPool(fetch(f"{prefix}.payload", template.data),
                          fetch(f"{prefix}.scale", template.scale))
     return fetch(f"{prefix}.payload", template)
+
+
+def pool_get_blocks(cache, block_ids):
+    """Native-format page extraction — the wire face of `pool_parts` for
+    cross-process KV shipping (serving/cluster.py): the pool's OWN leaves
+    at `block_ids`, as ``{"payload": [n, Nkv, bs, H]}`` plus
+    ``{"scale": [n, Nkv]}`` for a QuantPool.  An int8 pool ships its int8
+    payload and f32 scales VERBATIM (about half the wire bytes of a bf16
+    pool), and `pool_set_blocks` on the receiving side places the same
+    bytes — ship-then-place is bit-exact by construction, never a
+    re-quantization."""
+    idx = jnp.asarray(block_ids, jnp.int32)
+    return {name: jnp.take(arr, idx, axis=0)
+            for name, arr in pool_parts(cache)}
+
+
+def pool_set_blocks(cache, block_ids, blocks):
+    """Place native-format pages (a `pool_get_blocks` dict) into the pool
+    at `block_ids`.  The inverse wire face: leaves land verbatim (cast
+    only to the pool leaf dtype, an identity for a matched pool kind) —
+    quantization happened on the sending side or not at all."""
+    idx = jnp.asarray(block_ids, jnp.int32)
+    if isinstance(cache, QuantPool):
+        return QuantPool(
+            cache.data.at[idx].set(
+                jnp.asarray(blocks["payload"], cache.data.dtype)),
+            cache.scale.at[idx].set(
+                jnp.asarray(blocks["scale"], cache.scale.dtype)))
+    return cache.at[idx].set(jnp.asarray(blocks["payload"], cache.dtype))
 
 
 def pool_stack(pools):

@@ -215,10 +215,11 @@ class Profiler:
         with Calls/Total/Avg/Max/Min/Ratio columns, sortable via SortedKeys.
         Ends with the eager dispatch-cache counters when the fast path has
         seen traffic."""
-        from .statistics import (checkpoint_line, compile_cache_line,
-                                 decode_line, dispatch_cache_line,
-                                 lora_line, mesh_line, schedule_line,
-                                 snapshot_line, summary_text, verify_line)
+        from .statistics import (checkpoint_line, cluster_line,
+                                 compile_cache_line, decode_line,
+                                 dispatch_cache_line, lora_line, mesh_line,
+                                 schedule_line, snapshot_line, summary_text,
+                                 verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -250,6 +251,9 @@ class Profiler:
         snap_line = snapshot_line(snapshot_stats())
         if snap_line:
             out = out + "\n" + snap_line
+        cl_line = cluster_line(cluster_stats())
+        if cl_line:
+            out = out + "\n" + cl_line
         print(out)
         return out
 
@@ -459,6 +463,21 @@ def snapshot_stats(reset: bool = False) -> dict:
     return serving.snapshot_stats(reset=reset)
 
 
+def cluster_stats(reset: bool = False) -> dict:
+    """Disaggregated serving-cluster counters (serving/cluster.py,
+    docs/SERVING_CLUSTER.md): live decode replicas (a gauge), heartbeat
+    periods missed across the fleet, requests re-dispatched after a
+    replica death or drain, KV pages (and wire bytes) shipped
+    prefill->decode, retries on the shipping path, and queued requests
+    migrated by graceful drains.  Healthy steady state shows
+    heartbeats_missed and redispatches flat; climbing redispatches means
+    replicas are dying faster than they respawn.  The cluster module owns
+    the counters — one schema, no drift."""
+    from paddle_tpu.serving import cluster as _cluster
+
+    return _cluster.cluster_stats(reset=reset)
+
+
 def checkpoint_stats(reset: bool = False) -> dict:
     """CheckpointManager counters (distributed/checkpoint/manager.py):
     saves issued (async_saves of them backgrounded), atomic commits,
@@ -476,7 +495,8 @@ def checkpoint_stats(reset: bool = False) -> dict:
 
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
             "decode_stats", "lora_stats", "verify_stats", "mesh_lint_stats",
-            "schedule_search_stats", "checkpoint_stats", "snapshot_stats"]
+            "schedule_search_stats", "checkpoint_stats", "snapshot_stats",
+            "cluster_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -341,6 +341,27 @@ def checkpoint_line(stats: dict) -> str:
     )
 
 
+def cluster_line(stats: dict) -> str:
+    """One-line rendering of the disaggregated serving-cluster counters
+    for Profiler.summary(); empty when no cluster ran this process
+    (serving/cluster.py).  redispatches nonzero means a replica died or
+    drained and its accepted requests moved — the fail-over machinery
+    working, surfaced so an unstable fleet is visible at a glance."""
+    if not (stats.get("replicas_alive") or stats.get("redispatches")
+            or stats.get("pages_shipped") or stats.get("drain_migrations")
+            or stats.get("heartbeats_missed")):
+        return ""
+    return (
+        "Serving cluster: replicas_alive=%d heartbeats_missed=%d "
+        "redispatches=%d pages_shipped=%d ship_bytes=%d ship_retries=%d "
+        "drain_migrations=%d"
+        % (stats["replicas_alive"], stats["heartbeats_missed"],
+           stats["redispatches"], stats["pages_shipped"],
+           stats["ship_bytes"], stats["ship_retries"],
+           stats["drain_migrations"])
+    )
+
+
 def snapshot_line(stats: dict) -> str:
     """One-line rendering of the live-engine snapshot counters for
     Profiler.summary(); empty when no engine snapshot activity this

@@ -519,6 +519,8 @@ class GenerationEngine:
         self._last_auto_snapshot = 0   # boundary of the last periodic save
         self._snapshot_store = None    # cached EngineSnapshot (valid-cache)
         self._draining = False         # drain(): admissions closed
+        self._drain_step = None        # committed handoff step (idempotence)
+        self._drain_dir = None         # ...and where it committed
         self._preempt_requested = False
         self._preempt_saved = False
         self._prev_handlers: dict = {}
@@ -833,7 +835,7 @@ class GenerationEngine:
         slot.rid = None
 
     def add_request(self, rid, prompt_ids, max_new_tokens=16,
-                    temperature=None, seed=0, adapter=None):
+                    temperature=None, seed=0, adapter=None, nonce=None):
         """Prefill the prompt, pour K/V into pool pages, occupy a slot.
 
         With the prefix cache on, the longest cached token-id prefix is
@@ -863,7 +865,15 @@ class GenerationEngine:
         exhaustion — FIFO retry at the next macro-step boundary, with the
         PRNG nonce reserved at submit so a queued-then-admitted stream
         matches immediate admission bit-for-bit.  An UNREGISTERED adapter
-        name raises KeyError (nothing to wait for)."""
+        name raises KeyError (nothing to wait for).
+
+        nonce: EXPLICIT submit-time nonce (serving/cluster.py's router
+        assigns these globally) instead of this engine's local counter —
+        a request re-dispatched to a DIFFERENT replica after a crash
+        draws exactly the stream the dead replica would have, because the
+        sampling key is (seed, nonce) and both are now request identity,
+        not engine state.  The local counter advances past any explicit
+        nonce so mixed use can never collide."""
         if self._draining:
             raise RuntimeError(
                 "engine is draining (drain(): migration snapshot taken, "
@@ -895,8 +905,12 @@ class GenerationEngine:
                     "engine; call register_adapter first")
         # nonce reserved at SUBMIT time: retry timing can't shift the
         # request's sampling stream
-        nonce = self._req_counter
-        self._req_counter += 1
+        if nonce is None:
+            nonce = self._req_counter
+            self._req_counter += 1
+        else:
+            nonce = int(nonce)
+            self._req_counter = max(self._req_counter, nonce + 1)
         req = {"rid": rid, "prompt": prompt, "max_len": max_len,
                "n_blocks": n_blocks,
                "temperature": float(temperature or 0.0),
@@ -1167,6 +1181,101 @@ class GenerationEngine:
         self._results[slot.rid] = list(slot.generated)
         self._release(slot)
 
+    def adopt_pages(self, prompt_ids, k_blocks, v_blocks):
+        """Adopt externally prefilled KV pages (a prefill worker's
+        shipment — serving/cluster.py) as CACHED prefix pages: pool-native
+        page bytes (`ops.paged_attention.pool_get_blocks` dicts, one per
+        layer) land verbatim in freshly taken pool blocks, and the prompt's
+        full-block chunks enter the radix prefix tree refcount-ZERO —
+        resident, reclaimable, and matched by the next `add_request` for
+        this prompt exactly like locally cached pages.  Shipping is
+        DETERMINISTIC: a prefill worker pours through the same
+        `paged_pour_blocks` math over the same full-block forward, so a
+        re-dispatched request adopts byte-identical pages and its stream
+        is the one the first dispatch would have produced — the cluster's
+        bit-exact fail-over contract.  (Versus a purely local prefill of
+        the WHOLE prompt, page bytes can differ at XLA reassociation
+        level ~1e-9: the forward spans differ, so shape-dependent tiling
+        may reassociate — which is why the cluster contract compares
+        cluster runs to cluster runs, docs/SERVING_CLUSTER.md.)
+
+        Best-effort by contract: pool pressure (after LRU reclaim) or an
+        already-cached prefix simply adopts fewer (possibly zero) blocks
+        and returns that count — shipping is an optimization; admission
+        always works without it.  Geometry mismatches raise."""
+        if self._prefix is None:
+            raise RuntimeError(
+                "adopt_pages needs the prefix cache: shipped pages are "
+                "delivered AS cached prefixes (build the engine with "
+                "prefix_cache=True; docs/SERVING_CLUSTER.md)")
+        if self.draft_model is not None:
+            raise RuntimeError(
+                "adopt_pages on a speculative engine is not supported: "
+                "shipped pages cover the target pools only, and a "
+                "draft-pool-less prefix would desynchronize d_seq_len")
+        if self._pack is not None:
+            raise RuntimeError(
+                "adopt_pages on an adapter engine is not supported yet: "
+                "shipped pages carry no (slot, epoch) namespace, so an "
+                "adapter admission could never match them (and a base "
+                "admission must not match adapter-poured K/V)")
+        if len(k_blocks) != self._n_layers or len(v_blocks) != self._n_layers:
+            raise ValueError(
+                f"shipped pages cover {len(k_blocks)}/{len(v_blocks)} "
+                f"layers; this engine has {self._n_layers}")
+        bs = self.block_size
+        n_wire = int(np.asarray(k_blocks[0]["payload"]).shape[0])
+        toks = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        n = min(n_wire, len(toks) // bs)
+        from paddle_tpu.ops import paged_attention as pa
+
+        want_leaves = {name for name, _a in pa.pool_parts(self._kpools[0])}
+        for li in range(self._n_layers):
+            for leaves in (k_blocks[li], v_blocks[li]):
+                if set(leaves) != want_leaves:
+                    # a kind mismatch (bf16 pages into an int8 pool or
+                    # vice versa) must be THIS error, not a KeyError deep
+                    # in pool_set_blocks — the sender quantized for the
+                    # wrong pool kind and retrying cannot help
+                    raise ValueError(
+                        f"shipped page leaves {sorted(leaves)} != pool "
+                        f"kind {sorted(want_leaves)} (layer {li}; "
+                        f"kv_cache_dtype mismatch between sender and "
+                        "this engine?)")
+                got = tuple(np.asarray(leaves["payload"]).shape[1:])
+                want = (self._nkv, bs, self._head_dim)
+                if got != want:
+                    raise ValueError(
+                        f"shipped page geometry {got} != pool {want} "
+                        f"(layer {li})")
+        # only the NOVEL tail needs pool blocks: chunks the tree already
+        # holds keep their existing pages (and get LRU-touched)
+        matched = self._prefix.match(toks[: n * bs])
+        start = len(matched)
+        if start >= n:
+            return 0
+        try:
+            fresh = self._alloc(n - start)
+        except _PoolExhausted:
+            return 0
+        for b in fresh:
+            self._ref[b] = 0  # cached-but-unreferenced: reclaimable
+        idx = jnp.asarray(fresh, jnp.int32)
+        for li in range(self._n_layers):
+            kb = {name: jnp.asarray(arr)[start:n]
+                  for name, arr in k_blocks[li].items()}
+            vb = {name: jnp.asarray(arr)[start:n]
+                  for name, arr in v_blocks[li].items()}
+            self._kpools[li] = pa.pool_set_blocks(self._kpools[li], idx, kb)
+            self._vpools[li] = pa.pool_set_blocks(self._vpools[li], idx, vb)
+            if self._pool_sharding is not None:
+                self._kpools[li] = self._place_pool(self._kpools[li],
+                                                    self._pool_sharding)
+                self._vpools[li] = self._place_pool(self._vpools[li],
+                                                    self._pool_sharding)
+        self._prefix.insert(toks[: n * bs], matched + fresh)
+        return len(fresh)
+
     # ------------------------------------------------- fault tolerance
     def snapshot(self, dir, step=None) -> int:
         """Commit a restorable snapshot of this LIVE engine under `dir`
@@ -1265,13 +1374,29 @@ class GenerationEngine:
         the lame duck neither admits nor counts them as work; automatic
         maybe_snapshot is disarmed too, so post-handoff boundaries can
         never overwrite or age out the handoff snapshot)."""
+        if self._draining and self._drain_step is not None:
+            # idempotent: a re-drain (an orchestrator retrying a timed-out
+            # handoff) returns the ALREADY-committed handoff step — a
+            # second snapshot here would capture lame-duck progress and
+            # hand the restore target different state per retry.  Only
+            # for the SAME directory: returning a step that does not
+            # exist under a new dir would send the restore target to a
+            # missing snapshot while the caller believes it committed.
+            if dir is not None and str(dir) != self._drain_dir:
+                raise ValueError(
+                    f"engine already drained to {self._drain_dir!r} "
+                    f"(step {self._drain_step}); a re-drain to {dir!r} "
+                    "cannot re-capture the handoff state — restore from "
+                    "the original directory")
+            return self._drain_step
         d = dir if dir is not None else _flags.flag("FLAGS_engine_snapshot_dir")
         if not d:
             raise ValueError(
                 "drain() needs a snapshot directory: pass dir= or set "
                 "FLAGS_engine_snapshot_dir")
         self._draining = True
-        st = self.snapshot(d, step=step)
+        self._drain_dir = str(d)
+        st = self._drain_step = self.snapshot(d, step=step)
         from paddle_tpu.serving.snapshot import _SNAPSHOT_STATS
 
         _SNAPSHOT_STATS["drains"] += 1

@@ -1,0 +1,753 @@
+"""Disaggregated serving cluster: router tier + engine replicas as real OS
+processes (ROADMAP item 2; docs/SERVING_CLUSTER.md).
+
+`EngineCluster` is the ROUTER process object: it hosts the native TCPStore
+for rendezvous + heartbeats, creates one ShmRing pair per worker, spawns N
+decode replicas (each a `GenerationEngine` in its own process —
+serving/cluster_worker.py) and optionally M dedicated prefill workers, and
+drives everything from a single-threaded poll loop.  The design is
+failure-first:
+
+- **Acceptance is durable.**  submit() journals the request (prompt,
+  decode opts, router-assigned nonce) to a fsynced intake log BEFORE any
+  dispatch; a SIGKILL of the router or any worker can never lose an
+  accepted request.
+- **Identity is the stream.**  The router assigns the submit-time nonce,
+  so the sampled (and greedy) token stream is a pure function of the
+  request — whichever replica serves it, in whatever batch mix.  That is
+  what makes fail-over BIT-EXACT: a re-dispatched request regenerates the
+  same tokens, and the router's per-position merge verifies the overlap.
+- **Death is detected, not assumed.**  Replicas bump a per-replica
+  heartbeat counter in the store from a background thread; the router's
+  miss-threshold detector (FLAGS_cluster_heartbeat_ms /
+  FLAGS_cluster_heartbeat_misses) declares death, with child-exit as the
+  fast path (the router is the parent).  On death: the replica's prefix
+  pages leave the cluster index, its accepted-but-unfinished requests
+  re-dispatch — replayed from the intake log onto survivors, or claimed
+  by a respawned replacement restored from the dead replica's last
+  boundary `EngineSnapshot` (serving/snapshot.py) when one exists.
+- **Pages ship in pool-native bytes.**  Prefill workers pour K/V through
+  the SAME `paged_pour_blocks` math the engine uses and ship the pool's
+  own leaves (`pool_get_blocks`), so int8 pools ship int8 payload + f32
+  scales — about half the wire bytes of bf16 — and shipping is
+  deterministic: a re-dispatched request re-ships byte-identical pages.
+  The decode replica adopts them as refcount-zero cached prefix pages;
+  admission prefix-matches them and prefills only the suffix tail.
+- **Scale-down is drain.**  `scale_down(idx)` drains the replica (PR 13's
+  snapshot + closed admissions): residents finish on the lame duck, its
+  queued requests come home and re-dispatch — no request is ever served
+  to the client twice (the router's canonical stream is the only output).
+
+Every store/ring operation rides timeouts + capped exponential backoff
+with jitter (`router.retry_backoff`).  Crash injection for the test
+matrix: a `kill="point:nth"` spec SIGKILLs the router at named points, and
+the worker spec carries the same for replicas/prefill workers
+(tests/test_serving_cluster_crash.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+from paddle_tpu._core import flags as _flags
+from paddle_tpu.serving.router import (FailureDetector, IntakeLog,
+                                       RequestRouter, retry_backoff)
+
+__all__ = ["EngineCluster", "cluster_stats", "reset_cluster_stats"]
+
+
+# ---------------------------------------------------------------- telemetry
+# Cluster counters (profiler.cluster_stats() reads them — the serving-owns-
+# the-counters contract): replicas_alive is a GAUGE of live decode
+# replicas; heartbeats_missed counts heartbeat periods that elapsed with
+# no counter advance (each missed period once, not per poll); redispatches
+# counts requests re-routed after a death/drain; pages_shipped counts KV
+# pages forwarded prefill->decode; ship_bytes their wire bytes;
+# ship_retries counts backoff retries + re-ships on the shipping path;
+# drain_migrations counts queued requests handed back by drained replicas.
+_CLUSTER_STATS = {
+    "replicas_alive": 0,
+    "heartbeats_missed": 0,
+    "redispatches": 0,
+    "respawns": 0,
+    "pages_shipped": 0,
+    "ship_bytes": 0,
+    "ship_retries": 0,
+    "drain_migrations": 0,
+}
+
+
+def cluster_stats(reset: bool = False) -> dict:
+    """Disaggregated-serving cluster counters (docs/SERVING_CLUSTER.md):
+    live decode replicas, heartbeat periods missed, request re-dispatches
+    after death/drain, KV pages (and bytes) shipped prefill->decode, ship
+    retries, and drain-migrated queued requests.  Zeros when no cluster
+    ran this process."""
+    out = dict(_CLUSTER_STATS)
+    if reset:
+        reset_cluster_stats()
+    return out
+
+
+def reset_cluster_stats():
+    # replicas_alive is a gauge of live cluster state, not traffic
+    for k in _CLUSTER_STATS:
+        if k != "replicas_alive":
+            _CLUSTER_STATS[k] = 0
+
+
+# ------------------------------------------------------------ kill injection
+class _KillSpec:
+    """Crash injection: SIGKILL this process when `hit(point)` reaches the
+    named point for the nth time — the cluster mirror of
+    FLAGS_checkpoint_kill_point (spec "point" or "point:nth")."""
+
+    def __init__(self, spec):
+        self.point, self.nth = None, 1
+        if spec:
+            parts = str(spec).split(":")
+            self.point = parts[0]
+            if len(parts) > 1:
+                self.nth = int(parts[1])
+        self._count = 0
+
+    def hit(self, point):
+        if self.point != point:
+            return
+        self._count += 1
+        if self._count == self.nth:
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _encode(msg) -> bytes:
+    return pickle.dumps(msg, protocol=4)
+
+
+def _decode(data):
+    return pickle.loads(data)
+
+
+class _Worker:
+    """Router-side handle of one spawned worker process."""
+
+    __slots__ = ("role", "idx", "gen", "proc", "logf", "ring_in",
+                 "ring_out", "hb_key", "alive", "draining")
+
+    def __init__(self, role, idx, gen, proc, logf, ring_in, ring_out,
+                 hb_key):
+        self.role = role
+        self.idx = idx
+        self.gen = gen
+        self.proc = proc
+        self.logf = logf
+        self.ring_in = ring_in    # router -> worker
+        self.ring_out = ring_out  # worker -> router
+        self.hb_key = hb_key
+        self.alive = True
+        self.draining = False
+
+    @property
+    def key(self):
+        return (self.role, self.idx)
+
+
+class EngineCluster:
+    """Router + N decode replicas (+ M prefill workers) as OS processes.
+
+        cluster = EngineCluster("model_defs.py:tiny_llama", num_replicas=2,
+                                workdir="/tmp/c1",
+                                engine_kwargs={"max_batch": 2, ...})
+        cluster.submit("r1", prompt_ids, max_new_tokens=8)
+        cluster.serve()                  # poll until every request is done
+        cluster.result("r1")             # canonical token stream
+        cluster.shutdown()
+
+    `model_spec` is "module:factory" or "path/to/file.py:factory"; every
+    worker process calls the factory to build the (deterministically
+    seeded) model — weights ride process-local construction or the
+    training checkpoint tier, never the wire.  Re-instantiating with the
+    same `workdir` after a router death REPLAYS the intake log: completed
+    streams are served from the journal, unfinished requests re-dispatch,
+    and stale worker processes from the previous incarnation are swept.
+    """
+
+    def __init__(self, model_spec, num_replicas=2, num_prefill=0,
+                 engine_kwargs=None, *, workdir, heartbeat_ms=None,
+                 miss_threshold=None, snapshot_interval=0, respawn=True,
+                 ring_mb=16, kill=None, worker_kill=None):
+        """worker_kill: {(role, idx): "point:nth"} crash-injection specs
+        forwarded to specific workers; kill: the ROUTER's own spec.
+        snapshot_interval > 0 arms per-replica boundary snapshots
+        (FLAGS_engine_snapshot_interval inside the worker), which is what
+        enables restore-based fail-over instead of replay-from-scratch."""
+        from paddle_tpu import _native
+
+        if not _native.AVAILABLE:
+            raise RuntimeError(
+                "EngineCluster needs the native TCPStore/ShmRing runtime "
+                "(paddle_tpu/_native); no C++ toolchain was available")
+        self.model_spec = str(model_spec)
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.workdir = str(workdir)
+        os.makedirs(os.path.join(self.workdir, "logs"), exist_ok=True)
+        self.heartbeat_ms = int(
+            heartbeat_ms if heartbeat_ms is not None
+            else _flags.flag("FLAGS_cluster_heartbeat_ms"))
+        self.miss_threshold = int(
+            miss_threshold if miss_threshold is not None
+            else _flags.flag("FLAGS_cluster_heartbeat_misses"))
+        self.snapshot_interval = int(snapshot_interval)
+        self.respawn = bool(respawn)
+        self.ring_bytes = int(ring_mb) << 20
+        self._kill = _KillSpec(kill)
+        self._worker_kill = dict(worker_kill or {})
+        self._ns = f"c{uuid.uuid4().hex[:8]}"  # per-incarnation namespace
+
+        # ---- rendezvous store (the router hosts it) --------------------
+        self._store_srv = _native.TCPStoreServer()
+        self._store = _native.TCPStoreClient(port=self._store_srv.port)
+
+        # ---- router restart: sweep the previous incarnation ------------
+        self._pidfile = os.path.join(self.workdir, "pids.json")
+        self._sweep_stale_workers()
+
+        bs = int(self.engine_kwargs.get("block_size", 16))
+        self.block_size = bs
+        log_path = os.path.join(self.workdir, "intake.jsonl")
+        had_log = os.path.exists(log_path)
+        self.router = RequestRouter(bs, log_path=log_path)
+        if had_log:
+            self.router.restore(IntakeLog.replay(log_path))
+
+        self.detector = FailureDetector(
+            self.heartbeat_ms, self.miss_threshold,
+            on_miss=lambda n: _CLUSTER_STATS.__setitem__(
+                "heartbeats_missed",
+                _CLUSTER_STATS["heartbeats_missed"] + n))
+
+        self._workers: dict = {}        # (role, idx) -> _Worker
+        self._gens: dict = {}           # (role, idx) -> spawn generation
+        self._shipping: dict = {}       # rid -> {"pw", "target", "sid"}
+        self._pending_claims: dict = {} # decode idx -> set(rids)
+        self._stopped = False
+        # router restart over a live workdir: replicas spawned with a
+        # RESTORABLE snapshot will CLAIM their resident requests via
+        # their resume reports — replay-dispatching those same rids
+        # before the reports arrive would double-dispatch them, so the
+        # unassigned backlog holds until every restorable replica has
+        # resumed (or died, or the boot deadline passed)
+        self._awaiting_resume: set = set()
+        self._resume_deadline = 0.0
+        from paddle_tpu.serving.snapshot import EngineSnapshot
+
+        for i in range(int(num_replicas)):
+            if (os.path.isdir(self._snap_dir(i))
+                    and EngineSnapshot(self._snap_dir(i)).latest_step()
+                    is not None):
+                self._awaiting_resume.add(i)
+            self._spawn("decode", i, restore=True)
+        for i in range(int(num_prefill)):
+            self._spawn("prefill", i)
+        if self._awaiting_resume:
+            self._resume_deadline = (time.monotonic()
+                                     + self.detector.boot_grace_s)
+        else:
+            self.router_replay_dispatch()
+
+    # ------------------------------------------------------------ plumbing
+    def _snap_dir(self, idx):
+        return os.path.join(self.workdir, f"replica{idx}")
+
+    def _sweep_stale_workers(self):
+        """A restarted router inherits the previous incarnation's orphaned
+        workers (the old router died; its children did not).  They are
+        recorded in the pidfile; any that still look like cluster workers
+        are SIGKILLed before fresh ones spawn — two replica sets serving
+        one intake log would double-serve."""
+        try:
+            with open(self._pidfile) as f:
+                stale = json.load(f)
+        except (OSError, ValueError):
+            return
+        for _name, pid in stale.items():
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read()
+                if b"cluster_worker" not in cmd:
+                    continue  # pid reused by something else: leave it be
+                os.kill(int(pid), signal.SIGKILL)
+            except (OSError, ValueError):
+                continue
+        try:
+            os.remove(self._pidfile)
+        except OSError:
+            pass
+
+    def _write_pidfile(self):
+        pids = {f"{w.role}{w.idx}": w.proc.pid
+                for w in self._workers.values() if w.alive}
+        tmp = self._pidfile + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(pids, f)
+        os.replace(tmp, self._pidfile)
+
+    def _spawn(self, role, idx, restore=False):
+        from paddle_tpu import _native
+        import paddle_tpu
+
+        gen = self._gens.get((role, idx), 0) + 1
+        self._gens[(role, idx)] = gen
+        if gen > 1:
+            _CLUSTER_STATS["respawns"] += 1
+        base = f"/pc_{self._ns}_{role}{idx}g{gen}"
+        ring_in = _native.ShmRing(base + "_in", self.ring_bytes)
+        ring_out = _native.ShmRing(base + "_out", self.ring_bytes)
+        hb_key = f"{self._ns}/hb/{role}{idx}"
+        spec = {
+            "role": role, "idx": idx, "gen": gen,
+            "store_port": self._store_srv.port,
+            "ring_in": base + "_in", "ring_out": base + "_out",
+            "hb_key": hb_key, "heartbeat_ms": self.heartbeat_ms,
+            "model": self.model_spec, "engine": self.engine_kwargs,
+            "snapshot_dir": self._snap_dir(idx) if role == "decode" else "",
+            "snapshot_interval": self.snapshot_interval,
+            "restore": bool(restore),
+            # crash injection targets the ORIGINAL process only: a
+            # replacement re-armed with the same spec would re-kill
+            # itself forever and the matrix would test nothing but churn
+            "kill": (self._worker_kill.get((role, idx), "")
+                     if gen == 1 else ""),
+        }
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(paddle_tpu.__file__)))
+        env = dict(os.environ)
+        env["PADDLE_CLUSTER_SPEC"] = json.dumps(spec)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        logf = open(os.path.join(self.workdir, "logs",
+                                 f"{role}{idx}.g{gen}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.cluster_worker"],
+            env=env, stdout=logf, stderr=subprocess.STDOUT)
+        w = _Worker(role, idx, gen, proc, logf, ring_in, ring_out, hb_key)
+        self._workers[(role, idx)] = w
+        self.detector.track((role, idx))
+        if role == "decode":
+            self.router.add_replica(idx)
+        self._write_pidfile()
+        self._update_alive_gauge()
+        return w
+
+    def _update_alive_gauge(self):
+        _CLUSTER_STATS["replicas_alive"] = sum(
+            1 for w in self._workers.values()
+            if w.role == "decode" and w.alive and not w.draining)
+
+    def _live_decode(self):
+        return [w.idx for w in self._workers.values()
+                if w.role == "decode" and w.alive and not w.draining]
+
+    def _live_prefill(self):
+        return [w for w in self._workers.values()
+                if w.role == "prefill" and w.alive]
+
+    def _push(self, worker, msg, shipping=False):
+        """Ring push with the shared timeout+backoff+jitter contract.
+        A poisoned/closed ring (the peer died mid-operation) surfaces as
+        BrokenPipeError — the only push failure that means DEATH.  A
+        TimeoutError means backpressure (a full ring behind a long
+        macro-step or a first compile): callers must retry/re-route the
+        MESSAGE, never declare the worker dead for it."""
+        data = _encode(msg)
+
+        def once():
+            worker.ring_in.push(data, timeout_ms=250)
+
+        retry_backoff(
+            once, timeout_s=60.0, retry_on=(TimeoutError,),
+            on_retry=(lambda _e: _CLUSTER_STATS.__setitem__(
+                "ship_retries", _CLUSTER_STATS["ship_retries"] + 1))
+            if shipping else None)
+        return len(data)
+
+    # -------------------------------------------------------------- intake
+    def submit(self, rid, prompt, max_new_tokens=16, temperature=0.0,
+               seed=0):
+        """Accept (durably journal) and dispatch one request.  Idempotent
+        per rid: resubmitting a known id neither re-journals nor
+        re-dispatches — the first acceptance pinned its nonce and its
+        stream."""
+        known = self.router.request(rid) is not None
+        self.router.submit(rid, [int(t) for t in prompt],
+                           max_new=int(max_new_tokens),
+                           temperature=float(temperature), seed=int(seed))
+        self._kill.hit("router-after-accept")
+        if not known:
+            self._dispatch(rid)
+
+    def router_replay_dispatch(self):
+        """Dispatch every journal-replayed request that is unfinished and
+        unowned (router restart).  Requests a restored replica claims via
+        its resume report keep their owner instead."""
+        for rid in self.router.unassigned():
+            # a replayed request with delivered tokens is a true
+            # re-dispatch (its first serve died with the old router)
+            self._dispatch(
+                rid, redispatch=bool(self.router.request(rid).tokens))
+
+    def _dispatch(self, rid, redispatch=False):
+        req = self.router.request(rid)
+        live = self._live_decode()
+        if not live:
+            raise RuntimeError(
+                "no live decode replicas (all dead/draining and respawn "
+                "disabled) — the cluster cannot serve")
+        target = self.router.pick_replica(req.prompt, among=live)
+        if redispatch:
+            _CLUSTER_STATS["redispatches"] += 1
+            self._shipping.pop(rid, None)
+        pws = self._live_prefill()
+        full_blocks = (len(req.prompt) - 1) // self.block_size
+        if pws and full_blocks >= 1:
+            # least-outstanding prefill worker (idx as tie-break): a
+            # fixed lowest-idx pick would serialize every shipment
+            # through worker 0 and make num_prefill>1 pure overhead
+            in_flight = {}
+            for s in self._shipping.values():
+                in_flight[s["pw"]] = in_flight.get(s["pw"], 0) + 1
+            pw = min(pws, key=lambda w: (in_flight.get(w.key, 0), w.idx))
+            sid = f"{rid}#{uuid.uuid4().hex[:6]}"
+            self.router.assign(rid, target, shipped=True)
+            self._shipping[rid] = {"pw": pw.key, "target": target,
+                                   "sid": sid, "begun": False}
+            try:
+                self._push(pw, {"t": "prefill", "rid": rid, "sid": sid,
+                                "prompt": req.prompt,
+                                "n_blocks": full_blocks}, shipping=True)
+                return
+            except BrokenPipeError:
+                self._on_worker_dead(pw.key)
+                self._shipping.pop(rid, None)
+            except (TimeoutError, ConnectionError):
+                # saturated prefill ring: skip shipping for this request
+                # and fall through to the direct path — backpressure on a
+                # live worker is never a death verdict
+                self._shipping.pop(rid, None)
+                _CLUSTER_STATS["ship_retries"] += 1
+        # direct path: the replica prefills locally
+        self.router.assign(rid, target)
+        self._submit_to(target, req)
+
+    def _submit_to(self, idx, req):
+        w = self._workers[("decode", idx)]
+        try:
+            self._push(w, {"t": "submit", "rid": req.rid,
+                           "prompt": req.prompt,
+                           "max_new": req.opts.get("max_new", 16),
+                           "temperature": req.opts.get("temperature", 0.0),
+                           "seed": req.opts.get("seed", 0),
+                           "nonce": req.nonce})
+        except BrokenPipeError:
+            self._on_worker_dead(w.key)
+        except (TimeoutError, ConnectionError):
+            # backpressure, not death: the submit never entered the
+            # ring, so releasing the owner re-dispatches it later —
+            # the failure detector alone decides who is dead
+            self.router.unassign(req.rid)
+
+    # ------------------------------------------------------------- polling
+    def poll(self):
+        """One router turn: drain every worker's event ring, forward ship
+        traffic, detect failures (heartbeats + child exit), respawn and
+        re-dispatch.  Single-threaded on purpose — every state transition
+        is ordered, so the kill matrix enumerates real interleavings."""
+        for w in list(self._workers.values()):
+            if not w.alive:
+                continue
+            self._drain_events(w)
+        self._detect_failures()
+        self._dispatch_unassigned()
+
+    def _drain_events(self, w):
+        while True:
+            try:
+                data = w.ring_out.pop(timeout_ms=1)
+            except TimeoutError:
+                return
+            except BrokenPipeError:
+                self._on_worker_dead(w.key)
+                return
+            if data is None:
+                return
+            self._on_event(w, _decode(data))
+
+    def _on_event(self, w, msg):
+        t = msg["t"]
+        if t == "resume":
+            self._awaiting_resume.discard(w.idx)
+            claims = self._pending_claims.pop(w.idx, set())
+            for rid in msg["rids"]:
+                req = self.router.request(rid)
+                if req is not None and not req.done:
+                    self.router.assign(rid, w.idx)
+                    claims.discard(rid)
+            # rids the replacement did NOT resurrect (accepted after its
+            # last snapshot boundary) fall back to intake-log replay
+            for rid in sorted(claims):
+                if not self.router.request(rid).done:
+                    self._dispatch(rid, redispatch=True)
+        elif t == "tokens":
+            self.router.on_tokens(msg["rid"], msg["start"], msg["toks"])
+            self._kill.hit("router-mid-serving")
+        elif t == "done":
+            self.router.on_done(msg["rid"], msg["n"])
+        elif t == "requeue":
+            req = self.router.request(msg["rid"])
+            if req is not None and not req.done:
+                self._dispatch(msg["rid"], redispatch=True)
+        elif t == "drained":
+            w.draining = True
+            self._update_alive_gauge()
+            migrated = self.router.on_drained(w.idx, msg["queued"])
+            _CLUSTER_STATS["drain_migrations"] += len(migrated)
+            for rid in migrated:
+                self._dispatch(rid, redispatch=True)
+        elif t == "bye":
+            w.alive = False
+            self.detector.forget(w.key)
+            self._update_alive_gauge()
+        elif t in ("page_begin", "page_block", "page_end"):
+            self._forward_ship(w, msg)
+        elif t == "shipped":
+            state = self._shipping.pop(msg["rid"], None)
+            if state is not None:
+                req = self.router.request(msg["rid"])
+                self._submit_to(state["target"], req)
+        elif t == "fatal":
+            self._on_worker_dead(w.key)
+
+    def _forward_ship(self, pw, msg):
+        """Relay one prefill-worker page message into the target decode
+        replica's ring (star topology: the router is the only ring
+        producer a worker ever sees, so ship traffic and submits arrive
+        in one total order — ship_end always precedes the submit)."""
+        state = next((s for s in self._shipping.values()
+                      if s["sid"] == msg["sid"]), None)
+        if state is None:
+            return  # aborted ship: drop the straggler
+        tgt = self._workers.get(("decode", state["target"]))
+        if tgt is None or not tgt.alive:
+            return
+        fwd = dict(msg)
+        fwd["t"] = {"page_begin": "ship_begin", "page_block": "ship_block",
+                    "page_end": "ship_end"}[msg["t"]]
+        try:
+            n = self._push(tgt, fwd, shipping=True)
+        except BrokenPipeError:
+            self._on_worker_dead(tgt.key)
+            return
+        except (TimeoutError, ConnectionError):
+            # the target's ring is saturated: abandon this shipment (the
+            # decode side drops incomplete staging) and serve the request
+            # by direct submit — local prefill instead of shipped pages
+            rid = next((r for r, s in self._shipping.items()
+                        if s["sid"] == msg["sid"]), None)
+            if rid is not None:
+                self._shipping.pop(rid, None)
+                _CLUSTER_STATS["ship_retries"] += 1
+                req = self.router.request(rid)
+                if req is not None and not req.done:
+                    self._submit_to(state["target"], req)
+            return
+        state["begun"] = True
+        if msg["t"] == "page_block":
+            _CLUSTER_STATS["pages_shipped"] += 1
+            _CLUSTER_STATS["ship_bytes"] += n
+
+    def _detect_failures(self):
+        for w in list(self._workers.values()):
+            if not w.alive:
+                continue
+            try:
+                hb = self._store.add(w.hb_key, 0)
+            except OSError:
+                hb = -1
+            self.detector.observe(w.key, hb)
+            # fast path: the router is the parent — a SIGKILLed child is
+            # visible immediately, no need to wait out the miss threshold
+            if w.proc.poll() is not None:
+                self._on_worker_dead(w.key)
+        for key in self.detector.dead_ranks():
+            if key in self._workers and self._workers[key].alive:
+                self._on_worker_dead(key)
+
+    def _on_worker_dead(self, key):
+        w = self._workers.get(key)
+        if w is None or not w.alive:
+            return
+        w.alive = False
+        self.detector.forget(key)
+        if w.role == "decode":
+            # a restorable replica that died before resuming can no
+            # longer claim the replay backlog — release its hold
+            self._awaiting_resume.discard(w.idx)
+        try:
+            if w.proc.poll() is None:
+                w.proc.kill()
+        except OSError:
+            pass
+        for ring in (w.ring_in, w.ring_out):
+            try:
+                ring.destroy()
+            except OSError:
+                pass
+        self._write_pidfile()
+        self._update_alive_gauge()
+        if w.role == "prefill":
+            # abort in-flight ships from this worker, then re-route them
+            for rid, state in list(self._shipping.items()):
+                if state["pw"] != key:
+                    continue
+                tgt = self._workers.get(("decode", state["target"]))
+                if state["begun"] and tgt is not None and tgt.alive:
+                    try:
+                        self._push(tgt, {"t": "ship_abort",
+                                         "sid": state["sid"]})
+                    except (BrokenPipeError, TimeoutError, ConnectionError):
+                        pass
+                self._shipping.pop(rid, None)
+                _CLUSTER_STATS["ship_retries"] += 1
+                if not self.router.request(rid).done:
+                    self._dispatch(rid, redispatch=True)
+            if self.respawn:
+                self._spawn("prefill", w.idx)
+            return
+        # ---- decode replica death --------------------------------------
+        orphans = self.router.on_replica_dead(w.idx)
+        for rid in orphans:
+            self._shipping.pop(rid, None)
+        was_draining = w.draining
+        from paddle_tpu.serving.snapshot import EngineSnapshot
+
+        restorable = (self.respawn and not was_draining
+                      and os.path.isdir(self._snap_dir(w.idx))
+                      and EngineSnapshot(
+                          self._snap_dir(w.idx)).latest_step() is not None)
+        if self.respawn and not was_draining:
+            self._spawn("decode", w.idx, restore=True)
+        if restorable:
+            # let the restored replacement CLAIM what its snapshot holds;
+            # unclaimed orphans re-dispatch when its resume report lands
+            self._pending_claims[w.idx] = set(orphans)
+        else:
+            for rid in orphans:
+                self._dispatch(rid, redispatch=True)
+
+    def _dispatch_unassigned(self):
+        if self._awaiting_resume:
+            # restored replicas may still claim these rids (router
+            # restart): hold the backlog until every restorable replica
+            # has reported (resume), left (death), or overslept the grace
+            if time.monotonic() < self._resume_deadline:
+                return
+            self._awaiting_resume.clear()
+        for rid in self.router.unassigned():
+            if rid in self._shipping:
+                continue
+            if any(rid in claims for claims in
+                   self._pending_claims.values()):
+                continue
+            self._dispatch(rid, redispatch=True)
+
+    # ------------------------------------------------------------- serving
+    def serve(self, timeout_s=300.0, poll_s=0.002):
+        """Poll until every accepted request has completed (or raise at
+        the deadline with the stragglers named)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if not self.router.unfinished():
+                return
+            self.poll()
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"cluster serve timed out with unfinished requests "
+                    f"{self.router.unfinished()[:8]}")
+            time.sleep(poll_s)
+
+    def result(self, rid):
+        return self.router.result(rid)
+
+    def results(self):
+        return {r: self.router.result(r)
+                for r in sorted(self.router._reqs)}
+
+    # ---------------------------------------------------------- scale-down
+    def scale_down(self, idx, timeout_s=120.0):
+        """Graceful drain of decode replica `idx`: snapshot + closed
+        admissions on the worker (PR 13 drain), queued requests migrate
+        to survivors, residents finish on the lame duck, the process
+        exits cleanly.  Blocks until the drain report arrives."""
+        w = self._workers.get(("decode", idx))
+        if w is None or not w.alive:
+            raise ValueError(f"no live decode replica {idx}")
+        if len(self._live_decode()) <= 1:
+            raise RuntimeError(
+                "refusing to drain the LAST live replica — queued "
+                "requests would have nowhere to migrate")
+        self._push(w, {"t": "drain"})
+        deadline = time.monotonic() + timeout_s
+        while not w.draining:
+            self.poll()
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"replica {idx} never reported drained")
+            time.sleep(0.002)
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        from paddle_tpu.distributed.launch.main import terminate_procs
+
+        live = [w for w in self._workers.values() if w.alive]
+        for w in live:
+            try:
+                self._push(w, {"t": "stop"})
+            except (BrokenPipeError, TimeoutError, ConnectionError, OSError):
+                pass
+        # the launcher's stop-cleanly-then-forcefully helper (elastic tier)
+        terminate_procs([(w.proc, w.logf) for w in live], grace_s=5)
+        for w in self._workers.values():
+            w.alive = False
+            for ring in (w.ring_in, w.ring_out):
+                try:
+                    ring.destroy()
+                except OSError:
+                    pass
+        self._update_alive_gauge()
+        if self.router.log is not None:
+            self.router.log.close()
+        try:
+            self._store.close()
+            self._store_srv.stop()
+        except OSError:
+            pass
+        try:
+            os.remove(self._pidfile)
+        except OSError:
+            pass
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

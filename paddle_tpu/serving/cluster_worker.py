@@ -1,0 +1,351 @@
+"""Cluster worker process entry (`python -m paddle_tpu.serving.cluster_worker`).
+
+Spawned by `serving.cluster.EngineCluster` with a JSON spec in
+PADDLE_CLUSTER_SPEC.  Two roles:
+
+- **decode**: owns ONE `GenerationEngine` (prefix cache forced on — it is
+  both the page-adoption surface for shipped KV and the substrate of the
+  cluster prefix index).  Pops router messages from its inbound ShmRing,
+  steps the engine, and pushes per-position token events + completion
+  reports.  With a snapshot dir + interval the engine auto-snapshots at
+  macro-step boundaries (serving/snapshot.py), and a respawned worker
+  RESTORES from the newest valid boundary, re-emitting each resident
+  stream from position 0 — the router's per-position merge dedups and
+  verifies the overlap, so fail-over is bit-exact.
+- **prefill**: builds the model once, computes K/V for a prompt's full
+  blocks through the SAME `paged_pour_blocks` math the engine uses, and
+  ships the pool-native page bytes (`pool_get_blocks` leaves — int8
+  payload + f32 scales for int8 pools, about half the bf16 wire bytes)
+  back through the router to the target decode replica, block by block.
+
+Heartbeats ride a background thread bumping a TCPStore counter every
+heartbeat_ms/2 — SIGKILL stops the bumps, which is the router's
+miss-threshold failure signal.  A worker whose store connection dies
+(the router is gone) exits rather than serving into the void.
+Crash injection: spec["kill"] = "point:nth" SIGKILLs this process at the
+named protocol point (tests/test_serving_cluster_crash.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import threading
+
+
+def _bootstrap_jax():
+    """Same pinning as tests/conftest.py / run_tier1's worker bootstrap:
+    CPU platform, exact matmuls, shared persistent compile cache."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+    cache = os.environ.get("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def _load_factory(spec: str):
+    """'module:fn' or 'path/to/file.py:fn' -> the model factory."""
+    mod, fn = spec.rsplit(":", 1)
+    if mod.endswith(".py"):
+        import importlib.util
+
+        s = importlib.util.spec_from_file_location("_cluster_model_def", mod)
+        m = importlib.util.module_from_spec(s)
+        s.loader.exec_module(m)
+    else:
+        import importlib
+
+        m = importlib.import_module(mod)
+    return getattr(m, fn)
+
+
+def _heartbeat_loop(store, key, period_s):
+    while True:
+        try:
+            store.add(key, 1)
+        except OSError:
+            os._exit(4)  # the router (store host) is gone: stop serving
+        if _HB_STOP.wait(period_s):
+            return
+
+
+_HB_STOP = threading.Event()
+
+
+class _Out:
+    def __init__(self, ring):
+        self.ring = ring
+
+    def push(self, msg):
+        self.ring.push(pickle.dumps(msg, protocol=4), timeout_ms=30_000)
+
+
+# --------------------------------------------------------------- decode role
+def _decode_loop(spec, model, ring_in, out, killer):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import GenerationEngine, restore_engine
+    from paddle_tpu.serving.snapshot import EngineSnapshot
+
+    snap_dir = spec["snapshot_dir"]
+    if snap_dir and spec["snapshot_interval"] > 0:
+        paddle.set_flags({
+            "FLAGS_engine_snapshot_dir": snap_dir,
+            "FLAGS_engine_snapshot_interval": spec["snapshot_interval"]})
+
+    kw = dict(spec["engine"])
+    kw["prefix_cache"] = True
+    eng = None
+    tracked: set = set()
+    sent: dict = {}
+    if spec["restore"] and snap_dir and \
+            EngineSnapshot(snap_dir).latest_step() is not None:
+        eng = restore_engine(model, snap_dir)
+        for s in eng._slots:
+            if s.active:
+                tracked.add(s.rid)
+        tracked.update(eng.pending_requests())
+        # finished-but-undelivered results also re-emit: the boundary may
+        # have caught a request between completion and the router's read
+        for rid in eng._results:
+            tracked.add(rid)
+    if eng is None:
+        eng = GenerationEngine(model, **kw)
+    out.push({"t": "resume", "rids": sorted(tracked, key=str)})
+
+    staging: dict = {}
+    draining = eng._draining
+
+    def emit_progress():
+        active = {s.rid for s in eng._slots if s.active}
+        queued = set(eng.pending_requests())
+        for rid in sorted(tracked, key=str):
+            lst = eng.result(rid)
+            if lst is None:
+                continue
+            n0 = sent.get(rid, 0)
+            if len(lst) > n0:
+                out.push({"t": "tokens", "rid": rid, "start": n0,
+                          "toks": [int(x) for x in lst[n0:]]})
+                sent[rid] = len(lst)
+                killer.hit("decode-mid-stream")
+            if rid not in active and rid not in queued:
+                out.push({"t": "done", "rid": rid, "n": sent.get(rid, 0)})
+                tracked.discard(rid)
+
+    def handle(msg):
+        nonlocal draining
+        t = msg["t"]
+        if t == "submit":
+            if draining:
+                out.push({"t": "requeue", "rid": msg["rid"]})
+                return None
+            eng.add_request(msg["rid"], msg["prompt"],
+                            max_new_tokens=msg["max_new"],
+                            temperature=msg["temperature"] or None,
+                            seed=msg["seed"], nonce=msg["nonce"])
+            killer.hit("decode-after-accept")
+            tracked.add(msg["rid"])
+        elif t == "ship_begin":
+            staging[msg["sid"]] = {"tokens": msg["tokens"],
+                                   "n": msg["n_blocks"], "k": [], "v": []}
+        elif t == "ship_block":
+            st = staging.get(msg["sid"])
+            if st is not None:
+                st["k"].append(msg["k"])
+                st["v"].append(msg["v"])
+        elif t == "ship_end":
+            st = staging.pop(msg["sid"], None)
+            if st is not None and len(st["k"]) == st["n"]:
+                n_layers = len(st["k"][0])
+                k_blocks = [
+                    {leaf: np.concatenate(
+                        [blk[li][leaf] for blk in st["k"]], axis=0)
+                     for leaf in st["k"][0][li]}
+                    for li in range(n_layers)]
+                v_blocks = [
+                    {leaf: np.concatenate(
+                        [blk[li][leaf] for blk in st["v"]], axis=0)
+                     for leaf in st["v"][0][li]}
+                    for li in range(n_layers)]
+                eng.adopt_pages(st["tokens"], k_blocks, v_blocks)
+                killer.hit("decode-after-adopt")
+            # an incomplete ship (a killed prefill worker) just drops:
+            # admission falls back to local prefill, nothing is lost
+        elif t == "ship_abort":
+            staging.pop(msg["sid"], None)
+        elif t == "drain":
+            eng.drain(snap_dir)  # decode specs always carry a snapshot dir
+            draining = True
+            out.push({"t": "drained",
+                      "queued": list(eng.pending_requests())})
+        elif t == "stop":
+            return "stop"
+        return None
+
+    while True:
+        busy = eng.has_work()
+        try:
+            data = ring_in.pop(timeout_ms=1 if busy else 50)
+        except TimeoutError:
+            data = None
+        except BrokenPipeError:
+            os._exit(3)
+        if data is not None:
+            if handle(pickle.loads(data)) == "stop":
+                break
+            continue  # drain the inbox before paying for a macro-step
+        if busy:
+            eng.step()
+            emit_progress()
+        elif draining:
+            break  # residents finished; queued rids migrated via drained
+    out.push({"t": "bye"})
+
+
+# -------------------------------------------------------------- prefill role
+def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype):
+    """K/V pages for the prompt's first `n_blocks` FULL blocks, poured
+    through the engine's own quantize/pour math into a staging pool and
+    extracted as pool-native leaves.  Deterministic: the same prompt
+    always ships the same bytes (the bit-exact re-ship contract), int8
+    quantization included."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import _model_forward_cached
+    from paddle_tpu.ops import paged_attention as pa
+
+    cfg = model.config
+    nkv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    dt = (jnp.int8 if kv_dtype == "int8"
+          else jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32)
+    span = n_blocks * block_size
+    toks = [int(t) for t in prompt[:span]]
+    caches = [
+        (paddle.zeros([1, 0, nkv, hd], dtype=cfg.dtype),
+         paddle.zeros([1, 0, nkv, hd], dtype=cfg.dtype))
+        for _ in range(cfg.num_hidden_layers)]
+    arr = np.asarray(toks, np.int32).reshape(1, -1)
+    with paddle.no_grad():
+        _h, caches = _model_forward_cached(
+            model.model, paddle.to_tensor(arr), caches, 0)
+    idx = jnp.arange(n_blocks, dtype=jnp.int32)
+
+    def pour_and_extract(pool, tensor):
+        kv = jnp.moveaxis(tensor._value, 1, 2)  # [1, Nkv, S, H]
+        kv = kv.reshape(nkv, n_blocks, block_size, hd).swapaxes(0, 1)
+        pool = pa.paged_pour_blocks(pool, kv, idx)
+        return {name: np.asarray(a)
+                for name, a in pa.pool_get_blocks(pool, idx).items()}
+
+    k_layers, v_layers = [], []
+    for k, v in caches:
+        kp, vp = pa.alloc_paged_cache(n_blocks, nkv, block_size, hd, dt)
+        k_layers.append(pour_and_extract(kp, k))
+        v_layers.append(pour_and_extract(vp, v))
+    return toks, k_layers, v_layers
+
+
+def _prefill_loop(spec, model, ring_in, out, killer):
+    import uuid as _uuid  # noqa: F401  (sids come from the router)
+
+    from paddle_tpu._core import flags as _flags
+
+    block_size = int(spec["engine"].get("block_size", 16))
+    # resolve EXACTLY like GenerationEngine.__init__: an unset engine
+    # kwarg falls back to FLAGS_kv_cache_dtype — a 'bf16' literal here
+    # would ship scale-less pages into decode replicas whose env-flagged
+    # int8 pools expect payload + scales
+    kv_dtype = (spec["engine"].get("kv_cache_dtype")
+                or _flags.flag("FLAGS_kv_cache_dtype"))
+    while True:
+        try:
+            data = ring_in.pop(timeout_ms=100)
+        except TimeoutError:
+            continue
+        except BrokenPipeError:
+            os._exit(3)
+        if data is None:
+            break
+        msg = pickle.loads(data)
+        if msg["t"] == "stop":
+            break
+        if msg["t"] != "prefill":
+            continue
+        n = int(msg["n_blocks"])
+        toks, k_layers, v_layers = _prefill_pages(
+            model, msg["prompt"], n, block_size, kv_dtype)
+        killer.hit("prefill-before-ship")
+        sid = msg["sid"]
+        out.push({"t": "page_begin", "sid": sid, "rid": msg["rid"],
+                  "tokens": toks, "n_blocks": n,
+                  "n_layers": len(k_layers)})
+        for bi in range(n):
+            out.push({"t": "page_block", "sid": sid, "i": bi,
+                      "k": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
+                            for lay in k_layers],
+                      "v": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
+                            for lay in v_layers]})
+            if bi == n // 2:
+                killer.hit("prefill-mid-ship")
+        out.push({"t": "page_end", "sid": sid})
+        killer.hit("prefill-after-ship")
+        out.push({"t": "shipped", "rid": msg["rid"], "n_blocks": n})
+    out.push({"t": "bye"})
+
+
+# --------------------------------------------------------------------- main
+def main():
+    spec = json.loads(os.environ["PADDLE_CLUSTER_SPEC"])
+    _bootstrap_jax()
+
+    from paddle_tpu import _native
+    from paddle_tpu.serving.cluster import _KillSpec
+
+    killer = _KillSpec(spec.get("kill") or "")
+    store = _native.TCPStoreClient(port=spec["store_port"],
+                                   timeout_ms=30_000)
+    ring_in = _native.ShmRing(spec["ring_in"], create=False,
+                              attach_timeout_ms=30_000)
+    ring_out = _native.ShmRing(spec["ring_out"], create=False,
+                               attach_timeout_ms=30_000)
+    hb = threading.Thread(
+        target=_heartbeat_loop,
+        args=(store, spec["hb_key"], spec["heartbeat_ms"] / 2000.0),
+        daemon=True)
+    hb.start()
+
+    model = _load_factory(spec["model"])()
+    out = _Out(ring_out)
+    try:
+        if spec["role"] == "decode":
+            _decode_loop(spec, model, ring_in, out, killer)
+        else:
+            _prefill_loop(spec, model, ring_in, out, killer)
+    except BrokenPipeError:
+        os._exit(3)
+    except Exception as e:  # noqa: BLE001 — report, then die loudly
+        import traceback
+
+        traceback.print_exc()
+        try:
+            out.push({"t": "fatal", "err": f"{type(e).__name__}: {e}"})
+        except Exception:
+            pass
+        os._exit(5)
+    finally:
+        _HB_STOP.set()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,480 @@
+"""Router tier for the disaggregated serving cluster (docs/SERVING_CLUSTER.md).
+
+This module is the TRANSPORT-AGNOSTIC half of serving/cluster.py: every
+routing/robustness decision lives here as plain host-side state machines so
+the contracts are unit-testable without spawning a single process.
+cluster.py wires them to real OS processes over the native TCPStore and
+ShmRing.
+
+Pieces (reference lineage: the fleet/elastic failure-detection + relaunch
+design, docs/DISTRIBUTED.md failure-modes table, applied to serving):
+
+- `block_hashes` / `ClusterPrefixIndex` — the cluster-level prefix cache
+  index: chained hashes over FULL prompt blocks (the same page granularity
+  as the engine's radix tree, docs/DECODE.md) map to the replica whose
+  radix tree already holds those pages, so shared-system-prompt requests
+  route to the replica that can skip their prefill.
+- `IntakeLog` — the router's durable accepted-request log: an accepted
+  request is fsynced BEFORE it is dispatched, so a router crash (or a
+  replica crash) can never lose it; token deliveries and completions are
+  logged too, so a restarted router replays finished streams instead of
+  re-serving them.
+- `FailureDetector` — per-replica heartbeat miss counting: a replica whose
+  heartbeat counter stops advancing for `miss_threshold` consecutive
+  heartbeat periods is declared dead (SIGKILL leaves no goodbye).
+- `RequestRouter` — request identity (router-assigned idempotent ids +
+  submit-time nonces), replica selection (prefix affinity, then least
+  outstanding), per-position token dedup/merge (a re-dispatched or
+  snapshot-restored stream re-emits a prefix; the router keeps ONE
+  canonical stream and verifies the overlap bit-for-bit), and the
+  re-dispatch set on replica death/drain.
+- `retry_backoff` — timeouts + capped exponential backoff with jitter for
+  every store/ring operation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import time
+
+__all__ = ["block_hashes", "ClusterPrefixIndex", "IntakeLog",
+           "FailureDetector", "RequestRouter", "retry_backoff"]
+
+
+# ------------------------------------------------------------- retry helper
+def retry_backoff(fn, *, timeout_s=5.0, base_s=0.005, cap_s=0.25,
+                  retry_on=(TimeoutError, ConnectionError), rng=None,
+                  on_retry=None):
+    """Run `fn()` until it returns, retrying `retry_on` failures with
+    capped exponential backoff + full jitter under ONE deadline.
+
+    The deadline is shared across attempts (the TCPStore `wait` lesson:
+    per-attempt budgets multiply into unbounded stalls).  When the
+    deadline passes, the LAST failure re-raises — never a swallowed
+    timeout.  `on_retry(exc)` is called before each sleep (the cluster
+    counts ship_retries through it); `rng` (random.Random) makes jitter
+    deterministic under test."""
+    rng = rng or random
+    deadline = time.monotonic() + timeout_s
+    delay = base_s
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if time.monotonic() >= deadline:
+                raise
+            if on_retry is not None:
+                on_retry(e)
+            time.sleep(rng.uniform(0, min(delay, cap_s)))
+            delay *= 2
+
+
+# ---------------------------------------------------------- prefix affinity
+def block_hashes(tokens, block_size):
+    """Chained hashes of the prompt's FULL blocks — the cluster-wide key
+    for one engine page (docs/DECODE.md page granularity).  Hash i covers
+    tokens[0 : (i+1)*block_size] via chaining, so equal hash means equal
+    whole prefix, not merely an equal chunk — exactly the radix-tree path
+    identity, without shipping token lists around the cluster."""
+    out = []
+    h = hashlib.sha256()
+    bs = int(block_size)
+    for bi in range(len(tokens) // bs):
+        chunk = tokens[bi * bs:(bi + 1) * bs]
+        h.update((",".join(str(int(t)) for t in chunk) + ";").encode())
+        out.append(h.hexdigest()[:24])
+    return out
+
+
+class ClusterPrefixIndex:
+    """host-side map: block hash -> replicas believed to hold that page.
+
+    The router records optimistically at ROUTE time (the replica it picks
+    will insert those pages into its radix tree when prefill commits) and
+    drops a replica's entries wholesale on death/drain — a dead replica's
+    pages are gone, and stale affinity would keep routing hot prompts at a
+    corpse.  `best_replica` returns the replica covering the LONGEST
+    prefix of the prompt's hash chain, with the depth, so the caller can
+    weigh affinity against load."""
+
+    def __init__(self, block_size):
+        self.block_size = int(block_size)
+        self._by_hash: dict[str, set] = {}
+        self._ranks: dict[int, set] = {}  # rank -> its hashes (for drops)
+
+    def record(self, rank, tokens):
+        for hx in block_hashes(tokens, self.block_size):
+            self._by_hash.setdefault(hx, set()).add(rank)
+            self._ranks.setdefault(rank, set()).add(hx)
+
+    def drop_rank(self, rank):
+        for hx in self._ranks.pop(rank, ()):  # noqa: B905
+            holders = self._by_hash.get(hx)
+            if holders is not None:
+                holders.discard(rank)
+                if not holders:
+                    del self._by_hash[hx]
+
+    def best_replica(self, tokens, among=None):
+        """(rank, depth) of the replica holding the longest cached hash
+        chain of `tokens` (depth = matched full blocks), or (None, 0).
+        `among` restricts candidates (the live replica set)."""
+        depth_by_rank: dict[int, int] = {}
+        for i, hx in enumerate(block_hashes(tokens, self.block_size)):
+            holders = self._by_hash.get(hx)
+            if not holders:
+                break
+            for r in holders:
+                if among is None or r in among:
+                    # chained hashes: holding hash i implies the whole
+                    # prefix, so depth is simply the deepest level seen
+                    depth_by_rank[r] = i + 1
+        if not depth_by_rank:
+            return None, 0
+        best = max(depth_by_rank.items(), key=lambda kv: (kv[1], -kv[0]))
+        return best[0], best[1]
+
+
+# ------------------------------------------------------------- durable log
+class IntakeLog:
+    """Append-only fsynced JSONL journal of accepted requests and their
+    deliveries — the router's source of truth across its OWN death.
+
+    Records: {"ev": "submit", rid, prompt, opts, nonce}
+             {"ev": "tokens", rid, start, toks}
+             {"ev": "done", rid, n}
+    A SUBMIT is fsynced before the router acknowledges or dispatches it
+    (an accepted request must survive anything); token/done records ride
+    the same fsync discipline so a restarted router re-serves COMPLETED
+    streams from the log instead of re-running them.  Replay tolerates a
+    torn final line (a kill mid-append) by discarding it — the same
+    "prior state always recoverable" stance as the snapshot commit."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def append(self, record: dict):
+        self._f.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self):
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def replay(path):
+        """All intact records, in order; a torn trailing line (kill
+        mid-append) is dropped, a torn INTERIOR line fails loudly —
+        that is corruption, not a crash artifact."""
+        out = []
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().split("\n")
+        except FileNotFoundError:
+            return out
+        for i, line in enumerate(lines):
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                if i == len(lines) - 1 or all(
+                        not later for later in lines[i + 1:]):
+                    break  # torn tail: the append the kill interrupted
+                raise ValueError(
+                    f"intake log {path!r} corrupt at line {i + 1} "
+                    "(non-trailing unparseable record)")
+        return out
+
+
+# -------------------------------------------------------- failure detection
+class FailureDetector:
+    """Miss-threshold heartbeat detector over monotonically increasing
+    per-replica counters (replicas bump a TCPStore key; SIGKILL stops the
+    bumps).  One `observe(rank, counter)` per router poll; `dead_ranks()`
+    names replicas whose counter has not advanced for `miss_threshold`
+    heartbeat periods.  A `clock` injection point keeps the unit tests
+    off the wall clock."""
+
+    def __init__(self, heartbeat_ms, miss_threshold, clock=time.monotonic,
+                 on_miss=None, boot_grace_s=None):
+        """boot_grace_s: how long a tracked rank may go WITHOUT ITS FIRST
+        heartbeat before it counts as dead (default: the larger of the
+        miss budget and 30s).  A fresh worker pays interpreter + jax
+        import + first compiles before its heartbeat thread's first bump
+        reaches the store; judging that boot window by the steady-state
+        miss budget declares healthy replicas dead at spawn and melts the
+        cluster into a respawn loop (observed, not hypothetical)."""
+        self.heartbeat_s = heartbeat_ms / 1000.0
+        self.miss_threshold = int(miss_threshold)
+        self.boot_grace_s = (boot_grace_s if boot_grace_s is not None
+                             else max(self.heartbeat_s
+                                      * self.miss_threshold, 30.0))
+        self._clock = clock
+        self._on_miss = on_miss  # callback(n_new_misses) -> telemetry
+        # rank -> [counter, t_advance, misses_reported, ever_beat]
+        self._state: dict = {}
+
+    def track(self, rank):
+        self._state.setdefault(rank, [-1, self._clock(), 0, False])
+
+    def forget(self, rank):
+        self._state.pop(rank, None)
+
+    def observe(self, rank, counter):
+        st = self._state.setdefault(rank, [-1, self._clock(), 0, False])
+        if counter > st[0]:
+            booted = st[0] >= 0  # the -1 -> 0 step is key creation, not a beat
+            st[0], st[1], st[2] = counter, self._clock(), 0
+            st[3] = st[3] or booted
+
+    def misses(self, rank):
+        st = self._state.get(rank)
+        if st is None or not st[3]:
+            return 0
+        return int((self._clock() - st[1]) / self.heartbeat_s)
+
+    def dead_ranks(self):
+        """Ranks past the miss threshold (or past the boot grace without
+        a first heartbeat).  New misses since the last call are reported
+        through `on_miss` exactly once each, so the heartbeats_missed
+        counter is a true count, not a poll rate."""
+        dead = []
+        for rank, st in self._state.items():
+            if not st[3]:
+                if self._clock() - st[1] >= self.boot_grace_s:
+                    dead.append(rank)
+                continue  # boot window: no miss accounting yet
+            missed = int((self._clock() - st[1]) / self.heartbeat_s)
+            if missed > st[2] and self._on_miss is not None:
+                self._on_miss(missed - st[2])
+            st[2] = max(st[2], missed)
+            if missed >= self.miss_threshold:
+                dead.append(rank)
+        return dead
+
+
+# ------------------------------------------------------------- the router
+class _Req:
+    __slots__ = ("rid", "prompt", "opts", "nonce", "owner", "tokens",
+                 "done", "shipped")
+
+    def __init__(self, rid, prompt, opts, nonce):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.opts = dict(opts)
+        self.nonce = int(nonce)
+        self.owner = None       # replica rank currently serving it
+        self.tokens: list = []  # canonical delivered stream
+        self.done = False
+        self.shipped = False    # routed through a prefill worker
+
+
+class RequestRouter:
+    """The router's decision core: request identity, replica selection,
+    canonical stream assembly with per-position dedup, and the
+    re-dispatch set on replica death or drain.  Transport-free (cluster.py
+    owns rings/processes); durable through `IntakeLog`.
+
+    Idempotent ids: a rid resubmitted while known (an at-least-once
+    client, or an intake-log replay) is NOT a new request — it keeps its
+    original nonce, so its sampled stream is pinned at first acceptance.
+
+    Bit-exact fail-over rests on two facts this class enforces: (a) the
+    (seed, nonce) pair is request identity — assigned here once, carried
+    to whichever replica serves the request, so a re-dispatched stream is
+    THE stream; (b) re-emitted prefixes (intake-log replay from scratch,
+    or a snapshot-restored replica re-walking from its boundary) merge by
+    position and must MATCH the canonical tokens — divergence raises
+    instead of silently corrupting a client stream."""
+
+    def __init__(self, block_size, log_path=None):
+        self.index = ClusterPrefixIndex(block_size)
+        self.log = IntakeLog(log_path) if log_path else None
+        self._reqs: dict = {}
+        self._nonce = 0
+        self._outstanding: dict[int, set] = {}  # rank -> open rids
+
+    # ------------------------------------------------------------ lifecycle
+    def add_replica(self, rank):
+        self._outstanding.setdefault(rank, set())
+
+    def replicas(self):
+        return sorted(self._outstanding)
+
+    def load(self, rank):
+        return len(self._outstanding.get(rank, ()))
+
+    # ------------------------------------------------------------- intake
+    def submit(self, rid, prompt, **opts):
+        """Accept a request: assign its nonce (idempotently — a known rid
+        keeps its first), journal it durably, and return the _Req.  The
+        caller dispatches; acceptance is already crash-proof."""
+        req = self._reqs.get(rid)
+        if req is not None:
+            return req
+        req = _Req(rid, prompt, opts, self._nonce)
+        self._nonce += 1
+        self._reqs[rid] = req
+        if self.log is not None:
+            self.log.append({"ev": "submit", "rid": rid,
+                             "prompt": [int(t) for t in prompt],
+                             "opts": opts, "nonce": req.nonce})
+        return req
+
+    def restore(self, records):
+        """Rebuild router state from `IntakeLog.replay` records: completed
+        streams are final (never re-dispatched), partial streams keep
+        their delivered prefix as the dedup base, and the nonce counter
+        resumes PAST every logged nonce so post-restart submissions can
+        never collide with pre-crash identities."""
+        for rec in records:
+            if rec["ev"] == "submit":
+                req = _Req(rec["rid"], rec["prompt"], rec.get("opts", {}),
+                           rec["nonce"])
+                self._reqs[rec["rid"]] = req
+                self._nonce = max(self._nonce, req.nonce + 1)
+            elif rec["ev"] == "tokens":
+                req = self._reqs.get(rec["rid"])
+                if req is not None:
+                    self._merge(req, rec["start"], rec["toks"], log=False)
+            elif rec["ev"] == "done":
+                req = self._reqs.get(rec["rid"])
+                if req is not None:
+                    req.done = True
+
+    # ------------------------------------------------------------- routing
+    def pick_replica(self, prompt, among=None):
+        """Prefix affinity first (the replica already holding the longest
+        cached page chain of this prompt), least-outstanding as the
+        tie-break and the cold-prompt default."""
+        live = sorted(among if among is not None else self._outstanding)
+        if not live:
+            raise RuntimeError("no live replicas to route to")
+        rank, depth = self.index.best_replica(prompt, among=set(live))
+        if rank is not None and depth > 0:
+            return rank
+        return min(live, key=lambda r: (self.load(r), r))
+
+    def assign(self, rid, rank, shipped=False):
+        req = self._reqs[rid]
+        req.owner = rank
+        req.shipped = shipped
+        self._outstanding.setdefault(rank, set()).add(rid)
+        self.index.record(rank, req.prompt)
+
+    def unassign(self, rid):
+        """Release a request whose dispatch could not be DELIVERED (ring
+        backpressure): owner cleared, it returns to the unassigned
+        backlog for a later dispatch.  Distinct from replica death — the
+        replica is fine, the message never reached its ring."""
+        req = self._reqs.get(rid)
+        if req is None or req.owner is None:
+            return
+        self._outstanding.get(req.owner, set()).discard(rid)
+        req.owner = None
+
+    # ------------------------------------------------------------- delivery
+    def _merge(self, req, start, toks, log=True):
+        """Merge a token run at absolute position `start`; the overlap
+        with already-delivered tokens must match bit-for-bit (re-emission
+        after fail-over is expected, divergence is corruption).  Returns
+        the NEWLY appended tokens."""
+        toks = [int(t) for t in toks]
+        have = len(req.tokens)
+        if start > have:
+            raise RuntimeError(
+                f"request {req.rid!r}: token run starts at {start} but "
+                f"only {have} delivered — a gap means a lost event, "
+                "which the ring transport cannot produce")
+        overlap = req.tokens[start:start + len(toks)]
+        if overlap != toks[:len(overlap)]:
+            raise RuntimeError(
+                f"request {req.rid!r}: re-emitted tokens diverge from the "
+                f"delivered stream at position {start} "
+                f"({overlap[:8]} vs {toks[:8]}) — fail-over must be "
+                "bit-exact (docs/SERVING_CLUSTER.md)")
+        new = toks[len(overlap):]
+        if new:
+            req.tokens.extend(new)
+            if log and self.log is not None:
+                self.log.append({"ev": "tokens", "rid": req.rid,
+                                 "start": have, "toks": new})
+        return new
+
+    def on_tokens(self, rid, start, toks):
+        req = self._reqs.get(rid)
+        if req is None or req.done:
+            return []  # late echo from a lame duck after completion
+        return self._merge(req, start, toks)
+
+    def on_done(self, rid, total):
+        req = self._reqs.get(rid)
+        if req is None:
+            return
+        if len(req.tokens) != total:
+            raise RuntimeError(
+                f"request {rid!r}: replica reports {total} tokens done, "
+                f"router delivered {len(req.tokens)}")
+        first_done = not req.done
+        req.done = True
+        if req.owner is not None:
+            self._outstanding.get(req.owner, set()).discard(rid)
+        if first_done and self.log is not None:
+            self.log.append({"ev": "done", "rid": rid, "n": total})
+
+    # ------------------------------------------------------------ fail-over
+    def on_replica_dead(self, rank):
+        """A replica failed (heartbeat threshold or process death): drop
+        its prefix-index entries and return the accepted-but-unfinished
+        rids it owned — the re-dispatch set.  Completed requests are
+        final in the log and never move."""
+        self.index.drop_rank(rank)
+        orphans = sorted(self._outstanding.pop(rank, set()))
+        out = []
+        for rid in orphans:
+            req = self._reqs[rid]
+            if not req.done:
+                req.owner = None
+                out.append(rid)
+        return out
+
+    def on_drained(self, rank, queued_rids):
+        """Graceful scale-down: the drained replica keeps serving its
+        RESIDENTS to completion (their events still merge), but its
+        queued (never-started) requests come home for re-dispatch, and
+        its pages leave the prefix index (the process is exiting)."""
+        self.index.drop_rank(rank)
+        out = []
+        for rid in queued_rids:
+            req = self._reqs.get(rid)
+            if req is not None and not req.done:
+                self._outstanding.get(rank, set()).discard(rid)
+                req.owner = None
+                out.append(rid)
+        return out
+
+    # -------------------------------------------------------------- queries
+    def request(self, rid):
+        return self._reqs.get(rid)
+
+    def result(self, rid):
+        req = self._reqs.get(rid)
+        if req is None or not req.done:
+            return None
+        return list(req.tokens)
+
+    def unfinished(self):
+        return sorted(r.rid for r in self._reqs.values() if not r.done)
+
+    def unassigned(self):
+        return sorted(r.rid for r in self._reqs.values()
+                      if not r.done and r.owner is None)

@@ -1,0 +1,37 @@
+"""Shared model factory for the serving-cluster tests and bench.
+
+NOT a test module (no test_ prefix): cluster worker PROCESSES import this
+file by PATH (`EngineCluster(model_spec="<this file>:make_model")`), so
+every process in a cluster — router, decode replicas, prefill workers,
+and the in-test reference engine — builds the SAME deterministically
+seeded tiny llama.  Weights never ride the wire; identical construction
+is the cluster's weight-distribution story at test scale (production
+weights ride the training checkpoint tier)."""
+
+
+def make_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(41)
+    cfg = llama_tiny(vocab_size=128, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=4, max_position_embeddings=64,
+                     dtype="float32")
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def make_model_bf16():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(43)
+    cfg = llama_tiny(vocab_size=128, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=4, max_position_embeddings=64,
+                     dtype="bfloat16")
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m

@@ -1,0 +1,42 @@
+"""CI smoke for benchmarks/bench_cluster.py — the CPU-falsifiable twin of
+the cluster throughput + fail-over latency claims (the standing
+tunnel-down constraint: every perf claim must stay checkable offline).
+
+Runs the bench in --smoke mode as a subprocess (it forks and SIGKILLs
+real cluster processes, which is also why this module rides a DEDICATED
+tools/run_tier1.py isolated worker) and asserts the payload contract the
+regression gate consumes: zero lost requests, bit-matching fail-over
+streams, positive fail-over latencies, and pages actually shipped."""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cluster_smoke_payload():
+    env = dict(os.environ, PADDLE_TPU_BENCH_SMOKE="1",
+               PADDLE_TPU_BENCH_CPU="1", JAX_PLATFORMS="cpu")
+    env.setdefault("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benchmarks",
+                                      "bench_cluster.py"), "--smoke"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=_REPO)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
+    line = [ln for ln in r.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    payload = json.loads(line)
+    assert payload["metric"] == "cluster_tokens_per_sec"
+    assert payload["value"] > 0
+    assert payload["tokens_match"] is True
+    fo = payload["detail"]["failover"]
+    # the acceptance criteria the bench gates on: a SIGKILLed replica
+    # loses ZERO accepted requests and the recovered streams are the
+    # unkilled run's bit for bit
+    assert fo["lost"] == 0
+    assert fo["streams_match"] is True
+    assert fo["detect_ms"] > 0 and fo["recover_ms"] >= fo["detect_ms"]
+    assert payload["detail"]["ship"]["pages"] >= 1
+    assert payload["detail"]["ship"]["bytes"] > 0

@@ -97,6 +97,47 @@ def _snap_payload(save_ms=30.0, restore_ms=60.0):
     }
 
 
+def _cluster_payload(detect_ms=40.0, recover_ms=400.0, value=900.0):
+    return {
+        "metric": "cluster_tokens_per_sec", "value": value,
+        "unit": "tok/s", "tokens_match": True,
+        "detail": {"failover": {
+            "detect_ms": detect_ms, "recover_ms": recover_ms,
+            "lost": 0, "streams_match": True, "redispatches": 2,
+        }},
+    }
+
+
+def test_cluster_failover_gate(tmp_path):
+    """Cluster fail-over wiring (bench_cluster.py): detect/recover walls
+    gate lower-is-better at the SLO threshold; pre-cluster payloads skip
+    silently; the two latencies gate independently of the throughput
+    headline."""
+    old = _w(tmp_path, "c_old.json", _cluster_payload())
+    same = _w(tmp_path, "c_same.json", _cluster_payload())
+    assert main([old, same]) == 0
+    slow_detect = _w(tmp_path, "c_sd.json", _cluster_payload(detect_ms=200.0))
+    assert main([old, slow_detect]) == 1     # detection 5x slower: gates
+    assert main([old, slow_detect, "--slo-threshold", "9.0"]) == 0
+    assert main([slow_detect, old]) == 0     # improvement never gates
+    slow_recover = _w(tmp_path, "c_sr.json",
+                      _cluster_payload(recover_ms=2500.0))
+    assert main([old, slow_recover]) == 1    # recovery gates independently
+    # throughput regression still caught by the headline metric gate
+    slow_tps = _w(tmp_path, "c_tps.json", _cluster_payload(value=400.0))
+    assert main([old, slow_tps]) == 1
+    # pre-cluster payloads on either side skip the fail-over gate
+    pre = _w(tmp_path, "c_pre.json",
+             {"metric": "cluster_tokens_per_sec", "value": 900.0})
+    assert main([pre, slow_detect]) == 0
+    assert main([slow_detect, pre]) == 0
+    # a run that LOST a request records rc != 0: skipped as unhealthy,
+    # never used as a baseline that would mask the next regression
+    lost = _w(tmp_path, "c_lost.json",
+              {"rc": 1, "tail": json.dumps(_cluster_payload())})
+    assert main([lost, same]) == 0
+
+
 def test_snapshot_timing_gate(tmp_path):
     """Engine-snapshot wiring (serving fault tolerance): save/restore
     wall gates lower-is-better at the SLO threshold; pre-snapshot

@@ -325,6 +325,44 @@ def test_drain_closes_admissions_and_hands_off(tmp_path):
     _drain(eng2)
 
 
+def test_drain_empty_engine_and_double_drain_idempotent(tmp_path):
+    """drain() edge cases the headline test leaves uncovered: an EMPTY
+    engine drains cleanly (the snapshot is still a valid handoff — empty
+    clusters scale down too), a second drain() returns the SAME committed
+    handoff step without writing another snapshot (an orchestrator
+    retrying a timed-out drain must not hand the restore target a
+    different state per retry), and the drained engine refuses
+    add_request with the documented error either way."""
+    m = _model()
+    eng = GenerationEngine(m, max_batch=2, block_size=8, num_blocks=16,
+                           decode_chunk=2)
+    # idle drain: nothing resident, nothing queued
+    step = eng.drain(str(tmp_path))
+    store = EngineSnapshot(str(tmp_path))
+    assert store.latest_step() == step
+    committed = store.all_steps()
+    # double-drain: same step, no new commit, drains counted once more at
+    # most — the handoff state is immutable once taken
+    reset_snapshot_stats()
+    assert eng.drain(str(tmp_path)) == step
+    assert store.all_steps() == committed
+    assert snapshot_stats()["saves"] == 0  # idempotent: no re-snapshot
+    # ...but only for the SAME directory: a step tag that exists nowhere
+    # under the new dir must never be handed to an orchestrator
+    with pytest.raises(ValueError, match="already drained"):
+        eng.drain(str(tmp_path / "elsewhere"))
+    with pytest.raises(RuntimeError, match="draining"):
+        eng.add_request("late", P2, max_new_tokens=3)
+    assert not eng.has_work()
+    assert eng.step() == {}  # lame-duck stepping an empty engine is fine
+    # the handoff restores to a fully OPEN empty engine
+    eng2 = EngineSnapshot(str(tmp_path)).restore(m, step=step)
+    assert eng2.pending_requests() == []
+    eng2.add_request("fresh", P1, max_new_tokens=3)
+    _drain(eng2)
+    assert isinstance(eng2.result("fresh"), list)
+
+
 def test_sigterm_preemption_snapshots_at_boundary(tmp_path):
     """The SIGTERM mirror of CheckpointManager's flag-flip design: the
     handler only flips a flag; the NEXT macro-step boundary writes the

@@ -61,6 +61,70 @@ class TestTCPStore:
         finally:
             srv.stop()
 
+    def test_wait_shares_one_deadline_across_keys(self):
+        # Regression: wait() used to give EACH key a fresh timeout_ms, so
+        # keys trickling in slower than the shared budget but faster than
+        # a per-key budget let the total wait reach len(keys) x timeout_ms
+        # without ever raising.  One shared deadline must time out here.
+        import time
+
+        srv = _native.TCPStoreServer()
+        try:
+            cli = _native.TCPStoreClient(port=srv.port)
+
+            def setter():
+                c2 = _native.TCPStoreClient(port=srv.port)
+                for i in range(3):
+                    time.sleep(0.4)
+                    c2.set(f"w{i}", b"v")
+                c2.close()
+
+            t = threading.Thread(target=setter)
+            t.start()
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                cli.wait(["w0", "w1", "w2"], timeout_ms=700)
+            elapsed = time.monotonic() - t0
+            t.join()
+            # the old per-key loop would have waited ~1.2s and RETURNED;
+            # the shared deadline stops near 0.7s
+            assert elapsed < 1.15, elapsed
+            # and a wait whose keys are all present returns immediately
+            cli.wait(["w0", "w1", "w2"], timeout_ms=700)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_client_connects_before_server_starts(self):
+        # Startup race: under load a worker's client routinely outraces
+        # the server's bind — the constructor must retry with backoff
+        # until its deadline instead of failing on the first refusal.
+        import socket
+        import time
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        holder = {}
+
+        def late_server():
+            time.sleep(0.4)
+            holder["srv"] = _native.TCPStoreServer(port)
+
+        t = threading.Thread(target=late_server)
+        t.start()
+        try:
+            cli = _native.TCPStoreClient(port=port, timeout_ms=10_000)
+            cli.set("raced", b"ok")
+            assert cli.get("raced") == b"ok"
+            cli.close()
+        finally:
+            t.join()
+            holder["srv"].stop()
+        # a server that never comes up still fails, at the deadline
+        with pytest.raises(ConnectionError):
+            _native.TCPStoreClient(port=port, timeout_ms=300)
+
     def test_rendezvous_barrier_pattern(self):
         # the init_parallel_env bootstrap pattern: ranks add() then wait
         srv = _native.TCPStoreServer()
@@ -142,6 +206,37 @@ class TestShmRing:
         finally:
             ring.close()
             ring.destroy()
+
+    def test_attach_before_create_retries_until_deadline(self):
+        # the ring-consumer half of the startup race: attach with a
+        # deadline retries until the producer's create lands
+        import time
+
+        name = f"/pt_test_{os.getpid()}_late"
+        holder = {}
+
+        def late_create():
+            time.sleep(0.3)
+            holder["ring"] = _native.ShmRing(name, 1 << 16)
+
+        t = threading.Thread(target=late_create)
+        t.start()
+        try:
+            reader = _native.ShmRing(name, create=False,
+                                     attach_timeout_ms=5_000)
+            holder["ring"].push(b"raced")
+            assert reader.pop(timeout_ms=1000) == b"raced"
+        finally:
+            t.join()
+            holder["ring"].close()
+            holder["ring"].destroy()
+        # attach_timeout_ms=0 keeps the historical fail-fast contract
+        with pytest.raises(OSError):
+            _native.ShmRing(f"/pt_never_{os.getpid()}", create=False)
+        # and a producer that never creates still fails, at the deadline
+        with pytest.raises(OSError):
+            _native.ShmRing(f"/pt_never_{os.getpid()}", create=False,
+                            attach_timeout_ms=200)
 
     def test_cross_process(self):
         name = f"/pt_test_{os.getpid()}_d"

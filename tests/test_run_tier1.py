@@ -82,6 +82,13 @@ def test_build_plan_isolates_collective_modules():
     # round-robin shards
     for mod in ("test_engine_snapshot.py", "test_engine_snapshot_crash.py"):
         assert mod in rest_files, mod
+    # the serving-CLUSTER modules fork and SIGKILL real router/replica
+    # processes (heartbeat fail-over, drain migration, the cluster crash
+    # matrix, the fail-over bench): DEDICATED isolated workers, never
+    # round-robin, never slow-marked
+    for mod in ("test_serving_cluster.py", "test_serving_cluster_crash.py",
+                "test_bench_cluster.py"):
+        assert mod in iso_names, mod
 
 
 # -------------------------------------------------------- crash isolation

@@ -1,0 +1,487 @@
+"""Disaggregated serving cluster (serving/cluster.py + serving/router.py,
+docs/SERVING_CLUSTER.md, ROADMAP item 2).
+
+Two tiers:
+
+- **Unit tier** (no processes): every robustness decision is a plain host
+  state machine in serving/router.py — chained block hashes, the cluster
+  prefix index, the durable intake log (torn-tail tolerance), the
+  miss-threshold failure detector (fake clock), retry_backoff deadlines,
+  and the RequestRouter's per-position dedup/merge + re-dispatch sets.
+  Plus the engine-side cluster surface: explicit submit-time nonces and
+  pool-native page adoption (`adopt_pages` + `pool_get_blocks`).
+- **E2E tier** (REAL OS processes over TCPStore + ShmRing): a live
+  cluster serves greedy + sampled streams bit-identical to one local
+  engine, ships prefill pages with prefix-affinity routing, and
+  drain-migrates queued requests on scale-down with no double-serving.
+
+The SIGKILL crash matrix lives in test_serving_cluster_crash.py.  Both
+modules fork and kill processes, so they ride DEDICATED
+tools/run_tier1.py isolated workers — never the shared shard."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import GenerationEngine
+from paddle_tpu.serving.router import (ClusterPrefixIndex, FailureDetector,
+                                       IntakeLog, RequestRouter,
+                                       block_hashes, retry_backoff)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_MODEL_SPEC = os.path.join(_HERE, "cluster_common.py") + ":make_model"
+
+from tests.cluster_common import make_model, make_model_bf16  # noqa: E402
+
+_EKW = dict(max_batch=2, block_size=8, num_blocks=32, decode_chunk=2)
+
+# two prompts sharing one full 8-token block (the shipped/affinity unit)
+# plus distinct tails, and one short sampled prompt with no full block
+_SHARED = [5, 9, 17, 33, 2, 8, 7, 4]
+P_G1 = _SHARED + [22, 3]
+P_G2 = _SHARED + [9, 1]
+P_S1 = [7, 11, 3]
+
+
+# ---------------------------------------------------------------- unit tier
+def test_block_hashes_are_chained_prefix_identity():
+    bs = 4
+    a = block_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], bs)
+    assert len(a) == 2  # the partial third block never hashes
+    b = block_hashes([1, 2, 3, 4, 5, 6, 7, 8], bs)
+    assert a == b[:2] and len(b) == 2
+    # a change in block 0 changes EVERY later hash (chaining): equal hash
+    # at depth i must mean equal whole prefix, not equal chunk
+    c = block_hashes([9, 2, 3, 4, 5, 6, 7, 8], bs)
+    assert c[0] != a[0] and c[1] != a[1]
+    # same chunk content at a different depth hashes differently
+    d = block_hashes([5, 6, 7, 8], bs)
+    assert d[0] != a[1]
+
+
+def test_prefix_index_affinity_and_drop():
+    idx = ClusterPrefixIndex(block_size=4)
+    idx.record(0, [1, 2, 3, 4, 5, 6, 7, 8])
+    idx.record(1, [1, 2, 3, 4])
+    rank, depth = idx.best_replica([1, 2, 3, 4, 5, 6, 7, 8, 9])
+    assert (rank, depth) == (0, 2)  # deepest holder wins
+    rank, depth = idx.best_replica([1, 2, 3, 4, 99, 98, 97, 96])
+    assert depth == 1 and rank in (0, 1)
+    assert idx.best_replica([9, 9, 9, 9]) == (None, 0)
+    # `among` restricts to live replicas; a dead rank's pages drop wholesale
+    rank, depth = idx.best_replica([1, 2, 3, 4, 5, 6, 7, 8], among={1})
+    assert (rank, depth) == (1, 1)
+    idx.drop_rank(0)
+    rank, depth = idx.best_replica([1, 2, 3, 4, 5, 6, 7, 8])
+    assert (rank, depth) == (1, 1)
+
+
+def test_intake_log_replay_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "intake.jsonl")
+    log = IntakeLog(path)
+    log.append({"ev": "submit", "rid": "a", "prompt": [1, 2], "opts": {},
+                "nonce": 0})
+    log.append({"ev": "tokens", "rid": "a", "start": 0, "toks": [7, 8]})
+    log.close()
+    # a SIGKILL mid-append leaves a torn trailing line: replay drops it
+    with open(path, "a") as f:
+        f.write('{"ev": "tok')
+    recs = IntakeLog.replay(path)
+    assert [r["ev"] for r in recs] == ["submit", "tokens"]
+    # an INTERIOR torn line is corruption, not a crash artifact: loud
+    with open(path, "w") as f:
+        f.write('{"ev": "submit"}\n{"torn\n{"ev": "done"}\n')
+    with pytest.raises(ValueError, match="corrupt"):
+        IntakeLog.replay(path)
+    assert IntakeLog.replay(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_retry_backoff_shared_deadline_and_counting():
+    import random
+
+    calls = {"n": 0}
+    retries = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise TimeoutError("transient")
+        return "ok"
+
+    assert retry_backoff(flaky, timeout_s=5.0, base_s=0.001,
+                         rng=random.Random(0),
+                         on_retry=retries.append) == "ok"
+    assert calls["n"] == 4 and len(retries) == 3
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        retry_backoff(lambda: (_ for _ in ()).throw(TimeoutError("x")),
+                      timeout_s=0.25, base_s=0.01, cap_s=0.05,
+                      rng=random.Random(0))
+    assert time.monotonic() - t0 < 1.0  # ONE deadline, not per-attempt
+    # non-retryable errors propagate immediately
+    with pytest.raises(ValueError):
+        retry_backoff(lambda: (_ for _ in ()).throw(ValueError("real")),
+                      timeout_s=5.0)
+
+
+def test_failure_detector_miss_threshold_and_boot_grace():
+    clock = {"t": 0.0}
+    missed = []
+    det = FailureDetector(100, 3, clock=lambda: clock["t"],
+                          on_miss=missed.append, boot_grace_s=5.0)
+    det.track("r0")
+    # boot window: the counter sits at its creation value (0) while the
+    # worker imports jax — NOT dead until the boot grace, and no miss
+    # telemetry noise from a normal boot
+    clock["t"] = 0.4
+    det.observe("r0", 0)
+    assert det.dead_ranks() == [] and missed == []
+    clock["t"] = 1.0
+    det.observe("r0", 1)  # first real heartbeat: steady-state rules arm
+    assert det.misses("r0") == 0
+    clock["t"] = 1.25
+    det.observe("r0", 1)
+    assert det.dead_ranks() == []  # 2 misses < 3
+    assert missed == [2]
+    clock["t"] = 1.31
+    assert det.dead_ranks() == ["r0"]  # 3rd missed period
+    assert sum(missed) == 3  # each missed period reported exactly once
+    # a beat resets the window
+    det.observe("r0", 2)
+    assert det.dead_ranks() == [] and det.misses("r0") == 0
+    # a rank that NEVER beats dies at the boot grace
+    det.track("r1")
+    clock["t"] = 6.5
+    assert "r1" in det.dead_ranks()
+    det.forget("r1")
+    assert "r1" not in det.dead_ranks()
+
+
+def test_request_router_dedup_merge_and_redispatch(tmp_path):
+    r = RequestRouter(block_size=4, log_path=str(tmp_path / "log.jsonl"))
+    r.add_replica(0)
+    r.add_replica(1)
+    req = r.submit("a", [1, 2, 3, 4, 5], max_new=4, temperature=0.0, seed=0)
+    assert req.nonce == 0
+    # idempotent acceptance: a resubmitted rid keeps its first nonce
+    assert r.submit("a", [1, 2, 3, 4, 5]).nonce == 0
+    assert r.submit("b", [9, 9]).nonce == 1
+    r.assign("a", 0)
+    r.assign("b", 0)
+    assert r.load(0) == 2
+    assert r.on_tokens("a", 0, [10, 11]) == [10, 11]
+    # re-emission after fail-over: overlap dedups, the tail appends
+    assert r.on_tokens("a", 0, [10, 11, 12]) == [12]
+    # divergence is corruption, never silently merged
+    with pytest.raises(RuntimeError, match="diverge"):
+        r.on_tokens("a", 1, [99])
+    # a gap means a lost event: loud
+    with pytest.raises(RuntimeError, match="gap|starts at"):
+        r.on_tokens("b", 3, [1])
+    # replica death: unfinished owned rids come back for re-dispatch
+    r.on_tokens("b", 0, [20])
+    r.on_done("b", 1)
+    assert r.result("b") == [20]
+    assert r.on_replica_dead(0) == ["a"]  # done "b" never moves
+    assert r.unassigned() == ["a"]
+    # the journal rebuilds the same state in a fresh router
+    r2 = RequestRouter(block_size=4)
+    r2.restore(IntakeLog.replay(str(tmp_path / "log.jsonl")))
+    assert r2.result("b") == [20]
+    assert r2.request("a").tokens == [10, 11, 12]
+    assert r2.request("a").nonce == 0
+    assert r2.submit("c", [1]).nonce == 2  # counter resumes PAST the log
+    # drain: queued (never-started) rids migrate, residents stay
+    r2.add_replica(1)
+    r2.assign("a", 1)
+    r2.assign("c", 1)
+    assert r2.on_drained(1, ["c"]) == ["c"]
+    assert r2.request("a").owner == 1 and r2.request("c").owner is None
+
+
+def test_router_pick_replica_affinity_then_load():
+    r = RequestRouter(block_size=4)
+    for i in range(3):
+        r.add_replica(i)
+    p = [1, 2, 3, 4, 5, 6, 7, 8]
+    r.submit("a", p)
+    r.assign("a", 2)  # records the prompt's hashes for replica 2
+    assert r.pick_replica(p) == 2  # affinity beats emptier replicas
+    assert r.pick_replica([9, 9, 9, 9, 9]) in (0, 1)  # cold: least load
+    assert r.pick_replica(p, among={0, 1}) in (0, 1)  # dead excluded
+
+
+def test_explicit_nonce_reproduces_stream():
+    """The bit-exact fail-over keystone: (seed, nonce) is request
+    identity.  An engine given EXPLICIT nonces (the router's assignment)
+    draws exactly the streams another engine produced with its local
+    counter — submission order, engine instance, and admission timing
+    all drop out."""
+    m = make_model()
+    ref = GenerationEngine(m, **_EKW)
+    ref.add_request("x", P_S1, max_new_tokens=5, temperature=5.0, seed=3)
+    ref.add_request("y", P_S1, max_new_tokens=5, temperature=5.0, seed=3)
+    while ref.has_work():
+        ref.step()
+
+    eng = GenerationEngine(m, **_EKW)
+    # reversed submission order, explicit nonces pinned to the identity
+    eng.add_request("y", P_S1, max_new_tokens=5, temperature=5.0, seed=3,
+                    nonce=1)
+    eng.add_request("x", P_S1, max_new_tokens=5, temperature=5.0, seed=3,
+                    nonce=0)
+    while eng.has_work():
+        eng.step()
+    assert eng.result("x") == ref.result("x")
+    assert eng.result("y") == ref.result("y")
+    assert eng.result("x") != eng.result("y")  # distinct nonces still true
+    # the local counter advanced PAST the explicit nonces: no collision
+    assert eng._req_counter == 2
+
+
+def _prefill_pages_for(model, prompt, kv="bf16"):
+    from paddle_tpu.serving.cluster_worker import _prefill_pages
+
+    n = (len(prompt) - 1) // _EKW["block_size"]
+    return _prefill_pages(model, prompt, n, _EKW["block_size"], kv)
+
+
+def test_adopt_pages_prefix_hit_bit_exact():
+    """Shipped pages adopt as refcount-zero cached prefix pages, the next
+    admission prefix-hits them, and the served stream is BIT-identical to
+    a local-prefill engine (full-precision pools; the engine pours and
+    the prefill worker pours through the same math)."""
+    from paddle_tpu.serving import decode_stats, reset_decode_stats
+
+    m = make_model()
+    ref = GenerationEngine(m, prefix_cache=True, **_EKW)
+    ref.add_request("g", P_G1, max_new_tokens=6)
+    while ref.has_work():
+        ref.step()
+
+    eng = GenerationEngine(m, prefix_cache=True, **_EKW)
+    toks, k_layers, v_layers = _prefill_pages_for(m, P_G1)
+    assert eng.adopt_pages(toks, k_layers, v_layers) == 1
+    # adopted pages are resident-but-reclaimable (refcount 0), exactly
+    # like pages whose owning request finished
+    assert len(eng._prefix) == 1
+    reset_decode_stats()
+    eng.add_request("g", P_G1, max_new_tokens=6)
+    while eng.has_work():
+        eng.step()
+    st = decode_stats()
+    assert st["prefix_hits"] == 1 and st["prefix_hit_tokens"] == 8
+    assert eng.result("g") == ref.result("g")
+    # re-adoption of a cached prefix is a no-op, not a duplicate page
+    toks, k_layers, v_layers = _prefill_pages_for(m, P_G1)
+    assert eng.adopt_pages(toks, k_layers, v_layers) == 0
+
+
+def test_adopt_pages_int8_ship_deterministic_and_lossless():
+    """The two facts bit-exact fail-over rests on for int8 shipping:
+    (a) shipping is DETERMINISTIC — a re-dispatched request re-ships
+    byte-identical pages (same forward, same quantization), so the new
+    replica serves the same stream; (b) ship-then-place is LOSSLESS — the
+    wire carries the pool's own int8 payload + f32 scales and
+    `pool_set_blocks` lands them verbatim, never re-quantizing."""
+    m = make_model()
+    toks, k1, v1 = _prefill_pages_for(m, P_G1, kv="int8")
+    _t, k2, _v2 = _prefill_pages_for(m, P_G1, kv="int8")
+    for a, b in zip(k1, k2):  # (a): re-ship is bit-identical
+        np.testing.assert_array_equal(a["payload"], b["payload"])
+        np.testing.assert_array_equal(a["scale"], b["scale"])
+
+    eng = GenerationEngine(m, prefix_cache=True,
+                           **dict(_EKW, kv_cache_dtype="int8"))
+    assert eng.adopt_pages(toks, k1, v1) == 1
+    ab = eng._prefix.match(toks)[0]
+    for li in range(2):  # (b): adopted pool blocks == the shipped leaves
+        np.testing.assert_array_equal(
+            np.asarray(eng._kpools[li].data[ab]), k1[li]["payload"][0])
+        np.testing.assert_array_equal(
+            np.asarray(eng._kpools[li].scale[ab]), k1[li]["scale"][0])
+    # and an int8 admission over adopted pages serves a complete stream
+    eng.add_request("g", P_G1, max_new_tokens=4)
+    while eng.has_work():
+        eng.step()
+    assert len(eng.result("g")) == 4
+
+
+def test_int8_ship_halves_wire_bytes_vs_bf16():
+    m = make_model_bf16()
+    _t, k8, v8 = _prefill_pages_for(m, P_G1, kv="int8")
+    _t, kbf, vbf = _prefill_pages_for(m, P_G1, kv="bf16")
+
+    def nbytes(layers):
+        return sum(a.nbytes for lay in layers for a in lay.values())
+
+    ratio = (nbytes(k8) + nbytes(v8)) / (nbytes(kbf) + nbytes(vbf))
+    assert ratio < 0.6, ratio  # int8 payload halves bf16; scales ride along
+
+
+def test_adopt_pages_loud_on_bad_shapes_and_modes():
+    m = make_model()
+    eng = GenerationEngine(m, prefix_cache=False, **_EKW)
+    with pytest.raises(RuntimeError, match="prefix cache"):
+        eng.adopt_pages(P_G1, [], [])
+    eng = GenerationEngine(m, prefix_cache=True, **_EKW)
+    toks, k_layers, v_layers = _prefill_pages_for(m, P_G1)
+    with pytest.raises(ValueError, match="layers"):
+        eng.adopt_pages(toks, k_layers[:1], v_layers)
+    bad = [{k: v[:, :2] for k, v in lay.items()} for lay in k_layers]
+    with pytest.raises(ValueError, match="geometry"):
+        eng.adopt_pages(toks, bad, v_layers)
+    # pool-kind mismatch (bf16 pages into an int8 pool) is THIS error,
+    # not a KeyError deep in pool_set_blocks: the sender quantized for
+    # the wrong pool kind and a respawn-retry loop cannot fix that
+    eng8 = GenerationEngine(make_model(), prefix_cache=True,
+                            **dict(_EKW, kv_cache_dtype="int8"))
+    with pytest.raises(ValueError, match="kind|leaves"):
+        eng8.adopt_pages(toks, k_layers, v_layers)
+
+
+# ----------------------------------------------------------------- e2e tier
+def _mk_cluster(workdir, **kw):
+    from paddle_tpu.serving.cluster import EngineCluster
+
+    kw.setdefault("heartbeat_ms", 100)
+    kw.setdefault("miss_threshold", 20)
+    return EngineCluster(_MODEL_SPEC, engine_kwargs=_EKW,
+                         workdir=str(workdir), **kw)
+
+
+def _single_engine_reference(submissions, max_batch=4):
+    eng = GenerationEngine(make_model(),
+                           **dict(_EKW, max_batch=max_batch),
+                           prefix_cache=True)
+    for rid, prompt, opts in submissions:
+        eng.add_request(rid, prompt, **opts)
+    while eng.has_work():
+        eng.step()
+    return {rid: eng.result(rid) for rid, _p, _o in submissions}
+
+
+_WORKLOAD = [
+    ("g1", P_G1, dict(max_new_tokens=8)),
+    ("g2", P_G2, dict(max_new_tokens=8)),
+    ("s1", P_S1, dict(max_new_tokens=6, temperature=5.0, seed=3)),
+]
+
+
+def _cluster_e2e_matches_single_engine(tmp_path):
+    from paddle_tpu.serving.cluster import cluster_stats
+
+    ref = _single_engine_reference(_WORKLOAD)
+    c = _mk_cluster(tmp_path / "wd", num_replicas=2, num_prefill=1)
+    try:
+        for rid, prompt, opts in _WORKLOAD:
+            c.submit(rid, prompt,
+                     max_new_tokens=opts["max_new_tokens"],
+                     temperature=opts.get("temperature", 0.0),
+                     seed=opts.get("seed", 0))
+        c.serve(timeout_s=240)
+        got = {rid: c.result(rid) for rid, _p, _o in _WORKLOAD}
+        # full-precision pools: the shipped-page path reproduces the
+        # local engine's streams on this workload (the GUARANTEED
+        # contract — killed-vs-unkilled cluster bit-exactness — lives in
+        # test_serving_cluster_crash.py; this cross-architecture match is
+        # the stronger observed property for bf16/f32 pools)
+        assert got == ref, (got, ref)
+        # prefix affinity routed the shared-prefix pair to ONE replica
+        assert (c.router.request("g1").owner
+                == c.router.request("g2").owner)
+        st = cluster_stats()
+        assert st["replicas_alive"] == 2
+        assert st["pages_shipped"] >= 2 and st["ship_bytes"] > 0
+        assert st["redispatches"] == 0
+        # idempotent resubmission: no duplicate serve, stream unchanged
+        c.submit("g1", P_G1, max_new_tokens=8)
+        c.serve(timeout_s=30)
+        assert c.result("g1") == ref["g1"]
+    finally:
+        c.shutdown()
+
+
+def _cluster_drain_scale_down(tmp_path):
+    from paddle_tpu.serving.cluster import cluster_stats, \
+        reset_cluster_stats
+
+    # max_batch 1: the first request occupies replica 0's only slot, the
+    # same-prefix followers QUEUE on it (affinity routes them there)
+    ekw = dict(_EKW, max_batch=1)
+    # "a" is long on purpose: the drain must land while it is RESIDENT
+    # (so "b"/"c" are still queued on the worker and genuinely migrate)
+    subs = [("a", P_G1, dict(max_new_tokens=40)),
+            ("b", P_G2, dict(max_new_tokens=8)),
+            ("c", _SHARED + [1, 2], dict(max_new_tokens=8))]
+    ref = _single_engine_reference(subs, max_batch=1)
+
+    from paddle_tpu.serving.cluster import EngineCluster
+
+    reset_cluster_stats()
+    c = EngineCluster(_MODEL_SPEC, engine_kwargs=ekw,
+                      workdir=str(tmp_path / "wd"), num_replicas=2,
+                      heartbeat_ms=100, miss_threshold=20)
+    try:
+        for rid, prompt, opts in subs:
+            c.submit(rid, prompt, **{
+                "max_new_tokens": opts["max_new_tokens"]})
+        owner = c.router.request("a").owner
+        assert all(c.router.request(r).owner == owner for r in "abc")
+        # let replica `owner` admit "a" (first token delivered) so "b"/"c"
+        # are genuinely queued on the worker when the drain lands
+        deadline = time.monotonic() + 120
+        while not c.router.request("a").tokens:
+            c.poll()
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        c.scale_down(owner)
+        c.serve(timeout_s=240)
+        got = {rid: c.result(rid) for rid, _p, _o in subs}
+        assert got == ref, (got, ref)
+        st = cluster_stats()
+        # the queued pair migrated; the resident finished on the lame duck
+        assert st["drain_migrations"] == 2
+        assert st["replicas_alive"] == 1
+        survivors = {c.router.request(r).owner for r in ("b", "c")}
+        assert owner not in survivors
+    finally:
+        c.shutdown()
+
+
+def _cluster_telemetry_footer(tmp_path):
+    from paddle_tpu import profiler
+    from paddle_tpu.profiler.statistics import cluster_line
+
+    st = profiler.cluster_stats()
+    assert set(st) >= {"replicas_alive", "heartbeats_missed",
+                       "redispatches", "pages_shipped", "ship_retries",
+                       "drain_migrations"}
+    line = cluster_line(dict(st, replicas_alive=2, pages_shipped=3))
+    assert "Serving cluster:" in line and "pages_shipped=3" in line
+    assert cluster_line({k: 0 for k in st}) == ""
+    # reset zeroes traffic counters but keeps the alive gauge
+    before = profiler.cluster_stats()["replicas_alive"]
+    profiler.cluster_stats(reset=True)
+    after = profiler.cluster_stats()
+    assert after["replicas_alive"] == before
+    assert after["redispatches"] == 0
+
+
+# The e2e payloads fork real engine processes and kill them; each runs in
+# tier-1 through the dedicated isolated worker for this module, and the
+# pieces run as separate pytest cases for attribution.
+def test_cluster_e2e_matches_single_engine(tmp_path):
+    _cluster_e2e_matches_single_engine(tmp_path)
+
+
+def test_cluster_drain_scale_down_no_double_serve(tmp_path):
+    _cluster_drain_scale_down(tmp_path)
+
+
+def test_cluster_telemetry_schema_and_footer(tmp_path):
+    _cluster_telemetry_footer(tmp_path)

@@ -1,0 +1,187 @@
+"""Cluster SIGKILL crash-injection matrix (serving/cluster.py,
+docs/SERVING_CLUSTER.md; the serving-cluster extension of the
+test_engine_snapshot_crash.py matrix).
+
+A DRIVER subprocess runs a real cluster — router in the driver process, N
+decode replicas + a prefill worker as its own OS child processes — over
+the native TCPStore and ShmRing, serving a fixed greedy+sampled workload
+with KV-page shipping.  Crash injection SIGKILLs one enumerated
+participant at one enumerated protocol point:
+
+- a DECODE REPLICA after accepting a request, mid-stream (intake-log
+  replay fail-over), mid-stream with boundary snapshots armed
+  (EngineSnapshot restore fail-over), and right after adopting shipped
+  pages;
+- the PREFILL WORKER before and in the middle of a page shipment;
+- the ROUTER itself right after journaling an acceptance and mid-serving
+  (the driver process dies; a SECOND driver run over the same workdir
+  replays the durable intake log, sweeps the orphaned workers, and
+  finishes).
+
+Every completed run must produce streams BIT-IDENTICAL to the unkilled
+reference — zero accepted requests lost, no stream corrupted, no request
+served twice (the router's canonical per-position merge enforces all
+three).  This module forks and kills real processes: it rides a DEDICATED
+tools/run_tier1.py isolated worker, never the shared shard."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+_DRIVER = r"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+cache = os.environ.get("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from paddle_tpu.serving.cluster import EngineCluster
+
+(workdir, out_path, model_spec, router_kill, worker_role, worker_kill,
+ snapshot_interval) = sys.argv[1:8]
+
+worker_kill_map = {}
+if worker_kill:
+    worker_kill_map[(worker_role, 0)] = worker_kill
+
+EKW = dict(max_batch=2, block_size=8, num_blocks=32, decode_chunk=2)
+SHARED = [5, 9, 17, 33, 2, 8, 7, 4]
+WORKLOAD = [
+    ("g1", SHARED + [22, 3], dict(max_new_tokens=8)),
+    ("g2", SHARED + [9, 1], dict(max_new_tokens=8)),
+    ("s1", [7, 11, 3], dict(max_new_tokens=6, temperature=5.0, seed=3)),
+]
+
+c = EngineCluster(model_spec, num_replicas=2, num_prefill=1,
+                  engine_kwargs=EKW, workdir=workdir,
+                  heartbeat_ms=100, miss_threshold=10,
+                  snapshot_interval=int(snapshot_interval),
+                  kill=router_kill, worker_kill=worker_kill_map)
+try:
+    for rid, prompt, opts in WORKLOAD:
+        c.submit(rid, prompt, max_new_tokens=opts["max_new_tokens"],
+                 temperature=opts.get("temperature", 0.0),
+                 seed=opts.get("seed", 0))
+    c.serve(timeout_s=240)
+    with open(out_path, "w") as f:
+        json.dump({rid: c.result(rid) for rid, _p, _o in WORKLOAD}, f)
+    from paddle_tpu.serving.cluster import cluster_stats
+
+    print("STATS", json.dumps(cluster_stats()))
+    print("DONE")
+finally:
+    c.shutdown()
+"""
+
+_MODEL_SPEC = os.path.join(_HERE, "cluster_common.py") + ":make_model"
+
+
+def _run_driver(tmp_path, workdir, out, router_kill="", worker_role="",
+                worker_kill="", snapshot_interval=0):
+    script = tmp_path / "driver.py"
+    script.write_text(_DRIVER)
+    repo_root = os.path.dirname(_HERE)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo_root + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.setdefault("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
+    cmd = [sys.executable, str(script), str(workdir), str(out),
+           _MODEL_SPEC, router_kill, worker_role, worker_kill,
+           str(snapshot_interval)]
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                          env=env)
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """The unkilled cluster run: the streams every killed variant must
+    reproduce token for token."""
+    td = tmp_path_factory.mktemp("cluster_ref")
+    out = td / "ref.json"
+    r = _run_driver(td, td / "wd", out)
+    assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
+    return json.loads(out.read_text())
+
+
+# (who dies, at which protocol point, boundary snapshots armed?)
+_WORKER_MATRIX = [
+    ("decode", "decode-after-accept:1", 0),
+    ("decode", "decode-mid-stream:1", 0),   # intake-log replay fail-over
+    ("decode", "decode-mid-stream:2", 1),   # EngineSnapshot restore fail-over
+    ("decode", "decode-after-adopt:1", 0),  # dies holding shipped pages
+    ("prefill", "prefill-before-ship:1", 0),
+    ("prefill", "prefill-mid-ship:1", 0),   # partial shipment on the wire
+]
+
+
+@pytest.mark.parametrize("role,point,snap", _WORKER_MATRIX,
+                         ids=[p for _r, p, _s in _WORKER_MATRIX])
+def test_worker_kill_matrix_streams_bit_identical(tmp_path, reference,
+                                                  role, point, snap):
+    """SIGKILL one worker process at the named point: the router detects
+    the death (heartbeats/child-exit), re-dispatches every accepted-but-
+    unfinished request (replayed from the intake log, restored from the
+    dead replica's boundary snapshot, or re-shipped through a fresh
+    prefill worker), and the completed streams equal the unkilled run's
+    bit for bit."""
+    out = tmp_path / "out.json"
+    r = _run_driver(tmp_path, tmp_path / "wd", out, worker_role=role,
+                    worker_kill=point, snapshot_interval=snap)
+    assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
+    got = json.loads(out.read_text())
+    assert got == reference, (got, reference)
+    stats = json.loads(
+        [ln for ln in r.stdout.splitlines()
+         if ln.startswith("STATS ")][-1][len("STATS "):])
+    # the injected kill really happened: a replacement process spawned
+    assert stats["respawns"] >= 1, stats
+    if role == "decode" and not snap:
+        # replay fail-over: requests genuinely moved (the restore path
+        # instead CLAIMS them back via the replacement's resume report,
+        # so redispatches may legitimately stay 0 there)
+        assert stats["redispatches"] >= 1, stats
+    if role == "prefill":
+        assert stats["ship_retries"] >= 1, stats
+
+
+@pytest.mark.parametrize("router_kill,snap", [
+    ("router-after-accept:1", 0),
+    ("router-mid-serving:1", 0),
+    # boundary snapshots armed: the restarted router's replicas RESTORE
+    # and claim their residents via resume reports — the replay backlog
+    # must hold for those claims instead of double-dispatching the same
+    # rids onto other replicas
+    ("router-mid-serving:1", 1),
+], ids=["after-accept", "mid-serving", "mid-serving-snapshots"])
+def test_router_kill_then_restart_replays_intake_log(tmp_path, reference,
+                                                     router_kill, snap):
+    """SIGKILL the ROUTER PROCESS itself (after journaling the first
+    acceptance / after delivering the first token event): a fresh router
+    over the same workdir sweeps the orphaned workers, replays the
+    durable intake log — completed streams served from the journal,
+    unfinished requests re-dispatched — and finishes every stream
+    bit-identically.  An accepted request never dies with the router."""
+    wd = tmp_path / "wd"
+    r = _run_driver(tmp_path, wd, tmp_path / "x.json",
+                    router_kill=router_kill, snapshot_interval=snap)
+    assert r.returncode == -signal.SIGKILL, (r.stdout + r.stderr)[-3000:]
+    assert os.path.exists(wd / "intake.jsonl")
+
+    out = tmp_path / "resumed.json"
+    r2 = _run_driver(tmp_path, wd, out, snapshot_interval=snap)
+    assert "DONE" in r2.stdout, (r2.stdout + r2.stderr)[-3000:]
+    got = json.loads(out.read_text())
+    assert got == reference, (got, reference)

@@ -28,6 +28,14 @@ serving/snapshot.py) gate like the SLO percentiles — lower is better, so
 growth beyond --slo-threshold is the regression (the wall cost of
 honoring a preemption) — and skip silently on pre-snapshot payloads.
 
+Cluster payloads carrying the fail-over section (bench_cluster.py
+detail.failover: detect_ms from SIGKILL to the router's first re-dispatch,
+recover_ms to every stream complete) gate like the SLO percentiles —
+lower is better, growth beyond --slo-threshold is the regression — and
+skip silently on pre-cluster payloads.  A fail-over run that LOST a
+request records rc != 0 and is skipped as unhealthy rather than gated:
+zero-loss is an acceptance criterion, not a trend.
+
 Schedule-search payloads carrying the decode-chain section
 (bench_schedule_search.py detail.decode_chain: per-kv-variant
 win-or-disabled verdicts) gate each variant's measured win like the
@@ -115,6 +123,18 @@ def load_snapshot(path):
     return snap if isinstance(snap, dict) else None
 
 
+def load_failover(path):
+    """The fail-over section of a cluster bench payload (bench_cluster.py
+    detail.failover: {"detect_ms", "recover_ms", "lost",
+    "streams_match"}), or None when absent — pre-cluster rounds and
+    non-cluster benches skip the gate."""
+    data, _err = _payload_dict(path)
+    if not isinstance(data, dict):
+        return None
+    fo = (data.get("detail") or {}).get("failover")
+    return fo if isinstance(fo, dict) else None
+
+
 def load_decode_chain(path):
     """The decode-chain section of a schedule-search bench payload
     (bench_schedule_search.py detail.decode_chain: {"bf16": {"win": ...,
@@ -192,6 +212,28 @@ def main(argv=None):
             rel = (n - o) / o
             stat = "REGRESSION" if rel > args.slo_threshold else "ok"
             print(f"bench gate [snapshot {sk}]: {o:.2f} -> {n:.2f} ms "
+                  f"({rel:+.2%}) {stat}")
+            if stat == "REGRESSION":
+                rc = 1
+
+    # fail-over latency gate (serving cluster): SIGKILL-to-detection and
+    # SIGKILL-to-recovery walls, lower-is-better at the SLO threshold
+    # (single-shot process-kill timings jitter like tail percentiles).
+    # Sides missing the section (pre-cluster rounds) skip silently; a
+    # side that lost a request never got here (its rc != 0 already
+    # skipped the whole payload as unhealthy).
+    old_fo, new_fo = load_failover(args.old), load_failover(args.new)
+    if old_fo and new_fo:
+        for fk in ("detect_ms", "recover_ms"):
+            try:
+                o, n = float(old_fo.get(fk, 0)), float(new_fo.get(fk, 0))
+            except (TypeError, ValueError):
+                continue
+            if not o > 0 or not n > 0:
+                continue
+            rel = (n - o) / o
+            stat = "REGRESSION" if rel > args.slo_threshold else "ok"
+            print(f"bench gate [failover {fk}]: {o:.1f} -> {n:.1f} ms "
                   f"({rel:+.2%}) {stat}")
             if stat == "REGRESSION":
                 rc = 1

@@ -65,6 +65,14 @@ ISOLATED_DEFAULT = (
     "test_serving_mesh.py",
     "test_serving_mesh_spec.py",
     "test_engine_snapshot_mesh.py",
+    # The serving-cluster modules fork real engine/router processes and
+    # SIGKILL them mid-protocol (heartbeat fail-over, drain migration,
+    # the cluster crash matrix, the fail-over bench) — never in a shared
+    # worker, where an orphaned subprocess or a poisoned shm ring could
+    # take sibling modules' results down with it.
+    "test_serving_cluster.py",
+    "test_serving_cluster_crash.py",
+    "test_bench_cluster.py",
 )
 
 DEFAULT_CACHE_DIR = "/tmp/jax_cache"
