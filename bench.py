@@ -128,7 +128,23 @@ def parent() -> int:
     return _fail("measurement failed after %d attempts: %s" % (BENCH_ATTEMPTS, last_err))
 
 
-def child() -> int:
+def _pipeline_detail(S: int = 4, M: int = 16) -> dict:
+    """Simulator-backed pipeline-schedule section (ROADMAP item 3): bubble
+    fraction per registered schedule at the flagship (S, M), pure host math
+    from fleet/meta_parallel/schedules.py — CPU-falsifiable, rides every
+    payload so tools/check_bench_regression.py can gate bubble growth
+    (lower is better) the moment a schedule table changes."""
+    from paddle_tpu.distributed.fleet.meta_parallel import schedules as sched
+
+    out = {"S": S, "M": M, "schedules": {}, "peak_residency": {}}
+    for name in sched.available_schedules():
+        r = sched.simulate(name, S, M)
+        out["schedules"][name] = round(r.bubble_fraction, 6)
+        out["peak_residency"][name] = r.peak_residency
+    return out
+
+
+def child(smoke: bool = False) -> int:
     import numpy as np
     import jax
 
@@ -198,6 +214,20 @@ def child() -> int:
         ms = time_step_ms(lambda: step(ids, labels), inner=iters)
         return batch * S / (ms / 1e3)
 
+    # per-config MFU (ROADMAP item 3: the gain must be visible per swept
+    # config the moment the tunnel returns, not just for the winner)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * S
+
+    from paddle_tpu.device.peaks import device_peak_tflops
+
+    kind = jax.devices()[0].device_kind.lower()
+    peak = device_peak_tflops(kind, platform)
+
+    def _mfu(tps: float) -> float:
+        return (tps * flops_per_token / 1e12) / peak if peak else 0.0
+
+    configs = []
     if on_accel:
         # batch sweep, largest first: bigger batches fill the MXU better
         # until HBM runs out — an OOM falls through to the next size
@@ -211,6 +241,9 @@ def child() -> int:
                 if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
                     raise
                 continue
+            configs.append({"config": f"hidden2048_L8_bf16_B{batch}",
+                            "tokens_per_sec": round(tps, 2),
+                            "mfu": round(_mfu(tps), 4)})
             if tps > tokens_per_sec:
                 tokens_per_sec, best_b = tps, batch
         B = best_b
@@ -221,38 +254,54 @@ def child() -> int:
             return _fail("all sweep batch sizes hit device OOM")
     else:
         tokens_per_sec = measure(B)
+        configs.append({"config": "cpu_smoke",
+                        "tokens_per_sec": round(tokens_per_sec, 2),
+                        "mfu": round(_mfu(tokens_per_sec), 4)})
 
-    # achieved model FLOPs (6 * n_params per token, attention term included)
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * S
-    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
-
-    from paddle_tpu.device.peaks import device_peak_tflops
-
-    kind = jax.devices()[0].device_kind.lower()
-    peak = device_peak_tflops(kind, platform)
-    mfu = achieved_tflops / peak if peak else 0.0
+    mfu = _mfu(tokens_per_sec)
     vs_baseline = mfu / 0.45 if peak else 0.0
 
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": round(tokens_per_sec, 2),
-                "unit": "tokens/s",
-                "vs_baseline": round(vs_baseline, 4),
-                "mfu": round(mfu, 4),
-                "device_kind": kind,
-                "config": (f"hidden2048_L8_bf16_B{B}" if on_accel
-                           else "cpu_smoke"),
-            }
-        ),
-        flush=True,
-    )
+    payload = {
+        "metric": METRIC,
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "mfu": round(mfu, 4),
+        "device_kind": kind,
+        "config": (f"hidden2048_L8_bf16_B{B}" if on_accel else "cpu_smoke"),
+        "configs": configs,
+        "detail": {"pipeline": _pipeline_detail()},
+    }
+    print(json.dumps(payload), flush=True)
+    if smoke:
+        _assert_smoke(payload)
+        print("BENCH_SMOKE_OK", flush=True)
     return 0
 
 
+def _assert_smoke(payload: dict):
+    """--smoke contract: the CPU twin proves the payload SHAPE the on-chip
+    run will carry — per-config mfu fields and the simulator-backed
+    pipeline section with ZB-H1 strictly under 1F1B — so a field
+    regression fails in CI, not in the first post-tunnel round."""
+    assert payload["value"] > 0, payload
+    assert payload["configs"], "configs sweep section missing"
+    for c in payload["configs"]:
+        assert "mfu" in c and "tokens_per_sec" in c and "config" in c, c
+    pl = payload["detail"]["pipeline"]
+    scheds = pl["schedules"]
+    for name in ("FThenB", "1F1B", "ZB-H1"):
+        assert name in scheds, f"{name} missing from pipeline section"
+    assert scheds["ZB-H1"] < scheds["1F1B"], scheds
+    assert pl["peak_residency"]["ZB-H1"] <= pl["peak_residency"]["1F1B"], pl
+
+
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        # CPU twin (no tunnel, no probe tier): measure the smoke config
+        # in-process and assert the payload contract
+        os.environ["PADDLE_TPU_BENCH_CPU"] = "1"
+        sys.exit(child(smoke=True))
     if os.environ.get("PADDLE_TPU_BENCH_CHILD"):
         sys.exit(child())
     sys.exit(parent())

@@ -274,6 +274,18 @@ define_flag(
     "requests re-dispatch (serving/cluster.py)",
 )
 define_flag(
+    "FLAGS_pipeline_schedule",
+    "1F1B",
+    "Default pipeline schedule for PipelineStack/pipeline_llama/"
+    "pipeline_gpt built with schedule=None: one of the registered "
+    "schedule names (fleet/meta_parallel/schedules.py — FThenB | 1F1B | "
+    "ZB-H1).  ZB-H1 runs the zero-bubble split backward: grad-input (B) "
+    "on the critical path, grad-weight (W) deferred per the schedule's "
+    "tick table.  Changing the flag re-resolves flag-following stacks "
+    "and invalidates their cached built steps, the same contract as "
+    "FLAGS_decode_chunk (docs/PIPELINE.md)",
+)
+define_flag(
     "FLAGS_scan_body_guard",
     False,
     "Dev-mode guard: warn when the same lax.scan body function object is "

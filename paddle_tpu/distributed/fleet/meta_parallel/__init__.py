@@ -1,2 +1,11 @@
-from .pipeline import PipelineStack, segment_layers  # noqa: F401
+from .pipeline import PipelineStack, pipeline_parallel, segment_layers  # noqa: F401
+from .schedules import (  # noqa: F401
+    Costs,
+    Schedule,
+    available_schedules,
+    get_schedule,
+    pipeline_stats,
+    register_schedule,
+    simulate,
+)
 from .segment_parallel import SegmentParallel, sep_attention, split_inputs_sequence_dim  # noqa: F401

@@ -292,9 +292,9 @@ class PipelineSchedulerPass(PassBase):
         n = 0
         for sub in ctx.model.sublayers(include_self=True):
             if isinstance(sub, PipelineStack):
-                if schedule not in ("1F1B", "FThenB"):
-                    raise ValueError(f"unknown pipeline schedule {schedule!r}")
-                sub._schedule = schedule
+                # set_schedule validates against the registered schedule
+                # names (incl. ZB-H1) and drops the stack's cached steps
+                sub.set_schedule(schedule)
                 if "num_microbatches" in self.attrs:
                     sub._num_microbatches = int(self.attrs["num_microbatches"])
                 n += 1

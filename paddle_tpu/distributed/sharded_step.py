@@ -81,6 +81,17 @@ class ShardedTrainStep(TrainStep):
     zero_stage: 0 = replicated optimizer state (over dp); 1/2 = accumulators
     sharded over 'dp' on their largest divisible dim (stage 2's grad sharding
     is implicit — XLA is free to reduce-scatter into the sharded update).
+
+    comm_overlap=True decomposes each replicated parameter's dp grad sync
+    from GSPMD's single fused all-reduce into reduce-scatter + an explicit
+    ring all-gather of (dp-1) collective-permute hops
+    (fleet.meta_parallel.schedules.overlap_grad_sync): every hop is an
+    independent async collective XLA's latency-hiding scheduler can overlap
+    with the optimizer math of already-arrived chunks — and, under a ZB-H1
+    pipeline stack, with the W-pass ticks it does not depend on.  Values
+    are bit-identical (a gather of shards reassociates nothing); the chain
+    is statically checked by the mesh lint like every other collective
+    (docs/PIPELINE.md, docs/MESH_LINT.md).
     """
 
     def __init__(
@@ -93,6 +104,7 @@ class ShardedTrainStep(TrainStep):
         zero_stage: int = 1,
         dp_axis: str = "dp",
         scaler=None,
+        comm_overlap: bool = False,
     ):
         super().__init__(model, optimizer, loss_fn, scaler=scaler)
         self.mesh = _as_process_mesh(mesh)
@@ -100,6 +112,7 @@ class ShardedTrainStep(TrainStep):
         # group_sharded_parallel records its level on the optimizer
         self.zero_stage = getattr(optimizer, "_zero_stage", zero_stage)
         self.dp_axis = dp_axis if dp_axis in self.mesh.dim_names else None
+        self.comm_overlap = comm_overlap
 
     # ---------------------------------------------------------------- state
     def _param_sharding(self, t: Tensor) -> NamedSharding:
@@ -142,6 +155,30 @@ class ShardedTrainStep(TrainStep):
             else:
                 sh = self._acc_sharding(acc._value, psh)
             acc._bind(jax.device_put(acc._value, sh))
+
+    # ------------------------------------------------- comm/compute overlap
+    def _post_backward(self):
+        """Traced between backward and optimizer.step: rewrite each
+        replicated parameter's gradient through the overlap chain.
+        TP-sharded parameters keep GSPMD's own layout (their grads are
+        already partial-sharded; re-ringing them over dp would just churn
+        layouts), as do sparse SelectedRows grads."""
+        if not self.comm_overlap or self.dp_axis is None:
+            return
+        from paddle_tpu.distributed.fleet.meta_parallel.schedules import (
+            overlap_grad_sync,
+        )
+
+        for p in self.optimizer._parameter_list:
+            g = getattr(p, "grad", None)
+            if g is None or not hasattr(g, "_value"):
+                continue
+            sh = self._param_sharding(p)
+            if any(e is not None for e in sh.spec):
+                continue
+            synced = overlap_grad_sync(g._value, self.mesh.jax_mesh,
+                                       self.dp_axis)
+            p.grad = Tensor(synced, stop_gradient=True)
 
     # ----------------------------------------------------------------- call
     def _shard_batch_tensors(self, batch):

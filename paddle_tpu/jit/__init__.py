@@ -275,6 +275,11 @@ class TrainStep:
             tensors += self.scaler.state_tensors()
         return tensors
 
+    def _post_backward(self):
+        """Hook between loss.backward() and optimizer.step() inside the
+        traced program — ShardedTrainStep's comm/compute overlap rewrites
+        gradients here (grad-sync decomposition, docs/PIPELINE.md)."""
+
     def _eager_step(self, *batch):
         loss = self.loss_fn(self.model, *batch)
         if self.scaler is not None and self.scaler.is_enable():
@@ -410,9 +415,11 @@ class TrainStep:
                     loss = loss_fn(model, *batch)
                     if scaler is not None and scaler.is_enable():
                         scaler.scale(loss).backward()
+                        self._post_backward()
                         scaler.step(optimizer)
                     else:
                         loss.backward()
+                        self._post_backward()
                         optimizer.step()
                     optimizer.clear_grad()
                 new_vals = [t._value for t in state]

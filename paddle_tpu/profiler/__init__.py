@@ -218,8 +218,8 @@ class Profiler:
         from .statistics import (checkpoint_line, cluster_line,
                                  compile_cache_line, decode_line,
                                  dispatch_cache_line, lora_line, mesh_line,
-                                 schedule_line, snapshot_line, summary_text,
-                                 verify_line)
+                                 pipeline_line, schedule_line, snapshot_line,
+                                 summary_text, verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -254,6 +254,9 @@ class Profiler:
         cl_line = cluster_line(cluster_stats())
         if cl_line:
             out = out + "\n" + cl_line
+        pp_line = pipeline_line(pipeline_stats())
+        if pp_line:
+            out = out + "\n" + pp_line
         print(out)
         return out
 
@@ -478,6 +481,22 @@ def cluster_stats(reset: bool = False) -> dict:
     return _cluster.cluster_stats(reset=reset)
 
 
+def pipeline_stats(reset: bool = False) -> dict:
+    """Pipeline-schedule counters (fleet/meta_parallel/schedules.py,
+    docs/PIPELINE.md): pipeline step programs built, scan ticks traced
+    (forward + split-backward), F/B/W stage-microbatch slots, stage-ticks
+    spent on warmup/drain bubble work, and collective-permute hops issued
+    by comm/compute-overlap chains (ShardedTrainStep comm_overlap /
+    overlap_grad_sync).  Counted when a program is built or dispatched
+    from python — once per trace under a compiled TrainStep, per call in
+    eager (the mesh-lint counter convention).  w_slots nonzero means a
+    zero-bubble split-backward schedule (ZB-H1) is live.  The schedules
+    module owns the counters — one schema, no drift."""
+    from paddle_tpu.distributed.fleet.meta_parallel import schedules as _sched
+
+    return _sched.pipeline_stats(reset=reset)
+
+
 def checkpoint_stats(reset: bool = False) -> dict:
     """CheckpointManager counters (distributed/checkpoint/manager.py):
     saves issued (async_saves of them backgrounded), atomic commits,
@@ -496,7 +515,7 @@ def checkpoint_stats(reset: bool = False) -> dict:
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
             "decode_stats", "lora_stats", "verify_stats", "mesh_lint_stats",
             "schedule_search_stats", "checkpoint_stats", "snapshot_stats",
-            "cluster_stats"]
+            "cluster_stats", "pipeline_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -381,6 +381,24 @@ def snapshot_line(stats: dict) -> str:
     )
 
 
+def pipeline_line(stats: dict) -> str:
+    """One-line rendering of the pipeline-schedule counters for
+    Profiler.summary(); empty when no pipeline program ran this process
+    (fleet/meta_parallel/schedules.py, docs/PIPELINE.md).  w_slots nonzero
+    means a zero-bubble split-backward schedule is live; overlap_issued
+    counts the collective-permute hops of comm/compute-overlap grad-sync
+    chains."""
+    if not (stats.get("programs") or stats.get("overlap_issued")):
+        return ""
+    return (
+        "Pipeline: programs=%d ticks=%d slots F=%d B=%d W=%d "
+        "bubble_ticks=%d overlap_issued=%d"
+        % (stats["programs"], stats["ticks"], stats["f_slots"],
+           stats["b_slots"], stats["w_slots"], stats["bubble_ticks"],
+           stats["overlap_issued"])
+    )
+
+
 def compile_cache_line(stats: dict) -> str:
     """One-line rendering of the trace/compile + persistent-cache counters
     for Profiler.summary(); empty when nothing compiled this process."""

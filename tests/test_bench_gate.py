@@ -87,6 +87,78 @@ def test_slo_percentile_gate(tmp_path):
     assert main([old, slow]) == 1
 
 
+def _pipe_payload(zb=0.111, f1b=0.158, value=100.0):
+    return {
+        "metric": "llama_pretrain_tokens_per_sec_per_chip", "value": value,
+        "unit": "tokens/s",
+        "configs": [{"config": "B4", "tokens_per_sec": value, "mfu": 0.6}],
+        "detail": {"pipeline": {
+            "S": 4, "M": 16,
+            "schedules": {"FThenB": 0.158, "1F1B": f1b, "ZB-H1": zb},
+            "peak_residency": {"FThenB": 16.0, "1F1B": 4.0, "ZB-H1": 4.0},
+        }},
+    }
+
+
+def test_pipeline_schedule_gate(tmp_path):
+    """Pipeline wiring (bench.py detail.pipeline): per-schedule simulator
+    bubble fractions gate LOWER-is-better at the regular threshold;
+    pre-schedule payloads skip silently; an improved bubble never gates;
+    the throughput headline keeps gating independently."""
+    old = _w(tmp_path, "p_old.json", _pipe_payload())
+    same = _w(tmp_path, "p_same.json", _pipe_payload())
+    assert main([old, same]) == 0
+    # ZB-H1 bubble grew 50%: a schedule-table regression, gated
+    worse = _w(tmp_path, "p_worse.json", _pipe_payload(zb=0.166))
+    assert main([old, worse]) == 1
+    assert main([old, worse, "--threshold", "0.6"]) == 0
+    assert main([worse, old]) == 0        # bubble SHRANK: never gates
+    # the 1F1B entry gates independently of ZB-H1
+    worse_1f1b = _w(tmp_path, "p_w1.json", _pipe_payload(f1b=0.2))
+    assert main([old, worse_1f1b]) == 1
+    # pre-schedule payloads (every earlier round) skip the gate silently
+    pre = _w(tmp_path, "p_pre.json",
+             {"metric": "llama_pretrain_tokens_per_sec_per_chip",
+              "value": 100.0})
+    assert main([pre, worse]) == 0
+    assert main([worse, pre]) == 0
+    # a throughput regression still gates with clean bubbles
+    slow = _w(tmp_path, "p_slow.json", _pipe_payload(value=80.0))
+    assert main([old, slow]) == 1
+    # zero is the BEST bubble, not an unhealthy value: growth from a true
+    # zero-bubble baseline gates; zero -> zero passes
+    z_old = _w(tmp_path, "p_z0.json", _pipe_payload(zb=0.0))
+    z_same = _w(tmp_path, "p_z1.json", _pipe_payload(zb=0.0))
+    z_grew = _w(tmp_path, "p_z2.json", _pipe_payload(zb=0.05))
+    assert main([z_old, z_same]) == 0
+    assert main([z_old, z_grew]) == 1
+
+
+def test_bench_payload_pipeline_section_shape():
+    """The smoke/payload contract without running the model: bench.py's
+    simulator section carries every registered schedule with ZB-H1
+    strictly under 1F1B at the flagship (S, M), and the smoke assert
+    accepts exactly the payload child() builds."""
+    sys.path.insert(0, ".")
+    import bench
+
+    pl = bench._pipeline_detail()
+    assert set(pl["schedules"]) >= {"FThenB", "1F1B", "ZB-H1"}
+    assert pl["schedules"]["ZB-H1"] < pl["schedules"]["1F1B"]
+    assert pl["peak_residency"]["ZB-H1"] <= pl["peak_residency"]["1F1B"]
+    payload = {
+        "value": 10.0, "configs": [
+            {"config": "cpu_smoke", "tokens_per_sec": 10.0, "mfu": 0.0}],
+        "detail": {"pipeline": pl},
+    }
+    bench._assert_smoke(payload)  # the CPU twin's field contract
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError):
+        bench._assert_smoke({"value": 10.0, "configs": [],
+                             "detail": {"pipeline": pl}})
+
+
 def _snap_payload(save_ms=30.0, restore_ms=60.0):
     return {
         "metric": "serving_decode_chunked_speedup", "value": 5.0,

@@ -89,6 +89,12 @@ def test_build_plan_isolates_collective_modules():
     for mod in ("test_serving_cluster.py", "test_serving_cluster_crash.py",
                 "test_bench_cluster.py"):
         assert mod in iso_names, mod
+    # the pipeline-schedule parity suite dispatches split-backward GSPMD
+    # pipeline programs over 4/8-device in-process meshes every test: a
+    # DEDICATED isolated worker, never round-robin, never slow-marked
+    assert "test_zb_schedules.py" in iso_names
+    # while the bench-gate and simulator-only tests stay round-robin
+    assert "test_bench_gate.py" in rest_files
 
 
 # -------------------------------------------------------- crash isolation

@@ -36,6 +36,13 @@ skip silently on pre-cluster payloads.  A fail-over run that LOST a
 request records rc != 0 and is skipped as unhealthy rather than gated:
 zero-loss is an acceptance criterion, not a trend.
 
+Training payloads carrying the pipeline-schedule section (bench.py
+detail.pipeline.schedules: per-schedule bubble fraction from the static
+simulator, fleet/meta_parallel/schedules.py) gate each schedule's bubble
+LOWER-is-better at the regular --threshold — the numbers are
+deterministic host math, so any growth means a schedule table got worse
+— and skip silently on pre-schedule payloads.
+
 Schedule-search payloads carrying the decode-chain section
 (bench_schedule_search.py detail.decode_chain: per-kv-variant
 win-or-disabled verdicts) gate each variant's measured win like the
@@ -133,6 +140,20 @@ def load_failover(path):
         return None
     fo = (data.get("detail") or {}).get("failover")
     return fo if isinstance(fo, dict) else None
+
+
+def load_pipeline(path):
+    """The pipeline-schedule section of a training bench payload (bench.py
+    detail.pipeline: {"S", "M", "schedules": {"1F1B": bubble, ...}}), or
+    None when absent — pre-schedule rounds skip the gate."""
+    data, _err = _payload_dict(path)
+    if not isinstance(data, dict):
+        return None
+    pl = (data.get("detail") or {}).get("pipeline")
+    if not isinstance(pl, dict):
+        return None
+    sch = pl.get("schedules")
+    return sch if isinstance(sch, dict) else None
 
 
 def load_decode_chain(path):
@@ -235,6 +256,33 @@ def main(argv=None):
             stat = "REGRESSION" if rel > args.slo_threshold else "ok"
             print(f"bench gate [failover {fk}]: {o:.1f} -> {n:.1f} ms "
                   f"({rel:+.2%}) {stat}")
+            if stat == "REGRESSION":
+                rc = 1
+
+    # pipeline-schedule gate: per-schedule simulator bubble fraction,
+    # LOWER is better (growth means the schedule table regressed — the
+    # numbers are deterministic host math, so the regular threshold
+    # applies, not the jittery SLO one).  Sides missing the section
+    # (pre-schedule rounds) skip silently.
+    old_pl, new_pl = load_pipeline(args.old), load_pipeline(args.new)
+    if old_pl and new_pl:
+        for name in sorted(set(old_pl) & set(new_pl)):
+            try:
+                o, n = float(old_pl[name]), float(new_pl[name])
+            except (TypeError, ValueError):
+                continue
+            if o <= 0:
+                # zero is the BEST bubble (unlike throughput, where 0 is
+                # unhealthy): any growth from a true zero-bubble baseline
+                # is a regression, never a skip
+                stat = "REGRESSION" if n > 1e-9 else "ok"
+                print(f"bench gate [pipeline {name}]: bubble {o:.4f} -> "
+                      f"{n:.4f} {stat}")
+            else:
+                rel = (n - o) / o
+                stat = "REGRESSION" if rel > args.threshold else "ok"
+                print(f"bench gate [pipeline {name}]: bubble {o:.4f} -> "
+                      f"{n:.4f} ({rel:+.2%}) {stat}")
             if stat == "REGRESSION":
                 rc = 1
 
