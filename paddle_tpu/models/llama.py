@@ -33,6 +33,7 @@ __all__ = [
     "LLAMA_TP_ROW_TARGETS",
     "pipeline_llama",
     "context_parallel_llama",
+    "prefill_chain_scope",
     "llama_tiny",
     "llama_7b",
 ]
@@ -115,6 +116,36 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
         )
 
     return apply("rotary_pos_emb", _rope, q, k, cos, sin)
+
+
+# Accepted prefill-attention schedule (schedule search; PrefillChainSpec)
+# for the chunked-prefill scope the engine is currently inside, or None.
+# A module global, not engine state: LlamaAttention.forward is the one
+# place that knows whether THIS call is the eligible prefill core.
+_PREFILL_CHAIN_CFG = None
+
+
+def prefill_chain_scope(cfg):
+    """Scope an accepted prefill-chain config over a chunked prefill
+    (serving._try_admit): inside the scope every eligible
+    LlamaAttention.forward prefill core — batch 1, multi-token chunk, no
+    explicit mask, no context parallelism, shapes the config tiles —
+    runs as ONE fused K-tiled Pallas dispatch (ops.decode_chain.
+    fused_prefill_attention) instead of the XLA einsum chain; everything
+    else keeps the XLA path.  cfg=None is a no-op scope."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        global _PREFILL_CHAIN_CFG
+        prev = _PREFILL_CHAIN_CFG
+        _PREFILL_CHAIN_CFG = cfg
+        try:
+            yield
+        finally:
+            _PREFILL_CHAIN_CFG = prev
+
+    return _ctx()
 
 
 class LlamaAttention(nn.Layer):
@@ -202,11 +233,34 @@ class LlamaAttention(nn.Layer):
 
             out = sep_attention(q, k, v, causal=True, mode=self._sep_mode)
         else:
-            # empty-cache prefill is causal; a cached single-token decode
-            # attends to everything it has
-            out = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=attn_mask, is_causal=(kv_cache is None) or s > 1
-            )
+            chain = _PREFILL_CHAIN_CFG
+            bq = int(chain.get("block_q", 0)) if chain else 0
+            kch = int(chain.get("kchunk", 1) or 1) if chain else 1
+            if (chain is not None and attn_mask is None and s > 1
+                    and b == 1 and bq >= 2 and s % bq == 0
+                    and int(k.shape[1]) % kch == 0):
+                # fused chunked-prefill attention core (prefill_chain_scope;
+                # the accepted schedule tiles this chunk exactly) — the
+                # config rides kwargs so the dispatch cache keys on it
+                from paddle_tpu.ops import decode_chain as _dc
+
+                def _fused_prefill(qv, kv_, vv, *, block_q, stage, kchunk):
+                    return _dc.fused_prefill_attention(
+                        qv, kv_, vv, block_q=block_q, stage=stage,
+                        kchunk=kchunk)
+
+                out = apply("fused_prefill_attention", _fused_prefill,
+                            q, k, v,
+                            block_q=int(chain["block_q"]),
+                            stage=chain.get("stage", "take"),
+                            kchunk=int(chain.get("kchunk", 1) or 1))
+            else:
+                # empty-cache prefill is causal; a cached single-token
+                # decode attends to everything it has
+                out = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask,
+                    is_causal=(kv_cache is None) or s > 1
+                )
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if new_cache is not None:

@@ -39,6 +39,38 @@ whole-pool VMEM residency of these layouts is validated by
 ops.autotune.validate_tile, so geometries whose pools exceed the budget
 are pruned honestly rather than faked; a DMA-pipelined variant can join
 the candidate space later without changing the search contract.
+
+Mesh-sharded chains (schedule search over the mesh; ROADMAP item 3): a
+spec built with ``mesh=`` describes the SAME chain on a TP-sharded
+engine.  ``build`` then wraps the single-device kernel in ``shard_map``
+over the engine's pool layout — pools P(None, mp) on the KV-head dim,
+q/k_new/v_new P(None, mp, None) on the head dim, tables/lens replicated —
+with the per-device kernel geometry taken from
+``NamedSharding.shard_shape`` (the same source the serving telemetry's
+``pool_device_nbytes`` uses).  GQA head contiguity makes every candidate
+layout head-local: device d's query-head shard [d·n/mp, (d+1)·n/mp)
+attends exactly its own kv-head shard (``gathered_attention`` repeats kv
+heads in contiguous groups), so the fused chain runs ZERO in-kernel
+collectives and the mesh adds NO drift — parity re-gates bit-exactly
+against the sharded XLA twin (synthetic args committed to the engine's
+NamedShardings, reference jitted under GSPMD), the PR-11 contract.  The
+roofline costs PER-DEVICE traffic plus ``collective_bytes`` — the psum an
+attention epilogue would need if a kv group ever split across devices (0
+for every current layout; o_proj's row-parallel psum lives OUTSIDE the
+chain, in GSPMD's hands).  Cache verdicts are keyed by (device kind,
+mesh shape): the AutotuneCache file is per device kind and ``key()``
+gains a ``mesh`` entry only when a mesh is set, so single-device and
+sharded verdicts never collide (tested by the cache-pollution
+regression).  ``static.mesh_lint.lint_decode_chain`` statically checks
+the built kernel's collectives before an engine adopts it.
+
+``PrefillChainSpec`` extends the same searcher protocol to the OTHER
+serving hot path: the chunked-prefill attention core (q chunk against
+the growing cache, bottom-right aligned).  Candidates tile query rows
+(bit-exact — softmax is per row) and stage K/V in chunks (pure data
+movement), so long-prompt pours stop being a pure XLA chain once a
+config wins; models/llama adopts through ``fused_prefill_attention``
+under ``prefill_chain_scope``.
 """
 
 from __future__ import annotations
@@ -50,9 +82,11 @@ import numpy as np
 
 __all__ = [
     "DecodeChainSpec",
+    "PrefillChainSpec",
     "spec_from_arrays",
     "ensure_decision",
     "fused_decode_step",
+    "fused_prefill_attention",
 ]
 
 # per-copy-step turnaround for the analytic ranking (the scale of one DMA
@@ -69,7 +103,14 @@ class DecodeChainSpec:
     kv: 'bf16' (full-precision pools in `dtype`) | 'int8' (QuantPool —
     int8 payload + per-block-per-head f32 scales, running-max writes).
     num_blocks counts the WHOLE pool incl. scratch pages; max_blocks is
-    the per-sequence block-table width."""
+    the per-sequence block-table width.
+
+    mesh: None for the single-device chain, or the engine's ProcessMesh —
+    the spec then describes the TP-sharded chain (pools on the KV-head
+    dim over `mp_axis`, the serving layout) and builds inside shard_map;
+    the mesh handle itself never enters `key()` (only its shape string
+    does), so cache entries stay (device kind, mesh shape)-keyed and
+    host-portable."""
 
     batch: int
     num_heads: int
@@ -80,6 +121,8 @@ class DecodeChainSpec:
     num_blocks: int
     kv: str = "bf16"
     dtype: object = np.float32
+    mesh: object = None
+    mp_axis: str = "mp"
 
     check_parity = True  # searcher protocol: candidates numerics-gate
 
@@ -96,7 +139,7 @@ class DecodeChainSpec:
         return f"schedule/decode_{self.kv}"
 
     def key(self) -> dict:
-        return {
+        k = {
             "b": self.batch,
             "n": self.num_heads,
             "nkv": self.num_kv_heads,
@@ -106,6 +149,54 @@ class DecodeChainSpec:
             "nb": self.num_blocks,
             "dtype": np.dtype(self.dtype).name,
         }
+        # (device kind, mesh shape) verdict keying: the AutotuneCache file
+        # is already per device kind; the mesh-shape entry — ONLY when a
+        # mesh is set, so existing single-device key strings stay stable —
+        # keeps single-device and sharded verdicts from ever colliding
+        if self.mesh is not None:
+            k["mesh"] = self.mesh_desc()
+        return k
+
+    # ---------------------------------------------------------- mesh view
+    def mesh_desc(self) -> str:
+        """'mp2'-style mesh shape string (the serving telemetry format)."""
+        if self.mesh is None:
+            return ""
+        return "x".join(f"{n}{s}" for n, s in zip(self.mesh.dim_names,
+                                                  self.mesh.shape))
+
+    def _mp(self) -> int:
+        return int(dict(zip(self.mesh.dim_names,
+                            self.mesh.shape))[self.mp_axis])
+
+    def _shardings(self):
+        """(pool, heads, replicated) NamedShardings of the serving layout:
+        pools shard the KV-head dim (axis 1 of every pool leaf — payload
+        AND scales), q/k_new/v_new shard the head dim, tables/lens ride
+        replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        jm = self.mesh.jax_mesh
+        return (NamedSharding(jm, P(None, self.mp_axis)),
+                NamedSharding(jm, P(None, self.mp_axis, None)),
+                NamedSharding(jm, P()))
+
+    def device_spec(self) -> "DecodeChainSpec":
+        """The PER-DEVICE replica of this geometry: head counts come from
+        ``NamedSharding.shard_shape`` over the committed pool/head layouts
+        — the same source ops.paged_attention.pool_device_nbytes uses for
+        the telemetry's per-device bytes — never from ad-hoc division."""
+        import dataclasses
+
+        pool_s, head_s, _rep = self._shardings()
+        pool_shape = (self.num_blocks, self.num_kv_heads, self.block_size,
+                      self.head_dim)
+        _nb, nkv_local, _bs, _h = pool_s.shard_shape(pool_shape)
+        _b, n_local, _h2 = head_s.shard_shape(
+            (self.batch, self.num_heads, self.head_dim))
+        return dataclasses.replace(self, mesh=None,
+                                   num_heads=int(n_local),
+                                   num_kv_heads=int(nkv_local))
 
     def label(self) -> str:
         from paddle_tpu.ops.autotune import _key_str
@@ -159,11 +250,37 @@ class DecodeChainSpec:
             return b * nkv * bs * h * 1 + b * nkv * 4
         return b * nkv * h * np.dtype(self.dtype).itemsize
 
+    def collective_bytes(self, config) -> int:
+        """ICI bytes of the psum the attention epilogue needs, per device.
+        Every current layout is head-local — P(None, mp) keeps each query
+        head's whole GQA kv group on its own device (contiguous repeat in
+        gathered_attention), so the chain runs zero in-kernel collectives
+        and this is 0; o_proj's row-parallel psum stays OUTSIDE the chain
+        (GSPMD's epilogue, costed by the step program, not the kernel).
+        A future layout that splits a kv group across devices must cost
+        its partial-output psum here: one [b, n_local, h] f32 reduction."""
+        if self.mesh is None:
+            return 0
+        mp = self._mp()
+        if self.num_heads % mp == 0 and self.num_kv_heads % mp == 0:
+            return 0  # head-local: no epilogue reduction
+        # non-divisible heads can't ride shard_shape (uneven split):
+        # cost the ceil-divided local head count directly — build()
+        # refuses these geometries anyway, this is the honest estimate
+        n_local = -(-self.num_heads // mp)
+        return self.batch * n_local * self.head_dim * 4
+
     def traffic_bytes(self, config) -> int:
         """Modeled HBM traffic: every pool leaf read at its own itemsize
         (once for the 'batch' layout; re-staged per row — x batch — for
         'rows'), the write phase's touched bytes, and the q/k/v/token
-        tensors + output once."""
+        tensors + output once.  A mesh spec reports the PER-DEVICE number
+        — the device_spec's traffic (shard_shape-divided pools/heads)
+        plus the epilogue's collective bytes — because per-device time is
+        what the roofline ranks against the sharded XLA twin."""
+        if self.mesh is not None:
+            return (self.device_spec().traffic_bytes(config)
+                    + self.collective_bytes(config))
         it = np.dtype(self.dtype).itemsize
         b, n, nkv, h = (self.batch, self.num_heads, self.num_kv_heads,
                         self.head_dim)
@@ -178,10 +295,16 @@ class DecodeChainSpec:
         return int(traffic)
 
     def flops(self) -> float:
+        if self.mesh is not None:  # per-device: heads divide over the mesh
+            return self.device_spec().flops()
         b, n, h, s = self.batch, self.num_heads, self.head_dim, self.seq
         return 4.0 * b * n * s * h + 5.0 * b * n * s
 
     def roofline_ms(self, config, cost_model=None) -> float:
+        """Analytic rank: per-device flops over per-device traffic (which
+        already includes the epilogue's collective bytes on mesh specs),
+        plus the copy-granularity tie-breaker and — when a layout needs
+        an epilogue psum at all — one collective-launch turnaround."""
         if cost_model is None:
             from paddle_tpu.cost_model import OpCostModel
 
@@ -192,6 +315,8 @@ class DecodeChainSpec:
             copies = 2 * self.batch * (self.max_blocks // u)
         else:
             copies = 2  # one bulk gather per pool
+        if self.collective_bytes(config):
+            copies += 1  # the psum launch rides the same turnaround scale
         return (cost_model.flops_time(self.flops(),
                                       self.traffic_bytes(config))
                 + copies * _COPY_STEP_OVERHEAD_S) * 1e3
@@ -202,7 +327,10 @@ class DecodeChainSpec:
         per-step gathered views, logits tile, and token blocks.  The
         'rows' layout holds one row's views; both layouts keep the whole
         pool resident — on-chip geometries whose pools exceed VMEM are
-        pruned honestly here."""
+        pruned honestly here.  A mesh spec reports its device_spec's
+        working set: VMEM is a per-chip budget."""
+        if self.mesh is not None:
+            return self.device_spec().vmem_bytes(config)
         it = np.dtype(self.dtype).itemsize
         rows = 1 if config.get("layout") == "rows" else self.batch
         n, nkv, h, s = (self.num_heads, self.num_kv_heads, self.head_dim,
@@ -255,11 +383,25 @@ class DecodeChainSpec:
         vc = pa.paged_pour_blocks(vc, vv, ids.reshape(-1))
         s = self.seq
         lens = np.clip(np.linspace(2, s, b).astype(np.int32), 2, s)
-        return (kc, vc,
+        args = (kc, vc,
                 jnp.asarray(rng.standard_normal((b, n, h)), dt),
                 jnp.asarray(rng.standard_normal((b, nkv, h)), dt),
                 jnp.asarray(rng.standard_normal((b, nkv, h)), dt),
                 jnp.asarray(ids), jnp.asarray(lens))
+        if self.mesh is None:
+            return args
+        # commit the args to the engine's committed layout, so jitting
+        # reference() over them IS the sharded XLA twin (GSPMD partitions
+        # the unfused ops exactly as the serving step does) and the parity
+        # gate proves the mesh adds NO drift — the PR-11 contract
+        import jax
+
+        pool_s, head_s, rep = self._shardings()
+        kc, vc, q, kn, vn, tables, lens = args
+        return (jax.device_put(kc, pool_s), jax.device_put(vc, pool_s),
+                jax.device_put(q, head_s), jax.device_put(kn, head_s),
+                jax.device_put(vn, head_s),
+                jax.device_put(tables, rep), jax.device_put(lens, rep))
 
     def parity_ok(self, fn, args, reference_out) -> bool:
         """The parity gate: pools must match the twin BIT-EXACTLY for
@@ -291,11 +433,13 @@ class DecodeChainSpec:
 
     # --------------------------------------------------------------- build
     def build(self, config):
+        if config.get("layout") == "rows" and self.kv != "int8":
+            raise ValueError(
+                "the per-row layout re-associates the attention "
+                "einsum: bf16 chains are bit-exact-only ('batch')")
+        if self.mesh is not None:
+            return _build_sharded(self, config)
         if config.get("layout") == "rows":
-            if self.kv != "int8":
-                raise ValueError(
-                    "the per-row layout re-associates the attention "
-                    "einsum: bf16 chains are bit-exact-only ('batch')")
             return _build_rows(self, config)
         return _build_batch(self, config)
 
@@ -550,11 +694,288 @@ def _wrap_call(spec, kernel, grid, in_specs, out_specs, out_shape, aliases):
     return fused
 
 
+def _build_sharded(spec, config):
+    """The mesh chain: the SINGLE-DEVICE kernel at the device_spec's
+    shard_shape geometry, wrapped in shard_map over the engine's
+    committed layout.  GQA head contiguity makes every candidate layout
+    head-local — device d's query-head shard attends exactly its own
+    kv-head shard — so the body runs ZERO collectives and each device
+    replays the bit-exact single-device math on its slice; the donation
+    aliases ride through (pool shards update in place per device)."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.shard_map_compat import shard_map
+
+    mp = spec._mp()
+    if spec.num_heads % mp != 0 or spec.num_kv_heads % mp != 0:
+        # a split kv group would need the epilogue psum collective_bytes
+        # costs — no candidate implements it, and serving never gets here
+        # (ineligible engines keep the counted mesh skip)
+        raise ValueError(
+            f"sharded decode chain needs head counts divisible by "
+            f"{spec.mp_axis}={mp} (got n={spec.num_heads}, "
+            f"nkv={spec.num_kv_heads}): a split GQA group requires an "
+            "epilogue psum no layout implements")
+    inner = spec.device_spec().build(config)
+    pool_p, head_p = P(None, spec.mp_axis), P(None, spec.mp_axis, None)
+    return shard_map(
+        inner, mesh=spec.mesh.jax_mesh,
+        in_specs=(pool_p, pool_p, head_p, head_p, head_p, P(), P()),
+        out_specs=(head_p, pool_p, pool_p),
+        check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# the prefill-attention chain: the OTHER serving hot path joins the search
+
+
+@dataclass
+class PrefillChainSpec:
+    """One chunked-prefill attention call, ready to schedule: a query
+    chunk of `seq` tokens against `kv_len` cached-plus-chunk positions
+    (bottom-right aligned — chunk token i attends the cache and chunk
+    positions <= i), heads POST-GQA-repeat, the exact geometry
+    models/llama's LlamaAttention prefill branch hands
+    F.scaled_dot_product_attention.
+
+    Candidates keep the query grid at ONE tile (`block_q == seq`: the
+    in-kernel attention call has EXACTLY the twin's shapes, so XLA
+    compiles the same reduction order at every live kv length — a
+    sub-tile's differently-shaped call may re-fuse and drift ~1e-7) and
+    schedule the K/V staging granularity (`kchunk` pieces — pure data
+    movement, the DMA knob), so the parity gate demands BIT-EXACT
+    equality with the XLA twin, no tolerance tier."""
+
+    seq: int
+    kv_len: int
+    num_heads: int
+    head_dim: int
+    dtype: object = np.float32
+
+    check_parity = True
+
+    # ------------------------------------------------------------ identity
+    def kernel_name(self) -> str:
+        return "schedule/prefill"
+
+    def key(self) -> dict:
+        return {
+            "s": self.seq,
+            "t": self.kv_len,
+            "n": self.num_heads,
+            "h": self.head_dim,
+            "dtype": np.dtype(self.dtype).name,
+        }
+
+    def label(self) -> str:
+        from paddle_tpu.ops.autotune import _key_str
+
+        return f"{self.kernel_name()}|{_key_str(self.key())}"
+
+    def config_label(self, config) -> str:
+        lbl = f"#q{config.get('block_q', self.seq)}-{config.get('stage', 'take')}"
+        if config.get("stage") == "loop":
+            lbl += f"k{config.get('kchunk', 1)}"
+        return lbl
+
+    # ------------------------------------------------------ candidate space
+    def enumerate_configs(self):
+        """`block_q` — query tile height, pinned to the WHOLE chunk: a
+        sub-tile's attention call has different shapes than the twin's,
+        and XLA may re-fuse its reduction (~1e-7 drift, shape-dependent
+        — a candidate could even pass parity at this spec's geometry yet
+        drift at another live kv length, which the gate can't see).  One
+        full-chunk tile keeps the in-kernel call shape-identical to the
+        reference at EVERY kv length.  `stage` — 'take' hands the whole
+        K/V block to the core, 'loop' assembles it from `kchunk` staged
+        copies first (the K-tiled DMA granularity; values bit-identical
+        either way).  seq >= 2 required: jax.nn.dot_product_attention
+        special-cases single-row queries (decode shape) with a
+        re-associated reduction."""
+        if self.seq < 2:
+            return []
+        kchunks = [c for c in (2, 4)
+                   if c <= self.kv_len and self.kv_len % c == 0]
+        out = [{"block_q": self.seq, "stage": "take"}]
+        for c in kchunks:
+            out.append({"block_q": self.seq, "stage": "loop", "kchunk": c})
+        return out
+
+    # ------------------------------------------------------------ cost model
+    def flops(self) -> float:
+        s, t, n, h = self.seq, self.kv_len, self.num_heads, self.head_dim
+        return 4.0 * n * s * t * h + 5.0 * n * s * t
+
+    def traffic_bytes(self, config) -> int:
+        """q/output once; K/V re-fetched once per query tile when the
+        grid revisits them (the candidate_roofline_ms convention for a
+        block whose index map is constant across the grid is fetch-once —
+        but whole-block K/V here is re-staged per step off-chip unless
+        the grid is a single step)."""
+        it = np.dtype(self.dtype).itemsize
+        s, t, n, h = self.seq, self.kv_len, self.num_heads, self.head_dim
+        gq = s // int(config.get("block_q", s))
+        traffic = 2 * s * n * h * it          # q in, output out
+        traffic += 2 * t * n * h * it * gq    # k, v per query tile
+        return int(traffic)
+
+    def roofline_ms(self, config, cost_model=None) -> float:
+        if cost_model is None:
+            from paddle_tpu.cost_model import OpCostModel
+
+            cost_model = OpCostModel()
+        gq = self.seq // int(config.get("block_q", self.seq))
+        copies = gq
+        if config.get("stage") == "loop":
+            copies += 2 * gq * int(config.get("kchunk", 1))
+        return (cost_model.flops_time(self.flops(),
+                                      self.traffic_bytes(config))
+                + copies * _COPY_STEP_OVERHEAD_S) * 1e3
+
+    def vmem_bytes(self, config) -> int:
+        """Per grid step: the q tile, whole K/V (+ the staged copy for
+        'loop'), the f32 logits tile, and the output tile — x2 for the
+        double-buffer convention."""
+        it = np.dtype(self.dtype).itemsize
+        bq = int(config.get("block_q", self.seq))
+        t, n, h = self.kv_len, self.num_heads, self.head_dim
+        total = bq * n * h * it                  # q tile
+        total += 2 * t * n * h * it              # k, v
+        if config.get("stage") == "loop":
+            total += 2 * t * n * h * it          # assembly buffers
+        total += n * bq * t * 4                  # logits tile (f32)
+        total += bq * n * h * it                 # output tile
+        return int(total) * 2
+
+    # ------------------------------------------------------------- numerics
+    def reference(self):
+        """The XLA twin: EXACTLY the nn.functional.attention._core math
+        the model otherwise runs — jax.nn.dot_product_attention, causal
+        top-left for the square first chunk, the explicit bottom-right
+        tri mask for a chunk on a longer cache."""
+        import jax
+        import jax.numpy as jnp
+
+        def ref(q, k, v):
+            sq, sk = q.shape[1], k.shape[1]
+            if sq != sk:
+                tri = jnp.tril(jnp.ones((sq, sk), bool),
+                               k=sk - sq)[None, None]
+                return jax.nn.dot_product_attention(q, k, v, mask=tri,
+                                                    is_causal=False)
+            return jax.nn.dot_product_attention(q, k, v, is_causal=True)
+
+        return ref
+
+    def synthetic_args(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(self.dtype)
+        s, t, n, h = self.seq, self.kv_len, self.num_heads, self.head_dim
+        return (jnp.asarray(rng.standard_normal((1, s, n, h)), dt),
+                jnp.asarray(rng.standard_normal((1, t, n, h)), dt),
+                jnp.asarray(rng.standard_normal((1, t, n, h)), dt))
+
+    def parity_ok(self, fn, args, reference_out) -> bool:
+        """Bit-exact, no tolerance tier: the full-chunk tile keeps the
+        in-kernel attention call shape-identical to the twin (same XLA
+        reduction order) and staging is pure data movement."""
+        try:
+            got = fn(*args)
+        except Exception:
+            return False
+        return (got.shape == reference_out.shape
+                and got.dtype == reference_out.dtype
+                and bool((got == reference_out).all()))
+
+    # --------------------------------------------------------------- build
+    def build(self, config):
+        return _build_prefill(self, config)
+
+
+def _stage_chunks(src, kchunk):
+    """K/V assembly in `kchunk` pieces: a fori_loop copies each chunk of
+    the kv axis into the buffer — pure data movement (bit-identical to
+    using `src` directly), only the copy granularity differs."""
+    import jax
+    import jax.numpy as jnp
+
+    t = src.shape[1]
+    step_len = t // kchunk
+    buf = jnp.zeros_like(src)
+
+    def step(j, buf):
+        sl = jax.lax.dynamic_slice_in_dim(src, j * step_len, step_len,
+                                          axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(buf, sl, j * step_len,
+                                                   axis=1)
+
+    return jax.lax.fori_loop(0, kchunk, step, buf)
+
+
+def _build_prefill(spec, config):
+    """Grid over query-row tiles, whole K/V resident per step: each step
+    replays the EXACT reference call (jax.nn.dot_product_attention with
+    this tile's bottom-right mask rows) on its rows — bit-exact vs the
+    twin by construction, the decode-chain philosophy at prefill
+    shapes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.ops._pl_utils import imap
+
+    s, t, n, h = spec.seq, spec.kv_len, spec.num_heads, spec.head_dim
+    bq = int(config.get("block_q", s))
+    stage = config.get("stage", "take")
+    kchunk = int(config.get("kchunk", 1) or 1)
+    dt = jnp.dtype(spec.dtype)
+    gq = s // bq
+
+    def kernel(q_r, k_r, v_r, o_r):
+        i = pl.program_id(0)
+        k = k_r[...]
+        v = v_r[...]
+        if stage == "loop":
+            k = _stage_chunks(k, kchunk)
+            v = _stage_chunks(v, kchunk)
+        rows = i * bq + jnp.arange(bq)
+        # this tile's rows of tril(ones((s, t)), k=t-s): bottom-right
+        # aligned — identical to the causal path for the square chunk
+        mask = (jnp.arange(t)[None, :]
+                <= rows[:, None] + (t - s))[None, None]
+        o = jax.nn.dot_product_attention(q_r[...], k, v, mask=mask,
+                                         is_causal=False)
+        o_r[...] = o.astype(o_r.dtype)
+
+    def qtile(shape):
+        return pl.BlockSpec((1, bq) + shape[2:],
+                            imap(lambda i: (0, i, 0, 0)))
+
+    def whole(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, imap(lambda i: (0,) * nd))
+
+    def fused(q, k, v):
+        return pl.pallas_call(
+            kernel,
+            grid=(gq,),
+            in_specs=[qtile((1, s, n, h)), whole((1, t, n, h)),
+                      whole((1, t, n, h))],
+            out_specs=qtile((1, s, n, h)),
+            out_shape=jax.ShapeDtypeStruct((1, s, n, h), dt),
+            interpret=jax.default_backend() != "tpu",
+        )(q, k, v)
+
+    return fused
+
+
 # ---------------------------------------------------------------------------
 # engine-facing plumbing
 
 
-def spec_from_arrays(kc, q, tables):
+def spec_from_arrays(kc, q, tables, mesh=None, mp_axis="mp"):
     """Geometry spec for the chain the traced step is about to run —
     derived from the live pool/query/table shapes, so the fused kernel
     and the arrays it consumes can never disagree."""
@@ -569,7 +990,7 @@ def spec_from_arrays(kc, q, tables):
         head_dim=int(h), block_size=int(bs),
         max_blocks=int(tables.shape[1]), num_blocks=int(nb),
         kv="int8" if quant else "bf16",
-        dtype=np.dtype(q.dtype))
+        dtype=np.dtype(q.dtype), mesh=mesh, mp_axis=mp_axis)
 
 
 def ensure_decision(spec, searcher=None):
@@ -601,6 +1022,29 @@ def ensure_decision(spec, searcher=None):
 def fused_decode_step(kc, vc, q, kn, vn, tables, lens, *, config):
     """The macro-step scan body's fused seam: one accepted-config Pallas
     dispatch replacing the write→write→attend op sequence of
-    models/llama._decode_layer_paged.  Returns (o, kc', vc')."""
-    spec = spec_from_arrays(kc, q, tables)
+    models/llama._decode_layer_paged.  Returns (o, kc', vc').
+
+    A TP-sharded engine injects its mesh handle as the non-persisted
+    '_mesh'/'_mp_axis' config entries (serving._resolve_decode_chain) —
+    popped here before build, so the cache stores the pure schedule and
+    the live mesh object never leaks into a verdict file."""
+    config = dict(config)
+    mesh = config.pop("_mesh", None)
+    mp_axis = config.pop("_mp_axis", "mp")
+    spec = spec_from_arrays(kc, q, tables, mesh=mesh, mp_axis=mp_axis)
     return spec.build(config)(kc, vc, q, kn, vn, tables, lens)
+
+
+def fused_prefill_attention(q, k, v, *, block_q, stage="take", kchunk=1):
+    """The prefill branch's fused seam (LlamaAttention.forward under
+    models/llama.prefill_chain_scope): one accepted-config Pallas
+    dispatch replacing the F.scaled_dot_product_attention core for a
+    [1, S, n, h] chunk against [1, T, n, h] post-repeat K/V.  Callers
+    gate on divisibility (S % block_q, T % kchunk) — a chunk the config
+    doesn't tile keeps the XLA path."""
+    _b, s, n, h = q.shape
+    spec = PrefillChainSpec(seq=int(s), kv_len=int(k.shape[1]),
+                            num_heads=int(n), head_dim=int(h),
+                            dtype=np.dtype(q.dtype))
+    cfg = {"block_q": int(block_q), "stage": stage, "kchunk": int(kchunk)}
+    return spec.build(cfg)(q, k, v)

@@ -142,14 +142,23 @@ def reset_lora_stats():
 # engines that consulted the searcher for their macro-step geometry;
 # accepted = engines whose compiled macro-step adopted a fused config;
 # disabled = engines that kept the unfused ops (measured loss, cache
-# verdict, or a failed cache-config parity re-gate); mesh_skipped =
-# TP-sharded engines that skipped in-scan substitution (the fused kernel
-# is a single-device program — a counted skip, never a crash).
+# verdict, a failed cache-config parity re-gate, or a mesh-lint
+# violation on the sharded kernel); mesh_fused = the accepted subset
+# whose engine is TP-sharded (the shard_map chain over the mesh);
+# mesh_skipped = TP-sharded engines whose pools ride REPLICATED (head
+# counts the mp axis doesn't divide) — no head-local layout to fuse
+# over, a counted skip, never a crash.  prefill_chains_* mirror the same
+# verdict schema for the chunked-prefill attention chain
+# (PrefillChainSpec; single-device engines with prefill_chunk set).
 _SCHED_DECODE_STATS = {
     "decode_chains_found": 0,
     "decode_chains_accepted": 0,
     "decode_chains_disabled": 0,
     "decode_chains_mesh_skipped": 0,
+    "decode_chains_mesh_fused": 0,
+    "prefill_chains_found": 0,
+    "prefill_chains_accepted": 0,
+    "prefill_chains_disabled": 0,
 }
 
 
@@ -185,6 +194,7 @@ def _invalidate_decode_steps(_changed):
         # flags govern whether (and which) fused decode-chain schedule the
         # rebuilt steps may consume — re-resolve with the steps
         eng._decode_chain_cfg = _CHAIN_UNSET
+        eng._prefill_chain_cfg = _CHAIN_UNSET
 
 
 @dataclass
@@ -506,6 +516,7 @@ class GenerationEngine:
         self._decode_chunk = None if decode_chunk is None else int(decode_chunk)
         self._step_fns: dict = {}  # macro-step executables, keyed by D
         self._decode_chain_cfg = _CHAIN_UNSET  # lazy (_resolve_decode_chain)
+        self._prefill_chain_cfg = _CHAIN_UNSET  # lazy (_resolve_prefill_chain)
         # masked lanes' block tables (every page is the slot's scratch
         # page): constant, so committed to the device ONCE here — not
         # re-transferred on every dispatch
@@ -1017,13 +1028,21 @@ class GenerationEngine:
                 else:
                     # chunked prefill: fixed-size chunks through the cached
                     # forward (bottom-right-aligned cross-length attention)
-                    # cap the peak activation footprint for long prompts
-                    off = m_len
-                    while off < s0:
-                        chunk = prompt[:, off:off + self.prefill_chunk]
-                        h, caches = _model_forward_cached(
-                            model.model, paddle.to_tensor(chunk), caches, off)
-                        off += chunk.shape[1]
+                    # cap the peak activation footprint for long prompts.
+                    # An accepted prefill-chain config routes each
+                    # DIVISIBLE chunk's attention core through the fused
+                    # K-tiled kernel (schedule search; PrefillChainSpec)
+                    from paddle_tpu.models.llama import prefill_chain_scope
+
+                    pf_cfg = self._resolve_prefill_chain()
+                    with prefill_chain_scope(pf_cfg):
+                        off = m_len
+                        while off < s0:
+                            chunk = prompt[:, off:off + self.prefill_chunk]
+                            h, caches = _model_forward_cached(
+                                model.model, paddle.to_tensor(chunk),
+                                caches, off)
+                            off += chunk.shape[1]
                 logits_last = model._logits(h[:, -1:, :])._value[0, -1, :]
                 first = int(np.asarray(jnp.argmax(logits_last)))
 
@@ -1417,16 +1436,29 @@ class GenerationEngine:
         → parity → measure → measured-win gate) on a never-seen geometry
         — makes the compiled macro-step run the chain as ONE fused Pallas
         dispatch per layer per token; anything else keeps the unfused XLA
-        ops.  TP-sharded engines skip in-scan substitution with a counted
-        telemetry skip (the fused kernel is a single-device program), and
-        a flag change re-resolves alongside the invalidated step
-        executables."""
+        ops.  A flag change re-resolves alongside the invalidated step
+        executables.
+
+        TP-sharded engines search the MESH spec (schedule search over the
+        mesh, ROADMAP item 3): the spec carries the engine's mesh, so its
+        verdict caches under the (device kind, mesh shape) key, parity
+        gates against the sharded XLA twin, and the adopted kernel builds
+        inside shard_map over the committed pool layout.  Before adoption
+        the kernel's collectives are statically linted
+        (mesh_lint.lint_decode_chain) — a violation is a counted disable,
+        never a dispatch.  Engines whose pools ride replicated (head
+        counts the mp axis doesn't divide — the constructor's fallback)
+        keep the counted mesh skip: there is no head-local layout to fuse
+        over."""
         if self._decode_chain_cfg is not _CHAIN_UNSET:
             return self._decode_chain_cfg
         cfg = None
         if (_flags.flag("FLAGS_schedule_search")
                 and _flags.flag("FLAGS_schedule_search_decode")):
-            if self.mesh is not None:
+            mesh = self.mesh
+            n_heads = self.model.config.num_attention_heads
+            mp = mesh.get_dim_size(self._mp_axis) if mesh is not None else 1
+            if mesh is not None and (n_heads % mp or self._nkv % mp):
                 _SCHED_DECODE_STATS["decode_chains_mesh_skipped"] += 1
             else:
                 from paddle_tpu.ops import decode_chain as _dc
@@ -1434,7 +1466,7 @@ class GenerationEngine:
                 _SCHED_DECODE_STATS["decode_chains_found"] += 1
                 spec = _dc.DecodeChainSpec(
                     batch=self.max_batch,
-                    num_heads=self.model.config.num_attention_heads,
+                    num_heads=n_heads,
                     num_kv_heads=self._nkv,
                     head_dim=self._head_dim,
                     block_size=self.block_size,
@@ -1445,14 +1477,69 @@ class GenerationEngine:
                         jnp.bfloat16
                         if self.model.config.dtype == "bfloat16"
                         else jnp.float32),
+                    mesh=mesh,
+                    mp_axis=self._mp_axis,
                 )
                 decision = _dc.ensure_decision(spec)
-                if decision.accepted:
+                adopted = decision.accepted
+                if adopted and mesh is not None:
+                    from paddle_tpu.static.mesh_lint import lint_decode_chain
+
+                    if lint_decode_chain(spec, decision.config):
+                        adopted = False  # named violation → counted disable
+                if adopted:
                     cfg = dict(decision.config)
                     _SCHED_DECODE_STATS["decode_chains_accepted"] += 1
+                    if mesh is not None:
+                        # the live mesh handle rides NON-PERSISTED config
+                        # entries (fused_decode_step pops them): the cache
+                        # stores the pure schedule, the step builds the
+                        # shard_map chain
+                        cfg["_mesh"] = mesh
+                        cfg["_mp_axis"] = self._mp_axis
+                        _SCHED_DECODE_STATS["decode_chains_mesh_fused"] += 1
                 else:
                     _SCHED_DECODE_STATS["decode_chains_disabled"] += 1
         self._decode_chain_cfg = cfg
+        return cfg
+
+    def _resolve_prefill_chain(self):
+        """The chunked-prefill twin of _resolve_decode_chain
+        (PrefillChainSpec): engines with a fixed prefill_chunk search the
+        canonical mid-prompt geometry — an S=prefill_chunk query chunk
+        against a T=2·prefill_chunk cache span — and an accepted config
+        makes every DIVISIBLE chunk's attention core run as one K-tiled
+        Pallas dispatch under models.llama.prefill_chain_scope; chunks
+        the config doesn't tile keep the XLA path.  Single-device
+        engines only: mesh engines keep GSPMD prefill (the pour is
+        bandwidth-bound on the pool commit, not the attention core)."""
+        if self._prefill_chain_cfg is not _CHAIN_UNSET:
+            return self._prefill_chain_cfg
+        cfg = None
+        if (self.prefill_chunk is not None and self.mesh is None
+                and self.prefill_chunk >= 2
+                and _flags.flag("FLAGS_schedule_search")
+                and _flags.flag("FLAGS_schedule_search_decode")):
+            from paddle_tpu.ops import decode_chain as _dc
+
+            _SCHED_DECODE_STATS["prefill_chains_found"] += 1
+            spec = _dc.PrefillChainSpec(
+                seq=self.prefill_chunk,
+                kv_len=2 * self.prefill_chunk,
+                num_heads=self.model.config.num_attention_heads,
+                head_dim=self._head_dim,
+                dtype=jnp.dtype(
+                    jnp.bfloat16
+                    if self.model.config.dtype == "bfloat16"
+                    else jnp.float32),
+            )
+            decision = _dc.ensure_decision(spec)
+            if decision.accepted:
+                cfg = dict(decision.config)
+                _SCHED_DECODE_STATS["prefill_chains_accepted"] += 1
+            else:
+                _SCHED_DECODE_STATS["prefill_chains_disabled"] += 1
+        self._prefill_chain_cfg = cfg
         return cfg
 
     def _build_step(self, chunk: int):

@@ -67,6 +67,7 @@ __all__ = [
     "lint_program",
     "lint_train_step",
     "lint_engine",
+    "lint_decode_chain",
     "mesh_lint_stats",
     "reset_mesh_lint_stats",
 ]
@@ -773,3 +774,63 @@ def lint_engine(engine, mesh=None, raise_on_error=False, **kwargs):
     violations, est = linter.lint_engine(engine)
     _finish(violations, "Mesh lint failed (GenerationEngine)", raise_on_error)
     return violations, est
+
+
+def _chain_avals(spec):
+    """Abstract engine-shaped args of a DecodeChainSpec's canonical
+    (kc, vc, q, kn, vn, tables, lens) signature — ShapeDtypeStructs only,
+    so the lint trace never allocates a pool."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import paged_attention as pa
+
+    sds = jax.ShapeDtypeStruct
+    pool_dt = jnp.int8 if spec.kv == "int8" else jnp.dtype(spec.dtype)
+    pool_shape = (spec.num_blocks, spec.num_kv_heads, spec.block_size,
+                  spec.head_dim)
+    if spec.kv == "int8":
+        def quant():
+            return pa.QuantPool(
+                sds(pool_shape, pool_dt),
+                sds((spec.num_blocks, spec.num_kv_heads), jnp.float32))
+
+        kc, vc = quant(), quant()
+    else:
+        kc, vc = sds(pool_shape, pool_dt), sds(pool_shape, pool_dt)
+    dt = jnp.dtype(spec.dtype)
+    return (kc, vc,
+            sds((spec.batch, spec.num_heads, spec.head_dim), dt),
+            sds((spec.batch, spec.num_kv_heads, spec.head_dim), dt),
+            sds((spec.batch, spec.num_kv_heads, spec.head_dim), dt),
+            sds((spec.batch, spec.max_blocks), jnp.int32),
+            sds((spec.batch,), jnp.int32))
+
+
+def lint_decode_chain(spec, config, mesh=None, raise_on_error=False,
+                      **kwargs):
+    """Statically check a fused decode-chain kernel's collectives BEFORE
+    an engine adopts the config (docs/MESH_LINT.md kernel-collective
+    check): abstractly trace ``spec.build(config)`` over engine-shaped
+    avals and walk the jaxpr — shard_map mesh congruence, collective
+    axis/size checks, conditional collectives — without ever executing
+    the kernel.  A head-local sharded chain walks clean (zero in-kernel
+    collectives is the layout's contract); anything else is a named
+    violation the adopt path turns into a counted disable.  Same
+    authority rule as lint_engine: the spec's OWN mesh judges it — a
+    single-device spec lints mesh-less regardless of session state."""
+    if mesh is None:
+        mesh = getattr(spec, "mesh", None) or {}
+    linter = MeshLinter(mesh=mesh, **kwargs)
+    try:
+        fn = spec.build(config)
+    except Exception as e:
+        violations = [MeshViolation(
+            "unknown-axis",
+            f"decode-chain build rejected the config before trace: {e}",
+            spec.label())]
+        return _finish(violations, "Mesh lint failed (decode chain)",
+                       raise_on_error)
+    violations = linter.lint_callable(fn, *_chain_avals(spec),
+                                      site=spec.label())
+    return _finish(violations, "Mesh lint failed (decode chain)",
+                   raise_on_error)

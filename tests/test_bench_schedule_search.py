@@ -6,12 +6,20 @@ parses, with the deterministic decision set intact: the matmul chain's
 searched schedule accepted with a >1x recorded win, the K-tiled twin
 accepted through a genuinely contraction-split config (phase 2), the
 softmax chain's schedule disabled by the measured-win gate, the decode
-hot chain accepted for bf16 and disabled-persisted for int8, the disabled
-entries never re-measured on a cold reload, and the fused paths matching
-XLA-only numerics.  Plus: the payload must flow through
+hot chain accepted for bf16 and disabled-persisted for int8, the 2-device
+mesh engine adopting a fused decode-chain verdict (mesh_fused > 0) keyed
+by (device kind, mesh shape) with streams bit-identical to the search-off
+sharded twin, the K-tiled prefill-attention candidate accepted, the
+disabled entries never re-measured on a cold reload, and the fused paths
+matching XLA-only numerics.  Plus: the payload must flow through
 tools/check_bench_regression.py (the CI bench gate), including the new
 decode-chain section's win-to-win gate with disabled sides skipped
 honestly.
+
+The smoke subprocess dispatches GSPMD-partitioned decode programs over
+the in-process multi-device XLA:CPU communicator (the intermittent
+SIGSEGV class tools/run_tier1.py contains) — this module rides a
+DEDICATED isolated worker (ISOLATED_DEFAULT), never a round-robin shard.
 """
 
 import json
@@ -63,8 +71,19 @@ def test_bench_schedule_search_smoke_decisions():
     assert dec["bf16"]["config"]["layout"] == "batch"
     assert not dec["int8"]["accepted"]
     assert dec["int8"]["disabled_persisted"] is True
+    # schedule search over the mesh: the 2-device engine ADOPTED a fused
+    # verdict, keyed by mesh shape, with streams matching the sharded twin
+    mesh = dec["mesh"]
+    assert mesh["mesh_fused"] >= 1 and mesh["mesh_skipped"] == 0
+    assert mesh["streams_identical"] is True
+    assert mesh["win"] > 1.0
+    assert "mesh=mp2" in mesh["cache_key_mesh"]
+    # the K-tiled prefill-attention candidate joined the same search
+    pf = dec["prefill"]
+    assert pf["accepted"] and pf["win"] > 1.0
+    assert pf["config"]["block_q"] >= 2
     counters = detail["counters"]
-    assert counters["accepted"] == 3 and counters["disabled"] == 2
+    assert counters["accepted"] == 5 and counters["disabled"] == 2
     assert counters["measured"] > 0 and counters["disabled_hits"] >= 2
     assert counters["cache_hits"] >= 1  # accepted decode config re-served
 
@@ -103,29 +122,34 @@ def test_decode_chain_payload_gated(tmp_path):
     finally:
         sys.path.pop(0)
 
-    def payload(bf16_win, int8_win):
+    def payload(**wins):
         return json.dumps({
             "metric": "schedule_search_measured_win", "value": 2.5,
             "unit": "x",
             "detail": {"decode_chain": {
-                "bf16": {"win": bf16_win,
-                         "disabled_persisted": bf16_win == 0.0},
-                "int8": {"win": int8_win,
-                         "disabled_persisted": int8_win == 0.0},
+                kv: {"win": w, "disabled_persisted": w == 0.0}
+                for kv, w in wins.items()
             }},
         })
 
     old = tmp_path / "old.json"
     new = tmp_path / "new.json"
-    # same wins -> ok
-    old.write_text(payload(1.8, 1.4))
-    new.write_text(payload(1.8, 1.4))
+    # same wins -> ok (the loop is generic over variant names, so the
+    # mesh and prefill variants ride the same gate)
+    old.write_text(payload(bf16=1.8, int8=1.4, mesh=2.5))
+    new.write_text(payload(bf16=1.8, int8=1.4, mesh=2.5))
     assert gate.main([str(old), str(new)]) == 0
     # one variant's win collapses beyond the threshold -> regression
-    new.write_text(payload(1.8, 1.0))
+    new.write_text(payload(bf16=1.8, int8=1.0, mesh=2.5))
+    assert gate.main([str(old), str(new)]) == 1
+    # the MESH variant's win collapsing regresses too
+    new.write_text(payload(bf16=1.8, int8=1.4, mesh=1.0))
     assert gate.main([str(old), str(new)]) == 1
     # the variant going DISABLED (honest measured loss) skips, not fails
-    new.write_text(payload(1.8, 0.0))
+    new.write_text(payload(bf16=1.8, int8=0.0, mesh=2.5))
+    assert gate.main([str(old), str(new)]) == 0
+    # a side missing a variant entirely (pre-mesh round) skips it
+    new.write_text(payload(bf16=1.8, int8=1.4))
     assert gate.main([str(old), str(new)]) == 0
     # both sides pre-phase-2 (no section) skip silently
     base = json.dumps({"metric": "schedule_search_measured_win",

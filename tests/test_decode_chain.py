@@ -329,6 +329,44 @@ def test_flag_change_rearms_engine_verdict(sched_flags):
     assert len(eng.result("a")) == 6
 
 
+def test_engine_prefill_chain_adopted_streams_match(sched_flags):
+    """Long-prompt pours stop being a pure XLA chain: an engine with a
+    fixed prefill_chunk searches the fused prefill-attention candidate at
+    the canonical chunk geometry, and an adoption runs every divisible
+    chunk's attention core as one Pallas dispatch — with the poured
+    stream BIT-IDENTICAL to the search-off engine.  A measured loss
+    keeps the XLA pour and counts as disabled, streams unchanged."""
+    from paddle_tpu.serving import GenerationEngine
+
+    def run():
+        eng = GenerationEngine(_model(), max_batch=2, block_size=8,
+                               num_blocks=16, prefill_chunk=4)
+        eng.add_request("p", list(range(1, 21)), max_new_tokens=6)
+        while eng.has_work():
+            eng.step()
+        return eng.result("p")
+
+    ref = run()
+    paddle.set_flags({"FLAGS_schedule_search": True})
+    with ss.measure_override(_win):
+        got = run()
+    assert got == ref
+    stats = serving.schedule_decode_stats()
+    assert stats["prefill_chains_found"] == 1
+    assert stats["prefill_chains_accepted"] == 1
+    # the measured-loss twin: honest disable, same stream
+    serving.reset_schedule_decode_stats()
+    at._CACHES.clear()
+    paddle.set_flags({"FLAGS_autotune_cache_dir":
+                      str(sched_flags / "lose")})
+    with ss.measure_override(_lose):
+        got2 = run()
+    assert got2 == ref
+    stats = serving.schedule_decode_stats()
+    assert stats["prefill_chains_disabled"] == 1
+    assert stats["prefill_chains_accepted"] == 0
+
+
 def test_profiler_merges_decode_counters_and_footer(sched_flags):
     paddle.set_flags({"FLAGS_schedule_search": True})
     with ss.measure_override(_win):

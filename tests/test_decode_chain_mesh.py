@@ -1,0 +1,413 @@
+"""Sharded decode-chain fusion: schedule search over the mesh
+(ops/decode_chain.py mesh view + serving adoption; docs/SCHEDULE_SEARCH.md
+mesh section).
+
+The contract under test: a DecodeChainSpec carrying the engine's mesh is
+a first-class search subject — its verdict caches under the (device kind,
+mesh shape) key and NEVER cross-serves the single-device verdict (or vice
+versa); its roofline costs PER-DEVICE traffic from
+``NamedSharding.shard_shape`` plus the epilogue's psum bytes; its kernel
+builds inside shard_map over the committed fsdp×tp pool layout and every
+candidate passes parity against the SHARDED XLA twin (the mesh adds NO
+drift: bf16 chains stay bit-exact leaf for leaf).  An engine that adopts
+a fused mesh verdict emits token streams BIT-IDENTICAL to the
+single-device engine — full-precision and int8 pools, plain and
+LoRA-adapter-pack workloads, on 2/4/8-device CPU meshes.  The K-tiled
+fused prefill-attention candidate (PrefillChainSpec) rides the same
+search with a bit-exact gate.
+
+Every engine test dispatches GSPMD-partitioned decode programs (now with
+an interpret-mode Pallas body inside shard_map) over the in-process
+multi-device XLA:CPU communicator — the intermittent SIGSEGV class
+tools/run_tier1.py contains — so this module rides a DEDICATED isolated
+worker (ISOLATED_DEFAULT); the 4- and 8-device stream-parity cases
+additionally run through run_isolated_test subprocess workers so they
+stay in tier-1 un-slow-marked.
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.distributed.auto_parallel import ProcessMesh
+from paddle_tpu.ops import autotune as at
+from paddle_tpu.ops import decode_chain as dc
+from paddle_tpu.static import schedule_search as ss
+
+
+@pytest.fixture()
+def tmp_cache(tmp_path):
+    """Fresh autotune cache under a tmp dir + zeroed search counters."""
+    paddle.set_flags({"FLAGS_autotune_cache_dir": str(tmp_path)})
+    at._CACHES.clear()
+    ss.reset_schedule_search_stats()
+    serving.reset_schedule_decode_stats()
+    yield tmp_path
+    paddle.set_flags({"FLAGS_autotune_cache_dir": ""})
+    at._CACHES.clear()
+    ss.reset_schedule_search_stats()
+    serving.reset_schedule_decode_stats()
+
+
+def _mesh(mp):
+    return ProcessMesh(np.arange(mp), ["mp"])
+
+
+def _spec(kv="bf16", mp=None, **kw):
+    base = dict(batch=2, num_heads=4, num_kv_heads=2, head_dim=8,
+                block_size=4, max_blocks=2, num_blocks=8, kv=kv,
+                dtype=np.float32)
+    base.update(kw)
+    if mp:
+        base.setdefault("mesh", _mesh(mp))
+    return dc.DecodeChainSpec(**base)
+
+
+def _win(fn, args, *, label, config):
+    return 0.4 if config is not None else 1.0
+
+
+def _lose(fn, args, *, label, config):
+    return 4.0 if config is not None else 1.0
+
+
+# ------------------------------------------------------------ spec tier
+
+
+def test_mesh_key_carries_mesh_shape():
+    """(device kind, mesh shape) keying: the cache file is already per
+    device kind; the key dict grows a 'mesh' entry ONLY when a mesh is
+    set, so existing single-device key strings stay byte-stable."""
+    single, meshed = _spec(), _spec(mp=2)
+    assert "mesh" not in single.key()
+    k = meshed.key()
+    assert k["mesh"] == "mp2"
+    assert {kk: v for kk, v in k.items() if kk != "mesh"} == single.key()
+    assert _spec(mp=4, num_kv_heads=4).key()["mesh"] == "mp4"
+    assert "mesh=mp2" in meshed.label()
+
+
+def test_device_spec_divides_heads_via_shard_shape():
+    """The per-device replica's head counts come from
+    NamedSharding.shard_shape over the committed pool/head layouts — the
+    same source pool_device_nbytes uses — and the mesh spec's roofline
+    inputs (traffic, flops, vmem) are the PER-DEVICE numbers."""
+    meshed = _spec(mp=2)
+    local = meshed.device_spec()
+    assert local.mesh is None
+    assert (local.num_heads, local.num_kv_heads) == (2, 1)
+    cfg = {"layout": "batch", "gather": "take"}
+    # head-local layout: zero in-kernel collectives, so per-device
+    # traffic IS the local spec's traffic — and less than the global twin
+    assert meshed.collective_bytes(cfg) == 0
+    assert meshed.traffic_bytes(cfg) == local.traffic_bytes(cfg)
+    assert meshed.traffic_bytes(cfg) < _spec().traffic_bytes(cfg)
+    assert meshed.flops() == local.flops() < _spec().flops()
+    assert meshed.vmem_bytes(cfg) == local.vmem_bytes(cfg)
+
+
+def test_non_divisible_heads_cost_psum_and_refuse_build():
+    """A geometry whose kv groups would split across devices costs the
+    epilogue psum honestly ([b, n_local, h] f32) and build() refuses it
+    loudly — no candidate implements the reduction."""
+    bad = _spec(mp=2, num_kv_heads=1)  # n=4 divides, nkv=1 doesn't
+    cfg = {"layout": "batch", "gather": "take"}
+    assert bad.collective_bytes(cfg) == 2 * 2 * 8 * 4  # b * ceil(n/mp) * h * 4
+    with pytest.raises(ValueError, match="divisible"):
+        bad.build(cfg)
+
+
+@pytest.mark.parametrize("kv", ["bf16", "int8"])
+def test_mesh_candidates_parity_vs_sharded_twin(kv):
+    """The PR-11 contract holds THROUGH the mesh: every sharded candidate
+    passes parity against the sharded XLA twin (synthetic args committed
+    to the engine's layout), and bf16 chains stay bit-exact leaf for
+    leaf — the mesh adds NO drift."""
+    spec = _spec(kv, mp=2)
+    args = spec.synthetic_args()
+    ref = jax.jit(spec.reference())(*args)
+    for cfg in spec.enumerate_configs():
+        fn = jax.jit(spec.build(cfg))
+        assert spec.parity_ok(fn, args, ref), cfg
+        if kv == "bf16":
+            got = fn(*args)
+            for r, g in zip(jax.tree_util.tree_leaves(ref),
+                            jax.tree_util.tree_leaves(got)):
+                assert bool((r == g).all()), cfg
+
+
+def test_verdict_cache_never_cross_served(tmp_cache):
+    """The pollution regression (satellite): a cached single-device
+    verdict is NEVER served to the mesh spec of the same geometry, and
+    vice versa — each side searches fresh, persists its own entry, and a
+    cold reload serves each spec ITS OWN verdict.  Proven by making the
+    two verdicts DIFFER (accept vs disable) in both directions."""
+    single, meshed = _spec(), _spec(mp=2)
+    with ss.measure_override(_win):
+        assert dc.ensure_decision(single).status == "accepted"
+    with ss.measure_override(_lose):
+        # pollution would serve the accepted single-device config here
+        assert dc.ensure_decision(meshed).status == "disabled"
+    # the opposite direction on a second geometry: mesh accepts first
+    single4, meshed4 = _spec(batch=4), _spec(batch=4, mp=2)
+    with ss.measure_override(_win):
+        assert dc.ensure_decision(meshed4).status == "accepted"
+    with ss.measure_override(_lose):
+        assert dc.ensure_decision(single4).status == "disabled"
+    # distinct persisted entries under one kernel namespace, keyed apart
+    raw = json.load(open(os.path.join(
+        str(tmp_cache), at.device_kind_slug() + ".json")))
+    keys = list(raw["schedule/decode_bf16"])
+    assert len(keys) == 4
+    assert sum("mesh=mp2" in k for k in keys) == 2
+    # cold reload: zero measures, each spec gets its OWN verdict back
+    at._CACHES.clear()
+    calls = []
+
+    def counting(fn, args, *, label, config):
+        calls.append(label)
+        return 1.0
+
+    with ss.measure_override(counting):
+        assert dc.ensure_decision(single).status == "cache"
+        assert dc.ensure_decision(meshed).status == "cache_disabled"
+        assert dc.ensure_decision(meshed4).status == "cache"
+        assert dc.ensure_decision(single4).status == "cache_disabled"
+    assert calls == []
+
+
+def test_lint_decode_chain_own_and_foreign_mesh():
+    """The pre-dispatch static check tools/lint_mesh.py also runs: the
+    head-local sharded kernel walks with ZERO collectives against its
+    own mesh; judged against a foreign session mesh it is flagged, never
+    dispatched."""
+    from jax.sharding import Mesh
+    from paddle_tpu.static.mesh_lint import lint_decode_chain
+
+    cfg = {"layout": "batch", "gather": "take"}
+    assert lint_decode_chain(_spec("int8", mp=2), cfg) == []
+    assert lint_decode_chain(_spec("bf16"), cfg) == []  # single-device
+    foreign = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    viol = lint_decode_chain(_spec("int8", mp=2), cfg, mesh=foreign)
+    assert viol and {v.code for v in viol} == {"unknown-axis"}
+
+
+# ------------------------------------------------- prefill chain (spec)
+
+
+def test_prefill_candidates_pin_full_chunk_tile():
+    """block_q is pinned to the WHOLE chunk (a sub-tile's re-fused XLA
+    reduction can drift ~1e-7, shape-dependently — even past the parity
+    gate's geometry), and single-token chunks enumerate NOTHING:
+    jax.nn.dot_product_attention special-cases single-row queries with a
+    re-associated reduction."""
+    spec = dc.PrefillChainSpec(seq=4, kv_len=8, num_heads=2, head_dim=4)
+    cfgs = spec.enumerate_configs()
+    assert cfgs and {c["block_q"] for c in cfgs} == {4}
+    assert {c["stage"] for c in cfgs} == {"take", "loop"}
+    for c in cfgs:
+        if c["stage"] == "loop":
+            assert 8 % c["kchunk"] == 0
+    assert dc.PrefillChainSpec(seq=1, kv_len=4, num_heads=2,
+                               head_dim=4).enumerate_configs() == []
+
+
+@pytest.mark.parametrize("seq,kv_len", [(8, 8), (8, 16)])
+def test_prefill_all_candidates_bit_exact(seq, kv_len):
+    """Every prefill candidate — square first chunk AND bottom-right
+    mid-prompt chunk — is BIT-EXACT vs the _core XLA twin; staging K/V
+    in kchunk pieces is pure data movement."""
+    spec = dc.PrefillChainSpec(seq=seq, kv_len=kv_len, num_heads=4,
+                               head_dim=8)
+    args = spec.synthetic_args()
+    ref = jax.jit(spec.reference())(*args)
+    for cfg in spec.enumerate_configs():
+        fn = jax.jit(spec.build(cfg))
+        assert spec.parity_ok(fn, args, ref), cfg
+        assert bool((fn(*args) == ref).all()), cfg
+
+
+def test_fused_prefill_attention_public_entry():
+    """The adoption entry point models/llama uses: derives the spec from
+    live shapes and stays bit-exact under jit — the engine seam always
+    dispatches it through the jitted apply funnel, so jit is the honest
+    comparison context — including the K-staged loop path."""
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 8, 4, 8), jnp.float32)
+    k = jax.random.normal(kk, (1, 16, 4, 8), jnp.float32)
+    v = jax.random.normal(kv_, (1, 16, 4, 8), jnp.float32)
+    spec = dc.PrefillChainSpec(seq=8, kv_len=16, num_heads=4, head_dim=8)
+    ref = jax.jit(spec.reference())(q, k, v)
+    for cfg in ({"block_q": 8, "stage": "take"},
+                {"block_q": 8, "stage": "loop", "kchunk": 4}):
+        fused = jax.jit(lambda a, b, c, _cfg=cfg: dc.fused_prefill_attention(
+            a, b, c, block_q=_cfg["block_q"], stage=_cfg["stage"],
+            kchunk=_cfg.get("kchunk", 1)))
+        assert bool((fused(q, k, v) == ref).all()), cfg
+
+
+# ----------------------------------------------------------- engine tier
+
+
+def _model(seed=41, n=4, nkv=2, hidden=32):
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(seed)
+    m = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=hidden, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=n,
+        num_key_value_heads=nkv, max_position_embeddings=64,
+        dtype="float32"))
+    m.eval()
+    return m
+
+
+def _workload(eng):
+    """Greedy + mid-flight seeded-sampling join — the stream shape every
+    fused-vs-unfused comparison replays identically."""
+    eng.add_request("g", [5, 9, 17, 33, 2], max_new_tokens=8)
+    eng.step()
+    eng.add_request("s", [7, 11, 3], max_new_tokens=6, temperature=3.0,
+                    seed=42)
+    while eng.has_work():
+        eng.step()
+    return {"g": eng.result("g"), "s": eng.result("s")}
+
+
+def _stream_parity_body(mp, n, nkv, kv="bf16", cache_dir=None):
+    """Shared payload: single-device search-off engine vs mp-device
+    search-on engine — streams must be bit-identical AND the mesh engine
+    must have adopted a fused verdict (decode_chains_mesh_fused > 0)."""
+    from paddle_tpu.serving import GenerationEngine, schedule_decode_stats
+
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix="dcm_cache_")
+    paddle.set_flags({"FLAGS_autotune_cache_dir": cache_dir})
+    at._CACHES.clear()
+    serving.reset_schedule_decode_stats()
+    kw = dict(max_batch=2, block_size=8, num_blocks=16, kv_cache_dtype=kv)
+    try:
+        ref = _workload(GenerationEngine(_model(n=n, nkv=nkv), **kw))
+        paddle.set_flags({"FLAGS_schedule_search": True})
+        with ss.measure_override(_win):
+            got = _workload(GenerationEngine(_model(n=n, nkv=nkv),
+                                             mesh=_mesh(mp), **kw))
+    finally:
+        paddle.set_flags({"FLAGS_schedule_search": False,
+                          "FLAGS_autotune_cache_dir": ""})
+        at._CACHES.clear()
+    assert got == ref, (got, ref)
+    stats = schedule_decode_stats()
+    assert stats["decode_chains_mesh_fused"] >= 1, stats
+
+
+@pytest.mark.parametrize("kv", ["bf16", "int8"])
+def test_mesh_fused_streams_match_single_device(tmp_cache, kv):
+    """The acceptance crux over the mesh: a 2-device engine that ADOPTED
+    a fused sharded decode chain emits streams bit-identical to the
+    single-device engine — greedy and seeded sampling, bf16 AND int8
+    pools."""
+    _stream_parity_body(2, n=4, nkv=2, kv=kv, cache_dir=str(tmp_cache))
+
+
+def _adapter_sd(base, key_seed, n=4, nkv=4, rank=4):
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.nn.lora import apply_lora, lora_state_dict
+
+    ft = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=n,
+        num_key_value_heads=nkv, max_position_embeddings=64,
+        dtype="float32"))
+    ft.set_state_dict(base.state_dict())
+    ft.eval()
+    apply_lora(ft, rank=rank, alpha=8)
+    key = jax.random.PRNGKey(key_seed)
+    for name, p in ft.named_parameters():
+        if name.endswith(("lora_A", "lora_B")):
+            key, sk = jax.random.split(key)
+            scale = 0.2 if name.endswith("lora_B") else 0.05
+            p._bind(jax.random.normal(sk, p._value.shape,
+                                      jnp.float32) * scale)
+    return lora_state_dict(ft)
+
+
+def test_mesh_fused_chain_composes_with_adapter_packs(tmp_cache):
+    """LoRA adapter packs × fused sharded chain: a 2-device adapter
+    engine that adopted the fused decode chain serves mixed-tenant
+    batches (two tenants + a base row + a sampled adapter row)
+    bit-identical to the single-device adapter engine with search off."""
+    from paddle_tpu.serving import GenerationEngine, schedule_decode_stats
+
+    base = _model(n=4, nkv=4)
+    sds = {f"t{i}": _adapter_sd(base, key_seed=10 + i) for i in range(2)}
+
+    def run(mesh):
+        eng = GenerationEngine(_model(n=4, nkv=4), max_batch=4,
+                               block_size=8, num_blocks=32,
+                               adapters={"rank": 4, "max_adapters": 2},
+                               mesh=mesh)
+        for name, sd in sds.items():
+            eng.register_adapter(name, sd, alpha=8)
+        prompts = {"a0": ([5, 9, 17, 33, 2], "t0"),
+                   "a1": ([7, 11, 3, 20], "t1"),
+                   "base": ([5, 9, 17, 33, 2], None)}
+        for rid, (prompt, ad) in prompts.items():
+            eng.add_request(rid, prompt, max_new_tokens=6, adapter=ad)
+        eng.add_request("samp", [15, 4, 40], max_new_tokens=5,
+                        temperature=2.5, seed=9, adapter="t0")
+        while eng.has_work():
+            eng.step()
+        return {rid: eng.result(rid) for rid in list(prompts) + ["samp"]}
+
+    ref = run(None)
+    assert len({tuple(v) for v in ref.values()}) >= 3  # tenants differ
+    serving.reset_schedule_decode_stats()
+    paddle.set_flags({"FLAGS_schedule_search": True})
+    try:
+        with ss.measure_override(_win):
+            got = run(_mesh(2))
+    finally:
+        paddle.set_flags({"FLAGS_schedule_search": False})
+    assert got == ref
+    assert schedule_decode_stats()["decode_chains_mesh_fused"] >= 1
+
+
+# ------------------------------------------- 4/8-device isolated workers
+
+
+def _mp4_body():
+    """4-device stream parity, run in a crash-isolated subprocess: the
+    8-virtual-device XLA:CPU communicator under a shard_map'd Pallas
+    body is squarely the intermittent SIGSEGV class run_tier1 contains."""
+    _stream_parity_body(4, n=4, nkv=4)
+
+
+def _mp8_body():
+    """8-device twin of _mp4_body (n=nkv=8, head_dim 4)."""
+    _stream_parity_body(8, n=8, nkv=8)
+
+
+def test_mesh_fused_streams_match_single_device_mp4():
+    """4-device case IN tier-1 (not slow-marked): the payload rides
+    tools/run_tier1.py's crash-isolated worker — a SIGSEGV is a
+    contained retry, an assertion failure fails immediately."""
+    from tools.run_tier1 import run_isolated_test
+
+    run_isolated_test("tests.test_decode_chain_mesh", "_mp4_body",
+                      retries=2, timeout=300)
+
+
+def test_mesh_fused_streams_match_single_device_mp8():
+    """8-device twin — full mesh width, same containment."""
+    from tools.run_tier1 import run_isolated_test
+
+    run_isolated_test("tests.test_decode_chain_mesh", "_mp8_body",
+                      retries=2, timeout=300)

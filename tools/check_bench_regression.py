@@ -44,11 +44,15 @@ deterministic host math, so any growth means a schedule table got worse
 — and skip silently on pre-schedule payloads.
 
 Schedule-search payloads carrying the decode-chain section
-(bench_schedule_search.py detail.decode_chain: per-kv-variant
-win-or-disabled verdicts) gate each variant's measured win like the
-headline metric; a DISABLED side (win 0 — an honest measured loss, e.g.
-CPU interpret mode) skips that variant rather than fabricating a signal,
-and is never recorded as value=0 by the bench in the first place.
+(bench_schedule_search.py detail.decode_chain: per-variant
+win-or-disabled verdicts — kv dtypes "bf16"/"int8", plus "mesh" for the
+2-device sharded-engine verdict keyed by (device kind, mesh shape) and
+"prefill" for the K-tiled fused prefill-attention candidate) gate each
+variant's measured win like the headline metric; a DISABLED side (win 0
+— an honest measured loss, e.g. CPU interpret mode) skips that variant
+rather than fabricating a signal, and is never recorded as value=0 by
+the bench in the first place.  The loop is generic over variant names,
+so sides missing a variant (pre-mesh rounds) skip it silently.
 """
 
 from __future__ import annotations

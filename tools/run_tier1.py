@@ -65,6 +65,12 @@ ISOLATED_DEFAULT = (
     "test_serving_mesh.py",
     "test_serving_mesh_spec.py",
     "test_engine_snapshot_mesh.py",
+    # Sharded decode-chain fusion: shard_map'd interpret-mode Pallas
+    # bodies inside jitted decode scans on 2/4/8-device meshes, plus
+    # run_isolated_test subprocess workers of its own — and the bench
+    # smoke test, whose subprocess drives the same 2-device engine.
+    "test_decode_chain_mesh.py",
+    "test_bench_schedule_search.py",
     # The serving-cluster modules fork real engine/router processes and
     # SIGKILL them mid-protocol (heartbeat fail-over, drain migration,
     # the cluster crash matrix, the fail-over bench) — never in a shared
