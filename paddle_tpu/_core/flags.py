@@ -107,6 +107,22 @@ define_flag(
     "serving.GenerationEngine; 1 = per-token dispatch",
 )
 define_flag(
+    "FLAGS_prefill_chunk_blocks",
+    0,
+    "Per-macro-step prefill budget for interleaved chunked prefill, in pool "
+    "blocks: each serving step() runs at most this many block-sized prefill "
+    "chunks before the decode dispatch (deadline pressure may double it; "
+    "serving.GenerationEngine).  0 = atomic prefill at admission (legacy)",
+)
+define_flag(
+    "FLAGS_preempt_low_priority",
+    True,
+    "Allow the serving admission scheduler to preempt LOW-priority requests "
+    "when a higher-priority request cannot be admitted: their pool pages are "
+    "parked host-side and the stream resumes bit-identically on re-admission "
+    "(submit-time nonces; serving.GenerationEngine)",
+)
+define_flag(
     "FLAGS_compilation_cache_dir",
     "",
     "Directory for JAX's persistent XLA compilation cache: warm process "

@@ -32,6 +32,7 @@ __all__ = [
     "paged_write",
     "paged_write_chunk",
     "paged_pour_blocks",
+    "paged_pour_block",
     "paged_gather",
     "gathered_attention",
     "paged_decode_attention",
@@ -338,6 +339,19 @@ def paged_pour_blocks(cache, kv, block_ids):
         return QuantPool(cache.data.at[idx].set(q),
                          cache.scale.at[idx].set(s))
     return cache.at[idx].set(kv.astype(cache.dtype))
+
+
+def paged_pour_block(cache, kv, block_id):
+    """Pour ONE block — the chunked-prefill entry (interleaved prefill
+    pours each prompt block as its chunk completes; serving docs/DECODE.md
+    admission scheduler).
+
+    kv: [Nkv, bs, H] float values.  Delegates to `paged_pour_blocks` with
+    n=1, so a quantized pool's per-block-per-head scale is the amax of
+    exactly this block's content — the SAME scale (and therefore the same
+    int8 bytes) the batched atomic pour computes for the block, which is
+    what makes the chunk boundary pure data movement."""
+    return paged_pour_blocks(cache, kv[None], [int(block_id)])
 
 
 def gathered_attention(q, keys, vals, seq_lens, *, scale=None):

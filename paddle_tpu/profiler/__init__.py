@@ -364,6 +364,12 @@ def decode_stats(reset: bool = False) -> dict:
     capacity tier: pool_bytes of the most recent engine, resident_peak
     concurrently-active requests, and derived pool_bytes_per_resident —
     the number int8 KV pools (FLAGS_kv_cache_dtype) roughly halve.
+    The overload-discipline tier (docs/DECODE.md admission scheduler):
+    prefill_chunks (interleaved block-sized prefill chunks run between
+    decode dispatches), preemptions / preempt_readmits (LOW-priority
+    parking traffic), parked_requests (a GAUGE of the live parking lot,
+    preserved across resets like the LoRA slot gauges), and the
+    per-SLO-class admitted_/completed_{high,normal,low} breakdown.
     Zeros when no engine ran.  Serving owns the counters — one schema,
     no drift."""
     from paddle_tpu import serving

@@ -226,6 +226,25 @@ def decode_line(stats: dict) -> str:
             % (stats["mesh_shape"], stats.get("pool_bytes_per_device", 0),
                stats.get("pool_bytes", 0))
         )
+    classes = sum(stats.get("admitted_" + c, 0)
+                  + stats.get("completed_" + c, 0)
+                  for c in ("high", "normal", "low"))
+    if (stats.get("prefill_chunks") or stats.get("preemptions")
+            or stats.get("parked_requests") or classes):
+        # overload-discipline tier: interleaved prefill chunks, the
+        # preemption parking lot, and the per-SLO-class breakdown
+        line += (
+            "\nServing admission: prefill_chunks=%d preemptions=%d "
+            "readmits=%d parked=%d; admitted h/n/l=%d/%d/%d "
+            "completed h/n/l=%d/%d/%d"
+            % (stats.get("prefill_chunks", 0), stats.get("preemptions", 0),
+               stats.get("preempt_readmits", 0),
+               stats.get("parked_requests", 0),
+               stats.get("admitted_high", 0), stats.get("admitted_normal", 0),
+               stats.get("admitted_low", 0), stats.get("completed_high", 0),
+               stats.get("completed_normal", 0),
+               stats.get("completed_low", 0))
+        )
     return line
 
 

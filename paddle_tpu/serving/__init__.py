@@ -69,6 +69,21 @@ _DECODE_STATS = {
     # Profiler.summary() serving footer prints both when sharded
     "pool_bytes_per_device": 0,
     "mesh_shape": "",
+    # overload-discipline tier (docs/DECODE.md admission scheduler):
+    # interleaved prefill chunks run between decode dispatches
+    # (FLAGS_prefill_chunk_blocks), LOW-priority preemptions (pages
+    # parked host-side) and their re-admissions, the parked-request
+    # GAUGE, and the per-priority-class admitted/completed breakdown
+    "prefill_chunks": 0,
+    "preemptions": 0,
+    "preempt_readmits": 0,
+    "parked_requests": 0,
+    "admitted_high": 0,
+    "admitted_normal": 0,
+    "admitted_low": 0,
+    "completed_high": 0,
+    "completed_normal": 0,
+    "completed_low": 0,
 }
 
 
@@ -94,6 +109,10 @@ def decode_stats(reset: bool = False) -> dict:
 
 def reset_decode_stats():
     for k in _DECODE_STATS:
+        if k == "parked_requests":
+            # a GAUGE of live engine state (like the LoRA slot gauges):
+            # a traffic-counter reset must not misreport the parking lot
+            continue
         v = _DECODE_STATS[k]
         _DECODE_STATS[k] = "" if isinstance(v, str) else (
             0.0 if isinstance(v, float) else 0)
@@ -197,6 +216,36 @@ def _invalidate_decode_steps(_changed):
         eng._prefill_chain_cfg = _CHAIN_UNSET
 
 
+# SLO classes for add_request(priority=): admission order is (class, submit
+# sequence) — FIFO within a class — and the deadline-pressure scheduler
+# weights prefill-chunk grants by class (docs/DECODE.md admission scheduler)
+_PRIORITY = {"high": 0, "normal": 1, "low": 2}
+_PRI_NAMES = {v: k for k, v in _PRIORITY.items()}
+# pressure = weight * (1 + boundaries waited): a request crossing
+# _PRESSURE_ESCALATE doubles the macro-step's prefill-chunk budget, so
+# HIGH escalates after 3 waited boundaries, NORMAL after 7, LOW after 15
+_PRI_WEIGHT = {0: 4, 1: 2, 2: 1}
+_PRESSURE_ESCALATE = 16
+
+
+@dataclass
+class _PrefillState:
+    """Host bookkeeping for a PREFILLING slot (interleaved chunked
+    prefill): pool pages and the slot are reserved at admission, then the
+    prompt advances ONE pool block per granted chunk between decode
+    dispatches — the chunk spans are fixed block-aligned offsets, never
+    schedule-dependent, which is what keeps the stream bit-identical to
+    atomic admission (the chunk boundary is pure data movement)."""
+    req: dict                 # the queued submission (rid/prompt/nonce/...)
+    caches: list              # naive per-layer K/V grown chunk-by-chunk
+    matched: list             # shared prefix-cache pages (referenced)
+    fresh: list               # exclusively owned pages (poured as we go)
+    off: int = 0              # prompt tokens already forwarded
+    poured: int = 0           # full blocks resident in the pool so far
+    since: int = 0            # macro-step boundary when prefill began
+    h: object = None          # last chunk's hidden states (first-token logits)
+
+
 @dataclass
 class _Slot:
     rid: object = None
@@ -211,6 +260,9 @@ class _Slot:
     key: object = None        # precomputed PRNG key (seed + request nonce)
     d_seq_len: int = 0        # draft-pool coverage (speculative tier)
     adapter_slot: int = 0     # AdapterPack slot (0 = base-model identity)
+    priority: int = 1        # SLO class (_PRIORITY; 2 = LOW = preemptible)
+    req: object = None        # original submission (preemption re-queues it)
+    prefill: object = None    # _PrefillState while PREFILLING, else None
 
 
 class _PoolExhausted(RuntimeError):
@@ -393,7 +445,8 @@ class GenerationEngine:
                  eos_token_id=None, mesh=None, mp_axis="mp",
                  prefill_chunk=None, draft_model=None,
                  num_speculative_tokens=4, decode_chunk=None,
-                 prefix_cache=None, kv_cache_dtype=None, adapters=None):
+                 prefix_cache=None, kv_cache_dtype=None, adapters=None,
+                 prefill_chunk_blocks=None):
         """mesh: optional ProcessMesh/jax Mesh with an `mp_axis` dimension —
         the engine then serves TENSOR-PARALLEL: weights get Megatron
         placements (models.llama.shard_llama), the paged-KV pool is sharded
@@ -445,12 +498,29 @@ class GenerationEngine:
         the DRAFT proposes with the base model (no per-tenant draft
         packs) while the target verifies through each row's adapter —
         emitted streams equal the plain adapter engine's; a
-        heavily-shifted tenant just pays a lower acceptance rate."""
+        heavily-shifted tenant just pays a lower acceptance rate.
+
+        prefill_chunk_blocks (None -> FLAGS_prefill_chunk_blocks):
+        INTERLEAVED chunked prefill — admission only reserves a slot and
+        pool pages; the prompt then advances at most this many pool-block
+        chunks per step() between decode dispatches (the PREFILLING
+        state), so a long prompt never stalls resident streams' inter-
+        token latency.  0 = atomic prefill at admission (legacy).
+        Streams are bit-identical to atomic admission: every chunk is a
+        fixed block-aligned span through the same cached forward, and
+        the per-block pour writes the same bytes (and the same
+        per-block quant scales) the atomic pour batches.  Ignored by
+        speculative engines (their draft pour rides atomic admission)."""
         cfg = model.config
         self.model = model
         if prefill_chunk is not None and int(prefill_chunk) < 1:
             raise ValueError("prefill_chunk must be a positive token count")
         self.prefill_chunk = None if prefill_chunk is None else int(prefill_chunk)
+        if prefill_chunk_blocks is not None and int(prefill_chunk_blocks) < 0:
+            raise ValueError("prefill_chunk_blocks must be >= 0 "
+                             "(0 = atomic prefill)")
+        self.prefill_chunk_blocks = (None if prefill_chunk_blocks is None
+                                     else int(prefill_chunk_blocks))
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
         self.eos_token_id = eos_token_id
@@ -507,6 +577,8 @@ class GenerationEngine:
               else bool(_flags.flag("FLAGS_prefix_cache")))
         self._prefix = RadixPrefixCache(self.block_size) if pc else None
         self._pending: deque = deque()  # admission retries (pool pressure)
+        self._parked: dict = {}   # rid -> parked record (preempted LOWs)
+        self._submit_seq = 0      # admission tie-break within an SLO class
         self._scratch = [self._num_blocks + i for i in range(self.max_batch)]
         self._slots = [_Slot() for _ in range(self.max_batch)]
         self._results: dict = {}
@@ -664,14 +736,29 @@ class GenerationEngine:
         # a DRAINING engine's queued requests are not its work: they rode
         # the drain snapshot and belong to the restore target (serving
         # them here too would double-serve; counting them here would make
-        # the lame-duck `while has_work(): step()` loop spin forever)
-        return any(s.active for s in self._slots) or (
-            bool(self._pending) and not self._draining)
+        # the lame-duck `while has_work(): step()` loop spin forever).
+        # PREFILLING slots and the parked lot count: both finish through
+        # future boundaries (drain() demotes them to the queue first).
+        return any(s.active or s.prefill is not None
+                   for s in self._slots) or (
+            (bool(self._pending) or bool(self._parked))
+            and not self._draining)
 
     def pending_requests(self):
         """Request ids queued for admission (pool pressure); they retry at
         the next macro-step boundary."""
         return [req["rid"] for req in self._pending]
+
+    def parked_requests(self):
+        """Request ids preempted into the host-side parking lot (their
+        pool pages live host-side; they re-admit bit-identically at a
+        later boundary — docs/DECODE.md preemption)."""
+        return list(self._parked)
+
+    def prefilling_requests(self):
+        """Request ids in the PREFILLING state (interleaved chunked
+        prefill in progress; docs/DECODE.md admission scheduler)."""
+        return [s.rid for s in self._slots if s.prefill is not None]
 
     def result(self, rid):
         return self._results.get(rid)
@@ -844,9 +931,12 @@ class GenerationEngine:
         slot.blocks = []
         slot.active = False
         slot.rid = None
+        slot.req = None
+        slot.prefill = None
 
     def add_request(self, rid, prompt_ids, max_new_tokens=16,
-                    temperature=None, seed=0, adapter=None, nonce=None):
+                    temperature=None, seed=0, adapter=None, nonce=None,
+                    priority="normal"):
         """Prefill the prompt, pour K/V into pool pages, occupy a slot.
 
         With the prefix cache on, the longest cached token-id prefix is
@@ -884,7 +974,22 @@ class GenerationEngine:
         draws exactly the stream the dead replica would have, because the
         sampling key is (seed, nonce) and both are now request identity,
         not engine state.  The local counter advances past any explicit
-        nonce so mixed use can never collide."""
+        nonce so mixed use can never collide.
+
+        priority: SLO class — "high" | "normal" | "low".  Admission at
+        macro-step boundaries runs in (class, submit order) — FIFO
+        within a class — the deadline-pressure scheduler weights
+        interleaved prefill-chunk grants by class, and LOW requests are
+        PREEMPTIBLE (FLAGS_preempt_low_priority): when a higher class
+        cannot be admitted, a LOW resident's pages park host-side and
+        its stream resumes bit-identically on re-admission (submit-time
+        nonces make the stream request identity, not engine state).
+
+        With interleaved chunked prefill active (prefill_chunk_blocks /
+        FLAGS_prefill_chunk_blocks > 0) add_request ALWAYS returns None:
+        prefill spreads over future step() boundaries, and the first
+        token surfaces through step()'s output as a queued admission
+        would (a list-valued entry led by token #1)."""
         if self._draining:
             raise RuntimeError(
                 "engine is draining (drain(): migration snapshot taken, "
@@ -914,6 +1019,10 @@ class GenerationEngine:
                 raise KeyError(
                     f"adapter {adapter!r} is not registered on this "
                     "engine; call register_adapter first")
+        if priority not in _PRIORITY:
+            raise ValueError(
+                f"priority must be one of {sorted(_PRIORITY)}, "
+                f"got {priority!r}")
         # nonce reserved at SUBMIT time: retry timing can't shift the
         # request's sampling stream
         if nonce is None:
@@ -925,31 +1034,91 @@ class GenerationEngine:
         req = {"rid": rid, "prompt": prompt, "max_len": max_len,
                "n_blocks": n_blocks,
                "temperature": float(temperature or 0.0),
-               "seed": int(seed), "nonce": nonce, "adapter": adapter}
-        # FIFO fairness: while older requests wait, newcomers queue behind
+               "seed": int(seed), "nonce": nonce, "adapter": adapter,
+               "pri": _PRIORITY[priority], "seq": self._submit_seq}
+        self._submit_seq += 1
+        if self._prefill_chunk_blocks() > 0:
+            # interleaved mode: admission happens at boundaries only (the
+            # chunk scheduler owns the prefill work); first tokens surface
+            # through step() exactly like queued admissions
+            self._pending.append(req)
+            return None
+        # fairness: while older same-or-higher-class requests wait,
+        # newcomers queue behind (the boundary scheduler orders the queue
+        # by (class, submit order); all-default-priority traffic is FIFO —
+        # the original contract)
         if self._pending or not self._try_admit(req):
             self._pending.append(req)
             return None
         return self._results[rid][0]
 
+    def _prefill_chunk_blocks(self) -> int:
+        """Per-macro-step prefill budget N in pool blocks (0 = atomic
+        prefill at admission).  Speculative engines always resolve 0:
+        their admission pours the draft pools too, and interleaving
+        would desynchronize d_seq_len mid-prefill."""
+        if self.draft_model is not None:
+            return 0
+        if self.prefill_chunk_blocks is not None:
+            return self.prefill_chunk_blocks
+        return max(0, int(_flags.flag("FLAGS_prefill_chunk_blocks")))
+
     def _admit_pending(self):
-        """Retry queued admissions — called at macro-step boundaries.
-        Returns the admitted request ids: their prefill-produced FIRST
-        token (which add_request returned None for) is surfaced through
-        this step()'s output, so streaming callers never lose token #1."""
+        """Retry queued admissions — called at macro-step boundaries — in
+        (priority class, submit order): parked (preempted) requests
+        compete in the SAME ordering as queued ones.  When the head
+        candidate is above LOW and cannot be admitted, a LOW resident may
+        be preempted to make room (FLAGS_preempt_low_priority).  Returns
+        the admitted request ids whose FIRST token is already available
+        (atomic admissions): it surfaces through this step()'s output.
+        Interleaved reservations enter the PREFILLING state instead —
+        their rids surface later, when _advance_prefills finishes them —
+        and re-admitted parked requests already delivered token #1, so
+        neither appears in the returned list."""
         admitted = []
-        while self._pending:
-            if not self._try_admit(self._pending[0]):
-                if not any(s.active for s in self._slots):
-                    # nothing resident to drain and still no room: the
-                    # engine can never make progress — be loud
-                    raise RuntimeError(
-                        "queued request "
-                        f"{self._pending[0]['rid']!r} cannot be admitted "
-                        "with an idle engine (pool too small?)")
+        interleaved = self._prefill_chunk_blocks() > 0
+        while True:
+            cands = [((rec["req"].get("pri", 2), rec["req"].get("seq", 0)),
+                      None, rid) for rid, rec in self._parked.items()]
+            cands += [((req.get("pri", 1), req.get("seq", 0)), req,
+                       req["rid"]) for req in self._pending]
+            if not cands:
                 break
-            admitted.append(self._pending.popleft()["rid"])
+            _key, req, rid = min(cands, key=lambda c: c[0])
+            if req is None:
+                ok = self._try_unpark(rid)
+            elif interleaved:
+                ok = self._begin_prefill(req)
+                if ok:
+                    self._drop_pending(req)
+            else:
+                ok = self._try_admit(req)
+                if ok:
+                    self._drop_pending(req)
+                    admitted.append(rid)
+            if ok:
+                continue
+            # head-of-line blocked: a request above LOW may evict a LOW
+            # resident (its pages park host-side) and retry
+            if _key[0] < _PRIORITY["low"] and self._preempt_one():
+                continue
+            if not any(s.active or s.prefill is not None
+                       for s in self._slots):
+                # nothing resident to drain and still no room: the
+                # engine can never make progress — be loud
+                raise RuntimeError(
+                    f"queued request {rid!r} cannot be admitted "
+                    "with an idle engine (pool too small?)")
+            break
         return admitted
+
+    def _drop_pending(self, req):
+        # remove by IDENTITY: req dicts hold numpy prompts, so deque's
+        # ==-based remove could raise on a truth-ambiguous array compare
+        for i, r in enumerate(self._pending):
+            if r is req:
+                del self._pending[i]
+                return
 
     def _try_admit(self, req):
         """One admission attempt: prefix-match, allocate, prefill the
@@ -1083,6 +1252,9 @@ class GenerationEngine:
         slot.max_len = req["max_len"]
         slot.blocks = blocks
         slot.adapter_slot = ad_slot
+        slot.priority = req.get("pri", _PRIORITY["normal"])
+        slot.req = req
+        slot.prefill = None
         if self._pack is not None:
             # in-flight reference pins the adapter slot: LRU install and
             # evict_adapter both refuse referenced slots
@@ -1119,10 +1291,347 @@ class GenerationEngine:
         _DECODE_STATS["resident_peak"] = max(
             _DECODE_STATS["resident_peak"],
             sum(1 for s in self._slots if s.active))
+        _DECODE_STATS["admitted_" + _PRI_NAMES[slot.priority]] += 1
         if self.eos_token_id is not None and first == self.eos_token_id:
             self._finish(slot)
         elif slot.seq_len + 1 >= slot.max_len:
             self._finish(slot)
+        return True
+
+    # ------------------------------------- interleaved prefill (PREFILLING)
+    def _begin_prefill(self, req):
+        """Interleaved admission, reservation half: claim a slot, adapter
+        residency, prefix-cache pages, and fresh pool blocks NOW — then
+        hand the prompt to the chunk scheduler.  The slot enters the
+        PREFILLING state (`slot.prefill` set, `active` False: the decode
+        dispatch masks the lane onto its scratch page exactly like an
+        empty slot) and _advance_prefills forwards it one pool block per
+        granted chunk.  Returns False — fully backed out, same contract
+        as _try_admit — on transient shortage."""
+        slot = next((s for s in self._slots
+                     if not s.active and s.prefill is None), None)
+        if slot is None:
+            return False
+        ad_slot = 0
+        if self._pack is not None and req.get("adapter") is not None:
+            ad_slot = self._try_install(req["adapter"])
+            if ad_slot is None:
+                return False
+        prompt = req["prompt"]
+        s0 = prompt.shape[1]
+        bs = self.block_size
+        ns = ((ad_slot, self._slot_epochs[ad_slot])
+              if self._pack is not None else None)
+        matched = None
+        if self._prefix is not None:
+            toks = req.setdefault("toks", [int(t) for t in prompt[0]])
+            matched = self._prefix.match(toks, max_blocks=(s0 - 1) // bs,
+                                         ns=ns)
+            for b in matched:
+                self._ref[b] += 1
+        matched = matched or []
+        try:
+            fresh = self._alloc(req["n_blocks"] - len(matched))
+        except _PoolExhausted:
+            self._unref(matched)
+            return False
+        m_len = len(matched) * bs
+        try:
+            caches = self._prefix_or_empty(
+                self._kpools, self._vpools, matched, m_len, self._n_layers,
+                self._nkv, self._head_dim, self.model.config.dtype)
+        except BaseException:
+            for b in fresh:
+                self._ref[b] = 0
+                self._free.append(b)
+            self._unref(matched)
+            raise
+        slot.rid = req["rid"]
+        slot.blocks = matched + fresh
+        slot.adapter_slot = ad_slot
+        slot.priority = req.get("pri", _PRIORITY["normal"])
+        slot.req = req
+        if self._pack is not None:
+            self._slot_refs[ad_slot] += 1
+            self._touch_slot(ad_slot)
+        slot.prefill = _PrefillState(
+            req=req, caches=caches, matched=list(matched),
+            fresh=list(fresh), off=m_len, poured=len(matched),
+            since=self._macro_steps)
+        return True
+
+    def _pressure(self, slot) -> int:
+        """Deadline pressure of a PREFILLING slot: class weight scaled by
+        boundaries waited.  Deterministic in macro-steps — the budget
+        math never consults wall clocks, so schedules (and therefore
+        token streams) reproduce run-to-run."""
+        st = slot.prefill
+        waited = self._macro_steps - st.since
+        return _PRI_WEIGHT[slot.priority] * (1 + waited)
+
+    def _prefill_budget(self) -> int:
+        """Prefill-chunk grants for THIS macro-step.  N =
+        prefill_chunk_blocks while decode streams are resident (their
+        inter-token latency is what the budget protects); 2N once the
+        most-pressured prefill crosses _PRESSURE_ESCALATE (so a starved
+        prefill still converges under decode load); unbounded (-1) when
+        nothing is decoding — there is no ITL to protect, finish."""
+        n = self._prefill_chunk_blocks()
+        if not any(s.active for s in self._slots):
+            return -1
+        work = [s for s in self._slots if s.prefill is not None]
+        peak = max(self._pressure(s) for s in work)
+        return 2 * n if peak >= _PRESSURE_ESCALATE else n
+
+    def _advance_prefills(self):
+        """Run this boundary's prefill-chunk budget: grants go to the
+        most-pressured PREFILLING slot first (re-ranked per grant, so one
+        long prompt cannot shadow a later HIGH admission), and requests
+        whose final chunk lands activate — their rids are returned and
+        their first token surfaces through this step()'s output."""
+        finished = []
+        if not any(s.prefill is not None for s in self._slots):
+            return finished
+        budget = self._prefill_budget()
+        while budget != 0:
+            work = [s for s in self._slots if s.prefill is not None]
+            if not work:
+                break
+            slot = max(work, key=self._pressure)
+            if self._prefill_chunk_step(slot):
+                finished.append(slot.rid)
+            budget -= 1
+        return finished
+
+    def _prefill_chunk_step(self, slot):
+        """ONE granted chunk: forward the next pool-block-sized prompt
+        span through the cached prefill path, pour any block it
+        completed, and publish poured full blocks to the prefix tree so
+        a mid-prefill admission can already hit them on the chunk
+        boundary.  The span [off, off+bs) is a function of the prompt
+        alone — never of scheduling — and each chunk keeps its own
+        full-chunk attention geometry (the PR-16 PrefillChainSpec
+        shape-identity rule), which is why the emitted stream is
+        bit-identical to an atomic engine prefilling in
+        prefill_chunk=block_size chunks.  Returns True when the prompt
+        completed (the slot activated)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import (_model_forward_cached,
+                                             prefill_chain_scope)
+
+        st = slot.prefill
+        prompt = st.req["prompt"]
+        s0 = prompt.shape[1]
+        bs = self.block_size
+        model = self.model
+        try:
+            if self._pack is not None and slot.adapter_slot:
+                from paddle_tpu.nn.lora import adapter_prefill_scope
+
+                ctx = adapter_prefill_scope(model.model.layers, self._pack,
+                                            slot.adapter_slot)
+            else:
+                ctx = contextlib.nullcontext()
+            pf_cfg = self._resolve_prefill_chain()
+            with ctx, prefill_chain_scope(pf_cfg), paddle.no_grad():
+                chunk = prompt[:, st.off:st.off + bs]
+                st.h, st.caches = _model_forward_cached(
+                    model.model, paddle.to_tensor(chunk), st.caches,
+                    st.off)
+                st.off += chunk.shape[1]
+            _DECODE_STATS["prefill_chunks"] += 1
+            # pour freshly COMPLETED blocks as we go: per-block pour
+            # writes the same bytes (and the same per-block quant scales)
+            # the atomic pour batches, so the boundary is pure data
+            # movement
+            while st.poured < st.off // bs:
+                self._pour_block(slot, st.poured)
+                st.poured += 1
+            if self._prefix is not None and st.poured > len(st.matched):
+                ns = ((slot.adapter_slot,
+                       self._slot_epochs[slot.adapter_slot])
+                      if self._pack is not None else None)
+                toks = st.req.setdefault(
+                    "toks", [int(t) for t in prompt[0]])
+                self._prefix.insert(toks[:st.poured * bs],
+                                    slot.blocks[:st.poured], ns=ns)
+            if st.off < s0:
+                return False
+            self._finish_prefill(slot)
+            return True
+        except BaseException:
+            # back out like _try_admit: the request is forfeit, the
+            # allocator/slot are restored (tree-held poured pages stay
+            # cached — they are complete, valid blocks)
+            self._cancel_prefill(slot)
+            raise
+
+    def _pour_block(self, slot, j):
+        """Pour ONE completed prompt block (tokens [j*bs, (j+1)*bs)) from
+        the naive prefill caches into the slot's j-th pool page — the
+        chunked entry (ops.paged_attention.paged_pour_block)."""
+        from paddle_tpu.ops import paged_attention as pa
+
+        bs = self.block_size
+        st = slot.prefill
+        lo = j * bs
+        b = slot.blocks[j]
+        for li, (k, v) in enumerate(st.caches):
+            kv = jnp.moveaxis(k._value, 1, 2)[0, :, lo:lo + bs]  # [Nkv,bs,H]
+            vv = jnp.moveaxis(v._value, 1, 2)[0, :, lo:lo + bs]
+            self._kpools[li] = pa.paged_pour_block(self._kpools[li], kv, b)
+            self._vpools[li] = pa.paged_pour_block(self._vpools[li], vv, b)
+            if self._pool_sharding is not None:
+                self._kpools[li] = self._place_pool(self._kpools[li],
+                                                    self._pool_sharding)
+                self._vpools[li] = self._place_pool(self._vpools[li],
+                                                    self._pool_sharding)
+
+    def _finish_prefill(self, slot):
+        """Last chunk landed: pour the remainder (the partial tail block
+        plus zero-padded future decode pages — exactly the atomic pour's
+        coverage from the same offset), derive the first token from the
+        final chunk's logits, and activate the slot.  Mirrors
+        _try_admit's commit tail."""
+        import paddle_tpu as paddle
+
+        st = slot.prefill
+        req = st.req
+        prompt = req["prompt"]
+        s0 = prompt.shape[1]
+        bs = self.block_size
+        with paddle.no_grad():
+            logits_last = self.model._logits(
+                st.h[:, -1:, :])._value[0, -1, :]
+        first = int(np.asarray(jnp.argmax(logits_last)))
+        self._pour(self._kpools, self._vpools, st.caches, slot.blocks, s0,
+                   self._nkv, self._head_dim, sharding=self._pool_sharding,
+                   start_tok=st.poured * bs)
+        slot.active = True
+        slot.prefill = None
+        slot.seq_len = s0
+        slot.max_len = req["max_len"]
+        slot.temperature = req["temperature"]
+        slot.d_seq_len = 0
+        slot.key = np.asarray(
+            jax.random.fold_in(jax.random.PRNGKey(req["seed"]),
+                               req["nonce"]))
+        if slot.temperature > 0.0:
+            lg = logits_last.astype(jnp.float32) / slot.temperature
+            key = jax.random.fold_in(jnp.asarray(slot.key), 0)
+            first = int(np.asarray(jax.random.categorical(key, lg)))
+        slot.last_token = first
+        slot.generated = [first]
+        self._results[slot.rid] = slot.generated
+        if self._prefix is not None:
+            ns = ((slot.adapter_slot, self._slot_epochs[slot.adapter_slot])
+                  if self._pack is not None else None)
+            toks = req.setdefault("toks", [int(t) for t in prompt[0]])
+            self._prefix.insert(toks, slot.blocks[:s0 // bs], ns=ns)
+            if st.matched:
+                _DECODE_STATS["prefix_hits"] += 1
+                _DECODE_STATS["prefix_hit_tokens"] += len(st.matched) * bs
+            else:
+                _DECODE_STATS["prefix_misses"] += 1
+        _DECODE_STATS["resident_peak"] = max(
+            _DECODE_STATS["resident_peak"],
+            sum(1 for s in self._slots if s.active))
+        _DECODE_STATS["admitted_" + _PRI_NAMES[slot.priority]] += 1
+        if self.eos_token_id is not None and first == self.eos_token_id:
+            self._finish(slot)
+        elif slot.seq_len + 1 >= slot.max_len:
+            self._finish(slot)
+
+    def _cancel_prefill(self, slot, requeue=False):
+        """Back a PREFILLING slot out: references released through _unref
+        (never a direct free — incremental inserts may have handed poured
+        pages to the prefix tree, where they stay as reclaimable cached
+        pages), the slot cleared.  With requeue=True the original
+        submission returns to the queue — re-prefill is deterministic
+        (same spans, same bytes), so demotion costs work, never
+        correctness."""
+        st = slot.prefill
+        self._unref(st.fresh)
+        self._unref(st.matched)
+        if self._pack is not None:
+            self._slot_refs[slot.adapter_slot] -= 1
+        slot.adapter_slot = 0
+        slot.blocks = []
+        slot.rid = None
+        slot.req = None
+        slot.prefill = None
+        if requeue:
+            self._pending.append(st.req)
+
+    # ------------------------------------------- preemption (parking lot)
+    def _preempt_one(self):
+        """Evict one LOW-priority resident to unblock a higher-class
+        admission.  ACTIVE LOWs park: their pool pages ship host-side
+        (serving/snapshot.py park_request_state) and the stream resumes
+        bit-identically on re-admission.  PREFILLING LOWs demote back to
+        the queue instead — their progress is re-derivable, their pages
+        are not yet a stream.  Returns True when something was evicted."""
+        if self.draft_model is not None:
+            return False
+        if not _flags.flag("FLAGS_preempt_low_priority"):
+            return False
+        victims = [s for s in self._slots
+                   if s.active and s.priority >= _PRIORITY["low"]
+                   and s.adapter_slot == 0 and s.req is not None]
+        if victims:
+            # least progress lost first; slot index breaks ties so the
+            # choice is deterministic
+            v = min(victims,
+                    key=lambda s: (len(s.generated), self._slots.index(s)))
+            self._park_request(v)
+            return True
+        pf = [s for s in self._slots
+              if s.prefill is not None and s.priority >= _PRIORITY["low"]]
+        if pf:
+            self._cancel_prefill(pf[0], requeue=True)
+            _DECODE_STATS["preemptions"] += 1
+            return True
+        return False
+
+    def _park_request(self, slot):
+        """Preempt an ACTIVE request: its per-request state (slot fields,
+        emitted tokens, nonce-derived key) plus its pool pages — verbatim
+        pool-native bytes, the same wire face the cluster ships — move to
+        the host-side parking lot, and its pool blocks free NOW."""
+        from paddle_tpu.serving.snapshot import park_request_state
+
+        rec = park_request_state(self, slot)
+        self._parked[slot.rid] = rec
+        self._release(slot)
+        _DECODE_STATS["preemptions"] += 1
+        _DECODE_STATS["parked_requests"] = len(self._parked)
+
+    def _try_unpark(self, rid):
+        """Re-admit a parked request: fresh pool blocks, pages placed
+        VERBATIM (pool_set_blocks — ship-then-place is bit-exact by
+        construction, never a re-quantization), slot state restored.
+        The resumed stream continues token-for-token where it parked:
+        the sampling key is (seed, nonce) and the per-step fold index is
+        len(generated), both request identity.  Returns False on
+        transient shortage (slot or pool), leaving the record parked."""
+        from paddle_tpu.serving.snapshot import unpark_request_state
+
+        rec = self._parked[rid]
+        slot = next((s for s in self._slots
+                     if not s.active and s.prefill is None), None)
+        if slot is None:
+            return False
+        if not unpark_request_state(self, slot, rec):
+            return False
+        del self._parked[rid]
+        # live streams alias their slot's generated list — the same
+        # invariant _try_admit establishes
+        self._results[rid] = slot.generated
+        _DECODE_STATS["preempt_readmits"] += 1
+        _DECODE_STATS["parked_requests"] = len(self._parked)
+        _DECODE_STATS["resident_peak"] = max(
+            _DECODE_STATS["resident_peak"],
+            sum(1 for s in self._slots if s.active))
         return True
 
     def _prefix_or_empty(self, kpools, vpools, matched, m_len, n_layers,
@@ -1197,6 +1706,7 @@ class GenerationEngine:
                 vpools[li] = self._place_pool(vpools[li], sharding)
 
     def _finish(self, slot):
+        _DECODE_STATS["completed_" + _PRI_NAMES[slot.priority]] += 1
         self._results[slot.rid] = list(slot.generated)
         self._release(slot)
 
@@ -1413,6 +1923,17 @@ class GenerationEngine:
             raise ValueError(
                 "drain() needs a snapshot directory: pass dir= or set "
                 "FLAGS_engine_snapshot_dir")
+        # in-flight overload-discipline state is the restore target's to
+        # serve: PREFILLING slots and parked (preempted) requests demote
+        # to queued submissions BEFORE the snapshot — they ride it as
+        # pending and replay deterministically from (seed, nonce) on the
+        # restored engine (re-prefill spans and pours are identical)
+        for s in self._slots:
+            if s.prefill is not None:
+                self._cancel_prefill(s, requeue=True)
+        for rid in list(self._parked):
+            self._pending.append(self._parked.pop(rid)["req"])
+        _DECODE_STATS["parked_requests"] = len(self._parked)
         self._draining = True
         self._drain_dir = str(d)
         st = self._drain_step = self.snapshot(d, step=step)
@@ -1512,20 +2033,24 @@ class GenerationEngine:
         Pallas dispatch under models.llama.prefill_chain_scope; chunks
         the config doesn't tile keep the XLA path.  Single-device
         engines only: mesh engines keep GSPMD prefill (the pour is
-        bandwidth-bound on the pool commit, not the attention core)."""
+        bandwidth-bound on the pool commit, not the attention core).
+        INTERLEAVED engines (prefill_chunk_blocks > 0) search their
+        actual chunk geometry — one pool block — since every granted
+        chunk is exactly block_size tokens."""
         if self._prefill_chain_cfg is not _CHAIN_UNSET:
             return self._prefill_chain_cfg
         cfg = None
-        if (self.prefill_chunk is not None and self.mesh is None
-                and self.prefill_chunk >= 2
+        eff = (self.block_size if self._prefill_chunk_blocks() > 0
+               else self.prefill_chunk)
+        if (eff is not None and self.mesh is None and eff >= 2
                 and _flags.flag("FLAGS_schedule_search")
                 and _flags.flag("FLAGS_schedule_search_decode")):
             from paddle_tpu.ops import decode_chain as _dc
 
             _SCHED_DECODE_STATS["prefill_chains_found"] += 1
             spec = _dc.PrefillChainSpec(
-                seq=self.prefill_chunk,
-                kv_len=2 * self.prefill_chunk,
+                seq=eff,
+                kv_len=2 * eff,
                 num_heads=self.model.config.num_attention_heads,
                 head_dim=self._head_dim,
                 dtype=jnp.dtype(
@@ -1868,6 +2393,13 @@ class GenerationEngine:
         # A draining engine admits NOTHING: its queue was handed off in
         # the drain snapshot and will be served by the restore target.
         admitted = [] if self._draining else self._admit_pending()
+        # interleaved chunked prefill: grant this boundary's budget of
+        # block-sized chunks (deadline pressure orders the PREFILLING
+        # slots); prompts whose final chunk landed activate NOW and
+        # their first token joins this step's output like any queued
+        # admission (drain() demoted prefilling slots, so this is a
+        # no-op on a lame duck)
+        admitted.extend(self._advance_prefills())
         if not any(s.active for s in self._slots):
             # an admitted request may have finished AT admission
             # (EOS / max_new_tokens=1): its first token still surfaces.

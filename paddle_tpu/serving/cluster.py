@@ -381,15 +381,18 @@ class EngineCluster:
 
     # -------------------------------------------------------------- intake
     def submit(self, rid, prompt, max_new_tokens=16, temperature=0.0,
-               seed=0):
+               seed=0, priority="normal"):
         """Accept (durably journal) and dispatch one request.  Idempotent
         per rid: resubmitting a known id neither re-journals nor
         re-dispatches — the first acceptance pinned its nonce and its
-        stream."""
+        stream.  ``priority`` is the SLO class ("high"/"normal"/"low")
+        journaled with the request and forwarded to the worker engine's
+        admission scheduler."""
         known = self.router.request(rid) is not None
         self.router.submit(rid, [int(t) for t in prompt],
                            max_new=int(max_new_tokens),
-                           temperature=float(temperature), seed=int(seed))
+                           temperature=float(temperature), seed=int(seed),
+                           priority=str(priority))
         self._kill.hit("router-after-accept")
         if not known:
             self._dispatch(rid)
@@ -455,6 +458,7 @@ class EngineCluster:
                            "max_new": req.opts.get("max_new", 16),
                            "temperature": req.opts.get("temperature", 0.0),
                            "seed": req.opts.get("seed", 0),
+                           "priority": req.opts.get("priority", "normal"),
                            "nonce": req.nonce})
         except BrokenPipeError:
             self._on_worker_dead(w.key)

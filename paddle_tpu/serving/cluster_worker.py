@@ -149,7 +149,8 @@ def _decode_loop(spec, model, ring_in, out, killer):
             eng.add_request(msg["rid"], msg["prompt"],
                             max_new_tokens=msg["max_new"],
                             temperature=msg["temperature"] or None,
-                            seed=msg["seed"], nonce=msg["nonce"])
+                            seed=msg["seed"], nonce=msg["nonce"],
+                            priority=msg.get("priority", "normal"))
             killer.hit("decode-after-accept")
             tracked.add(msg["rid"])
         elif t == "ship_begin":

@@ -45,7 +45,8 @@ from paddle_tpu.distributed.checkpoint import _assemble_region, _LazyFiles
 from paddle_tpu.distributed.checkpoint import manager as _ckpt
 
 __all__ = ["EngineSnapshot", "restore_engine", "snapshot_stats",
-           "reset_snapshot_stats"]
+           "reset_snapshot_stats", "park_request_state",
+           "unpark_request_state"]
 
 _UNSET = object()
 
@@ -152,10 +153,88 @@ def _check_model(model, saved, who):
             "checkpoint tier, not the engine snapshot.")
 
 
+def park_request_state(eng, slot):
+    """Extract ONE resident request's restorable state — the
+    single-request face of the engine snapshot (preemption parking,
+    docs/DECODE.md): the slot's host fields plus its pool pages as
+    verbatim pool-native bytes (`pool_get_blocks` dicts per layer, the
+    same wire face the cluster ships).  The caller releases the slot;
+    `unpark_request_state` places the bytes back untouched, so
+    park→unpark is bit-exact by construction — never a re-quantization,
+    and the (seed, nonce) sampling key plus the len(generated) fold
+    index resume the stream token-for-token."""
+    from paddle_tpu.ops import paged_attention as pa
+
+    def host(blocks):
+        return {name: np.asarray(a) for name, a in blocks.items()}
+
+    pages_k = [host(pa.pool_get_blocks(p, slot.blocks))
+               for p in eng._kpools]
+    pages_v = [host(pa.pool_get_blocks(p, slot.blocks))
+               for p in eng._vpools]
+    return {
+        "req": slot.req, "seq_len": slot.seq_len, "max_len": slot.max_len,
+        "n_blocks": len(slot.blocks), "last_token": slot.last_token,
+        "generated": list(slot.generated), "temperature": slot.temperature,
+        "key": None if slot.key is None else np.asarray(slot.key),
+        "priority": slot.priority,
+        "pages_k": pages_k, "pages_v": pages_v,
+    }
+
+
+def unpark_request_state(eng, slot, rec):
+    """Re-admit a parked request into `slot`: fresh pool blocks, parked
+    pages placed VERBATIM (`pool_set_blocks`), slot state restored.
+    Returns False — nothing mutated — when the pool cannot supply the
+    blocks right now (the record stays parked for a later boundary)."""
+    from paddle_tpu.serving import _PoolExhausted
+    from paddle_tpu.ops import paged_attention as pa
+
+    try:
+        blocks = eng._alloc(rec["n_blocks"])
+    except _PoolExhausted:
+        return False
+    idx = jnp.asarray(blocks, jnp.int32)
+    for li in range(eng._n_layers):
+        eng._kpools[li] = pa.pool_set_blocks(eng._kpools[li], idx,
+                                             rec["pages_k"][li])
+        eng._vpools[li] = pa.pool_set_blocks(eng._vpools[li], idx,
+                                             rec["pages_v"][li])
+        if eng._pool_sharding is not None:
+            eng._kpools[li] = eng._place_pool(eng._kpools[li],
+                                              eng._pool_sharding)
+            eng._vpools[li] = eng._place_pool(eng._vpools[li],
+                                              eng._pool_sharding)
+    slot.rid = rec["req"]["rid"]
+    slot.active = True
+    slot.prefill = None
+    slot.seq_len = rec["seq_len"]
+    slot.max_len = rec["max_len"]
+    slot.blocks = blocks
+    slot.last_token = rec["last_token"]
+    slot.generated = list(rec["generated"])
+    slot.temperature = rec["temperature"]
+    slot.key = None if rec["key"] is None else np.asarray(rec["key"])
+    slot.d_seq_len = 0
+    slot.adapter_slot = 0
+    slot.priority = rec.get("priority", 2)
+    slot.req = rec["req"]
+    return True
+
+
 def _capture_host_state(eng):
     """Everything but the pool tensors, as picklable host values.  Called
     between macro-steps (the engine is single-threaded host-side), so the
-    captured view is a consistent boundary state."""
+    captured view is a consistent boundary state.
+
+    Overload-discipline state rides as RE-QUEUED submissions: PREFILLING
+    slots and parked (preempted) requests both append their original req
+    dicts to the captured pending queue — the restored engine replays
+    them from (seed, nonce), deterministically — and a prefilling slot's
+    reserved blocks are virtually released in the captured allocator
+    (mirroring _unref: pages the prefix tree holds stay resident as
+    reclaimable cached pages, so mid-prefill poured work survives as
+    cache hits)."""
     cfg = {
         "format": 1,
         "max_batch": eng.max_batch,
@@ -164,6 +243,7 @@ def _capture_host_state(eng):
         "eos_token_id": eng.eos_token_id,
         "kv_cache_dtype": eng._kv_dtype,
         "prefill_chunk": eng.prefill_chunk,
+        "prefill_chunk_blocks": eng.prefill_chunk_blocks,
         "decode_chunk": eng._decode_chunk,  # ctor value; None = flag-driven
         "prefix_cache": eng._prefix is not None,
         "has_draft": eng.draft_model is not None,
@@ -178,8 +258,29 @@ def _capture_host_state(eng):
             "targets": tuple(eng._pack.targets),
         }),
     }
+    free = list(eng._free)
+    ref = list(eng._ref)
+    pending = [dict(req) for req in eng._pending]
     slots = []
     for s in eng._slots:
+        if getattr(s, "prefill", None) is not None:
+            # PREFILLING: demote to a queued submission and virtually
+            # release its reserved blocks in the CAPTURED allocator
+            # (mirror _unref — tree-held poured pages stay out of free)
+            st = s.prefill
+            for b in st.fresh + st.matched:
+                ref[b] -= 1
+                if ref[b] <= 0 and (eng._prefix is None
+                                    or not eng._prefix.holds(b)):
+                    free.append(b)
+            pending.append(dict(st.req))
+            slots.append({
+                "rid": None, "active": False, "seq_len": 0, "max_len": 0,
+                "blocks": [], "last_token": 0, "generated": [],
+                "temperature": 0.0, "key": None, "d_seq_len": 0,
+                "adapter_slot": 0, "priority": 1, "req": None,
+            })
+            continue
         slots.append({
             "rid": s.rid, "active": s.active, "seq_len": s.seq_len,
             "max_len": s.max_len, "blocks": list(s.blocks),
@@ -187,7 +288,11 @@ def _capture_host_state(eng):
             "temperature": s.temperature,
             "key": None if s.key is None else np.asarray(s.key),
             "d_seq_len": s.d_seq_len, "adapter_slot": s.adapter_slot,
+            "priority": getattr(s, "priority", 1),
+            "req": getattr(s, "req", None),
         })
+    for rec in getattr(eng, "_parked", {}).values():
+        pending.append(dict(rec["req"]))
     pack = None
     if eng._pack is not None:
         registry = {}
@@ -203,10 +308,10 @@ def _capture_host_state(eng):
         }
     return {
         "config": cfg,
-        "alloc": {"free": list(eng._free), "ref": list(eng._ref)},
+        "alloc": {"free": free, "ref": ref},
         "slots": slots,
         "results": {rid: list(v) for rid, v in eng._results.items()},
-        "pending": [dict(req) for req in eng._pending],
+        "pending": pending,
         "req_counter": eng._req_counter,
         "macro_steps": eng._macro_steps,
         "radix": _radix_state(eng._prefix),
@@ -416,6 +521,9 @@ class EngineSnapshot:
             prefix_cache=cfg["prefix_cache"],
             kv_cache_dtype=cfg["kv_cache_dtype"],
             adapters=(dict(cfg["adapters"]) if cfg["adapters"] else None),
+            # absent in pre-overload snapshots: restore atomic (None ->
+            # flag-driven, the constructor default)
+            prefill_chunk_blocks=cfg.get("prefill_chunk_blocks"),
         )
 
         # ---- pools: shard records -> assembled host arrays -> the fresh
@@ -471,6 +579,14 @@ class EngineSnapshot:
             slot.key = None if sd["key"] is None else np.asarray(sd["key"])
             slot.d_seq_len = sd["d_seq_len"]
             slot.adapter_slot = sd["adapter_slot"]
+            slot.priority = sd.get("priority", 1)
+            slot.req = sd.get("req")
+        # the submit-sequence tie-break resumes past every captured
+        # request so post-restore submissions keep FIFO-within-class
+        eng._submit_seq = 1 + max(
+            [r.get("seq", -1) for r in extras["pending"]]
+            + [sd.get("req", {}).get("seq", -1) if sd.get("req") else -1
+               for sd in extras["slots"]] + [-1])
         eng._results = {rid: list(v) for rid, v in extras["results"].items()}
         for slot in eng._slots:
             if slot.active:

@@ -72,3 +72,15 @@ def test_bench_decode_smoke_emits_valid_json():
     assert snap["resume_tokens_match"] is True
     assert snap["save_ms"] > 0 and snap["restore_ms"] > 0
     assert snap["bytes"] > 0
+    # overload discipline: the long prompt really was chunk-interleaved
+    # (>= 2 prefill chunks), ALL streams bit-identical chunked vs atomic,
+    # and the preemption sub-scenario parked + re-admitted the LOW stream
+    # with a token-for-token resume (check_bench_regression's overload
+    # gate consumes the p99 ITL numbers)
+    ov = detail["overload"]
+    assert ov["streams_identical"] is True
+    assert ov["prefill_chunks"] >= 2
+    assert ov["preemptions"] >= 1 and ov["preempt_readmits"] >= 1
+    assert ov["preempted_stream_identical"] is True
+    assert ov["itl_p99_ms_chunked"] > 0 and ov["itl_p99_ms_atomic"] > 0
+    assert ov["tokens_per_sec_chunked"] > 0 and ov["tokens_per_sec_atomic"] > 0

@@ -169,6 +169,20 @@ def _snap_payload(save_ms=30.0, restore_ms=60.0):
     }
 
 
+def _overload_payload(chunked_ms=60.0, atomic_ms=400.0):
+    return {
+        "metric": "serving_decode_chunked_speedup", "value": 5.0,
+        "unit": "x", "detail": {"overload": {
+            "itl_p99_ms_chunked": chunked_ms,
+            "itl_p99_ms_atomic": atomic_ms,
+            "tokens_per_sec_chunked": 100.0, "tokens_per_sec_atomic": 95.0,
+            "streams_identical": True, "prefill_chunks": 8,
+            "preemptions": 1, "preempt_readmits": 1,
+            "preempted_stream_identical": True,
+        }},
+    }
+
+
 def _cluster_payload(detect_ms=40.0, recover_ms=400.0, value=900.0):
     return {
         "metric": "cluster_tokens_per_sec", "value": value,
@@ -208,6 +222,29 @@ def test_cluster_failover_gate(tmp_path):
     lost = _w(tmp_path, "c_lost.json",
               {"rc": 1, "tail": json.dumps(_cluster_payload())})
     assert main([lost, same]) == 0
+
+
+def test_overload_itl_gate(tmp_path):
+    """Overload-discipline wiring (chunked prefill interleaving): the
+    adversarial mix's resident-stream p99 ITL gates lower-is-better at
+    the SLO threshold on BOTH the chunked side (the product) and the
+    atomic side (the workload control); pre-chunking payloads skip
+    silently in either direction."""
+    old = _w(tmp_path, "o_old.json", _overload_payload())
+    same = _w(tmp_path, "o_same.json", _overload_payload())
+    assert main([old, same]) == 0            # unchanged timings pass
+    worse = _w(tmp_path, "o_worse.json", _overload_payload(chunked_ms=180.0))
+    assert main([old, worse]) == 1           # chunked p99 tripled: gates
+    assert main([old, worse, "--slo-threshold", "3.0"]) == 0  # within 300%
+    assert main([worse, old]) == 0           # improvement never gates
+    worse_atomic = _w(tmp_path, "o_wa.json",
+                      _overload_payload(atomic_ms=1600.0))
+    assert main([old, worse_atomic]) == 1    # the control gates too
+    # a pre-chunking payload on either side skips the overload gate
+    pre = _w(tmp_path, "o_pre.json",
+             {"metric": "serving_decode_chunked_speedup", "value": 5.0})
+    assert main([pre, worse]) == 0
+    assert main([worse, pre]) == 0
 
 
 def test_snapshot_timing_gate(tmp_path):

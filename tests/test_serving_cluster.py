@@ -472,11 +472,58 @@ def _cluster_telemetry_footer(tmp_path):
     assert after["redispatches"] == 0
 
 
+def _cluster_priority_ahead_of_long(tmp_path):
+    # SLO-class admission end-to-end: the replica's only free slot is
+    # held by a chunk-interleaved LOW-priority long prefill when a HIGH
+    # request lands — the worker engine preempts the LOW request (parks
+    # or demotes it) and the HIGH stream completes FIRST, while every
+    # final stream still matches an uncontended single engine's
+    # (submit-time nonces make the re-admitted stream bit-identical).
+    from paddle_tpu.serving.cluster import EngineCluster
+
+    rng = np.random.default_rng(17)
+    p_long = [int(t) for t in rng.integers(1, 128, 40)]
+    subs = [("w", P_G1, dict(max_new_tokens=20)),
+            ("long", p_long, dict(max_new_tokens=16, temperature=5.0,
+                                  seed=3, priority="low")),
+            ("hi", P_S1, dict(max_new_tokens=6, priority="high"))]
+    ref = _single_engine_reference(subs, max_batch=4)
+
+    ekw = dict(_EKW, prefill_chunk_blocks=1)
+    c = EngineCluster(_MODEL_SPEC, engine_kwargs=ekw,
+                      workdir=str(tmp_path / "wd"), num_replicas=1,
+                      heartbeat_ms=100, miss_threshold=20)
+    try:
+        for rid, prompt, opts in subs:
+            c.submit(rid, prompt,
+                     max_new_tokens=opts["max_new_tokens"],
+                     temperature=opts.get("temperature", 0.0),
+                     seed=opts.get("seed", 0),
+                     priority=opts.get("priority", "normal"))
+        deadline = time.monotonic() + 120
+        while c.result("hi") is None:
+            assert time.monotonic() < deadline, "hi never completed"
+            c.poll()
+            time.sleep(0.002)
+        # the HIGH request finished while the LOW long request (which
+        # was submitted before it) is still in flight
+        assert c.result("long") is None
+        c.serve(timeout_s=240)
+        got = {rid: c.result(rid) for rid, _p, _o in subs}
+        assert got == ref, (got, ref)
+    finally:
+        c.shutdown()
+
+
 # The e2e payloads fork real engine processes and kill them; each runs in
 # tier-1 through the dedicated isolated worker for this module, and the
 # pieces run as separate pytest cases for attribution.
 def test_cluster_e2e_matches_single_engine(tmp_path):
     _cluster_e2e_matches_single_engine(tmp_path)
+
+
+def test_cluster_priority_completes_ahead_of_long_prefill(tmp_path):
+    _cluster_priority_ahead_of_long(tmp_path)
 
 
 def test_cluster_drain_scale_down_no_double_serve(tmp_path):

@@ -28,6 +28,13 @@ serving/snapshot.py) gate like the SLO percentiles — lower is better, so
 growth beyond --slo-threshold is the regression (the wall cost of
 honoring a preemption) — and skip silently on pre-snapshot payloads.
 
+Serving payloads carrying the overload section (bench_decode.py
+detail.overload: resident-stream p99 inter-token latency under a long
+mid-decode prefill, chunked-interleaved vs atomic admission) gate both
+ITL numbers lower-is-better at --slo-threshold — the chunked side is the
+product, the atomic side the workload control — and skip silently on
+pre-chunking payloads.
+
 Cluster payloads carrying the fail-over section (bench_cluster.py
 detail.failover: detect_ms from SIGKILL to the router's first re-dispatch,
 recover_ms to every stream complete) gate like the SLO percentiles —
@@ -134,6 +141,18 @@ def load_snapshot(path):
     return snap if isinstance(snap, dict) else None
 
 
+def load_overload(path):
+    """The overload section of a serving bench payload (bench_decode.py
+    detail.overload: {"itl_p99_ms_chunked", "itl_p99_ms_atomic",
+    "tokens_per_sec_chunked", ...}), or None when absent — pre-chunking
+    rounds and non-serving benches skip the gate."""
+    data, _err = _payload_dict(path)
+    if not isinstance(data, dict):
+        return None
+    ov = (data.get("detail") or {}).get("overload")
+    return ov if isinstance(ov, dict) else None
+
+
 def load_failover(path):
     """The fail-over section of a cluster bench payload (bench_cluster.py
     detail.failover: {"detect_ms", "recover_ms", "lost",
@@ -237,6 +256,29 @@ def main(argv=None):
             rel = (n - o) / o
             stat = "REGRESSION" if rel > args.slo_threshold else "ok"
             print(f"bench gate [snapshot {sk}]: {o:.2f} -> {n:.2f} ms "
+                  f"({rel:+.2%}) {stat}")
+            if stat == "REGRESSION":
+                rc = 1
+
+    # overload inter-token-latency gate (chunked prefill interleaving):
+    # the adversarial mix's resident-stream p99 ITL under the long-prompt
+    # disturbance, lower-is-better at the SLO threshold like the other
+    # tail-latency walls.  Both the chunked and the atomic sides gate —
+    # the chunked number is the product, the atomic one the control (a
+    # regression there means the workload drifted, not the interleaver).
+    # Sides missing the section (pre-chunking rounds) skip silently.
+    old_ov, new_ov = load_overload(args.old), load_overload(args.new)
+    if old_ov and new_ov:
+        for ok in ("itl_p99_ms_chunked", "itl_p99_ms_atomic"):
+            try:
+                o, n = float(old_ov.get(ok, 0)), float(new_ov.get(ok, 0))
+            except (TypeError, ValueError):
+                continue
+            if not o > 0 or not n > 0:
+                continue
+            rel = (n - o) / o
+            stat = "REGRESSION" if rel > args.slo_threshold else "ok"
+            print(f"bench gate [overload {ok}]: {o:.2f} -> {n:.2f} ms "
                   f"({rel:+.2%}) {stat}")
             if stat == "REGRESSION":
                 rc = 1
