@@ -290,6 +290,19 @@ define_flag(
     "requests re-dispatch (serving/cluster.py)",
 )
 define_flag(
+    "FLAGS_cluster_standby",
+    0,
+    "Warm standby tier of the disaggregated serving cluster "
+    "(serving/cluster.py): EngineCluster pre-forks this many standby "
+    "worker processes that have already paid jax import + trace + "
+    "persistent-cache-served compile against the cluster's engine "
+    "geometry.  On a detected decode-replica death, promotion hands a "
+    "warm standby the dead replica's snapshot dir and re-keys its rings "
+    "into the replica slot — skipping the respawn entirely; a consumed "
+    "standby is backfilled asynchronously.  0 disables the tier "
+    "(respawn-with-warmup remains the recovery path)",
+)
+define_flag(
     "FLAGS_pipeline_schedule",
     "1F1B",
     "Default pipeline schedule for PipelineStack/pipeline_llama/"

@@ -480,7 +480,12 @@ def cluster_stats(reset: bool = False) -> dict:
     prefill->decode, retries on the shipping path, and queued requests
     migrated by graceful drains.  Healthy steady state shows
     heartbeats_missed and redispatches flat; climbing redispatches means
-    replicas are dying faster than they respawn.  The cluster module owns
+    replicas are dying faster than they respawn.  The warm-start tier
+    adds standbys_warm (gauge of ready standbys), promotions (standbys
+    that took a dead replica's slot), warmups/warmup_seconds (worker AOT
+    warm reports), and respawn_compile_hits/misses (the persistent
+    compile-cache counters respawned workers reported at boot —
+    hits > 0 is the warmed-respawn contract).  The cluster module owns
     the counters — one schema, no drift."""
     from paddle_tpu.serving import cluster as _cluster
 

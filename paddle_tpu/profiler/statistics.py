@@ -380,19 +380,29 @@ def cluster_line(stats: dict) -> str:
     for Profiler.summary(); empty when no cluster ran this process
     (serving/cluster.py).  redispatches nonzero means a replica died or
     drained and its accepted requests moved — the fail-over machinery
-    working, surfaced so an unstable fleet is visible at a glance."""
+    working, surfaced so an unstable fleet is visible at a glance.  The
+    warm-start tier rides the same line: standbys_warm is the live gauge,
+    promotions counts standbys that took a dead replica's slot, warmups/
+    warmup_s the worker AOT warm reports, and respawn_cache h/m the
+    persistent compile-cache hits/misses respawned workers booted with."""
     if not (stats.get("replicas_alive") or stats.get("redispatches")
             or stats.get("pages_shipped") or stats.get("drain_migrations")
-            or stats.get("heartbeats_missed")):
+            or stats.get("heartbeats_missed") or stats.get("standbys_warm")
+            or stats.get("promotions") or stats.get("warmups")):
         return ""
     return (
         "Serving cluster: replicas_alive=%d heartbeats_missed=%d "
         "redispatches=%d pages_shipped=%d ship_bytes=%d ship_retries=%d "
-        "drain_migrations=%d"
+        "drain_migrations=%d standbys_warm=%d promotions=%d warmups=%d "
+        "warmup_s=%.2f respawn_cache=%dh/%dm"
         % (stats["replicas_alive"], stats["heartbeats_missed"],
            stats["redispatches"], stats["pages_shipped"],
            stats["ship_bytes"], stats["ship_retries"],
-           stats["drain_migrations"])
+           stats["drain_migrations"], stats.get("standbys_warm", 0),
+           stats.get("promotions", 0), stats.get("warmups", 0),
+           stats.get("warmup_seconds", 0.0),
+           stats.get("respawn_compile_hits", 0),
+           stats.get("respawn_compile_misses", 0))
     )
 
 

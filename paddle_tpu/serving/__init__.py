@@ -2174,6 +2174,108 @@ class GenerationEngine:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
+    def _step_avals(self):
+        """ShapeDtypeStruct mirror of step()'s exact dispatch signature,
+        in argument order.  Device-resident inputs (weights, pools, the
+        scratch tables, adapter pack arrays) carry their live shardings so
+        an AOT-compiled executable accepts the real committed arrays;
+        host-built inputs (tokens/tables/lens/...) are plain avals.  The
+        signature is geometry-pure — max_batch, blocks-per-seq, pool
+        shapes, pack shape — so two engines built from the same recorded
+        geometry produce identical avals (what lets a warm standby carry
+        its compiled steps onto a snapshot-restored engine)."""
+        def arr_aval(v):
+            return jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                        sharding=getattr(v, "sharding",
+                                                         None))
+
+        B, W = self.max_batch, self._max_blocks_per_seq
+        avals = (
+            [arr_aval(t._value) for t in self._state],
+            jax.tree_util.tree_map(arr_aval, list(self._kpools)),
+            jax.tree_util.tree_map(arr_aval, list(self._vpools)),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),     # tokens
+            jax.ShapeDtypeStruct((B, W), jnp.int32),     # tables
+            arr_aval(self._scratch_tables),
+            jax.ShapeDtypeStruct((B,), jnp.int32),       # lens
+            jax.ShapeDtypeStruct((B,), jnp.int32),       # max_lens
+            jax.ShapeDtypeStruct((B,), jnp.bool_),       # done0
+            jax.ShapeDtypeStruct((B,), jnp.float32),     # temps
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32),    # keys
+            jax.ShapeDtypeStruct((B,), jnp.uint32),      # steps
+        )
+        if self._pack is not None:
+            avals += (jax.ShapeDtypeStruct((B,), jnp.int32),
+                      jax.tree_util.tree_map(arr_aval, self._pack.ab),
+                      jax.tree_util.tree_map(arr_aval, self._pack.scaling))
+        return avals
+
+    def warmup(self, chunks=None, *, prefill=True, adopt=True):
+        """Pay trace + XLA compile for this engine's hot executables
+        before traffic — the serving analogue of jit.TrainStep.warmup.
+        No step runs: the macro-step is lowered from ShapeDtypeStructs
+        (state, pools, and host inputs as avals), compiled, and stored in
+        the same `_step_fns` table step() consults, so the first real
+        dispatch runs a ready executable instead of compiling on the
+        serving critical path.  With FLAGS_compilation_cache_dir set the
+        compile itself deserializes from the persistent cache — a
+        respawned cluster worker warms up in cache-hit time before
+        announcing readiness (serving/cluster_worker.py).
+
+        `chunks` lists decode chunk widths to compile (default: the
+        effective chunk).  There is no separate tail program to warm: the
+        macro-step's done-mask design parks rows that finish mid-chunk on
+        their scratch pages in-device, so the one D-token executable IS
+        the tail executable.  `prefill=True` additionally runs the
+        admission prefill forward for a single-block prompt over empty
+        caches (the eager dispatch path keys on prompt length, so this
+        warms the one length every full-block admission dispatches;
+        longer prompts still compile lazily).  `adopt=True` round-trips
+        one scratch page through pool_get_blocks/pool_set_blocks — the
+        page-shipping adopt path's gather/scatter programs.
+
+        Speculative engines skip the macro-step warm (they dispatch
+        draft/verify programs, not `_step_fns`); prefill/adopt warming
+        still applies where supported.  Returns
+        {"chunks": [warmed widths], "seconds": wall}."""
+        t0 = time.perf_counter()
+        warmed: list = []
+        if self.draft_model is None:
+            todo = sorted({int(c) for c in (
+                chunks if chunks is not None else [self._effective_chunk()])})
+            for D in todo:
+                if D < 1:
+                    raise ValueError("decode chunk widths must be >= 1")
+                if D not in self._step_fns:
+                    self._step_fns[D] = (self._build_step(D)
+                                         .lower(*self._step_avals())
+                                         .compile())
+                warmed.append(D)
+        if prefill:
+            import paddle_tpu as paddle
+            from paddle_tpu.models.llama import _model_forward_cached
+
+            caches = self._prefix_or_empty(
+                self._kpools, self._vpools, [], 0, self._n_layers,
+                self._nkv, self._head_dim, self.model.config.dtype)
+            dummy = np.zeros((1, self.block_size), np.int32)
+            with paddle.no_grad():
+                _model_forward_cached(self.model.model,
+                                      paddle.to_tensor(dummy), caches, 0)
+        if adopt and self._prefix is not None and self.draft_model is None \
+                and self._pack is None:
+            from paddle_tpu.ops import paged_attention as pa
+
+            # one scratch page through the ship-adoption gather/scatter:
+            # scratch contents are garbage by design (masked lanes write
+            # there), and the poured-back pool is DISCARDED — only the
+            # compiled programs persist
+            idx = jnp.asarray([self._scratch[0]], jnp.int32)
+            for pool in (self._kpools[0], self._vpools[0]):
+                leaves = pa.pool_get_blocks(pool, idx)
+                pa.pool_set_blocks(pool, idx, dict(leaves))
+        return {"chunks": warmed, "seconds": time.perf_counter() - t0}
+
     def _build_draft_step(self):
         from paddle_tpu._core.autograd import no_grad
         from paddle_tpu._core.tensor import Tensor

@@ -72,6 +72,12 @@ __all__ = ["EngineCluster", "cluster_stats", "reset_cluster_stats"]
 # pages forwarded prefill->decode; ship_bytes their wire bytes;
 # ship_retries counts backoff retries + re-ships on the shipping path;
 # drain_migrations counts queued requests handed back by drained replicas.
+# Warm-start tier (docs/SERVING_CLUSTER.md): standbys_warm is a GAUGE of
+# standby workers that reported ready; promotions counts standbys re-keyed
+# into dead replica slots; warmups / warmup_seconds count worker AOT warm
+# reports and their wall; respawn_compile_hits/misses are the persistent
+# compile-cache counters reported by RESPAWNED (gen>1) workers — hits > 0
+# is the asserted warm-respawn contract, not an assumption.
 _CLUSTER_STATS = {
     "replicas_alive": 0,
     "heartbeats_missed": 0,
@@ -81,15 +87,27 @@ _CLUSTER_STATS = {
     "ship_bytes": 0,
     "ship_retries": 0,
     "drain_migrations": 0,
+    "standbys_warm": 0,
+    "promotions": 0,
+    "warmups": 0,
+    "warmup_seconds": 0.0,
+    "respawn_compile_hits": 0,
+    "respawn_compile_misses": 0,
 }
+
+# gauges describe LIVE cluster state, not traffic: reset never zeros them
+_GAUGES = ("replicas_alive", "standbys_warm")
 
 
 def cluster_stats(reset: bool = False) -> dict:
     """Disaggregated-serving cluster counters (docs/SERVING_CLUSTER.md):
     live decode replicas, heartbeat periods missed, request re-dispatches
     after death/drain, KV pages (and bytes) shipped prefill->decode, ship
-    retries, and drain-migrated queued requests.  Zeros when no cluster
-    ran this process."""
+    retries, drain-migrated queued requests, and the warm-start tier —
+    warm standbys (gauge), standby promotions, worker AOT warmups (count
+    + wall seconds), and the persistent compile-cache hit/miss counts
+    respawned workers reported at boot.  Zeros when no cluster ran this
+    process."""
     out = dict(_CLUSTER_STATS)
     if reset:
         reset_cluster_stats()
@@ -97,10 +115,9 @@ def cluster_stats(reset: bool = False) -> dict:
 
 
 def reset_cluster_stats():
-    # replicas_alive is a gauge of live cluster state, not traffic
     for k in _CLUSTER_STATS:
-        if k != "replicas_alive":
-            _CLUSTER_STATS[k] = 0
+        if k not in _GAUGES:
+            _CLUSTER_STATS[k] = 0.0 if k == "warmup_seconds" else 0
 
 
 # ------------------------------------------------------------ kill injection
@@ -183,12 +200,20 @@ class EngineCluster:
     def __init__(self, model_spec, num_replicas=2, num_prefill=0,
                  engine_kwargs=None, *, workdir, heartbeat_ms=None,
                  miss_threshold=None, snapshot_interval=0, respawn=True,
-                 ring_mb=16, kill=None, worker_kill=None):
+                 ring_mb=16, kill=None, worker_kill=None, standby=None,
+                 warmup=True):
         """worker_kill: {(role, idx): "point:nth"} crash-injection specs
         forwarded to specific workers; kill: the ROUTER's own spec.
         snapshot_interval > 0 arms per-replica boundary snapshots
         (FLAGS_engine_snapshot_interval inside the worker), which is what
-        enables restore-based fail-over instead of replay-from-scratch."""
+        enables restore-based fail-over instead of replay-from-scratch.
+        standby: warm standby tier size (None -> FLAGS_cluster_standby) —
+        pre-forked workers that already paid import + trace + compile and
+        park until a decode replica dies, when one is PROMOTED into the
+        dead slot (claiming its snapshot directory) instead of paying a
+        cold respawn; a consumed/dead standby is backfilled
+        asynchronously.  warmup=False skips worker AOT warmup (engines
+        compile lazily at first step, the pre-warm-start behaviour)."""
         from paddle_tpu import _native
 
         if not _native.AVAILABLE:
@@ -207,6 +232,9 @@ class EngineCluster:
             else _flags.flag("FLAGS_cluster_heartbeat_misses"))
         self.snapshot_interval = int(snapshot_interval)
         self.respawn = bool(respawn)
+        self.standby = int(standby if standby is not None
+                           else _flags.flag("FLAGS_cluster_standby"))
+        self.warmup = bool(warmup)
         self.ring_bytes = int(ring_mb) << 20
         self._kill = _KillSpec(kill)
         self._worker_kill = dict(worker_kill or {})
@@ -238,6 +266,8 @@ class EngineCluster:
         self._gens: dict = {}           # (role, idx) -> spawn generation
         self._shipping: dict = {}       # rid -> {"pw", "target", "sid"}
         self._pending_claims: dict = {} # decode idx -> set(rids)
+        self._standby_ready: set = set()  # standby keys that reported ready
+        self._standby_seq = 0             # monotonic standby idx allocator
         self._stopped = False
         # router restart over a live workdir: replicas spawned with a
         # RESTORABLE snapshot will CLAIM their resident requests via
@@ -257,6 +287,8 @@ class EngineCluster:
             self._spawn("decode", i, restore=True)
         for i in range(int(num_prefill)):
             self._spawn("prefill", i)
+        for _ in range(self.standby):
+            self._spawn("standby", self._next_standby_idx())
         if self._awaiting_resume:
             self._resume_deadline = (time.monotonic()
                                      + self.detector.boot_grace_s)
@@ -266,6 +298,13 @@ class EngineCluster:
     # ------------------------------------------------------------ plumbing
     def _snap_dir(self, idx):
         return os.path.join(self.workdir, f"replica{idx}")
+
+    def _next_standby_idx(self):
+        # standby idxs are never reused: a promoted standby keeps its
+        # rings and hb store key while serving under a DECODE key, so a
+        # recycled ("standby", i) would collide with the promoted one
+        self._standby_seq += 1
+        return self._standby_seq - 1
 
     def _sweep_stale_workers(self):
         """A restarted router inherits the previous incarnation's orphaned
@@ -321,6 +360,7 @@ class EngineCluster:
             "snapshot_dir": self._snap_dir(idx) if role == "decode" else "",
             "snapshot_interval": self.snapshot_interval,
             "restore": bool(restore),
+            "warmup": self.warmup,
             # crash injection targets the ORIGINAL process only: a
             # replacement re-armed with the same spec would re-kill
             # itself forever and the matrix would test nothing but churn
@@ -494,9 +534,33 @@ class EngineCluster:
                 return
             self._on_event(w, _decode(data))
 
+    def _note_warm_report(self, w, msg):
+        """Fold one worker boot report (resume/ready) into the warm-start
+        telemetry.  A warmed worker's compiles are behind it, so its
+        heartbeat is judged on the steady-state budget immediately — no
+        boot grace left to hide a stall in."""
+        if msg.get("warmed"):
+            self.detector.mark_warmed(w.key)
+            _CLUSTER_STATS["warmups"] += 1
+            _CLUSTER_STATS["warmup_seconds"] += float(
+                msg.get("warmup_s") or 0.0)
+        if w.gen > 1:
+            _CLUSTER_STATS["respawn_compile_hits"] += int(
+                msg.get("cache_hits") or 0)
+            _CLUSTER_STATS["respawn_compile_misses"] += int(
+                msg.get("cache_misses") or 0)
+
     def _on_event(self, w, msg):
         t = msg["t"]
-        if t == "resume":
+        if t == "ready":
+            # a standby finished its warmup and parked: eligible for
+            # promotion from now on
+            self._note_warm_report(w, msg)
+            if w.role == "standby" and w.alive:
+                self._standby_ready.add(w.key)
+                _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
+        elif t == "resume":
+            self._note_warm_report(w, msg)
             self._awaiting_resume.discard(w.idx)
             claims = self._pending_claims.pop(w.idx, set())
             for rid in msg["rids"]:
@@ -528,6 +592,8 @@ class EngineCluster:
         elif t == "bye":
             w.alive = False
             self.detector.forget(w.key)
+            self._standby_ready.discard(w.key)
+            _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
             self._update_alive_gauge()
         elif t in ("page_begin", "page_block", "page_end"):
             self._forward_ship(w, msg)
@@ -594,6 +660,47 @@ class EngineCluster:
             if key in self._workers and self._workers[key].alive:
                 self._on_worker_dead(key)
 
+    def _promote_standby(self, idx):
+        """Claim a warm standby for dead decode slot `idx`.  The standby
+        keeps its process, rings and heartbeat store key; only its
+        router-side identity changes — the _Worker handle is re-keyed to
+        ("decode", idx) and handed the dead replica's snapshot directory,
+        which it restores (resident requests and all) before reporting
+        resume.  Promotion is NOT a respawn: no process spawns, so the
+        respawns counter stays put and the consumed standby is backfilled
+        asynchronously.  Returns True when a standby took the slot."""
+        while self._standby_ready:
+            skey = min(self._standby_ready)  # oldest idx: FIFO-ish
+            self._standby_ready.discard(skey)
+            _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
+            s = self._workers.get(skey)
+            if s is None or not s.alive:
+                continue
+            try:
+                self._push(s, {"t": "promote",
+                               "snapshot_dir": self._snap_dir(idx),
+                               "snapshot_interval": self.snapshot_interval})
+            except (BrokenPipeError, TimeoutError, ConnectionError):
+                self._on_worker_dead(skey)
+                continue
+            # re-key the handle: same process, new cluster identity
+            del self._workers[skey]
+            self.detector.forget(skey)
+            s.role, s.idx = "decode", idx
+            self._workers[("decode", idx)] = s
+            self._gens[("decode", idx)] = (
+                self._gens.get(("decode", idx), 0) + 1)
+            self.detector.track(("decode", idx))
+            self.detector.mark_warmed(("decode", idx))
+            self.router.add_replica(idx)
+            _CLUSTER_STATS["promotions"] += 1
+            self._write_pidfile()
+            self._update_alive_gauge()
+            if self.respawn and not self._stopped:
+                self._spawn("standby", self._next_standby_idx())
+            return True
+        return False
+
     def _on_worker_dead(self, key):
         w = self._workers.get(key)
         if w is None or not w.alive:
@@ -616,6 +723,14 @@ class EngineCluster:
                 pass
         self._write_pidfile()
         self._update_alive_gauge()
+        if w.role == "standby":
+            # a dead standby serves nobody: just backfill the tier so the
+            # next decode death still finds a warm candidate
+            self._standby_ready.discard(key)
+            _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
+            if self.respawn and not self._stopped:
+                self._spawn("standby", self._next_standby_idx())
+            return
         if w.role == "prefill":
             # abort in-flight ships from this worker, then re-route them
             for rid, state in list(self._shipping.items()):
@@ -646,12 +761,21 @@ class EngineCluster:
                       and os.path.isdir(self._snap_dir(w.idx))
                       and EngineSnapshot(
                           self._snap_dir(w.idx)).latest_step() is not None)
+        promoted = False
         if self.respawn and not was_draining:
-            self._spawn("decode", w.idx, restore=True)
-        if restorable:
+            # warm standby first — it already paid import + trace +
+            # compile, so promotion beats respawn to first token; cold
+            # (well, cache-warmed) respawn is the fallback
+            promoted = self._promote_standby(w.idx)
+            if not promoted:
+                self._spawn("decode", w.idx, restore=True)
+        if promoted or restorable:
             # let the restored replacement CLAIM what its snapshot holds;
             # unclaimed orphans re-dispatch when its resume report lands
-            self._pending_claims[w.idx] = set(orphans)
+            # (union, not overwrite: a replacement that dies pre-resume
+            # must not drop the claims of the generation before it)
+            self._pending_claims[w.idx] = (
+                set(orphans) | self._pending_claims.get(w.idx, set()))
         else:
             for rid in orphans:
                 self._dispatch(rid, redispatch=True)
@@ -737,6 +861,9 @@ class EngineCluster:
                     ring.destroy()
                 except OSError:
                     pass
+        # the bye events may never drain: zero the standby gauge here
+        self._standby_ready.clear()
+        _CLUSTER_STATS["standbys_warm"] = 0
         self._update_alive_gauge()
         if self.router.log is not None:
             self.router.log.close()

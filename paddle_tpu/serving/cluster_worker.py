@@ -17,6 +17,21 @@ PADDLE_CLUSTER_SPEC.  Two roles:
   ships the pool-native page bytes (`pool_get_blocks` leaves — int8
   payload + f32 scales for int8 pools, about half the bf16 wire bytes)
   back through the router to the target decode replica, block by block.
+- **standby**: the warm-start tier (docs/SERVING_CLUSTER.md).  Builds an
+  engine with the cluster's geometry, AOT-warms its macro-step
+  executables (`GenerationEngine.warmup` — persistent-cache-served
+  compiles), announces `ready` with `warmed=True`, then parks on its
+  ring.  A `promote` message hands it a dead replica's snapshot dir: it
+  restores the boundary state, carries its warm executables onto the
+  restored engine (identical recorded geometry means an identical step
+  signature), reports the claimed residents via `resume`, and serves as
+  the replica — compile-free on the recovery critical path.
+
+A decode/standby worker spawned with spec["warmup"] warms up BEFORE
+pushing its readiness report, so its first heartbeat means "already
+compiled" — the router drops the boot-grace carve-out for it
+(FailureDetector.mark_warmed) and judges it on the steady-state miss
+budget immediately.
 
 Heartbeats ride a background thread bumping a TCPStore counter every
 heartbeat_ms/2 — SIGKILL stops the bumps, which is the router's
@@ -37,15 +52,22 @@ import threading
 
 def _bootstrap_jax():
     """Same pinning as tests/conftest.py / run_tier1's worker bootstrap:
-    CPU platform, exact matmuls, shared persistent compile cache."""
+    CPU platform, exact matmuls, shared persistent compile cache.  The
+    cache is configured through _core/compile_cache.configure — NOT raw
+    jax.config.update calls — so worker processes get the shared helper's
+    exact semantics: gate-zeroing (every small CPU-smoke compile
+    persists), the jax.monitoring hit/miss counters the readiness report
+    carries, and the FLAGS_compilation_cache_dir listener."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_default_matmul_precision", "highest")
-    cache = os.environ.get("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    from paddle_tpu._core import compile_cache
+    from paddle_tpu._core import flags as _flags
+
+    cache = (str(_flags.flag("FLAGS_compilation_cache_dir") or "")
+             or os.environ.get("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache"))
+    compile_cache.configure(cache)
 
 
 def _load_factory(spec: str):
@@ -86,9 +108,31 @@ class _Out:
 
 
 # --------------------------------------------------------------- decode role
-def _decode_loop(spec, model, ring_in, out, killer):
-    import numpy as np
+def _warm_report(warm):
+    """Readiness-report fields describing this process's warm state: did
+    it AOT-warm (and how long that took), and how the persistent compile
+    cache served its compiles (process-lifetime jax.monitoring counters —
+    zero at exec, so absolute values ARE this boot's counts)."""
+    from paddle_tpu._core.compile_cache import compile_stats
 
+    cs = compile_stats()
+    return {"warmed": warm is not None,
+            "warmup_s": float(warm["seconds"]) if warm else 0.0,
+            "cache_hits": int(cs["persistent_cache_hits"]),
+            "cache_misses": int(cs["persistent_cache_misses"])}
+
+
+def _claimed_rids(eng) -> set:
+    """The rids a restored engine resurrects: resident slots, the queued
+    backlog, and finished-but-undelivered results — the boundary may have
+    caught a request between completion and the router's read."""
+    tracked = {s.rid for s in eng._slots if s.active}
+    tracked.update(eng.pending_requests())
+    tracked.update(eng._results)
+    return tracked
+
+
+def _build_decode_engine(spec, model):
     import paddle_tpu as paddle
     from paddle_tpu.serving import GenerationEngine, restore_engine
     from paddle_tpu.serving.snapshot import EngineSnapshot
@@ -101,24 +145,29 @@ def _decode_loop(spec, model, ring_in, out, killer):
 
     kw = dict(spec["engine"])
     kw["prefix_cache"] = True
-    eng = None
-    tracked: set = set()
-    sent: dict = {}
     if spec["restore"] and snap_dir and \
             EngineSnapshot(snap_dir).latest_step() is not None:
         eng = restore_engine(model, snap_dir)
-        for s in eng._slots:
-            if s.active:
-                tracked.add(s.rid)
-        tracked.update(eng.pending_requests())
-        # finished-but-undelivered results also re-emit: the boundary may
-        # have caught a request between completion and the router's read
-        for rid in eng._results:
-            tracked.add(rid)
-    if eng is None:
-        eng = GenerationEngine(model, **kw)
-    out.push({"t": "resume", "rids": sorted(tracked, key=str)})
+        return eng, _claimed_rids(eng)
+    return GenerationEngine(model, **kw), set()
 
+
+def _decode_loop(spec, model, ring_in, out, killer):
+    eng, tracked = _build_decode_engine(spec, model)
+    # AOT warm BEFORE the readiness report: the resume push is the claim
+    # of this replica's requests, and announcing it with compiles still
+    # owed would put trace+compile back on the serving critical path
+    warm = eng.warmup() if spec.get("warmup") else None
+    out.push({"t": "resume", "rids": sorted(tracked, key=str),
+              **_warm_report(warm)})
+    _decode_serve(spec, eng, tracked, ring_in, out, killer)
+
+
+def _decode_serve(spec, eng, tracked, ring_in, out, killer):
+    import numpy as np
+
+    snap_dir = spec["snapshot_dir"]
+    sent: dict = {}
     staging: dict = {}
     draining = eng._draining
 
@@ -208,6 +257,84 @@ def _decode_loop(spec, model, ring_in, out, killer):
         elif draining:
             break  # residents finished; queued rids migrated via drained
     out.push({"t": "bye"})
+
+
+# -------------------------------------------------------------- standby role
+def _carries_executables(eng, cfg) -> bool:
+    """Whether the standby engine's AOT-compiled macro-steps are valid on
+    an engine restored from recorded geometry `cfg` (EngineSnapshot
+    .config()): the step signature is geometry-pure — batch, table width,
+    pool shapes/dtype — and the compiled executable closes over nothing
+    engine-local, so identical geometry means the executables carry.
+    Adapter/speculative snapshots never carry (their signatures differ)."""
+    return (cfg["max_batch"] == eng.max_batch
+            and cfg["block_size"] == eng.block_size
+            and cfg["num_blocks"] == eng._num_blocks
+            and cfg["kv_cache_dtype"] == eng._kv_dtype
+            and not cfg["has_draft"] and cfg["adapters"] is None
+            and eng.draft_model is None and eng._pack is None)
+
+
+def _standby_loop(spec, model, ring_in, out, killer):
+    """Warm standby: pay import + trace + (persistent-cache-served)
+    compile NOW, against the cluster's engine geometry, then park until a
+    `promote` message hands over a dead replica's snapshot dir.  On
+    promotion the standby restores the replica's boundary state, carries
+    its warm executables onto the restored engine when the recorded
+    geometry matches, claims the residents via `resume`, and becomes the
+    decode replica — the respawn path's jax import + trace + compile wall
+    never lands on the recovery critical path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.serving.snapshot import EngineSnapshot
+
+    kw = dict(spec["engine"])
+    kw["prefix_cache"] = True
+    eng = GenerationEngine(model, **kw)
+    killer.hit("standby-mid-warmup")
+    warm = eng.warmup() if spec.get("warmup", True) else None
+    out.push({"t": "ready", **_warm_report(warm)})
+
+    while True:
+        try:
+            data = ring_in.pop(timeout_ms=100)
+        except TimeoutError:
+            continue
+        except BrokenPipeError:
+            os._exit(3)
+        if data is None:
+            continue
+        msg = pickle.loads(data)
+        if msg["t"] == "stop":
+            out.push({"t": "bye"})
+            return
+        if msg["t"] == "promote":
+            break
+
+    snap_dir = msg["snapshot_dir"]
+    interval = int(msg.get("snapshot_interval", 0))
+    spec = dict(spec)
+    spec["snapshot_dir"], spec["snapshot_interval"] = snap_dir, interval
+    tracked: set = set()
+    if snap_dir and interval > 0:
+        # the flags listener clears EVERY engine's compiled steps on ANY
+        # set_flags — hold the warm executables across the snapshot-dir
+        # arm and reinstall them
+        step_fns = dict(eng._step_fns)
+        paddle.set_flags({
+            "FLAGS_engine_snapshot_dir": snap_dir,
+            "FLAGS_engine_snapshot_interval": interval})
+        eng._step_fns.update(step_fns)
+    store = EngineSnapshot(snap_dir) if snap_dir else None
+    if store is not None and store.latest_step() is not None:
+        restored = store.restore(model)
+        if _carries_executables(eng, store.config()):
+            restored._step_fns.update(eng._step_fns)
+        eng = restored
+        tracked = _claimed_rids(eng)
+    out.push({"t": "resume", "rids": sorted(tracked, key=str),
+              **_warm_report(warm)})
+    _decode_serve(spec, eng, tracked, ring_in, out, killer)
 
 
 # -------------------------------------------------------------- prefill role
@@ -330,6 +457,8 @@ def main():
     try:
         if spec["role"] == "decode":
             _decode_loop(spec, model, ring_in, out, killer)
+        elif spec["role"] == "standby":
+            _standby_loop(spec, model, ring_in, out, killer)
         else:
             _prefill_loop(spec, model, ring_in, out, killer)
     except BrokenPipeError:

@@ -236,6 +236,19 @@ class FailureDetector:
             st[0], st[1], st[2] = counter, self._clock(), 0
             st[3] = st[3] or booted
 
+    def mark_warmed(self, rank):
+        """Arm steady-state miss accounting for `rank` NOW: a worker that
+        announced `warmed=True` in its ready/resume record has already
+        paid import + trace + compile, so nothing slow stands between it
+        and its next heartbeat — the boot-grace carve-out (which exists
+        only because cold boots stall for seconds before the first bump)
+        does not apply.  A warm worker that then stalls is declared dead
+        within the NORMAL miss threshold.  Cold boots (no warmed record)
+        keep the grace window."""
+        st = self._state.setdefault(rank, [-1, self._clock(), 0, False])
+        st[1] = self._clock()  # the miss window starts at the report
+        st[3] = True
+
     def misses(self, rank):
         st = self._state.get(rank)
         if st is None or not st[3]:

@@ -464,6 +464,27 @@ class EngineSnapshot:
             _SKIP_COUNTED.discard(path)
         _ckpt.sweep_stale_tmp(self.dir)
 
+    def config(self, step=None) -> dict:
+        """The recorded engine geometry of snapshot `step` (default:
+        newest valid) — the `_capture_host_state` config dict (max_batch,
+        block_size, num_blocks, kv_cache_dtype, decode_chunk, model
+        record, ...), WITHOUT loading any pool bytes.  This is what lets
+        a warm standby decide whether its AOT-compiled executables carry
+        onto the restored engine (identical geometry => identical step
+        signature) and what a respawned worker warms up against before
+        announcing readiness (serving/cluster_worker.py)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise RuntimeError(
+                    f"no valid engine snapshot under {self.dir!r}")
+        path = self._step_dir(step)
+        if not self._valid(path):
+            raise RuntimeError(f"engine snapshot {path} is missing or corrupt")
+        with open(os.path.join(path, _ckpt._EXTRAS), "rb") as f:
+            extras = pickle.load(f)
+        return dict(extras["config"])
+
     # -------------------------------------------------------------- restore
     def restore(self, model, step=None, *, mesh=None, mp_axis="mp",
                 draft_model=None, decode_chunk=_UNSET):

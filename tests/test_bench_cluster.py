@@ -6,7 +6,10 @@ Runs the bench in --smoke mode as a subprocess (it forks and SIGKILLs
 real cluster processes, which is also why this module rides a DEDICATED
 tools/run_tier1.py isolated worker) and asserts the payload contract the
 regression gate consumes: zero lost requests, bit-matching fail-over
-streams, positive fail-over latencies, and pages actually shipped."""
+streams across EVERY recovery mode, positive fail-over latencies, pages
+actually shipped, and the warm-start acceptance floor — standby
+promotion's detect->first-token beats cold respawn by at least 2x, and
+the warmed respawn booted with persistent compile-cache hits > 0."""
 
 import json
 import os
@@ -38,5 +41,17 @@ def test_bench_cluster_smoke_payload():
     assert fo["lost"] == 0
     assert fo["streams_match"] is True
     assert fo["detect_ms"] > 0 and fo["recover_ms"] >= fo["detect_ms"]
+    # warm-start matrix: every recovery mode measured, and the promotion
+    # path's detect->first-token beats cold respawn by >= 2x (the
+    # ROADMAP item-5 acceptance floor — 2x is deliberately loose next to
+    # the typical ~20x so CPU scheduling jitter cannot flake it)
+    ft = fo["first_token_ms"]
+    for mode in ("cold", "warm_respawn", "standby"):
+        assert ft[mode] > 0, ft
+    assert ft["standby"] * 2 <= ft["cold"], ft
+    # the standby run really promoted, and the warmed respawn really
+    # booted off the persistent cache — asserted, not assumed
+    assert fo["promotions"] >= 1, fo
+    assert fo["respawn_compile_hits"] > 0, fo
     assert payload["detail"]["ship"]["pages"] >= 1
     assert payload["detail"]["ship"]["bytes"] > 0

@@ -183,14 +183,18 @@ def _overload_payload(chunked_ms=60.0, atomic_ms=400.0):
     }
 
 
-def _cluster_payload(detect_ms=40.0, recover_ms=400.0, value=900.0):
+def _cluster_payload(detect_ms=40.0, recover_ms=400.0, value=900.0,
+                     first_token=None):
+    fo = {"detect_ms": detect_ms, "recover_ms": recover_ms,
+          "lost": 0, "streams_match": True, "redispatches": 2}
+    if first_token is not None:
+        fo["first_token_ms"] = dict(first_token)
+        fo["promotions"] = 1
+        fo["respawn_compile_hits"] = 40
     return {
         "metric": "cluster_tokens_per_sec", "value": value,
         "unit": "tok/s", "tokens_match": True,
-        "detail": {"failover": {
-            "detect_ms": detect_ms, "recover_ms": recover_ms,
-            "lost": 0, "streams_match": True, "redispatches": 2,
-        }},
+        "detail": {"failover": fo},
     }
 
 
@@ -222,6 +226,36 @@ def test_cluster_failover_gate(tmp_path):
     lost = _w(tmp_path, "c_lost.json",
               {"rc": 1, "tail": json.dumps(_cluster_payload())})
     assert main([lost, same]) == 0
+
+
+def test_cluster_first_token_gate(tmp_path):
+    """Warm-start wiring (bench_cluster.py fail-over matrix): the
+    per-recovery-mode detect->first-token numbers gate lower-is-better
+    at the SLO threshold, each mode independently; payloads from before
+    the warm-start round carry no first_token_ms dict and skip that
+    sub-gate silently in either direction."""
+    ft = {"cold": 2000.0, "warm_respawn": 1500.0, "standby": 120.0}
+    old = _w(tmp_path, "f_old.json", _cluster_payload(first_token=ft))
+    same = _w(tmp_path, "f_same.json", _cluster_payload(first_token=ft))
+    assert main([old, same]) == 0
+    # the standby (promotion) path regressing 5x gates even while cold
+    # and warm_respawn are unchanged — each mode gates independently
+    slow_sb = _w(tmp_path, "f_sb.json", _cluster_payload(
+        first_token=dict(ft, standby=600.0)))
+    assert main([old, slow_sb]) == 1
+    assert main([old, slow_sb, "--slo-threshold", "9.0"]) == 0
+    assert main([slow_sb, old]) == 0         # improvement never gates
+    slow_cold = _w(tmp_path, "f_cold.json", _cluster_payload(
+        first_token=dict(ft, cold=9000.0)))
+    assert main([old, slow_cold]) == 1
+    # pre-warm-start payloads (no first_token_ms) skip the sub-gate but
+    # keep gating detect/recover
+    pre = _w(tmp_path, "f_pre.json", _cluster_payload())
+    assert main([pre, slow_sb]) == 0
+    assert main([slow_sb, pre]) == 0
+    pre_slow = _w(tmp_path, "f_preslow.json",
+                  _cluster_payload(detect_ms=200.0))
+    assert main([pre, pre_slow]) == 1
 
 
 def test_overload_itl_gate(tmp_path):

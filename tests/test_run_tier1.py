@@ -89,6 +89,9 @@ def test_build_plan_isolates_collective_modules():
     for mod in ("test_serving_cluster.py", "test_serving_cluster_crash.py",
                 "test_bench_cluster.py"):
         assert mod in iso_names, mod
+    # the warm-start module forks standby workers and SIGKILLs them
+    # mid-warmup — same fork/SIGKILL crash class, same dedicated worker
+    assert "test_cluster_warm.py" in iso_names
     # the pipeline-schedule parity suite dispatches split-backward GSPMD
     # pipeline programs over 4/8-device in-process meshes every test: a
     # DEDICATED isolated worker, never round-robin, never slow-marked

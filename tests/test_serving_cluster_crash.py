@@ -13,6 +13,10 @@ participant at one enumerated protocol point:
   (EngineSnapshot restore fail-over), and right after adopting shipped
   pages;
 - the PREFILL WORKER before and in the middle of a page shipment;
+- the WARM-STANDBY tier (ROADMAP item 5): a decode death with a warm
+  standby parked is recovered by PROMOTION (the standby claims the dead
+  replica's snapshot — no respawn), and a standby SIGKILLed mid-warmup
+  degrades recovery to the respawn fallback without losing a request;
 - the ROUTER itself right after journaling an acceptance and mid-serving
   (the driver process dies; a SECOND driver run over the same workdir
   replays the durable intake log, sweeps the orphaned workers, and
@@ -48,13 +52,18 @@ jax.config.update("jax_compilation_cache_dir", cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-from paddle_tpu.serving.cluster import EngineCluster
+from paddle_tpu.serving.cluster import EngineCluster, cluster_stats
 
 (workdir, out_path, model_spec, router_kill, worker_role, worker_kill,
- snapshot_interval) = sys.argv[1:8]
+ snapshot_interval, standby, wait_standby) = sys.argv[1:10]
 
 worker_kill_map = {}
-if worker_kill:
+if worker_kill.startswith("{"):
+    # multi-participant kills: {"role:idx": "point:nth", ...}
+    for k, v in json.loads(worker_kill).items():
+        role, idx = k.split(":")
+        worker_kill_map[(role, int(idx))] = v
+elif worker_kill:
     worker_kill_map[(worker_role, 0)] = worker_kill
 
 EKW = dict(max_batch=2, block_size=8, num_blocks=32, decode_chunk=2)
@@ -69,8 +78,19 @@ c = EngineCluster(model_spec, num_replicas=2, num_prefill=1,
                   engine_kwargs=EKW, workdir=workdir,
                   heartbeat_ms=100, miss_threshold=10,
                   snapshot_interval=int(snapshot_interval),
-                  kill=router_kill, worker_kill=worker_kill_map)
+                  kill=router_kill, worker_kill=worker_kill_map,
+                  standby=int(standby))
 try:
+    if int(wait_standby):
+        # the case under test is PROMOTION: the kill must find a WARM
+        # standby, not race its boot
+        import time
+        deadline = time.monotonic() + 180
+        while cluster_stats()["standbys_warm"] < int(wait_standby):
+            c.poll()
+            if time.monotonic() > deadline:
+                raise TimeoutError("standby tier never warmed")
+            time.sleep(0.01)
     for rid, prompt, opts in WORKLOAD:
         c.submit(rid, prompt, max_new_tokens=opts["max_new_tokens"],
                  temperature=opts.get("temperature", 0.0),
@@ -78,8 +98,6 @@ try:
     c.serve(timeout_s=240)
     with open(out_path, "w") as f:
         json.dump({rid: c.result(rid) for rid, _p, _o in WORKLOAD}, f)
-    from paddle_tpu.serving.cluster import cluster_stats
-
     print("STATS", json.dumps(cluster_stats()))
     print("DONE")
 finally:
@@ -90,7 +108,8 @@ _MODEL_SPEC = os.path.join(_HERE, "cluster_common.py") + ":make_model"
 
 
 def _run_driver(tmp_path, workdir, out, router_kill="", worker_role="",
-                worker_kill="", snapshot_interval=0):
+                worker_kill="", snapshot_interval=0, standby=0,
+                wait_standby=0):
     script = tmp_path / "driver.py"
     script.write_text(_DRIVER)
     repo_root = os.path.dirname(_HERE)
@@ -100,8 +119,8 @@ def _run_driver(tmp_path, workdir, out, router_kill="", worker_role="",
     env.setdefault("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
     cmd = [sys.executable, str(script), str(workdir), str(out),
            _MODEL_SPEC, router_kill, worker_role, worker_kill,
-           str(snapshot_interval)]
-    return subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+           str(snapshot_interval), str(standby), str(wait_standby)]
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=480,
                           env=env)
 
 
@@ -155,6 +174,54 @@ def test_worker_kill_matrix_streams_bit_identical(tmp_path, reference,
         assert stats["redispatches"] >= 1, stats
     if role == "prefill":
         assert stats["ship_retries"] >= 1, stats
+
+
+def test_standby_promotion_claims_snapshot_bit_identical(tmp_path,
+                                                         reference):
+    """Warm-standby fail-over (ROADMAP item 5): a decode replica is
+    SIGKILLed mid-stream with boundary snapshots armed and a WARM standby
+    parked.  The standby is PROMOTED — no process spawns — claims the
+    dead replica's snapshot directory, restores its residents, and every
+    completed stream equals the unkilled run's bit for bit (the
+    bit-exact fail-over contract re-asserted on the promotion path)."""
+    out = tmp_path / "out.json"
+    r = _run_driver(tmp_path, tmp_path / "wd", out, worker_role="decode",
+                    worker_kill="decode-mid-stream:2", snapshot_interval=1,
+                    standby=1, wait_standby=1)
+    assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
+    got = json.loads(out.read_text())
+    assert got == reference, (got, reference)
+    stats = json.loads(
+        [ln for ln in r.stdout.splitlines()
+         if ln.startswith("STATS ")][-1][len("STATS "):])
+    # the warm standby took the slot; the respawn path never ran
+    assert stats["promotions"] >= 1, stats
+    assert stats["respawns"] == 0, stats
+
+
+def test_standby_killed_mid_warmup_falls_back_to_respawn(tmp_path,
+                                                         reference):
+    """The standby ITSELF is SIGKILLed mid-warmup, then a decode replica
+    dies mid-stream before the backfilled standby can warm: recovery
+    falls back to the (cache-warmed) respawn path.  Zero requests lost,
+    streams bit-identical — a dead standby never weakens the fail-over
+    contract, it only costs the fast path."""
+    kills = json.dumps({"standby:0": "standby-mid-warmup:1",
+                        "decode:0": "decode-mid-stream:1"})
+    out = tmp_path / "out.json"
+    r = _run_driver(tmp_path, tmp_path / "wd", out, worker_kill=kills,
+                    snapshot_interval=1, standby=1)
+    assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
+    got = json.loads(out.read_text())
+    assert got == reference, (got, reference)
+    stats = json.loads(
+        [ln for ln in r.stdout.splitlines()
+         if ln.startswith("STATS ")][-1][len("STATS "):])
+    # the decode death was recovered by a respawn (the dead standby left
+    # no warm candidate in time); promotions are not asserted zero —
+    # the backfilled standby MAY win the race on a slow box, and either
+    # recovery path must uphold the same stream contract
+    assert stats["respawns"] >= 1 or stats["promotions"] >= 1, stats
 
 
 @pytest.mark.parametrize("router_kill,snap", [

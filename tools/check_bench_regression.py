@@ -155,9 +155,11 @@ def load_overload(path):
 
 def load_failover(path):
     """The fail-over section of a cluster bench payload (bench_cluster.py
-    detail.failover: {"detect_ms", "recover_ms", "lost",
-    "streams_match"}), or None when absent — pre-cluster rounds and
-    non-cluster benches skip the gate."""
+    detail.failover: {"detect_ms", "recover_ms", "lost", "streams_match",
+    "first_token_ms": {"cold", "warm_respawn", "standby"}}), or None when
+    absent — pre-cluster rounds and non-cluster benches skip the gate.
+    Payloads written before the warm-start round carry no first_token_ms
+    dict; that sub-gate skips silently for them."""
     data, _err = _payload_dict(path)
     if not isinstance(data, dict):
         return None
@@ -304,6 +306,25 @@ def main(argv=None):
                   f"({rel:+.2%}) {stat}")
             if stat == "REGRESSION":
                 rc = 1
+        # detect -> first-token per recovery mode (warm-start round):
+        # the user-visible outage per path, lower-is-better at the SLO
+        # threshold.  Pre-warm-start payloads carry no first_token_ms
+        # dict — the sub-gate skips silently for them.
+        oft, nft = old_fo.get("first_token_ms"), new_fo.get("first_token_ms")
+        if isinstance(oft, dict) and isinstance(nft, dict):
+            for mode in sorted(set(oft) & set(nft)):
+                try:
+                    o, n = float(oft[mode]), float(nft[mode])
+                except (TypeError, ValueError):
+                    continue
+                if not o > 0 or not n > 0:
+                    continue
+                rel = (n - o) / o
+                stat = "REGRESSION" if rel > args.slo_threshold else "ok"
+                print(f"bench gate [failover first_token {mode}]: "
+                      f"{o:.1f} -> {n:.1f} ms ({rel:+.2%}) {stat}")
+                if stat == "REGRESSION":
+                    rc = 1
 
     # pipeline-schedule gate: per-schedule simulator bubble fraction,
     # LOWER is better (growth means the schedule table regressed — the

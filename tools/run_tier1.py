@@ -79,6 +79,10 @@ ISOLATED_DEFAULT = (
     "test_serving_cluster.py",
     "test_serving_cluster_crash.py",
     "test_bench_cluster.py",
+    # Warm-start tier: forks standby workers, SIGKILLs them mid-warmup,
+    # and asserts a respawned worker's persistent-cache hit counters —
+    # same fork/SIGKILL crash class, same containment.
+    "test_cluster_warm.py",
     # The pipeline-schedule parity suite dispatches GSPMD split-backward
     # pipeline programs (custom-vjp scan pairs with ring ppermutes) over
     # 4- and 8-device in-process meshes every test — the same crash class,
