@@ -218,8 +218,8 @@ class Profiler:
         from .statistics import (checkpoint_line, cluster_line,
                                  compile_cache_line, decode_line,
                                  dispatch_cache_line, lora_line, mesh_line,
-                                 pipeline_line, schedule_line, snapshot_line,
-                                 summary_text, verify_line)
+                                 pipeline_line, protocol_line, schedule_line,
+                                 snapshot_line, summary_text, verify_line)
 
         out = summary_text(self._buffer.spans, self._step_spans,
                            sorted_by=sorted_by, op_detail=op_detail,
@@ -242,6 +242,9 @@ class Profiler:
         ml_line = mesh_line(mesh_lint_stats())
         if ml_line:
             out = out + "\n" + ml_line
+        pr_line = protocol_line(protocol_lint_stats())
+        if pr_line:
+            out = out + "\n" + pr_line
         sched_line = schedule_line(schedule_search_stats())
         if sched_line:
             out = out + "\n" + sched_line
@@ -434,6 +437,23 @@ def mesh_lint_stats(reset: bool = False) -> dict:
     return _ml.mesh_lint_stats(reset=reset)
 
 
+def protocol_lint_stats(reset: bool = False) -> dict:
+    """Protocol-lint counters (see static/protocol_lint.py and
+    docs/PROTOCOL_LINT.md): model-check scenarios run, abstract-cluster
+    states and transitions explored, per-state invariant evaluations,
+    violations and deadlocks found, plus the blocking-call AST pass
+    (files linted, functions scanned, blocking call sites classified).
+    A healthy run shows violations and deadlocks at zero — nonzero means
+    an interleaving of the abstract router/replica/prefill/standby model
+    broke a named invariant of serving/protocol.py (the raised
+    ProtocolLintError carries the minimal counterexample trace) or a
+    wait escaped retry_backoff's shared-deadline discipline.  The
+    protocol_lint module owns the counters — one schema, no drift."""
+    from paddle_tpu.static import protocol_lint as _pl
+
+    return _pl.protocol_lint_stats(reset=reset)
+
+
 def schedule_search_stats(reset: bool = False) -> dict:
     """Pallas schedule-search counters (FLAGS_schedule_search; see
     static/schedule_search.py and docs/SCHEDULE_SEARCH.md): subgraphs
@@ -526,7 +546,7 @@ def checkpoint_stats(reset: bool = False) -> dict:
 __all__ += ["dispatch_cache_stats", "reset_dispatch_cache", "compile_stats",
             "decode_stats", "lora_stats", "verify_stats", "mesh_lint_stats",
             "schedule_search_stats", "checkpoint_stats", "snapshot_stats",
-            "cluster_stats", "pipeline_stats"]
+            "cluster_stats", "pipeline_stats", "protocol_lint_stats"]
 
 
 def _compile_and_analyze(fn, example_args):

@@ -303,6 +303,27 @@ def mesh_line(stats: dict) -> str:
     )
 
 
+def protocol_line(stats: dict) -> str:
+    """One-line rendering of the protocol-lint counters for
+    Profiler.summary(); empty when neither the model checker nor the
+    blocking-call pass ran this process.  violations or deadlocks nonzero
+    is the red flag: an interleaving of the abstract cluster model broke
+    a named invariant (the ProtocolLintError carries the minimal
+    counterexample trace), or a blocking call site escaped the shared
+    deadline discipline."""
+    if not (stats.get("scenarios_checked") or stats.get("files_linted")):
+        return ""
+    return (
+        "Protocol lint: scenarios=%d states=%d transitions=%d "
+        "invariant_checks=%d violations=%d deadlocks=%d; files=%d "
+        "functions=%d blocking_calls=%d"
+        % (stats["scenarios_checked"], stats["model_states"],
+           stats["model_transitions"], stats["invariant_checks"],
+           stats["violations"], stats["deadlocks"], stats["files_linted"],
+           stats["functions_scanned"], stats["blocking_calls_checked"])
+    )
+
+
 def schedule_line(stats: dict) -> str:
     """One-line rendering of the Pallas schedule-search counters for
     Profiler.summary(); empty when the search tier never ran this process.

@@ -57,6 +57,7 @@ import time
 import uuid
 
 from paddle_tpu._core import flags as _flags
+from paddle_tpu.serving import protocol as _protocol
 from paddle_tpu.serving.router import (FailureDetector, IntakeLog,
                                        RequestRouter, retry_backoff)
 
@@ -239,6 +240,20 @@ class EngineCluster:
         self._kill = _KillSpec(kill)
         self._worker_kill = dict(worker_kill or {})
         self._ns = f"c{uuid.uuid4().hex[:8]}"  # per-incarnation namespace
+
+        # ---- spec <-> handler binding, BEFORE any fork ------------------
+        # Dispatch is table-driven through serving/protocol.py, both
+        # directions asserted here: every spec message with dst=router
+        # must bind to an _ev_* method and every _ev_* method must appear
+        # in the spec; the worker module's per-role tables bind the same
+        # way at its import.  Removing a handler or a spec row fails
+        # loudly at construction — the spec cannot rot.
+        self._handlers = _protocol.bind_handlers(
+            "router", _protocol.handler_lookup(self, "_ev_"),
+            prefix="_ev_", label="EngineCluster event dispatch")
+        from paddle_tpu.serving import cluster_worker as _worker_mod
+
+        _worker_mod.handler_tables()  # binds (and asserts) all 3 roles
 
         # ---- rendezvous store (the router hosts it) --------------------
         self._store_srv = _native.TCPStoreServer()
@@ -551,59 +566,88 @@ class EngineCluster:
                 msg.get("cache_misses") or 0)
 
     def _on_event(self, w, msg):
-        t = msg["t"]
-        if t == "ready":
-            # a standby finished its warmup and parked: eligible for
-            # promotion from now on
-            self._note_warm_report(w, msg)
-            if w.role == "standby" and w.alive:
-                self._standby_ready.add(w.key)
-                _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
-        elif t == "resume":
-            self._note_warm_report(w, msg)
-            self._awaiting_resume.discard(w.idx)
-            claims = self._pending_claims.pop(w.idx, set())
-            for rid in msg["rids"]:
-                req = self.router.request(rid)
-                if req is not None and not req.done:
-                    self.router.assign(rid, w.idx)
-                    claims.discard(rid)
-            # rids the replacement did NOT resurrect (accepted after its
-            # last snapshot boundary) fall back to intake-log replay
-            for rid in sorted(claims):
-                if not self.router.request(rid).done:
-                    self._dispatch(rid, redispatch=True)
-        elif t == "tokens":
-            self.router.on_tokens(msg["rid"], msg["start"], msg["toks"])
-            self._kill.hit("router-mid-serving")
-        elif t == "done":
-            self.router.on_done(msg["rid"], msg["n"])
-        elif t == "requeue":
-            req = self.router.request(msg["rid"])
-            if req is not None and not req.done:
-                self._dispatch(msg["rid"], redispatch=True)
-        elif t == "drained":
-            w.draining = True
-            self._update_alive_gauge()
-            migrated = self.router.on_drained(w.idx, msg["queued"])
-            _CLUSTER_STATS["drain_migrations"] += len(migrated)
-            for rid in migrated:
-                self._dispatch(rid, redispatch=True)
-        elif t == "bye":
-            w.alive = False
-            self.detector.forget(w.key)
-            self._standby_ready.discard(w.key)
+        """Table-driven event dispatch: the handler set is BOUND to the
+        protocol spec at construction (serving/protocol.py), so a message
+        outside the spec is a protocol violation, not a silent drop."""
+        try:
+            handler = self._handlers[msg["t"]]
+        except KeyError:
+            raise _protocol.ProtocolSpecError(
+                f"router received message {msg.get('t')!r} from "
+                f"{w.role}{w.idx} — not a spec message with dst=router "
+                "(serving/protocol.py)") from None
+        handler(w, msg)
+
+    # Every inbound spec message binds to one _ev_<message> method below
+    # (and every _ev_* method must be a spec message — both directions
+    # asserted at construction, before any fork).
+    def _ev_ready(self, w, msg):
+        # a standby finished its warmup and parked: eligible for
+        # promotion from now on
+        self._note_warm_report(w, msg)
+        if w.role == "standby" and w.alive:
+            self._standby_ready.add(w.key)
             _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
-            self._update_alive_gauge()
-        elif t in ("page_begin", "page_block", "page_end"):
-            self._forward_ship(w, msg)
-        elif t == "shipped":
-            state = self._shipping.pop(msg["rid"], None)
-            if state is not None:
-                req = self.router.request(msg["rid"])
-                self._submit_to(state["target"], req)
-        elif t == "fatal":
-            self._on_worker_dead(w.key)
+
+    def _ev_resume(self, w, msg):
+        self._note_warm_report(w, msg)
+        self._awaiting_resume.discard(w.idx)
+        claims = self._pending_claims.pop(w.idx, set())
+        for rid in msg["rids"]:
+            req = self.router.request(rid)
+            if req is not None and not req.done:
+                self.router.assign(rid, w.idx)
+                claims.discard(rid)
+        # rids the replacement did NOT resurrect (accepted after its
+        # last snapshot boundary) fall back to intake-log replay
+        for rid in sorted(claims):
+            if not self.router.request(rid).done:
+                self._dispatch(rid, redispatch=True)
+
+    def _ev_tokens(self, w, msg):
+        self.router.on_tokens(msg["rid"], msg["start"], msg["toks"])
+        self._kill.hit("router-mid-serving")
+
+    def _ev_done(self, w, msg):
+        self.router.on_done(msg["rid"], msg["n"])
+
+    def _ev_requeue(self, w, msg):
+        req = self.router.request(msg["rid"])
+        if req is not None and not req.done:
+            self._dispatch(msg["rid"], redispatch=True)
+
+    def _ev_drained(self, w, msg):
+        w.draining = True
+        self._update_alive_gauge()
+        migrated = self.router.on_drained(w.idx, msg["queued"])
+        _CLUSTER_STATS["drain_migrations"] += len(migrated)
+        for rid in migrated:
+            self._dispatch(rid, redispatch=True)
+
+    def _ev_bye(self, w, msg):
+        w.alive = False
+        self.detector.forget(w.key)
+        self._standby_ready.discard(w.key)
+        _CLUSTER_STATS["standbys_warm"] = len(self._standby_ready)
+        self._update_alive_gauge()
+
+    def _ev_page_begin(self, w, msg):
+        self._forward_ship(w, msg)
+
+    def _ev_page_block(self, w, msg):
+        self._forward_ship(w, msg)
+
+    def _ev_page_end(self, w, msg):
+        self._forward_ship(w, msg)
+
+    def _ev_shipped(self, w, msg):
+        state = self._shipping.pop(msg["rid"], None)
+        if state is not None:
+            req = self.router.request(msg["rid"])
+            self._submit_to(state["target"], req)
+
+    def _ev_fatal(self, w, msg):
+        self._on_worker_dead(w.key)
 
     def _forward_ship(self, pw, msg):
         """Relay one prefill-worker page message into the target decode

@@ -163,13 +163,101 @@ def _decode_loop(spec, model, ring_in, out, killer):
     _decode_serve(spec, eng, tracked, ring_in, out, killer)
 
 
-def _decode_serve(spec, eng, tracked, ring_in, out, killer):
+class _DecodeCtx:
+    """Mutable decode-serve state threaded through the table-driven
+    message handlers (what the pre-PR-19 handle() closure captured)."""
+
+    __slots__ = ("spec", "eng", "tracked", "staging", "sent", "out",
+                 "killer", "draining", "snap_dir")
+
+    def __init__(self, spec, eng, tracked, out, killer):
+        self.spec = spec
+        self.eng = eng
+        self.tracked = tracked
+        self.staging: dict = {}
+        self.sent: dict = {}
+        self.out = out
+        self.killer = killer
+        self.draining = eng._draining
+        self.snap_dir = spec["snapshot_dir"]
+
+
+# Decode-role message handlers.  One `_decode_msg_<message>` per spec
+# message with dst=decode — handler_tables() binds them through
+# serving/protocol.py with BOTH directions asserted (a spec message
+# without a handler, or a handler the spec no longer names, fails at
+# EngineCluster construction, before any fork).
+def _decode_msg_submit(ctx, msg):
+    if ctx.draining:
+        ctx.out.push({"t": "requeue", "rid": msg["rid"]})
+        return None
+    ctx.eng.add_request(msg["rid"], msg["prompt"],
+                        max_new_tokens=msg["max_new"],
+                        temperature=msg["temperature"] or None,
+                        seed=msg["seed"], nonce=msg["nonce"],
+                        priority=msg.get("priority", "normal"))
+    ctx.killer.hit("decode-after-accept")
+    ctx.tracked.add(msg["rid"])
+    return None
+
+
+def _decode_msg_ship_begin(ctx, msg):
+    ctx.staging[msg["sid"]] = {"tokens": msg["tokens"],
+                               "n": msg["n_blocks"], "k": [], "v": []}
+    return None
+
+
+def _decode_msg_ship_block(ctx, msg):
+    st = ctx.staging.get(msg["sid"])
+    if st is not None:
+        st["k"].append(msg["k"])
+        st["v"].append(msg["v"])
+    return None
+
+
+def _decode_msg_ship_end(ctx, msg):
     import numpy as np
 
-    snap_dir = spec["snapshot_dir"]
-    sent: dict = {}
-    staging: dict = {}
-    draining = eng._draining
+    st = ctx.staging.pop(msg["sid"], None)
+    if st is not None and len(st["k"]) == st["n"]:
+        n_layers = len(st["k"][0])
+        k_blocks = [
+            {leaf: np.concatenate(
+                [blk[li][leaf] for blk in st["k"]], axis=0)
+             for leaf in st["k"][0][li]}
+            for li in range(n_layers)]
+        v_blocks = [
+            {leaf: np.concatenate(
+                [blk[li][leaf] for blk in st["v"]], axis=0)
+             for leaf in st["v"][0][li]}
+            for li in range(n_layers)]
+        ctx.eng.adopt_pages(st["tokens"], k_blocks, v_blocks)
+        ctx.killer.hit("decode-after-adopt")
+    # an incomplete ship (a killed prefill worker) just drops:
+    # admission falls back to local prefill, nothing is lost
+    return None
+
+
+def _decode_msg_ship_abort(ctx, msg):
+    ctx.staging.pop(msg["sid"], None)
+    return None
+
+
+def _decode_msg_drain(ctx, msg):
+    ctx.eng.drain(ctx.snap_dir)  # decode specs always carry a snapshot dir
+    ctx.draining = True
+    ctx.out.push({"t": "drained",
+                  "queued": list(ctx.eng.pending_requests())})
+    return None
+
+
+def _decode_msg_stop(ctx, msg):
+    return "stop"
+
+
+def _decode_serve(spec, eng, tracked, ring_in, out, killer):
+    handlers, _, _ = handler_tables()
+    ctx = _DecodeCtx(spec, eng, tracked, out, killer)
 
     def emit_progress():
         active = {s.rid for s in eng._slots if s.active}
@@ -178,66 +266,16 @@ def _decode_serve(spec, eng, tracked, ring_in, out, killer):
             lst = eng.result(rid)
             if lst is None:
                 continue
-            n0 = sent.get(rid, 0)
+            n0 = ctx.sent.get(rid, 0)
             if len(lst) > n0:
                 out.push({"t": "tokens", "rid": rid, "start": n0,
                           "toks": [int(x) for x in lst[n0:]]})
-                sent[rid] = len(lst)
+                ctx.sent[rid] = len(lst)
                 killer.hit("decode-mid-stream")
             if rid not in active and rid not in queued:
-                out.push({"t": "done", "rid": rid, "n": sent.get(rid, 0)})
+                out.push({"t": "done", "rid": rid,
+                          "n": ctx.sent.get(rid, 0)})
                 tracked.discard(rid)
-
-    def handle(msg):
-        nonlocal draining
-        t = msg["t"]
-        if t == "submit":
-            if draining:
-                out.push({"t": "requeue", "rid": msg["rid"]})
-                return None
-            eng.add_request(msg["rid"], msg["prompt"],
-                            max_new_tokens=msg["max_new"],
-                            temperature=msg["temperature"] or None,
-                            seed=msg["seed"], nonce=msg["nonce"],
-                            priority=msg.get("priority", "normal"))
-            killer.hit("decode-after-accept")
-            tracked.add(msg["rid"])
-        elif t == "ship_begin":
-            staging[msg["sid"]] = {"tokens": msg["tokens"],
-                                   "n": msg["n_blocks"], "k": [], "v": []}
-        elif t == "ship_block":
-            st = staging.get(msg["sid"])
-            if st is not None:
-                st["k"].append(msg["k"])
-                st["v"].append(msg["v"])
-        elif t == "ship_end":
-            st = staging.pop(msg["sid"], None)
-            if st is not None and len(st["k"]) == st["n"]:
-                n_layers = len(st["k"][0])
-                k_blocks = [
-                    {leaf: np.concatenate(
-                        [blk[li][leaf] for blk in st["k"]], axis=0)
-                     for leaf in st["k"][0][li]}
-                    for li in range(n_layers)]
-                v_blocks = [
-                    {leaf: np.concatenate(
-                        [blk[li][leaf] for blk in st["v"]], axis=0)
-                     for leaf in st["v"][0][li]}
-                    for li in range(n_layers)]
-                eng.adopt_pages(st["tokens"], k_blocks, v_blocks)
-                killer.hit("decode-after-adopt")
-            # an incomplete ship (a killed prefill worker) just drops:
-            # admission falls back to local prefill, nothing is lost
-        elif t == "ship_abort":
-            staging.pop(msg["sid"], None)
-        elif t == "drain":
-            eng.drain(snap_dir)  # decode specs always carry a snapshot dir
-            draining = True
-            out.push({"t": "drained",
-                      "queued": list(eng.pending_requests())})
-        elif t == "stop":
-            return "stop"
-        return None
 
     while True:
         busy = eng.has_work()
@@ -248,18 +286,42 @@ def _decode_serve(spec, eng, tracked, ring_in, out, killer):
         except BrokenPipeError:
             os._exit(3)
         if data is not None:
-            if handle(pickle.loads(data)) == "stop":
+            # a message outside the spec raises KeyError -> the fatal
+            # path: protocol violations die loudly, never drop silently
+            msg = pickle.loads(data)
+            if handlers[msg["t"]](ctx, msg) == "stop":
                 break
             continue  # drain the inbox before paying for a macro-step
         if busy:
             eng.step()
             emit_progress()
-        elif draining:
+        elif ctx.draining:
             break  # residents finished; queued rids migrated via drained
     out.push({"t": "bye"})
 
 
 # -------------------------------------------------------------- standby role
+class _ParkedCtx:
+    """A parked standby's handler context: nothing but the outbound ring
+    (its engine is already warm; the handlers only steer the park loop)."""
+
+    __slots__ = ("out",)
+
+    def __init__(self, out):
+        self.out = out
+
+
+def _standby_msg_stop(ctx, msg):
+    ctx.out.push({"t": "bye"})
+    return "stop"
+
+
+def _standby_msg_promote(ctx, msg):
+    # the park loop breaks out and runs the restore/claim sequence with
+    # this message's snapshot_dir/snapshot_interval payload
+    return "promote"
+
+
 def _carries_executables(eng, cfg) -> bool:
     """Whether the standby engine's AOT-compiled macro-steps are valid on
     an engine restored from recorded geometry `cfg` (EngineSnapshot
@@ -295,6 +357,8 @@ def _standby_loop(spec, model, ring_in, out, killer):
     warm = eng.warmup() if spec.get("warmup", True) else None
     out.push({"t": "ready", **_warm_report(warm)})
 
+    _, _, handlers = handler_tables()
+    ctx = _ParkedCtx(out)
     while True:
         try:
             data = ring_in.pop(timeout_ms=100)
@@ -305,10 +369,10 @@ def _standby_loop(spec, model, ring_in, out, killer):
         if data is None:
             continue
         msg = pickle.loads(data)
-        if msg["t"] == "stop":
-            out.push({"t": "bye"})
+        verdict = handlers[msg["t"]](ctx, msg)
+        if verdict == "stop":
             return
-        if msg["t"] == "promote":
+        if verdict == "promote":
             break
 
     snap_dir = msg["snapshot_dir"]
@@ -383,9 +447,48 @@ def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype):
     return toks, k_layers, v_layers
 
 
-def _prefill_loop(spec, model, ring_in, out, killer):
-    import uuid as _uuid  # noqa: F401  (sids come from the router)
+class _PrefillCtx:
+    """Prefill-role handler context: the shared model plus the resolved
+    page geometry every shipment uses."""
 
+    __slots__ = ("model", "out", "killer", "block_size", "kv_dtype")
+
+    def __init__(self, model, out, killer, block_size, kv_dtype):
+        self.model = model
+        self.out = out
+        self.killer = killer
+        self.block_size = block_size
+        self.kv_dtype = kv_dtype
+
+
+def _prefill_msg_stop(ctx, msg):
+    return "stop"
+
+
+def _prefill_msg_prefill(ctx, msg):
+    n = int(msg["n_blocks"])
+    toks, k_layers, v_layers = _prefill_pages(
+        ctx.model, msg["prompt"], n, ctx.block_size, ctx.kv_dtype)
+    ctx.killer.hit("prefill-before-ship")
+    sid = msg["sid"]
+    ctx.out.push({"t": "page_begin", "sid": sid, "rid": msg["rid"],
+                  "tokens": toks, "n_blocks": n,
+                  "n_layers": len(k_layers)})
+    for bi in range(n):
+        ctx.out.push({"t": "page_block", "sid": sid, "i": bi,
+                      "k": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
+                            for lay in k_layers],
+                      "v": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
+                            for lay in v_layers]})
+        if bi == n // 2:
+            ctx.killer.hit("prefill-mid-ship")
+    ctx.out.push({"t": "page_end", "sid": sid})
+    ctx.killer.hit("prefill-after-ship")
+    ctx.out.push({"t": "shipped", "rid": msg["rid"], "n_blocks": n})
+    return None
+
+
+def _prefill_loop(spec, model, ring_in, out, killer):
     from paddle_tpu._core import flags as _flags
 
     block_size = int(spec["engine"].get("block_size", 16))
@@ -395,6 +498,8 @@ def _prefill_loop(spec, model, ring_in, out, killer):
     # int8 pools expect payload + scales
     kv_dtype = (spec["engine"].get("kv_cache_dtype")
                 or _flags.flag("FLAGS_kv_cache_dtype"))
+    _, handlers, _ = handler_tables()
+    ctx = _PrefillCtx(model, out, killer, block_size, kv_dtype)
     while True:
         try:
             data = ring_in.pop(timeout_ms=100)
@@ -405,29 +510,8 @@ def _prefill_loop(spec, model, ring_in, out, killer):
         if data is None:
             break
         msg = pickle.loads(data)
-        if msg["t"] == "stop":
+        if handlers[msg["t"]](ctx, msg) == "stop":
             break
-        if msg["t"] != "prefill":
-            continue
-        n = int(msg["n_blocks"])
-        toks, k_layers, v_layers = _prefill_pages(
-            model, msg["prompt"], n, block_size, kv_dtype)
-        killer.hit("prefill-before-ship")
-        sid = msg["sid"]
-        out.push({"t": "page_begin", "sid": sid, "rid": msg["rid"],
-                  "tokens": toks, "n_blocks": n,
-                  "n_layers": len(k_layers)})
-        for bi in range(n):
-            out.push({"t": "page_block", "sid": sid, "i": bi,
-                      "k": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
-                            for lay in k_layers],
-                      "v": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
-                            for lay in v_layers]})
-            if bi == n // 2:
-                killer.hit("prefill-mid-ship")
-        out.push({"t": "page_end", "sid": sid})
-        killer.hit("prefill-after-ship")
-        out.push({"t": "shipped", "rid": msg["rid"], "n_blocks": n})
     out.push({"t": "bye"})
 
 
@@ -475,6 +559,33 @@ def main():
     finally:
         _HB_STOP.set()
     os._exit(0)
+
+
+_TABLES = None
+
+
+def handler_tables():
+    """(decode, prefill, standby) dispatch tables, bound lazily.
+
+    Lazy so this module's top level stays stdlib-only (the worker entry
+    point must not drag numpy/jax in before the role is even known).
+    EngineCluster calls this at construction — before any fork — so a
+    spec message without a handler, or a stray ``_<role>_msg_*`` handler
+    without a spec row, fails loudly in the parent process.
+    """
+    global _TABLES
+    if _TABLES is None:
+        from paddle_tpu.serving import protocol
+
+        g = globals()
+        _TABLES = (
+            protocol.bind_handlers("decode", g, prefix="_decode_msg_",
+                                   label="cluster_worker decode loop"),
+            protocol.bind_handlers("prefill", g, prefix="_prefill_msg_",
+                                   label="cluster_worker prefill loop"),
+            protocol.bind_handlers("standby", g, prefix="_standby_msg_",
+                                   label="cluster_worker standby park loop"))
+    return _TABLES
 
 
 if __name__ == "__main__":

@@ -1,0 +1,406 @@
+"""The cluster wire protocol AS DATA (docs/SERVING_CLUSTER.md topology).
+
+Until PR 19 the router/replica/prefill/standby protocol lived in three
+places that could drift independently: the if/elif dispatch chains in
+serving/cluster.py and serving/cluster_worker.py, a hand-written markdown
+table in docs/SERVING_CLUSTER.md, and the SIGKILL test matrix's implicit
+expectations.  This module makes the protocol a single machine-readable
+source of truth:
+
+- ``MESSAGES`` — every wire message with its direction(s), payload fields
+  and one-line meaning.  docs/SERVING_CLUSTER.md embeds the table
+  ``wire_table_markdown()`` renders (a test regenerates and diffs it, so
+  the doc cannot drift from the code).
+- ``ROLE_STATES`` / ``TRANSITIONS`` — per-role state machines: which
+  messages a router / decode replica / prefill worker / warm standby may
+  legally receive and emit in each lifecycle phase.
+- ``INVARIANTS`` — the named safety properties the protocol exists to
+  uphold.  ``static/protocol_lint.py`` checks every one of them in every
+  reachable state of an abstract 5-process model (docs/PROTOCOL_LINT.md).
+
+Dispatch runs THROUGH these tables (the dead-flag-lint trick applied to a
+protocol): `EngineCluster` binds its ``_ev_<msg>`` event handlers via
+``bind_handlers`` at construction, and cluster_worker binds its per-role
+``_decode_msg_<msg>`` / ``_prefill_msg_<msg>`` / ``_standby_msg_<msg>``
+functions the same way at import.  Both directions are asserted — a spec
+message with no handler AND a handler with no spec message each raise
+``ProtocolSpecError`` before any process is forked — so removing either
+side fails loudly and the spec cannot rot.
+
+This module is deliberately dependency-free (stdlib only): the router
+imports it before jax exists in any worker, and the static-analysis tier
+(static/protocol_lint.py, tools/lint_protocol.py) consumes it without
+touching an accelerator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "Message",
+    "MESSAGES",
+    "ROLES",
+    "ROLE_STATES",
+    "TRANSITIONS",
+    "INVARIANTS",
+    "ProtocolSpecError",
+    "messages_to",
+    "messages_from",
+    "bind_handlers",
+    "wire_table_markdown",
+    "validate_spec",
+]
+
+
+ROLES = ("router", "decode", "prefill", "standby")
+
+
+class ProtocolSpecError(RuntimeError):
+    """The protocol spec and the code disagree: a spec message without a
+    bound handler, a handler outside the spec, or an internally
+    inconsistent table.  Raised at EngineCluster construction / worker
+    import — always BEFORE any process forks."""
+
+
+@dataclass(frozen=True)
+class Message:
+    """One wire message: pickled dict ``{"t": name, **fields}``."""
+
+    name: str
+    src: tuple       # sender role(s)
+    dst: tuple       # receiver role(s)
+    fields: tuple    # payload field names beyond "t"
+    meaning: str     # one-liner for the generated wire table
+
+    def direction(self) -> str:
+        return f"{'/'.join(self.src)} → {'/'.join(self.dst)}"
+
+
+# --------------------------------------------------------------- the wire
+# Order is the docs-table order: router->worker traffic first, then
+# worker->router reports — keep new messages in their direction group.
+MESSAGES = (
+    Message("submit", ("router",), ("decode",),
+            ("rid", "prompt", "max_new", "temperature", "seed", "priority",
+             "nonce"),
+            "serve this request with the ROUTER-assigned nonce (and SLO "
+            "class) journaled at acceptance"),
+    Message("ship_begin", ("router",), ("decode",),
+            ("sid", "rid", "tokens", "n_blocks", "n_layers"),
+            "forwarded prefill shipment opens: stage `n_blocks` pool-native "
+            "K/V pages for these prompt tokens"),
+    Message("ship_block", ("router",), ("decode",),
+            ("sid", "i", "k", "v"),
+            "one shipped K/V page (pool-native leaves, one block per "
+            "message)"),
+    Message("ship_end", ("router",), ("decode",),
+            ("sid",),
+            "shipment complete: adopt the staged pages as refcount-zero "
+            "cached prefix pages"),
+    Message("ship_abort", ("router",), ("decode",),
+            ("sid",),
+            "the shipping prefill worker died; drop the partial staging"),
+    Message("drain", ("router",), ("decode",),
+            (),
+            "graceful scale-down: snapshot, close admissions, finish "
+            "residents, hand queued requests home"),
+    Message("stop", ("router",), ("decode", "prefill", "standby"),
+            (),
+            "clean exit (answered with `bye`)"),
+    Message("prefill", ("router",), ("prefill",),
+            ("rid", "sid", "prompt", "n_blocks"),
+            "compute + ship the prompt's full-block K/V pages"),
+    Message("promote", ("router",), ("standby",),
+            ("snapshot_dir", "snapshot_interval"),
+            "claim a dead replica's snapshot dir and become its decode "
+            "replica"),
+    Message("ready", ("standby",), ("router",),
+            ("warmed", "warmup_s", "cache_hits", "cache_misses"),
+            "warmup finished; this standby is promotion-eligible (warmed "
+            "ends its boot grace)"),
+    Message("resume", ("decode", "standby"), ("router",),
+            ("rids", "warmed", "warmup_s", "cache_hits", "cache_misses"),
+            "which requests this (possibly snapshot-restored) engine owns, "
+            "plus its boot warm report"),
+    Message("tokens", ("decode",), ("router",),
+            ("rid", "start", "toks"),
+            "token run at absolute stream position `start` (re-emitted "
+            "overlaps must merge bit-for-bit)"),
+    Message("done", ("decode",), ("router",),
+            ("rid", "n"),
+            "request complete after `n` delivered tokens"),
+    Message("requeue", ("decode",), ("router",),
+            ("rid",),
+            "a draining replica refuses a submit; the router re-dispatches"),
+    Message("drained", ("decode",), ("router",),
+            ("queued",),
+            "drain report: these queued (never-started) requests migrate "
+            "to survivors"),
+    Message("page_begin", ("prefill",), ("router",),
+            ("sid", "rid", "tokens", "n_blocks", "n_layers"),
+            "shipment opens (relayed to the target replica as "
+            "`ship_begin`)"),
+    Message("page_block", ("prefill",), ("router",),
+            ("sid", "i", "k", "v"),
+            "one computed K/V page (relayed as `ship_block`)"),
+    Message("page_end", ("prefill",), ("router",),
+            ("sid",),
+            "shipment complete (relayed as `ship_end`)"),
+    Message("shipped", ("prefill",), ("router",),
+            ("rid", "n_blocks"),
+            "ship finished; the router now submits the request to the "
+            "target replica"),
+    Message("bye", ("decode", "prefill", "standby"), ("router",),
+            (),
+            "clean exit acknowledgement"),
+    Message("fatal", ("decode", "prefill", "standby"), ("router",),
+            ("err",),
+            "unrecoverable worker error (treated as death)"),
+)
+
+_BY_NAME = {m.name: m for m in MESSAGES}
+
+
+def messages_to(role: str):
+    """Spec messages `role` receives (its inbound dispatch surface)."""
+    return tuple(m for m in MESSAGES if role in m.dst)
+
+
+def messages_from(role: str):
+    """Spec messages `role` emits."""
+    return tuple(m for m in MESSAGES if role in m.src)
+
+
+# ------------------------------------------------------- role state machines
+# Events: "recv:<msg>" / "send:<msg>" for wire traffic, bare names for
+# internal lifecycle steps (boot, idle-drained, shutdown).  The model
+# checker walks these; validate_spec() proves the recv/send alphabets
+# match MESSAGES exactly, so the machines cannot name phantom traffic.
+ROLE_STATES = {
+    "router": ("replaying", "serving", "stopped"),
+    "decode": ("booting", "serving", "draining", "exiting", "exited"),
+    "prefill": ("booting", "serving", "exiting", "exited"),
+    # a promoted standby ENTERS the decode machine at "serving": its
+    # post-promotion traffic is decode traffic, not standby traffic
+    "standby": ("booting", "parked", "restoring", "serving", "exiting",
+                "exited"),
+}
+
+TRANSITIONS = {
+    "router": {
+        # construction: replay the intake journal, then serve
+        ("replaying", "boot"): "serving",
+        ("serving", "recv:ready"): "serving",
+        ("serving", "recv:resume"): "serving",
+        ("serving", "recv:tokens"): "serving",
+        ("serving", "recv:done"): "serving",
+        ("serving", "recv:requeue"): "serving",
+        ("serving", "recv:drained"): "serving",
+        ("serving", "recv:bye"): "serving",
+        ("serving", "recv:fatal"): "serving",
+        ("serving", "recv:page_begin"): "serving",
+        ("serving", "recv:page_block"): "serving",
+        ("serving", "recv:page_end"): "serving",
+        ("serving", "recv:shipped"): "serving",
+        ("serving", "send:submit"): "serving",
+        ("serving", "send:prefill"): "serving",
+        ("serving", "send:ship_begin"): "serving",
+        ("serving", "send:ship_block"): "serving",
+        ("serving", "send:ship_end"): "serving",
+        ("serving", "send:ship_abort"): "serving",
+        ("serving", "send:drain"): "serving",
+        ("serving", "send:promote"): "serving",
+        ("serving", "send:stop"): "serving",
+        ("serving", "shutdown"): "stopped",
+    },
+    "decode": {
+        # readiness = the resume report (AOT warmup already paid)
+        ("booting", "send:resume"): "serving",
+        ("booting", "send:fatal"): "exited",
+        ("serving", "recv:submit"): "serving",
+        ("serving", "recv:ship_begin"): "serving",
+        ("serving", "recv:ship_block"): "serving",
+        ("serving", "recv:ship_end"): "serving",
+        ("serving", "recv:ship_abort"): "serving",
+        ("serving", "send:tokens"): "serving",
+        ("serving", "send:done"): "serving",
+        ("serving", "recv:drain"): "draining",
+        ("serving", "recv:stop"): "exiting",
+        ("serving", "send:fatal"): "exited",
+        ("draining", "send:drained"): "draining",
+        # a submit racing the drain verdict bounces back to the router
+        ("draining", "recv:submit"): "draining",
+        ("draining", "send:requeue"): "draining",
+        ("draining", "recv:ship_begin"): "draining",
+        ("draining", "recv:ship_block"): "draining",
+        ("draining", "recv:ship_end"): "draining",
+        ("draining", "recv:ship_abort"): "draining",
+        ("draining", "send:tokens"): "draining",
+        ("draining", "send:done"): "draining",
+        ("draining", "recv:stop"): "exiting",
+        ("draining", "residents-finished"): "exiting",
+        ("draining", "send:fatal"): "exited",
+        ("exiting", "send:bye"): "exited",
+    },
+    "prefill": {
+        ("booting", "boot"): "serving",
+        ("booting", "send:fatal"): "exited",
+        ("serving", "recv:prefill"): "serving",
+        ("serving", "send:page_begin"): "serving",
+        ("serving", "send:page_block"): "serving",
+        ("serving", "send:page_end"): "serving",
+        ("serving", "send:shipped"): "serving",
+        ("serving", "recv:stop"): "exiting",
+        ("serving", "send:fatal"): "exited",
+        ("exiting", "send:bye"): "exited",
+    },
+    "standby": {
+        ("booting", "send:ready"): "parked",
+        ("booting", "send:fatal"): "exited",
+        ("parked", "recv:promote"): "restoring",
+        ("parked", "recv:stop"): "exiting",
+        ("parked", "send:fatal"): "exited",
+        # promotion claims the victim's streams via ONE resume report,
+        # then the decode machine takes over at "serving"
+        ("restoring", "send:resume"): "serving",
+        ("restoring", "send:fatal"): "exited",
+        ("exiting", "send:bye"): "exited",
+    },
+}
+
+
+# ---------------------------------------------------------- named invariants
+# The safety contract, by name.  static/protocol_lint.py checks each in
+# EVERY reachable state of the abstract cluster model; counterexample
+# traces name the violated invariant (docs/PROTOCOL_LINT.md).
+INVARIANTS = {
+    "journal-before-dispatch":
+        "an accepted rid is fsynced to the intake journal BEFORE any "
+        "dispatch for it reaches a ring — a router crash can never lose "
+        "an accepted request",
+    "no-double-serve":
+        "an accepted rid is never actively served by two live replicas "
+        "at once (one canonical owner; re-dispatch only after death, "
+        "drain, or an explicit requeue)",
+    "no-lost-request":
+        "an accepted rid always completes: every quiescent state of the "
+        "cluster has all accepted requests done — crashes re-dispatch, "
+        "never drop",
+    "nonce-before-first-token":
+        "a rid's nonce is assigned (journaled with the submit) before "
+        "its first token is emitted — stream identity precedes the "
+        "stream",
+    "backpressure-not-death":
+        "a ring TimeoutError is backpressure, never a death verdict: "
+        "only BrokenPipeError (a destroyed ring) may mark a worker dead",
+    "promotion-claims-once":
+        "a standby promotion claims a victim replica's streams exactly "
+        "once — one resume report, no second claimant",
+    "warmed-ends-boot-grace":
+        "a worker announcing warmed=True is judged on the steady-state "
+        "miss budget from that report on (FailureDetector.mark_warmed "
+        "ends its boot grace)",
+}
+
+
+# --------------------------------------------------------- handler binding
+def bind_handlers(role: str, lookup, *, prefix: str, label: str = None):
+    """Bind `role`'s inbound spec messages to handlers in `lookup`
+    (a name->object mapping: module globals, or an instance's attrs).
+
+    Both directions are enforced — the dead-flag-lint trick applied to a
+    protocol:
+
+    - every spec message with dst `role` must resolve to a callable named
+      ``prefix + message`` (a spec row nobody implements fails loudly);
+    - every `lookup` name starting with ``prefix`` must be a spec message
+      (a handler the spec no longer names is dead code wearing a live
+      wire's uniform).
+
+    Returns the dispatch dict {message name -> handler}.  Raises
+    ProtocolSpecError — at EngineCluster construction / worker import,
+    always before any fork."""
+    label = label or f"{role} dispatch"
+    expected = {m.name for m in messages_to(role)}
+    bound = {}
+    for name in sorted(expected):
+        fn = lookup.get(prefix + name)
+        if not callable(fn):
+            raise ProtocolSpecError(
+                f"{label}: spec message {name!r} (dst={role}) has no "
+                f"handler {prefix + name!r} — every spec transition must "
+                "bind to a real handler (serving/protocol.py)")
+        bound[name] = fn
+    for key in sorted(lookup):
+        if not key.startswith(prefix) or not callable(lookup.get(key)):
+            continue
+        if key[len(prefix):] not in expected:
+            raise ProtocolSpecError(
+                f"{label}: handler {key!r} does not correspond to any "
+                f"spec message with dst={role} — every handler must "
+                "appear in the spec (serving/protocol.py)")
+    return bound
+
+
+def handler_lookup(obj, prefix: str):
+    """An instance/class's ``prefix*`` attributes as a bind_handlers
+    lookup (dir() walk: inherited handlers count too)."""
+    return {n: getattr(obj, n) for n in dir(obj) if n.startswith(prefix)}
+
+
+# ------------------------------------------------------------ doc generation
+def wire_table_markdown() -> str:
+    """The docs/SERVING_CLUSTER.md wire-protocol table, generated from
+    MESSAGES — one row per message, direction groups in spec order.  The
+    doc embeds this between wire-protocol markers and a test regenerates
+    and diffs it, so prose can never drift from the dispatch tables."""
+    lines = ["| direction | message | payload | meaning |",
+             "|---|---|---|---|"]
+    for m in MESSAGES:
+        payload = f"`{', '.join(m.fields)}`" if m.fields else "—"
+        lines.append(
+            f"| {m.direction()} | `{m.name}` | {payload} | {m.meaning} |")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ spec self-check
+def validate_spec():
+    """Internal consistency of the tables themselves: directions name
+    real roles, state machines only use declared states, and each role's
+    recv/send alphabet in TRANSITIONS matches MESSAGES exactly.  Runs at
+    import — an inconsistent spec never loads."""
+    seen = set()
+    for m in MESSAGES:
+        if m.name in seen:
+            raise ProtocolSpecError(f"duplicate message {m.name!r}")
+        seen.add(m.name)
+        for r in m.src + m.dst:
+            if r not in ROLES:
+                raise ProtocolSpecError(
+                    f"message {m.name!r} names unknown role {r!r}")
+    for role, table in TRANSITIONS.items():
+        states = set(ROLE_STATES[role])
+        recvs, sends = set(), set()
+        for (state, event), nxt in table.items():
+            if state not in states or nxt not in states:
+                raise ProtocolSpecError(
+                    f"{role}: transition ({state!r}, {event!r}) -> "
+                    f"{nxt!r} uses an undeclared state")
+            if event.startswith("recv:"):
+                recvs.add(event[5:])
+            elif event.startswith("send:"):
+                sends.add(event[5:])
+        want_recv = {m.name for m in messages_to(role)}
+        want_send = {m.name for m in messages_from(role)}
+        if recvs != want_recv:
+            raise ProtocolSpecError(
+                f"{role}: state machine receives {sorted(recvs)} but the "
+                f"message table says {sorted(want_recv)}")
+        if sends != want_send:
+            raise ProtocolSpecError(
+                f"{role}: state machine sends {sorted(sends)} but the "
+                f"message table says {sorted(want_send)}")
+
+
+validate_spec()

@@ -46,6 +46,13 @@ from .mesh_lint import (  # noqa: F401
     lint_program,
     lint_train_step,
 )
+from .protocol_lint import (  # noqa: F401
+    ProtocolLintError,
+    ProtocolViolation,
+    check_model,
+    lint_blocking_calls,
+    lint_cluster_protocol,
+)
 from . import nn  # noqa: F401
 from .compat import *  # noqa: F401,F403
 from .compat import __all__ as _compat_all
@@ -79,6 +86,11 @@ __all__ = _compat_all + [
     "lint_program",
     "lint_train_step",
     "lint_engine",
+    "ProtocolLintError",
+    "ProtocolViolation",
+    "check_model",
+    "lint_cluster_protocol",
+    "lint_blocking_calls",
 ]
 
 

@@ -1,0 +1,972 @@
+"""Protocol lint: the cluster wire protocol checked BEFORE any fork.
+
+The serving cluster's headline contract — bit-exact fail-over across
+router / decode replicas / prefill workers / warm standbys — was until
+PR 19 proven only dynamically, by SIGKILLing real processes (the
+tests/test_serving_cluster_crash.py matrix) and reading the wreckage.
+This module is the static half: the same philosophy as the PR-4 Program
+verifier and the SPMD mesh lint (docs/VERIFIER.md, docs/MESH_LINT.md)
+applied to the wire protocol of docs/SERVING_CLUSTER.md, so the ROADMAP
+item-1 TCP data plane can be built against a machine-checked spec.
+
+Three check families (docs/PROTOCOL_LINT.md):
+
+1. **Exhaustive interleaving model check** — a breadth-first search with
+   state hashing over a small abstract cluster (1 router, 2 decode
+   replicas, 1 prefill worker, 1 warm standby, bounded message queues, a
+   crash transition armed at every state).  Every reachable state is
+   visited exactly once; every named invariant of
+   ``serving.protocol.INVARIANTS`` is checked in every state; a
+   quiescent non-terminal state is reported as a deadlock.  BFS order
+   makes the first counterexample found a MINIMAL one: the trace handed
+   back is the shortest interleaving that reaches the violation.
+   Transport semantics are a parameter: ``ShmRingSemantics`` models
+   today's shared-memory rings, ``TcpStubSemantics`` adds the
+   connection-drop transition of the future TCP ring (a dropped
+   connection is a ``BrokenPipeError`` to the worker, i.e. death — the
+   semantics today's workers already implement), so the TCP transport
+   lands with its interleavings already explored.
+
+2. **Seeded-violation scenarios** — deliberately broken protocol
+   variants (skip the intake fsync; treat ring ``TimeoutError`` as a
+   death verdict; let a second router replay the same journal) that must
+   each produce a readable counterexample trace naming the violated
+   invariant.  They are to the model checker what the verifier's seeded
+   IR fixtures are to ``verify_program``: proof the checker can actually
+   see the bug class it claims to guard.
+
+3. **Blocking-call lint** — an AST pass over ``serving/`` and
+   ``distributed/collective/`` that classifies blocking call sites
+   (ring ``push``/``pop``, store ``wait``/``get``, process ``join``,
+   lock ``acquire``) and flags: waits that neither carry a timeout nor
+   ride ``retry_backoff``'s shared deadline (``unbounded-blocking``),
+   blocking calls made while lexically holding a lock the heartbeat
+   thread may need (``lock-held-blocking``), and a frame that can block
+   in BOTH directions of a channel without deadlines — the two-party
+   circular-wait shape (``circular-wait``).  Receiver-name heuristics
+   (``ring``/``store``/``proc``/``lock``) keep dict ``.pop``/``.get``
+   and ``str.join`` out of scope.
+
+Counters ride ``paddle_tpu.profiler.protocol_lint_stats()`` with a
+``Protocol lint:`` summary footer; ``tools/lint_protocol.py`` sweeps the
+battery (clean spec clean on both transports, seeded scenarios flagged
+with traces, real tree lints clean) and a ``--pytest`` mode.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from collections import deque, namedtuple
+from dataclasses import dataclass, field
+
+from paddle_tpu.serving import protocol
+
+__all__ = [
+    "ProtocolViolation",
+    "ProtocolLintError",
+    "ShmRingSemantics",
+    "TcpStubSemantics",
+    "Scenario",
+    "SCENARIOS",
+    "ModelCheckResult",
+    "check_model",
+    "lint_cluster_protocol",
+    "lint_blocking_calls",
+    "lint_source",
+    "render_trace",
+    "protocol_lint_stats",
+    "reset_protocol_lint_stats",
+]
+
+
+_COUNTERS = {
+    "scenarios_checked": 0,     # check_model calls
+    "model_states": 0,          # distinct states visited
+    "model_transitions": 0,     # successor edges generated
+    "invariant_checks": 0,      # per-state named-invariant evaluations
+    "violations": 0,            # model violations + blocking-lint flags
+    "deadlocks": 0,             # quiescent non-terminal states reported
+    "files_linted": 0,          # sources through the blocking-call pass
+    "functions_scanned": 0,
+    "blocking_calls_checked": 0,
+}
+
+
+def protocol_lint_stats(reset: bool = False) -> dict:
+    out = dict(_COUNTERS)
+    if reset:
+        reset_protocol_lint_stats()
+    return out
+
+
+def reset_protocol_lint_stats():
+    for k in _COUNTERS:
+        _COUNTERS[k] = 0
+
+
+@dataclass
+class ProtocolViolation:
+    code: str        # an INVARIANTS key | unbounded-blocking |
+                     # lock-held-blocking | circular-wait
+    message: str
+    site: str = ""   # model:<scenario> or file:line the flag anchors to
+    trace: tuple = ()  # model counterexample: step labels, root first
+
+    def __str__(self):
+        loc = f" [{self.site}]" if self.site else ""
+        return f"{self.code}{loc}: {self.message}"
+
+
+class ProtocolLintError(RuntimeError):
+    def __init__(self, violations, header="Protocol lint failed"):
+        self.violations = list(violations)
+        lines = [f"{header} ({len(self.violations)} violation(s)):"]
+        lines += [f"  - {v}" for v in self.violations]
+        super().__init__("\n".join(lines))
+
+
+# =====================================================================
+# transport semantics (the model's swappable data plane)
+# =====================================================================
+class ShmRingSemantics:
+    """Today's data plane: bounded shared-memory rings.  A full ring is
+    backpressure (the send transition is simply not enabled until the
+    consumer drains); only a destroyed ring (worker death) breaks it."""
+
+    name = "shmring"
+    queue_cap = 2      # bounded rings: small cap keeps the model finite
+    drop_budget = 0    # shm rings cannot drop a connection
+
+
+class TcpStubSemantics(ShmRingSemantics):
+    """ROADMAP item-1 stub: a TCP ring behaves like a shm ring plus one
+    extra environment transition — the connection can drop.  The worker
+    sees that as BrokenPipeError and exits (exactly what cluster_worker
+    does today), so a drop IS a death with a different cause label; the
+    checker proves the recovery machinery absorbs it like a SIGKILL."""
+
+    name = "tcp-stub"
+    drop_budget = 1
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One model-checking configuration: a transport plus optional
+    seeded protocol bugs.  ``expect`` names the invariants a seeded bug
+    must trip — empty for the real spec, which must explore clean."""
+
+    name: str
+    transport: type = ShmRingSemantics
+    drop_fsync: bool = False      # accept without journaling (seeded bug)
+    lethal_timeout: bool = False  # ring TimeoutError => death (seeded bug)
+    rogue_router: bool = False    # a 2nd router replays the journal
+    n_requests: int = 2
+    crash_budget: int = 1
+    queue_cap: int = 0            # 0 = the transport's own cap
+    expect: tuple = ()
+    description: str = ""
+
+
+SCENARIOS = {
+    "clean-shmring": Scenario(
+        "clean-shmring", ShmRingSemantics,
+        description="the real protocol over shared-memory rings — must "
+                    "explore clean"),
+    "clean-tcp": Scenario(
+        "clean-tcp", TcpStubSemantics, crash_budget=0,
+        description="the real protocol over the TCP stub transport — "
+                    "the connection-drop transition is the armed fault "
+                    "(SIGKILL interleavings are clean-shmring's job); "
+                    "must explore clean"),
+    "drop-intake-fsync": Scenario(
+        "drop-intake-fsync", ShmRingSemantics, drop_fsync=True,
+        expect=("journal-before-dispatch", "nonce-before-first-token"),
+        description="accept skips the intake-journal fsync: dispatch "
+                    "precedes durability, a router crash loses requests"),
+    "lethal-ring-timeout": Scenario(
+        "lethal-ring-timeout", ShmRingSemantics, lethal_timeout=True,
+        queue_cap=1,  # a 1-deep ring actually fills under 2 requests
+        expect=("backpressure-not-death",),
+        description="a full ring's TimeoutError is treated as a death "
+                    "verdict instead of backpressure"),
+    "two-routers": Scenario(
+        "two-routers", ShmRingSemantics, rogue_router=True,
+        expect=("no-double-serve",),
+        description="a second router replays the same intake journal "
+                    "and re-dispatches an owned rid"),
+}
+
+
+# =====================================================================
+# the abstract cluster model
+# =====================================================================
+# Workers, in fixed index order.  A promoted standby enters the decode
+# machine at "serving" (serving/protocol.py ROLE_STATES).
+_WORKERS = ("D0", "D1", "P0", "S0")
+_WROLE = ("decode", "decode", "prefill", "standby")
+
+# The model state: one flat immutable record, hashable by construction.
+# Queues hold (message, payload) pairs; payload is a rid, a claim tuple,
+# or None.  BFS identity = structural equality of this tuple.
+_S = namedtuple("_S", [
+    "phase",      # per-worker lifecycle phase ("dead" once crashed)
+    "inq",        # per-worker router->worker queue (tuple of entries)
+    "outq",       # per-worker worker->router queue
+    "journaled",  # rids fsynced to the intake journal
+    "accepted",   # rids accepted from clients
+    "owner",      # sorted (rid, wi): router's canonical owner map
+    "active",     # per-worker frozenset of rids it is serving
+    "toked",      # per-worker frozenset of rids with tokens emitted
+    "delivered",  # rids whose tokens reached the router (the client)
+    "done",       # rids completed
+    "shipping",   # sorted (rid, target_wi): prefill shipments in flight
+    "pclaims",    # sorted rids awaiting a promoted standby's claim
+    "claims",     # sorted (rid, n): how often each rid was claimed
+    "grace",      # worker indices still inside boot grace
+    "warmed",     # worker indices whose warmed report was processed
+    "sb_ready",   # standby announced ready (promotion-eligible)
+    "crashes",    # remaining crash budget
+    "drops",      # remaining connection-drop budget (TCP stub)
+    "cause",      # sorted (wi, cause) for dead workers
+    "restore",    # per-worker claim payload while restoring (standby)
+    "to_accept",  # requests not yet accepted
+    "detected",   # dead workers whose death the router has handled
+    "rogue",      # the two-routers seeded dispatch already fired
+])
+
+
+def _initial(sc: Scenario) -> _S:
+    return _S(
+        phase=("booting",) * 4,
+        inq=((),) * 4, outq=((),) * 4,
+        journaled=frozenset(), accepted=frozenset(),
+        owner=(), active=(frozenset(),) * 4, toked=(frozenset(),) * 4,
+        delivered=frozenset(), done=frozenset(),
+        shipping=(), pclaims=(), claims=(),
+        grace=frozenset(range(4)), warmed=frozenset(),
+        sb_ready=False,
+        crashes=sc.crash_budget, drops=sc.transport.drop_budget,
+        cause=(), restore=((),) * 4, to_accept=sc.n_requests,
+        detected=frozenset(), rogue=False)
+
+
+def _tset(t, i, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+def _decode_capable(s):
+    """Workers the router may route decode traffic to: the replicas,
+    plus the standby once promoted into the decode machine."""
+    out = []
+    for wi in range(4):
+        if s.phase[wi] == "dead":
+            continue
+        if _WROLE[wi] == "decode" or (_WROLE[wi] == "standby"
+                                      and s.phase[wi] == "serving"):
+            out.append(wi)
+    return out
+
+
+def _kill(s, wi, cause, *, crashes=None, drops=None):
+    """Worker death: rings destroyed (queues vanish), in-flight worker
+    state gone.  The router's view (owner/shipping) is untouched until
+    a `detect` transition fires — that delay is the interesting part."""
+    return s._replace(
+        phase=_tset(s.phase, wi, "dead"),
+        inq=_tset(s.inq, wi, ()), outq=_tset(s.outq, wi, ()),
+        active=_tset(s.active, wi, frozenset()),
+        toked=_tset(s.toked, wi, frozenset()),
+        cause=tuple(sorted(set(s.cause) | {(wi, cause)})),
+        crashes=s.crashes if crashes is None else crashes,
+        drops=s.drops if drops is None else drops)
+
+
+def _bump_claims(claims, rids):
+    d = dict(claims)
+    for rid in rids:
+        d[rid] = d.get(rid, 0) + 1
+    return tuple(sorted(d.items()))
+
+
+def _successors(s: _S, sc: Scenario):
+    """Yield (label, next_state) for every transition enabled in `s`.
+    Exhaustive nondeterminism: the scheduler, the crash fault, and (on
+    TCP) the network are all adversarial."""
+    cap = sc.queue_cap or sc.transport.queue_cap
+    owned = {rid for rid, _ in s.owner}
+    shipping = {rid for rid, _ in s.shipping}
+    targets = _decode_capable(s)
+
+    # ---- router: accept a client request -----------------------------
+    if s.to_accept:
+        rid = f"r{sc.n_requests - s.to_accept + 1}"
+        if sc.drop_fsync:
+            label = f"router: accept {rid} (intake-journal fsync DROPPED)"
+            j = s.journaled
+        else:
+            label = f"router: accept {rid} (journaled + nonce fsynced)"
+            j = s.journaled | {rid}
+        yield (label, s._replace(journaled=j, accepted=s.accepted | {rid},
+                                 to_accept=s.to_accept - 1))
+
+    # ---- router: dispatch un-owned accepted rids ---------------------
+    pool = [rid for rid in sorted(s.accepted)
+            if rid not in s.done and rid not in owned
+            and rid not in shipping and rid not in s.pclaims]
+    for rid in pool:
+        for wi in targets:
+            if len(s.inq[wi]) < cap:
+                yield (f"router: dispatch submit({rid}) -> {_WORKERS[wi]}",
+                       s._replace(
+                           inq=_tset(s.inq, wi,
+                                     s.inq[wi] + (("submit", rid),)),
+                           owner=tuple(sorted(set(s.owner) | {(rid, wi)}))))
+            elif sc.lethal_timeout:
+                yield (f"router: push submit({rid}) -> {_WORKERS[wi]} hits "
+                       "a full ring (TimeoutError); BUG: backpressure "
+                       "treated as a death verdict",
+                       _kill(s, wi, "timeout"))
+        # via the prefill worker (KV pages shipped to a chosen target)
+        if s.phase[2] != "dead":
+            for tgt in targets:
+                if len(s.inq[2]) < cap:
+                    yield (f"router: dispatch {rid} via P0 (prefill, ship "
+                           f"to {_WORKERS[tgt]})",
+                           s._replace(
+                               inq=_tset(s.inq, 2,
+                                         s.inq[2] + (("prefill", rid),)),
+                               shipping=tuple(sorted(set(s.shipping)
+                                                     | {(rid, tgt)}))))
+                elif sc.lethal_timeout:
+                    yield (f"router: push prefill({rid}) -> P0 hits a full "
+                           "ring (TimeoutError); BUG: backpressure treated "
+                           "as a death verdict",
+                           _kill(s, 2, "timeout"))
+                break  # ship target re-chosen on `shipped`; one row here
+
+    # ---- the two-routers seeded bug ----------------------------------
+    if sc.rogue_router and not s.rogue:
+        for rid, wi in s.owner:
+            if s.phase[wi] == "dead" or rid not in s.active[wi]:
+                continue
+            for wj in targets:
+                if wj != wi and len(s.inq[wj]) < cap:
+                    yield (f"SECOND router (same journal replay): "
+                           f"dispatch submit({rid}) -> {_WORKERS[wj]} "
+                           f"while {_WORKERS[wi]} still serves it",
+                           s._replace(
+                               inq=_tset(s.inq, wj,
+                                         s.inq[wj] + (("submit", rid),)),
+                               rogue=True))
+
+    # ---- router: consume one worker report ---------------------------
+    for wi in range(4):
+        if not s.outq[wi]:
+            continue
+        msg, pay = s.outq[wi][0]
+        base = s._replace(outq=_tset(s.outq, wi, s.outq[wi][1:]))
+        name = _WORKERS[wi]
+        if msg == "resume":
+            if _WROLE[wi] == "standby" and pay:
+                # the promoted standby's ONE claim of the victim's streams
+                yield (f"router: recv resume from {name} — claims "
+                       f"{list(pay)} (mark_warmed)",
+                       base._replace(
+                           owner=tuple(sorted(set(s.owner)
+                                              | {(r, wi) for r in pay})),
+                           pclaims=tuple(r for r in s.pclaims
+                                         if r not in pay),
+                           claims=_bump_claims(s.claims, pay),
+                           warmed=s.warmed | {wi},
+                           grace=s.grace - {wi}))
+            else:
+                yield (f"router: recv resume from {name} (mark_warmed — "
+                       "boot grace ends)",
+                       base._replace(warmed=s.warmed | {wi},
+                                     grace=s.grace - {wi}))
+        elif msg == "ready":
+            yield (f"router: recv ready from {name} — standby is "
+                   "promotion-eligible (mark_warmed)",
+                   base._replace(sb_ready=True, warmed=s.warmed | {wi},
+                                 grace=s.grace - {wi}))
+        elif msg == "tokens":
+            yield (f"router: recv tokens({pay}) from {name} — first "
+                   "tokens reach the client stream",
+                   base._replace(delivered=s.delivered | {pay}))
+        elif msg == "done":
+            yield (f"router: recv done({pay}) from {name}",
+                   base._replace(
+                       done=s.done | {pay},
+                       owner=tuple(e for e in s.owner
+                                   if e != (pay, wi))))
+        elif msg == "shipped":
+            entry = next((e for e in s.shipping if e[0] == pay), None)
+            if entry is None:     # target died; shipment already released
+                yield (f"router: recv shipped({pay}) from {name} — "
+                       "shipment already released (target died)", base)
+                continue
+            tgt = entry[1]
+            ship2 = tuple(e for e in s.shipping if e != entry)
+            if s.phase[tgt] == "dead":
+                yield (f"router: recv shipped({pay}) from {name} — target "
+                       f"{_WORKERS[tgt]} is dead, release for re-dispatch",
+                       base._replace(shipping=ship2))
+            elif len(s.inq[tgt]) < cap:
+                yield (f"router: recv shipped({pay}) from {name} — submit "
+                       f"{pay} to {_WORKERS[tgt]}",
+                       base._replace(
+                           shipping=ship2,
+                           inq=_tset(base.inq, tgt,
+                                     base.inq[tgt] + (("submit", pay),)),
+                           owner=tuple(sorted(set(s.owner)
+                                              | {(pay, tgt)}))))
+            elif sc.lethal_timeout:
+                yield (f"router: post-ship submit({pay}) -> "
+                       f"{_WORKERS[tgt]} hits a full ring (TimeoutError); "
+                       "BUG: backpressure treated as a death verdict",
+                       _kill(base._replace(shipping=ship2), tgt, "timeout"))
+            # else: target ring full — the router retries later
+            #       (backpressure: this consume is simply not enabled)
+
+    # ---- router: notice a death (detection is delayed — that's the
+    # race the invariants must survive) --------------------------------
+    for wi in range(4):
+        if s.phase[wi] != "dead" or wi in s.detected:
+            continue
+        name = _WORKERS[wi]
+        orphans = tuple(sorted(rid for rid, w in s.owner if w == wi))
+        nxt = s._replace(
+            detected=s.detected | {wi},
+            owner=tuple(e for e in s.owner if e[1] != wi))
+        if wi == 2:  # prefill: release in-flight shipments
+            yield (f"router: heartbeat misses exceed budget — {name} "
+                   "declared dead; in-flight shipments released",
+                   nxt._replace(shipping=()))
+        elif wi == 3:  # standby died (parked/restoring/serving)
+            yield (f"router: heartbeat misses exceed budget — {name} "
+                   "declared dead; pending claims released for "
+                   "re-dispatch",
+                   nxt._replace(sb_ready=False, pclaims=()))
+        else:          # a decode replica
+            if (s.sb_ready and s.phase[3] == "parked"
+                    and len(s.inq[3]) < cap):
+                yield (f"router: heartbeat misses exceed budget — {name} "
+                       f"declared dead; promote S0 to claim "
+                       f"{list(orphans)}",
+                       nxt._replace(
+                           sb_ready=False,
+                           pclaims=tuple(sorted(set(s.pclaims)
+                                                | set(orphans))),
+                           inq=_tset(nxt.inq, 3,
+                                     nxt.inq[3]
+                                     + (("promote", orphans),))))
+            else:
+                yield (f"router: heartbeat misses exceed budget — {name} "
+                       "declared dead; orphans released for re-dispatch",
+                       nxt)
+
+    # ---- router: respawn a handled-dead decode replica ---------------
+    # The real cluster respawns a dead replica into the same slot (a new
+    # generation, fresh rings, boot grace restarted).  Without this the
+    # model deadlocks when every replica dies before the standby's ready
+    # report lands — the exact liveness hole respawn exists to close.
+    for wi in range(4):
+        if (s.phase[wi] == "dead" and wi in s.detected
+                and _WROLE[wi] == "decode"):
+            yield (f"router: respawn {_WORKERS[wi]} (new generation, "
+                   "fresh rings, boot grace restarted)",
+                   s._replace(phase=_tset(s.phase, wi, "booting"),
+                              grace=s.grace | {wi},
+                              warmed=s.warmed - {wi},
+                              detected=s.detected - {wi}))
+
+    # ---- workers ------------------------------------------------------
+    for wi in range(4):
+        ph = s.phase[wi]
+        if ph == "dead":
+            continue
+        name = _WORKERS[wi]
+        role = _WROLE[wi]
+        # boot
+        if ph == "booting":
+            if role == "decode" and len(s.outq[wi]) < cap:
+                yield (f"{name}: engine warm — send resume report",
+                       s._replace(phase=_tset(s.phase, wi, "serving"),
+                                  outq=_tset(s.outq, wi,
+                                             s.outq[wi]
+                                             + (("resume", ()),))))
+            elif role == "prefill":
+                yield (f"{name}: model built — serving",
+                       s._replace(phase=_tset(s.phase, wi, "serving")))
+            elif role == "standby" and len(s.outq[wi]) < cap:
+                yield (f"{name}: AOT warmup done — send ready "
+                       "(warmed=True)",
+                       s._replace(phase=_tset(s.phase, wi, "parked"),
+                                  outq=_tset(s.outq, wi,
+                                             s.outq[wi]
+                                             + (("ready", None),))))
+            continue
+        # standby lifecycle
+        if role == "standby" and ph == "parked":
+            if s.inq[wi]:
+                msg, pay = s.inq[wi][0]
+                if msg == "promote":
+                    yield (f"{name}: recv promote — restore victim "
+                           "snapshot",
+                           s._replace(
+                               phase=_tset(s.phase, wi, "restoring"),
+                               inq=_tset(s.inq, wi, s.inq[wi][1:]),
+                               restore=_tset(s.restore, wi, pay)))
+            continue
+        if role == "standby" and ph == "restoring":
+            if len(s.outq[wi]) < cap:
+                pay = s.restore[wi]
+                yield (f"{name}: snapshot restored — send resume claiming "
+                       f"{list(pay)}; serving as a decode replica",
+                       s._replace(
+                           phase=_tset(s.phase, wi, "serving"),
+                           outq=_tset(s.outq, wi,
+                                      s.outq[wi] + (("resume", pay),)),
+                           active=_tset(s.active, wi, frozenset(pay)),
+                           restore=_tset(s.restore, wi, ())))
+            continue
+        # prefill serving
+        if role == "prefill":
+            if s.inq[wi] and len(s.outq[wi]) < cap:
+                msg, rid = s.inq[wi][0]
+                yield (f"{name}: prefill {rid} — compute K/V, ship pages, "
+                       "report shipped",
+                       s._replace(
+                           inq=_tset(s.inq, wi, s.inq[wi][1:]),
+                           outq=_tset(s.outq, wi,
+                                      s.outq[wi] + (("shipped", rid),))))
+            continue
+        # decode-capable serving (replicas + promoted standby)
+        if s.inq[wi]:
+            msg, rid = s.inq[wi][0]
+            if msg == "submit":
+                yield (f"{name}: recv submit({rid}) — request admitted",
+                       s._replace(
+                           inq=_tset(s.inq, wi, s.inq[wi][1:]),
+                           active=_tset(s.active, wi,
+                                        s.active[wi] | {rid})))
+        for rid in sorted(s.active[wi] - s.toked[wi]):
+            if len(s.outq[wi]) < cap:
+                yield (f"{name}: emit first tokens for {rid}",
+                       s._replace(
+                           outq=_tset(s.outq, wi,
+                                      s.outq[wi] + (("tokens", rid),)),
+                           toked=_tset(s.toked, wi, s.toked[wi] | {rid})))
+        for rid in sorted(s.toked[wi]):
+            if len(s.outq[wi]) < cap:
+                yield (f"{name}: {rid} complete — report done",
+                       s._replace(
+                           outq=_tset(s.outq, wi,
+                                      s.outq[wi] + (("done", rid),)),
+                           active=_tset(s.active, wi,
+                                        s.active[wi] - {rid}),
+                           toked=_tset(s.toked, wi,
+                                       s.toked[wi] - {rid})))
+
+    # ---- the environment: crash / connection-drop, armed everywhere --
+    if s.crashes:
+        for wi in range(4):
+            if s.phase[wi] != "dead":
+                yield (f"SIGKILL {_WORKERS[wi]}",
+                       _kill(s, wi, "crash", crashes=s.crashes - 1))
+    if s.drops:
+        for wi in range(4):
+            if s.phase[wi] != "dead":
+                yield (f"TCP connection to {_WORKERS[wi]} drops — worker "
+                       "sees BrokenPipeError and exits",
+                       _kill(s, wi, "conn-drop", drops=s.drops - 1))
+
+
+def _check_invariants(s: _S, sc: Scenario):
+    """Evaluate every named invariant in state `s`; return violations as
+    (code, message) pairs.  One entry per INVARIANTS key — the checker
+    proves each name, not a vibe."""
+    out = []
+    _COUNTERS["invariant_checks"] += len(protocol.INVARIANTS)
+    # journal-before-dispatch: anything the router pushed toward a ring
+    # (or routed through prefill) must already be journaled
+    dispatched = {rid for rid, _ in s.owner} | {r for r, _ in s.shipping}
+    for q in s.inq:
+        dispatched |= {pay for m, pay in q
+                       if m in ("submit", "prefill")}
+    for rid in sorted(dispatched - s.journaled):
+        out.append(("journal-before-dispatch",
+                    f"rid {rid} was dispatched toward a worker ring "
+                    "without a fsynced intake-journal record — a router "
+                    "crash here silently loses an accepted request"))
+    # no-double-serve: a rid active on two LIVE workers at once
+    for rid in sorted(s.accepted):
+        servers = [wi for wi in range(4)
+                   if s.phase[wi] != "dead" and rid in s.active[wi]]
+        if len(servers) > 1:
+            names = "/".join(_WORKERS[w] for w in servers)
+            out.append(("no-double-serve",
+                        f"rid {rid} is actively served by {names} "
+                        "simultaneously — two live token streams for one "
+                        "request"))
+    # nonce-before-first-token: delivery implies a journaled nonce
+    for rid in sorted(s.delivered - s.journaled):
+        out.append(("nonce-before-first-token",
+                    f"tokens for rid {rid} reached the client before its "
+                    "nonce was journaled — the stream has no durable "
+                    "identity"))
+    # backpressure-not-death: only BrokenPipeError/SIGKILL may kill
+    for wi, cause in s.cause:
+        if cause == "timeout":
+            out.append(("backpressure-not-death",
+                        f"{_WORKERS[wi]} was declared dead on a ring "
+                        "TimeoutError — backpressure must never be a "
+                        "death verdict"))
+    # promotion-claims-once
+    for rid, n in s.claims:
+        if n > 1:
+            out.append(("promotion-claims-once",
+                        f"rid {rid} was claimed by a standby promotion "
+                        f"{n} times — exactly one resume claim allowed"))
+    # warmed-ends-boot-grace
+    for wi in sorted(s.warmed & s.grace):
+        out.append(("warmed-ends-boot-grace",
+                    f"{_WORKERS[wi]} reported warmed=True but is still "
+                    "inside boot grace — mark_warmed must end it"))
+    return out
+
+
+def _terminal(s: _S) -> bool:
+    """Quiescence is legal only once every accepted request completed."""
+    return s.to_accept == 0 and s.accepted <= s.done
+
+
+@dataclass
+class ModelCheckResult:
+    scenario: str
+    transport: str
+    states: int = 0
+    transitions: int = 0
+    violations: list = field(default_factory=list)
+    deadlocks: int = 0
+    complete: bool = False   # frontier exhausted (no early stop)
+
+    def ok(self) -> bool:
+        return not self.violations
+
+    def report(self) -> str:
+        head = (f"model check [{self.scenario} / {self.transport}]: "
+                f"{self.states} states, {self.transitions} transitions"
+                f"{'' if self.complete else ' (stopped at first hits)'}")
+        if self.ok():
+            return head + " — clean (all invariants hold, no deadlock)"
+        parts = [head + f" — {len(self.violations)} violation(s):"]
+        parts += [render_trace(v) for v in self.violations]
+        return "\n".join(parts)
+
+
+def render_trace(v: ProtocolViolation) -> str:
+    """A counterexample as a numbered interleaving ending in the named
+    invariant — the readable artifact a protocol bug report starts
+    from."""
+    lines = [f"counterexample ({len(v.trace)} steps) -> {v.code}:"]
+    lines += [f"  {i + 1:2d}. {step}" for i, step in enumerate(v.trace)]
+    lines.append(f"  VIOLATED {v.code}: {v.message}")
+    return "\n".join(lines)
+
+
+def check_model(scenario="clean-shmring", *, max_states=2_000_000,
+                stop_on_expected=True) -> ModelCheckResult:
+    """Exhaustive BFS over every reachable state of the abstract
+    cluster under `scenario` (a SCENARIOS name or a Scenario).
+
+    Breadth-first order means the first state exhibiting a violation is
+    at minimal depth, so its parent-pointer walk IS a minimal
+    counterexample.  For seeded scenarios (``scenario.expect``
+    non-empty) the search stops once every expected invariant produced
+    a trace — the point is the counterexample, not the full graph; the
+    real spec always runs to frontier exhaustion and must be clean."""
+    sc = SCENARIOS[scenario] if isinstance(scenario, str) else scenario
+    _COUNTERS["scenarios_checked"] += 1
+    res = ModelCheckResult(scenario=sc.name, transport=sc.transport.name)
+    init = _initial(sc)
+    parents = {init: (None, None)}
+    frontier = deque([init])
+    found = {}
+    while frontier:
+        s = frontier.popleft()
+        res.states += 1
+        for code, msg in _check_invariants(s, sc):
+            if code not in found:
+                found[code] = ProtocolViolation(
+                    code=code, message=msg,
+                    site=f"model:{sc.name}", trace=_walk_trace(parents, s))
+        if (stop_on_expected and sc.expect
+                and set(sc.expect) <= set(found)):
+            break
+        succ = list(_successors(s, sc))
+        if not succ and not _terminal(s):
+            res.deadlocks += 1
+            _COUNTERS["deadlocks"] += 1
+            undone = sorted(s.accepted - s.done)
+            if "no-lost-request" not in found:
+                found["no-lost-request"] = ProtocolViolation(
+                    code="no-lost-request",
+                    message=("deadlock: quiescent state with accepted "
+                             f"request(s) {undone} never completed — "
+                             "no transition is enabled"),
+                    site=f"model:{sc.name}",
+                    trace=_walk_trace(parents, s))
+        for label, s2 in succ:
+            res.transitions += 1
+            if s2 not in parents:
+                parents[s2] = (s, label)
+                frontier.append(s2)
+        if len(parents) > max_states:
+            raise RuntimeError(
+                f"protocol model check [{sc.name}] exceeded max_states="
+                f"{max_states} — the abstract model must stay finite")
+    else:
+        res.complete = True
+    res.violations = [found[c] for c in sorted(found)]
+    _COUNTERS["model_states"] += res.states
+    _COUNTERS["model_transitions"] += res.transitions
+    _COUNTERS["violations"] += len(res.violations)
+    return res
+
+
+def _walk_trace(parents, s):
+    steps = []
+    while True:
+        parent, label = parents[s]
+        if parent is None:
+            break
+        steps.append(label)
+        s = parent
+    return tuple(reversed(steps))
+
+
+def lint_cluster_protocol(transport="shmring",
+                          *, max_states=2_000_000) -> ModelCheckResult:
+    """Model-check the REAL protocol spec over `transport` ("shmring" |
+    "tcp") and raise ProtocolLintError unless it explores clean."""
+    name = {"shmring": "clean-shmring", "tcp": "clean-tcp"}[transport]
+    res = check_model(name, max_states=max_states)
+    if not res.ok():
+        raise ProtocolLintError(
+            res.violations,
+            header=f"Protocol model check failed [{name}]")
+    return res
+
+
+# =====================================================================
+# blocking-call lint (AST pass over the real code)
+# =====================================================================
+# Receiver-name heuristics: the op classes the deadline discipline
+# covers.  A dict's .pop/.get and str.join never match these.
+_RING_RE = re.compile(r"ring")
+_STORE_RE = re.compile(r"store")
+_PROC_RE = re.compile(r"proc|process|child|thread")
+_LOCK_RE = re.compile(r"lock|sem|cond")
+_TIMEOUT_KW = re.compile(r"^timeout")
+
+
+def _dotted(node):
+    """`a.b.c` as lowered text, '' for non-trivial receivers."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts)).lower()
+    return ""
+
+
+def _classify(call):
+    """(kind, direction) for a blocking op call node, else None."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = _dotted(f.value)
+    if not recv:
+        return None
+    meth = f.attr
+    if meth in ("push", "pop") and _RING_RE.search(recv):
+        return ("ring", "send" if meth == "push" else "recv")
+    if meth in ("get", "wait") and _STORE_RE.search(recv):
+        return ("store", "recv")
+    if meth == "join" and _PROC_RE.search(recv):
+        return ("process-join", "recv")
+    if meth == "acquire" and _LOCK_RE.search(recv):
+        return ("lock-acquire", "recv")
+    return None
+
+
+def _timed(call, kind):
+    """Does the call site carry an explicit deadline?  timeout*= kwargs
+    always count; a positional is a timeout only where the stdlib
+    signature says so (proc.join(5), lock.acquire(True, 5)) — a store's
+    positional is its KEY, a ring push's is its payload."""
+    for kw in call.keywords:
+        if kw.arg and _TIMEOUT_KW.match(kw.arg):
+            return True
+    if kind == "process-join" and call.args:
+        return True            # proc.join(5)
+    if kind == "lock-acquire" and len(call.args) >= 2:
+        return True            # lock.acquire(True, 5)
+    return False
+
+
+class _BlockingVisitor(ast.NodeVisitor):
+    def __init__(self, filename, retry_names):
+        self.filename = filename
+        self.violations = []
+        self._retry_names = retry_names  # defs passed to retry_backoff
+        self._retry_depth = 0
+        self._locks = []                 # with-held lock expressions
+        self._frames = []                # per-function untimed ring dirs
+
+    # -- scope tracking ------------------------------------------------
+    def _enter_fn(self, node, name):
+        riding = name in self._retry_names
+        if riding:
+            self._retry_depth += 1
+        self._frames.append({"name": name, "line": node.lineno,
+                             "untimed": {}})
+        self.generic_visit(node)
+        frame = self._frames.pop()
+        if riding:
+            self._retry_depth -= 1
+        dirs = frame["untimed"]
+        if "send" in dirs and "recv" in dirs:
+            self.violations.append(ProtocolViolation(
+                code="circular-wait",
+                message=(f"function {frame['name']!r} can block WITHOUT a "
+                         "deadline in both directions of a channel "
+                         f"(untimed send at line {dirs['send']}, untimed "
+                         f"recv at line {dirs['recv']}) — the two-party "
+                         "circular-wait shape; ride retry_backoff's "
+                         "shared deadline"),
+                site=f"{self.filename}:{frame['line']}"))
+
+    def visit_FunctionDef(self, node):
+        _COUNTERS["functions_scanned"] += 1
+        self._enter_fn(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter_fn(node, "<lambda>")
+
+    def visit_With(self, node):
+        lockish = [it for it in node.items
+                   if _LOCK_RE.search(_dotted(it.context_expr)
+                                      or (_dotted(it.context_expr.func)
+                                          if isinstance(it.context_expr,
+                                                        ast.Call)
+                                          and isinstance(
+                                              it.context_expr.func,
+                                              (ast.Attribute, ast.Name))
+                                          else ""))]
+        self._locks.extend(lockish)
+        self.generic_visit(node)
+        if lockish:
+            del self._locks[-len(lockish):]
+
+    # -- the ops -------------------------------------------------------
+    def visit_Call(self, node):
+        fname = _dotted(node.func) if not isinstance(node.func, ast.Name) \
+            else node.func.id.lower()
+        if fname.endswith("retry_backoff"):
+            # thunks handed to retry_backoff ride its shared deadline
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    self._retry_names.add("<lambda>")
+        cls = _classify(node)
+        if cls is not None:
+            kind, direction = cls
+            _COUNTERS["blocking_calls_checked"] += 1
+            timed = _timed(node, kind)
+            riding = self._retry_depth > 0
+            site = f"{self.filename}:{node.lineno}"
+            src = f"{_dotted(node.func.value)}.{node.func.attr}"
+            if not timed and not riding:
+                self.violations.append(ProtocolViolation(
+                    code="unbounded-blocking",
+                    message=(f"{kind} wait `{src}(...)` has no timeout "
+                             "and does not ride retry_backoff's shared "
+                             "deadline — an unreachable peer parks this "
+                             "frame forever"),
+                    site=site))
+                if self._frames and kind == "ring":
+                    self._frames[-1]["untimed"].setdefault(
+                        direction, node.lineno)
+            if self._locks and kind in ("ring", "store", "process-join"):
+                held = _dotted(self._locks[-1].context_expr) or "a lock"
+                self.violations.append(ProtocolViolation(
+                    code="lock-held-blocking",
+                    message=(f"{kind} wait `{src}(...)` is made while "
+                             f"holding `{held}` — a heartbeat thread "
+                             "needing that lock misses its beat and the "
+                             "router declares this worker dead"),
+                    site=site))
+        self.generic_visit(node)
+
+
+def _retry_sanctioned_names(tree):
+    """Names of local functions passed (by name) to retry_backoff — the
+    blocking op inside them rides the shared deadline by construction."""
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else "")
+        if fname == "retry_backoff":
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    names.add("<lambda>")
+    return names
+
+
+def lint_source(src, filename="<src>"):
+    """Blocking-call lint over one source text; returns violations.
+    The battery's seeded fixtures come through here."""
+    tree = ast.parse(src, filename)
+    _COUNTERS["files_linted"] += 1
+    visitor = _BlockingVisitor(filename, _retry_sanctioned_names(tree))
+    visitor.visit(tree)
+    _COUNTERS["violations"] += len(visitor.violations)
+    return visitor.violations
+
+
+def _default_lint_paths():
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = [os.path.join(pkg, "serving"),
+             os.path.join(pkg, "distributed", "collective")]
+    out = []
+    for root in roots:
+        for dirpath, _, files in os.walk(root):
+            out += [os.path.join(dirpath, f)
+                    for f in sorted(files) if f.endswith(".py")]
+    return out
+
+
+def lint_blocking_calls(paths=None):
+    """Blocking-call lint over the real serving/ + collective/ trees
+    (or explicit `paths`); returns all violations."""
+    violations = []
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for path in (paths or _default_lint_paths()):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, pkg_root)
+        violations += lint_source(src, rel)
+    return violations

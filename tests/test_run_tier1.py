@@ -15,6 +15,7 @@ import signal
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -98,6 +99,9 @@ def test_build_plan_isolates_collective_modules():
     assert "test_zb_schedules.py" in iso_names
     # while the bench-gate and simulator-only tests stay round-robin
     assert "test_bench_gate.py" in rest_files
+    # the protocol-lint suite is pure abstraction (model checker + AST
+    # pass — no fork, no ring, no device): ordinary round-robin shard
+    assert "test_protocol_lint.py" in rest_files
 
 
 # -------------------------------------------------------- crash isolation
@@ -214,6 +218,13 @@ def _ri_failing_payload():
     raise AssertionError("deliberate payload failure")
 
 
+def _ri_hanging_payload():
+    # parks far past any test timeout: every attempt times out no matter
+    # how fast the worker bootstrap runs (a warm jax import can finish
+    # inside 1s, so "the import eats the budget" is NOT deterministic)
+    time.sleep(600)
+
+
 def test_run_isolated_test_genuine_failure_no_retry():
     """rc > 0 (an assertion failure in the worker) fails IMMEDIATELY with
     the worker's tail in the message — retries are only for signal-deaths
@@ -232,9 +243,10 @@ def test_run_isolated_test_timeout_retries_like_signal_death():
     mechanism contains: TimeoutExpired must consume retries and surface
     as a signal-style failure, not escape as a raw exception."""
     with pytest.raises(AssertionError) as ei:
-        # the worker bootstrap alone (jax import) exceeds 1s, so every
-        # attempt times out deterministically
-        run_isolated_test("tests.test_run_tier1", "_ri_failing_payload",
+        # the payload parks forever, so every attempt times out
+        # deterministically — regardless of how fast the worker
+        # bootstrap (jax import) happens to be on a warm cache
+        run_isolated_test("tests.test_run_tier1", "_ri_hanging_payload",
                           retries=1, timeout=1)
     msg = str(ei.value)
     assert "signal" in msg

@@ -128,6 +128,105 @@ def test_retry_backoff_shared_deadline_and_counting():
                       timeout_s=5.0)
 
 
+def test_retry_backoff_jitter_bounded_by_cap():
+    """The sleep between attempts is full jitter on min(delay, cap_s):
+    never negative, never above the cap even after the exponential
+    doubling passes it — the contract that keeps N retrying callers from
+    synchronizing into a thundering herd with unbounded gaps."""
+    import random
+
+    class SpyRng:
+        def __init__(self):
+            self.bounds = []
+
+        def uniform(self, lo, hi):
+            self.bounds.append((lo, hi))
+            return 0.0  # no actual sleeping
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 6:
+            raise ConnectionError("transient")
+        return "ok"
+
+    rng = SpyRng()
+    assert retry_backoff(flaky, timeout_s=30.0, base_s=0.01, cap_s=0.04,
+                         rng=rng) == "ok"
+    # delays double 0.01, 0.02, 0.04, 0.08, 0.16 — but the jitter bound
+    # saturates at cap_s
+    assert [hi for _, hi in rng.bounds] == \
+        [0.01, 0.02, 0.04, 0.04, 0.04]
+    assert all(lo == 0.0 for lo, _ in rng.bounds)  # full jitter from 0
+
+    # the real rng draws stay inside [0, cap_s] too
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        retry_backoff(lambda: (_ for _ in ()).throw(TimeoutError("x")),
+                      timeout_s=0.1, base_s=0.001, cap_s=0.01,
+                      rng=random.Random(7))
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_prefix_index_drop_rank_shared_chain():
+    """Two replicas share a chained-hash prefix; dropping one must peel
+    ONLY its ranks out of the shared entries (the survivor keeps serving
+    the common prefix) and drop its exclusive deeper entries wholesale."""
+    idx = ClusterPrefixIndex(block_size=4)
+    common = [1, 2, 3, 4, 5, 6, 7, 8]
+    idx.record(0, common + [9, 10, 11, 12])  # rank 0: 3 blocks deep
+    idx.record(1, common)                    # rank 1: the shared 2 blocks
+    assert idx.best_replica(common + [9, 10, 11, 12]) == (0, 3)
+
+    idx.drop_rank(0)
+    # the shared chain survives via rank 1; rank 0's depth-3 page is gone
+    assert idx.best_replica(common + [9, 10, 11, 12]) == (1, 2)
+    assert idx.best_replica(common) == (1, 2)
+    # internal maps really shrank: no orphaned hash buckets, no rank-0
+    # residue to resurrect a corpse's affinity
+    assert 0 not in idx._ranks
+    assert all(0 not in holders for holders in idx._by_hash.values())
+    assert len(idx._by_hash) == 2
+
+    # dropping the survivor empties the index; a re-drop is a no-op
+    idx.drop_rank(1)
+    idx.drop_rank(1)
+    assert idx._by_hash == {} and idx._ranks == {}
+    assert idx.best_replica(common) == (None, 0)
+
+
+def test_intake_log_replay_multi_record_torn_tail(tmp_path):
+    """A SIGKILL tears at most the FINAL record: replay over a long log
+    keeps every whole record and drops only a trailing partial — while a
+    torn line with records AFTER it is corruption and stays loud, no
+    matter how deep the log."""
+    path = str(tmp_path / "intake.jsonl")
+    log = IntakeLog(path)
+    records = []
+    for i in range(20):
+        rec = {"ev": "tokens", "rid": f"r{i % 3}", "start": 4 * i,
+               "toks": [i, i + 1]}
+        records.append(rec)
+        log.append(rec)
+    log.close()
+    assert IntakeLog.replay(path) == records
+
+    with open(path, "a") as f:
+        f.write('{"ev": "done", "rid": "r0", "n"')  # torn final append
+    assert IntakeLog.replay(path) == records
+
+    # interior tear: every line after it parses, but durability already
+    # lied — loud, with the 1-based line number
+    with open(path) as f:
+        lines = f.readlines()
+    lines[10] = lines[10][:9] + "\n"
+    with open(path, "w") as f:
+        f.writelines(lines)
+    with pytest.raises(ValueError, match="line 11"):
+        IntakeLog.replay(path)
+
+
 def test_failure_detector_miss_threshold_and_boot_grace():
     clock = {"t": 0.0}
     missed = []

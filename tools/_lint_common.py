@@ -1,0 +1,103 @@
+"""Shared plumbing for the static-analysis lint tools.
+
+tools/lint_ir.py, tools/lint_mesh.py and tools/lint_protocol.py are the
+same shape: an environment preamble that must run before jax imports, a
+battery mode (clean scenarios must stay clean, seeded violations must be
+flagged), a ``--pytest`` sweep mode riding the program-creation hook,
+and a pass/fail CLI wrapper.  This module is that shape, once — each
+tool keeps only its actual scenarios.
+
+Import order matters: call ``setup_env()`` at module top, BEFORE any
+paddle_tpu/jax import, exactly like the inline preambles it replaced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def setup_env(host_devices=None):
+    """Repo-root on sys.path + CPU backend; optionally force an N-device
+    XLA host platform (the 8-device mesh the mesh-lint battery runs on).
+    Must run before jax is imported anywhere in the process."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if host_devices:
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            os.environ["XLA_FLAGS"] = (
+                xla_flags
+                + f" --xla_force_host_platform_device_count={host_devices}")
+
+
+def report(label, violations, expect_codes=None):
+    """Print one scenario row; returns 1 on unexpected outcome.
+
+    ``expect_codes=None`` means the scenario must be CLEAN; a set of
+    codes means the seeded violation must be FLAGGED with (at least)
+    those codes — the two outcomes every lint battery is made of."""
+    if expect_codes is None:
+        if violations:
+            print(f"FAIL {label}: expected clean, got "
+                  f"{len(violations)} violation(s):")
+            for v in violations:
+                print(f"    {v}")
+            return 1
+        print(f"ok   {label}: clean")
+        return 0
+    got = {v.code for v in violations}
+    missing = set(expect_codes) - got
+    if missing:
+        print(f"FAIL {label}: seeded violation NOT flagged "
+              f"(wanted {sorted(expect_codes)}, got {sorted(got)})")
+        return 1
+    print(f"ok   {label}: flagged {sorted(got & set(expect_codes))}")
+    return 0
+
+
+def tracked_pytest(node_ids):
+    """Run pytest in-process with the Program-creation hook installed;
+    returns (exit_code, traced_programs)."""
+    import pytest
+
+    from paddle_tpu.static.verify import track_programs
+
+    with track_programs() as programs:
+        rc = pytest.main(list(node_ids) + ["-q", "-p", "no:cacheprovider"])
+    return rc, programs
+
+
+def pytest_failures(rc):
+    """pytest exit codes that count as a sweep failure (5 = no tests
+    collected is tolerated: a node filter may legitimately match
+    nothing)."""
+    return 1 if rc not in (0, 5) else 0
+
+
+def run_cli(name, battery, sweep, argv=None, *, doc=None, ok_msg,
+            fail_msg, forward_extras=False,
+            pytest_help="run these pytest node ids through the sweep "
+                        "mode"):
+    """The tools' shared CLI: no args = battery, ``--pytest NODE...`` =
+    sweep.  ``forward_extras`` passes unrecognized argv (e.g. -m 'not
+    slow', -k expr) through to pytest.  Returns the process exit code:
+    0 = everything behaved, 1 = ``fail_msg`` (with the count)."""
+    ap = argparse.ArgumentParser(description=doc)
+    ap.add_argument("--pytest", nargs="+", metavar="NODE",
+                    help=pytest_help)
+    if forward_extras:
+        args, extra = ap.parse_known_args(argv)
+        node_ids = (list(args.pytest) + extra) if args.pytest else None
+    else:
+        args = ap.parse_args(argv)
+        node_ids = args.pytest
+    failures = sweep(node_ids) if node_ids else battery()
+    if failures:
+        print(f"\n{name}: " + fail_msg.format(n=failures))
+        return 1
+    print(f"\n{name}: {ok_msg}")
+    return 0

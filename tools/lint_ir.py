@@ -20,12 +20,12 @@ Exit status 0 = no violations; 1 = violations found (report on stdout).
 
 from __future__ import annotations
 
-import argparse
-import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from _lint_common import (pytest_failures, run_cli, setup_env,
+                          tracked_pytest)
+
+setup_env()
 
 
 def _verify_all(programs, labels=None):
@@ -178,31 +178,23 @@ def _battery() -> int:
 
 
 def _pytest_sweep(node_ids) -> int:
-    import pytest
+    from paddle_tpu.static.verify import verify_stats
 
-    from paddle_tpu.static.verify import track_programs, verify_stats
-
-    with track_programs() as programs:
-        rc = pytest.main(list(node_ids) + ["-q", "-p", "no:cacheprovider"])
+    rc, programs = tracked_pytest(node_ids)
     print(f"\npytest exit={rc}; {len(programs)} Program(s) traced — verifying")
     failures = _verify_all(programs)
     print()
     print("verify counters:", verify_stats())
-    return failures + (1 if rc not in (0, 5) else 0)
+    return failures + pytest_failures(rc)
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--pytest", nargs="+", metavar="NODE",
-                    help="run these pytest node ids and verify every "
-                         "Program they trace")
-    args = ap.parse_args(argv)
-    failures = _pytest_sweep(args.pytest) if args.pytest else _battery()
-    if failures:
-        print(f"\nlint_ir: {failures} failing program(s)")
-        return 1
-    print("\nlint_ir: all programs verified clean")
-    return 0
+    return run_cli(
+        "lint_ir", _battery, _pytest_sweep, argv, doc=__doc__,
+        ok_msg="all programs verified clean",
+        fail_msg="{n} failing program(s)",
+        pytest_help="run these pytest node ids and verify every Program "
+                    "they trace")
 
 
 if __name__ == "__main__":

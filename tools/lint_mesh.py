@@ -26,37 +26,12 @@ seeded fixture went unflagged (report on stdout).
 
 from __future__ import annotations
 
-import argparse
-import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        _xla_flags + " --xla_force_host_platform_device_count=8")
+from _lint_common import (pytest_failures, report as _report, run_cli,
+                          setup_env, tracked_pytest)
 
-
-def _report(label, violations, expect_codes=None):
-    """Print one scenario row; returns 1 on unexpected outcome."""
-    if expect_codes is None:
-        if violations:
-            print(f"FAIL {label}: expected clean, got "
-                  f"{len(violations)} violation(s):")
-            for v in violations:
-                print(f"    {v}")
-            return 1
-        print(f"ok   {label}: clean")
-        return 0
-    got = {v.code for v in violations}
-    missing = set(expect_codes) - got
-    if missing:
-        print(f"FAIL {label}: seeded violation NOT flagged "
-              f"(wanted {sorted(expect_codes)}, got {sorted(got)})")
-        return 1
-    print(f"ok   {label}: flagged {sorted(got & set(expect_codes))}")
-    return 0
+setup_env(host_devices=8)
 
 
 def _battery() -> int:
@@ -241,13 +216,9 @@ def _battery() -> int:
 
 
 def _pytest_sweep(node_ids) -> int:
-    import pytest
-
     from paddle_tpu.static.mesh_lint import lint_program, mesh_lint_stats
-    from paddle_tpu.static.verify import track_programs
 
-    with track_programs() as programs:
-        rc = pytest.main(list(node_ids) + ["-q", "-p", "no:cacheprovider"])
+    rc, programs = tracked_pytest(node_ids)
     print(f"\npytest exit={rc}; {len(programs)} Program(s) traced — "
           "mesh-linting")
     failures = 0
@@ -258,24 +229,19 @@ def _pytest_sweep(node_ids) -> int:
                             violations)
     print()
     print("mesh lint counters:", mesh_lint_stats())
-    return failures + (1 if rc not in (0, 5) else 0)
+    return failures + pytest_failures(rc)
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--pytest", nargs="+", metavar="NODE",
-                    help="run these pytest node ids and mesh-lint every "
-                         "Program they trace; unrecognized args (e.g. "
-                         "-m 'not slow', -k expr) are forwarded to pytest")
-    args, extra = ap.parse_known_args(argv)
-    failures = (_pytest_sweep(list(args.pytest) + extra) if args.pytest
-                else _battery())
-    if failures:
-        print(f"\nlint_mesh: {failures} scenario(s) misbehaved")
-        return 1
-    print("\nlint_mesh: all scenarios behaved (clean paths clean, seeded "
-          "violations flagged)")
-    return 0
+    return run_cli(
+        "lint_mesh", _battery, _pytest_sweep, argv, doc=__doc__,
+        ok_msg="all scenarios behaved (clean paths clean, seeded "
+               "violations flagged)",
+        fail_msg="{n} scenario(s) misbehaved",
+        forward_extras=True,
+        pytest_help="run these pytest node ids and mesh-lint every "
+                    "Program they trace; unrecognized args (e.g. "
+                    "-m 'not slow', -k expr) are forwarded to pytest")
 
 
 if __name__ == "__main__":

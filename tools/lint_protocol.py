@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Standalone protocol lint: model-check the cluster wire protocol.
+
+Two modes (docs/PROTOCOL_LINT.md), mirroring tools/lint_ir.py and
+tools/lint_mesh.py:
+
+  python tools/lint_protocol.py
+      Battery mode — (1) asserts the spec <-> handler binding both ways
+      (every serving/protocol.py message with a router/worker handler,
+      every handler with a spec row); (2) exhaustively model-checks the
+      REAL protocol over both transport semantics (ShmRing, TCP stub
+      with its connection-drop transition) and requires ZERO invariant
+      violations and ZERO deadlocks; (3) runs every seeded-violation
+      scenario (dropped intake fsync, lethal ring timeout, two routers
+      replaying one journal) and requires each to produce a minimal
+      counterexample trace naming the violated invariant — printed, so
+      the battery output doubles as protocol documentation; (4) runs
+      the blocking-call AST lint over the real serving/ +
+      distributed/collective/ trees (must be clean) and over seeded
+      source fixtures (each must be flagged); (5) checks the generated
+      wire table against docs/SERVING_CLUSTER.md.  Everything is
+      abstract — no process is forked, no ring is created.
+
+  python tools/lint_protocol.py --pytest tests/test_serving_cluster.py
+      Sweep mode — runs the pytest node ids in-process, then the full
+      protocol battery checks (the protocol is static: whatever the
+      tests exercised dynamically, the model check re-proves
+      exhaustively).
+
+Exit status 0 = all scenarios behaved; 1 = a clean scenario violated or
+a seeded scenario went unflagged (report on stdout).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from _lint_common import (pytest_failures, report as _report, run_cli,
+                          setup_env)
+
+setup_env()
+
+
+def _protocol_checks() -> int:
+    from paddle_tpu.serving import protocol
+    from paddle_tpu.static import protocol_lint as pl
+
+    failures = 0
+
+    # ---- spec <-> handler binding, both directions ------------------
+    from paddle_tpu.serving import cluster, cluster_worker
+
+    try:
+        protocol.bind_handlers(
+            "router", protocol.handler_lookup(cluster.EngineCluster, "_ev_"),
+            prefix="_ev_", label="EngineCluster event dispatch")
+        cluster_worker.handler_tables()
+        print("ok   spec-handler-binding: router + decode/prefill/standby "
+              "tables bind bidirectionally")
+    except protocol.ProtocolSpecError as e:
+        print(f"FAIL spec-handler-binding: {e}")
+        failures += 1
+
+    # ---- the real spec must explore clean on BOTH transports --------
+    for scenario in ("clean-shmring", "clean-tcp"):
+        res = pl.check_model(scenario)
+        failures += _report(
+            f"model-{scenario} ({res.states} states, "
+            f"{res.transitions} transitions, complete={res.complete})",
+            res.violations)
+
+    # ---- seeded scenarios: each must yield a named counterexample ---
+    for name, sc in pl.SCENARIOS.items():
+        if not sc.expect:
+            continue
+        res = pl.check_model(name)
+        failures += _report(f"model-{name}", res.violations,
+                            expect_codes=set(sc.expect))
+        for v in res.violations:
+            if v.code in sc.expect:
+                print("     " + pl.render_trace(v).replace("\n", "\n     "))
+
+    # ---- blocking-call lint: the real trees must be clean -----------
+    failures += _report("blocking-lint-real-tree (serving/ + "
+                        "distributed/collective/)",
+                        pl.lint_blocking_calls())
+
+    # ---- blocking-call lint: seeded fixtures must be flagged --------
+    fixtures = [
+        ("blocking-unbounded-ring-wait",
+         "def poll(ring_in):\n"
+         "    return ring_in.pop()\n",
+         {"unbounded-blocking"}),
+        ("blocking-unbounded-store-wait",
+         "def sync(store, key):\n"
+         "    store.wait(key)\n",
+         {"unbounded-blocking"}),
+        ("blocking-lock-held-ring-push",
+         "def forward(self, data):\n"
+         "    with self._state_lock:\n"
+         "        self.ring_out.push(data, timeout_ms=250)\n",
+         {"lock-held-blocking"}),
+        ("blocking-two-party-circular-wait",
+         "def exchange(ring_in, ring_out, data):\n"
+         "    ring_out.push(data)\n"
+         "    return ring_in.pop()\n",
+         {"circular-wait"}),
+    ]
+    for label, src, codes in fixtures:
+        failures += _report(label, pl.lint_source(src, f"<{label}>"),
+                            expect_codes=codes)
+    # the retry_backoff shared deadline sanctions an untimed wait
+    failures += _report(
+        "blocking-retry-backoff-sanctioned",
+        pl.lint_source(
+            "def forward(worker, data):\n"
+            "    def _push():\n"
+            "        worker.ring_in.push(data)\n"
+            "    retry_backoff(_push, timeout_s=5.0)\n",
+            "<sanctioned>"))
+
+    # ---- the generated wire table must match the committed doc ------
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "SERVING_CLUSTER.md")
+    with open(doc, encoding="utf-8") as f:
+        text = f.read()
+    table = protocol.wire_table_markdown()
+    if table in text:
+        print("ok   wire-table-doc: docs/SERVING_CLUSTER.md embeds the "
+              "generated table")
+    else:
+        print("FAIL wire-table-doc: docs/SERVING_CLUSTER.md drifted from "
+              "protocol.wire_table_markdown() — regenerate the block "
+              "between the wire-protocol markers")
+        failures += 1
+
+    print()
+    print("protocol lint counters:", pl.protocol_lint_stats())
+    return failures
+
+
+def _battery() -> int:
+    return _protocol_checks()
+
+
+def _pytest_sweep(node_ids) -> int:
+    import pytest
+
+    rc = pytest.main(list(node_ids) + ["-q", "-p", "no:cacheprovider"])
+    print(f"\npytest exit={rc}; running the full protocol battery")
+    return _protocol_checks() + pytest_failures(rc)
+
+
+def main(argv=None):
+    return run_cli(
+        "lint_protocol", _battery, _pytest_sweep, argv, doc=__doc__,
+        ok_msg="all scenarios behaved (real spec explores clean, seeded "
+               "violations produce counterexample traces)",
+        fail_msg="{n} scenario(s) misbehaved",
+        forward_extras=True,
+        pytest_help="run these pytest node ids, then the full protocol "
+                    "battery; unrecognized args are forwarded to pytest")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
