@@ -303,6 +303,29 @@ define_flag(
     "(respawn-with-warmup remains the recovery path)",
 )
 define_flag(
+    "FLAGS_cluster_transport",
+    "shm",
+    "Data-plane transport of the disaggregated serving cluster "
+    "(serving/transport.py, docs/SERVING_CLUSTER.md multi-host section): "
+    "'shm' rides process-shared ShmRing buffers (single box), 'tcp' rides "
+    "length-framed TcpRing sockets with endpoints published through the "
+    "TCPStore control tier — the same producer/consumer contract "
+    "(TimeoutError is backpressure, never death), so the SIGKILL crash "
+    "matrix and bit-exact fail-over hold verbatim on either.  "
+    "EngineCluster(transport=...) overrides per cluster",
+)
+define_flag(
+    "FLAGS_cluster_attach_timeout_ms",
+    30_000,
+    "Shared attach deadline for a cluster worker's boot-time channel "
+    "setup (serving/cluster_worker.py): the TCPStore client connect, "
+    "both ring attaches (shm attach retry or TcpRing endpoint wait + "
+    "dial — serving/transport.py) each ride this budget with "
+    "capped-backoff retries, because a worker routinely outraces the "
+    "router's bind/publish under load and first-refusal failure would "
+    "melt boots into respawn churn",
+)
+define_flag(
     "FLAGS_pipeline_schedule",
     "1F1B",
     "Default pipeline schedule for PipelineStack/pipeline_llama/"

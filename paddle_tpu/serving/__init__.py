@@ -124,7 +124,10 @@ def reset_decode_stats():
 # re-installs); evictions = slots vacated (explicit or LRU); gather
 # dispatches = compiled decode dispatches that gathered per-row A/B from a
 # pack; cache_epochs = slot-epoch bumps (each invalidates that slot's
-# prefix-cache subtree).
+# prefix-cache subtree); ship_ns_drops = shipped-page adoptions refused
+# for a (slot, epoch) namespace mismatch (the pages were poured under
+# adapter weights this engine no longer serves — dropping them loudly is
+# the epoch-bump-strands-shipments contract, docs/SERVING_CLUSTER.md).
 _LORA_STATS = {
     "slots_resident": 0,
     "slots_total": 0,
@@ -132,14 +135,16 @@ _LORA_STATS = {
     "evictions": 0,
     "gather_dispatches": 0,
     "cache_epochs": 0,
+    "ship_ns_drops": 0,
 }
 
 
 def lora_stats(reset: bool = False) -> dict:
     """Multi-tenant LoRA serving counters (docs/LORA.md): adapter slots
     resident / total on the most recent pack engine, hot swaps and
-    evictions, decode dispatches that gathered adapter rows, and
-    prefix-cache epoch bumps.  Zeros when no adapter engine ran."""
+    evictions, decode dispatches that gathered adapter rows, prefix-cache
+    epoch bumps, and shipped-page adoptions dropped for a namespace
+    (slot, epoch) mismatch.  Zeros when no adapter engine ran."""
     out = dict(_LORA_STATS)
     if reset:
         reset_lora_stats()
@@ -1710,7 +1715,7 @@ class GenerationEngine:
         self._results[slot.rid] = list(slot.generated)
         self._release(slot)
 
-    def adopt_pages(self, prompt_ids, k_blocks, v_blocks):
+    def adopt_pages(self, prompt_ids, k_blocks, v_blocks, ns=None):
         """Adopt externally prefilled KV pages (a prefill worker's
         shipment — serving/cluster.py) as CACHED prefix pages: pool-native
         page bytes (`ops.paged_attention.pool_get_blocks` dicts, one per
@@ -1728,6 +1733,18 @@ class GenerationEngine:
         may reassociate — which is why the cluster contract compares
         cluster runs to cluster runs, docs/SERVING_CLUSTER.md.)
 
+        `ns` is the sender's (slot, epoch) adapter namespace — the pack
+        slot whose weights poured these pages, pinned at SHIP time.  On
+        an adapter engine the pages land in exactly that prefix-cache
+        namespace, so a tenant admission under the same adapter matches
+        them and other tenants (different K/V!) never cross-match.  A
+        STALE epoch — the slot was re-registered/evicted between ship and
+        adoption, so this engine no longer serves those weights — drops
+        the shipment loudly (lora_stats()["ship_ns_drops"], return 0)
+        instead of caching K/V no admission should ever match.  ns=None
+        on an adapter engine means the base model: slot 0's namespace,
+        whose epoch never moves (slot 0 is the reserved identity).
+
         Best-effort by contract: pool pressure (after LRU reclaim) or an
         already-cached prefix simply adopts fewer (possibly zero) blocks
         and returns that count — shipping is an optimization; admission
@@ -1742,12 +1759,28 @@ class GenerationEngine:
                 "adopt_pages on a speculative engine is not supported: "
                 "shipped pages cover the target pools only, and a "
                 "draft-pool-less prefix would desynchronize d_seq_len")
-        if self._pack is not None:
-            raise RuntimeError(
-                "adopt_pages on an adapter engine is not supported yet: "
-                "shipped pages carry no (slot, epoch) namespace, so an "
-                "adapter admission could never match them (and a base "
-                "admission must not match adapter-poured K/V)")
+        if self._pack is None:
+            if ns is not None:
+                raise ValueError(
+                    "adopt_pages got adapter namespace ns="
+                    f"{tuple(ns)} but this engine was built without "
+                    "adapters= — adapter-poured K/V must never enter a "
+                    "base engine's un-namespaced prefix cache")
+        else:
+            slot, epoch = (0, self._slot_epochs[0]) if ns is None \
+                else (int(ns[0]), int(ns[1]))
+            if not 0 <= slot < self._pack.num_slots:
+                raise ValueError(
+                    f"adopt_pages namespace slot {slot} out of range "
+                    f"[0, {self._pack.num_slots}) for this engine's pack")
+            if epoch != self._slot_epochs[slot]:
+                # pinned at ship time, stale at adoption: the slot was
+                # re-registered (or its tenant evicted) in between, so
+                # these pages hold K/V of weights this engine no longer
+                # serves — strand them loudly, never cache them
+                _LORA_STATS["ship_ns_drops"] += 1
+                return 0
+            ns = (slot, epoch)
         if len(k_blocks) != self._n_layers or len(v_blocks) != self._n_layers:
             raise ValueError(
                 f"shipped pages cover {len(k_blocks)}/{len(v_blocks)} "
@@ -1779,7 +1812,7 @@ class GenerationEngine:
                         f"(layer {li})")
         # only the NOVEL tail needs pool blocks: chunks the tree already
         # holds keep their existing pages (and get LRU-touched)
-        matched = self._prefix.match(toks[: n * bs])
+        matched = self._prefix.match(toks[: n * bs], ns=ns)
         start = len(matched)
         if start >= n:
             return 0
@@ -1802,7 +1835,7 @@ class GenerationEngine:
                                                     self._pool_sharding)
                 self._vpools[li] = self._place_pool(self._vpools[li],
                                                     self._pool_sharding)
-        self._prefix.insert(toks[: n * bs], matched + fresh)
+        self._prefix.insert(toks[: n * bs], matched + fresh, ns=ns)
         return len(fresh)
 
     # ------------------------------------------------- fault tolerance

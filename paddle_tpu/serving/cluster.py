@@ -59,7 +59,8 @@ import uuid
 from paddle_tpu._core import flags as _flags
 from paddle_tpu.serving import protocol as _protocol
 from paddle_tpu.serving.router import (FailureDetector, IntakeLog,
-                                       RequestRouter, retry_backoff)
+                                       RequestRouter, cluster_adapter_table,
+                                       retry_backoff)
 
 __all__ = ["EngineCluster", "cluster_stats", "reset_cluster_stats"]
 
@@ -94,10 +95,18 @@ _CLUSTER_STATS = {
     "warmup_seconds": 0.0,
     "respawn_compile_hits": 0,
     "respawn_compile_misses": 0,
+    # prefix-cache hit tokens aggregated across decode replicas (relayed
+    # as deltas on `done`); nonzero after a shipped-page adoption is the
+    # asserted cross-host (and cross-tenant-isolation) cache contract
+    "prefix_hit_tokens": 0,
 }
 
 # gauges describe LIVE cluster state, not traffic: reset never zeros them
 _GAUGES = ("replicas_alive", "standbys_warm")
+
+# the data-plane kind of the most recent EngineCluster in this process —
+# a label, not a counter (reset leaves it, like the gauges)
+_CURRENT_TRANSPORT = {"kind": "shm"}
 
 
 def cluster_stats(reset: bool = False) -> dict:
@@ -107,18 +116,29 @@ def cluster_stats(reset: bool = False) -> dict:
     retries, drain-migrated queued requests, and the warm-start tier —
     warm standbys (gauge), standby promotions, worker AOT warmups (count
     + wall seconds), and the persistent compile-cache hit/miss counts
-    respawned workers reported at boot.  Zeros when no cluster ran this
-    process."""
+    respawned workers reported at boot.  `transport` labels the data
+    plane of the most recent cluster; `tcp_bytes`/`reconnects`/
+    `frames_sent`/`frames_recv` are the socket-transport counters
+    (serving/transport.py — all zero under shm).  Zeros when no cluster
+    ran this process."""
+    from paddle_tpu.serving.transport import transport_stats
+
     out = dict(_CLUSTER_STATS)
+    out["transport"] = _CURRENT_TRANSPORT["kind"]
+    out.update(transport_stats(reset=reset))
     if reset:
-        reset_cluster_stats()
+        reset_cluster_stats(_transport_too=False)
     return out
 
 
-def reset_cluster_stats():
+def reset_cluster_stats(_transport_too: bool = True):
     for k in _CLUSTER_STATS:
         if k not in _GAUGES:
             _CLUSTER_STATS[k] = 0.0 if k == "warmup_seconds" else 0
+    if _transport_too:
+        from paddle_tpu.serving.transport import reset_transport_stats
+
+        reset_transport_stats()
 
 
 # ------------------------------------------------------------ kill injection
@@ -202,9 +222,21 @@ class EngineCluster:
                  engine_kwargs=None, *, workdir, heartbeat_ms=None,
                  miss_threshold=None, snapshot_interval=0, respawn=True,
                  ring_mb=16, kill=None, worker_kill=None, standby=None,
-                 warmup=True):
+                 warmup=True, transport=None, adapters=None):
         """worker_kill: {(role, idx): "point:nth"} crash-injection specs
         forwarded to specific workers; kill: the ROUTER's own spec.
+        transport: the data-plane kind, "shm" (process-shared rings,
+        single box) or "tcp" (length-framed TcpRing sockets with
+        endpoints published through the TCPStore control tier —
+        serving/transport.py); None -> FLAGS_cluster_transport.  Both
+        carry the same producer/consumer contract, so every fail-over
+        path below is transport-agnostic.  adapters: [(name, rank,
+        alpha, seed), ...] — deterministic LoRA adapter specs every
+        worker registers IN ORDER at boot (adapter weights never ride
+        the wire, the same construction-identity story as the model
+        factory), giving each adapter an identical (slot, epoch)
+        namespace across the fleet so shipped pages adopt into the
+        right per-tenant prefix namespace.
         snapshot_interval > 0 arms per-replica boundary snapshots
         (FLAGS_engine_snapshot_interval inside the worker), which is what
         enables restore-based fail-over instead of replay-from-scratch.
@@ -237,6 +269,29 @@ class EngineCluster:
                            else _flags.flag("FLAGS_cluster_standby"))
         self.warmup = bool(warmup)
         self.ring_bytes = int(ring_mb) << 20
+        self.transport_kind = str(
+            transport if transport is not None
+            else _flags.flag("FLAGS_cluster_transport"))
+        self.adapters = [tuple(a) for a in (adapters or [])]
+        self._adapter_ns = cluster_adapter_table(self.adapters)
+        if self.adapters:
+            names = [str(a[0]) for a in self.adapters]
+            if len(set(names)) != len(names):
+                raise ValueError(
+                    f"duplicate cluster adapter names {names}: the "
+                    "deterministic (slot, epoch) namespace needs one slot "
+                    "per name")
+            ranks = {int(a[1]) for a in self.adapters}
+            if len(ranks) != 1:
+                raise ValueError(
+                    f"cluster adapters carry mixed ranks {sorted(ranks)}; "
+                    "AdapterPack geometry is rank-uniform — serve "
+                    "mixed-rank tenants from separate clusters")
+            # every worker engine needs a pack of matching geometry; an
+            # explicit adapters engine kwarg wins (caller knows better)
+            self.engine_kwargs.setdefault(
+                "adapters", {"rank": ranks.pop(),
+                             "max_adapters": len(self.adapters)})
         self._kill = _KillSpec(kill)
         self._worker_kill = dict(worker_kill or {})
         self._ns = f"c{uuid.uuid4().hex[:8]}"  # per-incarnation namespace
@@ -258,6 +313,11 @@ class EngineCluster:
         # ---- rendezvous store (the router hosts it) --------------------
         self._store_srv = _native.TCPStoreServer()
         self._store = _native.TCPStoreClient(port=self._store_srv.port)
+        from paddle_tpu.serving import transport as _transport
+
+        self._transport = _transport.get_transport(
+            self.transport_kind, store=self._store)
+        _CURRENT_TRANSPORT["kind"] = self.transport_kind
 
         # ---- router restart: sweep the previous incarnation ------------
         self._pidfile = os.path.join(self.workdir, "pids.json")
@@ -267,7 +327,8 @@ class EngineCluster:
         self.block_size = bs
         log_path = os.path.join(self.workdir, "intake.jsonl")
         had_log = os.path.exists(log_path)
-        self.router = RequestRouter(bs, log_path=log_path)
+        self.router = RequestRouter(bs, log_path=log_path,
+                                    adapter_ns=self._adapter_ns)
         if had_log:
             self.router.restore(IntakeLog.replay(log_path))
 
@@ -355,7 +416,6 @@ class EngineCluster:
         os.replace(tmp, self._pidfile)
 
     def _spawn(self, role, idx, restore=False):
-        from paddle_tpu import _native
         import paddle_tpu
 
         gen = self._gens.get((role, idx), 0) + 1
@@ -363,13 +423,15 @@ class EngineCluster:
         if gen > 1:
             _CLUSTER_STATS["respawns"] += 1
         base = f"/pc_{self._ns}_{role}{idx}g{gen}"
-        ring_in = _native.ShmRing(base + "_in", self.ring_bytes)
-        ring_out = _native.ShmRing(base + "_out", self.ring_bytes)
+        ring_in = self._transport.create(base + "_in", self.ring_bytes)
+        ring_out = self._transport.create(base + "_out", self.ring_bytes)
         hb_key = f"{self._ns}/hb/{role}{idx}"
         spec = {
             "role": role, "idx": idx, "gen": gen,
             "store_port": self._store_srv.port,
             "ring_in": base + "_in", "ring_out": base + "_out",
+            "transport": self.transport_kind,
+            "adapters": [list(a) for a in self.adapters],
             "hb_key": hb_key, "heartbeat_ms": self.heartbeat_ms,
             "model": self.model_spec, "engine": self.engine_kwargs,
             "snapshot_dir": self._snap_dir(idx) if role == "decode" else "",
@@ -436,18 +498,30 @@ class EngineCluster:
 
     # -------------------------------------------------------------- intake
     def submit(self, rid, prompt, max_new_tokens=16, temperature=0.0,
-               seed=0, priority="normal"):
+               seed=0, priority="normal", adapter=None):
         """Accept (durably journal) and dispatch one request.  Idempotent
         per rid: resubmitting a known id neither re-journals nor
         re-dispatches — the first acceptance pinned its nonce and its
         stream.  ``priority`` is the SLO class ("high"/"normal"/"low")
         journaled with the request and forwarded to the worker engine's
-        admission scheduler."""
+        admission scheduler.  ``adapter`` names one of the cluster's
+        construction-time adapters (the ``adapters=`` specs) to serve
+        this request with; an unknown name raises BEFORE anything is
+        journaled — a replayed journal must never carry a request no
+        worker can serve."""
+        if adapter is not None and adapter not in self._adapter_ns:
+            raise KeyError(
+                f"adapter {adapter!r} is not a cluster adapter "
+                f"(have {sorted(self._adapter_ns)}); adapters are fixed "
+                "at EngineCluster construction (adapters=[(name, rank, "
+                "alpha, seed), ...])")
         known = self.router.request(rid) is not None
-        self.router.submit(rid, [int(t) for t in prompt],
-                           max_new=int(max_new_tokens),
-                           temperature=float(temperature), seed=int(seed),
-                           priority=str(priority))
+        opts = dict(max_new=int(max_new_tokens),
+                    temperature=float(temperature), seed=int(seed),
+                    priority=str(priority))
+        if adapter is not None:
+            opts["adapter"] = str(adapter)
+        self.router.submit(rid, [int(t) for t in prompt], **opts)
         self._kill.hit("router-after-accept")
         if not known:
             self._dispatch(rid)
@@ -469,7 +543,8 @@ class EngineCluster:
             raise RuntimeError(
                 "no live decode replicas (all dead/draining and respawn "
                 "disabled) — the cluster cannot serve")
-        target = self.router.pick_replica(req.prompt, among=live)
+        ns = self.router.ns_of(req)
+        target = self.router.pick_replica(req.prompt, among=live, ns=ns)
         if redispatch:
             _CLUSTER_STATS["redispatches"] += 1
             self._shipping.pop(rid, None)
@@ -490,7 +565,9 @@ class EngineCluster:
             try:
                 self._push(pw, {"t": "prefill", "rid": rid, "sid": sid,
                                 "prompt": req.prompt,
-                                "n_blocks": full_blocks}, shipping=True)
+                                "n_blocks": full_blocks,
+                                "adapter": req.opts.get("adapter"),
+                                "ns": ns}, shipping=True)
                 return
             except BrokenPipeError:
                 self._on_worker_dead(pw.key)
@@ -514,6 +591,7 @@ class EngineCluster:
                            "temperature": req.opts.get("temperature", 0.0),
                            "seed": req.opts.get("seed", 0),
                            "priority": req.opts.get("priority", "normal"),
+                           "adapter": req.opts.get("adapter"),
                            "nonce": req.nonce})
         except BrokenPipeError:
             self._on_worker_dead(w.key)
@@ -610,6 +688,7 @@ class EngineCluster:
 
     def _ev_done(self, w, msg):
         self.router.on_done(msg["rid"], msg["n"])
+        _CLUSTER_STATS["prefix_hit_tokens"] += int(msg.get("hit_toks") or 0)
 
     def _ev_requeue(self, w, msg):
         req = self.router.request(msg["rid"])

@@ -107,6 +107,47 @@ class _Out:
         self.ring.push(pickle.dumps(msg, protocol=4), timeout_ms=30_000)
 
 
+# ----------------------------------------------------------- cluster adapters
+def _cluster_adapter_state(model, rank, seed):
+    """Deterministic LoRA weights for one cluster adapter spec: every
+    worker derives the SAME state dict from (model geometry, rank, seed)
+    — numpy RandomState, host-side, platform-stable — so adapter weights
+    never ride the wire and every engine's registration installs
+    identical contents (the model-factory construction-identity story
+    applied to adapters; router.cluster_adapter_table)."""
+    import numpy as np
+
+    from paddle_tpu.nn.lora import LLAMA_TARGETS, _resolve_sublayer
+
+    rng = np.random.RandomState(int(seed))
+    layers = model.model.layers
+    sd = {}
+    for li in range(len(layers)):
+        blk = layers[li]
+        for t in LLAMA_TARGETS:
+            lin = _resolve_sublayer(blk, t)
+            a = rng.standard_normal((lin.in_features, int(rank))) * 0.02
+            b = rng.standard_normal((int(rank), lin.out_features)) * 0.02
+            sd[f"model.layers.{li}.{t}.lora_A"] = a.astype(np.float32)
+            sd[f"model.layers.{li}.{t}.lora_B"] = b.astype(np.float32)
+    return sd
+
+
+def _register_cluster_adapters(eng, spec):
+    """Register spec["adapters"] IN ORDER on a freshly built engine:
+    first-fit slots from 1 + one epoch bump per install lands adapter i
+    at (slot i+1, epoch 1) on every worker — the fleet-wide namespace
+    cluster_adapter_table promises.  A snapshot-RESTORED engine already
+    carries its adapters (the snapshot records registry + slots +
+    epochs); re-registering a resident name would bump its epoch out of
+    fleet lockstep, so resident names are left untouched."""
+    for name, rank, alpha, seed in (spec.get("adapters") or []):
+        if name in eng._adapter_registry:
+            continue
+        eng.register_adapter(
+            name, _cluster_adapter_state(eng.model, rank, seed), alpha=alpha)
+
+
 # --------------------------------------------------------------- decode role
 def _warm_report(warm):
     """Readiness-report fields describing this process's warm state: did
@@ -154,6 +195,7 @@ def _build_decode_engine(spec, model):
 
 def _decode_loop(spec, model, ring_in, out, killer):
     eng, tracked = _build_decode_engine(spec, model)
+    _register_cluster_adapters(eng, spec)
     # AOT warm BEFORE the readiness report: the resume push is the claim
     # of this replica's requests, and announcing it with compiles still
     # owed would put trace+compile back on the serving critical path
@@ -168,7 +210,7 @@ class _DecodeCtx:
     message handlers (what the pre-PR-19 handle() closure captured)."""
 
     __slots__ = ("spec", "eng", "tracked", "staging", "sent", "out",
-                 "killer", "draining", "snap_dir")
+                 "killer", "draining", "snap_dir", "hit_toks_reported")
 
     def __init__(self, spec, eng, tracked, out, killer):
         self.spec = spec
@@ -180,6 +222,10 @@ class _DecodeCtx:
         self.killer = killer
         self.draining = eng._draining
         self.snap_dir = spec["snapshot_dir"]
+        # prefix_hit_tokens watermark already RELAYED to the router in
+        # `done` messages (the engine counter is process-global; deltas
+        # keep the router's cluster-wide aggregate double-count-free)
+        self.hit_toks_reported = 0
 
 
 # Decode-role message handlers.  One `_decode_msg_<message>` per spec
@@ -195,6 +241,7 @@ def _decode_msg_submit(ctx, msg):
                         max_new_tokens=msg["max_new"],
                         temperature=msg["temperature"] or None,
                         seed=msg["seed"], nonce=msg["nonce"],
+                        adapter=msg.get("adapter"),
                         priority=msg.get("priority", "normal"))
     ctx.killer.hit("decode-after-accept")
     ctx.tracked.add(msg["rid"])
@@ -203,7 +250,8 @@ def _decode_msg_submit(ctx, msg):
 
 def _decode_msg_ship_begin(ctx, msg):
     ctx.staging[msg["sid"]] = {"tokens": msg["tokens"],
-                               "n": msg["n_blocks"], "k": [], "v": []}
+                               "n": msg["n_blocks"], "k": [], "v": [],
+                               "ns": msg.get("ns")}
     return None
 
 
@@ -231,7 +279,8 @@ def _decode_msg_ship_end(ctx, msg):
                 [blk[li][leaf] for blk in st["v"]], axis=0)
              for leaf in st["v"][0][li]}
             for li in range(n_layers)]
-        ctx.eng.adopt_pages(st["tokens"], k_blocks, v_blocks)
+        ctx.eng.adopt_pages(st["tokens"], k_blocks, v_blocks,
+                            ns=st.get("ns"))
         ctx.killer.hit("decode-after-adopt")
     # an incomplete ship (a killed prefill worker) just drops:
     # admission falls back to local prefill, nothing is lost
@@ -273,8 +322,12 @@ def _decode_serve(spec, eng, tracked, ring_in, out, killer):
                 ctx.sent[rid] = len(lst)
                 killer.hit("decode-mid-stream")
             if rid not in active and rid not in queued:
+                from paddle_tpu.serving import decode_stats
+                hits = int(decode_stats()["prefix_hit_tokens"])
                 out.push({"t": "done", "rid": rid,
-                          "n": ctx.sent.get(rid, 0)})
+                          "n": ctx.sent.get(rid, 0),
+                          "hit_toks": hits - ctx.hit_toks_reported})
+                ctx.hit_toks_reported = hits
                 tracked.discard(rid)
 
     while True:
@@ -353,6 +406,7 @@ def _standby_loop(spec, model, ring_in, out, killer):
     kw = dict(spec["engine"])
     kw["prefix_cache"] = True
     eng = GenerationEngine(model, **kw)
+    _register_cluster_adapters(eng, spec)
     killer.hit("standby-mid-warmup")
     warm = eng.warmup() if spec.get("warmup", True) else None
     out.push({"t": "ready", **_warm_report(warm)})
@@ -402,12 +456,18 @@ def _standby_loop(spec, model, ring_in, out, killer):
 
 
 # -------------------------------------------------------------- prefill role
-def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype):
+def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype,
+                   scope=None):
     """K/V pages for the prompt's first `n_blocks` FULL blocks, poured
     through the engine's own quantize/pour math into a staging pool and
     extracted as pool-native leaves.  Deterministic: the same prompt
     always ships the same bytes (the bit-exact re-ship contract), int8
-    quantization included."""
+    quantization included.  `scope` wraps the forward (an adapter
+    request's nn.lora.adapter_prefill_scope: the poured K/V must be the
+    ADAPTED model's, exactly what the decode engine's own admission would
+    pour for that tenant)."""
+    import contextlib
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -415,6 +475,7 @@ def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype):
     from paddle_tpu.models.llama import _model_forward_cached
     from paddle_tpu.ops import paged_attention as pa
 
+    scope = scope if scope is not None else contextlib.nullcontext()
     cfg = model.config
     nkv = cfg.num_key_value_heads
     hd = cfg.hidden_size // cfg.num_attention_heads
@@ -427,7 +488,7 @@ def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype):
          paddle.zeros([1, 0, nkv, hd], dtype=cfg.dtype))
         for _ in range(cfg.num_hidden_layers)]
     arr = np.asarray(toks, np.int32).reshape(1, -1)
-    with paddle.no_grad():
+    with scope, paddle.no_grad():
         _h, caches = _model_forward_cached(
             model.model, paddle.to_tensor(arr), caches, 0)
     idx = jnp.arange(n_blocks, dtype=jnp.int32)
@@ -449,16 +510,42 @@ def _prefill_pages(model, prompt, n_blocks, block_size, kv_dtype):
 
 class _PrefillCtx:
     """Prefill-role handler context: the shared model plus the resolved
-    page geometry every shipment uses."""
+    page geometry every shipment uses.  `pack` holds the cluster's
+    deterministic adapters (same construction as every decode engine's
+    registration — slot i+1 in spec order) so adapter requests prefill
+    through their tenant's weights."""
 
-    __slots__ = ("model", "out", "killer", "block_size", "kv_dtype")
+    __slots__ = ("model", "out", "killer", "block_size", "kv_dtype",
+                 "pack")
 
-    def __init__(self, model, out, killer, block_size, kv_dtype):
+    def __init__(self, model, out, killer, block_size, kv_dtype,
+                 pack=None):
         self.model = model
         self.out = out
         self.killer = killer
         self.block_size = block_size
         self.kv_dtype = kv_dtype
+        self.pack = pack
+
+
+def _build_prefill_pack(model, spec):
+    """The prefill worker's AdapterPack: cluster adapter i installed at
+    slot i+1 — the same slots cluster_adapter_table names and every
+    decode engine's in-order registration lands on.  None without
+    cluster adapters."""
+    specs = spec.get("adapters") or []
+    if not specs:
+        return None
+    from paddle_tpu.nn.lora import AdapterPack, parse_adapter_state_dict
+
+    pack = AdapterPack(model, rank=int(specs[0][1]),
+                       max_adapters=len(specs))
+    for i, (name, rank, alpha, seed) in enumerate(specs):
+        arrays = parse_adapter_state_dict(
+            _cluster_adapter_state(model, rank, seed),
+            pack.num_layers, pack.targets, pack.rank)
+        pack.set_slot(i + 1, arrays, alpha)
+    return pack
 
 
 def _prefill_msg_stop(ctx, msg):
@@ -467,13 +554,27 @@ def _prefill_msg_stop(ctx, msg):
 
 def _prefill_msg_prefill(ctx, msg):
     n = int(msg["n_blocks"])
+    ns = msg.get("ns")
+    scope = None
+    if msg.get("adapter") is not None:
+        if ctx.pack is None or ns is None:
+            raise RuntimeError(
+                f"prefill for adapter {msg['adapter']!r} without a "
+                "cluster adapter pack/namespace — the router and worker "
+                "specs disagree on adapters= (serving/cluster.py)")
+        from paddle_tpu.nn.lora import adapter_prefill_scope
+
+        # the wire namespace names the slot whose weights pour this K/V
+        scope = adapter_prefill_scope(ctx.model.model.layers, ctx.pack,
+                                      int(ns[0]))
     toks, k_layers, v_layers = _prefill_pages(
-        ctx.model, msg["prompt"], n, ctx.block_size, ctx.kv_dtype)
+        ctx.model, msg["prompt"], n, ctx.block_size, ctx.kv_dtype,
+        scope=scope)
     ctx.killer.hit("prefill-before-ship")
     sid = msg["sid"]
     ctx.out.push({"t": "page_begin", "sid": sid, "rid": msg["rid"],
                   "tokens": toks, "n_blocks": n,
-                  "n_layers": len(k_layers)})
+                  "n_layers": len(k_layers), "ns": ns})
     for bi in range(n):
         ctx.out.push({"t": "page_block", "sid": sid, "i": bi,
                       "k": [{leaf: a[bi:bi + 1] for leaf, a in lay.items()}
@@ -499,7 +600,8 @@ def _prefill_loop(spec, model, ring_in, out, killer):
     kv_dtype = (spec["engine"].get("kv_cache_dtype")
                 or _flags.flag("FLAGS_kv_cache_dtype"))
     _, handlers, _ = handler_tables()
-    ctx = _PrefillCtx(model, out, killer, block_size, kv_dtype)
+    ctx = _PrefillCtx(model, out, killer, block_size, kv_dtype,
+                      pack=_build_prefill_pack(model, spec))
     while True:
         try:
             data = ring_in.pop(timeout_ms=100)
@@ -521,15 +623,20 @@ def main():
     _bootstrap_jax()
 
     from paddle_tpu import _native
+    from paddle_tpu._core import flags as _flags
     from paddle_tpu.serving.cluster import _KillSpec
+    from paddle_tpu.serving.transport import get_transport
 
     killer = _KillSpec(spec.get("kill") or "")
+    # One attach deadline (FLAGS_cluster_attach_timeout_ms) covers every
+    # boot-time channel: the store connect, both ring attaches, and — for
+    # transport="tcp" — the endpoint-key wait + dial inside attach()
+    attach_ms = int(_flags.flag("FLAGS_cluster_attach_timeout_ms"))
     store = _native.TCPStoreClient(port=spec["store_port"],
-                                   timeout_ms=30_000)
-    ring_in = _native.ShmRing(spec["ring_in"], create=False,
-                              attach_timeout_ms=30_000)
-    ring_out = _native.ShmRing(spec["ring_out"], create=False,
-                               attach_timeout_ms=30_000)
+                                   timeout_ms=attach_ms)
+    transport = get_transport(spec.get("transport") or "shm", store=store)
+    ring_in = transport.attach(spec["ring_in"], attach_timeout_ms=attach_ms)
+    ring_out = transport.attach(spec["ring_out"], attach_timeout_ms=attach_ms)
     hb = threading.Thread(
         target=_heartbeat_loop,
         args=(store, spec["hb_key"], spec["heartbeat_ms"] / 2000.0),

@@ -83,13 +83,14 @@ class Message:
 MESSAGES = (
     Message("submit", ("router",), ("decode",),
             ("rid", "prompt", "max_new", "temperature", "seed", "priority",
-             "nonce"),
+             "adapter", "nonce"),
             "serve this request with the ROUTER-assigned nonce (and SLO "
-            "class) journaled at acceptance"),
+            "class, and LoRA adapter name) journaled at acceptance"),
     Message("ship_begin", ("router",), ("decode",),
-            ("sid", "rid", "tokens", "n_blocks", "n_layers"),
+            ("sid", "rid", "tokens", "n_blocks", "n_layers", "ns"),
             "forwarded prefill shipment opens: stage `n_blocks` pool-native "
-            "K/V pages for these prompt tokens"),
+            "K/V pages for these prompt tokens under adapter namespace "
+            "`ns` ((slot, epoch), None = base model)"),
     Message("ship_block", ("router",), ("decode",),
             ("sid", "i", "k", "v"),
             "one shipped K/V page (pool-native leaves, one block per "
@@ -109,8 +110,10 @@ MESSAGES = (
             (),
             "clean exit (answered with `bye`)"),
     Message("prefill", ("router",), ("prefill",),
-            ("rid", "sid", "prompt", "n_blocks"),
-            "compute + ship the prompt's full-block K/V pages"),
+            ("rid", "sid", "prompt", "n_blocks", "adapter", "ns"),
+            "compute + ship the prompt's full-block K/V pages (through "
+            "adapter `adapter`'s weights when set, stamping namespace "
+            "`ns`)"),
     Message("promote", ("router",), ("standby",),
             ("snapshot_dir", "snapshot_interval"),
             "claim a dead replica's snapshot dir and become its decode "
@@ -128,8 +131,10 @@ MESSAGES = (
             "token run at absolute stream position `start` (re-emitted "
             "overlaps must merge bit-for-bit)"),
     Message("done", ("decode",), ("router",),
-            ("rid", "n"),
-            "request complete after `n` delivered tokens"),
+            ("rid", "n", "hit_toks"),
+            "request complete after `n` delivered tokens (`hit_toks` = "
+            "this engine's prefix-cache hit-token delta since its last "
+            "report, aggregated cluster-wide by the router)"),
     Message("requeue", ("decode",), ("router",),
             ("rid",),
             "a draining replica refuses a submit; the router re-dispatches"),
@@ -138,9 +143,9 @@ MESSAGES = (
             "drain report: these queued (never-started) requests migrate "
             "to survivors"),
     Message("page_begin", ("prefill",), ("router",),
-            ("sid", "rid", "tokens", "n_blocks", "n_layers"),
+            ("sid", "rid", "tokens", "n_blocks", "n_layers", "ns"),
             "shipment opens (relayed to the target replica as "
-            "`ship_begin`)"),
+            "`ship_begin`, adapter namespace `ns` included)"),
     Message("page_block", ("prefill",), ("router",),
             ("sid", "i", "k", "v"),
             "one computed K/V page (relayed as `ship_block`)"),

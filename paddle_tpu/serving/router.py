@@ -40,8 +40,8 @@ import os
 import random
 import time
 
-__all__ = ["block_hashes", "ClusterPrefixIndex", "IntakeLog",
-           "FailureDetector", "RequestRouter", "retry_backoff"]
+__all__ = ["block_hashes", "cluster_adapter_table", "ClusterPrefixIndex",
+           "IntakeLog", "FailureDetector", "RequestRouter", "retry_backoff"]
 
 
 # ------------------------------------------------------------- retry helper
@@ -73,20 +73,40 @@ def retry_backoff(fn, *, timeout_s=5.0, base_s=0.005, cap_s=0.25,
 
 
 # ---------------------------------------------------------- prefix affinity
-def block_hashes(tokens, block_size):
+def block_hashes(tokens, block_size, ns=None):
     """Chained hashes of the prompt's FULL blocks — the cluster-wide key
     for one engine page (docs/DECODE.md page granularity).  Hash i covers
     tokens[0 : (i+1)*block_size] via chaining, so equal hash means equal
     whole prefix, not merely an equal chunk — exactly the radix-tree path
-    identity, without shipping token lists around the cluster."""
+    identity, without shipping token lists around the cluster.  `ns` is
+    the (slot, epoch) adapter namespace: it seeds the chain, so the same
+    prompt under different adapters (different K/V!) hashes to disjoint
+    chains — the cluster-index mirror of the engine radix tree's
+    namespaced walk."""
     out = []
     h = hashlib.sha256()
+    if ns is not None:
+        h.update(f"ns:{int(ns[0])},{int(ns[1])};".encode())
     bs = int(block_size)
     for bi in range(len(tokens) // bs):
         chunk = tokens[bi * bs:(bi + 1) * bs]
         h.update((",".join(str(int(t)) for t in chunk) + ";").encode())
         out.append(h.hexdigest()[:24])
     return out
+
+
+def cluster_adapter_table(adapter_specs):
+    """{name: (slot, epoch)} the cluster's deterministic adapter
+    namespace: ``adapter_specs`` is EngineCluster's
+    ``[(name, rank, alpha, seed), ...]`` list, and every worker registers
+    exactly these, in order, on a freshly built engine at boot —
+    first-fit slots from 1 and one epoch bump per install
+    (GenerationEngine.register_adapter / _try_install), so adapter i
+    lands at (slot i+1, epoch 1) across the whole fleet.  Weights and
+    epochs never ride the wire; construction identity IS the namespace
+    agreement (the same story as the model factory), and a lockstep unit
+    test pins this table to the engine's actual registration behaviour."""
+    return {str(s[0]): (i + 1, 1) for i, s in enumerate(adapter_specs)}
 
 
 class ClusterPrefixIndex:
@@ -105,8 +125,8 @@ class ClusterPrefixIndex:
         self._by_hash: dict[str, set] = {}
         self._ranks: dict[int, set] = {}  # rank -> its hashes (for drops)
 
-    def record(self, rank, tokens):
-        for hx in block_hashes(tokens, self.block_size):
+    def record(self, rank, tokens, ns=None):
+        for hx in block_hashes(tokens, self.block_size, ns=ns):
             self._by_hash.setdefault(hx, set()).add(rank)
             self._ranks.setdefault(rank, set()).add(hx)
 
@@ -118,12 +138,13 @@ class ClusterPrefixIndex:
                 if not holders:
                     del self._by_hash[hx]
 
-    def best_replica(self, tokens, among=None):
+    def best_replica(self, tokens, among=None, ns=None):
         """(rank, depth) of the replica holding the longest cached hash
-        chain of `tokens` (depth = matched full blocks), or (None, 0).
-        `among` restricts candidates (the live replica set)."""
+        chain of `tokens` under adapter namespace `ns` (depth = matched
+        full blocks), or (None, 0).  `among` restricts candidates (the
+        live replica set)."""
         depth_by_rank: dict[int, int] = {}
-        for i, hx in enumerate(block_hashes(tokens, self.block_size)):
+        for i, hx in enumerate(block_hashes(tokens, self.block_size, ns=ns)):
             holders = self._by_hash.get(hx)
             if not holders:
                 break
@@ -309,9 +330,13 @@ class RequestRouter:
     position and must MATCH the canonical tokens — divergence raises
     instead of silently corrupting a client stream."""
 
-    def __init__(self, block_size, log_path=None):
+    def __init__(self, block_size, log_path=None, adapter_ns=None):
+        """adapter_ns: {adapter name: (slot, epoch)} — the cluster's
+        deterministic adapter namespace table (cluster_adapter_table);
+        requests carrying an ``adapter`` opt route and index under it."""
         self.index = ClusterPrefixIndex(block_size)
         self.log = IntakeLog(log_path) if log_path else None
+        self.adapter_ns = dict(adapter_ns or {})
         self._reqs: dict = {}
         self._nonce = 0
         self._outstanding: dict[int, set] = {}  # rank -> open rids
@@ -365,14 +390,21 @@ class RequestRouter:
                     req.done = True
 
     # ------------------------------------------------------------- routing
-    def pick_replica(self, prompt, among=None):
+    def ns_of(self, req):
+        """The (slot, epoch) adapter namespace a request's pages live
+        under, or None for base-model requests (and unknown names — the
+        cluster validates names at submit, before anything is journaled)."""
+        adapter = req.opts.get("adapter")
+        return self.adapter_ns.get(adapter) if adapter is not None else None
+
+    def pick_replica(self, prompt, among=None, ns=None):
         """Prefix affinity first (the replica already holding the longest
-        cached page chain of this prompt), least-outstanding as the
-        tie-break and the cold-prompt default."""
+        cached page chain of this prompt, within adapter namespace `ns`),
+        least-outstanding as the tie-break and the cold-prompt default."""
         live = sorted(among if among is not None else self._outstanding)
         if not live:
             raise RuntimeError("no live replicas to route to")
-        rank, depth = self.index.best_replica(prompt, among=set(live))
+        rank, depth = self.index.best_replica(prompt, among=set(live), ns=ns)
         if rank is not None and depth > 0:
             return rank
         return min(live, key=lambda r: (self.load(r), r))
@@ -382,7 +414,7 @@ class RequestRouter:
         req.owner = rank
         req.shipped = shipped
         self._outstanding.setdefault(rank, set()).add(rid)
-        self.index.record(rank, req.prompt)
+        self.index.record(rank, req.prompt, ns=self.ns_of(req))
 
     def unassign(self, rid):
         """Release a request whose dispatch could not be DELIVERED (ring

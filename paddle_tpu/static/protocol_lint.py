@@ -21,11 +21,14 @@ Three check families (docs/PROTOCOL_LINT.md):
    makes the first counterexample found a MINIMAL one: the trace handed
    back is the shortest interleaving that reaches the violation.
    Transport semantics are a parameter: ``ShmRingSemantics`` models
-   today's shared-memory rings, ``TcpStubSemantics`` adds the
-   connection-drop transition of the future TCP ring (a dropped
-   connection is a ``BrokenPipeError`` to the worker, i.e. death — the
-   semantics today's workers already implement), so the TCP transport
-   lands with its interleavings already explored.
+   the shared-memory rings, ``TcpStubSemantics`` keeps the worst-case
+   drop-as-death stub (a dropped connection is a ``BrokenPipeError`` to
+   the worker, i.e. death), and ``TcpRingSemantics`` models the REAL
+   ``serving/transport.py`` TcpRing — a drop is silence + background
+   redial with the in-flight frame re-sent whole, so the armed fault is
+   a DUPLICATED frame and the checker proves the protocol
+   re-emission-safe (plus the loud KeyError death on a spec-foreign
+   duplicate).  Reconnect-after-drop and death are distinct transitions.
 
 2. **Seeded-violation scenarios** — deliberately broken protocol
    variants (skip the intake fsync; treat ring ``TimeoutError`` as a
@@ -68,6 +71,7 @@ __all__ = [
     "ProtocolLintError",
     "ShmRingSemantics",
     "TcpStubSemantics",
+    "TcpRingSemantics",
     "Scenario",
     "SCENARIOS",
     "ModelCheckResult",
@@ -138,17 +142,38 @@ class ShmRingSemantics:
     name = "shmring"
     queue_cap = 2      # bounded rings: small cap keeps the model finite
     drop_budget = 0    # shm rings cannot drop a connection
+    reconnect = False  # a drop (if any) is final
 
 
 class TcpStubSemantics(ShmRingSemantics):
-    """ROADMAP item-1 stub: a TCP ring behaves like a shm ring plus one
-    extra environment transition — the connection can drop.  The worker
-    sees that as BrokenPipeError and exits (exactly what cluster_worker
-    does today), so a drop IS a death with a different cause label; the
-    checker proves the recovery machinery absorbs it like a SIGKILL."""
+    """The pre-transport stub: a TCP ring behaves like a shm ring plus
+    one extra environment transition — the connection can drop.  The
+    worker sees that as BrokenPipeError and exits, so a drop IS a death
+    with a different cause label; the checker proves the recovery
+    machinery absorbs it like a SIGKILL.  Kept as the WORST-CASE model:
+    a protocol that survives drop-as-death also survives any softer
+    semantics."""
 
     name = "tcp-stub"
     drop_budget = 1
+
+
+class TcpRingSemantics(ShmRingSemantics):
+    """The REAL serving/transport.py TcpRing: a connection drop is
+    SILENCE, not death.  The transport redials in the background and
+    re-sends the in-flight frame whole on reconnect (at-least-once
+    delivery); push sees backpressure, pop sees timeouts, and the
+    heartbeat tier keeps sole death authority.  The armed environment
+    transition is therefore a DUPLICATED frame — the checker proves the
+    protocol is re-emission-safe under redelivery (idempotent submit,
+    bit-mergeable token runs, claims consumed exactly once) and that a
+    spec-foreign duplicate (a stale ``promote`` re-sent to an
+    already-promoted standby) dies loudly through the KeyError path
+    instead of corrupting state."""
+
+    name = "tcp-ring"
+    drop_budget = 1
+    reconnect = True
 
 
 @dataclass(frozen=True)
@@ -162,6 +187,8 @@ class Scenario:
     drop_fsync: bool = False      # accept without journaling (seeded bug)
     lethal_timeout: bool = False  # ring TimeoutError => death (seeded bug)
     rogue_router: bool = False    # a 2nd router replays the journal
+    drop_as_backpressure: bool = False  # worker shrugs off a DESTROYED
+                                  # peer ring as backpressure (seeded bug)
     n_requests: int = 2
     crash_budget: int = 1
     queue_cap: int = 0            # 0 = the transport's own cap
@@ -180,6 +207,13 @@ SCENARIOS = {
                     "the connection-drop transition is the armed fault "
                     "(SIGKILL interleavings are clean-shmring's job); "
                     "must explore clean"),
+    "clean-tcp-ring": Scenario(
+        "clean-tcp-ring", TcpRingSemantics, crash_budget=0,
+        description="the real protocol over the REAL TcpRing transport "
+                    "(serving/transport.py): a drop is redial + "
+                    "at-least-once re-send, so the armed fault is a "
+                    "DUPLICATED in-flight frame, not a death — must "
+                    "explore clean (the protocol is re-emission-safe)"),
     "drop-intake-fsync": Scenario(
         "drop-intake-fsync", ShmRingSemantics, drop_fsync=True,
         expect=("journal-before-dispatch", "nonce-before-first-token"),
@@ -196,6 +230,16 @@ SCENARIOS = {
         expect=("no-double-serve",),
         description="a second router replays the same intake journal "
                     "and re-dispatches an owned rid"),
+    "drop-as-backpressure": Scenario(
+        "drop-as-backpressure", TcpRingSemantics,
+        drop_as_backpressure=True, n_requests=1, crash_budget=0,
+        expect=("no-double-serve",),
+        description="a TcpRing worker treats its genuinely-destroyed "
+                    "peer ring (BrokenPipeError / CLOSE) as mere "
+                    "backpressure and keeps serving while the heartbeat "
+                    "tier declares it dead — its streams re-dispatch and "
+                    "are served twice (silence is for TRANSIENT drops; "
+                    "ring teardown must stay lethal)"),
 }
 
 
@@ -369,7 +413,14 @@ def _successors(s: _S, sc: Scenario):
         base = s._replace(outq=_tset(s.outq, wi, s.outq[wi][1:]))
         name = _WORKERS[wi]
         if msg == "resume":
-            if _WROLE[wi] == "standby" and pay:
+            if wi in s.warmed:
+                # at-least-once redelivery (TcpRing re-send): the real
+                # router's _pending_claims.pop already ran — claims are
+                # consumed exactly once, re-assign is a set no-op
+                yield (f"router: recv duplicate resume from {name} — "
+                       "claims already consumed, idempotent redelivery",
+                       base)
+            elif _WROLE[wi] == "standby" and pay:
                 # the promoted standby's ONE claim of the victim's streams
                 yield (f"router: recv resume from {name} — claims "
                        f"{list(pay)} (mark_warmed)",
@@ -552,6 +603,15 @@ def _successors(s: _S, sc: Scenario):
                            inq=_tset(s.inq, wi, s.inq[wi][1:]),
                            active=_tset(s.active, wi,
                                         s.active[wi] | {rid})))
+            else:
+                # a frame outside the decode alphabet (e.g. a stale
+                # `promote` re-sent to a PROMOTED standby) is the
+                # KeyError fatal path in cluster_worker: die loudly,
+                # never drop silently — recovery absorbs it like a crash
+                yield (f"{name}: spec-foreign `{msg}` frame in the "
+                       "decode serve loop — KeyError fatal path, worker "
+                       "exits loudly",
+                       _kill(s, wi, "protocol"))
         for rid in sorted(s.active[wi] - s.toked[wi]):
             if len(s.outq[wi]) < cap:
                 yield (f"{name}: emit first tokens for {rid}",
@@ -578,10 +638,59 @@ def _successors(s: _S, sc: Scenario):
                        _kill(s, wi, "crash", crashes=s.crashes - 1))
     if s.drops:
         for wi in range(4):
-            if s.phase[wi] != "dead":
-                yield (f"TCP connection to {_WORKERS[wi]} drops — worker "
+            if s.phase[wi] == "dead":
+                continue
+            name = _WORKERS[wi]
+            if not sc.transport.reconnect:
+                # TcpStubSemantics: drop-as-death, the worst case
+                yield (f"TCP connection to {name} drops — worker "
                        "sees BrokenPipeError and exits",
                        _kill(s, wi, "conn-drop", drops=s.drops - 1))
+                continue
+            if sc.drop_as_backpressure:
+                # seeded bug: the peer ring was genuinely torn down
+                # (the heartbeat tier already counted this worker out)
+                # but the worker shrugs the BrokenPipeError off as
+                # backpressure and keeps serving its residents while
+                # the router re-homes them
+                if _WROLE[wi] != "decode" or not s.active[wi]:
+                    continue
+                orphans = tuple(sorted(
+                    rid for rid, w in s.owner if w == wi))
+                yield (f"{name}'s rings torn down after heartbeat "
+                       "death verdict; BUG: worker treats the "
+                       "BrokenPipeError as backpressure and keeps "
+                       f"serving {list(orphans)} while the router "
+                       "re-homes them",
+                       s._replace(
+                           detected=s.detected | {wi},
+                           owner=tuple(e for e in s.owner
+                                       if e[1] != wi),
+                           drops=s.drops - 1))
+                continue
+            # the REAL TcpRing: drop = silence + redial; the in-flight
+            # frame is re-sent whole, so the observable fault is a
+            # duplicated head-of-queue frame (at-least-once delivery) —
+            # silence itself is already every scheduling interleaving
+            # where this worker simply isn't picked
+            if s.inq[wi] and len(s.inq[wi]) < cap:
+                m0 = s.inq[wi][0][0]
+                yield (f"TCP conn for {name}.ring_in drops mid-frame — "
+                       f"redial re-sends the in-flight `{m0}` whole: "
+                       "frame delivered twice (at-least-once)",
+                       s._replace(
+                           inq=_tset(s.inq, wi,
+                                     (s.inq[wi][0],) + s.inq[wi]),
+                           drops=s.drops - 1))
+            if s.outq[wi] and len(s.outq[wi]) < cap:
+                m0 = s.outq[wi][0][0]
+                yield (f"TCP conn for {name}.ring_out drops mid-frame — "
+                       f"redial re-sends the in-flight `{m0}` whole: "
+                       "frame delivered twice (at-least-once)",
+                       s._replace(
+                           outq=_tset(s.outq, wi,
+                                      (s.outq[wi][0],) + s.outq[wi]),
+                           drops=s.drops - 1))
 
 
 def _check_invariants(s: _S, sc: Scenario):
@@ -751,8 +860,11 @@ def _walk_trace(parents, s):
 def lint_cluster_protocol(transport="shmring",
                           *, max_states=2_000_000) -> ModelCheckResult:
     """Model-check the REAL protocol spec over `transport` ("shmring" |
-    "tcp") and raise ProtocolLintError unless it explores clean."""
-    name = {"shmring": "clean-shmring", "tcp": "clean-tcp"}[transport]
+    "tcp" | "tcp-ring") and raise ProtocolLintError unless it explores
+    clean.  "tcp" is the worst-case drop-as-death stub; "tcp-ring" is
+    serving/transport.py's real redial + at-least-once semantics."""
+    name = {"shmring": "clean-shmring", "tcp": "clean-tcp",
+            "tcp-ring": "clean-tcp-ring"}[transport]
     res = check_model(name, max_states=max_states)
     if not res.ok():
         raise ProtocolLintError(

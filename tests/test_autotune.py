@@ -195,6 +195,23 @@ def test_v5p_readiness_geometry_and_peaks(tmp_cache):
     assert at.lookup("flash_fwd", key, slug="tpu_v5_lite") != {"block_q": 256, "block_k": 128}
 
 
+def _tune_retry(search, attempts=3):
+    """Run a tune_* driver, absorbing load-induced degenerate timings.
+
+    Under parallel tier-1 load (run_tier1 --jobs 6) scheduler preemption
+    between the back-to-back `inner` / `2*inner` batches can make every
+    timing difference nonpositive, and _time_fn then refuses to record a
+    winner (RuntimeError "every timing sample was degenerate") — correct
+    tuner behavior, but this test is about END-TO-END candidate
+    execution, not timing quality, so the whole search retries."""
+    for i in range(attempts):
+        try:
+            return search()
+        except RuntimeError as e:
+            if "degenerate" not in str(e) or i == attempts - 1:
+                raise
+
+
 def test_tune_drivers_execute_real_kernels(tmp_cache):
     """The tune_* drivers must build AND RUN their kernels end-to-end.
 
@@ -204,16 +221,21 @@ def test_tune_drivers_execute_real_kernels(tmp_cache):
     died with AttributeError on-chip.  The fake-timer test never called the
     built fn, so only a real execution catches this class.
     """
-    cfg, ms = at.tune_flash(batch=1, num_heads=1, seq=128, head_dim=8,
-                            dtype="float32", slug="testdev", iters=1, inner=1)
+    # inner=4 (not 1): a 4-vs-8 dispatch difference keeps a measurable
+    # signal above scheduler jitter when six test jobs share the host
+    cfg, ms = _tune_retry(lambda: at.tune_flash(
+        batch=1, num_heads=1, seq=128, head_dim=8,
+        dtype="float32", slug="testdev", iters=1, inner=4))
     # strictly above the degenerate-sample floor: a clamped/failed timing
     # must not satisfy this (1e-4 is _time_fn's failed-sample sentinel)
     assert cfg["block_q"] in (64, 128) and ms > 1e-4
-    cfg, _ = at.tune_fused_norm(rows=16, hidden=128, dtype="float32",
-                                slug="testdev", iters=1, inner=1)
+    cfg, _ = _tune_retry(lambda: at.tune_fused_norm(
+        rows=16, hidden=128, dtype="float32",
+        slug="testdev", iters=1, inner=4))
     assert 16 % cfg["rows_block"] == 0
-    cfg, _ = at.tune_swiglu(rows=64, cols=128, dtype="float32",
-                            slug="testdev", iters=1, inner=1)
+    cfg, _ = _tune_retry(lambda: at.tune_swiglu(
+        rows=64, cols=128, dtype="float32",
+        slug="testdev", iters=1, inner=4))
     assert 64 % cfg["rows_block"] == 0 and 128 % cfg["cols_block"] == 0
 
 

@@ -443,6 +443,117 @@ def test_adopt_pages_loud_on_bad_shapes_and_modes():
         eng8.adopt_pages(toks, k_layers, v_layers)
 
 
+# ------------------------------------------------------- adapter namespaces
+# cluster adapter specs: (name, rank, alpha, seed) — alpha 64 so the
+# tiny model's greedy argmax genuinely moves under the adapter (tenant
+# streams must be OBSERVABLY distinct, or isolation tests prove nothing)
+_ADAPTER_SPECS = [("tenant-a", 4, 64.0, 11), ("tenant-b", 4, 64.0, 12)]
+
+
+def test_cluster_adapter_table_lockstep_with_engine_registration():
+    """cluster_adapter_table is a PROMISE about engine behaviour — spec i
+    lands at (slot i+1, epoch 1) — kept only because every worker
+    registers the specs in order on a fresh engine.  Pin the table to the
+    real registration path so a slot-assignment or epoch-bump change
+    breaks HERE, not as a silent cluster-wide cache mismatch."""
+    from paddle_tpu.serving.cluster_worker import _register_cluster_adapters
+    from paddle_tpu.serving.router import cluster_adapter_table
+
+    table = cluster_adapter_table(_ADAPTER_SPECS)
+    assert table == {"tenant-a": (1, 1), "tenant-b": (2, 1)}
+
+    eng = GenerationEngine(make_model(), prefix_cache=True,
+                           adapters={"rank": 4, "max_adapters": 2}, **_EKW)
+    _register_cluster_adapters(eng, {"adapters": _ADAPTER_SPECS})
+    for name, (slot, epoch) in table.items():
+        got = eng._slot_of(name)
+        assert got == slot, (name, got, slot)
+        assert eng._slot_epochs[got] == epoch
+    # re-registration (a snapshot-restored engine re-running boot) must
+    # leave resident names untouched: an epoch bump here would desync
+    # this engine's namespace from the rest of the fleet
+    _register_cluster_adapters(eng, {"adapters": _ADAPTER_SPECS})
+    assert eng._slot_epochs[1] == 1 and eng._slot_epochs[2] == 1
+
+
+def test_block_hashes_adapter_namespaces_disjoint():
+    # the ns seeds the hash CHAIN, so one prompt under base / tenant-a /
+    # tenant-a-after-epoch-bump / tenant-b yields pairwise-disjoint
+    # chains — the cluster index can never alias tenants' pages
+    chains = [block_hashes(P_G1, 8),
+              block_hashes(P_G1, 8, ns=(1, 1)),
+              block_hashes(P_G1, 8, ns=(1, 2)),
+              block_hashes(P_G1, 8, ns=(2, 1))]
+    for i in range(len(chains)):
+        for j in range(i + 1, len(chains)):
+            assert not set(chains[i]) & set(chains[j]), (i, j)
+
+
+def test_adopt_pages_adapter_namespace_isolation_and_stale_epoch():
+    """Shipped adapter pages land in exactly the (slot, epoch) namespace
+    pinned at SHIP time: the tenant's own admission prefix-hits them,
+    no other tenant (nor the base model) ever cross-matches, a stale
+    epoch strands the shipment LOUDLY, and a base engine refuses
+    namespaced pages outright."""
+    from paddle_tpu.nn.lora import adapter_prefill_scope
+    from paddle_tpu.serving import (decode_stats, lora_stats,
+                                    reset_decode_stats)
+    from paddle_tpu.serving.cluster_worker import (
+        _build_prefill_pack, _cluster_adapter_state, _prefill_pages,
+        _register_cluster_adapters)
+
+    m = make_model()
+    spec = {"adapters": _ADAPTER_SPECS}
+    # pages poured through tenant-a's weights, the prefill-worker path
+    pack = _build_prefill_pack(m, spec)
+    scope = adapter_prefill_scope(m.model.layers, pack, 1)
+    toks, k_l, v_l = _prefill_pages(m, P_G1, 1, _EKW["block_size"],
+                                    "bf16", scope=scope)
+
+    eng = GenerationEngine(m, prefix_cache=True,
+                           adapters={"rank": 4, "max_adapters": 2}, **_EKW)
+    _register_cluster_adapters(eng, spec)
+    assert eng.adopt_pages(toks, k_l, v_l, ns=(1, 1)) == 1
+    reset_decode_stats()
+    eng.add_request("qa", P_G1, max_new_tokens=4, adapter="tenant-a")
+    while eng.has_work():
+        eng.step()
+    st = decode_stats()
+    assert st["prefix_hits"] == 1 and st["prefix_hit_tokens"] == 8
+
+    # the OTHER tenant and the base model never match tenant-a's pages
+    for rid, adapter in (("qb", "tenant-b"), ("qc", None)):
+        reset_decode_stats()
+        eng.add_request(rid, P_G1, max_new_tokens=4, adapter=adapter)
+        while eng.has_work():
+            eng.step()
+        assert decode_stats()["prefix_hits"] == 0, (rid, adapter)
+    # and the tenants' streams are genuinely distinct computations
+    assert eng.result("qa") != eng.result("qc")
+    assert eng.result("qa") != eng.result("qb")
+
+    # stale epoch: tenant-a re-registers (epoch bumps), so a shipment
+    # pinned at the OLD epoch holds K/V this engine no longer serves —
+    # dropped loudly, never cached
+    eng.register_adapter("tenant-a", _cluster_adapter_state(m, 4, 99),
+                         alpha=64.0)
+    assert eng._slot_epochs[1] == 2
+    drops0 = lora_stats()["ship_ns_drops"]
+    assert eng.adopt_pages(toks, k_l, v_l, ns=(1, 1)) == 0
+    assert lora_stats()["ship_ns_drops"] == drops0 + 1
+
+    # a namespace this pack cannot name is a spec disagreement, not a
+    # droppable race
+    with pytest.raises(ValueError, match="out of range"):
+        eng.adopt_pages(toks, k_l, v_l, ns=(7, 1))
+
+    # a base engine must never accept adapter-poured K/V into its
+    # un-namespaced prefix cache
+    base = GenerationEngine(m, prefix_cache=True, **_EKW)
+    with pytest.raises(ValueError, match="without"):
+        base.adopt_pages(toks, k_l, v_l, ns=(1, 1))
+
+
 # ----------------------------------------------------------------- e2e tier
 def _mk_cluster(workdir, **kw):
     from paddle_tpu.serving.cluster import EngineCluster
@@ -614,11 +725,69 @@ def _cluster_priority_ahead_of_long(tmp_path):
         c.shutdown()
 
 
+def _cluster_adapter_e2e_tcp(tmp_path):
+    """Adapter-aware page shipping over the TcpRing data plane: tenant
+    requests prefill through their adapter's weights on the prefill
+    worker, ship namespaced pages, and the decode replica's admission
+    prefix-hits the ADOPTED pages — asserted through the router-side
+    cluster counter (`prefix_hit_tokens`, relayed as per-`done` deltas),
+    the cross-host cache contract of docs/SERVING_CLUSTER.md.  Streams
+    must match a single adapter engine's, and tenants must observably
+    diverge from each other and from the base model."""
+    from paddle_tpu.serving.cluster import cluster_stats, \
+        reset_cluster_stats
+    from paddle_tpu.serving.cluster_worker import _register_cluster_adapters
+
+    subs = [("a1", P_G1, dict(max_new_tokens=8, adapter="tenant-a")),
+            ("b1", P_G1, dict(max_new_tokens=8, adapter="tenant-b")),
+            ("base", P_G1, dict(max_new_tokens=8))]
+    ref_eng = GenerationEngine(make_model(),
+                               **dict(_EKW, max_batch=4),
+                               prefix_cache=True,
+                               adapters={"rank": 4, "max_adapters": 2})
+    _register_cluster_adapters(ref_eng, {"adapters": _ADAPTER_SPECS})
+    for rid, prompt, opts in subs:
+        ref_eng.add_request(rid, prompt, **opts)
+    while ref_eng.has_work():
+        ref_eng.step()
+    ref = {rid: ref_eng.result(rid) for rid, _p, _o in subs}
+
+    reset_cluster_stats()
+    c = _mk_cluster(tmp_path / "wd", num_replicas=2, num_prefill=1,
+                    adapters=_ADAPTER_SPECS, transport="tcp")
+    try:
+        with pytest.raises(KeyError, match="not a cluster adapter"):
+            c.submit("x", P_G1, max_new_tokens=4, adapter="tenant-z")
+        for rid, prompt, opts in subs:
+            c.submit(rid, prompt,
+                     max_new_tokens=opts["max_new_tokens"],
+                     adapter=opts.get("adapter"))
+        c.serve(timeout_s=240)
+        got = {rid: c.result(rid) for rid, _p, _o in subs}
+        assert got == ref, (got, ref)
+        # tenancy is observable: each tenant's stream diverges
+        assert got["a1"] != got["base"] and got["a1"] != got["b1"]
+        st = cluster_stats()
+        # THE acceptance counter: shipped namespaced pages were adopted
+        # and prefix-HIT by the tenant admissions on the decode replicas
+        # (P_G1 carries one full 8-token block per request)
+        assert st["prefix_hit_tokens"] >= 8, st
+        assert st["pages_shipped"] >= 3 and st["ship_bytes"] > 0
+        # and the whole exchange genuinely rode the socket plane
+        assert st["tcp_bytes"] > 0 and st["frames_sent"] > 0, st
+    finally:
+        c.shutdown()
+
+
 # The e2e payloads fork real engine processes and kill them; each runs in
 # tier-1 through the dedicated isolated worker for this module, and the
 # pieces run as separate pytest cases for attribution.
 def test_cluster_e2e_matches_single_engine(tmp_path):
     _cluster_e2e_matches_single_engine(tmp_path)
+
+
+def test_cluster_adapter_tenants_prefix_hit_shipped_pages_tcp(tmp_path):
+    _cluster_adapter_e2e_tcp(tmp_path)
 
 
 def test_cluster_priority_completes_ahead_of_long_prefill(tmp_path):

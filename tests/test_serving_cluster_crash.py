@@ -25,8 +25,13 @@ participant at one enumerated protocol point:
 Every completed run must produce streams BIT-IDENTICAL to the unkilled
 reference — zero accepted requests lost, no stream corrupted, no request
 served twice (the router's canonical per-position merge enforces all
-three).  This module forks and kills real processes: it rides a DEDICATED
-tools/run_tier1.py isolated worker, never the shared shard."""
+three).  The worker and standby matrices run TWICE — once over ShmRing
+(single box) and once over the TcpRing socket data plane between two
+localhost "hosts" (serving/transport.py), both compared against the ONE
+shm reference, so a kill that tears live TCP connections mid-frame must
+still recover bit-exactly.  This module forks and kills real processes:
+it rides a DEDICATED tools/run_tier1.py isolated worker, never the
+shared shard."""
 
 import json
 import os
@@ -55,7 +60,7 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 from paddle_tpu.serving.cluster import EngineCluster, cluster_stats
 
 (workdir, out_path, model_spec, router_kill, worker_role, worker_kill,
- snapshot_interval, standby, wait_standby) = sys.argv[1:10]
+ snapshot_interval, standby, wait_standby, transport) = sys.argv[1:11]
 
 worker_kill_map = {}
 if worker_kill.startswith("{"):
@@ -79,7 +84,7 @@ c = EngineCluster(model_spec, num_replicas=2, num_prefill=1,
                   heartbeat_ms=100, miss_threshold=10,
                   snapshot_interval=int(snapshot_interval),
                   kill=router_kill, worker_kill=worker_kill_map,
-                  standby=int(standby))
+                  standby=int(standby), transport=transport)
 try:
     if int(wait_standby):
         # the case under test is PROMOTION: the kill must find a WARM
@@ -109,7 +114,7 @@ _MODEL_SPEC = os.path.join(_HERE, "cluster_common.py") + ":make_model"
 
 def _run_driver(tmp_path, workdir, out, router_kill="", worker_role="",
                 worker_kill="", snapshot_interval=0, standby=0,
-                wait_standby=0):
+                wait_standby=0, transport="shm"):
     script = tmp_path / "driver.py"
     script.write_text(_DRIVER)
     repo_root = os.path.dirname(_HERE)
@@ -119,21 +124,30 @@ def _run_driver(tmp_path, workdir, out, router_kill="", worker_role="",
     env.setdefault("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
     cmd = [sys.executable, str(script), str(workdir), str(out),
            _MODEL_SPEC, router_kill, worker_role, worker_kill,
-           str(snapshot_interval), str(standby), str(wait_standby)]
+           str(snapshot_interval), str(standby), str(wait_standby),
+           transport]
     return subprocess.run(cmd, capture_output=True, text=True, timeout=480,
                           env=env)
 
 
 @pytest.fixture(scope="module")
 def reference(tmp_path_factory):
-    """The unkilled cluster run: the streams every killed variant must
-    reproduce token for token."""
+    """The unkilled (shm) cluster run: the streams every killed variant
+    — on EITHER transport — must reproduce token for token.  Comparing
+    tcp runs against the shm reference additionally pins stream
+    transport-independence: the data plane may reorder wall-clock, never
+    tokens."""
     td = tmp_path_factory.mktemp("cluster_ref")
     out = td / "ref.json"
     r = _run_driver(td, td / "wd", out)
     assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
     return json.loads(out.read_text())
 
+
+# shm: process-shared rings (single box); tcp: TcpRing sockets between
+# two localhost "hosts" (serving/transport.py) — the FULL kill matrix
+# re-runs on each data plane, bit-exact against the one shm reference
+_TRANSPORTS = ["shm", "tcp"]
 
 # (who dies, at which protocol point, boundary snapshots armed?)
 _WORKER_MATRIX = [
@@ -146,19 +160,23 @@ _WORKER_MATRIX = [
 ]
 
 
+@pytest.mark.parametrize("transport", _TRANSPORTS)
 @pytest.mark.parametrize("role,point,snap", _WORKER_MATRIX,
                          ids=[p for _r, p, _s in _WORKER_MATRIX])
 def test_worker_kill_matrix_streams_bit_identical(tmp_path, reference,
-                                                  role, point, snap):
+                                                  role, point, snap,
+                                                  transport):
     """SIGKILL one worker process at the named point: the router detects
     the death (heartbeats/child-exit), re-dispatches every accepted-but-
     unfinished request (replayed from the intake log, restored from the
     dead replica's boundary snapshot, or re-shipped through a fresh
     prefill worker), and the completed streams equal the unkilled run's
-    bit for bit."""
+    bit for bit — on the shm plane and again over TcpRing sockets, where
+    the kill also tears the victim's live connections mid-frame."""
     out = tmp_path / "out.json"
     r = _run_driver(tmp_path, tmp_path / "wd", out, worker_role=role,
-                    worker_kill=point, snapshot_interval=snap)
+                    worker_kill=point, snapshot_interval=snap,
+                    transport=transport)
     assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
     got = json.loads(out.read_text())
     assert got == reference, (got, reference)
@@ -176,18 +194,21 @@ def test_worker_kill_matrix_streams_bit_identical(tmp_path, reference,
         assert stats["ship_retries"] >= 1, stats
 
 
+@pytest.mark.parametrize("transport", _TRANSPORTS)
 def test_standby_promotion_claims_snapshot_bit_identical(tmp_path,
-                                                         reference):
+                                                         reference,
+                                                         transport):
     """Warm-standby fail-over (ROADMAP item 5): a decode replica is
     SIGKILLed mid-stream with boundary snapshots armed and a WARM standby
     parked.  The standby is PROMOTED — no process spawns — claims the
     dead replica's snapshot directory, restores its residents, and every
     completed stream equals the unkilled run's bit for bit (the
-    bit-exact fail-over contract re-asserted on the promotion path)."""
+    bit-exact fail-over contract re-asserted on the promotion path, on
+    both data planes)."""
     out = tmp_path / "out.json"
     r = _run_driver(tmp_path, tmp_path / "wd", out, worker_role="decode",
                     worker_kill="decode-mid-stream:2", snapshot_interval=1,
-                    standby=1, wait_standby=1)
+                    standby=1, wait_standby=1, transport=transport)
     assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
     got = json.loads(out.read_text())
     assert got == reference, (got, reference)
@@ -199,8 +220,10 @@ def test_standby_promotion_claims_snapshot_bit_identical(tmp_path,
     assert stats["respawns"] == 0, stats
 
 
+@pytest.mark.parametrize("transport", _TRANSPORTS)
 def test_standby_killed_mid_warmup_falls_back_to_respawn(tmp_path,
-                                                         reference):
+                                                         reference,
+                                                         transport):
     """The standby ITSELF is SIGKILLed mid-warmup, then a decode replica
     dies mid-stream before the backfilled standby can warm: recovery
     falls back to the (cache-warmed) respawn path.  Zero requests lost,
@@ -210,7 +233,7 @@ def test_standby_killed_mid_warmup_falls_back_to_respawn(tmp_path,
                         "decode:0": "decode-mid-stream:1"})
     out = tmp_path / "out.json"
     r = _run_driver(tmp_path, tmp_path / "wd", out, worker_kill=kills,
-                    snapshot_interval=1, standby=1)
+                    snapshot_interval=1, standby=1, transport=transport)
     assert "DONE" in r.stdout, (r.stdout + r.stderr)[-3000:]
     got = json.loads(out.read_text())
     assert got == reference, (got, reference)
@@ -224,17 +247,23 @@ def test_standby_killed_mid_warmup_falls_back_to_respawn(tmp_path,
     assert stats["respawns"] >= 1 or stats["promotions"] >= 1, stats
 
 
-@pytest.mark.parametrize("router_kill,snap", [
-    ("router-after-accept:1", 0),
-    ("router-mid-serving:1", 0),
+@pytest.mark.parametrize("router_kill,snap,transport", [
+    ("router-after-accept:1", 0, "shm"),
+    ("router-mid-serving:1", 0, "shm"),
     # boundary snapshots armed: the restarted router's replicas RESTORE
     # and claim their residents via resume reports — the replay backlog
     # must hold for those claims instead of double-dispatching the same
     # rids onto other replicas
-    ("router-mid-serving:1", 1),
-], ids=["after-accept", "mid-serving", "mid-serving-snapshots"])
+    ("router-mid-serving:1", 1, "shm"),
+    # over TcpRing the restarted router binds FRESH listener ports and
+    # re-publishes every ep:<ring> key on its new control store — the
+    # orphan sweep plus endpoint re-publication path
+    ("router-mid-serving:1", 0, "tcp"),
+], ids=["after-accept", "mid-serving", "mid-serving-snapshots",
+        "mid-serving-tcp"])
 def test_router_kill_then_restart_replays_intake_log(tmp_path, reference,
-                                                     router_kill, snap):
+                                                     router_kill, snap,
+                                                     transport):
     """SIGKILL the ROUTER PROCESS itself (after journaling the first
     acceptance / after delivering the first token event): a fresh router
     over the same workdir sweeps the orphaned workers, replays the
@@ -243,12 +272,14 @@ def test_router_kill_then_restart_replays_intake_log(tmp_path, reference,
     bit-identically.  An accepted request never dies with the router."""
     wd = tmp_path / "wd"
     r = _run_driver(tmp_path, wd, tmp_path / "x.json",
-                    router_kill=router_kill, snapshot_interval=snap)
+                    router_kill=router_kill, snapshot_interval=snap,
+                    transport=transport)
     assert r.returncode == -signal.SIGKILL, (r.stdout + r.stderr)[-3000:]
     assert os.path.exists(wd / "intake.jsonl")
 
     out = tmp_path / "resumed.json"
-    r2 = _run_driver(tmp_path, wd, out, snapshot_interval=snap)
+    r2 = _run_driver(tmp_path, wd, out, snapshot_interval=snap,
+                     transport=transport)
     assert "DONE" in r2.stdout, (r2.stdout + r2.stderr)[-3000:]
     got = json.loads(out.read_text())
     assert got == reference, (got, reference)

@@ -43,6 +43,15 @@ skip silently on pre-cluster payloads.  A fail-over run that LOST a
 request records rc != 0 and is skipped as unhealthy rather than gated:
 zero-loss is an acceptance criterion, not a trend.
 
+Cluster payloads carrying the transport section (bench_cluster.py
+detail.transport: {"kind", "tcp_bytes", "reconnects", "frames_sent",
+"frames_recv"}) gate the SOCKET data plane when both sides ran
+--transport tcp: reconnects must not grow at all (a localhost cluster
+run never legitimately drops a connection — any new reconnect is a
+transport bug, not jitter) and tcp_bytes growth beyond the regular
+--threshold means framing overhead regressed.  Pre-transport payloads
+(no section) and shm runs skip silently.
+
 Training payloads carrying the pipeline-schedule section (bench.py
 detail.pipeline.schedules: per-schedule bubble fraction from the static
 simulator, fleet/meta_parallel/schedules.py) gate each schedule's bubble
@@ -165,6 +174,18 @@ def load_failover(path):
         return None
     fo = (data.get("detail") or {}).get("failover")
     return fo if isinstance(fo, dict) else None
+
+
+def load_transport(path):
+    """The transport section of a cluster bench payload (bench_cluster.py
+    detail.transport: {"kind", "tcp_bytes", "reconnects", "frames_sent",
+    "frames_recv"}), or None when absent — payloads written before the
+    socket data plane existed skip the gate silently."""
+    data, _err = _payload_dict(path)
+    if not isinstance(data, dict):
+        return None
+    tr = (data.get("detail") or {}).get("transport")
+    return tr if isinstance(tr, dict) else None
 
 
 def load_pipeline(path):
@@ -325,6 +346,36 @@ def main(argv=None):
                       f"{o:.1f} -> {n:.1f} ms ({rel:+.2%}) {stat}")
                 if stat == "REGRESSION":
                     rc = 1
+
+    # transport gate (socket data plane): only when BOTH sides ran the
+    # tcp transport.  Pre-transport payloads (no detail.transport) and
+    # shm runs skip silently — a silent skip, never a fabricated signal.
+    old_tr, new_tr = load_transport(args.old), load_transport(args.new)
+    if (old_tr and new_tr
+            and old_tr.get("kind") == "tcp" and new_tr.get("kind") == "tcp"):
+        try:
+            o_rc = int(old_tr.get("reconnects", 0))
+            n_rc = int(new_tr.get("reconnects", 0))
+        except (TypeError, ValueError):
+            o_rc = n_rc = 0
+        # reconnects are not jitter: a localhost bench never legitimately
+        # drops a connection, so ANY growth is a transport regression
+        stat = "REGRESSION" if n_rc > o_rc else "ok"
+        print(f"bench gate [transport reconnects]: {o_rc} -> {n_rc} {stat}")
+        if stat == "REGRESSION":
+            rc = 1
+        try:
+            o_b = float(old_tr.get("tcp_bytes", 0))
+            n_b = float(new_tr.get("tcp_bytes", 0))
+        except (TypeError, ValueError):
+            o_b = n_b = 0.0
+        if o_b > 0 and n_b > 0:
+            rel = (n_b - o_b) / o_b
+            stat = "REGRESSION" if rel > args.threshold else "ok"
+            print(f"bench gate [transport tcp_bytes]: {o_b:.0f} -> "
+                  f"{n_b:.0f} ({rel:+.2%}) {stat}")
+            if stat == "REGRESSION":
+                rc = 1
 
     # pipeline-schedule gate: per-schedule simulator bubble fraction,
     # LOWER is better (growth means the schedule table regressed — the

@@ -8,11 +8,13 @@ tools/lint_mesh.py:
       Battery mode — (1) asserts the spec <-> handler binding both ways
       (every serving/protocol.py message with a router/worker handler,
       every handler with a spec row); (2) exhaustively model-checks the
-      REAL protocol over both transport semantics (ShmRing, TCP stub
-      with its connection-drop transition) and requires ZERO invariant
-      violations and ZERO deadlocks; (3) runs every seeded-violation
-      scenario (dropped intake fsync, lethal ring timeout, two routers
-      replaying one journal) and requires each to produce a minimal
+      REAL protocol over all three transport semantics (ShmRing, the
+      drop-as-death TCP stub, and the real TcpRing whose drop is a
+      redial + at-least-once frame duplication) and requires ZERO
+      invariant violations and ZERO deadlocks; (3) runs every
+      seeded-violation scenario (dropped intake fsync, lethal ring
+      timeout, two routers replaying one journal, a TcpRing teardown
+      shrugged off as backpressure) and requires each to produce a minimal
       counterexample trace naming the violated invariant — printed, so
       the battery output doubles as protocol documentation; (4) runs
       the blocking-call AST lint over the real serving/ +
@@ -62,8 +64,8 @@ def _protocol_checks() -> int:
         print(f"FAIL spec-handler-binding: {e}")
         failures += 1
 
-    # ---- the real spec must explore clean on BOTH transports --------
-    for scenario in ("clean-shmring", "clean-tcp"):
+    # ---- the real spec must explore clean on EVERY transport --------
+    for scenario in ("clean-shmring", "clean-tcp", "clean-tcp-ring"):
         res = pl.check_model(scenario)
         failures += _report(
             f"model-{scenario} ({res.states} states, "
