@@ -189,7 +189,10 @@ def main():
     # parity gate unchanged — zero lost, bit-exact fail-over streams
     transport = os.environ.get("PADDLE_TPU_BENCH_TRANSPORT", "shm")
     if "--transport" in sys.argv:
-        transport = sys.argv[sys.argv.index("--transport") + 1]
+        i = sys.argv.index("--transport")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--transport needs a value: shm | tcp")
+        transport = sys.argv[i + 1]
     # workers share the tier-1 persistent compile cache when present
     os.environ.setdefault("PADDLE_TPU_TEST_CACHE_DIR", "/tmp/jax_cache")
 

@@ -687,8 +687,11 @@ class EngineCluster:
         self._kill.hit("router-mid-serving")
 
     def _ev_done(self, w, msg):
-        self.router.on_done(msg["rid"], msg["n"])
-        _CLUSTER_STATS["prefix_hit_tokens"] += int(msg.get("hit_toks") or 0)
+        # hit_toks is a watermark DELTA and the wire is at-least-once:
+        # a `done` redelivered whole after a TcpRing drop must not
+        # double-count it, so the add rides first-completion only.
+        if self.router.on_done(msg["rid"], msg["n"]):
+            _CLUSTER_STATS["prefix_hit_tokens"] += int(msg.get("hit_toks") or 0)
 
     def _ev_requeue(self, w, msg):
         req = self.router.request(msg["rid"])

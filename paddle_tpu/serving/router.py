@@ -462,9 +462,13 @@ class RequestRouter:
         return self._merge(req, start, toks)
 
     def on_done(self, rid, total):
+        """Mark `rid` complete.  Returns True only on the FIRST
+        completion — the wire is at-least-once (TcpRing re-sends its
+        in-flight frame whole after a drop), so callers folding `done`
+        payload deltas into counters must gate on this."""
         req = self._reqs.get(rid)
         if req is None:
-            return
+            return False
         if len(req.tokens) != total:
             raise RuntimeError(
                 f"request {rid!r}: replica reports {total} tokens done, "
@@ -475,6 +479,7 @@ class RequestRouter:
             self._outstanding.get(req.owner, set()).discard(rid)
         if first_done and self.log is not None:
             self.log.append({"ev": "done", "rid": rid, "n": total})
+        return first_done
 
     # ------------------------------------------------------------ fail-over
     def on_replica_dead(self, rank):

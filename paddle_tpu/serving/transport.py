@@ -12,7 +12,11 @@ the EXACT producer/consumer contract of `_native.ShmRing`:
 - ``pop(timeout_ms)``          next whole frame, ``None`` once the peer
   closed and the queue drained, ``TimeoutError`` at the deadline.
   Partial frames persist across pops (torn-frame tolerance): a frame
-  split over many TCP segments assembles invisibly.
+  split over many TCP segments assembles invisibly.  The capacity bound
+  holds END-TO-END: the rx thread stops draining the socket past
+  ``capacity`` buffered-unpopped bytes, so TCP flow control backs the
+  pipe up until the remote push genuinely blocks — a stalled consumer
+  bounds its producer exactly like shm, not just the send window.
 - ``close()`` / ``destroy()``  graceful close (a CLOSE sentinel frame
   rides the wire so the peer's pop drains to ``None``) / teardown.
 
@@ -117,6 +121,7 @@ class TcpRing:
         self._sendq = collections.deque()   # framed bytes, head = in flight
         self._send_bytes = 0
         self._recvq = collections.deque()   # whole payloads, ready to pop
+        self._recv_bytes = 0                # payload bytes parked in _recvq
         self._rbuf = bytearray()            # partial frame across segments
         self._conn = None
         self._conn_gen = 0
@@ -211,6 +216,20 @@ class TcpRing:
                 if self._destroyed:
                     return
                 conn, gen = self._conn, self._conn_gen
+                # Strict >: _rbuf holds at most ONE partial frame (parse
+                # runs on every recv), so a max-size frame with an empty
+                # recvq reaches exactly `capacity` buffered and must
+                # still complete — `>=` would park it forever.
+                if (conn is not None and self._recv_bytes
+                        + len(self._rbuf) > self.capacity):
+                    # Receiver-side backpressure: a consumer that stops
+                    # popping must stall the remote producer, or the
+                    # capacity contract only bounds the SEND window and
+                    # this queue grows without limit.  Stop draining the
+                    # socket; TCP flow control fills the sender's kernel
+                    # buffer until its push() genuinely blocks.
+                    self._cv.wait(0.2)
+                    continue
             if conn is None:
                 self._reconnect_step()
                 continue
@@ -264,6 +283,7 @@ class TcpRing:
             payload = bytes(self._rbuf[_HDR.size:_HDR.size + n])
             del self._rbuf[:_HDR.size + n]
             self._recvq.append(payload)
+            self._recv_bytes += len(payload)
 
     def _tx_loop(self):
         while True:
@@ -275,10 +295,7 @@ class TcpRing:
                     return
                 conn, gen = self._conn, self._conn_gen
                 frame = self._sendq[0]
-            try:
-                conn.sendall(frame)
-            except OSError:
-                self._drop(gen)
+            if not self._send_frame(conn, gen, frame):
                 continue
             with self._cv:
                 if (self._conn_gen != gen or not self._sendq
@@ -293,6 +310,39 @@ class TcpRing:
             _bump("tcp_bytes", len(frame))
             if frame is not _CLOSE_FRAME:
                 _bump("frames_sent")
+
+    _SEND_CHUNK = 1 << 16
+
+    def _send_frame(self, conn, gen, frame):
+        """Write one frame in bounded chunks.  The socket's 0.2s timeout
+        bounds the TOTAL duration of ``sendall`` (not per-syscall), so a
+        frame larger than the kernel send buffer — routine for multi-MB
+        ship_block K/V payloads on a real cross-host link — would time
+        out mid-send forever if sent whole: timeout -> treated as drop
+        -> reconnect -> re-send the SAME frame -> timeout again, a
+        livelock loopback tests cannot reproduce.  Chunking makes the
+        timeout per-chunk, so any progress resets the clock; a chunk
+        timeout means the kernel buffer is full (peer not draining) and
+        is BACKPRESSURE — retry on the same connection — while only a
+        real socket error is a drop.  Returns True when the frame went
+        out whole on this connection."""
+        view = memoryview(frame)
+        off = 0
+        while off < len(view):
+            with self._cv:
+                if self._destroyed or self._conn_gen != gen:
+                    # dropped (or torn down) mid-frame: the peer discards
+                    # its torn partial; the frame stays at the sendq head
+                    # and is re-sent whole on the replacement connection
+                    return False
+            try:
+                off += conn.send(view[off:off + self._SEND_CHUNK])
+            except socket.timeout:
+                continue  # kernel buffer full: backpressure, not death
+            except OSError:
+                self._drop(gen)
+                return False
+        return True
 
     # ------------------------------------------------------ ring contract
     def push(self, data: bytes, timeout_ms=-1):
@@ -326,6 +376,7 @@ class TcpRing:
             while True:
                 if self._recvq:
                     payload = self._recvq.popleft()
+                    self._recv_bytes -= len(payload)
                     self._cv.notify_all()
                     _bump("frames_recv")
                     return payload

@@ -92,6 +92,19 @@ def _floor_checked(extra_args, transport):
         assert ft["standby"] * 2 <= ft["cold"], ft
 
 
+def test_transport_flag_missing_value_is_a_clean_error():
+    # `--transport` as the LAST argument: a usage error, not an
+    # IndexError traceback (the parse runs before any bench work)
+    env = dict(os.environ, PADDLE_TPU_BENCH_CPU="1", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benchmarks",
+                                      "bench_cluster.py"), "--transport"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=_REPO)
+    assert r.returncode != 0
+    assert "needs a value" in (r.stdout + r.stderr)
+    assert "IndexError" not in r.stderr
+
+
 def test_bench_cluster_smoke_payload():
     _floor_checked((), "shm")
 

@@ -302,6 +302,39 @@ def test_request_router_dedup_merge_and_redispatch(tmp_path):
     assert r2.request("a").owner == 1 and r2.request("c").owner is None
 
 
+def test_done_redelivery_counts_hit_toks_once():
+    """REVIEW regression: the wire is at-least-once (TcpRing re-sends
+    its in-flight frame whole after a drop), and `done` carries the
+    prefix-hit watermark as a DELTA — a redelivered `done` must not
+    double-count it into `prefix_hit_tokens`.  on_done returns True only
+    on the FIRST completion and the handler gates the add on it."""
+    from paddle_tpu.serving import cluster as cl
+
+    r = RequestRouter(block_size=4)
+    r.add_replica(0)
+    r.submit("a", [1, 2, 3], max_new=1, temperature=0.0, seed=0)
+    r.assign("a", 0)
+    r.on_tokens("a", 0, [7])
+    assert r.on_done("a", 1) is True
+    assert r.on_done("a", 1) is False  # redelivered: not first
+    assert r.on_done("ghost", 0) is False  # unknown rid: never counted
+
+    class _Shell:
+        router = r
+
+    r.submit("b", [4, 5], max_new=1, temperature=0.0, seed=0)
+    r.assign("b", 0)
+    r.on_tokens("b", 0, [9])
+    before = cl._CLUSTER_STATS["prefix_hit_tokens"]
+    try:
+        msg = {"rid": "b", "n": 1, "hit_toks": 8}
+        cl.EngineCluster._ev_done(_Shell(), None, msg)
+        cl.EngineCluster._ev_done(_Shell(), None, dict(msg))  # dup frame
+        assert cl._CLUSTER_STATS["prefix_hit_tokens"] - before == 8
+    finally:
+        cl._CLUSTER_STATS["prefix_hit_tokens"] = before
+
+
 def test_router_pick_replica_affinity_then_load():
     r = RequestRouter(block_size=4)
     for i in range(3):

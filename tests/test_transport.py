@@ -294,3 +294,84 @@ def test_stats_reset_zeroes_counters():
     assert out["frames_sent"] >= 1  # the pre-reset snapshot is returned
     assert transport_stats() == {"tcp_bytes": 0, "reconnects": 0,
                                  "frames_sent": 0, "frames_recv": 0}
+
+
+def test_large_frame_outlives_send_timeout_no_reconnect():
+    """REVIEW regression: the socket's 0.2s timeout bounds the TOTAL
+    duration of ``sendall``, so a frame bigger than the kernel send
+    buffer used to time out mid-send, get treated as a connection drop,
+    and livelock (reconnect -> re-send whole -> time out again) while
+    the receiver stalled.  The chunked send must ride out a stalled
+    reader as BACKPRESSURE — progress resets the clock, zero drops."""
+    ring = TcpRing("chunked", capacity=8 << 20, create=True)
+    s_tx, s_rx = socket.socketpair()
+    try:
+        # a small kernel buffer + a reader parked well past the 0.2s
+        # socket timeout forces multiple per-chunk timeouts
+        s_tx.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 14)
+        s_tx.settimeout(0.2)
+        frame = bytes(range(256)) * 8192  # 2 MiB >> SNDBUF
+        out = {}
+
+        def _send():
+            out["ok"] = ring._send_frame(s_tx, ring._conn_gen, frame)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        time.sleep(0.6)  # >= 2 chunk timeouts while nobody reads
+        got = bytearray()
+        s_rx.settimeout(10)
+        while len(got) < len(frame):
+            data = s_rx.recv(1 << 16)
+            assert data, "sender gave up mid-frame"
+            got += data
+        t.join(timeout=10)
+        assert not t.is_alive(), "send never completed"
+        assert out["ok"] is True
+        assert bytes(got) == frame  # intact, exactly once
+        assert transport_stats()["reconnects"] == 0
+    finally:
+        s_tx.close()
+        s_rx.close()
+        ring.destroy()
+
+
+def test_receiver_backpressure_bounds_memory_and_stalls_push():
+    """REVIEW regression: the rx thread used to drain the socket into
+    an UNBOUNDED queue regardless of pop() rate, so a stalled consumer
+    let the producer run arbitrarily far ahead — ShmRing's capacity
+    contract did not hold end-to-end.  With recv paused past capacity,
+    TCP flow control must back the pipe up until push() itself times
+    out, with receiver-side buffering bounded near capacity."""
+    cap = 1 << 16
+    a, b = _pair(capacity=cap)
+    try:
+        seq_size = 1 << 15  # 32 KiB payloads, each well under capacity
+        pushed = 0
+        stalled = False
+        # 32 MiB ceiling: far beyond capacity + any autotuned kernel
+        # socket buffering, so an unbounded receiver would swallow it
+        # all without ever stalling the producer
+        for i in range(1024):
+            payload = _HDR.pack(i) + b"p" * (seq_size - _HDR.size)
+            try:
+                a.push(payload, timeout_ms=400)
+            except TimeoutError:
+                stalled = True
+                break
+            pushed += 1
+        assert stalled, "push never felt the stalled consumer"
+        with b._cv:
+            buffered = b._recv_bytes + len(b._rbuf)
+        assert buffered <= cap + (1 << 16), buffered  # one recv of slack
+        # nothing was lost or duplicated under the stall: every accepted
+        # frame arrives, in order, once the consumer drains
+        for i in range(pushed):
+            got = b.pop(timeout_ms=10_000)
+            assert got is not None and _HDR.unpack_from(got)[0] == i
+        with pytest.raises(TimeoutError):
+            b.pop(timeout_ms=100)
+        assert transport_stats()["reconnects"] == 0
+    finally:
+        a.destroy()
+        b.destroy()
